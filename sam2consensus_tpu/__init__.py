@@ -1,0 +1,24 @@
+"""sam2consensus-tpu: a TPU-native consensus-calling framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+``zoujiayun/sam2consensus`` (reference at ``/root/reference``, analyzed in
+SURVEY.md): SAM pileup → Geneious-style threshold consensus with IUPAC
+ambiguity codes, one FASTA per reference.
+
+Two backends sit behind the ``ConsensusBackend`` boundary:
+
+* ``cpu`` — the golden oracle, a spec-faithful Python 3 implementation of the
+  reference algorithm (quirks included);
+* ``jax`` — the TPU path: vectorized read→event encoding, scatter-add pileup
+  into a flat ``[total_positions, 6]`` count tensor, a closed-form threshold
+  vote vmapped over thresholds, shard_map data parallelism with ``psum`` over
+  ICI, and a Pallas segmented-reduce kernel for the insertion table.
+
+Both produce byte-identical FASTA output — that is the framework's
+correctness gate.
+"""
+
+__version__ = "0.1.0"
+
+from .config import RunConfig  # noqa: F401
+from .backends.base import BackendResult, ConsensusBackend, FastaRecord  # noqa: F401

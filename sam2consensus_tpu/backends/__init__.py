@@ -1,0 +1,1 @@
+from .base import BackendResult, BackendStats, ConsensusBackend, FastaRecord  # noqa: F401

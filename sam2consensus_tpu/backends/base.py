@@ -1,0 +1,53 @@
+"""The ``ConsensusBackend`` operator boundary (SURVEY.md §2b).
+
+The reference is a monolith; the new framework splits it at the natural seam:
+everything between "decoded SAM records" and "per-reference FASTA records"
+is a backend.  Both backends must produce byte-identical FASTA text — that is
+the framework's correctness gate (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Protocol, Tuple
+
+from ..config import RunConfig
+from ..io.fasta import FastaRecord  # noqa: F401  (canonical home: io.fasta)
+from ..io.sam import Contig, SamRecord
+
+
+@dataclass
+class BackendStats:
+    reads_mapped: int = 0
+    reads_skipped: int = 0      # permissive-mode drops (strict=False only)
+    aligned_bases: int = 0      # M/=/X + counted gap bases (pileup increments)
+    consensus_bases: int = 0    # emitted consensus characters across outputs
+    extra: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class BackendResult:
+    """Per-reference FASTA records, in contig file order, threshold order."""
+    fastas: Dict[str, List[FastaRecord]]
+    stats: BackendStats
+
+
+class ConsensusBackend(Protocol):
+    name: str
+
+    def run(self, contigs: List[Contig], records: Iterable[SamRecord],
+            cfg: RunConfig) -> BackendResult: ...
+
+
+def format_header(prefix: str, threshold: float, refname: str,
+                  sumcov: int, seq: str) -> str:
+    """FASTA header, field-for-field per sam2consensus.py:394-397.
+
+    ``coverage`` is ``round(sumcov/len(seq), 2)`` rendered via ``str``;
+    ``length`` strips only ``"-"`` so a non-gap fill char counts (quirk 10).
+    """
+    return (">" + prefix + "|c" + str(int(threshold * 100))
+            + " reference:" + refname
+            + " coverage:" + str(round(float(sumcov) / float(len(seq)), 2))
+            + " length:" + str(len(seq.replace("-", "")))
+            + " consensus_threshold:" + str(int(threshold * 100)) + "%")

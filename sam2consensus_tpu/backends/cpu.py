@@ -1,0 +1,218 @@
+"""CPU golden backend: spec-faithful Python 3 oracle.
+
+This is a deliberate, documented re-implementation of the reference
+algorithm's *semantics* (``/root/reference/sam2consensus.py``, analyzed in
+SURVEY.md §2) — the reference itself is Python-2-only (``iteritems`` at
+``:242,:247,:299,:304``) and cannot run here.  Every quirk that shapes output
+bytes is reproduced:
+
+* pileup over the fixed ``-ACGNT`` alphabet, gaps and Ns counted into
+  coverage (``:237``, quirk 5);
+* the per-read deletion gate: total gap length > maxdel ⇒ gap bases skipped
+  but the cursor still advances (``:210-218``);
+* negative Python-style indexing when POS-1 + leading deletions goes below
+  zero (list indexing at ``:212`` wraps within the contig);
+* count→nucleotide-group inversion with *group totals* (count × group size,
+  ``:241-252``);
+* the insertion "mini-alignment of motifs" with coverage-completion of the
+  gap lane — which may go negative (``:256-311``, quirk 4);
+* greedy threshold vote with tie groups all-or-nothing, compared against
+  ``t * coverage`` in float (``:359-366``);
+* insertion columns voted against the *position's* coverage and emitted after
+  the position's base (right-shift placement, quirks 3/8);
+* zero-coverage reference pruning (``:334-340``) and empty-sequence dropping
+  (``:400-406``).
+
+Everything here is Python dict/loop code on purpose: it is the oracle, and
+its clarity is the proof of the spec.  The JAX backend must match its output
+byte for byte.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+from ..config import RunConfig
+from ..constants import AMB, ALPHABET
+from ..core.cigar import walk
+from ..io.sam import Contig, SamRecord
+from .base import BackendResult, BackendStats, FastaRecord, format_header
+
+
+def _fresh_counts() -> Dict[str, int]:
+    return {s: 0 for s in ALPHABET}
+
+
+class CpuBackend:
+    name = "cpu"
+
+    def run(self, contigs: List[Contig], records: Iterable[SamRecord],
+            cfg: RunConfig) -> BackendResult:
+        stats = BackendStats()
+
+        # --- allocation (header pass, sam2consensus.py:160-169) ---
+        # Duplicate @SQ names overwrite like the reference's dict assignment
+        # (last LN wins); iteration order is first-seen, as in py3 dicts.
+        lengths: Dict[str, int] = {}
+        for c in contigs:
+            lengths[c.name] = c.length
+        order = list(lengths)
+        sequences = {name: [_fresh_counts() for _ in range(length)]
+                     for name, length in lengths.items()}
+        coverages = {name: [0] * length for name, length in lengths.items()}
+        insertions: Dict[str, list] = {name: [] for name in lengths}
+
+        # --- accumulation (sam2consensus.py:191-221) ---
+        for rec in records:
+            try:
+                seqs_ref = sequences[rec.refname]
+            except KeyError:
+                if cfg.strict:
+                    raise KeyError(
+                        f"read mapped to unknown reference {rec.refname!r} "
+                        "(reference would KeyError here too)") from None
+                stats.reads_skipped += 1
+                continue
+            seqout, insert = walk(rec.cigar, rec.seq, rec.pos)
+            pos_ref = rec.pos
+            # Validate the whole read *before* touching the pileup so a
+            # permissive-mode skip leaves no partial increments behind.
+            span_end = pos_ref + len(seqout)
+            in_bounds = -len(seqs_ref) <= pos_ref and span_end <= len(seqs_ref)
+            valid_bases = all(ch in "-ACGNT" for ch in seqout)
+            if not (in_bounds and valid_bases):
+                if cfg.strict:
+                    if not in_bounds:
+                        raise IndexError(
+                            f"read at pos {rec.pos} spans [{rec.pos},"
+                            f" {span_end}) outside reference "
+                            f"{rec.refname!r} of length {len(seqs_ref)} "
+                            "(reference would IndexError here too)")
+                    raise KeyError(
+                        f"read contains out-of-alphabet base at pos {rec.pos} "
+                        "(input contract is uppercase ACGTN; the reference "
+                        "would KeyError here too)")
+                stats.reads_skipped += 1
+                continue
+            if cfg.maxdel is None or seqout.count("-") <= cfg.maxdel:
+                for nuc in seqout:
+                    seqs_ref[pos_ref][nuc] += 1
+                    stats.aligned_bases += 1
+                    pos_ref += 1
+            else:
+                for nuc in seqout:
+                    if nuc != "-":
+                        seqs_ref[pos_ref][nuc] += 1
+                        stats.aligned_bases += 1
+                    pos_ref += 1
+            insertions[rec.refname] += insert
+            stats.reads_mapped += 1
+
+        # --- reformat + insertion table (sam2consensus.py:233-311) ---
+        for refname in order:
+            for pos in range(len(coverages[refname])):
+                coverages[refname][pos] = sum(sequences[refname][pos].values())
+                count_nucs: Dict[int, List[str]] = {}
+                for key, value in sequences[refname][pos].items():
+                    if value != 0:
+                        count_nucs.setdefault(value, []).append(key)
+                groups = sorted(count_nucs.items(), reverse=True)
+                sequences[refname][pos] = [[cnt * len(nucs), nucs]
+                                           for cnt, nucs in groups]
+
+            if insertions[refname]:
+                ins_tmp1: Dict[int, Dict[str, int]] = {}
+                for pos_i, motif in insertions[refname]:
+                    ins_tmp1.setdefault(pos_i, {})
+                    ins_tmp1[pos_i][motif] = ins_tmp1[pos_i].get(motif, 0) + 1
+
+                ins_tmp2: Dict[int, list] = {}
+                for pos_i in sorted(ins_tmp1):
+                    longest = max(len(m) for m in ins_tmp1[pos_i])
+                    ins_tmp2[pos_i] = [_fresh_counts() for _ in range(longest)]
+                for pos_i in sorted(ins_tmp1):
+                    for motif, mcount in ins_tmp1[pos_i].items():
+                        for col, ch in enumerate(motif):
+                            ins_tmp2[pos_i][col][ch] += mcount
+
+                for pos_i in sorted(ins_tmp2):
+                    for col in range(len(ins_tmp2[pos_i])):
+                        colcounts = ins_tmp2[pos_i][col]
+                        # gap lane completed from coverage; may be negative
+                        # when inserting reads contribute no coverage at pos
+                        # (quirk 4). pos_i == reflength (end-of-contig insert)
+                        # would IndexError in the reference via coverages[pos];
+                        # Python list indexing accepts it only when < len, so
+                        # mirror: such keys exist but are never emitted.
+                        cov_here = (coverages[refname][pos_i]
+                                    if pos_i < len(coverages[refname]) else 0)
+                        colcounts["-"] = cov_here - sum(colcounts.values())
+                        count_nucs = {}
+                        for key, value in colcounts.items():
+                            if value != 0:
+                                count_nucs.setdefault(value, []).append(key)
+                        groups = sorted(count_nucs.items(), reverse=True)
+                        ins_tmp2[pos_i][col] = [[cnt * len(nucs), nucs]
+                                                for cnt, nucs in groups]
+                insertions[refname] = ins_tmp2
+
+        # --- zero-coverage prune (sam2consensus.py:334-340) ---
+        for refname in list(order):
+            if sum(coverages[refname]) == 0:
+                del sequences[refname]
+                del insertions[refname]
+
+        # --- consensus call (sam2consensus.py:345-406) ---
+        fastas: Dict[str, List[FastaRecord]] = {}
+        for refname in order:
+            if refname not in sequences:
+                continue
+            for t in cfg.thresholds:
+                out_chars: List[str] = []
+                sumcov = 0
+                for pos in range(len(sequences[refname])):
+                    if sequences[refname][pos] != []:
+                        cov = coverages[refname][pos]
+                        sumcov += cov
+                        if cov >= cfg.min_depth:
+                            out_chars.append(_vote(sequences[refname][pos],
+                                                   t * cov))
+                            ins_table = insertions[refname]
+                            if isinstance(ins_table, dict) and pos in ins_table:
+                                for colgroups in ins_table[pos]:
+                                    call = _vote(colgroups, t * cov)
+                                    if call == "-":
+                                        continue
+                                    out_chars.append(call)
+                                    sumcov += cov
+                        else:
+                            out_chars.append(cfg.fill)
+                    else:
+                        out_chars.append(cfg.fill)
+
+                seq = "".join(out_chars)
+                if len(seq.replace("-", "")) > 0:
+                    header = format_header(cfg.prefix, t, refname, sumcov, seq)
+                    fastas.setdefault(refname, []).append(
+                        FastaRecord(header, seq))
+                    stats.consensus_bases += len(seq)
+
+        return BackendResult(fastas=fastas, stats=stats)
+
+
+def _vote(groups: List[list], cutoff: float) -> str:
+    """Greedy tie-group accumulation (sam2consensus.py:359-367).
+
+    ``groups`` is the reformatted ``[[group_total, [nucs]], ...]`` list sorted
+    by descending per-nucleotide count; groups are taken whole while the
+    accumulated total stays below ``cutoff`` (``t * coverage`` in float).
+    """
+    nucs: List[str] = []
+    cov_nucs = 0
+    for total, members in groups:
+        if cov_nucs < cutoff:
+            nucs += members
+            cov_nucs += total
+        else:
+            break
+    return AMB["".join(sorted(nucs))]

@@ -1,0 +1,185 @@
+"""Command-line interface.
+
+Drop-in compatible with the reference CLI (``/root/reference/
+sam2consensus.py:87-104``): the eight flags ``-i -c -n -o -p -m -f -d`` keep
+their names, defaults and post-processing (``:108-138``), and the progress
+messages match (``:143,:174,:225,:227,:419-426``).  New-framework flags are
+long-form only so they cannot collide with reference invocations.
+
+``--maxdel`` is ``type=int`` here — the reference omits the type so any
+user-supplied value silently disables the deletion filter under Python 2
+(quirk 1, SURVEY.md §2); pass ``--py2-compat`` to reproduce that behavior.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from .config import RunConfig, default_prefix, normalize_outfolder
+from .io.fasta import write_outputs
+from .io.sam import opener, read_header, iter_records
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sam2consensus-tpu",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("-i", "--input", dest="filename", required=True,
+                   help="SAM file (optionally gzip-compressed, need not be sorted)")
+    p.add_argument("-c", "--consensus-thresholds", dest="thresholds",
+                   type=str, default="0.25",
+                   help="comma-separated consensus threshold(s), e.g. 0.25,0.75; default=0.25")
+    p.add_argument("-n", dest="n", type=int, default=0,
+                   help="wrap FASTA sequences every n characters; default=no wrapping")
+    p.add_argument("-o", "--outfolder", dest="outfolder", default="./",
+                   help="output folder; default=current folder")
+    p.add_argument("-p", "--prefix", dest="prefix", default="",
+                   help="output name prefix; default=input filename without extension")
+    p.add_argument("-m", "--min-depth", dest="min_depth", type=int, default=1,
+                   help="minimum depth to call a consensus base; default=1")
+    p.add_argument("-f", "--fill", dest="fill", default="-",
+                   help="padding character for uncovered regions; default=-")
+    # default=None is a "not supplied" sentinel resolved to 150 in
+    # config_from_args; it lets --py2-compat detect an explicit -d reliably
+    # (including -d150 joined and --maxd abbreviated spellings).
+    p.add_argument("-d", "--maxdel", dest="maxdel", type=int, default=None,
+                   help="ignore deletions longer than this; default=150")
+    # --- new-framework flags ---
+    p.add_argument("--backend", choices=["cpu", "jax"], default="cpu",
+                   help="consensus backend: cpu (golden oracle) or jax (TPU)")
+    p.add_argument("--py2-compat", action="store_true",
+                   help="reproduce the reference's Python-2 maxdel quirk: any "
+                        "explicit -d value disables deletion filtering")
+    p.add_argument("--permissive", action="store_true",
+                   help="skip-and-count malformed/out-of-contract records "
+                        "instead of erroring like the reference")
+    p.add_argument("--quiet", action="store_true", help="suppress progress output")
+    p.add_argument("--json-metrics", dest="json_metrics", default=None,
+                   help="write run metrics as JSON to this path ('-' = stdout)")
+    p.add_argument("--profile-dir", dest="profile_dir", default=None,
+                   help="write a jax.profiler trace to this directory")
+    p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None,
+                   help="persist per-shard count-tensor checkpoints here and "
+                        "resume from them if present")
+    p.add_argument("--shards", type=int, default=0,
+                   help="data-parallel shards for the jax backend; 0 = all devices")
+    p.add_argument("--chunk-reads", dest="chunk_reads", type=int, default=262144,
+                   help="reads per host->device batch (jax backend)")
+    return p
+
+
+def config_from_args(args: argparse.Namespace,
+                     argv: Optional[List[str]] = None) -> RunConfig:
+    thresholds = [float(i) for i in args.thresholds.split(",")]
+    prefix = args.prefix if args.prefix != "" else default_prefix(args.filename)
+    if args.maxdel is None:
+        maxdel: Optional[int] = 150
+    elif args.py2_compat:
+        # quirk 1: a user-supplied -d/--maxdel under Python 2 compares as a
+        # string and the gate `gaps <= maxdel` is then always True.
+        maxdel = None
+    else:
+        maxdel = args.maxdel
+    return RunConfig(
+        thresholds=thresholds,
+        min_depth=args.min_depth,
+        fill=args.fill,
+        maxdel=maxdel,
+        prefix=prefix,
+        nchar=args.n,
+        outfolder=normalize_outfolder(args.outfolder),
+        backend=args.backend,
+        strict=not args.permissive,
+        py2_compat=args.py2_compat,
+        chunk_reads=args.chunk_reads,
+        profile_dir=args.profile_dir,
+        json_metrics=args.json_metrics,
+        checkpoint_dir=args.checkpoint_dir,
+        shards=args.shards,
+    )
+
+
+def get_backend(name: str):
+    if name == "cpu":
+        from .backends.cpu import CpuBackend
+        return CpuBackend()
+    if name == "jax":
+        try:
+            from .backends.jax_backend import JaxBackend
+        except ImportError as exc:
+            raise SystemExit(
+                "the jax backend failed to import (is jax installed?): "
+                f"{exc}") from exc
+        return JaxBackend()
+    raise ValueError(f"unknown backend {name!r}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args, argv)
+    echo = (lambda *a, **k: None) if args.quiet else print
+
+    t0 = time.perf_counter()
+    echo("\nProcessing file " + args.filename + ":\n")
+
+    handle = opener(args.filename)
+    contigs, _n_header, first = read_header(handle)
+    echo("SAM header processed, " + str(len(contigs)) + " references found.\n")
+
+    # Mirrors the reference's progress accounting: every non-leading-header
+    # line counts toward reads_total (sam2consensus.py:182,194,224-225).
+    line_count = [0]
+
+    def counting_lines():
+        for line in handle:
+            line_count[0] += 1
+            if line_count[0] % 500000 == 0:
+                echo(str(line_count[0]) + " reads processed.")
+            yield line
+
+    if first:
+        line_count[0] += 1
+    backend = get_backend(cfg.backend)
+    result = backend.run(contigs, iter_records(counting_lines(), first), cfg)
+    handle.close()
+    reads_total = line_count[0]
+
+    echo("A total of " + str(reads_total) + " reads were processed, out of "
+         "which, " + str(result.stats.reads_mapped) + " reads were mapped.\n")
+
+    write_outputs(result.fastas, cfg.outfolder, cfg.prefix, cfg.nchar,
+                  cfg.thresholds, echo=echo)
+    echo("Done.\n")
+
+    elapsed = time.perf_counter() - t0
+    if cfg.json_metrics:
+        metrics = {
+            "backend": cfg.backend,
+            "reads_mapped": result.stats.reads_mapped,
+            "reads_skipped": result.stats.reads_skipped,
+            "aligned_bases": result.stats.aligned_bases,
+            "consensus_bases": result.stats.consensus_bases,
+            "references": len(contigs),
+            "references_with_output": len(result.fastas),
+            "elapsed_sec": elapsed,
+            "consensus_bases_per_sec":
+                result.stats.consensus_bases / elapsed if elapsed > 0 else 0.0,
+            **result.stats.extra,
+        }
+        blob = json.dumps(metrics)
+        if cfg.json_metrics == "-":
+            print(blob)
+        else:
+            with open(cfg.json_metrics, "w") as fh:
+                fh.write(blob + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,61 @@
+"""Run configuration shared by every backend.
+
+Mirrors the reference's flag surface (``/root/reference/sam2consensus.py:87-104``)
+with the post-processing it applies at ``:108-138``, plus the new-framework
+extensions (``--backend`` etc.) called out in SURVEY.md §5.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class RunConfig:
+    """Everything a backend needs to turn records into FASTA records.
+
+    ``maxdel`` follows the *fixed* semantics (``type=int``); ``maxdel=None``
+    means the deletion gate is disabled (gaps always counted), which is what
+    the reference's quirk 1 silently does for any user-supplied ``-d`` value
+    under Python 2 (``sam2consensus.py:102-103,210``; str/int comparison).
+    ``--py2-compat`` maps a user-supplied ``-d`` to ``None`` to reproduce it.
+    """
+
+    thresholds: List[float] = field(default_factory=lambda: [0.25])
+    min_depth: int = 1
+    fill: str = "-"
+    maxdel: Optional[int] = 150
+    prefix: str = ""
+    nchar: int = 0
+    outfolder: str = "./"
+    backend: str = "cpu"
+    # --- non-reference extensions ---
+    strict: bool = True          # strict: error on invalid bases / out-of-range
+    py2_compat: bool = False
+    chunk_reads: int = 262144    # reads per host->device batch (jax backend)
+    profile_dir: Optional[str] = None
+    json_metrics: Optional[str] = None
+    checkpoint_dir: Optional[str] = None
+    shards: int = 0              # 0 = use all local devices for DP
+
+    @staticmethod
+    def threshold_labels(thresholds: List[float]) -> List[str]:
+        """Percent labels, matching ``int(t*100)`` (sam2consensus.py:394)."""
+        return [str(int(t * 100)) for t in thresholds]
+
+
+def default_prefix(filename: str) -> str:
+    """Input basename up to the first dot (sam2consensus.py:121-124)."""
+    return "".join(filename.split("/")[-1]).split(".")[0]
+
+
+def normalize_outfolder(outfolder: str) -> str:
+    """rstrip slash + ensure exists + trailing slash (sam2consensus.py:127-130)."""
+    out = outfolder.rstrip("/")
+    if out == "":
+        out = "/"  # pathological "-o /" case; reference would makedirs("")->error
+    if not os.path.exists(out):
+        os.makedirs(out)
+    return out + "/"

@@ -1,0 +1,104 @@
+"""Shared constants: the consensus alphabet and the IUPAC ambiguity mapping.
+
+The reference hard-codes a 6-symbol per-position count alphabet
+(``/root/reference/sam2consensus.py:167``) and a literal ambiguity dictionary
+(``sam2consensus.py:317-329``).  Here both are *derived* from first principles:
+
+* ``ALPHABET`` is the 6 symbols in ASCII-sorted order — ``-`` < ``A`` < ``C``
+  < ``G`` < ``N`` < ``T`` — which is exactly the order produced by
+  ``"".join(sorted(nucs))`` in the reference's emit step
+  (``sam2consensus.py:367``).  Symbol index therefore doubles as a bit position
+  in the 6-bit called-set mask used by the TPU vote kernel.
+
+* ``AMB`` maps every non-empty called subset to its output character using the
+  rule the reference's table encodes:
+
+    - the nucleotide part ``B = S ∩ {A,C,G,T}`` picks the standard IUPAC code;
+    - if ``B == {A,C,G,T}`` the call is always uppercase ``"N"`` (so is the
+      all-six set ``-ACGNT``, per ``sam2consensus.py:328-329``);
+    - otherwise the code is lowercased when ``-`` or ``N`` is in the set
+      (the reference uses lowercase to flag "gap or N participated");
+    - sets with no real nucleotide: ``{-}`` → ``-``, ``{N}`` → ``N``,
+      ``{-,N}`` → ``n``.
+
+  The reference's literal table has 62 entries; the rule reproduces every one
+  (pinned by ``tests/test_iupac.py``) and additionally defines the one subset
+  the reference forgot — ``ACGNT`` (five-way tie without gap), which raises
+  ``KeyError`` there — as ``"N"``.  That single deliberate fix is documented
+  as quirk-7-adjacent behavior in SURVEY.md §2.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: Count-lane alphabet in ASCII-sorted order; index == bit position in masks.
+ALPHABET = "-ACGNT"
+GAP, A, C, G, N, T = range(6)
+NUM_SYMBOLS = 6
+
+#: Standard IUPAC codes keyed by frozenset of nucleotides.
+_IUPAC_CORE = {
+    frozenset("A"): "A", frozenset("C"): "C", frozenset("G"): "G",
+    frozenset("T"): "T",
+    frozenset("AC"): "M", frozenset("AG"): "R", frozenset("AT"): "W",
+    frozenset("CG"): "S", frozenset("CT"): "Y", frozenset("GT"): "K",
+    frozenset("ACG"): "V", frozenset("ACT"): "H", frozenset("AGT"): "D",
+    frozenset("CGT"): "B", frozenset("ACGT"): "N",
+}
+
+
+def _call_for_subset(subset: frozenset) -> str:
+    """Output character for a called set of symbols (subset of ALPHABET)."""
+    nucs = subset & frozenset("ACGT")
+    if nucs == frozenset("ACGT"):
+        # Reference emits uppercase "N" for ACGT, -ACGT and -ACGNT alike
+        # (sam2consensus.py:327-329); ACGNT is the entry it forgot.
+        return "N"
+    if nucs:
+        code = _IUPAC_CORE[nucs]
+        if subset & frozenset("-N"):
+            return code.lower()
+        return code
+    if subset == frozenset("-"):
+        return "-"
+    if subset == frozenset("N"):
+        return "N"
+    if subset == frozenset("-N"):
+        return "n"
+    # Empty set: unreachable from the callers (a voted position always has at
+    # least one nonzero lane); use gap so the LUT below is total.
+    return "-"
+
+
+def build_amb_table() -> dict:
+    """Ambiguity dict keyed like the reference: sorted-concatenated subset."""
+    table = {}
+    for mask in range(1, 1 << NUM_SYMBOLS):
+        subset = frozenset(ALPHABET[i] for i in range(NUM_SYMBOLS) if mask & (1 << i))
+        key = "".join(sorted(subset))
+        table[key] = _call_for_subset(subset)
+    return table
+
+
+#: ``AMB["".join(sorted(called_symbols))] -> output char`` — the drop-in
+#: equivalent of the reference's ``amb`` dict (sam2consensus.py:317-329).
+AMB = build_amb_table()
+
+#: 64-entry uint8 LUT: 6-bit called-set mask (bit i == ALPHABET[i]) -> ASCII.
+#: This is the device-side form consumed by the JAX/Pallas vote kernels.
+IUPAC_MASK_LUT = np.zeros(1 << NUM_SYMBOLS, dtype=np.uint8)
+for _mask in range(1 << NUM_SYMBOLS):
+    _subset = frozenset(ALPHABET[i] for i in range(NUM_SYMBOLS) if _mask & (1 << i))
+    IUPAC_MASK_LUT[_mask] = ord(_call_for_subset(_subset))
+
+#: 256-entry uint8 LUT: ASCII base -> symbol index; 255 marks invalid input.
+#: The reference's input contract is uppercase ACGTN only (quirk 7): any other
+#: base raises KeyError there, so 255 triggers strict-mode errors here.
+INVALID_SYMBOL = 255
+BASE_TO_CODE = np.full(256, INVALID_SYMBOL, dtype=np.uint8)
+for _i, _ch in enumerate(ALPHABET):
+    BASE_TO_CODE[ord(_ch)] = _i
+
+#: Symbol index -> ASCII, for rendering.
+CODE_TO_BASE = np.frombuffer(ALPHABET.encode("ascii"), dtype=np.uint8).copy()

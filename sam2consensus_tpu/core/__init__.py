@@ -1,0 +1,1 @@
+from .cigar import split_ops, walk  # noqa: F401

@@ -1,0 +1,69 @@
+"""CIGAR engine: the golden scalar walker.
+
+Spec source: ``parsecigar`` at ``/root/reference/sam2consensus.py:46-82``.
+Semantics reproduced exactly, including the deliberate quirks documented in
+SURVEY.md §2:
+
+* ``M``/``=``/``X`` copy read bases and advance both cursors (``:66-69``);
+* ``D``/``N``/``P`` emit ``"-"`` and advance the *reference* cursor
+  (``:70-72``) — note ``P`` (padding) consumes reference here, diverging from
+  the SAM spec where ``P`` consumes neither (quirk 2);
+* ``I`` records ``(ref_cursor, inserted_seq)`` — the cursor value is the index
+  of the *next* reference base, which is what produces the right-by-one
+  insertion placement in the output (quirk 3) — and advances the read cursor
+  (``:73-75``);
+* ``S`` skips read bases (``:76-77``); ``H`` is a no-op (``:78-79``);
+* any other op prints the reference's (misleading) warning (``:80-81``).
+
+Ops are parsed with the same regex, so malformed CIGAR text degrades the same
+way (unmatched trailing garbage is silently ignored).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+_CIGAR_RE = re.compile(r"(\d+)([MIDNSHPX=]{1})")
+
+#: ops that consume the reference cursor *as implemented by the reference*
+#: (P included — quirk 2), not as the SAM spec defines.
+CONSUMES_REF_AS_GAP = frozenset("DNP")
+CONSUMES_BOTH = frozenset("M=X")
+
+
+def split_ops(cigarstring: str) -> List[Tuple[int, str]]:
+    """Parse a CIGAR string into (length, op) pairs via the spec regex."""
+    return [(int(n), op) for n, op in _CIGAR_RE.findall(cigarstring)]
+
+
+def walk(cigarstring: str, seq: str, pos_ref: int,
+         warn=print) -> Tuple[str, List[Tuple[int, str]]]:
+    """Return (aligned_seq, insertions) exactly like the reference.
+
+    ``aligned_seq`` is the read projected onto reference coordinates starting
+    at ``pos_ref``: read bases for M/=/X, ``"-"`` runs for D/N/P.
+    ``insertions`` is a list of ``(ref_index_of_next_base, motif)`` tuples.
+    """
+    start = 0
+    start_ref = pos_ref
+    out: List[str] = []
+    insert: List[Tuple[int, str]] = []
+    for length, op in split_ops(cigarstring):
+        if op in CONSUMES_BOTH:
+            out.append(seq[start:start + length])
+            start += length
+            start_ref += length
+        elif op in CONSUMES_REF_AS_GAP:
+            out.append("-" * length)
+            start_ref += length
+        elif op == "I":
+            insert.append((start_ref, seq[start:start + length]))
+            start += length
+        elif op == "S":
+            start += length
+        elif op == "H":
+            continue
+        else:  # pragma: no cover - regex admits no other ops
+            warn("SAM file probably contains unmapped reads")
+    return "".join(out), insert

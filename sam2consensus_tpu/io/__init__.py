@@ -1,0 +1,2 @@
+from .sam import Contig, SamRecord, opener, read_header, iter_records, read_sam  # noqa: F401
+from .fasta import FastaRecord, render_file, write_outputs  # noqa: F401

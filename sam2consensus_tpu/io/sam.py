@@ -1,0 +1,107 @@
+"""Streaming SAM input: header scan and record iteration.
+
+Reproduces the reference's I/O layer (L2/L3 in SURVEY.md §1):
+
+* gzip-or-plain opener keyed on the ``.gz`` suffix
+  (``/root/reference/sam2consensus.py:110-114``);
+* header pass that reads ``@SQ`` lines positionally — field 1 with every
+  ``"SN:"`` substring removed then whitespace-truncated, field 2 with every
+  ``"LN:"`` substring removed and int()'d (``sam2consensus.py:160-169``) —
+  and stops at the first non-``@`` line (``sam2consensus.py:171-172``);
+* record pass that keeps only lines whose CIGAR field is not ``"*"``
+  (``sam2consensus.py:195``) and uses exactly four fields: RNAME
+  (whitespace-truncated, ``:200``), 0-based POS (``:201``), CIGAR and SEQ
+  (``:206``).  No FLAG/MAPQ/quality filtering, matching the reference.
+
+Unlike the reference (two full passes over the file,
+``sam2consensus.py:149,180``) this module streams in a single pass: the
+header is consumed from the same handle the records then come from.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+from dataclasses import dataclass
+from typing import Iterable, Iterator, List, TextIO, Tuple
+
+
+def opener(filename: str) -> TextIO:
+    """Open plain or gzip text by suffix (sam2consensus.py:110-114)."""
+    if filename.endswith(".gz"):
+        return io.TextIOWrapper(gzip.open(filename, "rb"), encoding="ascii",
+                                errors="strict")
+    return open(filename, "r", encoding="ascii", errors="strict")
+
+
+@dataclass(frozen=True)
+class Contig:
+    """One ``@SQ`` header entry, in file order."""
+    name: str
+    length: int
+
+
+@dataclass(frozen=True)
+class SamRecord:
+    """The four fields the consensus algorithm consumes."""
+    refname: str
+    pos: int          # 0-based leftmost reference position (POS - 1)
+    cigar: str
+    seq: str
+
+
+def parse_sq_line(line: str) -> Contig:
+    """Positional @SQ parse, faithful to sam2consensus.py:163-164."""
+    fields = line.split("\t")
+    name = fields[1].replace("SN:", "").split()[0]
+    length = int(fields[2].replace("LN:", "").strip())
+    return Contig(name, length)
+
+
+def read_header(handle: TextIO) -> Tuple[List[Contig], int, str]:
+    """Consume header lines; return (contigs, header_line_count, first_body_line).
+
+    ``first_body_line`` is the line that terminated the header ("" at EOF); the
+    caller feeds it back into record iteration so a single pass suffices.
+    """
+    contigs: List[Contig] = []
+    n_header = 0
+    for line in handle:
+        if line.startswith("@"):
+            n_header += 1
+            if line.startswith("@SQ"):
+                contigs.append(parse_sq_line(line))
+        else:
+            return contigs, n_header, line
+    return contigs, n_header, ""
+
+
+def iter_records(handle: TextIO, first_line: str = "") -> Iterator[SamRecord]:
+    """Yield mapped records (CIGAR != "*"), skipping any stray header lines.
+
+    Mirrors the reference's body loop (sam2consensus.py:191-206); chunked
+    reading is an I/O detail there (``readlines(50000)``), not a semantic one,
+    so plain line iteration is used here.
+    """
+    def make(line: str) -> SamRecord:
+        fields = line.rstrip("\n").split("\t")
+        return SamRecord(
+            refname=fields[2].split()[0],
+            pos=int(fields[3]) - 1,
+            cigar=fields[5],
+            seq=fields[9],
+        )
+
+    if first_line and first_line[0] != "@":
+        if first_line.split("\t")[5] != "*":
+            yield make(first_line)
+    for line in handle:
+        if line[0] != "@" and line.split("\t")[5] != "*":
+            yield make(line)
+
+
+def read_sam(filename: str) -> Tuple[List[Contig], Iterator[SamRecord]]:
+    """Open ``filename`` and return (contigs, lazy record iterator)."""
+    handle = opener(filename)
+    contigs, _n_header, first = read_header(handle)
+    return contigs, iter_records(handle, first)

@@ -1,0 +1,26 @@
+"""Test environment: force JAX onto 8 virtual CPU devices.
+
+SURVEY.md §4 ("Multi-device without a cluster"): tests must run without TPU
+hardware, so the host platform is split into 8 fake devices before any JAX
+import.  The same pmap/shard_map tests then run unchanged on a real slice.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+from sam2consensus_tpu.config import RunConfig  # noqa: E402
+
+
+@pytest.fixture
+def cfg():
+    return RunConfig()

@@ -1,0 +1,75 @@
+"""CIGAR walker unit tests — every op, including the documented quirks.
+
+Spec: /root/reference/sam2consensus.py:46-82 (see SURVEY.md §2 table row
+"CIGAR walker").
+"""
+
+from sam2consensus_tpu.core.cigar import split_ops, walk
+
+
+def test_simple_match():
+    out, ins = walk("4M", "ACGT", 10)
+    assert out == "ACGT"
+    assert ins == []
+
+
+def test_eq_and_x_behave_like_match():
+    out, ins = walk("2=2X", "ACGT", 0)
+    assert out == "ACGT"
+    assert ins == []
+
+
+def test_deletion_emits_gaps():
+    out, ins = walk("2M3D2M", "ACGT", 0)
+    assert out == "AC---GT"
+    assert ins == []
+
+
+def test_refskip_N_emits_gaps():
+    out, _ = walk("1M2N1M", "AC", 0)
+    assert out == "A--C"
+
+
+def test_padding_P_consumes_reference():
+    # Quirk 2: the reference advances the ref cursor on P (sam2consensus.py:70-72)
+    # although the SAM spec says P consumes neither sequence.
+    out, _ = walk("1M1P1M", "AC", 0)
+    assert out == "A-C"
+
+
+def test_insertion_records_next_ref_index():
+    # Insertion key is the index of the *next* reference base (quirk 3).
+    out, ins = walk("3M2I2M", "AAACCGG", 5)
+    assert out == "AAAGG"
+    assert ins == [(8, "CC")]
+
+
+def test_insertion_at_read_start():
+    out, ins = walk("2I3M", "CCAAA", 5)
+    assert out == "AAA"
+    assert ins == [(5, "CC")]
+
+
+def test_softclip_skips_read_bases():
+    out, ins = walk("2S3M", "TTAAA", 0)
+    assert out == "AAA"
+    assert ins == []
+
+
+def test_hardclip_noop():
+    out, _ = walk("2H3M2H", "AAA", 0)
+    assert out == "AAA"
+
+
+def test_combined():
+    # 2S 3M 1I 2M 2D 1M: read = SS MMM I MM M
+    out, ins = walk("2S3M1I2M2D1M", "TTACGTCAG", 100)
+    assert out == "ACGCA--G"
+    assert ins == [(103, "T")]
+
+
+def test_split_ops_ignores_garbage():
+    # The reference regex silently drops unmatched text.
+    assert split_ops("3M*") == [(3, "M")]
+    assert split_ops("*") == []
+    assert split_ops("10M5I") == [(10, "M"), (5, "I")]

@@ -1,0 +1,90 @@
+"""CLI end-to-end tests: files on disk, names, wrapping, gzip, metrics."""
+
+import gzip
+import json
+import os
+
+from sam2consensus_tpu.cli import main
+from sam2consensus_tpu.utils.simulate import sam_text, write_sam
+
+
+def _fixture(tmp_path, name="sample.sam", gz=False):
+    text = sam_text(
+        [("geneA", 10), ("geneB", 6), ("empty", 4)],
+        [
+            ("geneA", 1, "4M", "ACGT"),
+            ("geneA", 3, "2M", "GT"),
+            ("geneB", 1, "3M", "TTT"),
+            ("geneB", 1, "3M", "TTT"),
+        ])
+    path = str(tmp_path / (name + (".gz" if gz else "")))
+    return write_sam(text, path)
+
+
+def test_end_to_end_files(tmp_path):
+    sam = _fixture(tmp_path)
+    out = str(tmp_path / "out")
+    assert main(["-i", sam, "-o", out, "--quiet"]) == 0
+    files = sorted(os.listdir(out))
+    assert files == ["geneA__sample.fasta", "geneB__sample.fasta"]
+    content = open(os.path.join(out, "geneA__sample.fasta")).read()
+    assert content == (">sample|c25 reference:geneA coverage:0.6 length:4"
+                       " consensus_threshold:25%\nACGT------\n")
+
+
+def test_gzip_input(tmp_path):
+    sam = _fixture(tmp_path, gz=True)
+    out = str(tmp_path / "out")
+    assert main(["-i", sam, "-o", out, "--quiet"]) == 0
+    assert "geneA__sample.fasta" in os.listdir(out)
+
+
+def test_wrapping(tmp_path):
+    sam = _fixture(tmp_path)
+    out = str(tmp_path / "out")
+    main(["-i", sam, "-o", out, "-n", "3", "--quiet"])
+    content = open(os.path.join(out, "geneA__sample.fasta")).read()
+    assert content.endswith("\nACG\nT--\n---\n-\n")
+
+
+def test_multi_threshold_single_file(tmp_path):
+    sam = _fixture(tmp_path)
+    out = str(tmp_path / "out")
+    main(["-i", sam, "-o", out, "-c", "0.25,0.75", "--quiet"])
+    content = open(os.path.join(out, "geneB__sample.fasta")).read()
+    assert content.count(">") == 2
+    assert "|c25 " in content and "|c75 " in content
+
+
+def test_prefix_flag(tmp_path):
+    sam = _fixture(tmp_path)
+    out = str(tmp_path / "out")
+    main(["-i", sam, "-o", out, "-p", "xx", "--quiet"])
+    assert "geneA__xx.fasta" in os.listdir(out)
+
+
+def test_json_metrics(tmp_path):
+    sam = _fixture(tmp_path)
+    out = str(tmp_path / "out")
+    metrics_path = str(tmp_path / "m.json")
+    main(["-i", sam, "-o", out, "--quiet", "--json-metrics", metrics_path])
+    m = json.loads(open(metrics_path).read())
+    assert m["reads_mapped"] == 4
+    assert m["references"] == 3
+    assert m["references_with_output"] == 2
+    assert m["backend"] == "cpu"
+
+
+def test_py2_compat_maxdel(tmp_path):
+    text = sam_text([("r", 8)], [("r", 1, "2M3D2M", "ACGT")])
+    sam = write_sam(text, str(tmp_path / "d.sam"))
+    out1 = str(tmp_path / "o1")
+    out2 = str(tmp_path / "o2")
+    # fixed semantics: -d 2 filters the 3-gap deletion
+    main(["-i", sam, "-o", out1, "-d", "2", "--quiet"])
+    c1 = open(os.path.join(out1, "r__d.fasta")).read()
+    assert "coverage:0.5" in c1
+    # py2-compat: an explicit -d disables the gate entirely (quirk 1)
+    main(["-i", sam, "-o", out2, "-d", "2", "--py2-compat", "--quiet"])
+    c2 = open(os.path.join(out2, "r__d.fasta")).read()
+    assert "coverage:0.88" in c2

@@ -1,0 +1,250 @@
+"""CPU golden backend tests: hand-computed expected outputs for every quirk.
+
+Each test pins a behavior documented in SURVEY.md §2 against expectations
+worked out by hand from the spec (/root/reference/sam2consensus.py).
+"""
+
+import io
+
+import pytest
+
+from sam2consensus_tpu.backends.cpu import CpuBackend
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.io.sam import read_header, iter_records
+from sam2consensus_tpu.utils.simulate import sam_text
+
+
+def run_cpu(text, **cfg_kwargs):
+    cfg = RunConfig(prefix="p", **cfg_kwargs)
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    return CpuBackend().run(contigs, iter_records(handle, first), cfg)
+
+
+def test_basic_consensus_and_header():
+    text = sam_text([("ref1", 10)], [
+        ("ref1", 1, "4M", "ACGT"),
+        ("ref1", 3, "2M", "GT"),
+    ])
+    res = run_cpu(text)
+    recs = res.fastas["ref1"]
+    assert len(recs) == 1
+    assert recs[0].seq == "ACGT------"
+    # sumcov = 1+1+2+2 = 6; len = 10 -> coverage 0.6; length strips "-" -> 4
+    assert recs[0].header == (">p|c25 reference:ref1 coverage:0.6 length:4"
+                              " consensus_threshold:25%")
+
+
+def test_tie_groups_all_or_nothing():
+    # one position: A:2, C:2, T:1 -> groups [[4,[A,C]],[1,[T]]]
+    text = sam_text([("r", 1)], [
+        ("r", 1, "1M", "A"), ("r", 1, "1M", "A"),
+        ("r", 1, "1M", "C"), ("r", 1, "1M", "C"),
+        ("r", 1, "1M", "T"),
+    ])
+    # t=0.5: cutoff 2.5 -> take {A,C} (total 4), stop -> "M"
+    assert run_cpu(text, thresholds=[0.5]).fastas["r"][0].seq == "M"
+    # t=0.9: cutoff 4.5 -> take {A,C} (4 < 4.5) then {T} -> "ACT" -> "H"
+    assert run_cpu(text, thresholds=[0.9]).fastas["r"][0].seq == "H"
+    # t=0.25: cutoff 1.25 -> take {A,C}, stop -> "M"
+    assert run_cpu(text, thresholds=[0.25]).fastas["r"][0].seq == "M"
+
+
+def test_multi_threshold_record_order():
+    text = sam_text([("r", 2)], [("r", 1, "2M", "AC")])
+    res = run_cpu(text, thresholds=[0.25, 0.75, 0.5])
+    labels = [r.header.split("|c")[1].split(" ")[0] for r in res.fastas["r"]]
+    assert labels == ["25", "75", "50"]
+    assert all(r.seq == "AC" for r in res.fastas["r"])
+
+
+def test_gap_majority_yields_gap_char_and_length_drop():
+    # 1 read with a counted deletion: gaps win the vote -> "-" in sequence.
+    text = sam_text([("r", 4)], [("r", 1, "1M2D1M", "AT")])
+    res = run_cpu(text)
+    assert res.fastas["r"][0].seq == "A--T"
+    # length strips gaps: 2
+    assert "length:2" in res.fastas["r"][0].header
+
+
+def test_maxdel_gate_skips_gap_bases_but_advances():
+    text = sam_text([("r", 8)], [("r", 1, "2M3D2M", "ACGT")])
+    # gaps total 3 > maxdel 2 -> gap bases not counted -> cov 0 at pos 2..4
+    res = run_cpu(text, maxdel=2)
+    assert res.fastas["r"][0].seq == "AC---GT-"
+    # sumcov = 4 covered positions -> coverage round(4/8,2)=0.5
+    assert "coverage:0.5" in res.fastas["r"][0].header
+    # default maxdel=150 -> gaps counted -> vote emits "-" at pos 2..4 (same
+    # text here, but coverage differs: sumcov=7)
+    res2 = run_cpu(text)
+    assert res2.fastas["r"][0].seq == "AC---GT-"
+    assert "coverage:0.88" in res2.fastas["r"][0].header  # round(7/8,2)
+
+
+def test_maxdel_none_means_gate_disabled():
+    text = sam_text([("r", 8)], [("r", 1, "2M3D2M", "ACGT")])
+    res = run_cpu(text, maxdel=None)
+    assert "coverage:0.88" in res.fastas["r"][0].header
+
+
+def test_min_depth_fills_shallow_positions():
+    text = sam_text([("r", 3)], [
+        ("r", 1, "3M", "ACG"),
+        ("r", 1, "1M", "A"),
+    ])
+    res = run_cpu(text, min_depth=2)
+    assert res.fastas["r"][0].seq == "A--"
+    # sumcov counts sub-min-depth covered positions too (spec :357): 2+1+1=4
+    assert "coverage:1.33" in res.fastas["r"][0].header  # round(4/3,2)
+
+
+def test_fill_character_and_length_interaction():
+    # Quirk 10: fill "N" counts toward the length: field (only "-" stripped).
+    text = sam_text([("r", 5)], [("r", 1, "2M", "AC")])
+    res = run_cpu(text, fill="N")
+    assert res.fastas["r"][0].seq == "ACNNN"
+    assert "length:5" in res.fastas["r"][0].header
+
+
+def test_zero_coverage_reference_pruned():
+    text = sam_text([("covered", 2), ("empty", 5)], [("covered", 1, "2M", "AC")])
+    res = run_cpu(text, fill="N")
+    assert "covered" in res.fastas
+    assert "empty" not in res.fastas  # pruned even though fill would be "N"
+
+
+def test_all_gap_consensus_dropped():
+    text = sam_text([("r", 5)], [("r", 1, "5D", "A")])
+    res = run_cpu(text)
+    assert res.fastas == {}
+
+
+def test_insertion_basic_placement_and_case():
+    # 3 reads AAA; 1 read with "CC" inserted between pos1 and pos2
+    text = sam_text([("r", 6)], [
+        ("r", 1, "3M", "AAA"), ("r", 1, "3M", "AAA"), ("r", 1, "3M", "AAA"),
+        ("r", 1, "2M2I1M", "AACCA"),
+    ])
+    # t=0.25: cutoff 1.0 at cov 4; ins col: {-:3, C:1} -> take gap group,
+    # call "-" -> skipped entirely
+    res = run_cpu(text, thresholds=[0.25])
+    assert res.fastas["r"][0].seq == "AAA---"
+    # t=1.0: cutoff 4.0 -> take gap (3<4) then C -> {-,C} -> "c";
+    # two columns appended after the base at pos 2 (right-shift, quirk 3)
+    res2 = run_cpu(text, thresholds=[1.0])
+    assert res2.fastas["r"][0].seq == "AAAcc---"
+    # sumcov = 4*3 + 4 + 4 = 20, len 8 -> 2.5; length strips "-" -> 5
+    assert "coverage:2.5" in res2.fastas["r"][0].header
+    assert "length:5" in res2.fastas["r"][0].header
+
+
+def test_insertion_majority_uppercase():
+    # insertion supported by 3 of 4 reads: col {-:1, C:3} -> t=0.5 cutoff 2
+    # -> take C group (3 >= 2) -> "C" uppercase.  The motif is recorded at
+    # start_ref=2 and emitted AFTER the base at index 2 (right-shift quirk 3),
+    # so the biological "AACAA" comes out as "AAACA".
+    text = sam_text([("r", 4)], [
+        ("r", 1, "2M1I2M", "AACAA"),
+        ("r", 1, "2M1I2M", "AACAA"),
+        ("r", 1, "2M1I2M", "AACAA"),
+        ("r", 1, "4M", "AAAA"),
+    ])
+    res = run_cpu(text, thresholds=[0.5])
+    assert res.fastas["r"][0].seq == "AAACA"
+
+
+def test_insertion_negative_gap_count_survives():
+    # Quirk 4: inserting read contributes no coverage at the key position.
+    # read: 1M2I at pos 1 -> insert key = 1, cov[1] = 0 -> gap count -1.
+    # Position 1 has zero coverage -> fill; insertion never emitted.
+    text = sam_text([("r", 2)], [("r", 1, "1M2I", "ACC")])
+    res = run_cpu(text)
+    assert res.fastas["r"][0].seq == "A-"
+
+
+def test_insertion_not_emitted_below_min_depth():
+    # Quirk 8: insertion emission is nested inside the min_depth branch.
+    text = sam_text([("r", 3)], [("r", 1, "1M1I2M", "ACAA")])
+    res = run_cpu(text, min_depth=2)
+    # every position is below min_depth -> all-fill sequence -> record dropped
+    # entirely (sam2consensus.py:400-406)
+    assert res.fastas == {}
+    # min_depth=1: ins col at key 1 is {C:1, -:0}; the zero gap count is
+    # filtered (value != 0), so C wins -> emitted after the base at index 1
+    # (right-shift): "AACA"
+    res2 = run_cpu(text, min_depth=1)
+    assert res2.fastas["r"][0].seq == "AACA"
+
+
+def test_insertion_at_contig_end_never_emitted():
+    # insert key == reflength: exists in the table but the emit loop stops at
+    # reflength-1 (the reference would IndexError during gap completion; we
+    # complete with cov 0 and never emit — divergence documented in cpu.py).
+    text = sam_text([("r", 2)], [("r", 1, "2M2I", "AACC")])
+    res = run_cpu(text)
+    assert res.fastas["r"][0].seq == "AA"
+
+
+def test_n_bases_count_and_lowercase_calls():
+    # N competes in the vote; {A,N} tie -> "AN" -> lowercase "a"
+    text = sam_text([("r", 1)], [("r", 1, "1M", "A"), ("r", 1, "1M", "N")])
+    res = run_cpu(text, thresholds=[1.0])
+    assert res.fastas["r"][0].seq == "a"
+
+
+def test_negative_pos_wraps_like_python_list():
+    # POS=0 => pos_ref=-1; Python list indexing wraps to the contig's end.
+    text = sam_text([("r", 4)], [("r", 0, "2M", "AC"), ("r", 1, "1M", "G")])
+    res = run_cpu(text)
+    # read1: A at index -1 (=3), C at index 0; read2: G at index 0
+    # pos0: C:1,G:1 tie -> t=.25 cutoff .5 -> take {C,G} -> "S"
+    assert res.fastas["r"][0].seq == "S--A"
+
+
+def test_unknown_reference_strict_raises_permissive_skips():
+    text = sam_text([("r", 2)], [("other", 1, "2M", "AC"), ("r", 1, "2M", "AC")])
+    with pytest.raises(KeyError):
+        run_cpu(text)
+    res = run_cpu(text, strict=False)
+    assert res.fastas["r"][0].seq == "AC"
+    assert res.stats.reads_skipped == 1
+
+
+def test_out_of_alphabet_base_strict_raises():
+    text = sam_text([("r", 2)], [("r", 1, "2M", "ac")])
+    with pytest.raises(KeyError):
+        run_cpu(text)
+    res = run_cpu(text, strict=False)
+    assert res.fastas == {}
+
+
+def test_read_overrunning_contig_strict_raises():
+    text = sam_text([("r", 3)], [("r", 2, "3M", "ACG")])
+    with pytest.raises(IndexError):
+        run_cpu(text)
+
+
+def test_unmapped_star_cigar_skipped():
+    text = sam_text([("r", 2)], [("r", 1, "*", "*"), ("r", 1, "2M", "AC")])
+    res = run_cpu(text)
+    assert res.stats.reads_mapped == 1
+    assert res.fastas["r"][0].seq == "AC"
+
+
+def test_duplicate_sq_lines_last_length_wins():
+    # Reference: each @SQ reallocates via dict assignment, so the last LN
+    # wins; must not crash the reformat pass.
+    text = sam_text([("r", 3), ("r", 5)], [("r", 1, "2M", "AC")])
+    res = run_cpu(text)
+    assert res.fastas["r"][0].seq == "AC---"
+
+
+def test_permissive_skip_leaves_no_partial_counts():
+    # An out-of-bounds read must contribute nothing when skipped.
+    text = sam_text([("r", 3)], [
+        ("r", 2, "3M", "GGG"),   # spans [1,4) past the end -> skipped
+        ("r", 1, "2M", "AC"),
+    ])
+    res = run_cpu(text, strict=False)
+    assert res.stats.reads_skipped == 1
+    assert res.fastas["r"][0].seq == "AC-"

@@ -1,0 +1,63 @@
+"""IUPAC ambiguity table tests.
+
+The expected mapping below is the fact table from the reference's ``amb``
+dict (/root/reference/sam2consensus.py:317-329), spelled out entry by entry so
+the derivation rule in ``constants.py`` is pinned against the original data.
+"""
+
+import numpy as np
+import pytest
+
+from sam2consensus_tpu.constants import (ALPHABET, AMB, BASE_TO_CODE,
+                                         IUPAC_MASK_LUT, build_amb_table)
+
+REFERENCE_AMB = {
+    "-": "-", "A": "A", "C": "C", "G": "G", "N": "N", "T": "T",
+    "-A": "a", "-C": "c", "-G": "g", "-N": "n", "-T": "t",
+    "AC": "M", "AG": "R", "AN": "a", "AT": "W", "CG": "S",
+    "CN": "c", "CT": "Y", "GN": "g", "GT": "K", "NT": "t",
+    "-AC": "m", "-AG": "r", "-AN": "a", "-AT": "w", "-CG": "s",
+    "-CN": "c", "-CT": "y", "-GN": "g", "-GT": "k", "-NT": "t",
+    "ACG": "V", "ACN": "m", "ACT": "H", "AGN": "r", "AGT": "D",
+    "ANT": "w", "CGN": "s", "CGT": "B", "CNT": "y", "GNT": "k",
+    "-ACG": "v", "-ACN": "m", "-ACT": "h", "-AGN": "r", "-AGT": "d",
+    "-ANT": "w", "-CGN": "s", "-CGT": "b", "-CNT": "y", "-GNT": "k",
+    "ACGN": "v", "ACGT": "N", "ACNT": "h", "AGNT": "d", "CGNT": "b",
+    "-ACGN": "v", "-ACGT": "N", "-ACNT": "h", "-AGNT": "d", "-CGNT": "b",
+    "-ACGNT": "N",
+}
+
+
+def test_every_reference_entry_reproduced():
+    for key, expected in REFERENCE_AMB.items():
+        assert AMB[key] == expected, key
+
+
+def test_reference_table_has_62_entries_we_cover_all_63():
+    assert len(REFERENCE_AMB) == 62
+    derived = build_amb_table()
+    assert len(derived) == 63  # every non-empty subset of -ACGNT
+
+
+def test_missing_reference_key_acgnt_fixed_to_N():
+    # The reference forgot "ACGNT" (five-way tie, no gap) and would KeyError;
+    # the framework defines it as "N" (documented fix, constants.py).
+    assert "ACGNT" not in REFERENCE_AMB
+    assert AMB["ACGNT"] == "N"
+
+
+def test_mask_lut_agrees_with_amb():
+    for mask in range(1, 64):
+        key = "".join(sorted(ALPHABET[i] for i in range(6) if mask & (1 << i)))
+        assert chr(IUPAC_MASK_LUT[mask]) == AMB[key], (mask, key)
+
+
+def test_alphabet_is_ascii_sorted():
+    assert list(ALPHABET) == sorted(ALPHABET)
+
+
+def test_base_to_code_roundtrip():
+    for i, ch in enumerate(ALPHABET):
+        assert BASE_TO_CODE[ord(ch)] == i
+    assert BASE_TO_CODE[ord("a")] == 255  # lowercase is out of contract (quirk 7)
+    assert BASE_TO_CODE[ord("U")] == 255
