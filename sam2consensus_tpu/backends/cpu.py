@@ -77,9 +77,15 @@ class CpuBackend:
             pos_ref = rec.pos
             # Validate the whole read *before* touching the pileup so a
             # permissive-mode skip leaves no partial increments behind.
+            # A zero-span read (all S/H/I ops) touches no position and is
+            # accepted at any POS, like the reference's zero-iteration loop.
             span_end = pos_ref + len(seqout)
-            in_bounds = -len(seqs_ref) <= pos_ref and span_end <= len(seqs_ref)
-            valid_bases = all(ch in "-ACGNT" for ch in seqout)
+            in_bounds = (len(seqout) == 0
+                         or (-len(seqs_ref) <= pos_ref
+                             and span_end <= len(seqs_ref)))
+            valid_bases = (all(ch in "-ACGNT" for ch in seqout)
+                           and all(ch in "-ACGNT"
+                                   for _pos, motif in insert for ch in motif))
             if not (in_bounds and valid_bases):
                 if cfg.strict:
                     if not in_bounds:
@@ -89,9 +95,10 @@ class CpuBackend:
                             f"{rec.refname!r} of length {len(seqs_ref)} "
                             "(reference would IndexError here too)")
                     raise KeyError(
-                        f"read contains out-of-alphabet base at pos {rec.pos} "
-                        "(input contract is uppercase ACGTN; the reference "
-                        "would KeyError here too)")
+                        f"read at pos {rec.pos} contains an out-of-alphabet "
+                        "base (input contract is uppercase ACGTN; the "
+                        "reference would KeyError here too, though for "
+                        "insertion motifs only later, in its reformat pass)")
                 stats.reads_skipped += 1
                 continue
             if cfg.maxdel is None or seqout.count("-") <= cfg.maxdel:

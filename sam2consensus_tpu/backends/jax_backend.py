@@ -1,0 +1,148 @@
+"""JAX/TPU backend: encoder → scatter-add pileup → jit vote → host render.
+
+The TPU-native pipeline replacing the reference's interpreter loops
+(SURVEY.md §1 "new-framework layer map", §7 steps 3-7):
+
+1. host encoder turns records into flat (position, code) event arrays
+   (``encoder/events.py``);
+2. device scatter-add accumulates the ``[total_len, 6]`` count tensor
+   (``ops/pileup.py``) — the count tensor is the entire job state and is
+   sum-decomposable, which is what makes DP/psum and checkpointing exact;
+3. the threshold vote runs as a closed-form int32 reduction vmapped over
+   thresholds (``ops/vote.py``), and the insertion "mini-alignment" table is
+   scatter-built and voted the same way (``ops/insertions.py``);
+4. the host splices insertion columns after their site's base (right-shift
+   placement, quirk 3), substitutes the fill character for sentinel bytes and
+   renders FASTA records byte-identically to the CPU oracle.
+
+Output equality with ``CpuBackend`` over the whole fixture corpus is the
+framework's correctness gate (tests/test_differential.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+import numpy as np
+
+from ..config import RunConfig
+from ..io.sam import Contig, SamRecord
+from .base import BackendResult, BackendStats, FastaRecord, format_header
+
+
+class JaxBackend:
+    name = "jax"
+
+    def run(self, contigs: List[Contig], records: Iterable[SamRecord],
+            cfg: RunConfig) -> BackendResult:
+        # jax imports deferred so `--backend cpu` never pays them
+        import jax.numpy as jnp
+
+        from ..encoder.events import GenomeLayout, ReadEncoder, group_insertions
+        from ..ops.insertions import build_insertion_table, vote_insertions
+        from ..ops.pileup import PileupAccumulator
+        from ..ops.vote import threshold_luts, vote_positions
+
+        stats = BackendStats()
+        layout = GenomeLayout(contigs)
+        if layout.total_len == 0:
+            return BackendResult(fastas={}, stats=stats)
+
+        encoder = ReadEncoder(layout, maxdel=cfg.maxdel, strict=cfg.strict)
+        acc = PileupAccumulator(layout.total_len)
+        for chunk in encoder.encode_chunks(records, cfg.chunk_reads):
+            acc.add(chunk)
+            stats.aligned_bases += len(chunk.positions)
+        stats.reads_mapped = encoder.n_reads
+        stats.reads_skipped = encoder.n_skipped
+
+        counts = acc.counts                                   # [L, 6] device
+        cov_dev = counts.sum(axis=-1)
+        max_cov = int(cov_dev.max())
+        t_luts = jnp.asarray(threshold_luts(cfg.thresholds, max_cov))
+
+        syms_dev, _ = vote_positions(counts, t_luts, cfg.min_depth)
+        syms = np.asarray(syms_dev)                           # [T, L] uint8
+        cov = np.asarray(cov_dev, dtype=np.int64)             # [L]
+
+        ins = group_insertions(encoder.insertions, layout)
+        if ins is not None:
+            k = len(ins["key_flat"])
+            table = jnp.zeros((k, ins["max_cols"], 6), dtype=jnp.int32)
+            table = build_insertion_table(
+                table, jnp.asarray(ins["ev_key"]), jnp.asarray(ins["ev_col"]),
+                jnp.asarray(ins["ev_code"]))
+            site_cov = np.where(ins["key_flat"] >= 0,
+                                cov[np.maximum(ins["key_flat"], 0)],
+                                0).astype(np.int32)
+            ins_syms = np.asarray(vote_insertions(
+                table, jnp.asarray(site_cov), jnp.asarray(ins["n_cols"]),
+                t_luts))                                      # [T, K, C] uint8
+        else:
+            site_cov = None
+            ins_syms = None
+
+        fastas = self._assemble(layout, syms, cov, ins, ins_syms, site_cov,
+                                cfg, stats)
+        return BackendResult(fastas=fastas, stats=stats)
+
+    # -- host-side rendering ---------------------------------------------
+    def _assemble(self, layout, syms: np.ndarray, cov: np.ndarray, ins,
+                  ins_syms, site_cov, cfg: RunConfig,
+                  stats: BackendStats) -> Dict[str, List[FastaRecord]]:
+        n_thresholds = syms.shape[0]
+        fastas: Dict[str, List[FastaRecord]] = {}
+
+        for ci, name in enumerate(layout.names):
+            off = int(layout.offsets[ci])
+            length = int(layout.lengths[ci])
+            ref_cov = cov[off:off + length]
+            sumcov_base = int(ref_cov.sum())
+            if sumcov_base == 0:
+                continue  # zero-coverage prune (sam2consensus.py:334-340)
+
+            # insertion sites for this contig, emittable ones only:
+            # local key within [0, length) and site depth passes the gates
+            # (emission is nested inside cov>0 and cov>=min_depth branches,
+            # sam2consensus.py:356-385).
+            site_rows = np.zeros(0, dtype=np.int64)
+            if ins is not None:
+                mask = ((ins["key_contig"] == ci)
+                        & (ins["key_local"] >= 0)
+                        & (ins["key_local"] < length))
+                site_rows = np.nonzero(mask)[0]
+                locs = ins["key_local"][site_rows].astype(np.int64)
+                order = np.argsort(locs, kind="stable")
+                site_rows, locs = site_rows[order], locs[order]
+                depth_ok = (cov[off + locs] > 0) & (
+                    cov[off + locs] >= cfg.min_depth)
+                site_rows, locs = site_rows[depth_ok], locs[depth_ok]
+
+            for t in range(n_thresholds):
+                base = syms[t, off:off + length]
+                if len(site_rows):
+                    pieces: List[bytes] = []
+                    prev = 0
+                    extra_cov = 0
+                    for row, loc in zip(site_rows, locs):
+                        cols = ins_syms[t, row][ins_syms[t, row] != 0]
+                        pieces.append(base[prev:loc + 1].tobytes())
+                        pieces.append(cols.tobytes())
+                        extra_cov += int(site_cov[row]) * len(cols)
+                        prev = loc + 1
+                    pieces.append(base[prev:].tobytes())
+                    raw = b"".join(pieces)
+                    sumcov = sumcov_base + extra_cov
+                else:
+                    raw = base.tobytes()
+                    sumcov = sumcov_base
+
+                seq = raw.decode("latin-1").replace("\x00", cfg.fill)
+                if len(seq) - seq.count("-") == 0:
+                    continue  # empty-sequence drop (sam2consensus.py:400-406)
+                header = format_header(cfg.prefix, cfg.thresholds[t], name,
+                                       sumcov, seq)
+                fastas.setdefault(name, []).append(FastaRecord(header, seq))
+                stats.consensus_bases += len(seq)
+
+        return fastas

@@ -1,0 +1,328 @@
+"""Read → tensor encoder: host-side CIGAR decode into scatter-ready events.
+
+This is the keystone of the TPU formulation (SURVEY.md §7 step 3): once reads
+become flat integer event arrays, the whole pileup is one scatter-add and the
+vote is a per-position reduction — no raggedness survives to the device.
+
+Semantics are identical to the golden CIGAR walker (``core/cigar.py``,
+spec ``/root/reference/sam2consensus.py:46-82,195-221``):
+
+* M/=/X bases become (position, base_code) events;
+* D/N/P bases become (position, GAP) events, subject to the per-read maxdel
+  gate (total gap length > maxdel ⇒ gap events dropped, positions still
+  advance);
+* I records an insertion event keyed by (contig, index of next ref base);
+* S skips read bases, H is a no-op;
+* POS-1 may be negative: local indices in [-reflen, 0) wrap Python-style.
+
+The genome is laid out as ONE flat position axis — contigs concatenated with
+per-contig offsets — rather than a padded [contig, max_len] matrix.  The vote
+is per-position, so nothing needs the contig structure on device; a flat
+layout wastes zero padding FLOPs/HBM and makes position-axis sharding a plain
+1-D sharding (SURVEY.md §5 long-context).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..constants import BASE_TO_CODE, GAP, INVALID_SYMBOL
+from ..core.cigar import split_ops
+from ..io.sam import Contig, SamRecord
+
+
+class GenomeLayout:
+    """Flat concatenated coordinate system over the declared contigs.
+
+    Duplicate @SQ names follow the reference's dict-overwrite (last LN wins,
+    first position in iteration order).
+    """
+
+    def __init__(self, contigs: Sequence[Contig]):
+        lengths: Dict[str, int] = {}
+        for c in contigs:
+            lengths[c.name] = c.length
+        self.names: List[str] = list(lengths)
+        self.lengths = np.array([lengths[n] for n in self.names], dtype=np.int64)
+        self.offsets = np.zeros(len(self.names) + 1, dtype=np.int64)
+        np.cumsum(self.lengths, out=self.offsets[1:])
+        self.total_len = int(self.offsets[-1])
+        self.index: Dict[str, int] = {n: i for i, n in enumerate(self.names)}
+
+    def contig_slice(self, name: str) -> slice:
+        i = self.index[name]
+        return slice(int(self.offsets[i]), int(self.offsets[i + 1]))
+
+
+@dataclass
+class PileupChunk:
+    """One host→device batch of per-base pileup events."""
+    positions: np.ndarray          # int32 [n] flat genome position
+    codes: np.ndarray              # int32 [n] symbol code 0..5
+    n_reads: int = 0
+
+
+@dataclass
+class InsertionEvents:
+    """Raw insertion observations, grouped later by (contig, local position)."""
+    contig_ids: List[int] = field(default_factory=list)
+    local_pos: List[int] = field(default_factory=list)
+    motifs: List[str] = field(default_factory=list)
+
+    def extend(self, other: "InsertionEvents") -> None:
+        self.contig_ids.extend(other.contig_ids)
+        self.local_pos.extend(other.local_pos)
+        self.motifs.extend(other.motifs)
+
+    def __len__(self) -> int:
+        return len(self.motifs)
+
+
+class EncodeError(ValueError):
+    pass
+
+
+def _expand_segments(starts: List[int], lengths: List[int]) -> np.ndarray:
+    """Concatenate ``arange(start, start+len)`` for all segments, vectorized."""
+    if not starts:
+        return np.zeros(0, dtype=np.int64)
+    starts_a = np.asarray(starts, dtype=np.int64)
+    lens_a = np.asarray(lengths, dtype=np.int64)
+    total = int(lens_a.sum())
+    ends = np.cumsum(lens_a)
+    # position within the concatenation minus segment base, plus start
+    idx = np.arange(total, dtype=np.int64)
+    seg_base = np.repeat(ends - lens_a, lens_a)
+    return idx - seg_base + np.repeat(starts_a, lens_a)
+
+
+class ReadEncoder:
+    """Streaming encoder: SamRecords in, PileupChunks + InsertionEvents out."""
+
+    def __init__(self, layout: GenomeLayout, maxdel: Optional[int] = 150,
+                 strict: bool = True):
+        self.layout = layout
+        self.maxdel = maxdel
+        self.strict = strict
+        self.n_reads = 0
+        self.n_skipped = 0
+        self.insertions = InsertionEvents()
+
+    def encode_chunks(self, records: Iterable[SamRecord],
+                      chunk_reads: int = 262144) -> Iterator[PileupChunk]:
+        """Yield pileup chunks of at most ``chunk_reads`` reads each."""
+        base_starts: List[int] = []      # flat-genome starts of M-run segments
+        base_codes: List[np.ndarray] = []
+        gap_starts: List[int] = []
+        gap_lens: List[int] = []
+        irr_pos: List[np.ndarray] = []   # pre-expanded irregular events
+        irr_codes: List[np.ndarray] = []
+        in_chunk = 0
+
+        def flush() -> PileupChunk:
+            nonlocal base_starts, base_codes, gap_starts, gap_lens
+            nonlocal irr_pos, irr_codes, in_chunk
+            lens = [len(c) for c in base_codes]
+            pos_bases = _expand_segments(base_starts, lens)
+            pos_gaps = _expand_segments(gap_starts, gap_lens)
+            parts_codes = ([c.astype(np.int32) for c in base_codes]
+                           + [np.full(len(pos_gaps), GAP, dtype=np.int32)]
+                           + [c.astype(np.int32) for c in irr_codes])
+            parts_pos = [pos_bases, pos_gaps] + [p for p in irr_pos]
+            positions = np.concatenate(parts_pos).astype(np.int32) \
+                if parts_pos else np.zeros(0, dtype=np.int32)
+            codes = np.concatenate(parts_codes) \
+                if parts_codes else np.zeros(0, dtype=np.int32)
+            chunk = PileupChunk(positions=positions, codes=codes,
+                                n_reads=in_chunk)
+            base_starts, base_codes, gap_starts, gap_lens = [], [], [], []
+            irr_pos, irr_codes = [], []
+            in_chunk = 0
+            return chunk
+
+        for rec in records:
+            try:
+                # _encode_one validates fully before committing any segment,
+                # so a raise here leaves the chunk lists untouched.
+                self._encode_one(rec, base_starts, base_codes,
+                                 gap_starts, gap_lens, irr_pos, irr_codes)
+            except EncodeError:
+                if self.strict:
+                    raise
+                self.n_skipped += 1
+                continue
+            self.n_reads += 1
+            in_chunk += 1
+            if in_chunk >= chunk_reads:
+                yield flush()
+        if in_chunk or base_codes or gap_lens or irr_codes:
+            yield flush()
+
+    # -- single read ------------------------------------------------------
+    def _encode_one(self, rec: SamRecord,
+                    base_starts: List[int], base_codes: List[np.ndarray],
+                    gap_starts: List[int], gap_lens: List[int],
+                    irr_pos: List[np.ndarray], irr_codes: List[np.ndarray]
+                    ) -> None:
+        layout = self.layout
+        ci = layout.index.get(rec.refname)
+        if ci is None:
+            raise EncodeError(f"unknown reference {rec.refname!r}")
+        reflen = int(layout.lengths[ci])
+        offset = int(layout.offsets[ci])
+
+        seq_codes = BASE_TO_CODE[
+            np.frombuffer(rec.seq.encode("ascii"), dtype=np.uint8)]
+
+        # walk ops, collecting local segments first (validation before commit)
+        my_base: List[Tuple[int, np.ndarray]] = []
+        my_gaps: List[Tuple[int, int]] = []
+        my_ins: List[Tuple[int, str]] = []
+        rc = 0
+        ref_cursor = rec.pos
+        gap_total = 0
+        for length, op in split_ops(rec.cigar):
+            if op in "M=X":
+                my_base.append((ref_cursor, seq_codes[rc:rc + length]))
+                rc += length
+                ref_cursor += length
+            elif op in "DNP":
+                my_gaps.append((ref_cursor, length))
+                gap_total += length
+                ref_cursor += length
+            elif op == "I":
+                my_ins.append((ref_cursor, rec.seq[rc:rc + length]))
+                rc += length
+            elif op == "S":
+                rc += length
+            # H: no-op
+
+        # validation (quirk 7 contract): bounds incl. negative-wrap, alphabet.
+        # A zero-span read (all S/H/I ops) touches no position and is accepted
+        # at any POS, like the reference's zero-iteration pileup loop.
+        span = ref_cursor - rec.pos
+        if span > 0 and (rec.pos < -reflen or ref_cursor > reflen):
+            raise EncodeError(
+                f"read at pos {rec.pos} spans [{rec.pos}, {ref_cursor}) "
+                f"outside reference {rec.refname!r} of length {reflen}")
+        for _start, codes in my_base:
+            if codes.size and codes.max() == INVALID_SYMBOL:
+                raise EncodeError(
+                    "read contains out-of-alphabet base "
+                    "(input contract is uppercase ACGTN)")
+        for _local, motif in my_ins:
+            mcodes = BASE_TO_CODE[
+                np.frombuffer(motif.encode("ascii"), dtype=np.uint8)]
+            if mcodes.size and mcodes.max() == INVALID_SYMBOL:
+                raise EncodeError(
+                    "insertion motif contains out-of-alphabet base "
+                    "(the reference KeyErrors on these in its reformat pass)")
+
+        # commit: translate to flat coordinates (wrapping negatives)
+        def flat(local_start: int, length: int) -> List[Tuple[int, int]]:
+            """Split a local run into flat-genome runs, wrapping negatives."""
+            if local_start >= 0:
+                return [(offset + local_start, length)]
+            neg = min(length, -local_start)   # bases in the wrapped tail
+            runs = [(offset + reflen + local_start, neg)]
+            if length > neg:
+                runs.append((offset, length - neg))
+            return runs
+
+        # The reference gates on seqout.count("-"), which counts D/N/P gap
+        # runs AND literal '-' characters appearing in SEQ itself ('-' is in
+        # the count alphabet); both kinds are skipped when the gate trips.
+        dash_in_m = sum(int((codes == GAP).sum()) for _s, codes in my_base)
+        count_gaps = (self.maxdel is None
+                      or (gap_total + dash_in_m) <= self.maxdel)
+        for start, codes in my_base:
+            if not count_gaps and (codes == GAP).any():
+                local = start + np.arange(len(codes), dtype=np.int64)
+                keep = codes != GAP
+                local, kept = local[keep], codes[keep]
+                flatpos = np.where(local < 0, offset + reflen + local,
+                                   offset + local)
+                irr_pos.append(flatpos)
+                irr_codes.append(kept)
+                continue
+            pieces = flat(start, len(codes))
+            consumed = 0
+            for fstart, flen in pieces:
+                base_starts.append(fstart)
+                base_codes.append(codes[consumed:consumed + flen])
+                consumed += flen
+        if count_gaps:
+            for start, length in my_gaps:
+                for fstart, flen in flat(start, length):
+                    gap_starts.append(fstart)
+                    gap_lens.append(flen)
+        for local, motif in my_ins:
+            self.insertions.contig_ids.append(ci)
+            self.insertions.local_pos.append(local)
+            self.insertions.motifs.append(motif)
+
+
+def group_insertions(events: InsertionEvents, layout: GenomeLayout):
+    """Group raw insertion events into the dense per-key column table inputs.
+
+    Returns ``None`` when there are no events, else a dict with:
+
+    * ``key_contig`` int32 [K], ``key_local`` int32 [K] — unique insertion
+      sites, ordered by (contig, local position);
+    * ``key_flat`` int64 [K] — flat genome position of the site, or -1 when
+      ``local == reflen`` (end-of-contig site: exists in the table, never
+      emitted, coverage treated as 0 — see cpu.py for the matching oracle
+      behavior);
+    * ``max_cols`` int — longest motif overall (table width);
+    * ``n_cols`` int32 [K] — longest motif per site (valid column count);
+    * ``ev_key`` int32 [E], ``ev_col`` int32 [E], ``ev_code`` int32 [E] —
+      one row per (motif occurrence, column), ready for scatter-add.
+    """
+    if len(events) == 0:
+        return None
+    contig = np.asarray(events.contig_ids, dtype=np.int64)
+    local = np.asarray(events.local_pos, dtype=np.int64)
+    motif_lens = np.array([len(m) for m in events.motifs], dtype=np.int64)
+    all_codes = BASE_TO_CODE[np.frombuffer(
+        "".join(events.motifs).encode("ascii"), dtype=np.uint8)]
+
+    # composite sort key: (contig, local); local may be negative (reads with
+    # POS=0 insert before wrap), so bias it into [0, 2^41) before packing.
+    bias = 1 << 40
+    composite = (contig << 41) + (local + bias)
+    uniq, inverse = np.unique(composite, return_inverse=True)
+    key_contig = (uniq >> 41).astype(np.int32)
+    key_local = ((uniq & ((1 << 41) - 1)) - bias).astype(np.int32)
+
+    n_cols = np.zeros(len(uniq), dtype=np.int64)
+    np.maximum.at(n_cols, inverse, motif_lens)
+    max_cols = int(n_cols.max())
+
+    # expand each motif occurrence into one event per column
+    ev_key = np.repeat(inverse, motif_lens).astype(np.int32)
+    ev_col = _expand_segments([0] * len(motif_lens),
+                              list(motif_lens)).astype(np.int32)
+    ev_code = all_codes.astype(np.int32)
+
+    reflens = layout.lengths[key_contig]
+    flat = layout.offsets[key_contig] + key_local
+    key_flat = np.where(key_local < reflens, flat, -1).astype(np.int64)
+    # negative local keys (possible via pos=0 reads): wrap like Python lists
+    neg = key_local < 0
+    if neg.any():
+        key_flat = np.where(
+            neg, layout.offsets[key_contig] + reflens + key_local, key_flat)
+
+    return {
+        "key_contig": key_contig,
+        "key_local": key_local,
+        "key_flat": key_flat,
+        "max_cols": max_cols,
+        "n_cols": n_cols.astype(np.int32),
+        "ev_key": ev_key,
+        "ev_col": ev_col,
+        "ev_code": ev_code,
+    }

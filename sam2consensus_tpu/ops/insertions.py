@@ -1,0 +1,74 @@
+"""Insertion-table construction and vote on device (pure-JAX reference path).
+
+The reference treats each insertion site as a "mini-alignment of motifs"
+(``/root/reference/sam2consensus.py:256-311``): per site, columns up to the
+longest motif; per column, nucleotide counts weighted by motif multiplicity;
+then the gap lane is completed as ``coverage[site] - sum(column counts)``
+(which may legitimately go negative — quirk 4) and the same greedy vote runs
+with the *site's* ``t * coverage`` cutoff (``:369-385``).
+
+Grouping motifs first and weighting by multiplicity is arithmetically
+identical to scatter-adding one event per (motif occurrence, column) — so the
+whole table build is a single scatter over rows pre-grouped by the host
+encoder (``encoder.events.group_insertions``).  A Pallas segmented-reduce
+variant of the same contraction lives in ``pallas_insertion.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import IUPAC_MASK_LUT
+from .vote import FILL_SENTINEL
+
+
+@jax.jit
+def build_insertion_table(table: jax.Array, ev_key: jax.Array,
+                          ev_col: jax.Array, ev_code: jax.Array) -> jax.Array:
+    """Scatter insertion events into the ``[K, max_cols, 6]`` count table."""
+    return table.at[ev_key, ev_col, ev_code].add(1)
+
+
+@jax.jit
+def vote_insertions(table: jax.Array, site_cov: jax.Array,
+                    n_cols: jax.Array, t_luts: jax.Array) -> jax.Array:
+    """Vote every insertion column for every threshold.
+
+    Args:
+      table: int32 ``[K, C, 6]`` raw base counts (gap lane all zero).
+      site_cov: int32 ``[K]`` coverage at each site's reference position
+        (0 for end-of-contig sites) — the cutoff uses the SITE's coverage,
+        not the column sum (sam2consensus.py:376).
+      n_cols: int32 ``[K]`` valid column count per site (longest motif).
+      t_luts: int32 ``[T, max_cov+1]``.
+
+    Returns:
+      uint8 ``[T, K, C]``: output byte per column; FILL_SENTINEL where the
+      column is skipped (past n_cols, or the call is "-",
+      sam2consensus.py:381-382).
+    """
+    # gap-lane completion: cov - sum(all lanes); may be negative (quirk 4)
+    colsum = table.sum(axis=-1)                                # [K, C]
+    completed = table.at[:, :, 0].set(site_cov[:, None] - colsum)
+
+    greater = completed[..., None, :] > completed[..., :, None]
+    strictly_greater_sum = jnp.sum(
+        jnp.where(greater, completed[..., None, :], 0), axis=-1)  # [K, C, 6]
+    nonzero = completed != 0
+    bit = (1 << jnp.arange(6, dtype=jnp.int32))
+    lut = jnp.asarray(IUPAC_MASK_LUT)
+    valid = (jnp.arange(table.shape[1])[None, :] < n_cols[:, None])  # [K, C]
+
+    def per_threshold(tlut):
+        cutoff = tlut[site_cov]                                # [K]
+        included = nonzero & (strictly_greater_sum < cutoff[:, None, None])
+        mask = jnp.sum(jnp.where(included, bit, 0), axis=-1)   # [K, C]
+        syms = lut[mask]
+        skip = (syms == ord("-")) | ~valid
+        return jnp.where(skip, jnp.uint8(FILL_SENTINEL), syms)
+
+    return jax.vmap(per_threshold)(t_luts)

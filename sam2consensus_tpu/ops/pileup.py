@@ -1,0 +1,71 @@
+"""Device-side pileup accumulation: the reference's hot loop as one scatter.
+
+The reference spends ~all wall-clock doing one Python dict increment per
+aligned base (``/root/reference/sam2consensus.py:211-218``, SURVEY.md CS3).
+Here the same update is ``counts.at[positions, codes].add(1)`` on a flat
+``[total_len + 1, 6]`` int32 tensor — XLA lowers it to a vectorized scatter
+whose duplicate-index accumulation is exact, so read order and sharding
+cannot change the result (addition commutes; SURVEY.md §5).
+
+Chunks arrive padded to a fixed size so the jitted update compiles once:
+pad rows point at the sacrificial row ``total_len`` which is dropped at read
+time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..encoder.events import PileupChunk
+
+
+@jax.jit
+def _scatter_add(counts: jax.Array, positions: jax.Array,
+                 codes: jax.Array) -> jax.Array:
+    return counts.at[positions, codes].add(1)
+
+
+class PileupAccumulator:
+    """Streaming accumulator for one device (sharded use lives in parallel/)."""
+
+    def __init__(self, total_len: int, pad_to: int = 1 << 22,
+                 device=None):
+        self.total_len = total_len
+        self.pad_to = pad_to
+        self.device = device
+        counts = jnp.zeros((total_len + 1, 6), dtype=jnp.int32)
+        if device is not None:
+            counts = jax.device_put(counts, device)
+        self._counts = counts
+
+    def add(self, chunk: PileupChunk) -> None:
+        n = len(chunk.positions)
+        if n == 0:
+            return
+        for start in range(0, n, self.pad_to):
+            pos = chunk.positions[start:start + self.pad_to]
+            code = chunk.codes[start:start + self.pad_to]
+            if len(pos) < self.pad_to:
+                # pad the tail slice up to a power-of-two bucket so jit
+                # compiles O(log) distinct shapes; pad rows write into the
+                # sacrificial row (counts[total_len])
+                target = max(1024, 1 << (len(pos) - 1).bit_length())
+                pad = target - len(pos)
+                pos = np.concatenate(
+                    [pos, np.full(pad, self.total_len, dtype=np.int32)])
+                code = np.concatenate([code, np.zeros(pad, dtype=np.int32)])
+            self._counts = _scatter_add(self._counts,
+                                        jnp.asarray(pos), jnp.asarray(code))
+
+    @property
+    def counts(self) -> jax.Array:
+        """Valid counts, ``[total_len, 6]`` (sacrificial row dropped)."""
+        return self._counts[:-1]
+
+    def set_counts(self, counts: jax.Array) -> None:
+        """Restore from a checkpoint: counts of shape [total_len, 6]."""
+        self._counts = jnp.concatenate(
+            [jnp.asarray(counts, dtype=jnp.int32),
+             jnp.zeros((1, 6), dtype=jnp.int32)], axis=0)

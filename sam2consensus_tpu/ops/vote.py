@@ -1,0 +1,96 @@
+"""The threshold consensus vote as a closed-form per-position reduction.
+
+The reference's caller (``/root/reference/sam2consensus.py:359-367``) walks
+count groups in descending order, taking whole tie-groups while the running
+total stays below ``t * coverage``.  That sequential greedy loop has an exact
+per-lane closed form, which is what makes it a TPU-friendly elementwise op:
+
+    lane i is included  ⟺  c_i != 0  AND  S_i < t * cov,
+    where S_i = Σ_j c_j over lanes j with c_j > c_i.
+
+Proof sketch: groups share a count value, so "all lanes with strictly greater
+count" is exactly the set of groups taken before lane i's group, and the
+greedy prefix is monotone (the only possibly-negative lane — the completed
+insertion gap lane, quirk 4 — sorts last, so prefix sums are non-decreasing
+until the final group).  Tie-group all-or-nothing inclusion and the
+break-at-first-failure are both captured.  Pinned against the oracle by the
+differential tests.
+
+Float fidelity: the reference compares an integer running total against the
+Python float ``t * coverage``.  To make the device comparison exact without
+global float64, the host precomputes, per threshold, an integer LUT
+``T[cov] = ceil(float64(t) * cov)``; then ``S < t*cov  ⟺  S < T[cov]`` for
+every integer S (see ``threshold_luts``), and the device never touches
+floats at all — the whole vote is int32/uint8 arithmetic.
+
+The called set becomes a 6-bit mask (bit i = ALPHABET[i], ASCII-sorted order)
+mapped through the 64-entry IUPAC LUT — the tensor form of the reference's
+``amb["".join(sorted(nucs))]``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import IUPAC_MASK_LUT
+
+#: device output byte marking "fill this position on host" (cov==0 or
+#: cov<min_depth); never collides with real output chars (all >= ord('-')).
+FILL_SENTINEL = 0
+
+
+def threshold_luts(thresholds: Sequence[float], max_cov: int) -> np.ndarray:
+    """Integer cutoffs: ``lut[t, cov] = ceil(float64(t)*cov)`` as int32.
+
+    For integer S: ``S < t*cov`` (the reference's float comparison at
+    sam2consensus.py:362) ⟺ ``S < lut[t, cov]``, because the smallest
+    integer not less than the float product is its ceiling.
+    """
+    t = np.asarray(thresholds, dtype=np.float64)[:, None]
+    cov = np.arange(max_cov + 1, dtype=np.float64)[None, :]
+    prod = t * cov
+    lut = np.ceil(prod)
+    if lut.max() > np.iinfo(np.int32).max:
+        raise OverflowError("threshold*coverage exceeds int32")
+    return lut.astype(np.int32)
+
+
+@partial(jax.jit, static_argnames=("min_depth",))
+def vote_positions(counts: jax.Array, t_luts: jax.Array,
+                   min_depth: int) -> tuple:
+    """Vote every position for every threshold.
+
+    Args:
+      counts: int32 ``[L, 6]`` pileup counts.
+      t_luts: int32 ``[T, max_cov+1]`` integer cutoff LUTs.
+      min_depth: static minimum depth gate.
+
+    Returns:
+      syms: uint8 ``[T, L]`` output byte per position (FILL_SENTINEL where
+        the reference emits the fill character), and cov: int32 ``[L]``.
+    """
+    cov = counts.sum(axis=-1)                                  # [L]
+    # S[l, i] = sum_j counts[l, j] * (counts[l, j] > counts[l, i]); the
+    # [L, 6, 6] broadcast fuses into the reduction under XLA.
+    greater = counts[:, None, :] > counts[:, :, None]
+    strictly_greater_sum = jnp.sum(
+        jnp.where(greater, counts[:, None, :], 0), axis=-1)    # [L, 6]
+    nonzero = counts != 0
+    bit = (1 << jnp.arange(6, dtype=jnp.int32))[None, :]
+    lut = jnp.asarray(IUPAC_MASK_LUT)
+
+    emit = (cov > 0) & (cov >= min_depth)                      # [L]
+
+    def per_threshold(tlut):
+        cutoff = tlut[cov]                                     # [L]
+        included = nonzero & (strictly_greater_sum < cutoff[:, None])
+        mask = jnp.sum(jnp.where(included, bit, 0), axis=-1)   # [L]
+        syms = lut[mask]
+        return jnp.where(emit, syms, jnp.uint8(FILL_SENTINEL))
+
+    return jax.vmap(per_threshold)(t_luts), cov

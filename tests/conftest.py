@@ -13,6 +13,17 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# An environment sitecustomize may pre-register a remote TPU backend and
+# override jax_platforms via jax.config (trumping the env var), which would
+# make the first backend use dial remote hardware from unit tests.  Re-pin
+# the config to cpu before any backend is initialized.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover - jax is a hard dep of the jax path
+    pass
+
 import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -88,3 +88,16 @@ def test_py2_compat_maxdel(tmp_path):
     main(["-i", sam, "-o", out2, "-d", "2", "--py2-compat", "--quiet"])
     c2 = open(os.path.join(out2, "r__d.fasta")).read()
     assert "coverage:0.88" in c2
+
+
+def test_jax_backend_cli_identical_output(tmp_path):
+    sam = _fixture(tmp_path)
+    out_cpu = str(tmp_path / "oc")
+    out_jax = str(tmp_path / "oj")
+    assert main(["-i", sam, "-o", out_cpu, "--quiet"]) == 0
+    assert main(["-i", sam, "-o", out_jax, "--quiet", "--backend", "jax"]) == 0
+    import filecmp
+    match, mismatch, errors = filecmp.cmpfiles(
+        out_cpu, out_jax, os.listdir(out_cpu), shallow=False)
+    assert mismatch == [] and errors == []
+    assert sorted(os.listdir(out_cpu)) == sorted(os.listdir(out_jax))

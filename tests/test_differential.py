@@ -1,0 +1,163 @@
+"""Golden-oracle differential tests: jax backend ⇔ cpu backend, byte for byte.
+
+This is the operational meaning of BASELINE.md's correctness gate ("FASTA
+byte-identity vs CPU backend", SURVEY.md §4).  Every corpus entry renders the
+full output files (headers + wrapping) for both backends and compares text.
+"""
+
+import io
+
+import pytest
+
+from sam2consensus_tpu.backends.cpu import CpuBackend
+from sam2consensus_tpu.backends.jax_backend import JaxBackend
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.io.sam import iter_records, read_header
+from sam2consensus_tpu.utils.simulate import (BASELINE_SPECS, SimSpec,
+                                              sam_text, simulate)
+
+
+def rendered(backend, text, cfg):
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    res = backend.run(contigs, iter_records(handle, first), cfg)
+    return {name: render_file(recs, cfg.nchar)
+            for name, recs in res.fastas.items()}
+
+
+def assert_identical(text, **cfg_kwargs):
+    cfg = RunConfig(prefix="p", **cfg_kwargs)
+    out_cpu = rendered(CpuBackend(), text, cfg)
+    out_jax = rendered(JaxBackend(), text, cfg)
+    assert out_jax == out_cpu
+
+
+HANDCRAFTED = {
+    "basic": sam_text([("ref1", 10)], [
+        ("ref1", 1, "4M", "ACGT"), ("ref1", 3, "2M", "GT")]),
+    "ties": sam_text([("r", 1)], [
+        ("r", 1, "1M", "A"), ("r", 1, "1M", "A"),
+        ("r", 1, "1M", "C"), ("r", 1, "1M", "C"), ("r", 1, "1M", "T")]),
+    "deletion": sam_text([("r", 8)], [("r", 1, "2M3D2M", "ACGT")]),
+    "insertions": sam_text([("r", 6)], [
+        ("r", 1, "3M", "AAA"), ("r", 1, "3M", "AAA"), ("r", 1, "3M", "AAA"),
+        ("r", 1, "2M2I1M", "AACCA")]),
+    "ins_no_cov": sam_text([("r", 2)], [("r", 1, "1M2I", "ACC")]),
+    "ins_at_end": sam_text([("r", 2)], [("r", 1, "2M2I", "AACC")]),
+    "neg_pos_wrap": sam_text([("r", 4)], [
+        ("r", 0, "2M", "AC"), ("r", 1, "1M", "G")]),
+    "multi_contig": sam_text([("a", 5), ("b", 7), ("zero", 3)], [
+        ("a", 1, "5M", "ACGTA"), ("b", 3, "4M", "TTTT"),
+        ("b", 1, "2M1I3M", "GGCAAA")]),
+    "n_bases": sam_text([("r", 3)], [
+        ("r", 1, "3M", "ANA"), ("r", 1, "3M", "NNA"), ("r", 1, "3M", "AGA")]),
+    "all_ops": sam_text([("r", 20)], [
+        ("r", 3, "2S3M1I2M2D1M2H", "TTACGTCAGX"[:9]),
+        ("r", 1, "5M", "ACGTA"), ("r", 10, "3=1X2M", "ACGTAC")]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(HANDCRAFTED))
+def test_handcrafted_identical(name):
+    assert_identical(HANDCRAFTED[name])
+
+
+@pytest.mark.parametrize("name", sorted(HANDCRAFTED))
+def test_handcrafted_identical_multithreshold(name):
+    assert_identical(HANDCRAFTED[name], thresholds=[0.25, 0.5, 0.75, 1.0])
+
+
+def test_simulated_phix_like():
+    spec = BASELINE_SPECS["phix_like"]
+    spec = SimSpec(**{**spec.__dict__, "n_reads": 800, "contig_len": 800})
+    assert_identical(simulate(spec), thresholds=[0.25, 0.5, 0.75])
+
+
+def test_simulated_target_capture():
+    spec = BASELINE_SPECS["target_capture"]
+    spec = SimSpec(**{**spec.__dict__, "n_contigs": 25, "n_reads": 1500,
+                      "contig_len": 300})
+    assert_identical(simulate(spec), thresholds=[0.25, 0.75])
+
+
+def test_simulated_amplicon_deep():
+    spec = BASELINE_SPECS["amplicon_deep"]
+    spec = SimSpec(**{**spec.__dict__, "n_reads": 3000, "contig_len": 200})
+    assert_identical(simulate(spec), thresholds=[0.25, 0.5], min_depth=10)
+
+
+def test_min_depth_and_fill_variants():
+    text = simulate(SimSpec(n_contigs=3, contig_len=150, n_reads=120,
+                            read_len=40, seed=9))
+    assert_identical(text, min_depth=3, fill="N")
+    assert_identical(text, min_depth=2, fill="?")
+
+
+def test_maxdel_variants():
+    text = simulate(SimSpec(n_contigs=2, contig_len=200, n_reads=300,
+                            read_len=50, del_read_rate=0.5, max_indel=5,
+                            seed=11))
+    assert_identical(text, maxdel=2)
+    assert_identical(text, maxdel=None)
+    assert_identical(text, maxdel=0)
+
+
+def test_wrapping_identical():
+    text = HANDCRAFTED["multi_contig"]
+    cfg = RunConfig(prefix="p", nchar=3)
+    assert rendered(JaxBackend(), text, cfg) == rendered(CpuBackend(), text, cfg)
+
+
+def test_odd_thresholds_float_fidelity():
+    # thresholds with inexact float64 representations exercise the integer
+    # cutoff LUT (ops/vote.py threshold_luts) against the oracle's raw
+    # float comparison
+    text = simulate(SimSpec(n_contigs=2, contig_len=120, n_reads=600,
+                            read_len=30, seed=13))
+    assert_identical(text, thresholds=[0.1, 0.3, 0.33, 0.66, 0.9, 1.0])
+
+
+def test_permissive_mode_identical():
+    text = sam_text([("r", 4)], [
+        ("other", 1, "2M", "AC"),      # unknown ref -> skipped
+        ("r", 3, "4M", "ACGT"),        # overruns contig -> skipped
+        ("r", 1, "2M", "ac"),          # bad alphabet -> skipped
+        ("r", 1, "3M", "ACG"),
+    ])
+    assert_identical(text, strict=False)
+
+
+def test_literal_dash_in_seq_counts_toward_maxdel():
+    # '-' is in the count alphabet: literal dashes in SEQ vote for gaps and
+    # count toward the maxdel gate (seqout.count('-') gates them all).
+    text = sam_text([("r", 4)], [
+        ("r", 1, "4M", "A--T"),
+        ("r", 1, "4M", "ACGT"),
+    ])
+    assert_identical(text, maxdel=1)
+    assert_identical(text, maxdel=2)
+    assert_identical(text, thresholds=[0.25, 0.75], maxdel=1)
+
+
+def test_invalid_motif_base_both_backends_raise():
+    text = sam_text([("r", 6)], [("r", 1, "2M2I2M", "AAxxGG")])
+    cfg = RunConfig(prefix="p")
+    with pytest.raises(KeyError):
+        rendered(CpuBackend(), text, cfg)
+    from sam2consensus_tpu.encoder.events import EncodeError
+    with pytest.raises(EncodeError):
+        rendered(JaxBackend(), text, cfg)
+    # permissive mode: both skip the read entirely, identical output
+    assert_identical(text, strict=False)
+
+
+def test_zero_span_read_beyond_contig_accepted():
+    # all-S/H/I CIGARs touch no position; the reference runs a zero-iteration
+    # loop and accepts them at any POS.
+    text = sam_text([("r", 4)], [
+        ("r", 9, "2S", "TT"),
+        ("r", 9, "3H", "*"),
+        ("r", 1, "4M", "ACGT"),
+    ])
+    assert_identical(text)
