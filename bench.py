@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""Benchmark: consensus bases/sec, jax backend vs the CPU golden baseline.
+
+Prints ONE JSON line:
+  {"metric": "consensus_bases_per_sec", "value": N, "unit": "bases/sec",
+   "vs_baseline": N}
+
+``value`` is the end-to-end jax-backend throughput (SAM text -> FASTA
+records, warm compile) on this machine's default JAX device (the TPU chip
+under the driver); ``vs_baseline`` is the speedup over the CPU golden
+backend on the identical workload (BASELINE.md's primary metric).  The run
+also asserts FASTA byte-identity between the two backends — a benchmark
+that produced wrong bytes would be meaningless.
+
+Workload knobs via env: BENCH_READS (default 200000), BENCH_CONTIGS (100),
+BENCH_READ_LEN (100), BENCH_CONTIG_LEN (2000).
+"""
+
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from sam2consensus_tpu.utils.platform import pin_platform_from_env  # noqa: E402
+pin_platform_from_env()
+
+from sam2consensus_tpu.backends.cpu import CpuBackend          # noqa: E402
+from sam2consensus_tpu.backends.jax_backend import JaxBackend  # noqa: E402
+from sam2consensus_tpu.config import RunConfig                 # noqa: E402
+from sam2consensus_tpu.io.fasta import render_file             # noqa: E402
+from sam2consensus_tpu.io.sam import iter_records, read_header  # noqa: E402
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate  # noqa: E402
+
+
+def run_once(backend, text, cfg):
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    t0 = time.perf_counter()
+    res = backend.run(contigs, iter_records(handle, first), cfg)
+    elapsed = time.perf_counter() - t0
+    rendered = {n: render_file(r, 0) for n, r in res.fastas.items()}
+    return res.stats, elapsed, rendered
+
+
+def main():
+    spec = SimSpec(
+        n_contigs=int(os.environ.get("BENCH_CONTIGS", "100")),
+        contig_len=int(os.environ.get("BENCH_CONTIG_LEN", "2000")),
+        n_reads=int(os.environ.get("BENCH_READS", "200000")),
+        read_len=int(os.environ.get("BENCH_READ_LEN", "100")),
+        ins_read_rate=0.05, del_read_rate=0.05, seed=42)
+    text = simulate(spec)
+    cfg = RunConfig(prefix="bench", thresholds=[0.25])
+
+    cpu_stats, cpu_time, cpu_out = run_once(CpuBackend(), text, cfg)
+
+    jax_backend = JaxBackend()
+    # warm-up run: pays jit compiles for this genome length / chunk buckets
+    _stats, _t, _out = run_once(jax_backend, text, cfg)
+    jax_stats, jax_time, jax_out = run_once(jax_backend, text, cfg)
+
+    assert jax_out == cpu_out, "BENCH INVALID: backends disagree byte-wise"
+    bases = jax_stats.consensus_bases
+    value = bases / jax_time
+    baseline = bases / cpu_time
+    print(json.dumps({
+        "metric": "consensus_bases_per_sec",
+        "value": round(value, 1),
+        "unit": "bases/sec",
+        "vs_baseline": round(value / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,7 @@ class JaxBackend:
     def run(self, contigs: List[Contig], records: Iterable[SamRecord],
             cfg: RunConfig) -> BackendResult:
         # jax imports deferred so `--backend cpu` never pays them
+        import jax
         import jax.numpy as jnp
 
         from ..encoder.events import GenomeLayout, ReadEncoder, group_insertions
@@ -48,22 +49,39 @@ class JaxBackend:
         if layout.total_len == 0:
             return BackendResult(fastas={}, stats=stats)
 
+        n_dev = len(jax.devices())
+        shards = cfg.shards if cfg.shards > 0 else n_dev
+        use_sharded = shards > 1
+
         encoder = ReadEncoder(layout, maxdel=cfg.maxdel, strict=cfg.strict)
-        acc = PileupAccumulator(layout.total_len)
+        if use_sharded:
+            from ..parallel.dp import ShardedConsensus
+            from ..parallel.mesh import make_mesh
+
+            acc = ShardedConsensus(make_mesh(shards), layout.total_len)
+        else:
+            acc = PileupAccumulator(layout.total_len)
         for chunk in encoder.encode_chunks(records, cfg.chunk_reads):
             acc.add(chunk)
             stats.aligned_bases += len(chunk.positions)
         stats.reads_mapped = encoder.n_reads
         stats.reads_skipped = encoder.n_skipped
+        stats.extra["shards"] = shards if use_sharded else 1
 
-        counts = acc.counts                                   # [L, 6] device
-        cov_dev = counts.sum(axis=-1)
-        max_cov = int(cov_dev.max())
-        t_luts = jnp.asarray(threshold_luts(cfg.thresholds, max_cov))
-
-        syms_dev, _ = vote_positions(counts, t_luts, cfg.min_depth)
-        syms = np.asarray(syms_dev)                           # [T, L] uint8
-        cov = np.asarray(cov_dev, dtype=np.int64)             # [L]
+        if use_sharded:
+            max_cov = int(jnp.max(jnp.sum(
+                acc.counts[: layout.total_len], axis=-1)))
+            luts_np = threshold_luts(cfg.thresholds, max_cov)
+            t_luts = jnp.asarray(luts_np)   # device copy for insertion vote
+            syms, cov = acc.vote(luts_np, cfg.min_depth)
+        else:
+            counts = acc.counts                               # [L, 6] device
+            cov_dev = counts.sum(axis=-1)
+            max_cov = int(cov_dev.max())
+            t_luts = jnp.asarray(threshold_luts(cfg.thresholds, max_cov))
+            syms_dev, _ = vote_positions(counts, t_luts, cfg.min_depth)
+            syms = np.asarray(syms_dev)                       # [T, L] uint8
+            cov = np.asarray(cov_dev, dtype=np.int64)         # [L]
 
         ins = group_insertions(encoder.insertions, layout)
         if ins is not None:

@@ -127,10 +127,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     # refuse silently ignoring not-yet-wired flags (they land with the
     # parallel/checkpoint/profiling milestones)
     for flag, value in (("--profile-dir", cfg.profile_dir),
-                        ("--checkpoint-dir", cfg.checkpoint_dir),
-                        ("--shards", cfg.shards)):
+                        ("--checkpoint-dir", cfg.checkpoint_dir)):
         if value:
             raise SystemExit(f"{flag} is not implemented yet")
+    if cfg.shards and cfg.backend != "jax":
+        raise SystemExit("--shards requires --backend jax")
 
     t0 = time.perf_counter()
     echo("\nProcessing file " + args.filename + ":\n")

@@ -60,10 +60,13 @@ def threshold_luts(thresholds: Sequence[float], max_cov: int) -> np.ndarray:
     return lut.astype(np.int32)
 
 
-@partial(jax.jit, static_argnames=("min_depth",))
-def vote_positions(counts: jax.Array, t_luts: jax.Array,
-                   min_depth: int) -> tuple:
-    """Vote every position for every threshold.
+def vote_block(counts: jax.Array, t_luts: jax.Array,
+               min_depth: int) -> tuple:
+    """Vote every position of a counts block for every threshold.
+
+    Pure traceable function (no jit) so it can run inside ``jax.jit``,
+    ``shard_map`` blocks (position-sharded vote) and Pallas comparisons
+    alike.
 
     Args:
       counts: int32 ``[L, 6]`` pileup counts.
@@ -94,3 +97,7 @@ def vote_positions(counts: jax.Array, t_luts: jax.Array,
         return jnp.where(emit, syms, jnp.uint8(FILL_SENTINEL))
 
     return jax.vmap(per_threshold)(t_luts), cov
+
+
+#: jitted single-device entry point over a full counts tensor
+vote_positions = partial(jax.jit, static_argnames=("min_depth",))(vote_block)

@@ -1,0 +1,130 @@
+"""Sharded consensus pipeline: DP scatter-add → reduce-scatter → SP vote.
+
+The distributed design (SURVEY.md §5 "Distributed communication backend"):
+the count tensor is a sum-decomposable sufficient statistic, so data
+parallelism plus one collective reduction is *exact* — no read ordering or
+tie-breaking concerns.  The collective rides XLA:
+
+1. each device scatter-adds its read-event shard into a full-length local
+   count tensor (pure DP over the flattened ("dp","sp") axes);
+2. one ``lax.psum_scatter`` both sums the local tensors and leaves each
+   device holding one contiguous block of the position axis — a
+   reduce-scatter, bandwidth-optimal vs. all-reduce (factor n less traffic),
+   and the result is already in the layout the vote wants;
+3. the vote (elementwise per position) runs on the position-sharded blocks —
+   sequence parallelism with zero extra communication;
+4. results reach the host as one device-sharded array fetch.
+
+On a single host the collectives ride ICI; on multi-host meshes the same
+code rides DCN (JAX mesh abstraction covers both, no NCCL/MPI analogue is
+needed).  The accumulator state stays position-sharded between chunks, so
+streaming input and checkpoint/resume compose with sharding.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..encoder.events import PileupChunk
+
+ALL = ("dp", "sp")  # both mesh axes flattened: pure-DP / pure-SP phases
+
+
+class ShardedConsensus:
+    """Streaming sharded accumulate + vote over a ("dp", "sp") mesh."""
+
+    def __init__(self, mesh: Mesh, total_len: int):
+        self.mesh = mesh
+        self.n = mesh.size
+        self.total_len = total_len
+        # position axis padded so every device owns an equal block; the
+        # sacrificial scatter row (index total_len) lives inside the pad.
+        self.block = -(-(total_len + 1) // self.n)
+        self.padded_len = self.block * self.n
+
+        counts_spec = NamedSharding(mesh, P(ALL, None))
+        self._counts = jax.device_put(
+            jnp.zeros((self.padded_len, 6), dtype=jnp.int32), counts_spec)
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(ALL, None), P(ALL), P(ALL)),
+                 out_specs=P(ALL, None))
+        def accumulate(counts_blk, positions, codes):
+            local = jnp.zeros((self.padded_len, 6), dtype=jnp.int32)
+            local = local.at[positions, codes].add(1)
+            # reduce over every device AND scatter position blocks: each
+            # device leaves holding its own summed block (reduce-scatter).
+            return counts_blk + jax.lax.psum_scatter(
+                local, ALL, scatter_dimension=0, tiled=True)
+
+        self._accumulate = jax.jit(accumulate, donate_argnums=0)
+
+    # -- streaming input --------------------------------------------------
+    def add(self, chunk: PileupChunk, pad_to: int = 1 << 22) -> None:
+        n_ev = len(chunk.positions)
+        if n_ev == 0:
+            return
+        # slices must shard evenly over the mesh: round the slice size up to
+        # a multiple of the device count (matters for non-power-of-two n)
+        pad_to = -(-pad_to // self.n) * self.n
+        for start in range(0, n_ev, pad_to):
+            pos = chunk.positions[start:start + pad_to]
+            code = chunk.codes[start:start + pad_to]
+            if len(pos) < pad_to:
+                target = max(self.n, 1 << (len(pos) - 1).bit_length())
+                target = -(-target // self.n) * self.n
+            else:
+                target = pad_to
+            if len(pos) < target:
+                pad = target - len(pos)
+                pos = np.concatenate(
+                    [pos, np.full(pad, self.total_len, dtype=np.int32)])
+                code = np.concatenate([code, np.zeros(pad, dtype=np.int32)])
+            spec = NamedSharding(self.mesh, P(ALL))
+            self._counts = self._accumulate(
+                self._counts,
+                jax.device_put(pos, spec), jax.device_put(code, spec))
+
+    # -- state ------------------------------------------------------------
+    @property
+    def counts(self) -> jax.Array:
+        """Position-sharded counts including the pad rows ([padded_len, 6])."""
+        return self._counts
+
+    def counts_host(self) -> np.ndarray:
+        """Valid counts on host, ``[total_len, 6]``."""
+        return np.asarray(self._counts)[: self.total_len]
+
+    def restore(self, counts: np.ndarray) -> None:
+        """Load checkpointed counts (``[total_len, 6]``), re-sharded."""
+        padded = np.zeros((self.padded_len, 6), dtype=np.int32)
+        padded[: self.total_len] = counts
+        self._counts = jax.device_put(
+            jnp.asarray(padded), NamedSharding(self.mesh, P(ALL, None)))
+
+    # -- vote -------------------------------------------------------------
+    def vote(self, t_luts: np.ndarray, min_depth: int
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        """Position-sharded vote; returns host (syms [T, total_len], cov)."""
+        from ..ops.vote import vote_block
+
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(P(ALL, None), P(None, None)),
+                 out_specs=(P(None, ALL), P(ALL)))
+        def voted(counts_blk, luts):
+            return vote_block(counts_blk, luts, min_depth)
+
+        syms, cov = jax.jit(voted)(self._counts, jnp.asarray(t_luts))
+        return (np.asarray(syms)[:, : self.total_len],
+                np.asarray(cov, dtype=np.int64)[: self.total_len])

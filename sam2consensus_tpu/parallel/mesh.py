@@ -1,0 +1,49 @@
+"""Device-mesh construction for the consensus workload.
+
+The workload has exactly two meaningful parallel dimensions (SURVEY.md §2b):
+
+* **dp** — data parallelism over SAM reads: each device scatter-adds its
+  read shard into a local count tensor; addition commutes, so a single
+  collective reduction makes this exact.
+* **sp** — sequence (genome-position) parallelism: the count tensor's flat
+  position axis is sharded for the vote and for huge references (the
+  counting-workload analogue of context parallelism, SURVEY.md §5).
+
+TP/PP/EP have no analogue in a counting pipeline and are deliberately not
+faked.  Reads and positions are both flat axes, so when a phase uses only
+one dimension it shards over the ("dp", "sp") axes *flattened* — the mesh
+stays 2-D so multi-host layouts can later map dp to DCN and sp to ICI
+without code changes (JAX meshes abstract both fabrics).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def factor_mesh(n: int) -> Tuple[int, int]:
+    """Split ``n`` devices into (dp, sp), preferring a balanced 2-D mesh."""
+    sp = 1
+    for cand in range(int(np.sqrt(n)), 0, -1):
+        if n % cand == 0:
+            sp = cand
+            break
+    return n // sp, sp
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the ("dp", "sp") mesh over the first ``n_devices`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    dp, sp = factor_mesh(len(devices))
+    return Mesh(np.asarray(devices).reshape(dp, sp), ("dp", "sp"))

@@ -1,0 +1,21 @@
+"""Platform selection guard.
+
+Some environments pre-register a remote accelerator backend from a
+``sitecustomize`` hook and override ``jax_platforms`` through ``jax.config``
+— which silently trumps the ``JAX_PLATFORMS`` environment variable the user
+(or a test/driver harness) set.  ``pin_platform_from_env`` restores the
+env var's authority; it is a no-op when the env var is unset or explicitly
+includes the remote platform.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_platform_from_env() -> None:
+    env_p = os.environ.get("JAX_PLATFORMS", "")
+    if env_p and "axon" not in env_p:
+        import jax
+
+        jax.config.update("jax_platforms", env_p)

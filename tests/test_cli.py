@@ -101,3 +101,10 @@ def test_jax_backend_cli_identical_output(tmp_path):
         out_cpu, out_jax, os.listdir(out_cpu), shallow=False)
     assert mismatch == [] and errors == []
     assert sorted(os.listdir(out_cpu)) == sorted(os.listdir(out_jax))
+
+
+def test_shards_requires_jax_backend(tmp_path):
+    sam = _fixture(tmp_path)
+    import pytest
+    with pytest.raises(SystemExit, match="requires --backend jax"):
+        main(["-i", sam, "-o", str(tmp_path / "o"), "--shards", "4", "--quiet"])

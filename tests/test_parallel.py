@@ -1,0 +1,131 @@
+"""Sharded pipeline tests on the 8 virtual CPU devices (conftest).
+
+SURVEY.md §4 "multi-device without a cluster": the same shard_map code later
+runs unchanged on a real slice.  Exactness is guaranteed by sum-decomposition
+of the count tensor; these tests pin it empirically.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+import jax
+
+from sam2consensus_tpu.backends.cpu import CpuBackend
+from sam2consensus_tpu.backends.jax_backend import JaxBackend
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.encoder.events import GenomeLayout, ReadEncoder
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.io.sam import Contig, iter_records, read_header
+from sam2consensus_tpu.ops.pileup import PileupAccumulator
+from sam2consensus_tpu.ops.vote import threshold_luts
+from sam2consensus_tpu.parallel.dp import ShardedConsensus
+from sam2consensus_tpu.parallel.mesh import factor_mesh, make_mesh
+from sam2consensus_tpu.utils.simulate import SimSpec, sam_text, simulate
+
+
+def test_factor_mesh():
+    assert factor_mesh(8) == (4, 2)
+    assert factor_mesh(7) == (7, 1)
+    assert factor_mesh(4) == (2, 2)
+    assert factor_mesh(1) == (1, 1)
+
+
+def test_mesh_axes():
+    mesh = make_mesh(8)
+    assert mesh.axis_names == ("dp", "sp")
+    assert mesh.size == 8
+
+
+def _encode_all(text):
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    layout = GenomeLayout(contigs)
+    enc = ReadEncoder(layout)
+    chunks = list(enc.encode_chunks(iter_records(handle, first),
+                                    chunk_reads=64))
+    return layout, chunks
+
+
+def test_sharded_counts_equal_single_device():
+    text = simulate(SimSpec(n_contigs=4, contig_len=200, n_reads=500,
+                            read_len=50, seed=21))
+    layout, chunks = _encode_all(text)
+
+    single = PileupAccumulator(layout.total_len)
+    for c in chunks:
+        single.add(c)
+    expected = np.asarray(single.counts)
+
+    sharded = ShardedConsensus(make_mesh(8), layout.total_len)
+    for c in chunks:
+        sharded.add(c)
+    np.testing.assert_array_equal(sharded.counts_host(), expected)
+
+
+def test_sharded_vote_equals_single_vote():
+    text = simulate(SimSpec(n_contigs=3, contig_len=150, n_reads=400,
+                            read_len=40, seed=22))
+    layout, chunks = _encode_all(text)
+    sharded = ShardedConsensus(make_mesh(8), layout.total_len)
+    for c in chunks:
+        sharded.add(c)
+    max_cov = int(sharded.counts_host().sum(axis=1).max())
+    luts = threshold_luts([0.25, 0.75], max_cov)
+    syms, cov = sharded.vote(luts, min_depth=1)
+
+    from sam2consensus_tpu.ops.vote import vote_positions
+    import jax.numpy as jnp
+    syms1, cov1 = vote_positions(jnp.asarray(sharded.counts_host()),
+                                 jnp.asarray(luts), 1)
+    np.testing.assert_array_equal(syms, np.asarray(syms1))
+    np.testing.assert_array_equal(cov, np.asarray(cov1))
+
+
+def test_restore_roundtrip():
+    layout = GenomeLayout([Contig("a", 40), Contig("b", 25)])
+    sharded = ShardedConsensus(make_mesh(8), layout.total_len)
+    rng = np.random.RandomState(0)
+    counts = rng.randint(0, 50, size=(layout.total_len, 6)).astype(np.int32)
+    sharded.restore(counts)
+    np.testing.assert_array_equal(sharded.counts_host(), counts)
+
+
+@pytest.mark.parametrize("shards", [2, 8])
+def test_sharded_backend_byte_identical(shards):
+    text = simulate(SimSpec(n_contigs=5, contig_len=180, n_reads=600,
+                            read_len=40, ins_read_rate=0.15,
+                            del_read_rate=0.15, seed=23))
+
+    def run(cfg):
+        handle = io.StringIO(text)
+        contigs, _n, first = read_header(handle)
+        res = (CpuBackend() if cfg.backend == "cpu" else JaxBackend()).run(
+            contigs, iter_records(handle, first), cfg)
+        return {n: render_file(r, 0) for n, r in res.fastas.items()}
+
+    cfg_cpu = RunConfig(prefix="p", thresholds=[0.25, 0.75], backend="cpu")
+    cfg_jax = RunConfig(prefix="p", thresholds=[0.25, 0.75], backend="jax",
+                        shards=shards)
+    assert run(cfg_jax) == run(cfg_cpu)
+
+
+def test_shards_exceeding_devices_raises():
+    with pytest.raises(ValueError):
+        make_mesh(99)
+
+
+def test_sharded_six_devices_large_slice():
+    # non-power-of-two device count: a slice at the pad_to boundary must
+    # still shard evenly (regression for the full-slice rounding bug)
+    text = simulate(SimSpec(n_contigs=2, contig_len=120, n_reads=300,
+                            read_len=40, seed=31))
+    layout, chunks = _encode_all(text)
+    single = PileupAccumulator(layout.total_len)
+    sharded = ShardedConsensus(make_mesh(6), layout.total_len)
+    for c in chunks:
+        single.add(c)
+        sharded.add(c, pad_to=1000)  # 1000 % 6 != 0 -> exercises rounding
+    np.testing.assert_array_equal(sharded.counts_host(),
+                                  np.asarray(single.counts))
