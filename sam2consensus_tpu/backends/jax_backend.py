@@ -61,9 +61,9 @@ class JaxBackend:
             acc = ShardedConsensus(make_mesh(shards), layout.total_len)
         else:
             acc = PileupAccumulator(layout.total_len)
-        for chunk in encoder.encode_chunks(records, cfg.chunk_reads):
-            acc.add(chunk)
-            stats.aligned_bases += len(chunk.positions)
+        for batch in encoder.encode_segments(records, cfg.chunk_reads):
+            acc.add(batch)
+            stats.aligned_bases += batch.n_events
         stats.reads_mapped = encoder.n_reads
         stats.reads_skipped = encoder.n_skipped
         stats.extra["shards"] = shards if use_sharded else 1

@@ -102,3 +102,11 @@ for _i, _ch in enumerate(ALPHABET):
 
 #: Symbol index -> ASCII, for rendering.
 CODE_TO_BASE = np.frombuffer(ALPHABET.encode("ascii"), dtype=np.uint8).copy()
+
+#: Padding code in segment rows (``encoder.events.SegmentBatch``): marks
+#: row positions that contribute no pileup event (beyond the read span, or
+#: gap bases dropped by the maxdel gate).  Shares the value of
+#: INVALID_SYMBOL on purpose — both mean "no countable symbol here", and
+#: invalid input bases never reach a committed row (strict mode raises,
+#: permissive mode skips the read).
+PAD_CODE = 255

@@ -1,25 +1,36 @@
-"""Read → tensor encoder: host-side CIGAR decode into scatter-ready events.
+"""Read → tensor encoder: host-side CIGAR decode into scatter-ready segments.
 
-This is the keystone of the TPU formulation (SURVEY.md §7 step 3): once reads
-become flat integer event arrays, the whole pileup is one scatter-add and the
-vote is a per-position reduction — no raggedness survives to the device.
+This is the keystone of the TPU formulation (SURVEY.md §7 step 3): each read
+becomes ONE contiguous reference-coordinate segment — a flat-genome start plus
+a uint8 code row (read bases for M/=/X, GAP for D/N/P runs, PAD_CODE for gap
+bases dropped by the maxdel gate) — because every reference-consuming CIGAR op
+is contiguous in reference coordinates.  Position indices are *not* expanded
+on the host: the device computes ``start + iota`` and scatter-adds, so the
+host→device transfer is ~1 byte per aligned base instead of 8+
+(positions int32 + codes int32 in a flat COO stream), which profiling showed
+was the pipeline bottleneck (the TPU scatter itself is ~free).
 
 Semantics are identical to the golden CIGAR walker (``core/cigar.py``,
 spec ``/root/reference/sam2consensus.py:46-82,195-221``):
 
-* M/=/X bases become (position, base_code) events;
-* D/N/P bases become (position, GAP) events, subject to the per-read maxdel
-  gate (total gap length > maxdel ⇒ gap events dropped, positions still
-  advance);
+* M/=/X bases become read-base codes at their reference positions;
+* D/N/P bases become GAP codes, subject to the per-read maxdel gate
+  (total gap length > maxdel ⇒ gap codes become PAD, positions still
+  advance) — the gate counts literal ``-`` characters in SEQ too, exactly
+  like the reference's ``seqout.count("-")``;
 * I records an insertion event keyed by (contig, index of next ref base);
 * S skips read bases, H is a no-op;
-* POS-1 may be negative: local indices in [-reflen, 0) wrap Python-style.
+* POS-1 may be negative: local indices in [-reflen, 0) wrap Python-style,
+  splitting the read into (at most) two segment rows.
 
 The genome is laid out as ONE flat position axis — contigs concatenated with
 per-contig offsets — rather than a padded [contig, max_len] matrix.  The vote
 is per-position, so nothing needs the contig structure on device; a flat
 layout wastes zero padding FLOPs/HBM and makes position-axis sharding a plain
 1-D sharding (SURVEY.md §5 long-context).
+
+Rows are bucketed by power-of-two width and row counts padded to powers of
+two, so the jitted device scatter compiles O(log²) distinct shapes.
 """
 
 from __future__ import annotations
@@ -29,9 +40,12 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..constants import BASE_TO_CODE, GAP, INVALID_SYMBOL
+from ..constants import BASE_TO_CODE, GAP, INVALID_SYMBOL, PAD_CODE
 from ..core.cigar import split_ops
 from ..io.sam import Contig, SamRecord
+
+#: smallest segment-row bucket width
+MIN_BUCKET_W = 32
 
 
 class GenomeLayout:
@@ -58,11 +72,17 @@ class GenomeLayout:
 
 
 @dataclass
-class PileupChunk:
-    """One host→device batch of per-base pileup events."""
-    positions: np.ndarray          # int32 [n] flat genome position
-    codes: np.ndarray              # int32 [n] symbol code 0..5
+class SegmentBatch:
+    """One host→device batch of per-read pileup segments.
+
+    ``buckets`` maps row width W to ``(starts int32 [S], codes uint8 [S, W])``
+    where row r contributes one pileup event per column c with
+    ``codes[r, c] != PAD_CODE`` at flat position ``starts[r] + c``.  S is
+    padded to a power of two with all-PAD rows (start 0), W is a power of two.
+    """
+    buckets: Dict[int, Tuple[np.ndarray, np.ndarray]]
     n_reads: int = 0
+    n_events: int = 0          # countable (non-PAD) symbols in the batch
 
 
 @dataclass
@@ -85,22 +105,35 @@ class EncodeError(ValueError):
     pass
 
 
-def _expand_segments(starts: List[int], lengths: List[int]) -> np.ndarray:
-    """Concatenate ``arange(start, start+len)`` for all segments, vectorized."""
-    if not starts:
-        return np.zeros(0, dtype=np.int64)
-    starts_a = np.asarray(starts, dtype=np.int64)
-    lens_a = np.asarray(lengths, dtype=np.int64)
-    total = int(lens_a.sum())
-    ends = np.cumsum(lens_a)
-    # position within the concatenation minus segment base, plus start
-    idx = np.arange(total, dtype=np.int64)
-    seg_base = np.repeat(ends - lens_a, lens_a)
-    return idx - seg_base + np.repeat(starts_a, lens_a)
+def _bucket_width(span: int) -> int:
+    return max(MIN_BUCKET_W, 1 << (span - 1).bit_length())
+
+
+def pack_rows(rows: List[Tuple[int, np.ndarray]]) -> SegmentBatch:
+    """Bucket (flat_start, code_row) pairs into padded SegmentBatch arrays."""
+    by_w: Dict[int, Tuple[List[int], List[np.ndarray]]] = {}
+    n_events = 0
+    for start, row in rows:
+        w = _bucket_width(len(row))
+        starts, codes = by_w.setdefault(w, ([], []))
+        starts.append(start)
+        codes.append(row)
+        n_events += len(row) - int((row == PAD_CODE).sum())
+    buckets: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+    for w, (starts, code_rows) in by_w.items():
+        s = len(starts)
+        s_pad = max(1024, 1 << (s - 1).bit_length())
+        mat = np.full((s_pad, w), PAD_CODE, dtype=np.uint8)
+        for r, row in enumerate(code_rows):
+            mat[r, : len(row)] = row
+        st = np.zeros(s_pad, dtype=np.int32)
+        st[:s] = starts
+        buckets[w] = (st, mat)
+    return SegmentBatch(buckets=buckets, n_events=n_events)
 
 
 class ReadEncoder:
-    """Streaming encoder: SamRecords in, PileupChunks + InsertionEvents out."""
+    """Streaming encoder: SamRecords in, SegmentBatches + InsertionEvents out."""
 
     def __init__(self, layout: GenomeLayout, maxdel: Optional[int] = 150,
                  strict: bool = True):
@@ -111,62 +144,41 @@ class ReadEncoder:
         self.n_skipped = 0
         self.insertions = InsertionEvents()
 
-    def encode_chunks(self, records: Iterable[SamRecord],
-                      chunk_reads: int = 262144) -> Iterator[PileupChunk]:
-        """Yield pileup chunks of at most ``chunk_reads`` reads each."""
-        base_starts: List[int] = []      # flat-genome starts of M-run segments
-        base_codes: List[np.ndarray] = []
-        gap_starts: List[int] = []
-        gap_lens: List[int] = []
-        irr_pos: List[np.ndarray] = []   # pre-expanded irregular events
-        irr_codes: List[np.ndarray] = []
+    def encode_segments(self, records: Iterable[SamRecord],
+                        chunk_reads: int = 262144) -> Iterator[SegmentBatch]:
+        """Yield segment batches of at most ``chunk_reads`` reads each."""
+        rows: List[Tuple[int, np.ndarray]] = []
         in_chunk = 0
-
-        def flush() -> PileupChunk:
-            nonlocal base_starts, base_codes, gap_starts, gap_lens
-            nonlocal irr_pos, irr_codes, in_chunk
-            lens = [len(c) for c in base_codes]
-            pos_bases = _expand_segments(base_starts, lens)
-            pos_gaps = _expand_segments(gap_starts, gap_lens)
-            parts_codes = ([c.astype(np.int32) for c in base_codes]
-                           + [np.full(len(pos_gaps), GAP, dtype=np.int32)]
-                           + [c.astype(np.int32) for c in irr_codes])
-            parts_pos = [pos_bases, pos_gaps] + [p for p in irr_pos]
-            positions = np.concatenate(parts_pos).astype(np.int32) \
-                if parts_pos else np.zeros(0, dtype=np.int32)
-            codes = np.concatenate(parts_codes) \
-                if parts_codes else np.zeros(0, dtype=np.int32)
-            chunk = PileupChunk(positions=positions, codes=codes,
-                                n_reads=in_chunk)
-            base_starts, base_codes, gap_starts, gap_lens = [], [], [], []
-            irr_pos, irr_codes = [], []
-            in_chunk = 0
-            return chunk
-
         for rec in records:
             try:
-                # _encode_one validates fully before committing any segment,
-                # so a raise here leaves the chunk lists untouched.
-                self._encode_one(rec, base_starts, base_codes,
-                                 gap_starts, gap_lens, irr_pos, irr_codes)
+                # encode_record validates fully before committing anything,
+                # so a raise here leaves the pending rows untouched.
+                new_rows = self.encode_record(rec)
             except EncodeError:
                 if self.strict:
                     raise
                 self.n_skipped += 1
                 continue
+            rows.extend(new_rows)
             self.n_reads += 1
             in_chunk += 1
             if in_chunk >= chunk_reads:
-                yield flush()
-        if in_chunk or base_codes or gap_lens or irr_codes:
-            yield flush()
+                batch = pack_rows(rows)
+                batch.n_reads = in_chunk
+                rows, in_chunk = [], 0
+                yield batch
+        if rows or in_chunk:
+            batch = pack_rows(rows)
+            batch.n_reads = in_chunk
+            yield batch
 
     # -- single read ------------------------------------------------------
-    def _encode_one(self, rec: SamRecord,
-                    base_starts: List[int], base_codes: List[np.ndarray],
-                    gap_starts: List[int], gap_lens: List[int],
-                    irr_pos: List[np.ndarray], irr_codes: List[np.ndarray]
-                    ) -> None:
+    def encode_record(self, rec: SamRecord) -> List[Tuple[int, np.ndarray]]:
+        """Encode one record into (flat_start, code_row) segment rows.
+
+        Raises EncodeError (before any side effect) on contract violations;
+        on success also appends the read's insertion events.
+        """
         layout = self.layout
         ci = layout.index.get(rec.refname)
         if ci is None:
@@ -177,9 +189,9 @@ class ReadEncoder:
         seq_codes = BASE_TO_CODE[
             np.frombuffer(rec.seq.encode("ascii"), dtype=np.uint8)]
 
-        # walk ops, collecting local segments first (validation before commit)
-        my_base: List[Tuple[int, np.ndarray]] = []
-        my_gaps: List[Tuple[int, int]] = []
+        # walk ops, collecting local runs first (validation before commit)
+        my_base: List[Tuple[int, np.ndarray]] = []    # (local_start, codes)
+        my_gaps: List[Tuple[int, int]] = []           # (local_start, length)
         my_ins: List[Tuple[int, str]] = []
         rc = 0
         ref_cursor = rec.pos
@@ -221,48 +233,53 @@ class ReadEncoder:
                     "insertion motif contains out-of-alphabet base "
                     "(the reference KeyErrors on these in its reformat pass)")
 
-        # commit: translate to flat coordinates (wrapping negatives)
-        def flat(local_start: int, length: int) -> List[Tuple[int, int]]:
-            """Split a local run into flat-genome runs, wrapping negatives."""
-            if local_start >= 0:
-                return [(offset + local_start, length)]
-            neg = min(length, -local_start)   # bases in the wrapped tail
-            runs = [(offset + reflen + local_start, neg)]
-            if length > neg:
-                runs.append((offset, length - neg))
-            return runs
-
-        # The reference gates on seqout.count("-"), which counts D/N/P gap
-        # runs AND literal '-' characters appearing in SEQ itself ('-' is in
-        # the count alphabet); both kinds are skipped when the gate trips.
-        dash_in_m = sum(int((codes == GAP).sum()) for _s, codes in my_base)
-        count_gaps = (self.maxdel is None
-                      or (gap_total + dash_in_m) <= self.maxdel)
-        for start, codes in my_base:
-            if not count_gaps and (codes == GAP).any():
-                local = start + np.arange(len(codes), dtype=np.int64)
-                keep = codes != GAP
-                local, kept = local[keep], codes[keep]
-                flatpos = np.where(local < 0, offset + reflen + local,
-                                   offset + local)
-                irr_pos.append(flatpos)
-                irr_codes.append(kept)
-                continue
-            pieces = flat(start, len(codes))
-            consumed = 0
-            for fstart, flen in pieces:
-                base_starts.append(fstart)
-                base_codes.append(codes[consumed:consumed + flen])
-                consumed += flen
-        if count_gaps:
-            for start, length in my_gaps:
-                for fstart, flen in flat(start, length):
-                    gap_starts.append(fstart)
-                    gap_lens.append(flen)
+        # commit: insertion side channel
         for local, motif in my_ins:
             self.insertions.contig_ids.append(ci)
             self.insertions.local_pos.append(local)
             self.insertions.motifs.append(motif)
+        if span == 0:
+            return []
+
+        # build the span row: M runs + GAP runs partition [pos, ref_cursor)
+        if len(my_base) == 1 and not my_gaps:
+            row = my_base[0][1]
+        else:
+            row = np.empty(span, dtype=np.uint8)
+            for start, codes in my_base:
+                row[start - rec.pos: start - rec.pos + len(codes)] = codes
+            for start, length in my_gaps:
+                row[start - rec.pos: start - rec.pos + length] = GAP
+
+        # maxdel gate (sam2consensus.py:210-218): the reference counts
+        # seqout's "-" characters — D/N/P runs AND literal '-' in SEQ alike —
+        # and when the gate trips, skips those bases but still advances.
+        n_gap_syms = int((row == GAP).sum())
+        if self.maxdel is not None and n_gap_syms > self.maxdel:
+            row = np.where(row == GAP, np.uint8(PAD_CODE), row)
+
+        # flat coordinates, wrapping negatives Python-style (quirk 7 contract)
+        if rec.pos >= 0:
+            return [(offset + rec.pos, row)]
+        neg = min(span, -rec.pos)          # bases in the wrapped tail
+        out = [(offset + reflen + rec.pos, row[:neg])]
+        if span > neg:
+            out.append((offset, row[neg:]))
+        return out
+
+
+def _expand_segments(starts: List[int], lengths: List[int]) -> np.ndarray:
+    """Concatenate ``arange(start, start+len)`` for all segments, vectorized."""
+    if not starts:
+        return np.zeros(0, dtype=np.int64)
+    starts_a = np.asarray(starts, dtype=np.int64)
+    lens_a = np.asarray(lengths, dtype=np.int64)
+    total = int(lens_a.sum())
+    ends = np.cumsum(lens_a)
+    # position within the concatenation minus segment base, plus start
+    idx = np.arange(total, dtype=np.int64)
+    seg_base = np.repeat(ends - lens_a, lens_a)
+    return idx - seg_base + np.repeat(starts_a, lens_a)
 
 
 def group_insertions(events: InsertionEvents, layout: GenomeLayout):

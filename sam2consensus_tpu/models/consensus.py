@@ -4,13 +4,14 @@ There is no neural network in this workload; the framework's model is the
 consensus caller itself (SURVEY.md north star).  ``make_consensus_model``
 closes over the static genome geometry and returns a pure function
 
-    forward(positions, codes, t_luts) -> (syms, cov)
+    forward(starts, codes, t_luts) -> (syms, cov)
 
-that scatter-adds one batch of read events into a fresh count tensor and
-votes every position for every threshold — the fused single-chip step the
-driver compile-checks (``__graft_entry__.entry``).  The streaming/sharded
-production paths decompose the same two ops (``ops/pileup.py``,
-``parallel/dp.py``).
+that expands one batch of read segment rows (flat-genome start + uint8 code
+row, ``encoder.events.SegmentBatch``), scatter-adds them into a fresh count
+tensor and votes every position for every threshold — the fused single-chip
+step the driver compile-checks (``__graft_entry__.entry``).  The
+streaming/sharded production paths decompose the same two ops
+(``ops/pileup.py``, ``parallel/dp.py``).
 """
 
 from __future__ import annotations
@@ -20,16 +21,20 @@ from typing import Callable, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..constants import NUM_SYMBOLS
 from ..ops.vote import vote_block
 
 
 def make_consensus_model(total_len: int, min_depth: int = 1) -> Callable:
     """Return the jittable forward step for a genome of ``total_len``."""
 
-    def forward(positions: jax.Array, codes: jax.Array,
+    def forward(starts: jax.Array, codes: jax.Array,
                 t_luts: jax.Array) -> Tuple[jax.Array, jax.Array]:
-        counts = jnp.zeros((total_len + 1, 6), dtype=jnp.int32)
-        counts = counts.at[positions, codes].add(1)[:-1]
+        from ..ops.pileup import expand_segment_positions
+
+        pos, code = expand_segment_positions(starts, codes, total_len)
+        counts = jnp.zeros((total_len + 1, NUM_SYMBOLS), dtype=jnp.int32)
+        counts = counts.at[pos, code].add(1)[:-1]
         return vote_block(counts, t_luts, min_depth)
 
     return forward

@@ -2,62 +2,95 @@
 
 The reference spends ~all wall-clock doing one Python dict increment per
 aligned base (``/root/reference/sam2consensus.py:211-218``, SURVEY.md CS3).
-Here the same update is ``counts.at[positions, codes].add(1)`` on a flat
-``[total_len + 1, 6]`` int32 tensor — XLA lowers it to a vectorized scatter
-whose duplicate-index accumulation is exact, so read order and sharding
+Here reads arrive as segment rows — flat-genome start + uint8 code row
+(``encoder.events.SegmentBatch``) — and the device expands positions with an
+iota and scatter-adds into a flat ``[total_len + 1, 6]`` int32 tensor.  XLA's
+scatter accumulates duplicate indices exactly, so read order and sharding
 cannot change the result (addition commutes; SURVEY.md §5).
 
-Chunks arrive padded to a fixed size so the jitted update compiles once:
-pad rows point at the sacrificial row ``total_len`` which is dropped at read
-time.
+Design note: an earlier COO formulation (one int32 position + one int32 code
+per aligned base, expanded on host) was host-transfer-bound — ~8 bytes/base
+over the PCIe/tunnel link dominated end-to-end time while the TPU scatter
+itself was ~free.  Segment rows move ~1 byte/base and push the expansion
+into the compiled program, where it fuses into the scatter's index
+computation.
+
+Rows are padded (PAD_CODE) and bucketed to power-of-two shapes so the jit
+cache holds O(log²) entries; PAD positions are redirected to the sacrificial
+row ``total_len``, which is dropped at read time.
 """
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from ..encoder.events import PileupChunk
+from ..constants import NUM_SYMBOLS
+from ..encoder.events import SegmentBatch
 
 
-@jax.jit
-def _scatter_add(counts: jax.Array, positions: jax.Array,
-                 codes: jax.Array) -> jax.Array:
-    return counts.at[positions, codes].add(1)
+#: cap on expanded scatter cells (rows x width) per device call, bounding the
+#: int32 position/code temporaries to ~32MB each even if XLA materializes them
+SCATTER_CELL_BUDGET = 1 << 23
+
+
+def expand_segment_positions(starts: jax.Array, codes: jax.Array,
+                             sacrificial) -> tuple:
+    """Expand segment rows to flat (pos, code) scatter operands.
+
+    Pure traceable function shared by every consumer of SegmentBatch rows
+    (single-device scatter here, the fused model step, the shard_map DP path)
+    so PAD/validity semantics cannot drift between them.  PAD cells are
+    redirected to the ``sacrificial`` position with code 0.
+    """
+    w = codes.shape[1]
+    pos = starts[:, None] + jax.lax.iota(jnp.int32, w)[None, :]
+    valid = codes < NUM_SYMBOLS
+    pos = jnp.where(valid, pos, sacrificial)
+    code = jnp.where(valid, codes, 0).astype(jnp.int32)
+    return pos.reshape(-1), code.reshape(-1)
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=3)
+def _scatter_segments(counts: jax.Array, starts: jax.Array,
+                      codes: jax.Array, sacrificial: int) -> jax.Array:
+    pos, code = expand_segment_positions(starts, codes, sacrificial)
+    return counts.at[pos, code].add(1)
+
+
+def iter_row_slices(n_rows: int, width: int, multiple_of: int = 1):
+    """Yield (lo, hi) row slices capping hi-lo at SCATTER_CELL_BUDGET cells.
+
+    The step stays a power of two (assuming the budget and width are), so
+    pre-padded power-of-two batches stay power-of-two per slice and the jit
+    cache stays small; ``multiple_of`` additionally aligns the step for
+    even sharding over a device mesh.
+    """
+    step = max(multiple_of, (SCATTER_CELL_BUDGET // width)
+               // multiple_of * multiple_of)
+    for lo in range(0, n_rows, step):
+        yield lo, min(n_rows, lo + step)
 
 
 class PileupAccumulator:
     """Streaming accumulator for one device (sharded use lives in parallel/)."""
 
-    def __init__(self, total_len: int, pad_to: int = 1 << 22,
-                 device=None):
+    def __init__(self, total_len: int, device=None):
         self.total_len = total_len
-        self.pad_to = pad_to
         self.device = device
-        counts = jnp.zeros((total_len + 1, 6), dtype=jnp.int32)
+        counts = jnp.zeros((total_len + 1, NUM_SYMBOLS), dtype=jnp.int32)
         if device is not None:
             counts = jax.device_put(counts, device)
         self._counts = counts
 
-    def add(self, chunk: PileupChunk) -> None:
-        n = len(chunk.positions)
-        if n == 0:
-            return
-        for start in range(0, n, self.pad_to):
-            pos = chunk.positions[start:start + self.pad_to]
-            code = chunk.codes[start:start + self.pad_to]
-            if len(pos) < self.pad_to:
-                # pad the tail slice up to a power-of-two bucket so jit
-                # compiles O(log) distinct shapes; pad rows write into the
-                # sacrificial row (counts[total_len])
-                target = max(1024, 1 << (len(pos) - 1).bit_length())
-                pad = target - len(pos)
-                pos = np.concatenate(
-                    [pos, np.full(pad, self.total_len, dtype=np.int32)])
-                code = np.concatenate([code, np.zeros(pad, dtype=np.int32)])
-            self._counts = _scatter_add(self._counts,
-                                        jnp.asarray(pos), jnp.asarray(code))
+    def add(self, batch: SegmentBatch) -> None:
+        for w, (starts, codes) in sorted(batch.buckets.items()):
+            for lo, hi in iter_row_slices(len(starts), w):
+                self._counts = _scatter_segments(
+                    self._counts, jnp.asarray(starts[lo:hi]),
+                    jnp.asarray(codes[lo:hi]), self.total_len)
 
     @property
     def counts(self) -> jax.Array:
@@ -68,4 +101,4 @@ class PileupAccumulator:
         """Restore from a checkpoint: counts of shape [total_len, 6]."""
         self._counts = jnp.concatenate(
             [jnp.asarray(counts, dtype=jnp.int32),
-             jnp.zeros((1, 6), dtype=jnp.int32)], axis=0)
+             jnp.zeros((1, NUM_SYMBOLS), dtype=jnp.int32)], axis=0)

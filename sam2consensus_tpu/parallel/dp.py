@@ -1,12 +1,14 @@
-"""Sharded consensus pipeline: DP scatter-add → reduce-scatter → SP vote.
+"""Sharded consensus pipeline: DP segment scatter → reduce-scatter → SP vote.
 
 The distributed design (SURVEY.md §5 "Distributed communication backend"):
 the count tensor is a sum-decomposable sufficient statistic, so data
 parallelism plus one collective reduction is *exact* — no read ordering or
 tie-breaking concerns.  The collective rides XLA:
 
-1. each device scatter-adds its read-event shard into a full-length local
-   count tensor (pure DP over the flattened ("dp","sp") axes);
+1. each device expands + scatter-adds its shard of segment rows
+   (``encoder.events.SegmentBatch``: flat start + uint8 code row per read)
+   into a full-length local count tensor (pure DP over the flattened
+   ("dp","sp") axes);
 2. one ``lax.psum_scatter`` both sums the local tensors and leaves each
    device holding one contiguous block of the position axis — a
    reduce-scatter, bandwidth-optimal vs. all-reduce (factor n less traffic),
@@ -24,7 +26,7 @@ streaming input and checkpoint/resume compose with sharding.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +38,9 @@ try:
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
-from ..encoder.events import PileupChunk
+from ..constants import NUM_SYMBOLS, PAD_CODE
+from ..encoder.events import SegmentBatch
+from ..ops.pileup import expand_segment_positions, iter_row_slices
 
 ALL = ("dp", "sp")  # both mesh axes flattened: pure-DP / pure-SP phases
 
@@ -55,14 +59,18 @@ class ShardedConsensus:
 
         counts_spec = NamedSharding(mesh, P(ALL, None))
         self._counts = jax.device_put(
-            jnp.zeros((self.padded_len, 6), dtype=jnp.int32), counts_spec)
+            jnp.zeros((self.padded_len, NUM_SYMBOLS), dtype=jnp.int32),
+            counts_spec)
+        self._row_spec = NamedSharding(mesh, P(ALL))
+        self._mat_spec = NamedSharding(mesh, P(ALL, None))
 
         @partial(shard_map, mesh=mesh,
-                 in_specs=(P(ALL, None), P(ALL), P(ALL)),
+                 in_specs=(P(ALL, None), P(ALL), P(ALL, None)),
                  out_specs=P(ALL, None))
-        def accumulate(counts_blk, positions, codes):
-            local = jnp.zeros((self.padded_len, 6), dtype=jnp.int32)
-            local = local.at[positions, codes].add(1)
+        def accumulate(counts_blk, starts, codes):
+            pos, code = expand_segment_positions(starts, codes, total_len)
+            local = jnp.zeros((self.padded_len, NUM_SYMBOLS), dtype=jnp.int32)
+            local = local.at[pos, code].add(1)
             # reduce over every device AND scatter position blocks: each
             # device leaves holding its own summed block (reduce-scatter).
             return counts_blk + jax.lax.psum_scatter(
@@ -71,30 +79,23 @@ class ShardedConsensus:
         self._accumulate = jax.jit(accumulate, donate_argnums=0)
 
     # -- streaming input --------------------------------------------------
-    def add(self, chunk: PileupChunk, pad_to: int = 1 << 22) -> None:
-        n_ev = len(chunk.positions)
-        if n_ev == 0:
-            return
-        # slices must shard evenly over the mesh: round the slice size up to
-        # a multiple of the device count (matters for non-power-of-two n)
-        pad_to = -(-pad_to // self.n) * self.n
-        for start in range(0, n_ev, pad_to):
-            pos = chunk.positions[start:start + pad_to]
-            code = chunk.codes[start:start + pad_to]
-            if len(pos) < pad_to:
-                target = max(self.n, 1 << (len(pos) - 1).bit_length())
-                target = -(-target // self.n) * self.n
-            else:
-                target = pad_to
-            if len(pos) < target:
-                pad = target - len(pos)
-                pos = np.concatenate(
-                    [pos, np.full(pad, self.total_len, dtype=np.int32)])
-                code = np.concatenate([code, np.zeros(pad, dtype=np.int32)])
-            spec = NamedSharding(self.mesh, P(ALL))
-            self._counts = self._accumulate(
-                self._counts,
-                jax.device_put(pos, spec), jax.device_put(code, spec))
+    def add(self, batch: SegmentBatch) -> None:
+        for w, (starts, codes) in sorted(batch.buckets.items()):
+            s = len(starts)
+            # rows must shard evenly over the mesh (matters for
+            # non-power-of-two device counts)
+            target = -(-s // self.n) * self.n
+            if target != s:
+                starts = np.concatenate(
+                    [starts, np.zeros(target - s, dtype=np.int32)])
+                codes = np.concatenate(
+                    [codes, np.full((target - s, codes.shape[1]), PAD_CODE,
+                                    dtype=np.uint8)])
+            for lo, hi in iter_row_slices(target, w, multiple_of=self.n):
+                self._counts = self._accumulate(
+                    self._counts,
+                    jax.device_put(starts[lo:hi], self._row_spec),
+                    jax.device_put(codes[lo:hi], self._mat_spec))
 
     # -- state ------------------------------------------------------------
     @property
@@ -108,7 +109,7 @@ class ShardedConsensus:
 
     def restore(self, counts: np.ndarray) -> None:
         """Load checkpointed counts (``[total_len, 6]``), re-sharded."""
-        padded = np.zeros((self.padded_len, 6), dtype=np.int32)
+        padded = np.zeros((self.padded_len, NUM_SYMBOLS), dtype=np.int32)
         padded[: self.total_len] = counts
         self._counts = jax.device_put(
             jnp.asarray(padded), NamedSharding(self.mesh, P(ALL, None)))

