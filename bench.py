@@ -31,7 +31,7 @@ from sam2consensus_tpu.backends.cpu import CpuBackend          # noqa: E402
 from sam2consensus_tpu.backends.jax_backend import JaxBackend  # noqa: E402
 from sam2consensus_tpu.config import RunConfig                 # noqa: E402
 from sam2consensus_tpu.io.fasta import render_file             # noqa: E402
-from sam2consensus_tpu.io.sam import iter_records, read_header  # noqa: E402
+from sam2consensus_tpu.io.sam import ReadStream, read_header  # noqa: E402
 from sam2consensus_tpu.utils.simulate import SimSpec, simulate  # noqa: E402
 
 
@@ -39,7 +39,7 @@ def run_once(backend, text, cfg):
     handle = io.StringIO(text)
     contigs, _n, first = read_header(handle)
     t0 = time.perf_counter()
-    res = backend.run(contigs, iter_records(handle, first), cfg)
+    res = backend.run(contigs, ReadStream(handle, first), cfg)
     elapsed = time.perf_counter() - t0
     rendered = {n: render_file(r, 0) for n, r in res.fastas.items()}
     return res.stats, elapsed, rendered

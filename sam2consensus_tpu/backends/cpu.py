@@ -48,6 +48,10 @@ class CpuBackend:
 
     def run(self, contigs: List[Contig], records: Iterable[SamRecord],
             cfg: RunConfig) -> BackendResult:
+        from ..io.sam import ReadStream
+
+        if isinstance(records, ReadStream):
+            records = records.records()
         stats = BackendStats()
 
         # --- allocation (header pass, sam2consensus.py:160-169) ---

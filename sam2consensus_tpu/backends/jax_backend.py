@@ -44,6 +44,8 @@ class JaxBackend:
         from ..ops.pileup import PileupAccumulator
         from ..ops.vote import threshold_luts, vote_positions
 
+        from ..io.sam import ReadStream
+
         stats = BackendStats()
         layout = GenomeLayout(contigs)
         if layout.total_len == 0:
@@ -53,7 +55,6 @@ class JaxBackend:
         shards = cfg.shards if cfg.shards > 0 else n_dev
         use_sharded = shards > 1
 
-        encoder = ReadEncoder(layout, maxdel=cfg.maxdel, strict=cfg.strict)
         if use_sharded:
             from ..parallel.dp import ShardedConsensus
             from ..parallel.mesh import make_mesh
@@ -61,27 +62,34 @@ class JaxBackend:
             acc = ShardedConsensus(make_mesh(shards), layout.total_len)
         else:
             acc = PileupAccumulator(layout.total_len)
-        for batch in encoder.encode_segments(records, cfg.chunk_reads):
+
+        # host decode: native C++ text path when a ReadStream is available
+        # (SURVEY.md §2b native component), python record path otherwise
+        encoder, batches = self._make_encoder(layout, records, cfg)
+        for batch in batches:
             acc.add(batch)
             stats.aligned_bases += batch.n_events
         stats.reads_mapped = encoder.n_reads
         stats.reads_skipped = encoder.n_skipped
         stats.extra["shards"] = shards if use_sharded else 1
+        stats.extra["decoder"] = encoder.__class__.__name__
 
+        # one sync: fetch coverage (needed on host for rendering anyway),
+        # derive max_cov there, then dispatch the vote — avoids a separate
+        # blocking int(max) round trip, which costs real latency on a
+        # tunneled device
         if use_sharded:
-            max_cov = int(jnp.max(jnp.sum(
-                acc.counts[: layout.total_len], axis=-1)))
-            luts_np = threshold_luts(cfg.thresholds, max_cov)
+            cov = np.asarray(acc.counts_host().sum(axis=-1), dtype=np.int64)
+            luts_np = threshold_luts(cfg.thresholds, int(cov.max(initial=0)))
             t_luts = jnp.asarray(luts_np)   # device copy for insertion vote
-            syms, cov = acc.vote(luts_np, cfg.min_depth)
+            syms, _cov_dev = acc.vote(luts_np, cfg.min_depth)
         else:
             counts = acc.counts                               # [L, 6] device
-            cov_dev = counts.sum(axis=-1)
-            max_cov = int(cov_dev.max())
-            t_luts = jnp.asarray(threshold_luts(cfg.thresholds, max_cov))
+            cov = np.asarray(counts.sum(axis=-1), dtype=np.int64)
+            t_luts = jnp.asarray(
+                threshold_luts(cfg.thresholds, int(cov.max(initial=0))))
             syms_dev, _ = vote_positions(counts, t_luts, cfg.min_depth)
             syms = np.asarray(syms_dev)                       # [T, L] uint8
-            cov = np.asarray(cov_dev, dtype=np.int64)         # [L]
 
         ins = group_insertions(encoder.insertions, layout)
         if ins is not None:
@@ -103,6 +111,30 @@ class JaxBackend:
         fastas = self._assemble(layout, syms, cov, ins, ins_syms, site_cov,
                                 cfg, stats)
         return BackendResult(fastas=fastas, stats=stats)
+
+    def _make_encoder(self, layout, records, cfg: RunConfig):
+        """Pick the host decode path; returns (encoder, batch iterator)."""
+        from ..encoder.events import GenomeLayout, ReadEncoder  # noqa: F811
+        from ..io.sam import ReadStream
+
+        if isinstance(records, ReadStream) and cfg.decoder != "py":
+            from ..encoder import native_encoder
+
+            if native_encoder.available():
+                enc = native_encoder.NativeReadEncoder(
+                    layout, maxdel=cfg.maxdel, strict=cfg.strict,
+                    on_lines=records.add_lines)
+                return enc, enc.encode_blocks(records.blocks())
+            if cfg.decoder == "native":
+                from .. import native
+
+                raise RuntimeError("--decoder native requested but the C++ "
+                                   f"decoder is unavailable: "
+                                   f"{native.load_error()}")
+        enc = ReadEncoder(layout, maxdel=cfg.maxdel, strict=cfg.strict)
+        source = records.records() if isinstance(records, ReadStream) \
+            else records
+        return enc, enc.encode_segments(source, cfg.chunk_reads)
 
     # -- host-side rendering ---------------------------------------------
     def _assemble(self, layout, syms: np.ndarray, cov: np.ndarray, ins,

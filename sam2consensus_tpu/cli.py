@@ -21,7 +21,7 @@ from typing import List, Optional
 
 from .config import RunConfig, default_prefix, normalize_outfolder
 from .io.fasta import write_outputs
-from .io.sam import opener, read_header, iter_records
+from .io.sam import ReadStream, opener, read_header
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -66,6 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None,
                    help="persist per-shard count-tensor checkpoints here and "
                         "resume from them if present")
+    p.add_argument("--decoder", choices=["auto", "native", "py"],
+                   default="auto",
+                   help="host SAM decode path for the jax backend: the C++ "
+                        "decoder when available (auto), required (native), "
+                        "or pure python (py)")
     p.add_argument("--shards", type=int, default=0,
                    help="data-parallel shards for the jax backend; 0 = all devices")
     p.add_argument("--chunk-reads", dest="chunk_reads", type=int, default=262144,
@@ -95,6 +100,7 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         backend=args.backend,
         strict=not args.permissive,
         py2_compat=args.py2_compat,
+        decoder=args.decoder,
         chunk_reads=args.chunk_reads,
         profile_dir=args.profile_dir,
         json_metrics=args.json_metrics,
@@ -142,21 +148,20 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # Mirrors the reference's progress accounting: every non-leading-header
     # line counts toward reads_total (sam2consensus.py:182,194,224-225).
-    line_count = [0]
+    # The native decoder reports lines per block, so emit one message per
+    # 500k multiple crossed (identical lines, batched timing).
+    progress = [0]
 
-    def counting_lines():
-        for line in handle:
-            line_count[0] += 1
-            if line_count[0] % 500000 == 0:
-                echo(str(line_count[0]) + " reads processed.")
-            yield line
+    def on_lines(total: int) -> None:
+        for k in range(progress[0] // 500000 + 1, total // 500000 + 1):
+            echo(str(k * 500000) + " reads processed.")
+        progress[0] = total
 
-    if first:
-        line_count[0] += 1
+    stream = ReadStream(handle, first, on_lines=on_lines)
     backend = get_backend(cfg.backend)
-    result = backend.run(contigs, iter_records(counting_lines(), first), cfg)
+    result = backend.run(contigs, stream, cfg)
     handle.close()
-    reads_total = line_count[0]
+    reads_total = stream.n_lines
 
     echo("A total of " + str(reads_total) + " reads were processed, out of "
          "which, " + str(result.stats.reads_mapped) + " reads were mapped.\n")

@@ -34,6 +34,7 @@ class RunConfig:
     # --- non-reference extensions ---
     strict: bool = True          # strict: error on invalid bases / out-of-range
     py2_compat: bool = False
+    decoder: str = "auto"        # auto | native | py (jax backend host decode)
     chunk_reads: int = 262144    # reads per host->device batch (jax backend)
     profile_dir: Optional[str] = None
     json_metrics: Optional[str] = None

@@ -87,18 +87,45 @@ class SegmentBatch:
 
 @dataclass
 class InsertionEvents:
-    """Raw insertion observations, grouped later by (contig, local position)."""
+    """Raw insertion observations, grouped later by (contig, local position).
+
+    Two storage forms coexist: per-read Python lists (the Python encoder
+    appends one entry per I op) and bulk array chunks
+    ``(contig int32, local int32, motif_len int32, motif_chars uint8)``
+    appended by the native decoder.  ``to_arrays`` merges both; ordering
+    between forms is irrelevant (grouping sorts by site key).
+    """
     contig_ids: List[int] = field(default_factory=list)
     local_pos: List[int] = field(default_factory=list)
     motifs: List[str] = field(default_factory=list)
+    array_chunks: List[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+                       ] = field(default_factory=list)
 
     def extend(self, other: "InsertionEvents") -> None:
         self.contig_ids.extend(other.contig_ids)
         self.local_pos.extend(other.local_pos)
         self.motifs.extend(other.motifs)
+        self.array_chunks.extend(other.array_chunks)
 
     def __len__(self) -> int:
-        return len(self.motifs)
+        return len(self.motifs) + sum(len(c[0]) for c in self.array_chunks)
+
+    def to_arrays(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                 np.ndarray]:
+        """Merged ``(contig i64, local i64, motif_lens i64, motif_chars u8)``
+        — motif_chars is raw ASCII, one motif after another."""
+        contigs = [np.asarray(self.contig_ids, dtype=np.int64)]
+        locals_ = [np.asarray(self.local_pos, dtype=np.int64)]
+        mlens = [np.array([len(m) for m in self.motifs], dtype=np.int64)]
+        chars = [np.frombuffer("".join(self.motifs).encode("ascii"),
+                               dtype=np.uint8)]
+        for c, l, ml, ch in self.array_chunks:
+            contigs.append(c.astype(np.int64))
+            locals_.append(l.astype(np.int64))
+            mlens.append(ml.astype(np.int64))
+            chars.append(ch)
+        return (np.concatenate(contigs), np.concatenate(locals_),
+                np.concatenate(mlens), np.concatenate(chars))
 
 
 class EncodeError(ValueError):
@@ -245,7 +272,10 @@ class ReadEncoder:
         if len(my_base) == 1 and not my_gaps:
             row = my_base[0][1]
         else:
-            row = np.empty(span, dtype=np.uint8)
+            # PAD-filled, not empty: a SEQ shorter than its CIGAR claims
+            # (out-of-contract input) leaves deterministic no-event cells
+            # instead of garbage.
+            row = np.full(span, PAD_CODE, dtype=np.uint8)
             for start, codes in my_base:
                 row[start - rec.pos: start - rec.pos + len(codes)] = codes
             for start, length in my_gaps:
@@ -300,11 +330,8 @@ def group_insertions(events: InsertionEvents, layout: GenomeLayout):
     """
     if len(events) == 0:
         return None
-    contig = np.asarray(events.contig_ids, dtype=np.int64)
-    local = np.asarray(events.local_pos, dtype=np.int64)
-    motif_lens = np.array([len(m) for m in events.motifs], dtype=np.int64)
-    all_codes = BASE_TO_CODE[np.frombuffer(
-        "".join(events.motifs).encode("ascii"), dtype=np.uint8)]
+    contig, local, motif_lens, motif_chars = events.to_arrays()
+    all_codes = BASE_TO_CODE[motif_chars]
 
     # composite sort key: (contig, local); local may be negative (reads with
     # POS=0 insert before wrap), so bias it into [0, 2^41) before packing.

@@ -1,0 +1,289 @@
+"""Native-decode path: raw SAM text blocks → SegmentBatch via the C++ core.
+
+Wraps ``native/decoder.cpp`` (ctypes) with the orchestration the C side
+deliberately doesn't do:
+
+* buffer sizing/growth and resume-after-capacity (the C call commits whole
+  lines and reports consumed bytes);
+* width adaptation: rows wider than the current bucket width W are reported
+  as overflow lines, fall back to the Python encoder for this block, and
+  double W for subsequent blocks when they stop being rare;
+* error parity: a line the C decoder flags is REPLAYED through the Python
+  parser/encoder, so the exception type and message are identical to the
+  pure-Python path (and if the replay disagrees and succeeds — e.g. exotic
+  int literals Python accepts — the read is committed via the Python
+  fallback and decoding continues);
+* merging native row matrices with Python-fallback rows into one
+  power-of-two-padded SegmentBatch per block.
+
+Byte-for-byte output equivalence with the Python encoder over the fixture
+corpus is pinned by tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..constants import PAD_CODE
+from ..io.sam import iter_records
+from .. import native
+from .events import (EncodeError, GenomeLayout, MIN_BUCKET_W, ReadEncoder,
+                     SegmentBatch, _bucket_width)
+
+
+def available() -> bool:
+    return native.load() is not None
+
+
+def _line_end(data: np.ndarray, start: int) -> int:
+    """Index of the newline ending the line at ``start`` (or end of data)."""
+    seg = data[start:start + (1 << 20)]
+    nl = np.nonzero(seg == 10)[0]
+    if len(nl):
+        return start + int(nl[0])
+    if start + len(seg) < len(data):  # pragma: no cover - >1MB line
+        nl = np.nonzero(data[start:] == 10)[0]
+        return start + int(nl[0]) if len(nl) else len(data)
+    return len(data)
+
+
+class NativeReadEncoder:
+    """Streaming encoder over raw text blocks; same surface as ReadEncoder."""
+
+    def __init__(self, layout: GenomeLayout, maxdel: Optional[int] = 150,
+                 strict: bool = True, width: int = 256,
+                 on_lines=None):
+        lib = native.load()
+        if lib is None:  # pragma: no cover - callers check available()
+            raise RuntimeError(f"native decoder unavailable: "
+                               f"{native.load_error()}")
+        self._lib = lib
+        self.layout = layout
+        self.maxdel = maxdel
+        self.strict = strict
+        self.width = width
+        self.on_lines = on_lines
+        # python twin for overflow/error-replay fallback; shares counters
+        # and the insertion store so fallback reads land in the same place
+        self._py = ReadEncoder(layout, maxdel=maxdel, strict=strict)
+        self.insertions = self._py.insertions
+
+        names_blob = "".join(layout.names).encode("ascii")
+        name_off = np.zeros(len(layout.names) + 1, dtype=np.int64)
+        np.cumsum([len(n.encode("ascii")) for n in layout.names],
+                  out=name_off[1:])
+        self._names = names_blob
+        self._name_off = name_off
+        self._ctg_offset = layout.offsets[:-1].astype(np.int64).copy()
+        self._ctg_len = layout.lengths.astype(np.int64).copy()
+
+    @property
+    def n_reads(self) -> int:
+        return self._py.n_reads
+
+    @property
+    def n_skipped(self) -> int:
+        return self._py.n_skipped
+
+    #: expanded scatter cells per emitted slab (rows = SLAB_CELLS // width);
+    #: matches ops.pileup.SCATTER_CELL_BUDGET so one slab = one scatter call
+    SLAB_CELLS = 1 << 23
+
+    def encode_blocks(self, blocks: Iterable[str]) -> Iterator[SegmentBatch]:
+        """Yield SegmentBatches as fixed-size row slabs fill.
+
+        Slabs persist across text blocks, so the steady state is one
+        (rows, width) shape per run — one jit compilation, near-zero row
+        padding — and only the final partial slab pads up to a power of
+        two.
+        """
+        # slab state
+        self._probed = False
+        self._new_slab()
+        self._fallback_rows: List[Tuple[int, np.ndarray]] = []
+        self._batch_reads = 0
+        self._batch_events = 0
+
+        # persistent insertion/overflow buffers (copied out per call)
+        ins_cap = 1 << 16
+        chars_cap = 1 << 20
+        ovf_cap = 4096
+        out = np.zeros(16, dtype=np.int64)
+
+        for text in blocks:
+            data = np.frombuffer(text.encode("ascii"), dtype=np.uint8)
+            offset = 0
+            while offset < len(data):
+                chunk = data[offset:]
+                ic = np.empty(ins_cap, dtype=np.int32)
+                il = np.empty(ins_cap, dtype=np.int32)
+                im = np.empty(ins_cap, dtype=np.int32)
+                ich = np.empty(chars_cap, dtype=np.uint8)
+                ovf = np.empty(ovf_cap, dtype=np.int64)
+
+                fill = self._fill
+                self._lib.s2c_decode(
+                    chunk, len(chunk),
+                    self._names, self._name_off, len(self._ctg_len),
+                    self._ctg_offset, self._ctg_len,
+                    -1 if self.maxdel is None else self.maxdel,
+                    1 if self.strict else 0,
+                    self._slab_w,
+                    self._starts[fill:], self._codes[fill:],
+                    len(self._starts) - fill,
+                    ic, il, im, ins_cap,
+                    ich, chars_cap,
+                    ovf, ovf_cap,
+                    out)
+
+                (n_rows, n_reads, n_skipped, consumed, n_ins, n_chars,
+                 status, _err_off, n_events, n_lines, n_overflow,
+                 _max_span) = out[:12]
+
+                self._fill = fill + int(n_rows)
+                if n_ins:
+                    self.insertions.array_chunks.append(
+                        (ic[:n_ins].copy(), il[:n_ins].copy(),
+                         im[:n_ins].copy(), ich[:n_chars].copy()))
+                self._py.n_reads += int(n_reads)
+                self._py.n_skipped += int(n_skipped)
+                self._batch_reads += int(n_reads)
+                self._batch_events += int(n_events)
+                self._count_lines(int(n_lines))
+
+                # overflow lines (span > width): python fallback, whole read
+                for k in range(int(n_overflow)):
+                    self._fallback_line(chunk, int(ovf[k]))
+                if n_overflow > max(64, n_reads // 64):
+                    # widen future slabs; the current slab keeps its width
+                    self.width = min(1 << 16, self.width * 2)
+                elif (not self._probed and n_reads > 256 and _max_span > 0
+                      and not n_overflow):
+                    # one-shot shrink to the observed span profile: padding
+                    # bytes are wire bytes on the host->device link
+                    self._probed = True
+                    self.width = max(MIN_BUCKET_W,
+                                     _bucket_width(int(_max_span)))
+
+                offset += int(consumed)
+                if status == 2:
+                    # flagged line: python replay for identical errors; if
+                    # the replay succeeds instead (python being more lenient
+                    # than the C parser), commit it via the fallback path
+                    line_end = _line_end(data, offset)
+                    self._fallback_line(data, offset, line_end=line_end)
+                    self._count_lines(1)
+                    offset = line_end + 1
+                elif status == 1:
+                    if len(self._starts) - self._fill < 2:
+                        # slab full: emit and start fresh
+                        batch = self._flush()
+                        if batch is not None:
+                            yield batch
+                    elif consumed == 0:
+                        # a single line overran the insertion buffers
+                        ins_cap *= 2
+                        chars_cap *= 2
+                        ovf_cap *= 2
+                    # else: per-call insertion buffers were the constraint;
+                    # they were copied out above, so just keep going
+
+        batch = self._flush()
+        if batch is not None:
+            yield batch
+
+    # ------------------------------------------------------------------
+    def _new_slab(self) -> None:
+        self._slab_w = self.width
+        rows = max(1024, self.SLAB_CELLS // self._slab_w)
+        self._starts = np.empty(rows, dtype=np.int32)
+        self._codes = np.empty((rows, self._slab_w), dtype=np.uint8)
+        self._fill = 0
+
+    def _flush(self) -> Optional[SegmentBatch]:
+        batch = self._build_batch(
+            [(self._starts, self._codes, self._fill)] if self._fill else [],
+            self._fallback_rows, self._batch_reads, self._batch_events)
+        self._new_slab()
+        self._fallback_rows = []
+        self._batch_reads = 0
+        self._batch_events = 0
+        return batch
+
+    def _count_lines(self, k: int) -> None:
+        if self.on_lines is not None and k:
+            self.on_lines(k)
+
+    def _fallback_line(self, data: np.ndarray, start: int,
+                       line_end: Optional[int] = None) -> None:
+        """Encode one raw line via the Python path into the pending batch."""
+        if line_end is None:
+            line_end = _line_end(data, start)
+        # include the trailing newline so even an empty line replays as the
+        # truthy "\n" string the pure-python path would have seen
+        line = bytes(data[start:min(line_end + 1, len(data))]).decode("ascii")
+        # the record iterator raises IndexError on malformed lines in every
+        # mode, exactly like the pure-python path
+        recs = list(iter_records(iter(()), line))
+        for rec in recs:
+            try:
+                rows = self._py.encode_record(rec)
+            except EncodeError:
+                if self.strict:
+                    raise
+                self._py.n_skipped += 1
+                continue
+            self._py.n_reads += 1
+            self._batch_reads += 1
+            for start_flat, row in rows:
+                self._fallback_rows.append((start_flat, row))
+                self._batch_events += len(row) - int((row == PAD_CODE).sum())
+
+    def _build_batch(self, native_parts, fallback_rows, n_reads, n_events
+                     ) -> Optional[SegmentBatch]:
+        """Merge native matrices + fallback rows into one padded batch.
+
+        Common case (one native part per width, no fallback rows): the
+        decode buffer is padded *in place* — only the pad tail is written,
+        no bulk copy.
+        """
+        per_w: Dict[int, List] = {}
+        for starts, codes, n in native_parts:
+            per_w.setdefault(codes.shape[1], []).append((starts, codes, n))
+        for start_flat, row in fallback_rows:
+            w = _bucket_width(len(row))
+            per_w.setdefault(w, []).append((start_flat, row))
+
+        buckets: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        for w, items in per_w.items():
+            if len(items) == 1 and len(items[0]) == 3:
+                starts, codes, n = items[0]
+                s_pad = max(1024, 1 << (n - 1).bit_length())
+                if s_pad <= len(starts):   # buffer big enough: pad in place
+                    starts[n:s_pad] = 0
+                    codes[n:s_pad] = PAD_CODE
+                    buckets[w] = (starts[:s_pad], codes[:s_pad])
+                    continue
+            total = sum(it[2] if len(it) == 3 else 1 for it in items)
+            s_pad = max(1024, 1 << (total - 1).bit_length())
+            mat = np.full((s_pad, w), PAD_CODE, dtype=np.uint8)
+            st = np.zeros(s_pad, dtype=np.int32)
+            r = 0
+            for it in items:
+                if len(it) == 3:
+                    starts, codes, n = it
+                    st[r:r + n] = starts[:n]
+                    mat[r:r + n] = codes[:n]
+                    r += n
+                else:
+                    start_flat, row = it
+                    st[r] = start_flat
+                    mat[r, : len(row)] = row
+                    r += 1
+            buckets[w] = (st, mat)
+        if not buckets and n_reads == 0:
+            return None
+        return SegmentBatch(buckets=buckets, n_reads=n_reads,
+                            n_events=n_events)

@@ -105,3 +105,55 @@ def read_sam(filename: str) -> Tuple[List[Contig], Iterator[SamRecord]]:
     handle = opener(filename)
     contigs, _n_header, first = read_header(handle)
     return contigs, iter_records(handle, first)
+
+
+class ReadStream:
+    """Single-pass source of SAM body content, as records OR text blocks.
+
+    Backends consume whichever form fits: the CPU oracle and the Python
+    encoder pull parsed ``records()``; the native decoder pulls raw
+    ``blocks()`` (whole lines) and parses in C++.  Both report consumed body
+    lines through ``add_lines`` so the CLI's progress accounting
+    (``sam2consensus.py:224-225``: every body line counts, including
+    unmapped and stray header lines) is identical either way.
+    """
+
+    def __init__(self, handle: TextIO, first_line: str = "", on_lines=None):
+        self.handle = handle
+        self.first = first_line
+        self.on_lines = on_lines
+        self.n_lines = 0
+
+    def add_lines(self, k: int) -> None:
+        if k:
+            self.n_lines += k
+            if self.on_lines is not None:
+                self.on_lines(self.n_lines)
+
+    def records(self) -> Iterator[SamRecord]:
+        """Parsed mapped records, counting every body line."""
+        def counted() -> Iterator[str]:
+            for line in self.handle:
+                self.add_lines(1)
+                yield line
+
+        first = self.first
+        if first:
+            self.add_lines(1)
+        yield from iter_records(counted(), first)
+
+    def blocks(self, max_bytes: int = 1 << 23) -> Iterator[str]:
+        """Raw text blocks of whole lines (line counting is the consumer's
+        job via ``add_lines`` — the native decoder counts in C++)."""
+        pending = self.first
+        self.first = ""
+        while True:
+            chunk = self.handle.read(max_bytes)
+            if not chunk:
+                if pending:
+                    yield pending
+                return
+            if not chunk.endswith("\n"):
+                chunk += self.handle.readline()
+            block, pending = pending + chunk, ""
+            yield block

@@ -1,0 +1,82 @@
+"""Native decoder loader: builds and binds decoder.cpp via ctypes.
+
+No pybind11 in this environment, so the boundary is a plain C ABI + ctypes
+with NumPy-owned buffers (zero-copy in both directions).  The shared object
+is compiled on first use with g++ and cached next to the source, keyed by a
+source hash so edits rebuild automatically; any build/load failure degrades
+silently to the pure-Python encoder (``encoder/events.py``), which is the
+semantics oracle for this code path anyway.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "decoder.cpp")
+
+_lib = None
+_lib_err: Optional[str] = None
+
+
+def _build_so() -> str:
+    with open(_SRC, "rb") as fh:
+        tag = hashlib.sha256(fh.read()).hexdigest()[:16]
+    so_path = os.path.join(_DIR, f"_decoder_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    # compile to a temp name then rename so concurrent builders can't race
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
+             "-o", tmp],
+            check=True, capture_output=True, timeout=300)
+        os.replace(tmp, so_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return so_path
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the decoder; None if unavailable."""
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    try:
+        lib = ctypes.CDLL(_build_so())
+    except (OSError, subprocess.SubprocessError) as exc:
+        _lib_err = str(exc)
+        return None
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.s2c_decode.restype = ctypes.c_long
+    lib.s2c_decode.argtypes = [
+        u8p, ctypes.c_long,                    # text (uint8 view: resuming
+                                               #   mid-buffer is zero-copy)
+        ctypes.c_char_p, i64p, ctypes.c_long,  # names, name_off, n_contigs
+        i64p, i64p,                            # ctg_offset, ctg_len
+        ctypes.c_long, ctypes.c_long,          # maxdel, strict
+        ctypes.c_long,                         # width
+        i32p, u8p, ctypes.c_long,              # starts, codes, rows_cap
+        i32p, i32p, i32p, ctypes.c_long,       # ins contig/local/mlen, cap
+        u8p, ctypes.c_long,                    # ins_chars, cap
+        i64p, ctypes.c_long,                   # overflow_off, cap
+        i64p,                                  # out stats
+    ]
+    _lib = lib
+    return _lib
+
+
+def load_error() -> Optional[str]:
+    return _lib_err

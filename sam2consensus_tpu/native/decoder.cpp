@@ -1,0 +1,455 @@
+// Native SAM -> segment-row decoder.
+//
+// The one justified native component of the framework (SURVEY.md §2b): at
+// TPU throughput the per-read Python/NumPy decode loop is the end-to-end
+// bottleneck, so the hot text path — SAM field split, CIGAR walk, base
+// translation, segment-row emission — is C++ behind a ctypes boundary.
+// Semantics replicate the Python encoder exactly
+// (sam2consensus_tpu/encoder/events.py, itself pinned to
+// /root/reference/sam2consensus.py:46-82,191-221); on any flagged line the
+// Python wrapper replays that line through the Python path so error
+// behavior (exception type and message) stays byte-for-byte identical.
+//
+// Contract notes mirrored from the Python encoder:
+//  * field use: RNAME (whitespace-truncated), POS-1, CIGAR, SEQ — no
+//    FLAG/MAPQ filtering (sam2consensus.py:195-206);
+//  * CIGAR parsed with regex-equivalent semantics: a digit run must be
+//    immediately followed by a valid op, otherwise scanning resumes one
+//    character later (re.findall on r"(\d+)([MIDNSHPX=])");
+//  * M/=/X copy read bases (SEQ truncation leaves PAD cells), D/N/P emit
+//    GAP and advance the reference cursor (P included — quirk 2), I records
+//    a motif keyed by the next reference index (quirk 3), S skips read
+//    bases, H is a no-op;
+//  * the maxdel gate counts GAP cells (deletion runs AND literal '-' SEQ
+//    bases) and, when tripped, turns them into PAD (skipped but advancing);
+//  * POS-1 may be negative down to -reflen: rows wrap Python-style and
+//    split in two;
+//  * errors: malformed lines (too few fields / bad int / empty RNAME) stop
+//    decoding in every mode (Python raises from the record iterator);
+//    contract violations (unknown RNAME, out-of-bounds span,
+//    out-of-alphabet base) stop in strict mode and skip the read in
+//    permissive mode.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr unsigned char kPad = 255;   // == encoder PAD_CODE
+constexpr unsigned char kGap = 0;
+
+struct BaseLut {
+  unsigned char m[256];
+  BaseLut() {
+    memset(m, 255, sizeof(m));
+    m[static_cast<unsigned char>('-')] = 0;
+    m[static_cast<unsigned char>('A')] = 1;
+    m[static_cast<unsigned char>('C')] = 2;
+    m[static_cast<unsigned char>('G')] = 3;
+    m[static_cast<unsigned char>('N')] = 4;
+    m[static_cast<unsigned char>('T')] = 5;
+  }
+};
+const BaseLut kLut;
+
+inline bool is_ws(char c) {
+  // ASCII subset of Python str.split() whitespace (input is ascii-decoded)
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\v' ||
+         c == '\f';
+}
+
+inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
+
+inline bool is_op(char c) {
+  switch (c) {
+    case 'M': case 'I': case 'D': case 'N': case 'S': case 'H': case 'P':
+    case 'X': case '=':
+      return true;
+    default:
+      return false;
+  }
+}
+
+uint64_t hash_bytes(const char* s, long n) {
+  uint64_t h = 1469598103934665603ULL;
+  for (long i = 0; i < n; ++i) {
+    h ^= static_cast<unsigned char>(s[i]);
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+// Open-addressing contig-name table (names are pre-deduplicated by the
+// GenomeLayout, so insertion order conflicts cannot happen).
+struct NameTable {
+  std::vector<int32_t> slot;  // contig index + 1; 0 = empty
+  uint64_t mask = 0;
+  const char* names = nullptr;
+  const int64_t* off = nullptr;
+
+  void build(const char* names_, const int64_t* off_, long n) {
+    names = names_;
+    off = off_;
+    long cap = 16;
+    while (cap < 2 * n) cap <<= 1;
+    slot.assign(cap, 0);
+    mask = cap - 1;
+    for (long i = 0; i < n; ++i) {
+      uint64_t h = hash_bytes(names + off[i], off[i + 1] - off[i]) & mask;
+      while (slot[h]) h = (h + 1) & mask;
+      slot[h] = static_cast<int32_t>(i) + 1;
+    }
+  }
+
+  long find(const char* s, long len) const {
+    uint64_t h = hash_bytes(s, len) & mask;
+    while (slot[h]) {
+      long i = slot[h] - 1;
+      if (off[i + 1] - off[i] == len && memcmp(names + off[i], s, len) == 0)
+        return i;
+      h = (h + 1) & mask;
+    }
+    return -1;
+  }
+};
+
+enum Status : long {
+  kOk = 0,
+  kCapacity = 1,   // out buffers full; out[3] = consumed bytes so far
+  kErrorLine = 2,  // line flagged; out[7] = its byte offset (python replays)
+};
+
+enum OutIdx : int {
+  oRows = 0,
+  oReads = 1,
+  oSkipped = 2,
+  oConsumed = 3,
+  oIns = 4,
+  oInsChars = 5,
+  oStatus = 6,
+  oErrorOff = 7,
+  oEvents = 8,
+  oLines = 9,
+  oOverflow = 10,
+  oMaxSpan = 11,
+};
+
+}  // namespace
+
+extern "C" long s2c_decode(
+    const char* text, long text_len,
+    const char* names, const int64_t* name_off, long n_contigs,
+    const int64_t* ctg_offset, const int64_t* ctg_len,
+    long maxdel,  // -1 = gate disabled
+    long strict,
+    long width,
+    int32_t* starts, unsigned char* codes, long rows_cap,
+    int32_t* ins_contig, int32_t* ins_local, int32_t* ins_mlen, long ins_cap,
+    unsigned char* ins_chars, long ins_chars_cap,
+    int64_t* overflow_off, long overflow_cap,
+    int64_t* out) {
+  NameTable table;
+  table.build(names, name_off, n_contigs);
+
+  long n_rows = 0, n_reads = 0, n_skipped = 0, n_ins = 0, n_ins_chars = 0;
+  long n_events = 0, n_lines = 0, n_overflow = 0, max_span = 0;
+  long status = kOk;
+  long err_off = -1;
+
+  std::vector<unsigned char> row;           // reused per line
+  std::vector<int64_t> ins_pos_tmp;         // insertion local positions
+  std::vector<long> ins_seq_tmp;            // (seq offset, length) pairs
+
+  long i = 0;
+  while (i < text_len) {
+    const char* nl = static_cast<const char*>(
+        memchr(text + i, '\n', text_len - i));
+    long line_end = nl ? (nl - text) : text_len;
+    long next = line_end + 1;
+    long ls = i;  // line start
+
+    ++n_lines;
+    if (line_end == ls || text[ls] == '@') {
+      if (line_end == ls) {  // empty line: python IndexErrors on fields[5]
+        status = kErrorLine;
+        err_off = ls;
+        break;
+      }
+      i = next;
+      continue;
+    }
+
+    // --- split into tab fields (need 0..9; record starts/ends) ---
+    long fs[11], fe[11];
+    int nf = 0;
+    long p = ls;
+    fs[0] = p;
+    while (p < line_end && nf < 10) {
+      if (text[p] == '\t') {
+        fe[nf++] = p;
+        fs[nf] = p + 1;
+      }
+      ++p;
+    }
+    if (nf < 10) fe[nf++] = line_end;
+
+    if (nf < 6) {  // python: line.split("\t")[5] -> IndexError
+      status = kErrorLine;
+      err_off = ls;
+      break;
+    }
+    // CIGAR "*" -> unmapped, skipped before any further field access
+    if (fe[5] - fs[5] == 1 && text[fs[5]] == '*') {
+      i = next;
+      continue;
+    }
+    if (nf < 10) {  // python: fields[9] -> IndexError
+      status = kErrorLine;
+      err_off = ls;
+      break;
+    }
+
+    // --- RNAME: leading-whitespace skip + whitespace-truncated token ---
+    long rs = fs[2], re_ = fe[2];
+    while (rs < re_ && is_ws(text[rs])) ++rs;
+    long rtok = rs;
+    while (rtok < re_ && !is_ws(text[rtok])) ++rtok;
+    if (rtok == rs) {  // empty token: python fields[2].split()[0] IndexErrors
+      status = kErrorLine;
+      err_off = ls;
+      break;
+    }
+
+    // --- POS: python int() semantics (ascii): ws* [+-] digits+ ws* ---
+    long ps = fs[3], pe = fe[3];
+    while (ps < pe && is_ws(text[ps])) ++ps;
+    while (pe > ps && is_ws(text[pe - 1])) --pe;
+    bool negpos = false;
+    if (ps < pe && (text[ps] == '+' || text[ps] == '-')) {
+      negpos = text[ps] == '-';
+      ++ps;
+    }
+    if (ps == pe) {
+      status = kErrorLine;
+      err_off = ls;
+      break;
+    }
+    int64_t posv = 0;
+    bool badint = false;
+    for (long k = ps; k < pe; ++k) {
+      if (!is_digit(text[k])) {
+        badint = true;
+        break;
+      }
+      posv = posv * 10 + (text[k] - '0');
+      if (posv > (int64_t(1) << 60)) posv = int64_t(1) << 60;  // clamp, errors below
+    }
+    if (badint) {
+      status = kErrorLine;
+      err_off = ls;
+      break;
+    }
+    if (negpos) posv = -posv;
+    int64_t pos = posv - 1;  // 0-based
+
+    // --- contig lookup (contract violation, not a parse error) ---
+    long ci = table.find(text + rs, rtok - rs);
+    bool encode_err = (ci < 0);
+    int64_t reflen = encode_err ? 0 : ctg_len[ci];
+
+    // --- CIGAR walk ---
+    long ss = fs[9], se = fe[9];
+    long seq_len = se - ss;
+    long rc = 0;
+    int64_t ref_cursor = pos;
+    bool bad_base = false;
+    bool huge_span = false;
+    row.clear();
+    ins_pos_tmp.clear();
+    ins_seq_tmp.clear();
+
+    long cs = fs[5], ce = fe[5];
+    long c = cs;
+    while (c < ce && !huge_span) {
+      if (!is_digit(text[c])) {
+        ++c;
+        continue;
+      }
+      long j = c;
+      int64_t num = 0;
+      while (j < ce && is_digit(text[j])) {
+        num = num * 10 + (text[j] - '0');
+        if (num > (int64_t(1) << 40)) num = int64_t(1) << 40;
+        ++j;
+      }
+      if (j >= ce || !is_op(text[j])) {
+        ++c;  // regex-style: resume scanning one char later
+        continue;
+      }
+      char op = text[j];
+      c = j + 1;
+      switch (op) {
+        case 'M': case '=': case 'X': {
+          // guard absurd lengths before allocating: such a span can only
+          // fail the bounds check, which the python replay will report
+          if (ref_cursor - pos + num > 2 * reflen + 64) {
+            huge_span = true;
+            break;
+          }
+          long take = seq_len - rc;
+          if (take < 0) take = 0;
+          if (take > num) take = num;
+          size_t base = row.size();
+          row.resize(base + num, kPad);
+          for (long k = 0; k < take; ++k) {
+            unsigned char code =
+                kLut.m[static_cast<unsigned char>(text[ss + rc + k])];
+            if (code == 255) bad_base = true;
+            row[base + k] = code;
+          }
+          rc += num;
+          ref_cursor += num;
+          break;
+        }
+        case 'D': case 'N': case 'P': {
+          if (ref_cursor - pos + num > 2 * reflen + 64) {
+            huge_span = true;
+            break;
+          }
+          row.resize(row.size() + num, kGap);
+          ref_cursor += num;
+          break;
+        }
+        case 'I': {
+          long take = seq_len - rc;
+          if (take < 0) take = 0;
+          if (take > num) take = num;
+          for (long k = 0; k < take; ++k) {
+            unsigned char code =
+                kLut.m[static_cast<unsigned char>(text[ss + rc + k])];
+            if (code == 255) bad_base = true;
+          }
+          ins_pos_tmp.push_back(ref_cursor);
+          ins_seq_tmp.push_back(ss + rc);
+          ins_seq_tmp.push_back(take);
+          rc += num;
+          break;
+        }
+        case 'S':
+          rc += num;
+          break;
+        default:  // 'H'
+          break;
+      }
+    }
+
+    long span = static_cast<long>(row.size());
+    if (span > max_span) max_span = span;
+
+    // --- validation (mirrors encoder ordering; any failure -> one flag) ---
+    if (huge_span ||
+        (span > 0 && (pos < -reflen || pos + span > reflen)) || bad_base)
+      encode_err = true;
+
+    if (encode_err) {
+      if (strict) {
+        status = kErrorLine;
+        err_off = ls;
+        break;
+      }
+      ++n_skipped;
+      i = next;
+      continue;
+    }
+
+    // --- maxdel gate ---
+    long gaps = 0;
+    for (unsigned char ch : row)
+      if (ch == kGap) ++gaps;
+    if (maxdel >= 0 && gaps > maxdel)
+      for (auto& ch : row)
+        if (ch == kGap) ch = kPad;
+
+    // --- capacity pre-check (whole line commits or none) ---
+    long rows_needed = 0;
+    bool overflow = span > width;
+    if (span > 0 && !overflow)
+      rows_needed = (pos < 0 && pos + span > 0) ? 2 : 1;
+    long chars_needed = 0;
+    for (size_t k = 1; k < ins_seq_tmp.size(); k += 2)
+      chars_needed += ins_seq_tmp[k];
+    if (n_rows + rows_needed > rows_cap ||
+        (overflow && n_overflow + 1 > overflow_cap) ||
+        (!overflow &&
+         (n_ins + static_cast<long>(ins_pos_tmp.size()) > ins_cap ||
+          n_ins_chars + chars_needed > ins_chars_cap))) {
+      status = kCapacity;
+      break;  // consumed stops at this line's start
+    }
+
+    if (overflow) {
+      // whole read (rows AND insertions) delegated to the python fallback
+      overflow_off[n_overflow++] = ls;
+      i = next;
+      continue;
+    }
+
+    // --- commit: insertions (raw ASCII motifs; python translates) ---
+    for (size_t k = 0; k < ins_pos_tmp.size(); ++k) {
+      ins_contig[n_ins] = static_cast<int32_t>(ci);
+      ins_local[n_ins] = static_cast<int32_t>(ins_pos_tmp[k]);
+      long moff = ins_seq_tmp[2 * k], mlen = ins_seq_tmp[2 * k + 1];
+      ins_mlen[n_ins] = static_cast<int32_t>(mlen);
+      memcpy(ins_chars + n_ins_chars, text + moff, mlen);
+      n_ins_chars += mlen;
+      ++n_ins;
+    }
+
+    // --- commit: segment rows (wrapping negative POS python-style) ---
+    if (span > 0) {
+      int64_t base_off = ctg_offset[ci];
+      long neg = 0;
+      if (pos < 0) neg = (span < -pos) ? span : -pos;
+      const unsigned char* rp = row.data();
+      if (neg > 0) {
+        starts[n_rows] = static_cast<int32_t>(base_off + reflen + pos);
+        unsigned char* dst = codes + static_cast<int64_t>(n_rows) * width;
+        memcpy(dst, rp, neg);
+        memset(dst + neg, kPad, width - neg);
+        ++n_rows;
+      }
+      if (span > neg) {
+        starts[n_rows] =
+            static_cast<int32_t>(base_off + (pos < 0 ? 0 : pos));
+        unsigned char* dst = codes + static_cast<int64_t>(n_rows) * width;
+        memcpy(dst, rp + neg, span - neg);
+        memset(dst + (span - neg), kPad, width - (span - neg));
+        ++n_rows;
+      }
+      for (long k = 0; k < span; ++k)
+        if (row[k] != kPad) ++n_events;
+    }
+    ++n_reads;
+    i = next;
+  }
+
+  // n_lines counts fully-consumed lines only: every break above happens
+  // after ++n_lines but before the line is consumed (the wrapper re-feeds
+  // or replays it), so roll that one back.
+  if (status != kOk) --n_lines;
+
+  out[oRows] = n_rows;
+  out[oReads] = n_reads;
+  out[oSkipped] = n_skipped;
+  out[oConsumed] = (status == kOk) ? text_len : i;
+  out[oIns] = n_ins;
+  out[oInsChars] = n_ins_chars;
+  out[oStatus] = status;
+  out[oErrorOff] = err_off;
+  out[oEvents] = n_events;
+  out[oLines] = n_lines;
+  out[oOverflow] = n_overflow;
+  out[oMaxSpan] = max_span;
+  return status;
+}
