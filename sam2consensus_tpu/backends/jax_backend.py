@@ -21,6 +21,8 @@ framework's correctness gate (tests/test_differential.py).
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Dict, Iterable, List
 
 import numpy as np
@@ -63,21 +65,59 @@ class JaxBackend:
         else:
             acc = PileupAccumulator(layout.total_len)
 
+        # checkpoint resume: counts + insertion log + consumed-line offset
+        # are the entire job state (SURVEY.md §5)
+        ck = None
+        if cfg.checkpoint_dir:
+            from ..utils import checkpoint as ckpt
+
+            if not isinstance(records, ReadStream):
+                raise RuntimeError(
+                    "--checkpoint-dir requires a file-backed input stream")
+            ck = ckpt.load(cfg.checkpoint_dir, layout.total_len)
+            if ck is not None:
+                records.skip_lines(ck.lines_consumed)
+                if use_sharded:
+                    acc.restore(ck.counts)
+                else:
+                    acc.set_counts(ck.counts)
+        base_mapped = ck.reads_mapped if ck else 0
+        base_skipped = ck.reads_skipped if ck else 0
+        base_aligned = ck.aligned_bases if ck else 0
+
         # host decode: native C++ text path when a ReadStream is available
         # (SURVEY.md §2b native component), python record path otherwise
         encoder, batches = self._make_encoder(layout, records, cfg)
+        if ck is not None:
+            encoder.insertions.array_chunks.extend(ck.insertions.array_chunks)
+        stats.aligned_bases = base_aligned
+
+        t0 = time.perf_counter()
+        reads_at_ckpt = 0
         for batch in batches:
+            if cfg.paranoid:
+                self._paranoid_batch(batch, layout.total_len, stats)
             acc.add(batch)
             stats.aligned_bases += batch.n_events
-        stats.reads_mapped = encoder.n_reads
-        stats.reads_skipped = encoder.n_skipped
+            if (cfg.checkpoint_dir
+                    and encoder.n_reads - reads_at_ckpt
+                    >= cfg.checkpoint_every):
+                self._write_checkpoint(cfg, records, acc, encoder, stats,
+                                       base_mapped, base_skipped)
+                reads_at_ckpt = encoder.n_reads
+        stats.reads_mapped = base_mapped + encoder.n_reads
+        stats.reads_skipped = base_skipped + encoder.n_skipped
         stats.extra["shards"] = shards if use_sharded else 1
         stats.extra["decoder"] = encoder.__class__.__name__
+        stats.extra["accumulate_sec"] = round(time.perf_counter() - t0, 4)
+        if ck is not None:
+            stats.extra["resumed_from_line"] = ck.lines_consumed
 
         # one sync: fetch coverage (needed on host for rendering anyway),
         # derive max_cov there, then dispatch the vote — avoids a separate
         # blocking int(max) round trip, which costs real latency on a
         # tunneled device
+        t0 = time.perf_counter()
         if use_sharded:
             cov = np.asarray(acc.counts_host().sum(axis=-1), dtype=np.int64)
             luts_np = threshold_luts(cfg.thresholds, int(cov.max(initial=0)))
@@ -90,7 +130,11 @@ class JaxBackend:
                 threshold_luts(cfg.thresholds, int(cov.max(initial=0))))
             syms_dev, _ = vote_positions(counts, t_luts, cfg.min_depth)
             syms = np.asarray(syms_dev)                       # [T, L] uint8
+        stats.extra["vote_sec"] = round(time.perf_counter() - t0, 4)
+        if cfg.paranoid:
+            self._paranoid_result(acc, cov, stats)
 
+        t0 = time.perf_counter()
         ins = group_insertions(encoder.insertions, layout)
         if ins is not None:
             k = len(ins["key_flat"])
@@ -107,10 +151,70 @@ class JaxBackend:
         else:
             site_cov = None
             ins_syms = None
+        stats.extra["insertions_sec"] = round(time.perf_counter() - t0, 4)
 
+        t0 = time.perf_counter()
         fastas = self._assemble(layout, syms, cov, ins, ins_syms, site_cov,
                                 cfg, stats)
+        stats.extra["render_sec"] = round(time.perf_counter() - t0, 4)
+
+        # a completed run invalidates its checkpoint: remove it so a rerun
+        # starts from scratch instead of replaying a finished job
+        if cfg.checkpoint_dir:
+            from ..utils import checkpoint as ckpt
+
+            p = ckpt.path_for(cfg.checkpoint_dir)
+            if os.path.exists(p):
+                os.unlink(p)
         return BackendResult(fastas=fastas, stats=stats)
+
+    # -- checkpointing -----------------------------------------------------
+    def _write_checkpoint(self, cfg, stream, acc, encoder, stats,
+                          base_mapped, base_skipped) -> None:
+        from ..utils import checkpoint as ckpt
+
+        ckpt.save(cfg.checkpoint_dir, ckpt.CheckpointState(
+            counts=acc.counts_host(),
+            lines_consumed=stream.n_lines,
+            reads_mapped=base_mapped + encoder.n_reads,
+            reads_skipped=base_skipped + encoder.n_skipped,
+            aligned_bases=stats.aligned_bases,
+            insertions=encoder.insertions))
+        stats.extra["checkpoints_written"] = (
+            stats.extra.get("checkpoints_written", 0) + 1)
+
+    # -- paranoid mode (SURVEY.md §5 sanitizers) ---------------------------
+    def _paranoid_batch(self, batch, total_len: int, stats) -> None:
+        """Re-validate scatter inputs before they reach the device."""
+        from ..constants import NUM_SYMBOLS
+
+        for w, (starts, codes) in batch.buckets.items():
+            rows, cols = np.nonzero(codes < NUM_SYMBOLS)
+            pos = starts[rows].astype(np.int64) + cols
+            if len(pos) and (pos.min() < 0 or pos.max() >= total_len):
+                raise RuntimeError(
+                    "paranoid: scatter position out of bounds "
+                    f"(width-{w} bucket, range [{pos.min()}, {pos.max()}], "
+                    f"genome length {total_len})")
+            bad = (codes > NUM_SYMBOLS - 1) & (codes != 255)
+            if bad.any():
+                raise RuntimeError(
+                    f"paranoid: {int(bad.sum())} invalid symbol codes in "
+                    f"width-{w} bucket")
+        stats.extra["paranoid_batches"] = (
+            stats.extra.get("paranoid_batches", 0) + 1)
+
+    def _paranoid_result(self, acc, cov: np.ndarray, stats) -> None:
+        counts = acc.counts_host()
+        if (counts < 0).any():
+            raise RuntimeError("paranoid: negative pileup count")
+        if not np.array_equal(counts.sum(axis=-1), cov):
+            raise RuntimeError("paranoid: coverage != sum of count lanes")
+        if int(cov.sum()) != stats.aligned_bases:
+            raise RuntimeError(
+                f"paranoid: device event total {int(cov.sum())} != host "
+                f"accounting {stats.aligned_bases}")
+        stats.extra["paranoid_result_ok"] = True
 
     def _make_encoder(self, layout, records, cfg: RunConfig):
         """Pick the host decode path; returns (encoder, batch iterator)."""

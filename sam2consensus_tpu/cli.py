@@ -64,8 +64,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", dest="profile_dir", default=None,
                    help="write a jax.profiler trace to this directory")
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None,
-                   help="persist per-shard count-tensor checkpoints here and "
-                        "resume from them if present")
+                   help="persist count-tensor checkpoints here and resume "
+                        "from them if present (jax backend)")
+    p.add_argument("--checkpoint-every", dest="checkpoint_every", type=int,
+                   default=2_000_000,
+                   help="reads between checkpoint writes; default=2000000")
+    p.add_argument("--paranoid", action="store_true",
+                   help="re-validate device inputs and outputs every batch "
+                        "(index bounds, symbol codes, count invariants)")
     p.add_argument("--decoder", choices=["auto", "native", "py"],
                    default="auto",
                    help="host SAM decode path for the jax backend: the C++ "
@@ -105,6 +111,8 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         profile_dir=args.profile_dir,
         json_metrics=args.json_metrics,
         checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        paranoid=args.paranoid,
         shards=args.shards,
     )
 
@@ -130,14 +138,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     cfg = config_from_args(args)
     echo = (lambda *a, **k: None) if args.quiet else print
 
-    # refuse silently ignoring not-yet-wired flags (they land with the
-    # parallel/checkpoint/profiling milestones)
-    for flag, value in (("--profile-dir", cfg.profile_dir),
-                        ("--checkpoint-dir", cfg.checkpoint_dir)):
-        if value:
-            raise SystemExit(f"{flag} is not implemented yet")
     if cfg.shards and cfg.backend != "jax":
         raise SystemExit("--shards requires --backend jax")
+    if cfg.checkpoint_dir and cfg.backend != "jax":
+        raise SystemExit("--checkpoint-dir requires --backend jax")
 
     t0 = time.perf_counter()
     echo("\nProcessing file " + args.filename + ":\n")
@@ -159,7 +163,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     stream = ReadStream(handle, first, on_lines=on_lines)
     backend = get_backend(cfg.backend)
-    result = backend.run(contigs, stream, cfg)
+    if cfg.profile_dir:
+        import jax
+
+        with jax.profiler.trace(cfg.profile_dir):
+            result = backend.run(contigs, stream, cfg)
+    else:
+        result = backend.run(contigs, stream, cfg)
     handle.close()
     reads_total = stream.n_lines
 

@@ -39,6 +39,8 @@ class RunConfig:
     profile_dir: Optional[str] = None
     json_metrics: Optional[str] = None
     checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 2_000_000  # reads between checkpoint writes
+    paranoid: bool = False       # re-validate device inputs/outputs per batch
     shards: int = 0              # 0 = use all local devices for DP
 
     @staticmethod

@@ -124,6 +124,18 @@ class ReadStream:
         self.on_lines = on_lines
         self.n_lines = 0
 
+    def skip_lines(self, k: int) -> None:
+        """Skip ``k`` body lines (checkpoint resume); they still count."""
+        if k <= 0:
+            return
+        n = k
+        if self.first:
+            self.first = ""
+            n -= 1
+        for _ in range(n):
+            self.handle.readline()
+        self.n_lines = k
+
     def add_lines(self, k: int) -> None:
         if k:
             self.n_lines += k

@@ -97,6 +97,13 @@ class PileupAccumulator:
         """Valid counts, ``[total_len, 6]`` (sacrificial row dropped)."""
         return self._counts[:-1]
 
+    def counts_host(self):
+        """Valid counts on host, ``[total_len, 6]`` (same surface as the
+        sharded accumulator, for checkpointing)."""
+        import numpy as np
+
+        return np.asarray(self._counts)[:-1]
+
     def set_counts(self, counts: jax.Array) -> None:
         """Restore from a checkpoint: counts of shape [total_len, 6]."""
         self._counts = jnp.concatenate(

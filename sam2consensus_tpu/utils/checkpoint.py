@@ -1,0 +1,87 @@
+"""Checkpoint/resume for the streaming consensus job.
+
+SURVEY.md §5: the count tensor IS the entire job state and is
+sum-decomposable, so a checkpoint is just ``[total_len, 6]`` counts plus
+the insertion event log and the number of input lines already consumed —
+a killed run resumes by loading the arrays and skipping that many body
+lines (the reference has nothing comparable: two full passes, all state in
+RAM, ``/root/reference/sam2consensus.py:149,180``).
+
+Checkpoints are written at batch boundaries, where the pipeline guarantees
+every decoded line's contribution is either in the count tensor or the
+insertion log (nothing in flight).  Files are plain ``.npz`` written via a
+temp file + atomic rename, so a crash mid-write leaves the previous
+checkpoint intact.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..encoder.events import InsertionEvents
+
+_FILE = "sam2consensus_ckpt.npz"
+
+
+@dataclass
+class CheckpointState:
+    counts: np.ndarray           # [total_len, 6] int32
+    lines_consumed: int
+    reads_mapped: int
+    reads_skipped: int
+    aligned_bases: int
+    insertions: InsertionEvents
+
+
+def path_for(checkpoint_dir: str) -> str:
+    return os.path.join(checkpoint_dir, _FILE)
+
+
+def save(checkpoint_dir: str, state: CheckpointState) -> None:
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    ic, il, im, ich = state.insertions.to_arrays()
+    fd, tmp = tempfile.mkstemp(suffix=".npz.tmp", dir=checkpoint_dir)
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            np.savez_compressed(
+                fh,
+                counts=state.counts.astype(np.int32),
+                meta=np.array([state.lines_consumed, state.reads_mapped,
+                               state.reads_skipped, state.aligned_bases],
+                              dtype=np.int64),
+                ins_contig=ic.astype(np.int32),
+                ins_local=il.astype(np.int32),
+                ins_mlen=im.astype(np.int32),
+                ins_chars=ich.astype(np.uint8))
+        os.replace(tmp, path_for(checkpoint_dir))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load(checkpoint_dir: str, total_len: int) -> Optional[CheckpointState]:
+    """Load the checkpoint if present and shape-compatible, else None."""
+    p = path_for(checkpoint_dir)
+    if not os.path.exists(p):
+        return None
+    with np.load(p, allow_pickle=False) as z:
+        counts = z["counts"]
+        if counts.shape != (total_len, 6):
+            raise ValueError(
+                f"checkpoint at {p} is for a genome of length "
+                f"{counts.shape[0]}, not {total_len} — wrong input file?")
+        meta = z["meta"]
+        ins = InsertionEvents()
+        if len(z["ins_contig"]):
+            ins.array_chunks.append(
+                (z["ins_contig"], z["ins_local"], z["ins_mlen"],
+                 z["ins_chars"]))
+        return CheckpointState(
+            counts=counts, lines_consumed=int(meta[0]),
+            reads_mapped=int(meta[1]), reads_skipped=int(meta[2]),
+            aligned_bases=int(meta[3]), insertions=ins)

@@ -1,0 +1,178 @@
+"""Checkpoint/resume, paranoid mode, and phase-timer observability.
+
+SURVEY.md §5: the count tensor is the entire job state and is
+sum-decomposable, so resume-after-crash must be exact — pinned here by
+crashing a run mid-stream and comparing the resumed output byte-for-byte
+against an uninterrupted run.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from sam2consensus_tpu.backends.cpu import CpuBackend
+from sam2consensus_tpu.backends.jax_backend import JaxBackend
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.encoder.events import GenomeLayout, InsertionEvents
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.io.sam import ReadStream, read_header
+from sam2consensus_tpu.utils import checkpoint as ckpt
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+
+TEXT = simulate(SimSpec(n_contigs=4, contig_len=220, n_reads=600,
+                        read_len=44, ins_read_rate=0.15, del_read_rate=0.15,
+                        seed=17))
+
+
+def _run(cfg, text=TEXT, handle_wrapper=None):
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    if handle_wrapper is not None:
+        handle = handle_wrapper(handle)
+    stream = ReadStream(handle, first)
+    backend = CpuBackend() if cfg.backend == "cpu" else JaxBackend()
+    res = backend.run(contigs, stream, cfg)
+    return ({n: render_file(r, 0) for n, r in res.fastas.items()},
+            res.stats, stream)
+
+
+class _CrashingHandle:
+    """File-handle proxy that dies after ``limit`` lines (crash injection,
+    SURVEY.md §5 failure detection)."""
+
+    def __init__(self, handle, limit):
+        self.handle = handle
+        self.limit = limit
+        self.count = 0
+
+    def __iter__(self):
+        for line in self.handle:
+            self.count += 1
+            if self.count > self.limit:
+                raise RuntimeError("injected crash")
+            yield line
+
+    def read(self, n=-1):  # pragma: no cover - records() path only
+        raise RuntimeError("injected crash")
+
+    def readline(self):
+        return self.handle.readline()
+
+
+def test_roundtrip(tmp_path):
+    ins = InsertionEvents()
+    ins.contig_ids += [0, 1]
+    ins.local_pos += [5, 7]
+    ins.motifs += ["AC", "GGT"]
+    counts = np.arange(60, dtype=np.int32).reshape(10, 6)
+    ckpt.save(str(tmp_path), ckpt.CheckpointState(
+        counts=counts, lines_consumed=123, reads_mapped=40, reads_skipped=2,
+        aligned_bases=555, insertions=ins))
+    state = ckpt.load(str(tmp_path), 10)
+    np.testing.assert_array_equal(state.counts, counts)
+    assert state.lines_consumed == 123
+    assert state.reads_mapped == 40
+    assert state.reads_skipped == 2
+    assert state.aligned_bases == 555
+    a = state.insertions.to_arrays()
+    b = ins.to_arrays()
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_load_missing_returns_none(tmp_path):
+    assert ckpt.load(str(tmp_path), 10) is None
+
+
+def test_load_wrong_genome_raises(tmp_path):
+    ckpt.save(str(tmp_path), ckpt.CheckpointState(
+        counts=np.zeros((10, 6), np.int32), lines_consumed=0, reads_mapped=0,
+        reads_skipped=0, aligned_bases=0, insertions=InsertionEvents()))
+    with pytest.raises(ValueError):
+        ckpt.load(str(tmp_path), 11)
+
+
+def test_crash_resume_byte_identical(tmp_path):
+    cfg = RunConfig(prefix="ck", thresholds=[0.25, 0.75], backend="jax",
+                    decoder="py", chunk_reads=64,
+                    checkpoint_dir=str(tmp_path), checkpoint_every=64)
+    # phase 1: crash mid-stream, after at least one checkpoint
+    with pytest.raises(RuntimeError, match="injected crash"):
+        _run(cfg, handle_wrapper=lambda h: _CrashingHandle(h, 400))
+    state = ckpt.load(str(tmp_path), GenomeLayout(
+        read_header(io.StringIO(TEXT))[0]).total_len)
+    assert state is not None and state.lines_consumed > 0
+
+    # phase 2: resume on a fresh stream -> identical to an uninterrupted run
+    out_resumed, stats, stream = _run(cfg)
+    assert "resumed_from_line" in stats.extra
+    out_fresh, fresh_stats, _s = _run(
+        RunConfig(prefix="ck", thresholds=[0.25, 0.75], backend="jax",
+                  decoder="py", chunk_reads=64))
+    assert out_resumed == out_fresh
+    assert stats.reads_mapped == fresh_stats.reads_mapped
+    assert stats.aligned_bases == fresh_stats.aligned_bases
+    n_body_lines = sum(1 for l in TEXT.splitlines()
+                       if l and not l.startswith("@"))
+    assert stream.n_lines == n_body_lines
+    # completed run removes its checkpoint
+    assert ckpt.load(str(tmp_path), 880) is None
+
+
+def test_resume_interops_with_native_decoder(tmp_path):
+    """A checkpoint written by the python path resumes under the native
+    decoder (and vice versa the state format is identical)."""
+    from sam2consensus_tpu.encoder import native_encoder
+
+    if not native_encoder.available():
+        pytest.skip("C++ decoder unavailable")
+    cfg_py = RunConfig(prefix="ck", thresholds=[0.25], backend="jax",
+                       decoder="py", chunk_reads=64,
+                       checkpoint_dir=str(tmp_path), checkpoint_every=64)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        _run(cfg_py, handle_wrapper=lambda h: _CrashingHandle(h, 300))
+    cfg_nat = RunConfig(prefix="ck", thresholds=[0.25], backend="jax",
+                        decoder="native", checkpoint_dir=str(tmp_path))
+    out_resumed, stats, _s = _run(cfg_nat)
+    out_fresh, _st, _s2 = _run(RunConfig(prefix="ck", thresholds=[0.25],
+                                         backend="jax", decoder="native"))
+    assert out_resumed == out_fresh
+
+
+def test_cpu_and_jax_agree_under_checkpointing(tmp_path):
+    out_cpu, _st, _s = _run(RunConfig(prefix="ck", thresholds=[0.5]))
+    out_jax, _st2, _s2 = _run(RunConfig(
+        prefix="ck", thresholds=[0.5], backend="jax", decoder="py",
+        chunk_reads=32, checkpoint_dir=str(tmp_path), checkpoint_every=32))
+    assert out_jax == out_cpu
+
+
+def test_paranoid_mode_clean_run():
+    out_plain, _st, _s = _run(RunConfig(prefix="p", backend="jax",
+                                        decoder="py"))
+    out_paranoid, stats, _s2 = _run(RunConfig(prefix="p", backend="jax",
+                                              decoder="py", paranoid=True))
+    assert out_paranoid == out_plain
+    assert stats.extra.get("paranoid_result_ok") is True
+    assert stats.extra.get("paranoid_batches", 0) >= 1
+
+
+def test_paranoid_catches_corrupt_batch():
+    backend = JaxBackend()
+    from sam2consensus_tpu.backends.base import BackendStats
+    from sam2consensus_tpu.encoder.events import SegmentBatch
+
+    bad = SegmentBatch(buckets={32: (np.array([10_000], dtype=np.int32),
+                                     np.full((1, 32), 1, dtype=np.uint8))},
+                       n_reads=1, n_events=32)
+    with pytest.raises(RuntimeError, match="paranoid"):
+        backend._paranoid_batch(bad, total_len=100, stats=BackendStats())
+
+
+def test_phase_timers_reported():
+    _out, stats, _s = _run(RunConfig(prefix="t", backend="jax",
+                                     decoder="py"))
+    for key in ("accumulate_sec", "vote_sec", "insertions_sec", "render_sec"):
+        assert key in stats.extra
