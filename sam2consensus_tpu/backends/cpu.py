@@ -226,4 +226,13 @@ def _vote(groups: List[list], cutoff: float) -> str:
             cov_nucs += total
         else:
             break
+    # Empty called set — reachable two ways, both of which the reference
+    # crashes on (``amb[""]`` KeyError at sam2consensus.py:367): an insertion
+    # column whose lanes all cancel to zero after gap completion (requires a
+    # '-' motif char, outside the ACGTN input contract), or an API-supplied
+    # threshold <= 0 (cutoff <= 0 takes no group; the CLI rejects these).
+    # Define it as a gap — skipping the column / filling the position —
+    # matching the JAX vote exactly (mask 0 → '-' via the total LUT).
+    if not nucs:
+        return "-"
     return AMB["".join(sorted(nucs))]

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import time
 from typing import List, Optional
@@ -85,7 +86,23 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def config_from_args(args: argparse.Namespace) -> RunConfig:
-    thresholds = [float(i) for i in args.thresholds.split(",")]
+    # The reference crashes on any unusable threshold — ValueError in the
+    # float parse, or amb[""] KeyError (sam2consensus.py:367) at the first
+    # covered position for t <= 0 / nan; reject all of them up front.
+    try:
+        thresholds = [float(i) for i in args.thresholds.split(",")]
+    except ValueError:
+        raise SystemExit(
+            f"error: could not parse consensus thresholds {args.thresholds!r}"
+            " (expected comma-separated numbers, e.g. 0.25,0.75)") from None
+    # Upper bound: t is a fraction in (0, 1]; anything above 1 behaves like
+    # t=1 (the greedy vote takes every group).  100 leaves headroom for
+    # percent-style inputs the reference also tolerated, while keeping the
+    # header's int(t*100) and the jax backend's int32 cutoff LUTs finite.
+    if not all(math.isfinite(t) and 0 < t <= 100 for t in thresholds):
+        raise SystemExit(
+            "error: consensus thresholds must be finite, > 0 and <= 100, "
+            f"got {args.thresholds}")
     prefix = args.prefix if args.prefix != "" else default_prefix(args.filename)
     if args.maxdel is None:
         maxdel: Optional[int] = 150

@@ -108,3 +108,16 @@ def test_shards_requires_jax_backend(tmp_path):
     import pytest
     with pytest.raises(SystemExit, match="requires --backend jax"):
         main(["-i", sam, "-o", str(tmp_path / "o"), "--shards", "4", "--quiet"])
+
+
+def test_nonpositive_threshold_rejected(tmp_path):
+    # the reference crashes on t <= 0 (amb[""] KeyError at
+    # sam2consensus.py:367); the CLI rejects it with a clear error instead
+    sam = _fixture(tmp_path)
+    import pytest
+    for bad in ("0", "-0.5", "0.25,0", "nan", "inf", "2e306"):
+        with pytest.raises(SystemExit, match="must be finite"):
+            main(["-i", sam, "-o", str(tmp_path / "o"), "-c", bad, "--quiet"])
+    for bad in ("abc", "0.25,", ""):
+        with pytest.raises(SystemExit, match="could not parse"):
+            main(["-i", sam, "-o", str(tmp_path / "o"), "-c", bad, "--quiet"])
