@@ -16,10 +16,10 @@ Workload knobs via env: BENCH_READS (default 200000), BENCH_CONTIGS (100),
 BENCH_READ_LEN (100), BENCH_CONTIG_LEN (2000).
 """
 
-import io
 import json
 import os
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -31,16 +31,17 @@ from sam2consensus_tpu.backends.cpu import CpuBackend          # noqa: E402
 from sam2consensus_tpu.backends.jax_backend import JaxBackend  # noqa: E402
 from sam2consensus_tpu.config import RunConfig                 # noqa: E402
 from sam2consensus_tpu.io.fasta import render_file             # noqa: E402
-from sam2consensus_tpu.io.sam import ReadStream, read_header  # noqa: E402
+from sam2consensus_tpu.io.sam import ReadStream, opener, read_header  # noqa: E402
 from sam2consensus_tpu.utils.simulate import SimSpec, simulate  # noqa: E402
 
 
-def run_once(backend, text, cfg):
-    handle = io.StringIO(text)
+def run_once(backend, path, cfg, binary):
+    handle = opener(path, binary=binary)
     contigs, _n, first = read_header(handle)
     t0 = time.perf_counter()
     res = backend.run(contigs, ReadStream(handle, first), cfg)
     elapsed = time.perf_counter() - t0
+    handle.close()
     rendered = {n: render_file(r, 0) for n, r in res.fastas.items()}
     return res.stats, elapsed, rendered
 
@@ -55,12 +56,20 @@ def main():
     text = simulate(spec)
     cfg = RunConfig(prefix="bench", thresholds=[0.25])
 
-    cpu_stats, cpu_time, cpu_out = run_once(CpuBackend(), text, cfg)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "bench.sam")
+        with open(path, "w") as fh:
+            fh.write(text)
+        del text
 
-    jax_backend = JaxBackend()
-    # warm-up run: pays jit compiles for this genome length / chunk buckets
-    _stats, _t, _out = run_once(jax_backend, text, cfg)
-    jax_stats, jax_time, jax_out = run_once(jax_backend, text, cfg)
+        cpu_stats, cpu_time, cpu_out = run_once(CpuBackend(), path, cfg,
+                                                binary=False)
+
+        jax_backend = JaxBackend()
+        # warm-up: pays jit compiles for this genome length / chunk buckets
+        _stats, _t, _out = run_once(jax_backend, path, cfg, binary=True)
+        jax_stats, jax_time, jax_out = run_once(jax_backend, path, cfg,
+                                                binary=True)
 
     assert jax_out == cpu_out, "BENCH INVALID: backends disagree byte-wise"
     bases = jax_stats.consensus_bases

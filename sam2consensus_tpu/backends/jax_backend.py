@@ -42,6 +42,7 @@ class JaxBackend:
         import jax.numpy as jnp
 
         from ..encoder.events import GenomeLayout, ReadEncoder, group_insertions
+        from ..ops import fused
         from ..ops.insertions import build_insertion_table, vote_insertions
         from ..ops.pileup import PileupAccumulator
         from ..ops.vote import threshold_luts, vote_positions
@@ -63,7 +64,8 @@ class JaxBackend:
 
             acc = ShardedConsensus(make_mesh(shards), layout.total_len)
         else:
-            acc = PileupAccumulator(layout.total_len)
+            acc = PileupAccumulator(layout.total_len,
+                                    strategy=getattr(cfg, "pileup", "auto"))
 
         # checkpoint resume: counts + insertion log + consumed-line offset
         # are the entire job state (SURVEY.md §5)
@@ -109,48 +111,86 @@ class JaxBackend:
         stats.reads_skipped = base_skipped + encoder.n_skipped
         stats.extra["shards"] = shards if use_sharded else 1
         stats.extra["decoder"] = encoder.__class__.__name__
+        if getattr(acc, "strategy_used", None):
+            stats.extra["pileup"] = dict(acc.strategy_used)
         stats.extra["accumulate_sec"] = round(time.perf_counter() - t0, 4)
         if ck is not None:
             stats.extra["resumed_from_line"] = ck.lines_consumed
 
-        # one sync: fetch coverage (needed on host for rendering anyway),
-        # derive max_cov there, then dispatch the vote — avoids a separate
-        # blocking int(max) round trip, which costs real latency on a
-        # tunneled device
+        # Post-accumulation tail in exactly two device round trips (each
+        # fetch of a computed array costs tens of ms on a tunneled chip):
+        # 1. coverage — fetched asynchronously while the host groups
+        #    insertion events; host needs it for the LUTs / gates / headers;
+        # 2. one fused dispatch (vote + insertion table + insertion vote)
+        #    returning one packed uint8 buffer.
         t0 = time.perf_counter()
         if use_sharded:
             cov = np.asarray(acc.counts_host().sum(axis=-1), dtype=np.int64)
+            ins = group_insertions(encoder.insertions, layout)
             luts_np = threshold_luts(cfg.thresholds, int(cov.max(initial=0)))
             t_luts = jnp.asarray(luts_np)   # device copy for insertion vote
             syms, _cov_dev = acc.vote(luts_np, cfg.min_depth)
         else:
             counts = acc.counts                               # [L, 6] device
-            cov = np.asarray(counts.sum(axis=-1), dtype=np.int64)
+            cov_dev = fused.coverage(counts)
+            cov_dev.copy_to_host_async()
+            ins = group_insertions(encoder.insertions, layout)  # overlaps
+            cov = np.asarray(cov_dev).astype(np.int64)
             t_luts = jnp.asarray(
                 threshold_luts(cfg.thresholds, int(cov.max(initial=0))))
-            syms_dev, _ = vote_positions(counts, t_luts, cfg.min_depth)
-            syms = np.asarray(syms_dev)                       # [T, L] uint8
         stats.extra["vote_sec"] = round(time.perf_counter() - t0, 4)
         if cfg.paranoid:
             self._paranoid_result(acc, cov, stats)
 
         t0 = time.perf_counter()
-        ins = group_insertions(encoder.insertions, layout)
+        n_thresholds = len(cfg.thresholds)
+        total_len = layout.total_len
         if ins is not None:
             k = len(ins["key_flat"])
-            table = jnp.zeros((k, ins["max_cols"], 6), dtype=jnp.int32)
-            table = build_insertion_table(
-                table, jnp.asarray(ins["ev_key"]), jnp.asarray(ins["ev_col"]),
-                jnp.asarray(ins["ev_code"]))
+            # pad sites and columns to powers of two: pad events scatter
+            # into the sacrificial last row (kp > k always), pad columns
+            # vote past n_cols and come back as skip sentinels
+            kp = fused.next_pow2(k + 1)
+            cp = fused.next_pow2(ins["max_cols"])
             site_cov = np.where(ins["key_flat"] >= 0,
                                 cov[np.maximum(ins["key_flat"], 0)],
                                 0).astype(np.int32)
-            ins_syms = np.asarray(vote_insertions(
-                table, jnp.asarray(site_cov), jnp.asarray(ins["n_cols"]),
-                t_luts))                                      # [T, K, C] uint8
+            site_cov_p = np.zeros(kp, dtype=np.int32)
+            site_cov_p[:k] = site_cov
+            n_cols_p = np.zeros(kp, dtype=np.int32)
+            n_cols_p[:k] = ins["n_cols"]
+            e = len(ins["ev_key"])
+            ep = fused.next_pow2(max(e, 1))
+            ev_key = np.full(ep, kp - 1, dtype=np.int32)
+            ev_key[:e] = ins["ev_key"]
+            ev_col = np.zeros(ep, dtype=np.int32)
+            ev_col[:e] = ins["ev_col"]
+            ev_code = np.zeros(ep, dtype=np.int32)
+            ev_code[:e] = ins["ev_code"]
+            if use_sharded:
+                table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
+                table = build_insertion_table(
+                    table, jnp.asarray(ev_key), jnp.asarray(ev_col),
+                    jnp.asarray(ev_code))
+                ins_syms = np.asarray(vote_insertions(
+                    table, jnp.asarray(site_cov_p), jnp.asarray(n_cols_p),
+                    t_luts))[:, :k, :]                        # [T, K, Cp]
+            else:
+                packed = fused.vote_packed(
+                    counts, t_luts, jnp.asarray(ev_key), jnp.asarray(ev_col),
+                    jnp.asarray(ev_code), jnp.asarray(site_cov_p),
+                    jnp.asarray(n_cols_p), cfg.min_depth, cp)
+                out = np.asarray(packed)
+                split = n_thresholds * total_len
+                syms = out[:split].reshape(n_thresholds, total_len)
+                ins_syms = out[split:].reshape(
+                    n_thresholds, kp, cp)[:, :k, :]           # [T, K, Cp]
         else:
             site_cov = None
             ins_syms = None
+            if not use_sharded:
+                syms_dev, _ = vote_positions(counts, t_luts, cfg.min_depth)
+                syms = np.asarray(syms_dev)                   # [T, L] uint8
         stats.extra["insertions_sec"] = round(time.perf_counter() - t0, 4)
 
         t0 = time.perf_counter()

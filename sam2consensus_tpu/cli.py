@@ -73,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--paranoid", action="store_true",
                    help="re-validate device inputs and outputs every batch "
                         "(index bounds, symbol codes, count invariants)")
+    p.add_argument("--pileup", choices=["auto", "mxu", "scatter"],
+                   default="auto",
+                   help="device pileup strategy: XLA scatter-add (scatter, "
+                        "current auto default) or MXU one-hot matmul (mxu, "
+                        "experimental; falls back to scatter on skewed "
+                        "coverage). Single-device jax backend only")
     p.add_argument("--decoder", choices=["auto", "native", "py"],
                    default="auto",
                    help="host SAM decode path for the jax backend: the C++ "
@@ -124,6 +130,7 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         strict=not args.permissive,
         py2_compat=args.py2_compat,
         decoder=args.decoder,
+        pileup=args.pileup,
         chunk_reads=args.chunk_reads,
         profile_dir=args.profile_dir,
         json_metrics=args.json_metrics,
@@ -157,13 +164,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if cfg.shards and cfg.backend != "jax":
         raise SystemExit("--shards requires --backend jax")
+    if cfg.pileup == "mxu" and cfg.shards > 1:
+        raise SystemExit("--pileup mxu is not yet supported with --shards; "
+                         "the sharded accumulator uses the scatter path")
     if cfg.checkpoint_dir and cfg.backend != "jax":
         raise SystemExit("--checkpoint-dir requires --backend jax")
 
     t0 = time.perf_counter()
     echo("\nProcessing file " + args.filename + ":\n")
 
-    handle = opener(args.filename)
+    # jax backend: binary handle so the native decoder parses raw bytes
+    # (no whole-file str decode/encode round trip on the hot path)
+    handle = opener(args.filename, binary=cfg.backend == "jax")
     contigs, _n_header, first = read_header(handle)
     echo("SAM header processed, " + str(len(contigs)) + " references found.\n")
 

@@ -35,6 +35,7 @@ class RunConfig:
     strict: bool = True          # strict: error on invalid bases / out-of-range
     py2_compat: bool = False
     decoder: str = "auto"        # auto | native | py (jax backend host decode)
+    pileup: str = "auto"         # auto | mxu | scatter (device pileup strategy)
     chunk_reads: int = 262144    # reads per host->device batch (jax backend)
     profile_dir: Optional[str] = None
     json_metrics: Optional[str] = None

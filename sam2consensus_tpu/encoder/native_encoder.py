@@ -113,7 +113,9 @@ class NativeReadEncoder:
         out = np.zeros(16, dtype=np.int64)
 
         for text in blocks:
-            data = np.frombuffer(text.encode("ascii"), dtype=np.uint8)
+            if isinstance(text, str):
+                text = text.encode("ascii")
+            data = np.frombuffer(text, dtype=np.uint8)
             offset = 0
             while offset < len(data):
                 chunk = data[offset:]

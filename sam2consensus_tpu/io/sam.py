@@ -26,11 +26,22 @@ from dataclasses import dataclass
 from typing import Iterable, Iterator, List, TextIO, Tuple
 
 
-def opener(filename: str) -> TextIO:
-    """Open plain or gzip text by suffix (sam2consensus.py:110-114)."""
+def opener(filename: str, binary: bool = False):
+    """Open plain or gzip text by suffix (sam2consensus.py:110-114).
+
+    ``binary=True`` returns a bytes handle: the native decoder parses raw
+    bytes, so decoding 100s of MB of SAM text to ``str`` on the way in would
+    be pure overhead.  (Header lines are still ascii-decoded individually in
+    ``read_header``; a non-ascii *body* byte then surfaces as a decode error
+    from the C++/Python encoder rather than a ``UnicodeDecodeError``.)
+    """
     if filename.endswith(".gz"):
-        return io.TextIOWrapper(gzip.open(filename, "rb"), encoding="ascii",
-                                errors="strict")
+        raw = gzip.open(filename, "rb")
+        if binary:
+            return raw
+        return io.TextIOWrapper(raw, encoding="ascii", errors="strict")
+    if binary:
+        return open(filename, "rb")
     return open(filename, "r", encoding="ascii", errors="strict")
 
 
@@ -58,19 +69,22 @@ def parse_sq_line(line: str) -> Contig:
     return Contig(name, length)
 
 
-def read_header(handle: TextIO) -> Tuple[List[Contig], int, str]:
+def read_header(handle) -> Tuple[List[Contig], int, str]:
     """Consume header lines; return (contigs, header_line_count, first_body_line).
 
     ``first_body_line`` is the line that terminated the header ("" at EOF); the
     caller feeds it back into record iteration so a single pass suffices.
+    Accepts text or binary handles; header lines are ascii-decoded per line
+    (they are few and short), and ``first_body_line`` keeps the handle's type.
     """
     contigs: List[Contig] = []
     n_header = 0
     for line in handle:
-        if line.startswith("@"):
+        text = line.decode("ascii") if isinstance(line, bytes) else line
+        if text.startswith("@"):
             n_header += 1
-            if line.startswith("@SQ"):
-                contigs.append(parse_sq_line(line))
+            if text.startswith("@SQ"):
+                contigs.append(parse_sq_line(text))
         else:
             return contigs, n_header, line
     return contigs, n_header, ""
@@ -147,16 +161,20 @@ class ReadStream:
         def counted() -> Iterator[str]:
             for line in self.handle:
                 self.add_lines(1)
-                yield line
+                yield line.decode("ascii") if isinstance(line, bytes) \
+                    else line
 
         first = self.first
+        if isinstance(first, bytes):
+            first = first.decode("ascii")
         if first:
             self.add_lines(1)
         yield from iter_records(counted(), first)
 
-    def blocks(self, max_bytes: int = 1 << 23) -> Iterator[str]:
-        """Raw text blocks of whole lines (line counting is the consumer's
-        job via ``add_lines`` — the native decoder counts in C++)."""
+    def blocks(self, max_bytes: int = 1 << 23):
+        """Raw blocks of whole lines, str or bytes per the handle's mode
+        (line counting is the consumer's job via ``add_lines`` — the native
+        decoder counts in C++)."""
         pending = self.first
         self.first = ""
         while True:
@@ -165,7 +183,11 @@ class ReadStream:
                 if pending:
                     yield pending
                 return
-            if not chunk.endswith("\n"):
+            if not isinstance(pending, type(chunk)):  # str first body line
+                pending = pending.encode("ascii") if isinstance(pending, str) \
+                    else pending.decode("ascii")
+            newline = "\n" if isinstance(chunk, str) else b"\n"
+            if not chunk.endswith(newline):
                 chunk += self.handle.readline()
-            block, pending = pending + chunk, ""
+            block, pending = pending + chunk, chunk[:0]
             yield block

@@ -26,9 +26,30 @@ _lib = None
 _lib_err: Optional[str] = None
 
 
+_FLAGS = ["-O3", "-march=native", "-std=c++17", "-shared", "-fPIC"]
+
+
+def _cpu_fingerprint() -> bytes:
+    """ISA identity for the build cache: -march=native binaries must never
+    be picked up by a host with a different feature set (SIGILL, not a
+    loadable-module error, so the silent-fallback path would miss it)."""
+    try:
+        with open("/proc/cpuinfo", "r") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    return line.encode()
+    except OSError:
+        pass
+    return os.uname().machine.encode()
+
+
 def _build_so() -> str:
+    h = hashlib.sha256()
     with open(_SRC, "rb") as fh:
-        tag = hashlib.sha256(fh.read()).hexdigest()[:16]
+        h.update(fh.read())
+    h.update(" ".join(_FLAGS).encode())
+    h.update(_cpu_fingerprint())
+    tag = h.hexdigest()[:16]
     so_path = os.path.join(_DIR, f"_decoder_{tag}.so")
     if os.path.exists(so_path):
         return so_path
@@ -37,8 +58,7 @@ def _build_so() -> str:
     os.close(fd)
     try:
         subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
-             "-o", tmp],
+            ["g++", *_FLAGS, _SRC, "-o", tmp],
             check=True, capture_output=True, timeout=300)
         os.replace(tmp, so_path)
     finally:

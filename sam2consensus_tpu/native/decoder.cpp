@@ -71,6 +71,40 @@ inline bool is_op(char c) {
   }
 }
 
+// Advance `c` to the next CIGAR (num, op) token, replicating python's
+// re.findall(r"(\d+)([MIDNSHPX=])") semantics: non-digits are skipped, a
+// digit run not immediately followed by a valid op resumes one char later,
+// lengths clamp at 2^40 (they can only fail the bounds check, whose message
+// comes from the python replay).  Returns false at end of string.  The
+// pre-scan and both translation walks share this so their (num, op)
+// sequences can never diverge — the fast path's capacity pre-check and
+// direct slab writes rely on that agreement.
+inline bool next_cigar_op(const char* text, long ce, long& c, int64_t& num,
+                          char& op) {
+  while (c < ce) {
+    if (!is_digit(text[c])) {
+      ++c;
+      continue;
+    }
+    long j = c;
+    int64_t n = 0;
+    while (j < ce && is_digit(text[j])) {
+      n = n * 10 + (text[j] - '0');
+      if (n > (int64_t(1) << 40)) n = int64_t(1) << 40;
+      ++j;
+    }
+    if (j >= ce || !is_op(text[j])) {
+      ++c;  // regex-style: resume scanning one char later
+      continue;
+    }
+    num = n;
+    op = text[j];
+    c = j + 1;
+    return true;
+  }
+  return false;
+}
+
 uint64_t hash_bytes(const char* s, long n) {
   uint64_t h = 1469598103934665603ULL;
   for (long i = 0; i < n; ++i) {
@@ -157,7 +191,7 @@ extern "C" long s2c_decode(
   long status = kOk;
   long err_off = -1;
 
-  std::vector<unsigned char> row;           // reused per line
+  std::vector<unsigned char> row;           // reused per line (slow path)
   std::vector<int64_t> ins_pos_tmp;         // insertion local positions
   std::vector<long> ins_seq_tmp;            // (seq offset, length) pairs
 
@@ -185,12 +219,13 @@ extern "C" long s2c_decode(
     int nf = 0;
     long p = ls;
     fs[0] = p;
-    while (p < line_end && nf < 10) {
-      if (text[p] == '\t') {
-        fe[nf++] = p;
-        fs[nf] = p + 1;
-      }
-      ++p;
+    while (nf < 10) {
+      const char* tab = static_cast<const char*>(
+          memchr(text + p, '\t', line_end - p));
+      if (!tab) break;
+      fe[nf++] = tab - text;
+      p = (tab - text) + 1;
+      fs[nf] = p;
     }
     if (nf < 10) fe[nf++] = line_end;
 
@@ -255,104 +290,233 @@ extern "C" long s2c_decode(
 
     // --- contig lookup (contract violation, not a parse error) ---
     long ci = table.find(text + rs, rtok - rs);
-    bool encode_err = (ci < 0);
-    int64_t reflen = encode_err ? 0 : ctg_len[ci];
+    int64_t reflen = (ci < 0) ? 0 : ctg_len[ci];
 
-    // --- CIGAR walk ---
     long ss = fs[9], se = fe[9];
     long seq_len = se - ss;
+    long cs = fs[5], ce = fe[5];
+
+    // --- CIGAR pre-scan: span / insertion sizes / huge-span guard, no
+    //     base translation (one cheap pass over the short CIGAR string);
+    //     lets the common case translate straight into the slab row and
+    //     the capacity pre-check run before any commit ---
+    long span = 0;         // ref-consuming cells (== row length)
+    long pre_rc = 0;       // read-cursor simulation (M/I/S advance it)
+    long pre_ins = 0, pre_chars = 0;
+    bool huge_span = false;
+    {
+      long c = cs;
+      int64_t num;
+      char op;
+      while (next_cigar_op(text, ce, c, num, op)) {
+        switch (op) {
+          case 'M': case '=': case 'X':
+            // guard absurd lengths: such a span can only fail the bounds
+            // check, which the python replay will report
+            if (span + num > 2 * reflen + 64) {
+              huge_span = true;
+              break;
+            }
+            span += num;
+            pre_rc += num;
+            break;
+          case 'D': case 'N': case 'P':
+            if (span + num > 2 * reflen + 64) {
+              huge_span = true;
+              break;
+            }
+            span += num;
+            break;
+          case 'I': {
+            long take = seq_len - pre_rc;
+            if (take < 0) take = 0;
+            if (take > num) take = num;
+            ++pre_ins;
+            pre_chars += take;
+            pre_rc += num;
+            break;
+          }
+          case 'S':
+            pre_rc += num;
+            break;
+          default:  // 'H'
+            break;
+        }
+        if (huge_span) break;
+      }
+    }
+    if (span > max_span) max_span = span;
+
+    // --- structural validation (bad bases are found during translation;
+    //     the python replay reproduces the exact message either way) ---
+    if (ci < 0 || huge_span ||
+        (span > 0 && (pos < -reflen || pos + span > reflen))) {
+      if (strict) {
+        status = kErrorLine;
+        err_off = ls;
+        break;
+      }
+      ++n_skipped;
+      i = next;
+      continue;
+    }
+
+    bool overflow = span > width;
+    if (pos >= 0 && !overflow) {
+      // ---- FAST PATH: capacity first, then translate directly into the
+      //      next slab row (uncommitted until n_rows advances) ----
+      long rows_needed = span > 0 ? 1 : 0;
+      if (n_rows + rows_needed > rows_cap || n_ins + pre_ins > ins_cap ||
+          n_ins_chars + pre_chars > ins_chars_cap) {
+        status = kCapacity;
+        break;  // consumed stops at this line's start
+      }
+      unsigned char* dst = codes + static_cast<int64_t>(n_rows) * width;
+      long o = 0, rc = 0, gaps = 0, pads = 0;
+      bool bad_base = false;
+      long ins_base = n_ins, chars_base = n_ins_chars;
+      long c = cs;
+      int64_t num;
+      char op;
+      while (next_cigar_op(text, ce, c, num, op)) {
+        switch (op) {
+          case 'M': case '=': case 'X': {
+            long take = seq_len - rc;
+            if (take < 0) take = 0;
+            if (take > num) take = num;
+            const char* sp = text + ss + rc;
+            for (long k = 0; k < take; ++k) {
+              unsigned char code =
+                  kLut.m[static_cast<unsigned char>(sp[k])];
+              bad_base |= (code == 255);
+              gaps += (code == kGap);
+              dst[o + k] = code;
+            }
+            if (num > take) {
+              memset(dst + o + take, kPad, num - take);
+              pads += num - take;
+            }
+            o += num;
+            rc += num;
+            break;
+          }
+          case 'D': case 'N': case 'P':
+            memset(dst + o, kGap, num);
+            gaps += num;
+            o += num;
+            break;
+          case 'I': {
+            long take = seq_len - rc;
+            if (take < 0) take = 0;
+            if (take > num) take = num;
+            const char* sp = text + ss + rc;
+            for (long k = 0; k < take; ++k)
+              bad_base |= (kLut.m[static_cast<unsigned char>(sp[k])] == 255);
+            // commit now (capacity pre-checked); rolled back on bad_base
+            ins_contig[n_ins] = static_cast<int32_t>(ci);
+            ins_local[n_ins] = static_cast<int32_t>(pos + o);
+            ins_mlen[n_ins] = static_cast<int32_t>(take);
+            memcpy(ins_chars + n_ins_chars, sp, take);
+            n_ins_chars += take;
+            ++n_ins;
+            rc += num;
+            break;
+          }
+          case 'S':
+            rc += num;
+            break;
+          default:  // 'H'
+            break;
+        }
+      }
+      if (bad_base) {
+        n_ins = ins_base;
+        n_ins_chars = chars_base;
+        if (strict) {
+          status = kErrorLine;
+          err_off = ls;
+          break;
+        }
+        ++n_skipped;
+        i = next;
+        continue;
+      }
+      if (maxdel >= 0 && gaps > maxdel) {
+        for (long k = 0; k < span; ++k)
+          if (dst[k] == kGap) dst[k] = kPad;
+        pads += gaps;
+      }
+      if (span > 0) {
+        memset(dst + span, kPad, width - span);
+        starts[n_rows] = static_cast<int32_t>(ctg_offset[ci] + pos);
+        ++n_rows;
+        n_events += span - pads;
+      }
+      ++n_reads;
+      i = next;
+      continue;
+    }
+
+    // ---- SLOW PATH (negative POS wrap, or span > width): translate into
+    //      the temp row, then the original capacity / overflow / commit
+    //      protocol ----
     long rc = 0;
     int64_t ref_cursor = pos;
     bool bad_base = false;
-    bool huge_span = false;
     row.clear();
     ins_pos_tmp.clear();
     ins_seq_tmp.clear();
-
-    long cs = fs[5], ce = fe[5];
-    long c = cs;
-    while (c < ce && !huge_span) {
-      if (!is_digit(text[c])) {
-        ++c;
-        continue;
-      }
-      long j = c;
-      int64_t num = 0;
-      while (j < ce && is_digit(text[j])) {
-        num = num * 10 + (text[j] - '0');
-        if (num > (int64_t(1) << 40)) num = int64_t(1) << 40;
-        ++j;
-      }
-      if (j >= ce || !is_op(text[j])) {
-        ++c;  // regex-style: resume scanning one char later
-        continue;
-      }
-      char op = text[j];
-      c = j + 1;
-      switch (op) {
-        case 'M': case '=': case 'X': {
-          // guard absurd lengths before allocating: such a span can only
-          // fail the bounds check, which the python replay will report
-          if (ref_cursor - pos + num > 2 * reflen + 64) {
-            huge_span = true;
+    {
+      long c = cs;
+      int64_t num;
+      char op;
+      while (next_cigar_op(text, ce, c, num, op)) {
+        switch (op) {
+          case 'M': case '=': case 'X': {
+            long take = seq_len - rc;
+            if (take < 0) take = 0;
+            if (take > num) take = num;
+            size_t base = row.size();
+            row.resize(base + num, kPad);
+            for (long k = 0; k < take; ++k) {
+              unsigned char code =
+                  kLut.m[static_cast<unsigned char>(text[ss + rc + k])];
+              if (code == 255) bad_base = true;
+              row[base + k] = code;
+            }
+            rc += num;
+            ref_cursor += num;
             break;
           }
-          long take = seq_len - rc;
-          if (take < 0) take = 0;
-          if (take > num) take = num;
-          size_t base = row.size();
-          row.resize(base + num, kPad);
-          for (long k = 0; k < take; ++k) {
-            unsigned char code =
-                kLut.m[static_cast<unsigned char>(text[ss + rc + k])];
-            if (code == 255) bad_base = true;
-            row[base + k] = code;
-          }
-          rc += num;
-          ref_cursor += num;
-          break;
-        }
-        case 'D': case 'N': case 'P': {
-          if (ref_cursor - pos + num > 2 * reflen + 64) {
-            huge_span = true;
+          case 'D': case 'N': case 'P':
+            row.resize(row.size() + num, kGap);
+            ref_cursor += num;
+            break;
+          case 'I': {
+            long take = seq_len - rc;
+            if (take < 0) take = 0;
+            if (take > num) take = num;
+            for (long k = 0; k < take; ++k) {
+              unsigned char code =
+                  kLut.m[static_cast<unsigned char>(text[ss + rc + k])];
+              if (code == 255) bad_base = true;
+            }
+            ins_pos_tmp.push_back(ref_cursor);
+            ins_seq_tmp.push_back(ss + rc);
+            ins_seq_tmp.push_back(take);
+            rc += num;
             break;
           }
-          row.resize(row.size() + num, kGap);
-          ref_cursor += num;
-          break;
+          case 'S':
+            rc += num;
+            break;
+          default:  // 'H'
+            break;
         }
-        case 'I': {
-          long take = seq_len - rc;
-          if (take < 0) take = 0;
-          if (take > num) take = num;
-          for (long k = 0; k < take; ++k) {
-            unsigned char code =
-                kLut.m[static_cast<unsigned char>(text[ss + rc + k])];
-            if (code == 255) bad_base = true;
-          }
-          ins_pos_tmp.push_back(ref_cursor);
-          ins_seq_tmp.push_back(ss + rc);
-          ins_seq_tmp.push_back(take);
-          rc += num;
-          break;
-        }
-        case 'S':
-          rc += num;
-          break;
-        default:  // 'H'
-          break;
       }
     }
 
-    long span = static_cast<long>(row.size());
-    if (span > max_span) max_span = span;
-
-    // --- validation (mirrors encoder ordering; any failure -> one flag) ---
-    if (huge_span ||
-        (span > 0 && (pos < -reflen || pos + span > reflen)) || bad_base)
-      encode_err = true;
-
-    if (encode_err) {
+    if (bad_base) {
       if (strict) {
         status = kErrorLine;
         err_off = ls;
@@ -373,7 +537,6 @@ extern "C" long s2c_decode(
 
     // --- capacity pre-check (whole line commits or none) ---
     long rows_needed = 0;
-    bool overflow = span > width;
     if (span > 0 && !overflow)
       rows_needed = (pos < 0 && pos + span > 0) ? 2 : 1;
     long chars_needed = 0;

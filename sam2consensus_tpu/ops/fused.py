@@ -1,0 +1,57 @@
+"""One-dispatch pipeline tail: position vote + insertion table + vote.
+
+On a tunneled TPU every dispatch→fetch round trip costs tens of
+milliseconds, which dwarfs the actual vote compute (an elementwise int32
+reduction).  So the whole post-accumulation tail runs as ONE jitted call
+producing ONE packed uint8 buffer:
+
+    [ syms  T*L  |  insertion syms  T*Kp*Cp ]
+
+and the host does exactly two device round trips after accumulation:
+
+1. fetch coverage (needed on host anyway for the threshold LUTs, the
+   min-depth gates and the FASTA headers) — started asynchronously so the
+   host's insertion grouping overlaps the transfer;
+2. fetch the packed vote output.
+
+Insertion-site count ``Kp`` and column count ``Cp`` are padded to powers of
+two so the jit cache stays O(log²) across runs; pad events scatter into the
+sacrificial last table row, whose votes the host slices off.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .insertions import build_insertion_table, vote_insertions
+from .vote import vote_block
+
+
+@jax.jit
+def coverage(counts: jax.Array) -> jax.Array:
+    """Per-position depth ``[L]`` — gaps and Ns count (quirk 5)."""
+    return counts.sum(axis=-1)
+
+
+@partial(jax.jit, static_argnames=("min_depth", "cp"))
+def vote_packed(counts: jax.Array, t_luts: jax.Array, ev_key: jax.Array,
+                ev_col: jax.Array, ev_code: jax.Array, site_cov: jax.Array,
+                n_cols: jax.Array, min_depth: int, cp: int) -> jax.Array:
+    """Position vote + insertion table build + insertion vote, packed uint8.
+
+    ``site_cov``/``n_cols`` are the padded ``[Kp]`` site arrays; ``cp`` is
+    the padded insertion-table column count (static).
+    """
+    syms, _cov = vote_block(counts, t_luts, min_depth)          # [T, L]
+    kp = site_cov.shape[0]
+    table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
+    table = build_insertion_table(table, ev_key, ev_col, ev_code)
+    ins_syms = vote_insertions(table, site_cov, n_cols, t_luts)  # [T, Kp, Cp]
+    return jnp.concatenate([syms.reshape(-1), ins_syms.reshape(-1)])
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
