@@ -155,19 +155,28 @@ class JaxBackend:
             site_cov = np.where(ins["key_flat"] >= 0,
                                 cov[np.maximum(ins["key_flat"], 0)],
                                 0).astype(np.int32)
-            site_cov_p = np.zeros(kp, dtype=np.int32)
-            site_cov_p[:k] = site_cov
-            n_cols_p = np.zeros(kp, dtype=np.int32)
-            n_cols_p[:k] = ins["n_cols"]
-            e = len(ins["ev_key"])
-            ep = fused.next_pow2(max(e, 1))
-            ev_key = np.full(ep, kp - 1, dtype=np.int32)
-            ev_key[:e] = ins["ev_key"]
-            ev_col = np.zeros(ep, dtype=np.int32)
-            ev_col[:e] = ins["ev_col"]
-            ev_code = np.zeros(ep, dtype=np.int32)
-            ev_code[:e] = ins["ev_code"]
+            use_pallas = getattr(cfg, "ins_kernel", "scatter") == "pallas"
+
+            def padded_scatter_inputs():
+                """Pad sites to kp and events to a power of two; pad events
+                scatter into the sacrificial row kp-1 (> k always)."""
+                scp = np.zeros(kp, dtype=np.int32)
+                scp[:k] = site_cov
+                ncp = np.zeros(kp, dtype=np.int32)
+                ncp[:k] = ins["n_cols"]
+                e = len(ins["ev_key"])
+                ep = fused.next_pow2(max(e, 1))
+                ek = np.full(ep, kp - 1, dtype=np.int32)
+                ek[:e] = ins["ev_key"]
+                ec = np.zeros(ep, dtype=np.int32)
+                ec[:e] = ins["ev_col"]
+                eb = np.zeros(ep, dtype=np.int32)
+                eb[:e] = ins["ev_code"]
+                return scp, ncp, ek, ec, eb
+
             if use_sharded:
+                site_cov_p, n_cols_p, ev_key, ev_col, ev_code = \
+                    padded_scatter_inputs()
                 table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
                 table = build_insertion_table(
                     table, jnp.asarray(ev_key), jnp.asarray(ev_col),
@@ -175,7 +184,33 @@ class JaxBackend:
                 ins_syms = np.asarray(vote_insertions(
                     table, jnp.asarray(site_cov_p), jnp.asarray(n_cols_p),
                     t_luts))[:, :k, :]                        # [T, K, Cp]
+            elif use_pallas:
+                from ..ops import pallas_insertion
+
+                # the pallas table is [eplan.kp, cp, 6]; pad the site
+                # arrays to ITS key padding (a KEY_BLOCK multiple)
+                eplan = pallas_insertion.plan_events(
+                    ins["ev_key"], ins["ev_col"], ins["ev_code"], k, cp)
+                sc = np.zeros(eplan.kp, dtype=np.int32)
+                sc[:k] = site_cov
+                nc = np.zeros(eplan.kp, dtype=np.int32)
+                nc[:k] = ins["n_cols"]
+                interp = jax.default_backend() != "tpu"
+                packed = fused.vote_packed_pallas(
+                    counts, t_luts, jnp.asarray(eplan.key3),
+                    jnp.asarray(eplan.cc3), jnp.asarray(eplan.blk_lo),
+                    jnp.asarray(eplan.blk_n), jnp.asarray(sc),
+                    jnp.asarray(nc), cfg.min_depth, cp, eplan.kp,
+                    eplan.c6p, eplan.max_blocks, interp)
+                out = np.asarray(packed)
+                split = n_thresholds * total_len
+                syms = out[:split].reshape(n_thresholds, total_len)
+                ins_syms = out[split:].reshape(
+                    n_thresholds, eplan.kp, cp)[:, :k, :]     # [T, K, Cp]
+                stats.extra["insertion_kernel"] = "pallas"
             else:
+                site_cov_p, n_cols_p, ev_key, ev_col, ev_code = \
+                    padded_scatter_inputs()
                 packed = fused.vote_packed(
                     counts, t_luts, jnp.asarray(ev_key), jnp.asarray(ev_col),
                     jnp.asarray(ev_code), jnp.asarray(site_cov_p),

@@ -79,6 +79,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "current auto default) or MXU one-hot matmul (mxu, "
                         "experimental; falls back to scatter on skewed "
                         "coverage). Single-device jax backend only")
+    p.add_argument("--insertion-kernel", dest="ins_kernel",
+                   choices=["scatter", "pallas"], default="scatter",
+                   help="insertion-table build on device: XLA scatter "
+                        "(default) or the Pallas segmented-reduce kernel")
     p.add_argument("--decoder", choices=["auto", "native", "py"],
                    default="auto",
                    help="host SAM decode path for the jax backend: the C++ "
@@ -131,6 +135,7 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         py2_compat=args.py2_compat,
         decoder=args.decoder,
         pileup=args.pileup,
+        ins_kernel=args.ins_kernel,
         chunk_reads=args.chunk_reads,
         profile_dir=args.profile_dir,
         json_metrics=args.json_metrics,
@@ -167,6 +172,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if cfg.pileup == "mxu" and cfg.shards > 1:
         raise SystemExit("--pileup mxu is not yet supported with --shards; "
                          "the sharded accumulator uses the scatter path")
+    if cfg.ins_kernel == "pallas" and cfg.shards > 1:
+        raise SystemExit("--insertion-kernel pallas is not yet supported "
+                         "with --shards; the sharded path uses the scatter "
+                         "table build")
     if cfg.checkpoint_dir and cfg.backend != "jax":
         raise SystemExit("--checkpoint-dir requires --backend jax")
 

@@ -55,3 +55,28 @@ def vote_packed(counts: jax.Array, t_luts: jax.Array, ev_key: jax.Array,
 
 def next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
+
+
+@partial(jax.jit, static_argnames=("min_depth", "cp", "kp", "c6p",
+                                   "max_blocks", "interpret"))
+def vote_packed_pallas(counts: jax.Array, t_luts: jax.Array,
+                       key3: jax.Array, cc3: jax.Array, blk_lo: jax.Array,
+                       blk_n: jax.Array, site_cov: jax.Array,
+                       n_cols: jax.Array, min_depth: int, cp: int, kp: int,
+                       c6p: int, max_blocks: int,
+                       interpret: bool = False) -> jax.Array:
+    """``vote_packed`` with the insertion table built by the Pallas
+    segmented-reduce kernel (ops/pallas_insertion.py) instead of the XLA
+    scatter — still one dispatch, one packed uint8 result.
+
+    Inputs are the kernel's host-planned arrays (key-sorted event blocks +
+    CSR block ranges); ``site_cov``/``n_cols`` are padded to ``kp``.
+    """
+    from .pallas_insertion import _table_call
+
+    syms, _cov = vote_block(counts, t_luts, min_depth)          # [T, L]
+    out = _table_call(key3, cc3, blk_lo, blk_n, kp=kp, c6p=c6p,
+                      max_blocks=max_blocks, interpret=interpret)
+    table = out.reshape(kp, c6p)[:, : cp * 6].reshape(kp, cp, 6)
+    ins_syms = vote_insertions(table, site_cov, n_cols, t_luts)
+    return jnp.concatenate([syms.reshape(-1), ins_syms.reshape(-1)])

@@ -46,6 +46,10 @@ TILE_POSITIONS = 2048
 #: fall back to scatter when per-tile padding would inflate rows this much
 MAX_BLOWUP = 4.0
 
+#: tiles processed per lax.map step: bounds the live matmul intermediate
+#: to TILE_CHUNK * tile * W * 6 int32 (~200MB at W=128) on any genome size
+TILE_CHUNK = 32
+
 
 class TilePlan(NamedTuple):
     """Host-side plan: rows tile-sorted and densely padded per tile."""
@@ -132,7 +136,25 @@ def pileup_mxu(counts: jax.Array, loc_flat: jax.Array, codes_flat: jax.Array,
                                 preferred_element_type=jnp.int32)
         return _skew_fold(t.reshape(tile, width, NUM_SYMBOLS))
 
-    tiles = jax.vmap(per_tile)(loc, cod)                     # [NT, TP+W, 6]
+    # chunk the tile axis: a flat vmap would materialize the matmul output
+    # for EVERY tile at once — [n_tiles, tile, W*6] int32 scales with
+    # genome length and OOMs HBM on multi-Mbp genomes.  lax.map over
+    # fixed-size tile chunks caps the live intermediate at
+    # TILE_CHUNK * tile * W * 6 * 4B regardless of n_tiles.
+    if n_tiles <= TILE_CHUNK:
+        tiles = jax.vmap(per_tile)(loc, cod)                 # [NT, TP+W, 6]
+    else:
+        n_chunks = -(-n_tiles // TILE_CHUNK)
+        pad = n_chunks * TILE_CHUNK - n_tiles
+        loc_p = jnp.pad(loc, ((0, pad), (0, 0)))
+        cod_p = jnp.pad(cod, ((0, pad), (0, 0), (0, 0)),
+                        constant_values=255)
+        tiles = jax.lax.map(
+            lambda xs: jax.vmap(per_tile)(*xs),
+            (loc_p.reshape(n_chunks, TILE_CHUNK, rows_per_tile),
+             cod_p.reshape(n_chunks, TILE_CHUNK, rows_per_tile, width)))
+        tiles = tiles.reshape(n_chunks * TILE_CHUNK, tile + width,
+                              NUM_SYMBOLS)[:n_tiles]
     main = tiles[:, :tile, :].reshape(-1, NUM_SYMBOLS)
     # overhang of tile t covers [(t+1)*TP, (t+1)*TP + W): one tiny scatter
     pad = jnp.zeros(((n_tiles + 1) * tile + width, NUM_SYMBOLS),

@@ -1,0 +1,173 @@
+"""Pallas TPU kernel: insertion-table build as a segmented one-hot dot.
+
+The insertion "mini-alignment" table (SURVEY.md §2b; reference semantics at
+``/root/reference/sam2consensus.py:256-311``) is a segmented reduction of
+(site key, column, base) events into a ``[K, C, 6]`` count table.  The
+pure-JAX path scatters (``ops.insertions.build_insertion_table``); this
+kernel instead contracts one-hot matrices on the MXU, CSR-style:
+
+* the host sorts events by site key and computes, per 128-key block, the
+  range of 512-event blocks that can contain its events (scalar-prefetched
+  ``blk_lo``/``blk_n``);
+* the grid walks ``(key block, event block)``; each step builds
+  ``A[e, k] = [key_e == block_base + k]`` and
+  ``B[e, m] = [col_e*6 + code_e == m]`` as f32 one-hots and accumulates
+  ``AᵀB`` into a VMEM scratch block — all shapes static and lane-aligned,
+  so Mosaic needs no dynamic-offset vector stores;
+* events belonging to other key blocks one-hot to zero rows (keys are
+  disjoint across blocks), so the event-range skipping is purely a
+  performance device, not a correctness one — except for clamped re-visits
+  of the last event block, which the ``j < blk_n`` gate suppresses;
+* f32 accumulation is exact for counts below 2^24 (the table is per-run
+  event counts; the int32 cast on write would overflow long before f32
+  loses integers).
+
+``interpret=True`` runs the same kernel on CPU for CI (SURVEY.md §4);
+equivalence against the scatter path is pinned by
+tests/test_pallas_insertion.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..constants import NUM_SYMBOLS
+
+#: keys per grid block (lane-aligned)
+KEY_BLOCK = 128
+#: events per grid block
+EVENT_BLOCK = 512
+
+
+def _kernel(blk_lo_ref, blk_n_ref, key_ref, cc_ref, out_ref, acc_ref, *,
+            c6p: int, n_event_blocks: int):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j < blk_n_ref[i])
+    def _accumulate():
+        key = key_ref[0]                                     # [EB, 1] int32
+        cc = cc_ref[0]                                       # [EB, 1] int32
+        local = key - i * KEY_BLOCK
+        a = (local == jax.lax.broadcasted_iota(
+            jnp.int32, (EVENT_BLOCK, KEY_BLOCK), 1)).astype(jnp.float32)
+        b = (cc == jax.lax.broadcasted_iota(
+            jnp.int32, (EVENT_BLOCK, c6p), 1)).astype(jnp.float32)
+        acc_ref[...] += jax.lax.dot_general(
+            a, b, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nb - 1)
+    def _emit():
+        out_ref[0] = acc_ref[...].astype(jnp.int32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("kp", "c6p", "max_blocks", "interpret"))
+def _table_call(key3, cc3, blk_lo, blk_n, *, kp, c6p, max_blocks,
+                interpret=False):
+    n_event_blocks = key3.shape[0]
+    kernel = functools.partial(_kernel, c6p=c6p,
+                               n_event_blocks=n_event_blocks)
+
+    def ev_index(i, j, blk_lo, blk_n):
+        return (jnp.minimum(blk_lo[i] + j, n_event_blocks - 1), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(kp // KEY_BLOCK, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, EVENT_BLOCK, 1), ev_index),
+            pl.BlockSpec((1, EVENT_BLOCK, 1), ev_index),
+        ],
+        out_specs=pl.BlockSpec((1, KEY_BLOCK, c6p),
+                               lambda i, j, lo, n: (i, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((KEY_BLOCK, c6p), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((kp // KEY_BLOCK, KEY_BLOCK, c6p),
+                                       jnp.int32),
+        interpret=interpret,
+    )(blk_lo, blk_n, key3, cc3)
+
+
+class EventPlan(NamedTuple):
+    """Host-side kernel plan: key-sorted event blocks + CSR block ranges."""
+    key3: np.ndarray       # [NEB, EVENT_BLOCK, 1] int32, key-sorted
+    cc3: np.ndarray        # [NEB, EVENT_BLOCK, 1] int32, col*6+code
+    blk_lo: np.ndarray     # [kp/KEY_BLOCK] int32 first event block per key blk
+    blk_n: np.ndarray      # [kp/KEY_BLOCK] int32 event blocks per key blk
+    kp: int                # padded key count (KEY_BLOCK multiple)
+    c6p: int               # padded flattened column-code lanes
+    max_blocks: int        # grid's event-block axis (fullest key block)
+
+
+def plan_events(ev_key: np.ndarray, ev_col: np.ndarray,
+                ev_code: np.ndarray, n_keys: int, cp: int) -> EventPlan:
+    """Sort events by key and compute per-key-block event ranges.
+
+    ``cp`` is the (possibly already padded) column count of the table the
+    caller wants back; lanes pad to ``c6p = roundup(cp*6, 128)``.
+    """
+    e = len(ev_key)
+    order = np.argsort(ev_key, kind="stable")
+    key_s = ev_key[order].astype(np.int32)
+    cc_s = (ev_col[order] * NUM_SYMBOLS + ev_code[order]).astype(np.int32)
+
+    kp = max(KEY_BLOCK, -(-n_keys // KEY_BLOCK) * KEY_BLOCK)
+    c6p = max(128, -(-(cp * NUM_SYMBOLS) // 128) * 128)
+    ep = max(EVENT_BLOCK, -(-e // EVENT_BLOCK) * EVENT_BLOCK)
+    if ep != e:
+        # pad keys with int32 max: keeps key_s ascending (searchsorted
+        # below relies on it) and matches no key block's local iota
+        key_s = np.concatenate(
+            [key_s, np.full(ep - e, np.iinfo(np.int32).max,
+                            dtype=np.int32)])
+        cc_s = np.concatenate([cc_s, np.zeros(ep - e, dtype=np.int32)])
+    n_event_blocks = ep // EVENT_BLOCK
+
+    bounds = np.arange(0, kp + KEY_BLOCK, KEY_BLOCK)
+    ev_bounds = np.searchsorted(key_s, bounds, side="left")
+    blk_lo = (ev_bounds[:-1] // EVENT_BLOCK).astype(np.int32)
+    last = np.maximum(ev_bounds[1:] - 1, ev_bounds[:-1])
+    blk_hi = np.where(ev_bounds[1:] > ev_bounds[:-1],
+                      last // EVENT_BLOCK + 1, blk_lo)
+    blk_n = (blk_hi - blk_lo).astype(np.int32)
+    return EventPlan(
+        key_s.reshape(n_event_blocks, EVENT_BLOCK, 1),
+        cc_s.reshape(n_event_blocks, EVENT_BLOCK, 1),
+        blk_lo, blk_n, kp, c6p, max(1, int(blk_n.max(initial=1))))
+
+
+def build_insertion_table_pallas(ev_key: np.ndarray, ev_col: np.ndarray,
+                                 ev_code: np.ndarray, n_keys: int,
+                                 max_cols: int,
+                                 interpret: bool = False) -> jax.Array:
+    """Segmented-reduce insertion events into an int32 ``[n_keys, C, 6]``.
+
+    Same contract as ``ops.insertions.build_insertion_table`` applied to a
+    zero table.
+    """
+    plan = plan_events(ev_key, ev_col, ev_code, n_keys, max_cols)
+    out = _table_call(
+        jnp.asarray(plan.key3), jnp.asarray(plan.cc3),
+        jnp.asarray(plan.blk_lo), jnp.asarray(plan.blk_n),
+        kp=plan.kp, c6p=plan.c6p, max_blocks=plan.max_blocks,
+        interpret=interpret)
+    table = out.reshape(plan.kp, plan.c6p)[:n_keys,
+                                           : max_cols * NUM_SYMBOLS]
+    return table.reshape(n_keys, max_cols, NUM_SYMBOLS)

@@ -120,3 +120,22 @@ def test_skew_falls_back_to_scatter():
         acc.strategy_used
     counts = acc.counts_host()
     assert counts[:width, 2].tolist() == [n] * width
+
+
+def test_mxu_chunked_tile_axis():
+    """n_tiles > TILE_CHUNK exercises the lax.map chunked path."""
+    rng = np.random.default_rng(3)
+    tile = 256
+    padded_len = (mxu_pileup.TILE_CHUNK + 9) * tile
+    width = 32
+    starts = rng.integers(0, padded_len - width, 2000).astype(np.int32)
+    codes = rng.integers(0, 6, (2000, width)).astype(np.uint8)
+    plan = mxu_pileup.plan_tiles(starts, codes, padded_len, tile,
+                                 max_blowup=float("inf"))
+    assert plan.n_tiles > mxu_pileup.TILE_CHUNK
+    out = mxu_pileup.pileup_mxu(
+        jnp.zeros((padded_len, 6), jnp.int32), jnp.asarray(plan.loc),
+        jnp.asarray(plan.codes), tile=tile, n_tiles=plan.n_tiles,
+        rows_per_tile=plan.rows_per_tile, width=plan.width)
+    assert np.array_equal(np.asarray(out, dtype=np.int64),
+                          _ref_counts(starts, codes, padded_len))
