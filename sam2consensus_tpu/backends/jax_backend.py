@@ -31,6 +31,11 @@ from ..config import RunConfig
 from ..io.sam import Contig, SamRecord
 from .base import BackendResult, BackendStats, FastaRecord, format_header
 
+#: halo width for the position-sharded (sp) accumulator; must cover the
+#: widest segment-row bucket the native encoder will emit (it widens up
+#: to 1<<16 on overflow, encoder/native_encoder.py)
+SP_HALO = 1 << 16
+
 
 class JaxBackend:
     name = "jax"
@@ -59,10 +64,30 @@ class JaxBackend:
         use_sharded = shards > 1
 
         if use_sharded:
-            from ..parallel.dp import ShardedConsensus
             from ..parallel.mesh import make_mesh
 
-            acc = ShardedConsensus(make_mesh(shards), layout.total_len)
+            from ..parallel.base import block_for
+
+            mode = getattr(cfg, "shard_mode", "auto")
+            block = block_for(layout.total_len, shards)
+            if mode == "auto":
+                # sp (position-sharded blocks + halo exchange) once the
+                # dp pipeline's transient full-length local tensor per
+                # device stops being cheap; dp otherwise (it needs no
+                # host-side read routing and reduce-scatter is optimal)
+                mode = ("sp" if layout.total_len >= (1 << 25)
+                        and block >= SP_HALO else "dp")
+            if mode == "sp":
+                from ..parallel.sp import PositionShardedConsensus
+
+                acc = PositionShardedConsensus(
+                    make_mesh(shards), layout.total_len,
+                    halo=min(block, SP_HALO))
+            else:
+                from ..parallel.dp import ShardedConsensus
+
+                acc = ShardedConsensus(make_mesh(shards), layout.total_len)
+            stats.extra["shard_mode"] = mode
         else:
             acc = PileupAccumulator(layout.total_len,
                                     strategy=getattr(cfg, "pileup", "auto"))

@@ -88,6 +88,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="host SAM decode path for the jax backend: the C++ "
                         "decoder when available (auto), required (native), "
                         "or pure python (py)")
+    p.add_argument("--shard-mode", dest="shard_mode",
+                   choices=["auto", "dp", "sp"], default="auto",
+                   help="sharded accumulator layout: full-length local "
+                        "scatter + reduce-scatter (dp) or position-sharded "
+                        "blocks with halo exchange for huge genomes (sp); "
+                        "auto picks by genome size")
     p.add_argument("--shards", type=int, default=0,
                    help="data-parallel shards for the jax backend; 0 = all devices")
     p.add_argument("--chunk-reads", dest="chunk_reads", type=int, default=262144,
@@ -143,6 +149,7 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         checkpoint_every=args.checkpoint_every,
         paranoid=args.paranoid,
         shards=args.shards,
+        shard_mode=args.shard_mode,
     )
 
 

@@ -37,6 +37,7 @@ class RunConfig:
     decoder: str = "auto"        # auto | native | py (jax backend host decode)
     pileup: str = "auto"         # auto | mxu | scatter (device pileup strategy)
     ins_kernel: str = "scatter"  # scatter | pallas (insertion table build)
+    shard_mode: str = "auto"     # auto | dp | sp (sharded accumulator layout)
     chunk_reads: int = 262144    # reads per host->device batch (jax backend)
     profile_dir: Optional[str] = None
     json_metrics: Optional[str] = None

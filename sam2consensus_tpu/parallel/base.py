@@ -1,0 +1,90 @@
+"""Shared machinery for the sharded accumulators (dp and sp layouts).
+
+Both pipelines keep the count tensor position-sharded across the
+flattened ("dp", "sp") mesh axes and share the same state surface
+(``counts`` / ``counts_host`` / ``restore`` for checkpointing, and the
+position-sharded ``vote``); only *accumulation* differs — dp scatters
+full-length local tensors and reduce-scatters, sp routes rows to the
+owning position block and halo-exchanges.  Keeping the common parts here
+means a vote or checkpoint fix cannot silently diverge the two modes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..constants import NUM_SYMBOLS
+
+#: both mesh axes flattened: every collective treats the mesh as one ring
+ALL = ("dp", "sp")
+
+
+def block_for(total_len: int, n_devices: int) -> int:
+    """Rows of the position axis each device owns (+1 covers the
+    scatter path's sacrificial row inside the pad)."""
+    return -(-(total_len + 1) // n_devices)
+
+
+class ShardedCountsBase:
+    """Position-sharded count-tensor state + vote, layout-agnostic."""
+
+    def __init__(self, mesh: Mesh, total_len: int):
+        self.mesh = mesh
+        self.n = mesh.size
+        self.total_len = total_len
+        self.block = block_for(total_len, self.n)
+        self.padded_len = self.block * self.n
+
+        self._counts = jax.device_put(
+            jnp.zeros((self.padded_len, NUM_SYMBOLS), dtype=jnp.int32),
+            NamedSharding(mesh, P(ALL, None)))
+        self._row_spec = NamedSharding(mesh, P(ALL))
+        self._mat_spec = NamedSharding(mesh, P(ALL, None))
+
+    # -- state ------------------------------------------------------------
+    @property
+    def counts(self) -> jax.Array:
+        """Position-sharded counts including pad rows ([padded_len, 6])."""
+        return self._counts
+
+    def counts_host(self) -> np.ndarray:
+        """Valid counts on host, ``[total_len, 6]``."""
+        return np.asarray(self._counts)[: self.total_len]
+
+    def restore(self, counts: np.ndarray) -> None:
+        """Load checkpointed counts (``[total_len, 6]``), re-sharded."""
+        padded = np.zeros((self.padded_len, NUM_SYMBOLS), dtype=np.int32)
+        padded[: self.total_len] = counts
+        self._counts = jax.device_put(
+            jnp.asarray(padded), NamedSharding(self.mesh, P(ALL, None)))
+
+    # -- vote -------------------------------------------------------------
+    def vote(self, t_luts: np.ndarray, min_depth: int
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        """Position-sharded vote; returns host (syms [T, total_len], cov).
+
+        Sequence parallelism with zero extra communication: the vote is
+        elementwise per position, so it runs on the resident blocks.
+        """
+        from ..ops.vote import vote_block
+
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(P(ALL, None), P(None, None)),
+                 out_specs=(P(None, ALL), P(ALL)))
+        def voted(counts_blk, luts):
+            return vote_block(counts_blk, luts, min_depth)
+
+        syms, cov = jax.jit(voted)(self._counts, jnp.asarray(t_luts))
+        return (np.asarray(syms)[:, : self.total_len],
+                np.asarray(cov, dtype=np.int64)[: self.total_len])

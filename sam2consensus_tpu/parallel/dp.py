@@ -26,43 +26,27 @@ streaming input and checkpoint/resume compose with sharding.
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-try:
-    from jax import shard_map  # jax >= 0.8
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import expand_segment_positions, iter_row_slices
+from .base import ALL, ShardedCountsBase, shard_map
 
-ALL = ("dp", "sp")  # both mesh axes flattened: pure-DP / pure-SP phases
+__all__ = ["ShardedConsensus", "ALL"]
 
 
-class ShardedConsensus:
+class ShardedConsensus(ShardedCountsBase):
     """Streaming sharded accumulate + vote over a ("dp", "sp") mesh."""
 
     def __init__(self, mesh: Mesh, total_len: int):
-        self.mesh = mesh
-        self.n = mesh.size
-        self.total_len = total_len
         # position axis padded so every device owns an equal block; the
         # sacrificial scatter row (index total_len) lives inside the pad.
-        self.block = -(-(total_len + 1) // self.n)
-        self.padded_len = self.block * self.n
-
-        counts_spec = NamedSharding(mesh, P(ALL, None))
-        self._counts = jax.device_put(
-            jnp.zeros((self.padded_len, NUM_SYMBOLS), dtype=jnp.int32),
-            counts_spec)
-        self._row_spec = NamedSharding(mesh, P(ALL))
-        self._mat_spec = NamedSharding(mesh, P(ALL, None))
+        super().__init__(mesh, total_len)
 
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(ALL, None), P(ALL), P(ALL, None)),
@@ -96,36 +80,3 @@ class ShardedConsensus:
                     self._counts,
                     jax.device_put(starts[lo:hi], self._row_spec),
                     jax.device_put(codes[lo:hi], self._mat_spec))
-
-    # -- state ------------------------------------------------------------
-    @property
-    def counts(self) -> jax.Array:
-        """Position-sharded counts including the pad rows ([padded_len, 6])."""
-        return self._counts
-
-    def counts_host(self) -> np.ndarray:
-        """Valid counts on host, ``[total_len, 6]``."""
-        return np.asarray(self._counts)[: self.total_len]
-
-    def restore(self, counts: np.ndarray) -> None:
-        """Load checkpointed counts (``[total_len, 6]``), re-sharded."""
-        padded = np.zeros((self.padded_len, NUM_SYMBOLS), dtype=np.int32)
-        padded[: self.total_len] = counts
-        self._counts = jax.device_put(
-            jnp.asarray(padded), NamedSharding(self.mesh, P(ALL, None)))
-
-    # -- vote -------------------------------------------------------------
-    def vote(self, t_luts: np.ndarray, min_depth: int
-             ) -> Tuple[np.ndarray, np.ndarray]:
-        """Position-sharded vote; returns host (syms [T, total_len], cov)."""
-        from ..ops.vote import vote_block
-
-        @partial(shard_map, mesh=self.mesh,
-                 in_specs=(P(ALL, None), P(None, None)),
-                 out_specs=(P(None, ALL), P(ALL)))
-        def voted(counts_blk, luts):
-            return vote_block(counts_blk, luts, min_depth)
-
-        syms, cov = jax.jit(voted)(self._counts, jnp.asarray(t_luts))
-        return (np.asarray(syms)[:, : self.total_len],
-                np.asarray(cov, dtype=np.int64)[: self.total_len])

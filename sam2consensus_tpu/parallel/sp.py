@@ -1,0 +1,154 @@
+"""Long-context mode: position-sharded accumulation with halo exchange.
+
+The DP pipeline (``parallel/dp.py``) scatters every read shard into a
+FULL-length local count tensor and reduce-scatters — communication-optimal,
+but each device transiently holds O(total_len) memory.  For huge genomes
+(the reference would allocate one Python dict per position and die,
+``/root/reference/sam2consensus.py:167``; SURVEY.md §5 "long-context")
+this module shards the *position axis itself*, the counting-workload
+analogue of sequence/context parallelism:
+
+* each device owns one contiguous position block of ``B = padded_len / n``
+  rows and materializes only ``[B + H, 6]`` locally (H = halo width);
+* the host routes each segment row to the device owning its start
+  position (a counting sort, same shape as the MXU pileup's tile plan);
+  rows wider than the halo are split into halo-width pieces first
+  (segment rows are position-contiguous, so splitting is exact);
+* a routed row may overhang its owner's block by up to ``H - 1``
+  positions; the overhang accumulates into the local halo tail, and ONE
+  ``lax.ppermute`` per chunk shifts every halo to the next device, which
+  folds it into its block head.  Addition commutes, so the result is
+  exactly the unsharded pileup (pinned by tests/test_parallel_sp.py);
+* the vote then runs on the resident position-sharded blocks with zero
+  extra communication (``ShardedCountsBase.vote``).
+
+Memory per device: O(total_len / n + H).  Communication per chunk: one
+neighbor-shift of ``[H, 6]`` int32 over ICI — independent of genome and
+chunk size.  The same code rides DCN on multi-host meshes (the mesh
+abstraction covers both fabrics; SURVEY.md §5 "distributed backend").
+
+Known trade-off: routing is dense SPMD — every chunk ships
+``n * max_rows_per_device`` row slots, so a coordinate-sorted SAM whose
+chunk lands entirely on one device pays ~n× the minimal transfer bytes
+for that chunk.  Correctness is unaffected (PAD rows count nothing); a
+position-windowed host re-chunking pass can remove the blowup later.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import NUM_SYMBOLS, PAD_CODE
+from ..encoder.events import SegmentBatch
+from ..ops.pileup import expand_segment_positions, iter_row_slices
+from .base import ALL, ShardedCountsBase, block_for, shard_map
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["PositionShardedConsensus", "block_for"]
+
+
+class PositionShardedConsensus(ShardedCountsBase):
+    """Streaming position-sharded accumulate + vote over a device mesh.
+
+    Same surface as ``parallel.dp.ShardedConsensus`` so the backend can
+    pick either by genome size.
+    """
+
+    def __init__(self, mesh, total_len: int, halo: int = 1 << 16):
+        super().__init__(mesh, total_len)
+        self.halo = halo
+        if self.block < halo:
+            raise ValueError(
+                f"position block {self.block} smaller than halo {halo}: "
+                "use the DP pipeline for genomes this small")
+
+        block = self.block
+        n = self.n
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(ALL, None), P(ALL), P(ALL, None)),
+                 out_specs=P(ALL, None))
+        def accumulate(counts_blk, starts, codes):
+            # device index along the flattened ("dp","sp") axes
+            di = jax.lax.axis_index(ALL)
+            # one slot PAST the halo is the PAD-cell sacrifice: it must
+            # live outside [0, block + halo) or pad garbage would ride
+            # the halo shift into the next device's real positions
+            local = jnp.zeros((block + halo + 1, NUM_SYMBOLS),
+                              dtype=jnp.int32)
+            pos, code = expand_segment_positions(
+                starts - di * block, codes, block + halo)
+            local = local.at[pos, code].add(1)
+            # one neighbor shift moves every halo to its owner; the last
+            # device's halo covers pad positions only (valid cells never
+            # pass padded_len), so the non-wrapping drop is exact
+            shifted = jax.lax.ppermute(
+                local[block:block + halo], ALL,
+                perm=[(i, i + 1) for i in range(n - 1)])
+            out = counts_blk + local[:block]
+            return out.at[:halo].add(shifted)
+
+        self._accumulate = jax.jit(accumulate, donate_argnums=0)
+
+    # -- streaming input --------------------------------------------------
+    def add(self, batch: SegmentBatch) -> None:
+        for w, (starts, codes) in sorted(batch.buckets.items()):
+            starts = np.asarray(starts)
+            codes = np.asarray(codes)
+            if w > self.halo:
+                # split wide rows into halo-width pieces: segment rows are
+                # position-contiguous, so the split is exact.  Trailing
+                # all-PAD pieces may nominally start past the genome;
+                # clamp them (their cells are PAD and never count)
+                k = -(-w // self.halo)
+                wp = k * self.halo
+                if wp != w:
+                    codes = np.concatenate(
+                        [codes, np.full((len(codes), wp - w), PAD_CODE,
+                                        dtype=np.uint8)], axis=1)
+                starts = (starts[:, None]
+                          + (np.arange(k) * self.halo)[None, :]).reshape(-1)
+                starts = np.minimum(starts, self.padded_len - 1)
+                starts = starts.astype(np.int32)
+                codes = codes.reshape(-1, self.halo)
+                w = self.halo
+
+            # route rows to the device owning their start position; PAD
+            # rows (all-PAD codes, start 0) follow start 0 to device 0
+            # where expand() redirects their cells to the sacrificial slot
+            dev = starts // self.block
+            order = np.argsort(dev, kind="stable")
+            dev_sorted = dev[order]
+            per_dev = np.bincount(dev_sorted, minlength=self.n)
+            r = 1 << max(3, int(per_dev.max(initial=1) - 1).bit_length())
+
+            s_routed = np.zeros((self.n, r), dtype=np.int32)
+            c_routed = np.full((self.n, r, w), PAD_CODE, dtype=np.uint8)
+            hi = np.cumsum(per_dev)
+            flat = (dev_sorted * r
+                    + (np.arange(len(starts)) - (hi - per_dev)[dev_sorted]))
+            s_routed.reshape(-1)[flat] = starts[order]
+            c_routed.reshape(-1, w)[flat] = codes[order]
+            # pad-row starts must stay on their assigned device's block so
+            # local offsets stay in range; PAD cells never count anyway
+            pad_mask = np.ones(self.n * r, dtype=bool)
+            pad_mask[flat] = False
+            pad_dev = np.repeat(np.arange(self.n), r)
+            s_routed.reshape(-1)[pad_mask] = (
+                pad_dev[pad_mask] * self.block).astype(np.int32)
+
+            # cap expanded cells per device call (same budget discipline
+            # as the unsharded and dp paths, ops.pileup.iter_row_slices)
+            for lo, hi_r in iter_row_slices(r, w):
+                self._counts = self._accumulate(
+                    self._counts,
+                    jax.device_put(
+                        s_routed[:, lo:hi_r].reshape(-1).copy(),
+                        self._row_spec),
+                    jax.device_put(
+                        c_routed[:, lo:hi_r].reshape(-1, w).copy(),
+                        self._mat_spec))

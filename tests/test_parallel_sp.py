@@ -1,0 +1,166 @@
+"""Position-sharded (long-context) accumulation vs the unsharded oracle.
+
+``parallel.sp.PositionShardedConsensus`` must produce exactly the
+unsharded counts for any read set — including rows that overhang device
+block boundaries (the ppermute halo path), rows at the very edges of the
+genome, PAD rows, and streaming over multiple chunks.  Runs on the 8
+virtual CPU devices from tests/conftest.py (SURVEY.md §4 "multi-device
+without a cluster").
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from sam2consensus_tpu.encoder.events import SegmentBatch  # noqa: E402
+from sam2consensus_tpu.ops.pileup import PileupAccumulator  # noqa: E402
+from sam2consensus_tpu.ops.vote import threshold_luts  # noqa: E402
+from sam2consensus_tpu.parallel.mesh import make_mesh  # noqa: E402
+from sam2consensus_tpu.parallel.sp import PositionShardedConsensus  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+
+def _batch(starts, codes):
+    return SegmentBatch(buckets={codes.shape[1]: (starts, codes)},
+                        n_reads=len(starts),
+                        n_events=int((codes < 6).sum()))
+
+
+def _ref_counts(total_len, starts, codes):
+    acc = PileupAccumulator(total_len, strategy="scatter")
+    acc.add(_batch(starts, codes))
+    return acc.counts_host()
+
+
+def test_sp_equals_unsharded_random():
+    rng = np.random.default_rng(0)
+    total_len = 9000
+    w = 64
+    starts = rng.integers(0, total_len - w, 700).astype(np.int32)
+    codes = rng.integers(0, 6, (700, w)).astype(np.uint8)
+    codes[rng.random(codes.shape) < 0.2] = 255
+
+    sp = PositionShardedConsensus(make_mesh(8), total_len, halo=128)
+    sp.add(_batch(starts, codes))
+    assert np.array_equal(sp.counts_host(),
+                          _ref_counts(total_len, starts, codes))
+
+
+def test_sp_halo_boundary_rows():
+    """Rows starting exactly at / just before block boundaries."""
+    total_len = 8 * 1024 - 1
+    w = 32
+    sp = PositionShardedConsensus(make_mesh(8), total_len, halo=64)
+    block = sp.block
+    edge_starts = []
+    for d in range(7):
+        edge_starts += [d * block + block - 1,       # full overhang
+                        d * block + block - w // 2,  # partial overhang
+                        d * block]                   # block start
+    edge_starts.append(total_len - w)                # genome end
+    starts = np.asarray(edge_starts, dtype=np.int32)
+    codes = np.tile(np.arange(w) % 6, (len(starts), 1)).astype(np.uint8)
+
+    sp.add(_batch(starts, codes))
+    assert np.array_equal(sp.counts_host(),
+                          _ref_counts(total_len, starts, codes))
+
+
+def test_sp_streaming_chunks_accumulate():
+    rng = np.random.default_rng(5)
+    total_len = 4096
+    w = 32
+    sp = PositionShardedConsensus(make_mesh(8), total_len, halo=w)
+    all_s, all_c = [], []
+    for chunk in range(3):
+        starts = rng.integers(0, total_len - w, 100).astype(np.int32)
+        codes = rng.integers(0, 6, (100, w)).astype(np.uint8)
+        sp.add(_batch(starts, codes))
+        all_s.append(starts)
+        all_c.append(codes)
+    ref = _ref_counts(total_len, np.concatenate(all_s),
+                      np.concatenate(all_c))
+    assert np.array_equal(sp.counts_host(), ref)
+
+
+def test_sp_vote_matches_dp_vote():
+    from sam2consensus_tpu.parallel.dp import ShardedConsensus
+
+    rng = np.random.default_rng(9)
+    total_len = 6000
+    w = 64
+    starts = rng.integers(0, total_len - w, 400).astype(np.int32)
+    codes = rng.integers(0, 6, (400, w)).astype(np.uint8)
+
+    sp = PositionShardedConsensus(make_mesh(8), total_len, halo=w)
+    sp.add(_batch(starts, codes))
+    dp = ShardedConsensus(make_mesh(8), total_len)
+    dp.add(_batch(starts, codes))
+    assert np.array_equal(sp.counts_host(), dp.counts_host())
+
+    luts = threshold_luts([0.25, 0.75],
+                          int(sp.counts_host().sum(axis=1).max()))
+    syms_sp, cov_sp = sp.vote(luts, 1)
+    syms_dp, cov_dp = dp.vote(luts, 1)
+    assert np.array_equal(syms_sp, syms_dp)
+    assert np.array_equal(cov_sp, cov_dp)
+
+
+def test_sp_restore_roundtrip():
+    total_len = 4096
+    sp = PositionShardedConsensus(make_mesh(8), total_len, halo=32)
+    rng = np.random.default_rng(2)
+    counts = rng.integers(0, 50, (total_len, 6)).astype(np.int32)
+    sp.restore(counts)
+    assert np.array_equal(sp.counts_host(), counts)
+
+
+def test_sp_rejects_tiny_blocks():
+    with pytest.raises(ValueError, match="smaller than halo"):
+        PositionShardedConsensus(make_mesh(8), 100, halo=1 << 16)
+
+
+@pytest.mark.parametrize("shards", [2, 8])
+def test_sp_backend_byte_identical(shards):
+    """Full backend with --shard-mode sp == CPU oracle, byte for byte."""
+    import io
+
+    from sam2consensus_tpu.backends.cpu import CpuBackend
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.io.fasta import render_file
+    from sam2consensus_tpu.io.sam import iter_records, read_header
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+    text = simulate(SimSpec(n_contigs=2, contig_len=64 * shards,
+                            n_reads=60 * shards, read_len=16,
+                            ins_read_rate=0.2, max_indel=3, seed=21))
+
+    def run(backend, cfg):
+        handle = io.StringIO(text)
+        contigs, _n, first = read_header(handle)
+        res = backend.run(contigs, iter_records(handle, first), cfg)
+        return {n: render_file(r, 0) for n, r in res.fastas.items()}
+
+    out_cpu = run(CpuBackend(), RunConfig(prefix="p", thresholds=[0.25, 0.75]))
+    out_sp = run(JaxBackend(), RunConfig(prefix="p", thresholds=[0.25, 0.75],
+                                         backend="jax", shards=shards,
+                                         shard_mode="sp"))
+    assert out_sp == out_cpu
+
+
+def test_sp_splits_rows_wider_than_halo():
+    """Width-256 rows against a small halo: exact via piece splitting."""
+    rng = np.random.default_rng(17)
+    total_len = 4096
+    w = 256
+    starts = rng.integers(0, total_len - w, 150).astype(np.int32)
+    codes = rng.integers(0, 6, (150, w)).astype(np.uint8)
+    codes[rng.random(codes.shape) < 0.2] = 255
+    sp = PositionShardedConsensus(make_mesh(8), total_len, halo=64)
+    sp.add(_batch(starts, codes))
+    assert np.array_equal(sp.counts_host(),
+                          _ref_counts(total_len, starts, codes))
