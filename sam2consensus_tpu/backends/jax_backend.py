@@ -95,6 +95,13 @@ class JaxBackend:
         # checkpoint resume: counts + insertion log + consumed-line offset
         # are the entire job state (SURVEY.md §5)
         ck = None
+        skip_input = False
+        incremental = getattr(cfg, "incremental", False)
+        source_id = getattr(cfg, "source_id", "")
+        if incremental and not source_id:
+            raise RuntimeError(
+                "incremental mode needs a non-empty source_id identifying "
+                "the input (the CLI passes the input file's absolute path)")
         if cfg.checkpoint_dir:
             from ..utils import checkpoint as ckpt
 
@@ -103,7 +110,23 @@ class JaxBackend:
                     "--checkpoint-dir requires a file-backed input stream")
             ck = ckpt.load(cfg.checkpoint_dir, layout.total_len)
             if ck is not None:
-                records.skip_lines(ck.lines_consumed)
+                # three incremental cases (SURVEY.md §5 "incremental
+                # updates"), resolved by the checkpoint's source identity:
+                # * listed in ck.sources -> this input is already fully
+                #   absorbed: add nothing (idempotent re-run);
+                # * ck.source (in-flight) -> crashed mid-input: resume by
+                #   skipping its consumed lines;
+                # * otherwise -> NEW shard on the accumulated base: start
+                #   from line 0.
+                # Without --incremental the checkpoint always refers to
+                # the current input: plain resume.
+                if incremental and source_id in (ck.sources or []):
+                    skip_input = True
+                    stats.extra["incremental_duplicate"] = source_id
+                elif not incremental or source_id == ck.source:
+                    records.skip_lines(ck.lines_consumed)
+                else:
+                    stats.extra["incremental_base"] = list(ck.sources or [])
                 if use_sharded:
                     acc.restore(ck.counts)
                 else:
@@ -139,7 +162,7 @@ class JaxBackend:
         if getattr(acc, "strategy_used", None):
             stats.extra["pileup"] = dict(acc.strategy_used)
         stats.extra["accumulate_sec"] = round(time.perf_counter() - t0, 4)
-        if ck is not None:
+        if ck is not None and "incremental_base" not in stats.extra:
             stats.extra["resumed_from_line"] = ck.lines_consumed
 
         # Post-accumulation tail in exactly two device round trips (each
@@ -258,14 +281,21 @@ class JaxBackend:
                                 cfg, stats)
         stats.extra["render_sec"] = round(time.perf_counter() - t0, 4)
 
-        # a completed run invalidates its checkpoint: remove it so a rerun
-        # starts from scratch instead of replaying a finished job
         if cfg.checkpoint_dir:
             from ..utils import checkpoint as ckpt
 
-            p = ckpt.path_for(cfg.checkpoint_dir)
-            if os.path.exists(p):
-                os.unlink(p)
+            if getattr(cfg, "incremental", False):
+                # incremental: the checkpoint IS the accumulated base for
+                # the next shard — persist the final state (idempotent: a
+                # rerun of the same input skips all its lines)
+                self._write_checkpoint(cfg, records, acc, encoder, stats,
+                                       base_mapped, base_skipped)
+            else:
+                # a completed run invalidates its checkpoint: remove it so
+                # a rerun starts from scratch, not replaying a finished job
+                p = ckpt.path_for(cfg.checkpoint_dir)
+                if os.path.exists(p):
+                    os.unlink(p)
         return BackendResult(fastas=fastas, stats=stats)
 
     # -- checkpointing -----------------------------------------------------
@@ -279,7 +309,8 @@ class JaxBackend:
             reads_mapped=base_mapped + encoder.n_reads,
             reads_skipped=base_skipped + encoder.n_skipped,
             aligned_bases=stats.aligned_bases,
-            insertions=encoder.insertions))
+            insertions=encoder.insertions,
+            source=getattr(cfg, "source_id", "")))
         stats.extra["checkpoints_written"] = (
             stats.extra.get("checkpoints_written", 0) + 1)
 

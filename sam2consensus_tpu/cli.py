@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 import time
 from typing import List, Optional
@@ -70,6 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", dest="checkpoint_every", type=int,
                    default=2_000_000,
                    help="reads between checkpoint writes; default=2000000")
+    p.add_argument("--incremental", action="store_true",
+                   help="treat the checkpoint as an accumulated base: a new "
+                        "input file ADDS its reads on top (and the final "
+                        "state is persisted for the next shard) instead of "
+                        "resuming the same file; requires --checkpoint-dir")
     p.add_argument("--paranoid", action="store_true",
                    help="re-validate device inputs and outputs every batch "
                         "(index bounds, symbol codes, count invariants)")
@@ -148,6 +154,8 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         paranoid=args.paranoid,
+        incremental=args.incremental,
+        source_id=os.path.abspath(args.filename),
         shards=args.shards,
         shard_mode=args.shard_mode,
     )
@@ -185,6 +193,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "table build")
     if cfg.checkpoint_dir and cfg.backend != "jax":
         raise SystemExit("--checkpoint-dir requires --backend jax")
+    if cfg.incremental and not cfg.checkpoint_dir:
+        raise SystemExit("--incremental requires --checkpoint-dir")
 
     t0 = time.perf_counter()
     echo("\nProcessing file " + args.filename + ":\n")

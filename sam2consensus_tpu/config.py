@@ -38,6 +38,8 @@ class RunConfig:
     pileup: str = "auto"         # auto | mxu | scatter (device pileup strategy)
     ins_kernel: str = "scatter"  # scatter | pallas (insertion table build)
     shard_mode: str = "auto"     # auto | dp | sp (sharded accumulator layout)
+    incremental: bool = False    # keep/extend checkpoints across input files
+    source_id: str = ""          # identity of the input (for incremental)
     chunk_reads: int = 262144    # reads per host->device batch (jax backend)
     profile_dir: Optional[str] = None
     json_metrics: Optional[str] = None

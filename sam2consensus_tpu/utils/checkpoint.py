@@ -36,6 +36,13 @@ class CheckpointState:
     reads_skipped: int
     aligned_bases: int
     insertions: InsertionEvents
+    #: identity of the in-flight input the line offset refers to; an
+    #: --incremental run whose input differs treats the checkpoint as an
+    #: accumulated base and starts the new file from line 0
+    source: str = ""
+    #: identities of inputs FULLY absorbed into counts; an --incremental
+    #: run whose input is listed here is a duplicate and adds nothing
+    sources: list = None
 
 
 def path_for(checkpoint_dir: str) -> str:
@@ -57,7 +64,12 @@ def save(checkpoint_dir: str, state: CheckpointState) -> None:
                 ins_contig=ic.astype(np.int32),
                 ins_local=il.astype(np.int32),
                 ins_mlen=im.astype(np.int32),
-                ins_chars=ich.astype(np.uint8))
+                ins_chars=ich.astype(np.uint8),
+                source=np.frombuffer(state.source.encode("utf-8"),
+                                     dtype=np.uint8),
+                sources=np.frombuffer(
+                    "\n".join(state.sources or []).encode("utf-8"),
+                    dtype=np.uint8))
         os.replace(tmp, path_for(checkpoint_dir))
     finally:
         if os.path.exists(tmp):
@@ -81,7 +93,13 @@ def load(checkpoint_dir: str, total_len: int) -> Optional[CheckpointState]:
             ins.array_chunks.append(
                 (z["ins_contig"], z["ins_local"], z["ins_mlen"],
                  z["ins_chars"]))
+        source = bytes(z["source"]).decode("utf-8") \
+            if "source" in z.files else ""
+        blob = bytes(z["sources"]).decode("utf-8") \
+            if "sources" in z.files else ""
+        sources = [s for s in blob.split("\n") if s]
         return CheckpointState(
             counts=counts, lines_consumed=int(meta[0]),
             reads_mapped=int(meta[1]), reads_skipped=int(meta[2]),
-            aligned_bases=int(meta[3]), insertions=ins)
+            aligned_bases=int(meta[3]), insertions=ins, source=source,
+            sources=sources)
