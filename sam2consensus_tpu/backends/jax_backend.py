@@ -96,6 +96,7 @@ class JaxBackend:
         # are the entire job state (SURVEY.md §5)
         ck = None
         skip_input = False
+        prior_sources: List[str] = []
         incremental = getattr(cfg, "incremental", False)
         source_id = getattr(cfg, "source_id", "")
         if incremental and not source_id:
@@ -120,13 +121,29 @@ class JaxBackend:
                 #   from line 0.
                 # Without --incremental the checkpoint always refers to
                 # the current input: plain resume.
-                if incremental and source_id in (ck.sources or []):
+                prior_sources = list(ck.sources or [])
+                if incremental and source_id != ck.source \
+                        and ck.lines_consumed > 0 and ck.source \
+                        and ck.source not in prior_sources:
+                    # the checkpoint holds a PARTIAL prefix of a crashed
+                    # shard; any run other than resuming that shard (a new
+                    # shard, or a no-op duplicate whose final write would
+                    # reset source/lines_consumed) would bake the prefix in
+                    # untracked, and a later rerun of the crashed shard
+                    # would then double-count it
+                    raise RuntimeError(
+                        f"checkpoint contains a partially absorbed input "
+                        f"{ck.source!r} (crashed mid-shard); rerun that "
+                        f"input to completion before adding "
+                        f"{source_id!r}, or delete the checkpoint")
+                if incremental and source_id in prior_sources:
                     skip_input = True
                     stats.extra["incremental_duplicate"] = source_id
                 elif not incremental or source_id == ck.source:
-                    records.skip_lines(ck.lines_consumed)
+                    stats.extra["resume_mode"] = records.skip_to(
+                        ck.byte_offset, ck.lines_consumed)
                 else:
-                    stats.extra["incremental_base"] = list(ck.sources or [])
+                    stats.extra["incremental_base"] = prior_sources
                 if use_sharded:
                     acc.restore(ck.counts)
                 else:
@@ -138,6 +155,10 @@ class JaxBackend:
         # host decode: native C++ text path when a ReadStream is available
         # (SURVEY.md §2b native component), python record path otherwise
         encoder, batches = self._make_encoder(layout, records, cfg)
+        if skip_input:
+            # already-absorbed shard: decode nothing (its contribution is in
+            # the checkpointed counts; re-reading it would double-count)
+            batches = iter(())
         if ck is not None:
             encoder.insertions.array_chunks.extend(ck.insertions.array_chunks)
         stats.aligned_bases = base_aligned
@@ -153,7 +174,8 @@ class JaxBackend:
                     and encoder.n_reads - reads_at_ckpt
                     >= cfg.checkpoint_every):
                 self._write_checkpoint(cfg, records, acc, encoder, stats,
-                                       base_mapped, base_skipped)
+                                       base_mapped, base_skipped,
+                                       prior_sources)
                 reads_at_ckpt = encoder.n_reads
         stats.reads_mapped = base_mapped + encoder.n_reads
         stats.reads_skipped = base_skipped + encoder.n_skipped
@@ -286,10 +308,14 @@ class JaxBackend:
 
             if getattr(cfg, "incremental", False):
                 # incremental: the checkpoint IS the accumulated base for
-                # the next shard — persist the final state (idempotent: a
-                # rerun of the same input skips all its lines)
+                # the next shard — persist the final state, and record this
+                # input as FULLY absorbed so a later rerun of it (even with
+                # other shards in between) adds nothing
+                done = list(prior_sources)
+                if source_id and source_id not in done:
+                    done.append(source_id)
                 self._write_checkpoint(cfg, records, acc, encoder, stats,
-                                       base_mapped, base_skipped)
+                                       base_mapped, base_skipped, done)
             else:
                 # a completed run invalidates its checkpoint: remove it so
                 # a rerun starts from scratch, not replaying a finished job
@@ -300,7 +326,7 @@ class JaxBackend:
 
     # -- checkpointing -----------------------------------------------------
     def _write_checkpoint(self, cfg, stream, acc, encoder, stats,
-                          base_mapped, base_skipped) -> None:
+                          base_mapped, base_skipped, sources) -> None:
         from ..utils import checkpoint as ckpt
 
         ckpt.save(cfg.checkpoint_dir, ckpt.CheckpointState(
@@ -310,7 +336,9 @@ class JaxBackend:
             reads_skipped=base_skipped + encoder.n_skipped,
             aligned_bases=stats.aligned_bases,
             insertions=encoder.insertions,
-            source=getattr(cfg, "source_id", "")))
+            source=getattr(cfg, "source_id", ""),
+            sources=list(sources),
+            byte_offset=stream.byte_offset()))
         stats.extra["checkpoints_written"] = (
             stats.extra.get("checkpoints_written", 0) + 1)
 
@@ -358,7 +386,7 @@ class JaxBackend:
             if native_encoder.available():
                 enc = native_encoder.NativeReadEncoder(
                     layout, maxdel=cfg.maxdel, strict=cfg.strict,
-                    on_lines=records.add_lines)
+                    on_lines=records.add_lines, on_bytes=records.add_bytes)
                 return enc, enc.encode_blocks(records.blocks())
             if cfg.decoder == "native":
                 from .. import native

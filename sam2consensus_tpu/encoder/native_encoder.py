@@ -54,7 +54,7 @@ class NativeReadEncoder:
 
     def __init__(self, layout: GenomeLayout, maxdel: Optional[int] = 150,
                  strict: bool = True, width: int = 256,
-                 on_lines=None):
+                 on_lines=None, on_bytes=None):
         lib = native.load()
         if lib is None:  # pragma: no cover - callers check available()
             raise RuntimeError(f"native decoder unavailable: "
@@ -65,6 +65,7 @@ class NativeReadEncoder:
         self.strict = strict
         self.width = width
         self.on_lines = on_lines
+        self.on_bytes = on_bytes
         # python twin for overflow/error-replay fallback; shares counters
         # and the insertion store so fallback reads land in the same place
         self._py = ReadEncoder(layout, maxdel=maxdel, strict=strict)
@@ -170,6 +171,7 @@ class NativeReadEncoder:
                                      _bucket_width(int(_max_span)))
 
                 offset += int(consumed)
+                self._count_bytes(int(consumed))
                 if status == 2:
                     # flagged line: python replay for identical errors; if
                     # the replay succeeds instead (python being more lenient
@@ -177,6 +179,7 @@ class NativeReadEncoder:
                     line_end = _line_end(data, offset)
                     self._fallback_line(data, offset, line_end=line_end)
                     self._count_lines(1)
+                    self._count_bytes(min(line_end + 1, len(data)) - offset)
                     offset = line_end + 1
                 elif status == 1:
                     if len(self._starts) - self._fill < 2:
@@ -217,6 +220,10 @@ class NativeReadEncoder:
     def _count_lines(self, k: int) -> None:
         if self.on_lines is not None and k:
             self.on_lines(k)
+
+    def _count_bytes(self, k: int) -> None:
+        if self.on_bytes is not None and k > 0:
+            self.on_bytes(k)
 
     def _fallback_line(self, data: np.ndarray, start: int,
                        line_end: Optional[int] = None) -> None:

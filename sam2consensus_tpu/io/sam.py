@@ -137,6 +137,44 @@ class ReadStream:
         self.first = first_line
         self.on_lines = on_lines
         self.n_lines = 0
+        #: bytes of body content consumed so far (consumers report via
+        #: add_bytes / the counted iterators below; ascii input makes
+        #: str-length == byte-length on text handles)
+        self.n_bytes = 0
+        # absolute offset of the body start, when the handle can report it.
+        # Binary handles (incl. GzipFile, in uncompressed offsets) keep
+        # tell() accurate through read_header's line iteration; a
+        # TextIOWrapper raises here ("telling position disabled") and the
+        # stream falls back to line-skipping resume.
+        try:
+            self._body_start = self.handle.tell() - len(first_line)
+        except (AttributeError, OSError, ValueError):
+            self._body_start = None
+
+    def byte_offset(self) -> int:
+        """Absolute input offset matching ``n_lines``; -1 if unknown."""
+        if self._body_start is None:
+            return -1
+        return self._body_start + self.n_bytes
+
+    def skip_to(self, byte_offset: int, k: int) -> str:
+        """Position after ``k`` body lines: seek straight to the recorded
+        byte offset when both sides can (O(1) resume), else re-read and
+        discard ``k`` lines.  Returns the mode used ("seek" or "lines")."""
+        if k <= 0:
+            return "none"
+        if byte_offset >= 0 and self._body_start is not None:
+            try:
+                self.handle.seek(byte_offset)
+            except (AttributeError, OSError, ValueError):
+                pass
+            else:
+                self.first = ""
+                self.n_lines = k
+                self.n_bytes = byte_offset - self._body_start
+                return "seek"
+        self.skip_lines(k)
+        return "lines"
 
     def skip_lines(self, k: int) -> None:
         """Skip ``k`` body lines (checkpoint resume); they still count."""
@@ -144,10 +182,11 @@ class ReadStream:
             return
         n = k
         if self.first:
+            self.n_bytes += len(self.first)
             self.first = ""
             n -= 1
         for _ in range(n):
-            self.handle.readline()
+            self.n_bytes += len(self.handle.readline())
         self.n_lines = k
 
     def add_lines(self, k: int) -> None:
@@ -156,11 +195,16 @@ class ReadStream:
             if self.on_lines is not None:
                 self.on_lines(self.n_lines)
 
+    def add_bytes(self, k: int) -> None:
+        if k:
+            self.n_bytes += k
+
     def records(self) -> Iterator[SamRecord]:
         """Parsed mapped records, counting every body line."""
         def counted() -> Iterator[str]:
             for line in self.handle:
                 self.add_lines(1)
+                self.add_bytes(len(line))
                 yield line.decode("ascii") if isinstance(line, bytes) \
                     else line
 
@@ -169,6 +213,7 @@ class ReadStream:
             first = first.decode("ascii")
         if first:
             self.add_lines(1)
+            self.add_bytes(len(first))
         yield from iter_records(counted(), first)
 
     def blocks(self, max_bytes: int = 1 << 23):

@@ -36,7 +36,10 @@ def _cpu_fingerprint() -> bytes:
     try:
         with open("/proc/cpuinfo", "r") as fh:
             for line in fh:
-                if line.startswith("flags"):
+                # x86 spells the ISA-extension line "flags"; ARM spells it
+                # "Features" — either one identifies what -march=native
+                # actually compiled for
+                if line.startswith(("flags", "Features")):
                     return line.encode()
     except OSError:
         pass

@@ -43,6 +43,11 @@ class CheckpointState:
     #: identities of inputs FULLY absorbed into counts; an --incremental
     #: run whose input is listed here is a duplicate and adds nothing
     sources: list = None
+    #: absolute byte offset in the (uncompressed) input matching
+    #: lines_consumed; resume seeks here in O(1) instead of re-reading
+    #: the consumed lines.  -1 = unknown (non-seekable stream): resume
+    #: falls back to the line-skipping loop.
+    byte_offset: int = -1
 
 
 def path_for(checkpoint_dir: str) -> str:
@@ -59,7 +64,8 @@ def save(checkpoint_dir: str, state: CheckpointState) -> None:
                 fh,
                 counts=state.counts.astype(np.int32),
                 meta=np.array([state.lines_consumed, state.reads_mapped,
-                               state.reads_skipped, state.aligned_bases],
+                               state.reads_skipped, state.aligned_bases,
+                               state.byte_offset],
                               dtype=np.int64),
                 ins_contig=ic.astype(np.int32),
                 ins_local=il.astype(np.int32),
@@ -102,4 +108,5 @@ def load(checkpoint_dir: str, total_len: int) -> Optional[CheckpointState]:
             counts=counts, lines_consumed=int(meta[0]),
             reads_mapped=int(meta[1]), reads_skipped=int(meta[2]),
             aligned_bases=int(meta[3]), insertions=ins, source=source,
-            sources=sources)
+            sources=sources,
+            byte_offset=int(meta[4]) if len(meta) > 4 else -1)

@@ -60,6 +60,12 @@ class _CrashingHandle:
     def readline(self):
         return self.handle.readline()
 
+    def tell(self):
+        return self.handle.tell()
+
+    def seek(self, pos):
+        return self.handle.seek(pos)
+
 
 def test_roundtrip(tmp_path):
     ins = InsertionEvents()
@@ -108,6 +114,9 @@ def test_crash_resume_byte_identical(tmp_path):
     # phase 2: resume on a fresh stream -> identical to an uninterrupted run
     out_resumed, stats, stream = _run(cfg)
     assert "resumed_from_line" in stats.extra
+    # the checkpoint carried a byte offset, so the resume seeks in O(1)
+    # instead of re-reading the consumed lines
+    assert stats.extra["resume_mode"] == "seek"
     out_fresh, fresh_stats, _s = _run(
         RunConfig(prefix="ck", thresholds=[0.25, 0.75], backend="jax",
                   decoder="py", chunk_reads=64))
@@ -223,3 +232,78 @@ def test_incremental_two_shards_equal_one_run(tmp_path):
     # idempotency: re-adding the SAME shard skips all its lines
     out_again = run(JaxBackend(), text_b, cfg_b)
     assert out_again == out_one
+
+
+def test_incremental_rerun_of_older_shard_adds_nothing(tmp_path):
+    """A, B, then A again: the non-latest shard is found in the
+    checkpoint's absorbed-sources list and its reads are NOT re-added
+    (the round-1 double-count hole)."""
+    import io
+
+    from sam2consensus_tpu.backends.cpu import CpuBackend
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+    from sam2consensus_tpu.io.fasta import render_file
+    from sam2consensus_tpu.io.sam import ReadStream, read_header
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+    combined = simulate(SimSpec(n_contigs=3, contig_len=180, n_reads=500,
+                                read_len=40, ins_read_rate=0.2, max_indel=3,
+                                seed=72))
+    lines = combined.splitlines(keepends=True)
+    header = [ln for ln in lines if ln.startswith("@")]
+    body = [ln for ln in lines if not ln.startswith("@")]
+    text_a = "".join(header + body[:250])
+    text_b = "".join(header + body[250:])
+
+    def run(backend, text, cfg):
+        handle = io.StringIO(text)
+        contigs, _n, first = read_header(handle)
+        res = backend.run(contigs, ReadStream(handle, first), cfg)
+        return ({n: render_file(r, 0) for n, r in res.fastas.items()},
+                res.stats)
+
+    ck = str(tmp_path / "ck")
+    cfg_a = RunConfig(prefix="p", thresholds=[0.25, 0.75],
+                      checkpoint_dir=ck, incremental=True, source_id="a")
+    cfg_b = RunConfig(prefix="p", thresholds=[0.25, 0.75],
+                      checkpoint_dir=ck, incremental=True, source_id="b")
+    run(JaxBackend(), text_a, cfg_a)
+    out_ab, _st = run(JaxBackend(), text_b, cfg_b)
+    out_one, _st1 = run(CpuBackend(), combined,
+                        RunConfig(prefix="p", thresholds=[0.25, 0.75]))
+    assert out_ab == out_one
+
+    out_dup, stats = run(JaxBackend(), text_a, cfg_a)  # A again, after B
+    assert stats.extra.get("incremental_duplicate") == "a"
+    assert out_dup == out_one
+
+    # and the state on disk is still the clean A+B base afterwards
+    out_b_again, _st2 = run(JaxBackend(), text_b, cfg_b)
+    assert out_b_again == out_one
+
+
+def test_incremental_rejects_stacking_on_crashed_shard(tmp_path):
+    """A completes; B crashes mid-shard; adding C must be refused — the
+    checkpoint holds B's untracked partial prefix, and stacking C on top
+    would let a later rerun of B double-count that prefix."""
+    ck = str(tmp_path / "ck")
+
+    def cfg(src):
+        return RunConfig(prefix="p", thresholds=[0.25], backend="jax",
+                         decoder="py", chunk_reads=64, checkpoint_dir=ck,
+                         checkpoint_every=64, incremental=True,
+                         source_id=src)
+
+    _out, _st, _s = _run(cfg("a"))                       # A completes
+    with pytest.raises(RuntimeError, match="injected crash"):
+        _run(cfg("b"), handle_wrapper=lambda h: _CrashingHandle(h, 400))
+    with pytest.raises(RuntimeError, match="partially absorbed"):
+        _run(cfg("c"))                                   # refuse stacking C
+    with pytest.raises(RuntimeError, match="partially absorbed"):
+        _run(cfg("a"))  # refuse even a no-op duplicate: its final write
+        #               # would reset source/lines and launder B's prefix
+    # finishing B unblocks: resume B, then C adds cleanly
+    _out_b, st_b, _s2 = _run(cfg("b"))
+    assert "resumed_from_line" in st_b.extra
+    _out_c, st_c, _s3 = _run(cfg("c"))
+    assert sorted(st_c.extra["incremental_base"]) == ["a", "b"]
