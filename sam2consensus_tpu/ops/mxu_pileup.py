@@ -61,30 +61,49 @@ class TilePlan(NamedTuple):
     blowup: float          # padded rows / real rows
 
 
-def plan_tiles(starts: np.ndarray, codes: np.ndarray, padded_len: int,
-               tile: int = TILE_POSITIONS,
-               max_blowup: float = MAX_BLOWUP) -> Optional[TilePlan]:
-    """Counting-sort rows by position tile.
+def _plan_prelude(starts: np.ndarray, padded_len: int, tile: int,
+                  max_blowup: float, rows_per_tile: Optional[int]):
+    """Shared planning prelude: tile histogram, E selection, blowup gate.
 
-    Returns ``None`` when there are no rows OR when per-tile padding would
-    inflate the row count beyond ``max_blowup`` (skewed coverage) — checked
-    BEFORE the padded arrays are allocated, since a pathological slab (all
-    rows on one tile of a large genome) would otherwise ask for
-    ``n_tiles * max_per_tile`` rows of host memory just to be discarded.
+    Returns ``(n_tiles, tile_of, per_tile, e, blowup)`` or ``None`` when
+    there are no rows OR when per-tile padding would inflate the row count
+    beyond ``max_blowup`` (skewed coverage) — checked BEFORE any padded
+    array is allocated.  ``rows_per_tile`` forces E instead of deriving it
+    from this slab's fullest tile — the sharded pipeline plans one chunk
+    per device and SPMD needs a uniform shape across them (parallel/dp.py).
     """
     n = len(starts)
     if n == 0:
         return None
-    width = codes.shape[1]
     n_tiles = max(1, -(-padded_len // tile))
     tile_of = starts // tile
     per_tile = np.bincount(tile_of, minlength=n_tiles)
-    # power-of-two rows per tile: keeps the jit cache O(log) across slabs
-    # at the price of ≤2x padding (counted in blowup)
-    e = 1 << max(3, int(per_tile.max() - 1).bit_length())
+    if rows_per_tile is None:
+        # power-of-two rows per tile: keeps the jit cache O(log) across
+        # slabs at the price of ≤2x padding (counted in blowup)
+        e = 1 << max(3, int(per_tile.max() - 1).bit_length())
+    else:
+        e = rows_per_tile
+        if int(per_tile.max(initial=0)) > e:
+            return None
     blowup = n_tiles * e / n
     if blowup > max_blowup:
         return None
+    return n_tiles, tile_of, per_tile, e, blowup
+
+
+def plan_tiles(starts: np.ndarray, codes: np.ndarray, padded_len: int,
+               tile: int = TILE_POSITIONS,
+               max_blowup: float = MAX_BLOWUP,
+               rows_per_tile: Optional[int] = None) -> Optional[TilePlan]:
+    """Counting-sort rows by position tile into host-padded arrays
+    (the padded-transfer layout; see :func:`plan_slots` for production)."""
+    pre = _plan_prelude(starts, padded_len, tile, max_blowup, rows_per_tile)
+    if pre is None:
+        return None
+    n_tiles, tile_of, per_tile, e, blowup = pre
+    n = len(starts)
+    width = codes.shape[1]
 
     order = np.argsort(tile_of, kind="stable")
     s_sorted = starts[order]
@@ -111,20 +130,55 @@ def _skew_fold(t3: jax.Array) -> jax.Array:
     return jnp.concatenate([out, jnp.zeros((1, c), out.dtype)], axis=0)
 
 
-@functools.partial(jax.jit, donate_argnums=0,
-                   static_argnames=("tile", "n_tiles", "rows_per_tile",
-                                    "width"))
-def pileup_mxu(counts: jax.Array, loc_flat: jax.Array, codes_flat: jax.Array,
-               *, tile: int, n_tiles: int, rows_per_tile: int,
-               width: int) -> jax.Array:
-    """Accumulate a TilePlan's rows into ``counts`` ([n_tiles*tile, 6]).
+class SlotPlan(NamedTuple):
+    """Host-side compact plan: one int32 slot per row, nothing padded.
 
-    Flat inputs are reshaped on device: multi-dimensional host->device
-    transfers of non-native shapes are pathologically slow through a
-    tunneled runtime, flat byte streams are not.
+    The padded tile layout is materialized ON DEVICE (a row scatter by
+    ``slot``), so the host->device transfer stays at the scatter path's
+    compact bytes (+4B/row for the slot) instead of shipping up to
+    ``MAX_BLOWUP``x padded rows over the (tunnel-bottlenecked) link —
+    the prime suspect for round 1's end-to-end MXU regression.
     """
-    loc = loc_flat.reshape(n_tiles, rows_per_tile)
-    cod = codes_flat.reshape(n_tiles, rows_per_tile, width)
+    slot: np.ndarray       # [N] int32, unique: tile_of * E + rank-in-tile
+    n_tiles: int
+    rows_per_tile: int     # E
+    width: int
+    blowup: float          # device-side padded rows / real rows
+
+
+def assign_slots(tile_of: np.ndarray, per_tile: np.ndarray,
+                 e: int) -> np.ndarray:
+    """Rank each row within its tile: slot = tile_of * E + rank."""
+    n = len(tile_of)
+    order = np.argsort(tile_of, kind="stable")
+    hi = np.cumsum(per_tile)
+    rank = np.empty(n, dtype=np.int64)
+    rank[order] = np.arange(n) - (hi - per_tile)[tile_of[order]]
+    return (tile_of * e + rank).astype(np.int32)
+
+
+def plan_slots(starts: np.ndarray, width: int, padded_len: int,
+               tile: int = TILE_POSITIONS,
+               max_blowup: float = MAX_BLOWUP,
+               rows_per_tile: Optional[int] = None) -> Optional[SlotPlan]:
+    """Assign each row its padded-layout slot (counting sort, no copies).
+
+    Same fallback contract as :func:`plan_tiles`; ``rows_per_tile`` forces
+    E for SPMD-uniform sharded planning (parallel/dp.py).
+    """
+    pre = _plan_prelude(starts, padded_len, tile, max_blowup, rows_per_tile)
+    if pre is None:
+        return None
+    n_tiles, tile_of, per_tile, e, blowup = pre
+    return SlotPlan(assign_slots(tile_of, per_tile, e),
+                    n_tiles, e, width, blowup)
+
+
+def _accumulate_tiles(counts: jax.Array, loc: jax.Array, cod: jax.Array,
+                      *, tile: int, n_tiles: int, rows_per_tile: int,
+                      width: int) -> jax.Array:
+    """Traceable tile body shared by both transfer layouts:
+    ``loc`` [NT, E] tile-local starts, ``cod`` [NT, E, W] code rows."""
 
     def per_tile(locs, codes):
         d = jax.lax.iota(jnp.int32, tile)[None, :]
@@ -164,3 +218,58 @@ def pileup_mxu(counts: jax.Array, loc_flat: jax.Array, codes_flat: jax.Array,
     pad = pad.at[idx.reshape(-1)].add(
         tiles[:, tile:, :].reshape(-1, NUM_SYMBOLS))
     return counts + main + pad[: n_tiles * tile]
+
+
+def build_padded_layout(starts: jax.Array, codes: jax.Array,
+                        slot: jax.Array, *, tile: int, n_tiles: int,
+                        rows_per_tile: int, width: int):
+    """Traceable device-side padding: compact rows + slot -> (loc, cod).
+
+    One row scatter (N indices for whole W-byte rows — far fewer indices
+    than the N*W cell scatter of the scatter pileup, and with no duplicate
+    accumulation).  Slots are unique by construction, so ``.set`` is
+    deterministic.
+    """
+    e = rows_per_tile
+    tile_of = slot // e
+    loc = jnp.zeros(n_tiles * e, dtype=jnp.int32).at[slot].set(
+        (starts - tile_of * tile).astype(jnp.int32))
+    cod = jnp.full((n_tiles * e, width), 255, dtype=jnp.uint8).at[slot].set(
+        codes)
+    return loc.reshape(n_tiles, e), cod.reshape(n_tiles, e, width)
+
+
+@functools.partial(jax.jit, donate_argnums=0,
+                   static_argnames=("tile", "n_tiles", "rows_per_tile",
+                                    "width"))
+def pileup_mxu(counts: jax.Array, loc_flat: jax.Array, codes_flat: jax.Array,
+               *, tile: int, n_tiles: int, rows_per_tile: int,
+               width: int) -> jax.Array:
+    """Padded-transfer layout (TilePlan): accumulate into ``counts``
+    ([n_tiles*tile, 6]).  Flat inputs are reshaped on device:
+    multi-dimensional host->device transfers of non-native shapes are
+    pathologically slow through a tunneled runtime, flat byte streams are
+    not.  Kept as the semantics twin for tests; production uses the
+    compact layout below.
+    """
+    loc = loc_flat.reshape(n_tiles, rows_per_tile)
+    cod = codes_flat.reshape(n_tiles, rows_per_tile, width)
+    return _accumulate_tiles(counts, loc, cod, tile=tile, n_tiles=n_tiles,
+                             rows_per_tile=rows_per_tile, width=width)
+
+
+@functools.partial(jax.jit, donate_argnums=0,
+                   static_argnames=("tile", "n_tiles", "rows_per_tile",
+                                    "width"))
+def pileup_mxu_compact(counts: jax.Array, starts: jax.Array,
+                       codes: jax.Array, slot: jax.Array, *, tile: int,
+                       n_tiles: int, rows_per_tile: int,
+                       width: int) -> jax.Array:
+    """Compact-transfer layout (SlotPlan): rows ship exactly as the
+    scatter path ships them (+4B/row slot); the padded tile layout is
+    built on device, keeping the tunnel link at compact bytes."""
+    loc, cod = build_padded_layout(starts, codes, slot, tile=tile,
+                                   n_tiles=n_tiles,
+                                   rows_per_tile=rows_per_tile, width=width)
+    return _accumulate_tiles(counts, loc, cod, tile=tile, n_tiles=n_tiles,
+                             rows_per_tile=rows_per_tile, width=width)

@@ -110,19 +110,20 @@ class PileupAccumulator:
             plan = None
             # NOTE: "auto" currently resolves to scatter.  The MXU path wins
             # in isolated device microbenchmarks (~44ms vs ~58ms per slab,
-            # scan-pipelined) but regresses end-to-end through the tunneled
-            # runtime; until that is root-caused on real hardware it must be
-            # opted into with --pileup mxu.
+            # scan-pipelined) but round 1's padded-transfer layout regressed
+            # end-to-end through the tunneled runtime (it shipped up to
+            # MAX_BLOWUP x padded rows over the link).  The compact slot
+            # layout removes that overhead; it stays opt-in (--pileup mxu)
+            # until proven faster on hardware.
             if self.strategy == "mxu":
-                # plan_tiles returns None on skew (padding blowup): scatter
-                plan = mxu_pileup.plan_tiles(
-                    np.asarray(starts), np.asarray(codes), self.padded_len,
-                    self._tile)
+                # plan_slots returns None on skew (padding blowup): scatter
+                plan = mxu_pileup.plan_slots(
+                    np.asarray(starts), w, self.padded_len, self._tile)
             if plan is not None:
                 key = f"mxu_w{w}"
-                self._counts = mxu_pileup.pileup_mxu(
-                    self._counts, jnp.asarray(plan.loc),
-                    jnp.asarray(plan.codes), tile=self._tile,
+                self._counts = mxu_pileup.pileup_mxu_compact(
+                    self._counts, jnp.asarray(starts), jnp.asarray(codes),
+                    jnp.asarray(plan.slot), tile=self._tile,
                     n_tiles=plan.n_tiles,
                     rows_per_tile=plan.rows_per_tile, width=plan.width)
             else:
