@@ -74,19 +74,30 @@ class JaxBackend:
                 # sp (position-sharded blocks + halo exchange) once the
                 # dp pipeline's transient full-length local tensor per
                 # device stops being cheap; dp otherwise (it needs no
-                # host-side read routing and reduce-scatter is optimal)
+                # host-side read routing and reduce-scatter is optimal).
+                # An explicit --pileup mxu pins dp: the MXU tile plan
+                # composes with the dp layout only.
                 mode = ("sp" if layout.total_len >= (1 << 25)
-                        and block >= SP_HALO else "dp")
+                        and block >= SP_HALO
+                        and getattr(cfg, "pileup", "auto") != "mxu"
+                        else "dp")
             if mode == "sp":
                 from ..parallel.sp import PositionShardedConsensus
 
+                if getattr(cfg, "pileup", "auto") == "mxu":
+                    raise RuntimeError(
+                        "--pileup mxu composes with the dp shard layout "
+                        "only; use --shard-mode dp (sp routes rows to "
+                        "position blocks, which the MXU tile plan does not "
+                        "model yet)")
                 acc = PositionShardedConsensus(
                     make_mesh(shards), layout.total_len,
                     halo=min(block, SP_HALO))
             else:
                 from ..parallel.dp import ShardedConsensus
 
-                acc = ShardedConsensus(make_mesh(shards), layout.total_len)
+                acc = ShardedConsensus(make_mesh(shards), layout.total_len,
+                                       pileup=getattr(cfg, "pileup", "auto"))
             stats.extra["shard_mode"] = mode
         else:
             acc = PileupAccumulator(layout.total_len,
@@ -244,7 +255,36 @@ class JaxBackend:
                 eb[:e] = ins["ev_code"]
                 return scp, ncp, ek, ec, eb
 
-            if use_sharded:
+            if use_pallas:
+                from ..ops import pallas_insertion
+
+                # shared pallas setup: the kernel's table is
+                # [eplan.kp, cp, 6] — pad the site arrays to ITS key
+                # padding (a KEY_BLOCK multiple), not the scatter kp
+                eplan = pallas_insertion.plan_events(
+                    ins["ev_key"], ins["ev_col"], ins["ev_code"], k, cp)
+                sc = np.zeros(eplan.kp, dtype=np.int32)
+                sc[:k] = site_cov
+                nc = np.zeros(eplan.kp, dtype=np.int32)
+                nc[:k] = ins["n_cols"]
+                interp = jax.default_backend() != "tpu"
+
+            if use_sharded and use_pallas:
+                # the position vote already ran position-sharded
+                # (acc.vote); only the insertion table + vote remain, so
+                # the Pallas kernel runs standalone on the default device
+                out = pallas_insertion._table_call(
+                    jnp.asarray(eplan.key3), jnp.asarray(eplan.cc3),
+                    jnp.asarray(eplan.blk_lo), jnp.asarray(eplan.blk_n),
+                    kp=eplan.kp, c6p=eplan.c6p,
+                    max_blocks=eplan.max_blocks, interpret=interp)
+                table = out.reshape(eplan.kp, eplan.c6p)[
+                    :, : cp * 6].reshape(eplan.kp, cp, 6)
+                ins_syms = np.asarray(vote_insertions(
+                    table, jnp.asarray(sc), jnp.asarray(nc),
+                    t_luts))[:, :k, :]                        # [T, K, Cp]
+                stats.extra["insertion_kernel"] = "pallas"
+            elif use_sharded:
                 site_cov_p, n_cols_p, ev_key, ev_col, ev_code = \
                     padded_scatter_inputs()
                 table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
@@ -255,17 +295,6 @@ class JaxBackend:
                     table, jnp.asarray(site_cov_p), jnp.asarray(n_cols_p),
                     t_luts))[:, :k, :]                        # [T, K, Cp]
             elif use_pallas:
-                from ..ops import pallas_insertion
-
-                # the pallas table is [eplan.kp, cp, 6]; pad the site
-                # arrays to ITS key padding (a KEY_BLOCK multiple)
-                eplan = pallas_insertion.plan_events(
-                    ins["ev_key"], ins["ev_col"], ins["ev_code"], k, cp)
-                sc = np.zeros(eplan.kp, dtype=np.int32)
-                sc[:k] = site_cov
-                nc = np.zeros(eplan.kp, dtype=np.int32)
-                nc[:k] = ins["n_cols"]
-                interp = jax.default_backend() != "tpu"
                 packed = fused.vote_packed_pallas(
                     counts, t_luts, jnp.asarray(eplan.key3),
                     jnp.asarray(eplan.cc3), jnp.asarray(eplan.blk_lo),

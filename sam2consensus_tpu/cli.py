@@ -84,7 +84,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device pileup strategy: XLA scatter-add (scatter, "
                         "current auto default) or MXU one-hot matmul (mxu, "
                         "experimental; falls back to scatter on skewed "
-                        "coverage). Single-device jax backend only")
+                        "coverage). Composes with --shards in the dp "
+                        "shard layout")
     p.add_argument("--insertion-kernel", dest="ins_kernel",
                    choices=["scatter", "pallas"], default="scatter",
                    help="insertion-table build on device: XLA scatter "
@@ -184,13 +185,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if cfg.shards and cfg.backend != "jax":
         raise SystemExit("--shards requires --backend jax")
-    if cfg.pileup == "mxu" and cfg.shards > 1:
-        raise SystemExit("--pileup mxu is not yet supported with --shards; "
-                         "the sharded accumulator uses the scatter path")
-    if cfg.ins_kernel == "pallas" and cfg.shards > 1:
-        raise SystemExit("--insertion-kernel pallas is not yet supported "
-                         "with --shards; the sharded path uses the scatter "
-                         "table build")
+    if cfg.pileup == "mxu" and cfg.shard_mode == "sp":
+        raise SystemExit("--pileup mxu composes with the dp shard layout "
+                         "only; use --shard-mode dp")
     if cfg.checkpoint_dir and cfg.backend != "jax":
         raise SystemExit("--checkpoint-dir requires --backend jax")
     if cfg.incremental and not cfg.checkpoint_dir:

@@ -41,12 +41,28 @@ __all__ = ["ShardedConsensus", "ALL"]
 
 
 class ShardedConsensus(ShardedCountsBase):
-    """Streaming sharded accumulate + vote over a ("dp", "sp") mesh."""
+    """Streaming sharded accumulate + vote over a ("dp", "sp") mesh.
 
-    def __init__(self, mesh: Mesh, total_len: int):
+    ``pileup`` picks the per-device accumulation strategy: ``"mxu"`` plans
+    one tile-sorted chunk per device and runs the one-hot-matmul pileup
+    (``ops.mxu_pileup``) locally before the reduce-scatter; ``"scatter"``
+    (and, until the MXU path is proven on hardware, ``"auto"``) keeps the
+    XLA scatter.  Skewed slabs fall back to scatter per bucket, exactly as
+    on a single device.
+    """
+
+    def __init__(self, mesh: Mesh, total_len: int, pileup: str = "auto"):
         # position axis padded so every device owns an equal block; the
         # sacrificial scatter row (index total_len) lives inside the pad.
         super().__init__(mesh, total_len)
+        from ..ops import mxu_pileup
+
+        self.pileup = pileup
+        self.strategy_used: dict = {}
+        self._tile = mxu_pileup.TILE_POSITIONS
+        self._tiles_len = -(-self.padded_len // self._tile) * self._tile
+        self._n_tiles = self._tiles_len // self._tile
+        self._mxu_cache: dict = {}
 
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(ALL, None), P(ALL), P(ALL, None)),
@@ -62,21 +78,98 @@ class ShardedConsensus(ShardedCountsBase):
 
         self._accumulate = jax.jit(accumulate, donate_argnums=0)
 
+    def _mxu_accumulate(self, rows_per_tile: int, width: int):
+        """Per-(E, W) jitted sharded MXU accumulate (cached: the slab
+        protocol keeps these shapes near-constant per run).  Rows ship
+        compact (scatter-path bytes +4B/row slot); each device builds its
+        padded tile layout locally (ops.mxu_pileup.build_padded_layout)."""
+        key = (rows_per_tile, width)
+        if key not in self._mxu_cache:
+            from ..ops import mxu_pileup
+
+            tile, n_tiles = self._tile, self._n_tiles
+            tiles_len, padded_len = self._tiles_len, self.padded_len
+
+            @partial(shard_map, mesh=self.mesh,
+                     in_specs=(P(ALL, None), P(ALL), P(ALL, None), P(ALL)),
+                     out_specs=P(ALL, None))
+            def accumulate_mxu(counts_blk, starts, codes, slot):
+                loc, cod = mxu_pileup.build_padded_layout(
+                    starts, codes, slot, tile=tile, n_tiles=n_tiles,
+                    rows_per_tile=rows_per_tile, width=width)
+                local = mxu_pileup._accumulate_tiles(
+                    jnp.zeros((tiles_len, NUM_SYMBOLS), dtype=jnp.int32),
+                    loc, cod, tile=tile, n_tiles=n_tiles,
+                    rows_per_tile=rows_per_tile, width=width)
+                return counts_blk + jax.lax.psum_scatter(
+                    local[:padded_len], ALL, scatter_dimension=0, tiled=True)
+
+            self._mxu_cache[key] = jax.jit(accumulate_mxu, donate_argnums=0)
+        return self._mxu_cache[key]
+
+    def _plan_mxu(self, starts: np.ndarray, codes: np.ndarray):
+        """Split rows into one contiguous chunk per device and slot-plan
+        each with a common E; None on skew (scatter fallback)."""
+        from ..ops import mxu_pileup
+
+        total = len(starts)
+        if total == 0:
+            return None
+        w = codes.shape[1]
+        per = -(-total // self.n)
+        if per * self.n != total:
+            # equalize chunk lengths with PAD rows; they plan like real
+            # rows into tile 0 and count nothing (codes one-hot to zero)
+            starts = np.concatenate(
+                [starts, np.zeros(per * self.n - total, dtype=starts.dtype)])
+            codes = np.concatenate(
+                [codes, np.full((per * self.n - total, w), PAD_CODE,
+                                dtype=np.uint8)])
+        bounds = [(i * per, (i + 1) * per) for i in range(self.n)]
+        hists = []
+        for lo, hi in bounds:
+            tile_of = starts[lo:hi] // self._tile
+            hists.append((tile_of, np.bincount(tile_of,
+                                               minlength=self._n_tiles)))
+        emax = max(int(pt.max(initial=1)) for _t, pt in hists)
+        e = 1 << max(3, (emax - 1).bit_length())
+        if self.n * self._n_tiles * e / total > mxu_pileup.MAX_BLOWUP:
+            return None
+        slots = np.empty(per * self.n, dtype=np.int32)
+        for (lo, hi), (tile_of, per_tile) in zip(bounds, hists):
+            slots[lo:hi] = mxu_pileup.assign_slots(tile_of, per_tile, e)
+        return starts, codes, slots, e
+
     # -- streaming input --------------------------------------------------
     def add(self, batch: SegmentBatch) -> None:
         for w, (starts, codes) in sorted(batch.buckets.items()):
-            s = len(starts)
-            # rows must shard evenly over the mesh (matters for
-            # non-power-of-two device counts)
-            target = -(-s // self.n) * self.n
-            if target != s:
-                starts = np.concatenate(
-                    [starts, np.zeros(target - s, dtype=np.int32)])
-                codes = np.concatenate(
-                    [codes, np.full((target - s, codes.shape[1]), PAD_CODE,
-                                    dtype=np.uint8)])
-            for lo, hi in iter_row_slices(target, w, multiple_of=self.n):
-                self._counts = self._accumulate(
+            plan = None
+            if self.pileup == "mxu":
+                plan = self._plan_mxu(np.asarray(starts), np.asarray(codes))
+            if plan is not None:
+                p_starts, p_codes, slots, e = plan
+                fn = self._mxu_accumulate(e, w)
+                self._counts = fn(
                     self._counts,
-                    jax.device_put(starts[lo:hi], self._row_spec),
-                    jax.device_put(codes[lo:hi], self._mat_spec))
+                    jax.device_put(p_starts, self._row_spec),
+                    jax.device_put(p_codes, self._mat_spec),
+                    jax.device_put(slots, self._row_spec))
+                key = f"mxu_w{w}"
+            else:
+                s = len(starts)
+                # rows must shard evenly over the mesh (matters for
+                # non-power-of-two device counts)
+                target = -(-s // self.n) * self.n
+                if target != s:
+                    starts = np.concatenate(
+                        [starts, np.zeros(target - s, dtype=np.int32)])
+                    codes = np.concatenate(
+                        [codes, np.full((target - s, codes.shape[1]),
+                                        PAD_CODE, dtype=np.uint8)])
+                for lo, hi in iter_row_slices(target, w, multiple_of=self.n):
+                    self._counts = self._accumulate(
+                        self._counts,
+                        jax.device_put(starts[lo:hi], self._row_spec),
+                        jax.device_put(codes[lo:hi], self._mat_spec))
+                key = f"scatter_w{w}"
+            self.strategy_used[key] = self.strategy_used.get(key, 0) + 1

@@ -139,3 +139,44 @@ def test_mxu_chunked_tile_axis():
         rows_per_tile=plan.rows_per_tile, width=plan.width)
     assert np.array_equal(np.asarray(out, dtype=np.int64),
                           _ref_counts(starts, codes, padded_len))
+
+
+@pytest.mark.parametrize("tile,n,width", [(512, 300, 64), (256, 50, 32),
+                                          (1024, 1000, 128)])
+def test_compact_layout_equals_padded(tile, n, width):
+    """pileup_mxu_compact (device-built padding) == pileup_mxu
+    (host-padded transfer) == numpy reference."""
+    rng = np.random.default_rng(tile * 7 + n)
+    span = 4 * tile + 100
+    padded_len = -(-span // tile) * tile
+    starts, codes = _random_rows(rng, n, width, span)
+    sp = mxu_pileup.plan_slots(starts, width, padded_len, tile,
+                               max_blowup=float("inf"))
+    out = mxu_pileup.pileup_mxu_compact(
+        jnp.zeros((padded_len, 6), jnp.int32), jnp.asarray(starts),
+        jnp.asarray(codes), jnp.asarray(sp.slot), tile=tile,
+        n_tiles=sp.n_tiles, rows_per_tile=sp.rows_per_tile, width=width)
+    assert np.array_equal(np.asarray(out, dtype=np.int64),
+                          _ref_counts(starts, codes, padded_len))
+
+
+def test_plan_slots_matches_plan_tiles_layout():
+    """Scattering compact rows by plan_slots' slot reproduces plan_tiles'
+    padded arrays exactly (the two layouts are the same plan)."""
+    rng = np.random.default_rng(99)
+    tile = 256
+    padded_len = 6 * tile
+    width = 32
+    starts, codes = _random_rows(rng, 200, width, padded_len - width)
+    tp = mxu_pileup.plan_tiles(starts, codes, padded_len, tile,
+                               max_blowup=float("inf"))
+    sp = mxu_pileup.plan_slots(starts, width, padded_len, tile,
+                               max_blowup=float("inf"))
+    assert (sp.n_tiles, sp.rows_per_tile) == (tp.n_tiles, tp.rows_per_tile)
+    loc = np.zeros(sp.n_tiles * sp.rows_per_tile, np.int32)
+    cod = np.full((sp.n_tiles * sp.rows_per_tile, width), 255, np.uint8)
+    tile_of = sp.slot // sp.rows_per_tile
+    loc[sp.slot] = starts - tile_of * tile
+    cod[sp.slot] = codes
+    assert np.array_equal(loc, tp.loc)
+    assert np.array_equal(cod.reshape(-1), tp.codes)

@@ -129,3 +129,60 @@ def test_sharded_six_devices():
         sharded.add(c)
     np.testing.assert_array_equal(sharded.counts_host(),
                                   np.asarray(single.counts))
+
+
+def test_sharded_mxu_counts_equal_scatter():
+    """dp + per-device MXU pileup == dp + scatter (task: fast kernels
+    compose with --shards)."""
+    text = simulate(SimSpec(n_contigs=3, contig_len=220, n_reads=500,
+                            read_len=40, seed=41))
+    layout, chunks = _encode_all(text)
+    scatter = ShardedConsensus(make_mesh(8), layout.total_len,
+                               pileup="scatter")
+    mxu = ShardedConsensus(make_mesh(8), layout.total_len, pileup="mxu")
+    for c in chunks:
+        scatter.add(c)
+        mxu.add(c)
+    assert any(k.startswith("mxu") for k in mxu.strategy_used), \
+        mxu.strategy_used
+    np.testing.assert_array_equal(mxu.counts_host(), scatter.counts_host())
+
+
+@pytest.mark.parametrize("kernels", [
+    {"pileup": "mxu"},
+    {"ins_kernel": "pallas"},
+    {"pileup": "mxu", "ins_kernel": "pallas"},
+])
+def test_sharded_backend_with_fast_kernels_byte_identical(kernels):
+    """--shards composed with --pileup mxu / --insertion-kernel pallas."""
+    text = simulate(SimSpec(n_contigs=4, contig_len=200, n_reads=600,
+                            read_len=40, ins_read_rate=0.2,
+                            del_read_rate=0.15, seed=42))
+
+    def run(cfg):
+        handle = io.StringIO(text)
+        contigs, _n, first = read_header(handle)
+        res = (CpuBackend() if cfg.backend == "cpu" else JaxBackend()).run(
+            contigs, iter_records(handle, first), cfg)
+        return ({n: render_file(r, 0) for n, r in res.fastas.items()},
+                res.stats)
+
+    out_cpu, _st = run(RunConfig(prefix="p", thresholds=[0.25, 0.75]))
+    out_jax, stats = run(RunConfig(prefix="p", thresholds=[0.25, 0.75],
+                                   backend="jax", shards=8, **kernels))
+    assert out_jax == out_cpu
+    if kernels.get("pileup") == "mxu":
+        assert any(k.startswith("mxu") for k in stats.extra["pileup"])
+    if kernels.get("ins_kernel") == "pallas":
+        assert stats.extra.get("insertion_kernel") == "pallas"
+
+
+def test_sp_mode_rejects_mxu():
+    text = simulate(SimSpec(n_contigs=1, contig_len=100, n_reads=50,
+                            read_len=30, seed=43))
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    cfg = RunConfig(prefix="p", backend="jax", shards=8, shard_mode="sp",
+                    pileup="mxu")
+    with pytest.raises(RuntimeError, match="dp shard layout"):
+        JaxBackend().run(contigs, iter_records(handle, first), cfg)
