@@ -27,11 +27,22 @@ neighbor-shift of ``[H, 6]`` int32 over ICI — independent of genome and
 chunk size.  The same code rides DCN on multi-host meshes (the mesh
 abstraction covers both fabrics; SURVEY.md §5 "distributed backend").
 
-Known trade-off: routing is dense SPMD — every chunk ships
-``n * max_rows_per_device`` row slots, so a coordinate-sorted SAM whose
-chunk lands entirely on one device pays ~n× the minimal transfer bytes
-for that chunk.  Correctness is unaffected (PAD rows count nothing); a
-position-windowed host re-chunking pass can remove the blowup later.
+Two accumulation strategies, picked per slab by the slab's position span:
+
+* **window** — when the slab's rows span a narrow position window (the
+  coordinate-sorted common case): rows split EVENLY across devices (no
+  routing, transfer ∝ real rows), each device scatters into a small
+  ``[Wp, 6]`` window-local tensor, one ``psum`` of the window rides ICI,
+  and each device folds the slice overlapping its resident block.
+  Transfer is minimal; communication is O(window), independent of genome
+  size.
+* **routed** — scattered input: rows route to the device owning their
+  start position (dense SPMD, ``n * max_rows_per_device`` slots — which
+  is ≈ the real row count precisely when the input is NOT sorted), with
+  the halo exchange folding block overhangs.
+
+``rows_shipped`` / ``rows_real`` count row slots actually transferred vs
+received, pinning the sorted-input fix (tests/test_parallel_sp.py).
 """
 
 from __future__ import annotations
@@ -58,6 +69,10 @@ class PositionShardedConsensus(ShardedCountsBase):
     pick either by genome size.
     """
 
+    #: largest position window the window strategy will materialize per
+    #: device ([Wp, 6] int32 local + one psum of the same size over ICI)
+    WINDOW_CAP = 1 << 21
+
     def __init__(self, mesh, total_len: int, halo: int = 1 << 16):
         super().__init__(mesh, total_len)
         self.halo = halo
@@ -65,6 +80,10 @@ class PositionShardedConsensus(ShardedCountsBase):
             raise ValueError(
                 f"position block {self.block} smaller than halo {halo}: "
                 "use the DP pipeline for genomes this small")
+        self.strategy_used: dict = {}
+        self.rows_shipped = 0
+        self.rows_real = 0
+        self._window_cache: dict = {}
 
         block = self.block
         n = self.n
@@ -94,6 +113,32 @@ class PositionShardedConsensus(ShardedCountsBase):
 
         self._accumulate = jax.jit(accumulate, donate_argnums=0)
 
+    def _window_accumulate(self, wp: int):
+        """Per-Wp jitted window-strategy accumulate (pow2 Wp keeps the
+        cache O(log))."""
+        if wp not in self._window_cache:
+            block, n = self.block, self.n
+
+            @partial(shard_map, mesh=self.mesh,
+                     in_specs=(P(ALL, None), P(ALL), P(ALL, None), P()),
+                     out_specs=P(ALL, None))
+            def accumulate_window(counts_blk, starts, codes, wlo):
+                di = jax.lax.axis_index(ALL)
+                local = jnp.zeros((wp + 1, NUM_SYMBOLS), dtype=jnp.int32)
+                pos, code = expand_segment_positions(starts - wlo, codes, wp)
+                local = local.at[pos, code].add(1)
+                # one window-sized all-reduce rides ICI; every device then
+                # folds the slice overlapping its resident position block
+                win = jax.lax.psum(local[:wp], ALL)
+                idx = di * block + jnp.arange(block) - wlo
+                valid = (idx >= 0) & (idx < wp)
+                safe = jnp.clip(idx, 0, wp - 1)
+                return counts_blk + jnp.where(valid[:, None], win[safe], 0)
+
+            self._window_cache[wp] = jax.jit(accumulate_window,
+                                             donate_argnums=0)
+        return self._window_cache[wp]
+
     # -- streaming input --------------------------------------------------
     def add(self, batch: SegmentBatch) -> None:
         for w, (starts, codes) in sorted(batch.buckets.items()):
@@ -116,6 +161,43 @@ class PositionShardedConsensus(ShardedCountsBase):
                 starts = starts.astype(np.int32)
                 codes = codes.reshape(-1, self.halo)
                 w = self.halo
+
+            self.rows_real += len(starts)
+            # strategy pick: a narrow position span (coordinate-sorted
+            # input) takes the window path — even row split, minimal
+            # transfer, one O(window) psum — instead of routing, whose
+            # dense slot grid would ship ~n x the real rows
+            real = ~(codes == PAD_CODE).all(axis=1)
+            if real.any():
+                wlo = int(starts[real].min())
+                span = int(starts[real].max()) + w - wlo
+                wp = 1 << max(10, (span - 1).bit_length())
+            else:
+                continue  # nothing but pad rows: nothing to count
+            if wp <= min(self.WINDOW_CAP, self.padded_len):
+                # pad-row starts may sit outside the window; pin them to
+                # wlo so the shifted scatter index stays in range (their
+                # cells are PAD and redirect anyway)
+                starts = np.where(real, starts, wlo).astype(np.int32)
+                n_rows = -(-len(starts) // self.n) * self.n
+                if n_rows != len(starts):
+                    starts = np.concatenate(
+                        [starts,
+                         np.full(n_rows - len(starts), wlo, np.int32)])
+                    codes = np.concatenate(
+                        [codes, np.full((n_rows - len(codes), w), PAD_CODE,
+                                        dtype=np.uint8)])
+                fn = self._window_accumulate(wp)
+                for lo, hi in iter_row_slices(n_rows, w, multiple_of=self.n):
+                    self._counts = fn(
+                        self._counts,
+                        jax.device_put(starts[lo:hi], self._row_spec),
+                        jax.device_put(codes[lo:hi], self._mat_spec),
+                        np.int32(wlo))
+                    self.rows_shipped += hi - lo
+                key = f"window_w{w}"
+                self.strategy_used[key] = self.strategy_used.get(key, 0) + 1
+                continue
 
             # route rows to the device owning their start position; PAD
             # rows (all-PAD codes, start 0) follow start 0 to device 0
@@ -152,3 +234,6 @@ class PositionShardedConsensus(ShardedCountsBase):
                     jax.device_put(
                         c_routed[:, lo:hi_r].reshape(-1, w).copy(),
                         self._mat_spec))
+                self.rows_shipped += self.n * (hi_r - lo)
+            key = f"routed_w{w}"
+            self.strategy_used[key] = self.strategy_used.get(key, 0) + 1

@@ -164,3 +164,65 @@ def test_sp_splits_rows_wider_than_halo():
     sp.add(_batch(starts, codes))
     assert np.array_equal(sp.counts_host(),
                           _ref_counts(total_len, starts, codes))
+
+
+def test_sp_sorted_input_ships_near_minimal_rows():
+    """Coordinate-sorted input (the real-world common case): the window
+    strategy must ship ~the real row count, not n x max_per_device
+    (the round-1 ~8x transfer blowup)."""
+    rng = np.random.default_rng(33)
+    total_len = 1 << 20
+    w = 64
+    n_rows = 4096
+    # coordinate-sorted: every chunk's rows land in one narrow window
+    sp = PositionShardedConsensus(make_mesh(8), total_len, halo=256)
+    all_s, all_c = [], []
+    for chunk in range(4):
+        base = chunk * 2000
+        starts = (base + np.sort(rng.integers(0, 1500, n_rows))).astype(
+            np.int32)
+        codes = rng.integers(0, 6, (n_rows, w)).astype(np.uint8)
+        sp.add(_batch(starts, codes))
+        all_s.append(starts)
+        all_c.append(codes)
+
+    assert any(k.startswith("window") for k in sp.strategy_used), \
+        sp.strategy_used
+    assert sp.rows_shipped <= 1.5 * sp.rows_real, (
+        sp.rows_shipped, sp.rows_real, sp.strategy_used)
+    ref = _ref_counts(total_len, np.concatenate(all_s),
+                      np.concatenate(all_c))
+    assert np.array_equal(sp.counts_host(), ref)
+
+
+def test_sp_scattered_input_uses_routed_path():
+    """Whole-genome-scattered rows exceed the window cap relative to the
+    genome and fall back to routing (which is balanced for this case)."""
+    rng = np.random.default_rng(34)
+    total_len = 9000
+    w = 32
+    starts = rng.integers(0, total_len - w, 800).astype(np.int32)
+    codes = rng.integers(0, 6, (800, w)).astype(np.uint8)
+    sp = PositionShardedConsensus(make_mesh(8), total_len, halo=64)
+    sp.add(_batch(starts, codes))
+    assert any(k.startswith("routed") for k in sp.strategy_used), \
+        sp.strategy_used
+    assert np.array_equal(sp.counts_host(),
+                          _ref_counts(total_len, starts, codes))
+
+
+def test_sp_window_spanning_block_boundaries():
+    """A sorted window that straddles several device blocks folds each
+    device's overlap exactly (the masked-slice path)."""
+    total_len = 1 << 16
+    sp = PositionShardedConsensus(make_mesh(8), total_len, halo=128)
+    block = sp.block
+    w = 64
+    # rows packed around the 3rd/4th block boundary
+    starts = np.arange(3 * block - 200, 3 * block + 200,
+                       dtype=np.int32)
+    codes = np.tile(np.arange(w) % 6, (len(starts), 1)).astype(np.uint8)
+    sp.add(_batch(starts, codes))
+    assert any(k.startswith("window") for k in sp.strategy_used)
+    assert np.array_equal(sp.counts_host(),
+                          _ref_counts(total_len, starts, codes))
