@@ -81,11 +81,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(index bounds, symbol codes, count invariants)")
     p.add_argument("--pileup", choices=["auto", "mxu", "scatter"],
                    default="auto",
-                   help="device pileup strategy: XLA scatter-add (scatter, "
-                        "current auto default) or MXU one-hot matmul (mxu, "
-                        "experimental; falls back to scatter on skewed "
-                        "coverage). Composes with --shards in the dp "
-                        "shard layout")
+                   help="device pileup strategy: auto (online autotune — "
+                        "times scatter and mxu on early slabs and keeps "
+                        "the measured winner; single-device), XLA "
+                        "scatter-add, or MXU one-hot matmul (falls back "
+                        "to scatter on skewed coverage). Both kernels "
+                        "compose with --shards in the dp shard layout")
     p.add_argument("--insertion-kernel", dest="ins_kernel",
                    choices=["scatter", "pallas"], default="scatter",
                    help="insertion-table build on device: XLA scatter "

@@ -22,6 +22,7 @@ row ``total_len``, which is dropped at read time.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -78,14 +79,33 @@ def iter_row_slices(n_rows: int, width: int, multiple_of: int = 1):
 class PileupAccumulator:
     """Streaming accumulator for one device (sharded use lives in parallel/).
 
-    Two device strategies per slab (``strategy``):
+    Three strategies (``strategy``):
 
-    * ``"mxu"`` (default where it pays): one-hot matmul + overlap-add
-      (``ops.mxu_pileup``) — ~11x the scatter's throughput on v5e;
     * ``"scatter"``: XLA scatter-add — the semantics oracle, and the
       automatic fallback when per-tile padding would explode (skewed
-      coverage) or a bucket is tiny.
+      coverage) or a bucket is tiny;
+    * ``"mxu"``: one-hot matmul + overlap-add (``ops.mxu_pileup``,
+      compact slot transfer) — the FLOPs land on the systolic array;
+    * ``"auto"``: ONLINE AUTOTUNE.  Rather than hard-coding a winner
+      that depends on the runtime (round 1's padded-transfer MXU layout
+      won on-device microbenchmarks ~11x yet lost end-to-end through the
+      tunneled link), auto measures each strategy on early steady-state
+      slabs — warm a strategy on one slab, time it on the NEXT slab of
+      the same shape (so jit compilation never pollutes the number),
+      scatter first, then mxu — and locks in the winner by per-cell
+      throughput from then on.  The mxu measurement starts before host
+      slot planning, so it is honestly end-to-end (host plan + transfer
+      + device); a trial that keeps hitting skewed slabs gives up after
+      ``_MAX_SKEW_RETRIES`` and locks in scatter.  Runs too small to
+      finish the trial stay on scatter; every trial slab still
+      accumulates exactly (both strategies are exact), so the tuning is
+      free of correctness cost.
     """
+
+    #: autotune stages: warm scatter, time scatter, warm mxu, time mxu
+    _STAGES = (("scatter", False), ("scatter", True),
+               ("mxu", False), ("mxu", True))
+    _MAX_SKEW_RETRIES = 3
 
     def __init__(self, total_len: int, device=None, strategy: str = "auto"):
         from . import mxu_pileup
@@ -102,23 +122,70 @@ class PileupAccumulator:
             counts = jax.device_put(counts, device)
         self._counts = counts
         self.strategy_used: dict = {}
+        self._stage = 0
+        self._warm_shape = None
+        self._skew_retries = 0
+        self._trial_times: dict = {}       # strategy -> sec per cell
+
+    def _lock_winner(self, winner: str, **extra) -> None:
+        self._trial_times["winner"] = winner
+        self.strategy_used["autotune"] = {
+            "scatter_sec_per_mcell": round(
+                self._trial_times.get("scatter", 0.0) * 1e6, 5),
+            "mxu_sec_per_mcell": round(
+                self._trial_times.get("mxu", 0.0) * 1e6, 5),
+            "winner": winner, **extra}
+
+    def _record_trial(self, strategy: str, sec_per_cell: float) -> None:
+        self._trial_times[strategy] = sec_per_cell
+        if "scatter" in self._trial_times and "mxu" in self._trial_times:
+            self._lock_winner(min(("scatter", "mxu"),
+                                  key=self._trial_times.get))
 
     def add(self, batch: SegmentBatch) -> None:
         from . import mxu_pileup
 
         for w, (starts, codes) in sorted(batch.buckets.items()):
-            plan = None
-            # NOTE: "auto" currently resolves to scatter.  The MXU path wins
-            # in isolated device microbenchmarks (~44ms vs ~58ms per slab,
-            # scan-pipelined) but round 1's padded-transfer layout regressed
-            # end-to-end through the tunneled runtime (it shipped up to
-            # MAX_BLOWUP x padded rows over the link).  The compact slot
-            # layout removes that overhead; it stays opt-in (--pileup mxu)
-            # until proven faster on hardware.
-            if self.strategy == "mxu":
+            # strategy + trial role for this slab
+            timing = False
+            advance = False
+            if self.strategy != "auto":
+                chosen = self.strategy
+            elif "winner" in self._trial_times:
+                chosen = self._trial_times["winner"]
+            elif len(starts) * w < (SCATTER_CELL_BUDGET >> 3):
+                # tiny slab: timing would be noise, cost is negligible
+                chosen = "scatter"
+            else:
+                chosen, is_timing_stage = self._STAGES[self._stage]
+                shape = (len(starts), w)
+                if not is_timing_stage:
+                    self._warm_shape = shape        # warm slab
+                    advance = True
+                elif shape != self._warm_shape:
+                    # shape changed since the warm slab: this run would
+                    # include jit compilation — re-warm, stay in stage
+                    self._warm_shape = shape
+                else:
+                    timing = advance = True
+
+            t0 = time.perf_counter()       # before host planning: the mxu
+            plan = None                    # number must be end-to-end
+            if chosen == "mxu":
                 # plan_slots returns None on skew (padding blowup): scatter
                 plan = mxu_pileup.plan_slots(
                     np.asarray(starts), w, self.padded_len, self._tile)
+                if plan is None:
+                    if self.strategy == "auto" \
+                            and "winner" not in self._trial_times:
+                        # skewed trial slab can't measure mxu; give up
+                        # after a few — persistent skew means mxu would
+                        # rarely engage anyway, and each retry pays the
+                        # host planning scan
+                        self._skew_retries += 1
+                        if self._skew_retries >= self._MAX_SKEW_RETRIES:
+                            self._lock_winner("scatter", reason="mxu_skew")
+                    timing = advance = False
             if plan is not None:
                 key = f"mxu_w{w}"
                 self._counts = mxu_pileup.pileup_mxu_compact(
@@ -132,6 +199,13 @@ class PileupAccumulator:
                     self._counts = _scatter_segments(
                         self._counts, jnp.asarray(starts[lo:hi]),
                         jnp.asarray(codes[lo:hi]), self.total_len)
+            if timing:
+                jax.block_until_ready(self._counts)
+                self._record_trial(
+                    chosen,
+                    (time.perf_counter() - t0) / (len(starts) * w))
+            if advance:
+                self._stage += 1
             self.strategy_used[key] = self.strategy_used.get(key, 0) + 1
 
     @property

@@ -180,3 +180,80 @@ def test_plan_slots_matches_plan_tiles_layout():
     cod[sp.slot] = codes
     assert np.array_equal(loc, tp.loc)
     assert np.array_equal(cod.reshape(-1), tp.codes)
+
+
+def test_auto_strategy_autotunes_and_stays_exact():
+    """'auto' times scatter and mxu on early steady-state slabs, locks in
+    the measured winner, and every slab (trial or not) accumulates
+    exactly."""
+    rng = np.random.default_rng(55)
+    total_len = 16000
+    width = 32
+    rows = 1 << 15                 # x width 32 = 1M cells: enters the trial
+    acc = PileupAccumulator(total_len, strategy="auto")
+    ref = np.zeros((acc.padded_len, 6), np.int64)
+    for i in range(6):
+        starts = rng.integers(0, total_len - width, rows).astype(np.int32)
+        codes = rng.integers(0, 6, (rows, width)).astype(np.uint8)
+        acc.add(SegmentBatch(buckets={width: (starts, codes)},
+                             n_reads=rows, n_events=rows * width))
+        ref += _ref_counts(starts, codes, acc.padded_len)
+    tune = acc.strategy_used.get("autotune")
+    assert tune is not None and tune["winner"] in ("scatter", "mxu"), \
+        acc.strategy_used
+    assert tune["scatter_sec_per_mcell"] > 0
+    assert tune["mxu_sec_per_mcell"] > 0
+    assert np.array_equal(acc.counts_host().astype(np.int64),
+                          ref[:total_len])
+
+
+def test_auto_strategy_small_slabs_skip_trials():
+    """Tiny slabs never enter the trial: no autotune stats, scatter only."""
+    rng = np.random.default_rng(56)
+    total_len = 3000
+    acc = PileupAccumulator(total_len, strategy="auto")
+    for _ in range(6):
+        starts = rng.integers(0, total_len - 32, 100).astype(np.int32)
+        codes = rng.integers(0, 6, (100, 32)).astype(np.uint8)
+        acc.add(SegmentBatch(buckets={32: (starts, codes)},
+                             n_reads=100, n_events=3200))
+    assert "autotune" not in acc.strategy_used
+    assert all(k.startswith("scatter") for k in acc.strategy_used)
+
+
+def test_auto_strategy_reswarms_on_shape_change():
+    """A timing-stage slab whose shape differs from the warm slab re-warms
+    instead of timing (jit compilation must never pollute the trial)."""
+    rng = np.random.default_rng(57)
+    total_len = 16000
+    acc = PileupAccumulator(total_len, strategy="auto")
+    ref = np.zeros((acc.padded_len, 6), np.int64)
+    shapes = [(1 << 15, 32), (1 << 14, 64), (1 << 15, 32), (1 << 15, 32),
+              (1 << 15, 32), (1 << 15, 32), (1 << 15, 32), (1 << 15, 32)]
+    for rows, width in shapes:
+        starts = rng.integers(0, total_len - width, rows).astype(np.int32)
+        codes = rng.integers(0, 6, (rows, width)).astype(np.uint8)
+        acc.add(SegmentBatch(buckets={width: (starts, codes)},
+                             n_reads=rows, n_events=rows * width))
+        ref += _ref_counts(starts, codes, acc.padded_len)
+    assert acc.strategy_used.get("autotune", {}).get("winner") \
+        in ("scatter", "mxu")
+    assert np.array_equal(acc.counts_host().astype(np.int64),
+                          ref[:total_len])
+
+
+def test_auto_strategy_persistent_skew_locks_scatter():
+    """Trial slabs that always skew (all rows on one tile of a large
+    genome) stop retrying after the cap and lock in scatter."""
+    total_len = 64 * mxu_pileup.TILE_POSITIONS
+    width = 32
+    rows = 1 << 15
+    acc = PileupAccumulator(total_len, strategy="auto")
+    for _ in range(8):
+        starts = np.zeros(rows, dtype=np.int32)       # all on tile 0
+        codes = np.full((rows, width), 3, dtype=np.uint8)
+        acc.add(SegmentBatch(buckets={width: (starts, codes)},
+                             n_reads=rows, n_events=rows * width))
+    tune = acc.strategy_used.get("autotune")
+    assert tune is not None and tune["winner"] == "scatter" \
+        and tune.get("reason") == "mxu_skew", acc.strategy_used
