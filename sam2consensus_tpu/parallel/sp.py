@@ -166,15 +166,30 @@ class PositionShardedConsensus(ShardedCountsBase):
             # strategy pick: a narrow position span (coordinate-sorted
             # input) takes the window path — even row split, minimal
             # transfer, one O(window) psum — instead of routing, whose
-            # dense slot grid would ship ~n x the real rows
-            real = ~(codes == PAD_CODE).all(axis=1)
+            # dense slot grid would ship ~n x the real rows.
+            # Identifying encoder pad rows (all-PAD, start 0) needs no
+            # full-matrix scan: only zero-start rows can be padding, so
+            # only they are checked (a real row may still START with PAD
+            # cells — maxdel-skipped leading gaps — which is why the
+            # window math itself never relies on this mask; PAD cells
+            # self-redirect to the sacrificial slot regardless).
+            real = np.ones(len(starts), dtype=bool)
+            zero = np.nonzero(starts == 0)[0]
+            if len(zero):
+                real[zero[(codes[zero] == PAD_CODE).all(axis=1)]] = False
             if real.any():
                 wlo = int(starts[real].min())
                 span = int(starts[real].max()) + w - wlo
                 wp = 1 << max(10, (span - 1).bit_length())
             else:
                 continue  # nothing but pad rows: nothing to count
-            if wp <= min(self.WINDOW_CAP, self.padded_len):
+            # density gate: the window psum moves wp*6*4 bytes over ICI
+            # per slice; demand it stay within a small multiple of the
+            # slab's own row bytes so a sparse-but-sorted slab doesn't
+            # buy a 50MB all-reduce with 64KB of data (routing serves it
+            # fine — sparse rows spread over devices anyway)
+            dense_enough = wp * NUM_SYMBOLS * 4 <= 16 * len(starts) * w
+            if dense_enough and wp <= min(self.WINDOW_CAP, self.padded_len):
                 # pad-row starts may sit outside the window; pin them to
                 # wlo so the shifted scatter index stays in range (their
                 # cells are PAD and redirect anyway)
