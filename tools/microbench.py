@@ -1,0 +1,221 @@
+#!/usr/bin/env python3
+"""Device-op microbenchmarks: the evidence base for kernel defaults.
+
+Times the competing implementations of the two hot device ops on the
+current JAX default device and prints one JSON object per line:
+
+* pileup: XLA scatter-add vs MXU one-hot matmul in both transfer layouts
+  (padded TilePlan vs compact SlotPlan) — end-to-end per slab, split into
+  host planning / host->device transfer / device compute so a tunnel-
+  bottlenecked link is visible instead of inferred (round 1 shipped the
+  MXU path default-off because the padded layout lost end-to-end while
+  winning on-device; this harness is how that decision gets re-made on
+  numbers).
+* insertion table: XLA scatter build vs the Pallas segmented-reduce
+  kernel, on an insertion-heavy amplicon-like event mix.
+
+Run on real hardware:  python tools/microbench.py
+CI / no accelerator:   JAX_PLATFORMS=cpu python tools/microbench.py
+Knobs: MB_ROWS (default 65536), MB_WIDTH (128), MB_GENOME (4600000),
+MB_REPEATS (5), MB_INS_SITES (20000), MB_INS_EVENTS (2000000).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sam2consensus_tpu.utils.platform import pin_platform_from_env  # noqa: E402
+pin_platform_from_env()
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def timed(fn, repeats):
+    """Median wall seconds over ``repeats`` calls (after the caller's
+    warm-up), blocking on the result."""
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)), out
+
+
+def bench_pileup(rows, width, genome_len, repeats):
+    from sam2consensus_tpu.constants import NUM_SYMBOLS
+    from sam2consensus_tpu.ops import mxu_pileup
+    from sam2consensus_tpu.ops.pileup import _scatter_segments
+
+    rng = np.random.default_rng(7)
+    tile = mxu_pileup.TILE_POSITIONS
+    padded_len = -(-(genome_len + 1) // tile) * tile
+    starts = rng.integers(0, genome_len - width, rows).astype(np.int32)
+    codes = rng.integers(0, 6, (rows, width)).astype(np.uint8)
+    codes[rng.random(codes.shape) < 0.05] = 255
+    cells = rows * width
+
+    counts = jnp.zeros((padded_len, NUM_SYMBOLS), dtype=jnp.int32)
+
+    # --- scatter ---------------------------------------------------------
+    s_dev = jax.device_put(starts)
+    c_dev = jax.device_put(codes)
+    _ = _scatter_segments(counts, s_dev, c_dev, genome_len)  # warm compile
+    counts = jnp.zeros((padded_len, NUM_SYMBOLS), dtype=jnp.int32)
+
+    def run_scatter():
+        s = jax.device_put(starts)
+        c = jax.device_put(codes)
+        return _scatter_segments(jnp.zeros((padded_len, NUM_SYMBOLS),
+                                           jnp.int32), s, c, genome_len)
+
+    t_scatter, out_scatter = timed(run_scatter, repeats)
+    emit(op="pileup", impl="scatter", rows=rows, width=width,
+         genome_len=genome_len, sec=round(t_scatter, 5),
+         cells_per_sec=round(cells / t_scatter))
+
+    # --- mxu, padded transfer (round-1 layout) ---------------------------
+    plan = mxu_pileup.plan_tiles(starts, codes, padded_len, tile,
+                                 max_blowup=float("inf"))
+    _ = mxu_pileup.pileup_mxu(
+        jnp.zeros((padded_len, NUM_SYMBOLS), jnp.int32),
+        jnp.asarray(plan.loc), jnp.asarray(plan.codes), tile=tile,
+        n_tiles=plan.n_tiles, rows_per_tile=plan.rows_per_tile,
+        width=width)
+
+    def run_padded():
+        p = mxu_pileup.plan_tiles(starts, codes, padded_len, tile,
+                                  max_blowup=float("inf"))
+        return mxu_pileup.pileup_mxu(
+            jnp.zeros((padded_len, NUM_SYMBOLS), jnp.int32),
+            jnp.asarray(p.loc), jnp.asarray(p.codes), tile=tile,
+            n_tiles=p.n_tiles, rows_per_tile=p.rows_per_tile, width=width)
+
+    t_padded, out_padded = timed(run_padded, repeats)
+    t_plan0 = time.perf_counter()
+    for _ in range(repeats):
+        mxu_pileup.plan_tiles(starts, codes, padded_len, tile,
+                              max_blowup=float("inf"))
+    plan_padded_sec = (time.perf_counter() - t_plan0) / repeats
+    emit(op="pileup", impl="mxu_padded", rows=rows, width=width,
+         genome_len=genome_len, sec=round(t_padded, 5),
+         host_plan_sec=round(plan_padded_sec, 5),
+         wire_bytes=int(plan.loc.nbytes + plan.codes.nbytes),
+         blowup=round(plan.blowup, 2),
+         cells_per_sec=round(cells / t_padded))
+
+    # --- mxu, compact transfer (slot layout) -----------------------------
+    sp = mxu_pileup.plan_slots(starts, width, padded_len, tile,
+                               max_blowup=float("inf"))
+    _ = mxu_pileup.pileup_mxu_compact(
+        jnp.zeros((padded_len, NUM_SYMBOLS), jnp.int32),
+        jnp.asarray(starts), jnp.asarray(codes), jnp.asarray(sp.slot),
+        tile=tile, n_tiles=sp.n_tiles, rows_per_tile=sp.rows_per_tile,
+        width=width)
+
+    def run_compact():
+        p = mxu_pileup.plan_slots(starts, width, padded_len, tile,
+                                  max_blowup=float("inf"))
+        return mxu_pileup.pileup_mxu_compact(
+            jnp.zeros((padded_len, NUM_SYMBOLS), jnp.int32),
+            jnp.asarray(starts), jnp.asarray(codes), jnp.asarray(p.slot),
+            tile=tile, n_tiles=p.n_tiles, rows_per_tile=p.rows_per_tile,
+            width=width)
+
+    t_compact, out_compact = timed(run_compact, repeats)
+    t_plan0 = time.perf_counter()
+    for _ in range(repeats):
+        mxu_pileup.plan_slots(starts, width, padded_len, tile,
+                              max_blowup=float("inf"))
+    plan_compact_sec = (time.perf_counter() - t_plan0) / repeats
+    emit(op="pileup", impl="mxu_compact", rows=rows, width=width,
+         genome_len=genome_len, sec=round(t_compact, 5),
+         host_plan_sec=round(plan_compact_sec, 5),
+         wire_bytes=int(starts.nbytes + codes.nbytes + sp.slot.nbytes),
+         blowup=round(sp.blowup, 2),
+         cells_per_sec=round(cells / t_compact))
+
+    same = (np.array_equal(np.asarray(out_scatter)[:genome_len],
+                           np.asarray(out_padded)[:genome_len])
+            and np.array_equal(np.asarray(out_scatter)[:genome_len],
+                               np.asarray(out_compact)[:genome_len]))
+    emit(op="pileup", check="all_impls_equal", ok=bool(same))
+    return {"scatter": t_scatter, "mxu_padded": t_padded,
+            "mxu_compact": t_compact}
+
+
+def bench_insertion(n_sites, n_events, repeats):
+    from sam2consensus_tpu.ops import pallas_insertion
+    from sam2consensus_tpu.ops.insertions import build_insertion_table
+
+    rng = np.random.default_rng(11)
+    max_cols = 8
+    ev_key = np.sort(rng.integers(0, n_sites, n_events)).astype(np.int32)
+    ev_col = rng.integers(0, max_cols, n_events).astype(np.int32)
+    ev_code = rng.integers(0, 6, n_events).astype(np.int32)
+
+    kp = 1 << max(1, (n_sites + 1 - 1).bit_length())
+    cp = 1 << max(1, (max_cols - 1).bit_length())
+
+    def run_scatter():
+        table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
+        return build_insertion_table(table, jnp.asarray(ev_key),
+                                     jnp.asarray(ev_col),
+                                     jnp.asarray(ev_code))
+
+    _ = run_scatter()
+    t_scatter, out_scatter = timed(run_scatter, repeats)
+    emit(op="insertion_table", impl="scatter", sites=n_sites,
+         events=n_events, sec=round(t_scatter, 5),
+         events_per_sec=round(n_events / t_scatter))
+
+    interp = jax.default_backend() != "tpu"
+
+    def run_pallas():
+        return pallas_insertion.build_insertion_table_pallas(
+            ev_key, ev_col, ev_code, kp, cp, interpret=interp)
+
+    _ = run_pallas()
+    t_pallas, out_pallas = timed(run_pallas, repeats)
+    emit(op="insertion_table", impl="pallas", sites=n_sites,
+         events=n_events, sec=round(t_pallas, 5), interpret=interp,
+         events_per_sec=round(n_events / t_pallas))
+
+    same = np.array_equal(np.asarray(out_scatter),
+                          np.asarray(out_pallas))
+    emit(op="insertion_table", check="all_impls_equal", ok=bool(same))
+    return {"scatter": t_scatter, "pallas": t_pallas}
+
+
+def main():
+    rows = int(os.environ.get("MB_ROWS", "65536"))
+    width = int(os.environ.get("MB_WIDTH", "128"))
+    genome = int(os.environ.get("MB_GENOME", "4600000"))
+    repeats = int(os.environ.get("MB_REPEATS", "5"))
+    ins_sites = int(os.environ.get("MB_INS_SITES", "20000"))
+    ins_events = int(os.environ.get("MB_INS_EVENTS", "2000000"))
+
+    dev = jax.devices()[0]
+    emit(op="env", platform=dev.platform, device_kind=dev.device_kind,
+         n_devices=len(jax.devices()))
+    p = bench_pileup(rows, width, genome, repeats)
+    i = bench_insertion(ins_sites, ins_events, repeats)
+    emit(op="summary",
+         pileup_winner=min(p, key=p.get),
+         pileup_speedup_vs_scatter=round(p["scatter"] / min(p.values()), 2),
+         insertion_winner=min(i, key=i.get),
+         insertion_speedup_vs_scatter=round(
+             i["scatter"] / min(i.values()), 2))
+
+
+if __name__ == "__main__":
+    main()
