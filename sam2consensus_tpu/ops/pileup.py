@@ -76,6 +76,88 @@ def iter_row_slices(n_rows: int, width: int, multiple_of: int = 1):
         yield lo, min(n_rows, lo + step)
 
 
+class PileupAutoTuner:
+    """Online-autotune state machine shared by the single-device and dp
+    accumulators (see PileupAccumulator's docstring for the rationale).
+
+    Protocol per slab: ``choose(n_rows, width)`` -> (strategy, timing);
+    execute the slab; then call exactly one of ``report_skew()`` (the mxu
+    plan fell back) or ``complete(sec_per_cell)`` (pass the measured
+    per-cell seconds iff ``timing`` was True, else no argument).
+    ``stats`` is a dict once a winner is locked, else None.
+    """
+
+    STAGES = (("scatter", False), ("scatter", True),
+              ("mxu", False), ("mxu", True))
+    MAX_SKEW_RETRIES = 3
+
+    def __init__(self, min_cells: int = SCATTER_CELL_BUDGET >> 3):
+        self.min_cells = min_cells
+        self.times: dict = {}
+        self.stats = None
+        self._stage = 0
+        self._warm_shape = None
+        self._skew = 0
+        self._chosen = "scatter"
+        self._timing = False
+        self._advance = False
+
+    @property
+    def winner(self):
+        return self.times.get("winner")
+
+    def _lock(self, winner: str, **extra) -> None:
+        self.times["winner"] = winner
+        self.stats = {
+            "scatter_sec_per_mcell": round(
+                self.times.get("scatter", 0.0) * 1e6, 5),
+            "mxu_sec_per_mcell": round(
+                self.times.get("mxu", 0.0) * 1e6, 5),
+            "winner": winner, **extra}
+
+    def choose(self, n_rows: int, width: int):
+        self._timing = self._advance = False
+        if self.winner is not None:
+            self._chosen = self.winner
+        elif n_rows * width < self.min_cells:
+            # tiny slab: timing would be noise, cost is negligible
+            self._chosen = "scatter"
+        else:
+            self._chosen, is_timing_stage = self.STAGES[self._stage]
+            shape = (n_rows, width)
+            if not is_timing_stage:
+                self._warm_shape = shape        # warm slab
+                self._advance = True
+            elif shape != self._warm_shape:
+                # shape changed since the warm slab: this run would
+                # include jit compilation — re-warm, stay in stage
+                self._warm_shape = shape
+            else:
+                self._timing = self._advance = True
+        return self._chosen, self._timing
+
+    def report_skew(self) -> None:
+        """The mxu plan fell back to scatter on this slab."""
+        if self.winner is not None:
+            return
+        self._timing = self._advance = False
+        self._skew += 1
+        if self._skew >= self.MAX_SKEW_RETRIES:
+            # persistent skew: mxu would rarely engage anyway, and each
+            # retry pays the host planning scan — settle for scatter
+            self._lock("scatter", reason="mxu_skew")
+
+    def complete(self, sec_per_cell=None) -> None:
+        if self.winner is not None:
+            return
+        if self._timing:
+            self.times[self._chosen] = sec_per_cell
+            if "scatter" in self.times and "mxu" in self.times:
+                self._lock(min(("scatter", "mxu"), key=self.times.get))
+        if self._advance:
+            self._stage += 1
+
+
 class PileupAccumulator:
     """Streaming accumulator for one device (sharded use lives in parallel/).
 
