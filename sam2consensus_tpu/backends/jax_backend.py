@@ -37,6 +37,92 @@ from .base import BackendResult, BackendStats, FastaRecord, format_header
 SP_HALO = 1 << 16
 
 
+def _timed_iter(it, times, key: str = "decode_sec"):
+    """Yield from ``it``, accumulating the time spent inside ``next``."""
+    while True:
+        t0 = time.perf_counter()
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        times[key] += time.perf_counter() - t0
+        yield batch
+
+
+class _Prefetcher:
+    """Bounded background decode: overlap host decode with pileup work.
+
+    The producer thread drains the encoder generator (timing its decode
+    work into ``times``) into a depth-2 queue; the consumer iterates
+    batches as they land.  Exceptions — including strict-mode EncodeErrors,
+    whose type/message parity with the serial path is contract — are
+    re-raised in the consumer at the point of consumption.
+    """
+
+    _DONE = object()
+
+    def __init__(self, gen, times, depth: int = 2):
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._exc = None
+        self._times = times
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._work, args=(gen,), daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that aborts when the consumer called close()."""
+        import queue
+
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _work(self, gen) -> None:
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    batch = next(gen)
+                except StopIteration:
+                    break
+                self._times["decode_sec"] += time.perf_counter() - t0
+                if not self._put(batch):
+                    return                 # consumer gone; drop the rest
+        except BaseException as exc:  # re-raised on the consumer side
+            self._exc = exc
+        self._put(self._DONE)
+
+    def close(self) -> None:
+        """Unblock and join the producer (consumer exited early)."""
+        import queue
+
+        self._stop.set()
+        while self._thread.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                self._thread.join(timeout=0.05)
+        self._thread.join()
+
+    def __iter__(self):
+        while True:
+            batch = self._q.get()
+            if batch is self._DONE:
+                self._thread.join()
+                if self._exc is not None:
+                    raise self._exc
+                return
+            yield batch
+
+
 class JaxBackend:
     name = "jax"
 
@@ -48,9 +134,9 @@ class JaxBackend:
 
         from ..encoder.events import GenomeLayout, ReadEncoder, group_insertions
         from ..ops import fused
+        from ..ops.cutoff import encode_thresholds
         from ..ops.insertions import build_insertion_table, vote_insertions
         from ..ops.pileup import PileupAccumulator
-        from ..ops.vote import threshold_luts, vote_positions
 
         from ..io.sam import ReadStream
 
@@ -68,6 +154,11 @@ class JaxBackend:
 
             from ..parallel.base import block_for
 
+            if getattr(cfg, "pileup", "auto") == "host":
+                raise RuntimeError(
+                    "--pileup host is a single-device strategy (the count "
+                    "tensor accumulates on the host); drop --shards or "
+                    "pick a device pileup strategy")
             mode = getattr(cfg, "shard_mode", "auto")
             block = block_for(layout.total_len, shards)
             if mode == "auto":
@@ -100,8 +191,18 @@ class JaxBackend:
                                        pileup=getattr(cfg, "pileup", "auto"))
             stats.extra["shard_mode"] = mode
         else:
-            acc = PileupAccumulator(layout.total_len,
-                                    strategy=getattr(cfg, "pileup", "auto"))
+            from ..ops.pileup import HOST_PILEUP_MAX_LEN, \
+                HostPileupAccumulator
+
+            strategy = getattr(cfg, "pileup", "auto")
+            if strategy == "host" or (
+                    strategy == "auto"
+                    and layout.total_len <= HOST_PILEUP_MAX_LEN):
+                # wire-cost policy, measured on the tunneled chip: see
+                # HostPileupAccumulator's docstring
+                acc = HostPileupAccumulator(layout.total_len)
+            else:
+                acc = PileupAccumulator(layout.total_len, strategy=strategy)
 
         # checkpoint resume: counts + insertion log + consumed-line offset
         # are the entire job state (SURVEY.md §5)
@@ -176,56 +277,74 @@ class JaxBackend:
 
         t0 = time.perf_counter()
         reads_at_ckpt = 0
-        for batch in batches:
-            if cfg.paranoid:
-                self._paranoid_batch(batch, layout.total_len, stats)
-            acc.add(batch)
-            stats.aligned_bases += batch.n_events
-            if (cfg.checkpoint_dir
-                    and encoder.n_reads - reads_at_ckpt
-                    >= cfg.checkpoint_every):
-                self._write_checkpoint(cfg, records, acc, encoder, stats,
-                                       base_mapped, base_skipped,
-                                       prior_sources)
-                reads_at_ckpt = encoder.n_reads
+        decode_times = {"decode_sec": 0.0}
+        if cfg.checkpoint_dir:
+            # serial decode: a checkpoint must snapshot stream/encoder state
+            # consistent with the batches already committed to the counts,
+            # which a decode thread running ahead would break
+            batch_iter = _timed_iter(iter(batches), decode_times)
+        else:
+            # overlap host decode with pileup work (SURVEY.md §7(d)): a
+            # bounded prefetch thread decodes the next slabs while this
+            # thread feeds the accumulator (ctypes/C++ decode releases the
+            # GIL, so the overlap is real)
+            batch_iter = _Prefetcher(iter(batches), decode_times)
+        pileup_sec = 0.0
+        try:
+            for batch in batch_iter:
+                if cfg.paranoid:
+                    self._paranoid_batch(batch, layout.total_len, stats)
+                ta = time.perf_counter()
+                acc.add(batch)
+                pileup_sec += time.perf_counter() - ta
+                stats.aligned_bases += batch.n_events
+                if (cfg.checkpoint_dir
+                        and encoder.n_reads - reads_at_ckpt
+                        >= cfg.checkpoint_every):
+                    self._write_checkpoint(cfg, records, acc, encoder,
+                                           stats, base_mapped, base_skipped,
+                                           prior_sources)
+                    reads_at_ckpt = encoder.n_reads
+        finally:
+            # consumer-side failure (paranoid reject, device error) must not
+            # leave the decode thread blocked on a full queue holding the
+            # input stream open
+            if isinstance(batch_iter, _Prefetcher):
+                batch_iter.close()
         stats.reads_mapped = base_mapped + encoder.n_reads
         stats.reads_skipped = base_skipped + encoder.n_skipped
         stats.extra["shards"] = shards if use_sharded else 1
         stats.extra["decoder"] = encoder.__class__.__name__
         if getattr(acc, "strategy_used", None):
             stats.extra["pileup"] = dict(acc.strategy_used)
+        stats.extra["decode_sec"] = round(decode_times["decode_sec"], 4)
+        stats.extra["pileup_dispatch_sec"] = round(pileup_sec, 4)
         stats.extra["accumulate_sec"] = round(time.perf_counter() - t0, 4)
         if ck is not None and "incremental_base" not in stats.extra:
             stats.extra["resumed_from_line"] = ck.lines_consumed
 
-        # Post-accumulation tail in exactly two device round trips (each
-        # fetch of a computed array costs tens of ms on a tunneled chip):
-        # 1. coverage — fetched asynchronously while the host groups
-        #    insertion events; host needs it for the LUTs / gates / headers;
-        # 2. one fused dispatch (vote + insertion table + insertion vote)
-        #    returning one packed uint8 buffer.
+        # Post-accumulation tail in ONE device round trip (a dispatch→fetch
+        # costs ~65 ms on the tunneled chip and the link moves ~40 MB/s —
+        # tools/tunnel_probe.py): the host groups insertion events, then a
+        # single fused dispatch computes vote + insertion table + insertion
+        # vote + per-contig coverage sums + per-site coverage, returning one
+        # packed uint8 buffer.  Nothing depends on max(cov) because the
+        # threshold cutoffs are computed exactly on device (ops/cutoff.py).
         t0 = time.perf_counter()
-        if use_sharded:
-            cov = np.asarray(acc.counts_host().sum(axis=-1), dtype=np.int64)
-            ins = group_insertions(encoder.insertions, layout)
-            luts_np = threshold_luts(cfg.thresholds, int(cov.max(initial=0)))
-            t_luts = jnp.asarray(luts_np)   # device copy for insertion vote
-            syms, _cov_dev = acc.vote(luts_np, cfg.min_depth)
-        else:
-            counts = acc.counts                               # [L, 6] device
-            cov_dev = fused.coverage(counts)
-            cov_dev.copy_to_host_async()
-            ins = group_insertions(encoder.insertions, layout)  # overlaps
-            cov = np.asarray(cov_dev).astype(np.int64)
-            t_luts = jnp.asarray(
-                threshold_luts(cfg.thresholds, int(cov.max(initial=0))))
-        stats.extra["vote_sec"] = round(time.perf_counter() - t0, 4)
-        if cfg.paranoid:
-            self._paranoid_result(acc, cov, stats)
+        if stats.aligned_bases > np.iinfo(np.int32).max:
+            raise RuntimeError(
+                "total aligned bases exceed int32 — beyond the count "
+                "tensor's supported scale")
+        thr_enc_np = encode_thresholds(cfg.thresholds)
+        thr_enc = jnp.asarray(thr_enc_np)
+        offsets32 = layout.offsets.astype(np.int32)
+        ins = group_insertions(encoder.insertions, layout)
+        stats.extra["insertions_sec"] = round(time.perf_counter() - t0, 4)
 
         t0 = time.perf_counter()
         n_thresholds = len(cfg.thresholds)
         total_len = layout.total_len
+        n_contigs = len(layout.names)
         if ins is not None:
             k = len(ins["key_flat"])
             # pad sites and columns to powers of two: pad events scatter
@@ -233,27 +352,27 @@ class JaxBackend:
             # vote past n_cols and come back as skip sentinels
             kp = fused.next_pow2(k + 1)
             cp = fused.next_pow2(ins["max_cols"])
-            site_cov = np.where(ins["key_flat"] >= 0,
-                                cov[np.maximum(ins["key_flat"], 0)],
-                                0).astype(np.int32)
             use_pallas = getattr(cfg, "ins_kernel", "scatter") == "pallas"
 
-            def padded_scatter_inputs():
-                """Pad sites to kp and events to a power of two; pad events
-                scatter into the sacrificial row kp-1 (> k always)."""
-                scp = np.zeros(kp, dtype=np.int32)
-                scp[:k] = site_cov
-                ncp = np.zeros(kp, dtype=np.int32)
+            def padded_sites(pad_to):
+                sk = np.full(pad_to, -1, dtype=np.int32)
+                sk[:k] = ins["key_flat"].astype(np.int32)
+                ncp = np.zeros(pad_to, dtype=np.int32)
                 ncp[:k] = ins["n_cols"]
+                return sk, ncp
+
+            def padded_events(pad_rows_to):
+                """Pad events to a power of two; pad events scatter into
+                the sacrificial row pad_rows_to-1 (> k always)."""
                 e = len(ins["ev_key"])
                 ep = fused.next_pow2(max(e, 1))
-                ek = np.full(ep, kp - 1, dtype=np.int32)
+                ek = np.full(ep, pad_rows_to - 1, dtype=np.int32)
                 ek[:e] = ins["ev_key"]
                 ec = np.zeros(ep, dtype=np.int32)
                 ec[:e] = ins["ev_col"]
                 eb = np.zeros(ep, dtype=np.int32)
                 eb[:e] = ins["ev_code"]
-                return scp, ncp, ek, ec, eb
+                return ek, ec, eb
 
             if use_pallas:
                 from ..ops import pallas_insertion
@@ -263,73 +382,84 @@ class JaxBackend:
                 # padding (a KEY_BLOCK multiple), not the scatter kp
                 eplan = pallas_insertion.plan_events(
                     ins["ev_key"], ins["ev_col"], ins["ev_code"], k, cp)
-                sc = np.zeros(eplan.kp, dtype=np.int32)
-                sc[:k] = site_cov
-                nc = np.zeros(eplan.kp, dtype=np.int32)
-                nc[:k] = ins["n_cols"]
+                sk_pl, nc_pl = padded_sites(eplan.kp)
                 interp = jax.default_backend() != "tpu"
 
-            if use_sharded and use_pallas:
-                # the position vote already ran position-sharded
-                # (acc.vote); only the insertion table + vote remain, so
-                # the Pallas kernel runs standalone on the default device
-                out = pallas_insertion._table_call(
-                    jnp.asarray(eplan.key3), jnp.asarray(eplan.cc3),
-                    jnp.asarray(eplan.blk_lo), jnp.asarray(eplan.blk_n),
-                    kp=eplan.kp, c6p=eplan.c6p,
-                    max_blocks=eplan.max_blocks, interpret=interp)
-                table = out.reshape(eplan.kp, eplan.c6p)[
-                    :, : cp * 6].reshape(eplan.kp, cp, 6)
+            if use_sharded:
+                # position vote + stats run position-sharded; the insertion
+                # table + vote run on the default device (K is small)
+                sk, ncp = (sk_pl, nc_pl) if use_pallas \
+                    else padded_sites(kp)
+                contig_sums, site_cov_p = acc.tail_stats(offsets32, sk)
+                syms = acc.vote(thr_enc_np, cfg.min_depth)
+                site_cov = site_cov_p[:k]
+                sc_dev = jnp.asarray(site_cov_p.astype(np.int32))
+                if use_pallas:
+                    out = pallas_insertion._table_call(
+                        jnp.asarray(eplan.key3), jnp.asarray(eplan.cc3),
+                        jnp.asarray(eplan.blk_lo), jnp.asarray(eplan.blk_n),
+                        kp=eplan.kp, c6p=eplan.c6p,
+                        max_blocks=eplan.max_blocks, interpret=interp)
+                    table = out.reshape(eplan.kp, eplan.c6p)[
+                        :, : cp * 6].reshape(eplan.kp, cp, 6)
+                    stats.extra["insertion_kernel"] = "pallas"
+                else:
+                    ev_key, ev_col, ev_code = padded_events(kp)
+                    table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
+                    table = build_insertion_table(
+                        table, jnp.asarray(ev_key), jnp.asarray(ev_col),
+                        jnp.asarray(ev_code))
                 ins_syms = np.asarray(vote_insertions(
-                    table, jnp.asarray(sc), jnp.asarray(nc),
-                    t_luts))[:, :k, :]                        # [T, K, Cp]
-                stats.extra["insertion_kernel"] = "pallas"
-            elif use_sharded:
-                site_cov_p, n_cols_p, ev_key, ev_col, ev_code = \
-                    padded_scatter_inputs()
-                table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
-                table = build_insertion_table(
-                    table, jnp.asarray(ev_key), jnp.asarray(ev_col),
-                    jnp.asarray(ev_code))
-                ins_syms = np.asarray(vote_insertions(
-                    table, jnp.asarray(site_cov_p), jnp.asarray(n_cols_p),
-                    t_luts))[:, :k, :]                        # [T, K, Cp]
+                    table, sc_dev, jnp.asarray(ncp),
+                    thr_enc))[:, :k, :]                       # [T, K, Cp]
             elif use_pallas:
                 packed = fused.vote_packed_pallas(
-                    counts, t_luts, jnp.asarray(eplan.key3),
-                    jnp.asarray(eplan.cc3), jnp.asarray(eplan.blk_lo),
-                    jnp.asarray(eplan.blk_n), jnp.asarray(sc),
-                    jnp.asarray(nc), cfg.min_depth, cp, eplan.kp,
-                    eplan.c6p, eplan.max_blocks, interp)
+                    acc.counts, thr_enc, jnp.asarray(offsets32),
+                    jnp.asarray(sk_pl), jnp.asarray(nc_pl),
+                    jnp.asarray(eplan.key3), jnp.asarray(eplan.cc3),
+                    jnp.asarray(eplan.blk_lo), jnp.asarray(eplan.blk_n),
+                    cfg.min_depth, cp, eplan.kp, eplan.c6p,
+                    eplan.max_blocks, interp)
                 out = np.asarray(packed)
-                split = n_thresholds * total_len
-                syms = out[:split].reshape(n_thresholds, total_len)
-                ins_syms = out[split:].reshape(
-                    n_thresholds, eplan.kp, cp)[:, :k, :]     # [T, K, Cp]
+                syms, ins_syms, contig_sums, site_cov = self._unpack_tail(
+                    out, n_thresholds, total_len, eplan.kp, cp, n_contigs, k)
                 stats.extra["insertion_kernel"] = "pallas"
             else:
-                site_cov_p, n_cols_p, ev_key, ev_col, ev_code = \
-                    padded_scatter_inputs()
+                sk, ncp = padded_sites(kp)
+                ev_key, ev_col, ev_code = padded_events(kp)
                 packed = fused.vote_packed(
-                    counts, t_luts, jnp.asarray(ev_key), jnp.asarray(ev_col),
-                    jnp.asarray(ev_code), jnp.asarray(site_cov_p),
-                    jnp.asarray(n_cols_p), cfg.min_depth, cp)
+                    acc.counts, thr_enc, jnp.asarray(offsets32),
+                    jnp.asarray(sk), jnp.asarray(ncp), jnp.asarray(ev_key),
+                    jnp.asarray(ev_col), jnp.asarray(ev_code),
+                    cfg.min_depth, cp)
                 out = np.asarray(packed)
-                split = n_thresholds * total_len
-                syms = out[:split].reshape(n_thresholds, total_len)
-                ins_syms = out[split:].reshape(
-                    n_thresholds, kp, cp)[:, :k, :]           # [T, K, Cp]
+                syms, ins_syms, contig_sums, site_cov = self._unpack_tail(
+                    out, n_thresholds, total_len, kp, cp, n_contigs, k)
         else:
             site_cov = None
             ins_syms = None
-            if not use_sharded:
-                syms_dev, _ = vote_positions(counts, t_luts, cfg.min_depth)
-                syms = np.asarray(syms_dev)                   # [T, L] uint8
-        stats.extra["insertions_sec"] = round(time.perf_counter() - t0, 4)
+            if use_sharded:
+                contig_sums, _ = acc.tail_stats(
+                    offsets32, np.zeros(0, dtype=np.int32))
+                syms = acc.vote(thr_enc_np, cfg.min_depth)
+            else:
+                out = np.asarray(fused.vote_packed_simple(
+                    acc.counts, thr_enc, jnp.asarray(offsets32),
+                    cfg.min_depth))
+                split = n_thresholds * total_len
+                syms = out[:split].reshape(n_thresholds, total_len)
+                contig_sums = fused.unpack_i32(out[split:], n_contigs)
+        stats.extra["vote_sec"] = round(time.perf_counter() - t0, 4)
+        if getattr(acc, "strategy_used", None):
+            # refresh: the host-counts path records its wire dtype at upload
+            stats.extra["pileup"] = dict(acc.strategy_used)
+        if cfg.paranoid:
+            self._paranoid_result(acc, contig_sums, layout, stats,
+                                  ins=ins, site_cov=site_cov)
 
         t0 = time.perf_counter()
-        fastas = self._assemble(layout, syms, cov, ins, ins_syms, site_cov,
-                                cfg, stats)
+        fastas = self._assemble(layout, syms, contig_sums, ins, ins_syms,
+                                site_cov, cfg, stats)
         stats.extra["render_sec"] = round(time.perf_counter() - t0, 4)
 
         if cfg.checkpoint_dir:
@@ -371,6 +501,22 @@ class JaxBackend:
         stats.extra["checkpoints_written"] = (
             stats.extra.get("checkpoints_written", 0) + 1)
 
+    @staticmethod
+    def _unpack_tail(out: np.ndarray, n_thresholds: int, total_len: int,
+                     kp: int, cp: int, n_contigs: int, k: int):
+        """Split the fused tail's packed uint8 buffer (ops/fused.py)."""
+        from ..ops import fused
+
+        split1 = n_thresholds * total_len
+        split2 = split1 + n_thresholds * kp * cp
+        split3 = split2 + 4 * n_contigs
+        syms = out[:split1].reshape(n_thresholds, total_len)
+        ins_syms = out[split1:split2].reshape(
+            n_thresholds, kp, cp)[:, :k, :]                   # [T, K, Cp]
+        contig_sums = fused.unpack_i32(out[split2:split3], n_contigs)
+        site_cov = fused.unpack_i32(out[split3:], kp)[:k]
+        return syms, ins_syms, contig_sums, site_cov
+
     # -- paranoid mode (SURVEY.md §5 sanitizers) ---------------------------
     def _paranoid_batch(self, batch, total_len: int, stats) -> None:
         """Re-validate scatter inputs before they reach the device."""
@@ -392,16 +538,34 @@ class JaxBackend:
         stats.extra["paranoid_batches"] = (
             stats.extra.get("paranoid_batches", 0) + 1)
 
-    def _paranoid_result(self, acc, cov: np.ndarray, stats) -> None:
+    def _paranoid_result(self, acc, contig_sums: np.ndarray, layout,
+                         stats, ins=None, site_cov=None) -> None:
+        """Fetch the full count tensor and cross-check the device-computed
+        tail stats (contig sums AND per-site coverage — both feed emission
+        gates) against an independent host recomputation."""
         counts = acc.counts_host()
         if (counts < 0).any():
             raise RuntimeError("paranoid: negative pileup count")
-        if not np.array_equal(counts.sum(axis=-1), cov):
-            raise RuntimeError("paranoid: coverage != sum of count lanes")
+        cov = counts.sum(axis=-1, dtype=np.int64)
         if int(cov.sum()) != stats.aligned_bases:
             raise RuntimeError(
                 f"paranoid: device event total {int(cov.sum())} != host "
                 f"accounting {stats.aligned_bases}")
+        want = np.asarray([
+            cov[int(layout.offsets[i]):int(layout.offsets[i + 1])].sum()
+            for i in range(len(layout.names))], dtype=np.int64)
+        if not np.array_equal(np.asarray(contig_sums, dtype=np.int64), want):
+            raise RuntimeError(
+                "paranoid: device per-contig coverage sums diverge from "
+                "host recomputation")
+        if ins is not None and site_cov is not None:
+            kf = ins["key_flat"]
+            want_sc = np.where(kf >= 0, cov[np.maximum(kf, 0)], 0)
+            if not np.array_equal(np.asarray(site_cov, dtype=np.int64),
+                                  want_sc.astype(np.int64)):
+                raise RuntimeError(
+                    "paranoid: device per-site coverage diverges from "
+                    "host recomputation")
         stats.extra["paranoid_result_ok"] = True
 
     def _make_encoder(self, layout, records, cfg: RunConfig):
@@ -429,24 +593,28 @@ class JaxBackend:
         return enc, enc.encode_segments(source, cfg.chunk_reads)
 
     # -- host-side rendering ---------------------------------------------
-    def _assemble(self, layout, syms: np.ndarray, cov: np.ndarray, ins,
-                  ins_syms, site_cov, cfg: RunConfig,
+    def _assemble(self, layout, syms: np.ndarray, contig_sums: np.ndarray,
+                  ins, ins_syms, site_cov, cfg: RunConfig,
                   stats: BackendStats) -> Dict[str, List[FastaRecord]]:
+        """Render FASTA records from device outputs.  Coverage facts arrive
+        pre-reduced from the fused tail (ops/fused.py): per-contig sums and
+        per-insertion-site depths — the full [L] coverage vector never
+        reaches the host."""
         n_thresholds = syms.shape[0]
         fastas: Dict[str, List[FastaRecord]] = {}
 
         for ci, name in enumerate(layout.names):
             off = int(layout.offsets[ci])
             length = int(layout.lengths[ci])
-            ref_cov = cov[off:off + length]
-            sumcov_base = int(ref_cov.sum())
+            sumcov_base = int(contig_sums[ci])
             if sumcov_base == 0:
                 continue  # zero-coverage prune (sam2consensus.py:334-340)
 
             # insertion sites for this contig, emittable ones only:
             # local key within [0, length) and site depth passes the gates
             # (emission is nested inside cov>0 and cov>=min_depth branches,
-            # sam2consensus.py:356-385).
+            # sam2consensus.py:356-385).  site_cov[row] is exactly
+            # cov[off + local] for these rows (fused tail gather).
             site_rows = np.zeros(0, dtype=np.int64)
             if ins is not None:
                 mask = ((ins["key_contig"] == ci)
@@ -456,8 +624,8 @@ class JaxBackend:
                 locs = ins["key_local"][site_rows].astype(np.int64)
                 order = np.argsort(locs, kind="stable")
                 site_rows, locs = site_rows[order], locs[order]
-                depth_ok = (cov[off + locs] > 0) & (
-                    cov[off + locs] >= cfg.min_depth)
+                sc = site_cov[site_rows]
+                depth_ok = (sc > 0) & (sc >= cfg.min_depth)
                 site_rows, locs = site_rows[depth_ok], locs[depth_ok]
 
             for t in range(n_thresholds):

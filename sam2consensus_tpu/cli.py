@@ -79,14 +79,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--paranoid", action="store_true",
                    help="re-validate device inputs and outputs every batch "
                         "(index bounds, symbol codes, count invariants)")
-    p.add_argument("--pileup", choices=["auto", "mxu", "scatter"],
+    p.add_argument("--pileup", choices=["auto", "mxu", "scatter", "host"],
                    default="auto",
-                   help="device pileup strategy: auto (online autotune — "
-                        "times scatter and mxu on early slabs and keeps "
-                        "the measured winner; single-device), XLA "
-                        "scatter-add, or MXU one-hot matmul (falls back "
-                        "to scatter on skewed coverage). Both kernels "
-                        "compose with --shards in the dp shard layout")
+                   help="pileup strategy: auto (host-counts on genomes up "
+                        "to ~2M positions — least wire on a tunneled chip "
+                        "— else online autotune between the device "
+                        "kernels), XLA scatter-add, MXU one-hot matmul "
+                        "(falls back to scatter on skewed coverage), or "
+                        "host (accumulate counts in native code, ship the "
+                        "tensor once; single-device). scatter/mxu compose "
+                        "with --shards in the dp shard layout")
     p.add_argument("--insertion-kernel", dest="ins_kernel",
                    choices=["scatter", "pallas"], default="scatter",
                    help="insertion-table build on device: XLA scatter "
@@ -189,6 +191,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if cfg.pileup == "mxu" and cfg.shard_mode == "sp":
         raise SystemExit("--pileup mxu composes with the dp shard layout "
                          "only; use --shard-mode dp")
+    if cfg.pileup == "host" and cfg.shards > 1:
+        raise SystemExit("--pileup host accumulates on the single host; "
+                         "it does not compose with --shards")
     if cfg.checkpoint_dir and cfg.backend != "jax":
         raise SystemExit("--checkpoint-dir requires --backend jax")
     if cfg.incremental and not cfg.checkpoint_dir:

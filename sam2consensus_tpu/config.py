@@ -35,7 +35,7 @@ class RunConfig:
     strict: bool = True          # strict: error on invalid bases / out-of-range
     py2_compat: bool = False
     decoder: str = "auto"        # auto | native | py (jax backend host decode)
-    pileup: str = "auto"         # auto | mxu | scatter (device pileup strategy)
+    pileup: str = "auto"         # auto | mxu | scatter | host (pileup strategy)
     ins_kernel: str = "scatter"  # scatter | pallas (insertion table build)
     shard_mode: str = "auto"     # auto | dp | sp (sharded accumulator layout)
     incremental: bool = False    # keep/extend checkpoints across input files

@@ -4,7 +4,7 @@ There is no neural network in this workload; the framework's model is the
 consensus caller itself (SURVEY.md north star).  ``make_consensus_model``
 closes over the static genome geometry and returns a pure function
 
-    forward(starts, codes, t_luts) -> (syms, cov)
+    forward(starts, codes, thr_enc) -> (syms, cov)
 
 that expands one batch of read segment rows (flat-genome start + uint8 code
 row, ``encoder.events.SegmentBatch``), scatter-adds them into a fresh count
@@ -29,12 +29,12 @@ def make_consensus_model(total_len: int, min_depth: int = 1) -> Callable:
     """Return the jittable forward step for a genome of ``total_len``."""
 
     def forward(starts: jax.Array, codes: jax.Array,
-                t_luts: jax.Array) -> Tuple[jax.Array, jax.Array]:
+                thr_enc: jax.Array) -> Tuple[jax.Array, jax.Array]:
         from ..ops.pileup import expand_segment_positions
 
         pos, code = expand_segment_positions(starts, codes, total_len)
         counts = jnp.zeros((total_len + 1, NUM_SYMBOLS), dtype=jnp.int32)
         counts = counts.at[pos, code].add(1)[:-1]
-        return vote_block(counts, t_luts, min_depth)
+        return vote_block(counts, thr_enc, min_depth)
 
     return forward

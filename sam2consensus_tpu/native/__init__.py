@@ -97,6 +97,12 @@ def load() -> Optional[ctypes.CDLL]:
         i64p, ctypes.c_long,                   # overflow_off, cap
         i64p,                                  # out stats
     ]
+    lib.s2c_accumulate_rows.restype = None
+    lib.s2c_accumulate_rows.argtypes = [
+        i32p, u8p,                             # starts, codes
+        ctypes.c_long, ctypes.c_long,          # n_rows, width
+        i32p, ctypes.c_long,                   # counts [L*6], total_len
+    ]
     _lib = lib
     return _lib
 

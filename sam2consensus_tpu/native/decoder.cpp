@@ -616,3 +616,29 @@ extern "C" long s2c_decode(
   out[oMaxSpan] = max_span;
   return status;
 }
+
+// ---------------------------------------------------------------------------
+// Host-side pileup accumulation over decoded segment-row slabs.
+//
+// Companion to the host-counts pileup strategy (ops/pileup.py
+// HostPileupAccumulator): when aligned bases far exceed L*6 count cells
+// (deep coverage / small genomes), shipping the count tensor once beats
+// shipping ~1 byte per aligned base over the ~40 MB/s tunneled link, and
+// this pass — a plain slab walk at memory speed — rides with decode.
+// Cells outside [0, total_len) or with non-symbol codes (PAD) are skipped,
+// mirroring the device scatter's sacrificial-row redirect.
+extern "C" void s2c_accumulate_rows(
+    const int32_t* starts, const unsigned char* codes,
+    long n_rows, long width, int32_t* counts /* [total_len * 6] */,
+    long total_len) {
+  for (long r = 0; r < n_rows; ++r) {
+    const int64_t start = starts[r];
+    const unsigned char* row = codes + static_cast<int64_t>(r) * width;
+    for (long c = 0; c < width; ++c) {
+      const unsigned char code = row[c];
+      const int64_t pos = start + c;
+      if (code < 6 && pos >= 0 && pos < total_len)
+        ++counts[pos * 6 + code];
+    }
+  }
+}

@@ -1,0 +1,177 @@
+"""Exact device-side threshold cutoffs: ``ceil(float64(t) * cov)`` in int32.
+
+The reference's greedy vote compares an integer running total against the
+Python float product ``t * coverage`` (``/root/reference/sam2consensus.py:
+359-367`` — a float64 multiply, then an int-vs-float comparison).  Rounds
+1-2 made this exact on device with a host-precomputed LUT
+``lut[cov] = ceil(float64(t) * cov)``; correct, but with two measured costs
+on the tunneled chip (tools/tunnel_probe.py):
+
+* the LUT's size depends on ``max(cov)``, whose host fetch is a ~65 ms
+  round trip that *serializes* the post-accumulation tail;
+* the ``[L]``-wide table gather costs ~46 ms at L = 4.6 M, while every
+  non-gather op in the vote measures ~free (TPU vector units hate gathers,
+  love elementwise int32).
+
+This module deletes the LUT: it evaluates ``ceil(fl64(t * cov))`` exactly
+with int32 limb arithmetic on device — *including the float64 rounding of
+the product* (round-to-nearest-even at 53 bits), which the LUT inherited
+from numpy and which must be reproduced bit-for-bit for byte-identity with
+the oracle:
+
+1. host: ``t = M * 2^(e-53)`` exactly (``math.frexp``; M is t's 53-bit
+   integer mantissa), shipped as four 14-bit limbs of M plus e — five
+   int32s per threshold (``encode_thresholds``);
+2. device: ``P = M * cov`` in base-2^14 limbs (every partial product and
+   carry column stays < 2^30, int32-safe);
+3. round P to 53 significant bits (RNE) → Q', the exact mantissa of
+   ``fl64(t * cov)``;
+4. ``cutoff = ceil(Q' * 2^(r+e-53))`` via two-word integer shifts, clamped
+   to ``[0, 2^31-1]`` — the clamp preserves the predicate ``S < cutoff``
+   for every achievable S (S ≤ cov < 2^31).
+
+Everything is elementwise int32 — no gathers, no tables — so XLA fuses it
+into the vote at ~zero cost.  ``tests/test_cutoff.py`` pins equality with
+``threshold_luts`` (numpy's float64 product) exhaustively over coverage
+ranges and property-based over random doubles.
+
+Supported domain (documented contract): ``t > 0`` finite, ``0 ≤ cov < 2^31``
+— the reference itself is int32-bounded here because total aligned bases
+are counted in int32.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+LIMB = 14
+MASK = (1 << LIMB) - 1
+INT32_MAX = (1 << 31) - 1
+
+
+def encode_thresholds(thresholds: Sequence[float]) -> np.ndarray:
+    """Pack thresholds as int32 ``[T, 5]``: four 14-bit mantissa limbs + e.
+
+    ``t = M * 2^(e-53)`` exactly, with ``M = int(frexp(t).frac * 2^53)``
+    (t's full 53-bit mantissa, so no precision is lost for any double).
+    """
+    rows = []
+    for t in thresholds:
+        t = float(t)
+        if not (t > 0.0) or not math.isfinite(t):
+            raise ValueError(f"threshold must be a positive finite float, "
+                             f"got {t!r}")
+        frac, e = math.frexp(t)            # t = frac * 2^e, frac in [0.5, 1)
+        m = int(frac * (1 << 53))          # exact: frac has <= 53 sig bits
+        rows.append([m & MASK, (m >> LIMB) & MASK, (m >> (2 * LIMB)) & MASK,
+                     (m >> (3 * LIMB)) & MASK, e - 53])
+    return np.asarray(rows, dtype=np.int32)
+
+
+def exact_cutoff(cov, enc_row):
+    """``ceil(fl64(t * cov))`` for int32 ``cov >= 0``; pure traceable fn.
+
+    Args:
+      cov: int32 array (any shape), each value in ``[0, 2^31)``.
+      enc_row: int32 ``[5]`` — one row of :func:`encode_thresholds`.
+
+    Returns:
+      int32 cutoffs, same shape as ``cov``, clamped to ``[0, 2^31-1]``.
+    """
+    cov = cov.astype(jnp.int32)
+    m0, m1, m2, m3, e = (enc_row[0], enc_row[1], enc_row[2], enc_row[3],
+                         enc_row[4])
+
+    c0 = cov & MASK
+    c1 = (cov >> LIMB) & MASK
+    c2 = (cov >> (2 * LIMB)) & MASK                      # < 2^3
+
+    # P = M * cov, base-2^14 columns; each column < 3*2^28 + carry < 2^30
+    cols = (m0 * c0,
+            m0 * c1 + m1 * c0,
+            m0 * c2 + m1 * c1 + m2 * c0,
+            m1 * c2 + m2 * c1 + m3 * c0,
+            m2 * c2 + m3 * c1,
+            m3 * c2)
+    p = []
+    carry = jnp.zeros_like(cov)
+    for col in cols:
+        cur = col + carry
+        p.append(cur & MASK)
+        carry = cur >> LIMB
+    p.append(carry)                        # p6 == 0 (P < 2^84); pads selects
+
+    # bit length of cov (valid for cov >= 1; cov == 0 handled at the end)
+    x = cov
+    blc = jnp.zeros_like(cov)
+    for s in (16, 8, 4, 2, 1):
+        big = x >= (1 << s)
+        blc = blc + jnp.where(big, s, 0)
+        x = jnp.where(big, x >> s, x)
+    blc = blc + 1                                         # floor(log2)+1
+
+    # nbits(P) is blc+52 or blc+53: test bit blc+52 of P
+    k = blc + 52
+    kl = k // LIMB                                        # in {3, 4, 5}
+    kb = k % LIMB
+    lk = jnp.where(kl == 3, p[3], jnp.where(kl == 4, p[4], p[5]))
+    topbit = (lk >> kb) & 1
+    r = blc + topbit - 1                                  # nbits-53, [0, 31]
+
+    # R = P mod 2^r -> round + sticky bits (RNE)
+    low31 = p[0] | (p[1] << LIMB) | ((p[2] & 0x7) << (2 * LIMB))
+    rm1 = jnp.maximum(r - 1, 0)
+    mask_r = jnp.where(r > 0, (jnp.left_shift(1, rm1) - 1) * 2 + 1, 0)
+    rr = low31 & mask_r
+    rnd = jnp.where(r > 0, (rr >> rm1) & 1, 0)
+    sticky = (rr & (jnp.left_shift(1, rm1) - 1)) != 0
+
+    # Q = P >> r as four 14-bit limbs (53 bits)
+    rl = r // LIMB                                        # in {0, 1, 2}
+    rb = r % LIMB
+
+    def sel(i):
+        return jnp.where(rl == 0, p[i], jnp.where(rl == 1, p[i + 1],
+                                                  p[i + 2]))
+
+    q = []
+    for i in range(4):
+        li, ln = sel(i), sel(i + 1)
+        q.append(((li >> rb) | (ln << (LIMB - rb))) & MASK)
+    q_lo = q[0] | (q[1] << LIMB)                          # bits 0..27
+    q_hi = q[2] | (q[3] << LIMB)                          # bits 28..55 (<2^25)
+
+    # round to nearest even -> Q' in (q_lo, q_hi), possibly 2^53 exactly
+    odd = (q[0] & 1) == 1
+    inc = jnp.where((rnd == 1) & (sticky | odd), 1, 0)
+    q_lo = q_lo + inc
+    q_hi = q_hi + (q_lo >> 28)
+    q_lo = q_lo & ((1 << 28) - 1)
+
+    # cutoff = ceil(Q' * 2^(r+e)), e already biased by -53; s = right shift
+    s = -e - r
+    s_c = jnp.clip(s, 1, 53)
+
+    # s in [1, 27]: shift across both words; pre-clamp values >= 2^31
+    s1 = jnp.clip(s_c, 1, 27)
+    over1 = q_hi >= jnp.left_shift(1, s1 + 3)             # Q' >= 2^(31+s)
+    hi_safe = jnp.where(over1, 0, q_hi)
+    floor1 = jnp.left_shift(hi_safe, 28 - s1) | (q_lo >> s1)
+    rem1 = (q_lo & (jnp.left_shift(1, s1) - 1)) != 0
+    ceil1 = floor1 + rem1
+    ceil1 = jnp.where(over1 | (ceil1 < 0), INT32_MAX, ceil1)
+
+    # s in [28, 53]: high word only
+    s2 = jnp.clip(s_c - 28, 0, 25)
+    floor2 = q_hi >> s2
+    rem2 = ((q_hi & (jnp.left_shift(1, s2) - 1)) != 0) | (q_lo != 0)
+    ceil2 = floor2 + rem2
+
+    cutoff = jnp.where(s_c < 28, ceil1, ceil2)
+    cutoff = jnp.where(s <= 0, INT32_MAX,                 # value >= 2^52
+                       jnp.where(s >= 54, 1, cutoff))     # 0 < value < 1
+    return jnp.where(cov == 0, 0, cutoff).astype(jnp.int32)

@@ -1,22 +1,32 @@
-"""One-dispatch pipeline tail: position vote + insertion table + vote.
+"""One-dispatch pipeline tail: vote + insertion table + host-facing stats.
 
-On a tunneled TPU every dispatch→fetch round trip costs tens of
-milliseconds, which dwarfs the actual vote compute (an elementwise int32
-reduction).  So the whole post-accumulation tail runs as ONE jitted call
-producing ONE packed uint8 buffer:
+On a tunneled TPU every dispatch→fetch round trip costs ~65 ms and the
+link moves ~40 MB/s (tools/tunnel_probe.py), which dwarfs the actual vote
+compute (an elementwise int32 reduction, measured ~free).  So the whole
+post-accumulation tail runs as ONE jitted call producing ONE packed uint8
+buffer:
 
-    [ syms  T*L  |  insertion syms  T*Kp*Cp ]
+    [ syms T*L | insertion syms T*Kp*Cp | contig cov sums C*4 | site cov Kp*4 ]
 
-and the host does exactly two device round trips after accumulation:
+and the host does exactly ONE device round trip after accumulation.  The
+stats tail replaces the round-2 flow (fetch the full [L] coverage vector —
+18 MB ≈ 450 ms at L = 4.6 M — then build LUTs, then dispatch the vote):
 
-1. fetch coverage (needed on host anyway for the threshold LUTs, the
-   min-depth gates and the FASTA headers) — started asynchronously so the
-   host's insertion grouping overlaps the transfer;
-2. fetch the packed vote output.
+* per-contig coverage sums (for FASTA headers and the zero-coverage prune)
+  come from one cumulative sum, differenced at the contig offsets;
+* per-insertion-site coverage (for min-depth gates, header sums and the
+  insertion vote's cutoffs) is a K-wide gather, K ~ thousands;
+* the threshold cutoffs are computed exactly on device
+  (``ops.cutoff.exact_cutoff``), so nothing in the tail depends on
+  ``max(cov)`` and no LUT round trip exists at all.
 
 Insertion-site count ``Kp`` and column count ``Cp`` are padded to powers of
 two so the jit cache stays O(log²) across runs; pad events scatter into the
 sacrificial last table row, whose votes the host slices off.
+
+Int32 note: the cumulative coverage sum is exact while total aligned bases
+stay < 2^31 — the same bound the int32 count tensor already imposes; the
+backend enforces it host-side.
 """
 
 from __future__ import annotations
@@ -30,53 +40,98 @@ from .insertions import build_insertion_table, vote_insertions
 from .vote import vote_block
 
 
+def next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
 @jax.jit
 def coverage(counts: jax.Array) -> jax.Array:
     """Per-position depth ``[L]`` — gaps and Ns count (quirk 5)."""
     return counts.sum(axis=-1)
 
 
-@partial(jax.jit, static_argnames=("min_depth", "cp"))
-def vote_packed(counts: jax.Array, t_luts: jax.Array, ev_key: jax.Array,
-                ev_col: jax.Array, ev_code: jax.Array, site_cov: jax.Array,
-                n_cols: jax.Array, min_depth: int, cp: int) -> jax.Array:
-    """Position vote + insertion table build + insertion vote, packed uint8.
+def _bytes_of_i32(x: jax.Array) -> jax.Array:
+    """Portable little-endian byte split of an int32 vector → uint8 [n*4]."""
+    parts = [((x >> (8 * i)) & 0xFF).astype(jnp.uint8) for i in range(4)]
+    return jnp.stack(parts, axis=-1).reshape(-1)
 
-    ``site_cov``/``n_cols`` are the padded ``[Kp]`` site arrays; ``cp`` is
-    the padded insertion-table column count (static).
+
+def unpack_i32(buf, n: int):
+    """Host-side inverse of :func:`_bytes_of_i32` (numpy uint8 slice)."""
+    import numpy as np
+
+    b = np.asarray(buf, dtype=np.uint8).reshape(n, 4).astype(np.uint32)
+    out = b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24)
+    return out.astype(np.int64)
+
+
+def _tail_stats(cov: jax.Array, offsets: jax.Array, site_keys: jax.Array):
+    """(contig_sums [C], site_cov [Kp]) from resident coverage."""
+    prefix = jnp.concatenate(
+        [jnp.zeros(1, dtype=cov.dtype), jnp.cumsum(cov)])
+    contig_sums = prefix[offsets[1:]] - prefix[offsets[:-1]]
+    safe = jnp.maximum(site_keys, 0)
+    site_cov = jnp.where(site_keys >= 0, cov[safe], 0).astype(jnp.int32)
+    return contig_sums.astype(jnp.int32), site_cov
+
+
+@partial(jax.jit, static_argnames=("min_depth",))
+def vote_packed_simple(counts: jax.Array, thr_enc: jax.Array,
+                       offsets: jax.Array, min_depth: int) -> jax.Array:
+    """No-insertion tail: position vote + contig sums, one packed buffer."""
+    syms, cov = vote_block(counts, thr_enc, min_depth)          # [T, L]
+    contig_sums, _ = _tail_stats(cov, offsets,
+                                 jnp.full((1,), -1, jnp.int32))
+    return jnp.concatenate([syms.reshape(-1), _bytes_of_i32(contig_sums)])
+
+
+@partial(jax.jit, static_argnames=("min_depth", "cp"))
+def vote_packed(counts: jax.Array, thr_enc: jax.Array, offsets: jax.Array,
+                site_keys: jax.Array, n_cols: jax.Array, ev_key: jax.Array,
+                ev_col: jax.Array, ev_code: jax.Array,
+                min_depth: int, cp: int) -> jax.Array:
+    """Position vote + insertion table + insertion vote + stats, packed.
+
+    ``site_keys``/``n_cols`` are the padded ``[Kp]`` site arrays
+    (flat genome position, -1 for end-of-contig and pad sites); ``cp`` is
+    the padded insertion-table column count (static).  Pad events scatter
+    into the sacrificial row Kp-1.
     """
-    syms, _cov = vote_block(counts, t_luts, min_depth)          # [T, L]
-    kp = site_cov.shape[0]
+    syms, cov = vote_block(counts, thr_enc, min_depth)          # [T, L]
+    contig_sums, site_cov = _tail_stats(cov, offsets, site_keys)
+    kp = site_keys.shape[0]
     table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
     table = build_insertion_table(table, ev_key, ev_col, ev_code)
-    ins_syms = vote_insertions(table, site_cov, n_cols, t_luts)  # [T, Kp, Cp]
-    return jnp.concatenate([syms.reshape(-1), ins_syms.reshape(-1)])
-
-
-def next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1)).bit_length()
+    ins_syms = vote_insertions(table, site_cov, n_cols, thr_enc)  # [T,Kp,Cp]
+    return jnp.concatenate([
+        syms.reshape(-1), ins_syms.reshape(-1),
+        _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)])
 
 
 @partial(jax.jit, static_argnames=("min_depth", "cp", "kp", "c6p",
                                    "max_blocks", "interpret"))
-def vote_packed_pallas(counts: jax.Array, t_luts: jax.Array,
-                       key3: jax.Array, cc3: jax.Array, blk_lo: jax.Array,
-                       blk_n: jax.Array, site_cov: jax.Array,
-                       n_cols: jax.Array, min_depth: int, cp: int, kp: int,
-                       c6p: int, max_blocks: int,
-                       interpret: bool = False) -> jax.Array:
+def vote_packed_pallas(counts: jax.Array, thr_enc: jax.Array,
+                       offsets: jax.Array, site_keys: jax.Array,
+                       n_cols: jax.Array, key3: jax.Array, cc3: jax.Array,
+                       blk_lo: jax.Array, blk_n: jax.Array,
+                       min_depth: int, cp: int, kp: int, c6p: int,
+                       max_blocks: int, interpret: bool = False) -> jax.Array:
     """``vote_packed`` with the insertion table built by the Pallas
     segmented-reduce kernel (ops/pallas_insertion.py) instead of the XLA
     scatter — still one dispatch, one packed uint8 result.
 
     Inputs are the kernel's host-planned arrays (key-sorted event blocks +
-    CSR block ranges); ``site_cov``/``n_cols`` are padded to ``kp``.
+    CSR block ranges); ``site_keys``/``n_cols`` are padded to the KERNEL's
+    key padding ``kp`` (a KEY_BLOCK multiple), not the scatter padding.
     """
     from .pallas_insertion import _table_call
 
-    syms, _cov = vote_block(counts, t_luts, min_depth)          # [T, L]
+    syms, cov = vote_block(counts, thr_enc, min_depth)          # [T, L]
+    contig_sums, site_cov = _tail_stats(cov, offsets, site_keys)
     out = _table_call(key3, cc3, blk_lo, blk_n, kp=kp, c6p=c6p,
                       max_blocks=max_blocks, interpret=interpret)
     table = out.reshape(kp, c6p)[:, : cp * 6].reshape(kp, cp, 6)
-    ins_syms = vote_insertions(table, site_cov, n_cols, t_luts)
-    return jnp.concatenate([syms.reshape(-1), ins_syms.reshape(-1)])
+    ins_syms = vote_insertions(table, site_cov, n_cols, thr_enc)
+    return jnp.concatenate([
+        syms.reshape(-1), ins_syms.reshape(-1),
+        _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)])

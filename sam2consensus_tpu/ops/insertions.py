@@ -22,8 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..constants import IUPAC_MASK_LUT
-from .vote import FILL_SENTINEL
+from .cutoff import exact_cutoff
+from .vote import FILL_SENTINEL, iupac_select
 
 
 @jax.jit
@@ -35,7 +35,7 @@ def build_insertion_table(table: jax.Array, ev_key: jax.Array,
 
 @jax.jit
 def vote_insertions(table: jax.Array, site_cov: jax.Array,
-                    n_cols: jax.Array, t_luts: jax.Array) -> jax.Array:
+                    n_cols: jax.Array, thr_enc: jax.Array) -> jax.Array:
     """Vote every insertion column for every threshold.
 
     Args:
@@ -44,7 +44,8 @@ def vote_insertions(table: jax.Array, site_cov: jax.Array,
         (0 for end-of-contig sites) — the cutoff uses the SITE's coverage,
         not the column sum (sam2consensus.py:376).
       n_cols: int32 ``[K]`` valid column count per site (longest motif).
-      t_luts: int32 ``[T, max_cov+1]``.
+      thr_enc: int32 ``[T, 5]`` encoded thresholds
+        (``ops.cutoff.encode_thresholds``).
 
     Returns:
       uint8 ``[T, K, C]``: output byte per column; FILL_SENTINEL where the
@@ -60,15 +61,14 @@ def vote_insertions(table: jax.Array, site_cov: jax.Array,
         jnp.where(greater, completed[..., None, :], 0), axis=-1)  # [K, C, 6]
     nonzero = completed != 0
     bit = (1 << jnp.arange(6, dtype=jnp.int32))
-    lut = jnp.asarray(IUPAC_MASK_LUT)
     valid = (jnp.arange(table.shape[1])[None, :] < n_cols[:, None])  # [K, C]
 
-    def per_threshold(tlut):
-        cutoff = tlut[site_cov]                                # [K]
+    def per_threshold(enc_row):
+        cutoff = exact_cutoff(site_cov, enc_row)               # [K]
         included = nonzero & (strictly_greater_sum < cutoff[:, None, None])
         mask = jnp.sum(jnp.where(included, bit, 0), axis=-1)   # [K, C]
-        syms = lut[mask]
+        syms = iupac_select(mask)
         skip = (syms == ord("-")) | ~valid
         return jnp.where(skip, jnp.uint8(FILL_SENTINEL), syms)
 
-    return jax.vmap(per_threshold)(t_luts)
+    return jax.vmap(per_threshold)(thr_enc)

@@ -158,6 +158,86 @@ class PileupAutoTuner:
             self._stage += 1
 
 
+#: "auto" picks the host-counts strategy when the genome is at most this
+#: many positions: the count tensor's one-time wire cost (<= L*6*2 bytes,
+#: dtype-narrowed) is then bounded by ~24 MB while the row stream costs
+#: ~1 byte per aligned base — at any depth >= ~12x the counts win, and at
+#: low depth on a genome this small both are cheap.  Larger genomes keep
+#: the device pileup, whose wire bill scales with aligned bases, not L.
+HOST_PILEUP_MAX_LEN = 1 << 21
+
+
+class HostPileupAccumulator:
+    """Host-side counts accumulation: ship the count tensor, not the reads.
+
+    Measured rationale (tools/tunnel_probe.py): the tunneled chip moves
+    ~40 MB/s each way with ~65 ms round-trip latency, so the device pileup
+    pays ~1 byte per aligned base on the wire while the count tensor is
+    only ``L*6`` cells.  Whenever aligned bases >> L*6 (deep coverage,
+    small genomes — e.g. amplicon at 100k depth: 8 MB of rows vs 9.6 KB of
+    counts), accumulating on host and shipping COUNTS once is strictly
+    less wire, and the host pass (native C++ slab walk, memory-speed)
+    rides with decode.  The TPU still runs the whole tail: vote, insertion
+    table, stats (ops/fused.py).
+
+    The count tensor is the same sum-decomposable state as the device
+    accumulator's, so checkpoint / resume / incremental / paranoid
+    semantics are unchanged (SURVEY.md §5); ``counts`` uploads with the
+    narrowest dtype that holds ``max(counts)`` (uint8/uint16/int32) and
+    the device vote widens to int32 on arrival.
+    """
+
+    def __init__(self, total_len: int):
+        from .. import native
+
+        self.total_len = total_len
+        self._counts = np.zeros((total_len, NUM_SYMBOLS), dtype=np.int32)
+        self._lib = native.load()              # None -> numpy fallback
+        self._device_counts = None
+        self.strategy_used: dict = {"host": 0}
+
+    def add(self, batch: SegmentBatch) -> None:
+        self._device_counts = None
+        flat = self._counts.reshape(-1)
+        for w, (starts, codes) in sorted(batch.buckets.items()):
+            if self._lib is not None:
+                self._lib.s2c_accumulate_rows(
+                    np.ascontiguousarray(starts),
+                    np.ascontiguousarray(codes),
+                    len(starts), w, flat, self.total_len)
+            else:
+                rows, cols = np.nonzero(codes < NUM_SYMBOLS)
+                pos = starts[rows].astype(np.int64) + cols
+                ok = (pos >= 0) & (pos < self.total_len)
+                np.add.at(self._counts,
+                          (pos[ok], codes[rows[ok], cols[ok]]), 1)
+            self.strategy_used["host"] += 1
+
+    @property
+    def counts(self):
+        """Device copy of the counts, wire-narrowed; vote widens on chip."""
+        import jax
+
+        if self._device_counts is None:
+            m = int(self._counts.max(initial=0))
+            if m < (1 << 8):
+                arr = self._counts.astype(np.uint8)
+            elif m < (1 << 16):
+                arr = self._counts.astype(np.uint16)
+            else:
+                arr = self._counts
+            self.strategy_used["host_wire_dtype"] = str(arr.dtype)
+            self._device_counts = jax.device_put(arr)
+        return self._device_counts
+
+    def counts_host(self) -> np.ndarray:
+        return self._counts
+
+    def set_counts(self, counts) -> None:
+        self._counts = np.array(counts, dtype=np.int32)
+        self._device_counts = None
+
+
 class PileupAccumulator:
     """Streaming accumulator for one device (sharded use lives in parallel/).
 

@@ -17,27 +17,31 @@ break-at-first-failure are both captured.  Pinned against the oracle by the
 differential tests.
 
 Float fidelity: the reference compares an integer running total against the
-Python float ``t * coverage``.  To make the device comparison exact without
-global float64, the host precomputes, per threshold, an integer LUT
-``T[cov] = ceil(float64(t) * cov)``; then ``S < t*cov  ⟺  S < T[cov]`` for
-every integer S (see ``threshold_luts``), and the device never touches
-floats at all — the whole vote is int32/uint8 arithmetic.
+Python float ``t * coverage``.  The device reproduces that float64 product's
+value — including its rounding — with pure int32 limb arithmetic
+(``ops.cutoff.exact_cutoff``), so the whole vote is elementwise integer math
+with NO table gathers: ``S < t*cov ⟺ S < ceil(fl64(t*cov))`` for integer S.
+(The earlier host-LUT formulation was equally exact but cost a ~65 ms
+max-coverage round trip plus a ~46 ms [L]-wide gather per vote on the
+tunneled chip — see ops/cutoff.py for the measurements.)
 
 The called set becomes a 6-bit mask (bit i = ALPHABET[i], ASCII-sorted order)
 mapped through the 64-entry IUPAC LUT — the tensor form of the reference's
-``amb["".join(sorted(nucs))]``.
+``amb["".join(sorted(nucs))]``.  The LUT lookup runs as a one-hot select
+(64 elementwise compares), measured ~free where the gather was not.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..constants import IUPAC_MASK_LUT
+from .cutoff import exact_cutoff
 
 #: device output byte marking "fill this position on host" (cov==0 or
 #: cov<min_depth); never collides with real output chars (all >= ord('-')).
@@ -45,11 +49,13 @@ FILL_SENTINEL = 0
 
 
 def threshold_luts(thresholds: Sequence[float], max_cov: int) -> np.ndarray:
-    """Integer cutoffs: ``lut[t, cov] = ceil(float64(t)*cov)`` as int32.
+    """Integer cutoffs ``lut[t, cov] = ceil(float64(t)*cov)`` as int32.
 
     For integer S: ``S < t*cov`` (the reference's float comparison at
-    sam2consensus.py:362) ⟺ ``S < lut[t, cov]``, because the smallest
-    integer not less than the float product is its ceiling.
+    sam2consensus.py:362) ⟺ ``S < lut[t, cov]``.  The production vote now
+    computes the same value on device (``ops.cutoff``); this host builder
+    remains as the independent oracle the device math is tested against
+    (tests/test_cutoff.py) and for numpy-side consumers.
     """
     t = np.asarray(thresholds, dtype=np.float64)[:, None]
     cov = np.arange(max_cov + 1, dtype=np.float64)[None, :]
@@ -60,7 +66,19 @@ def threshold_luts(thresholds: Sequence[float], max_cov: int) -> np.ndarray:
     return lut.astype(np.int32)
 
 
-def vote_block(counts: jax.Array, t_luts: jax.Array,
+def iupac_select(mask: jax.Array) -> jax.Array:
+    """Map 6-bit called-set masks to output bytes, gather-free.
+
+    One-hot select over the 64-entry IUPAC LUT: elementwise compares fuse
+    into the vote for ~free where a table gather measured ~46 ms at
+    L = 4.6 M (tools/tunnel_probe.py).
+    """
+    lut = jnp.asarray(IUPAC_MASK_LUT).astype(jnp.int32)
+    onehot = mask[..., None] == jnp.arange(64, dtype=jnp.int32)
+    return jnp.sum(jnp.where(onehot, lut, 0), axis=-1).astype(jnp.uint8)
+
+
+def vote_block(counts: jax.Array, thr_enc: jax.Array,
                min_depth: int) -> tuple:
     """Vote every position of a counts block for every threshold.
 
@@ -70,13 +88,17 @@ def vote_block(counts: jax.Array, t_luts: jax.Array,
 
     Args:
       counts: int32 ``[L, 6]`` pileup counts.
-      t_luts: int32 ``[T, max_cov+1]`` integer cutoff LUTs.
+      thr_enc: int32 ``[T, 5]`` encoded thresholds
+        (``ops.cutoff.encode_thresholds``).
       min_depth: static minimum depth gate.
 
     Returns:
       syms: uint8 ``[T, L]`` output byte per position (FILL_SENTINEL where
         the reference emits the fill character), and cov: int32 ``[L]``.
     """
+    # widen on chip: the host-counts path uploads uint8/uint16 to spare the
+    # ~40 MB/s link (ops/pileup.py HostPileupAccumulator)
+    counts = counts.astype(jnp.int32)
     cov = counts.sum(axis=-1)                                  # [L]
     # S[l, i] = sum_j counts[l, j] * (counts[l, j] > counts[l, i]); the
     # [L, 6, 6] broadcast fuses into the reduction under XLA.
@@ -85,18 +107,17 @@ def vote_block(counts: jax.Array, t_luts: jax.Array,
         jnp.where(greater, counts[:, None, :], 0), axis=-1)    # [L, 6]
     nonzero = counts != 0
     bit = (1 << jnp.arange(6, dtype=jnp.int32))[None, :]
-    lut = jnp.asarray(IUPAC_MASK_LUT)
 
     emit = (cov > 0) & (cov >= min_depth)                      # [L]
 
-    def per_threshold(tlut):
-        cutoff = tlut[cov]                                     # [L]
+    def per_threshold(enc_row):
+        cutoff = exact_cutoff(cov, enc_row)                    # [L]
         included = nonzero & (strictly_greater_sum < cutoff[:, None])
         mask = jnp.sum(jnp.where(included, bit, 0), axis=-1)   # [L]
-        syms = lut[mask]
+        syms = iupac_select(mask)
         return jnp.where(emit, syms, jnp.uint8(FILL_SENTINEL))
 
-    return jax.vmap(per_threshold)(t_luts), cov
+    return jax.vmap(per_threshold)(thr_enc), cov
 
 
 #: jitted single-device entry point over a full counts tensor

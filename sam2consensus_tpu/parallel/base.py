@@ -70,21 +70,62 @@ class ShardedCountsBase:
             jnp.asarray(padded), NamedSharding(self.mesh, P(ALL, None)))
 
     # -- vote -------------------------------------------------------------
-    def vote(self, t_luts: np.ndarray, min_depth: int
-             ) -> Tuple[np.ndarray, np.ndarray]:
-        """Position-sharded vote; returns host (syms [T, total_len], cov).
+    def vote(self, thr_enc: np.ndarray, min_depth: int) -> np.ndarray:
+        """Position-sharded vote; returns host syms ``[T, total_len]``.
 
         Sequence parallelism with zero extra communication: the vote is
-        elementwise per position, so it runs on the resident blocks.
+        elementwise per position (cutoffs computed on device,
+        ``ops.cutoff``), so it runs on the resident blocks.
         """
         from ..ops.vote import vote_block
 
         @partial(shard_map, mesh=self.mesh,
                  in_specs=(P(ALL, None), P(None, None)),
-                 out_specs=(P(None, ALL), P(ALL)))
-        def voted(counts_blk, luts):
-            return vote_block(counts_blk, luts, min_depth)
+                 out_specs=P(None, ALL))
+        def voted(counts_blk, enc):
+            syms, _cov = vote_block(counts_blk, enc, min_depth)
+            return syms
 
-        syms, cov = jax.jit(voted)(self._counts, jnp.asarray(t_luts))
-        return (np.asarray(syms)[:, : self.total_len],
-                np.asarray(cov, dtype=np.int64)[: self.total_len])
+        syms = jax.jit(voted)(self._counts, jnp.asarray(thr_enc))
+        return np.asarray(syms)[:, : self.total_len]
+
+    def tail_stats(self, offsets: np.ndarray, site_keys: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """Device-side replacement for the full-coverage host fetch.
+
+        Returns host ``(contig_sums [C], site_cov [K])`` — the only
+        coverage facts the host rendering needs (ops/fused.py) — without
+        moving the [L] coverage vector off device.  Per-contig sums come
+        from local prefix sums differenced at the contig offsets and one
+        psum; per-site coverage from an owned-block gather and one psum.
+        """
+        from jax import lax
+
+        n_sp = self.mesh.shape["sp"]
+        block = self.padded_len // self.n
+
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(P(ALL, None), P(None), P(None)),
+                 out_specs=(P(None), P(None)))
+        def stats(counts_blk, offs, keys):
+            cov_blk = counts_blk.sum(axis=-1)                  # [Lb]
+            i = lax.axis_index("dp") * n_sp + lax.axis_index("sp")
+            lo = i * block
+            prefix = jnp.concatenate(
+                [jnp.zeros(1, dtype=cov_blk.dtype), jnp.cumsum(cov_blk)])
+            part = prefix[jnp.clip(offs - lo, 0, block)]       # [C+1]
+            gsum = lax.psum(part, ALL)     # global prefix at each offset
+            contig_sums = gsum[1:] - gsum[:-1]
+            owned = (keys >= lo) & (keys < lo + block)
+            local = jnp.where(
+                owned, cov_blk[jnp.clip(keys - lo, 0, block - 1)], 0)
+            site_cov = lax.psum(local, ALL)
+            return contig_sums.astype(jnp.int32), site_cov.astype(jnp.int32)
+
+        if len(site_keys) == 0:
+            site_keys = np.full(1, -1, dtype=np.int32)
+        contig_sums, site_cov = jax.jit(stats)(
+            self._counts, jnp.asarray(offsets.astype(np.int32)),
+            jnp.asarray(site_keys.astype(np.int32)))
+        return (np.asarray(contig_sums, dtype=np.int64),
+                np.asarray(site_cov, dtype=np.int64))

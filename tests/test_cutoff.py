@@ -1,0 +1,94 @@
+"""Device-side exact threshold cutoffs vs the host float64 oracle.
+
+``ops.cutoff.exact_cutoff`` must reproduce ``ceil(fl64(t) * cov)`` —
+including the float64 rounding of the product — for every threshold double
+and int32 coverage, because the reference's greedy vote compares integer
+running totals against that float product
+(/root/reference/sam2consensus.py:359-367).  ``threshold_luts`` (numpy
+float64) is the independent oracle.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sam2consensus_tpu.ops.cutoff import encode_thresholds, exact_cutoff
+from sam2consensus_tpu.ops.vote import threshold_luts
+
+_f = jax.jit(exact_cutoff)
+
+
+def _oracle(t, cov):
+    return np.minimum(np.ceil(np.float64(t) * cov.astype(np.float64)),
+                      2 ** 31 - 1).astype(np.int64)
+
+
+def _check(thresholds, cov):
+    enc = encode_thresholds(thresholds)
+    for i, t in enumerate(thresholds):
+        got = np.asarray(_f(jnp.asarray(cov), jnp.asarray(enc[i])))
+        want = _oracle(t, cov)
+        bad = np.nonzero(got.astype(np.int64) != want)[0]
+        assert len(bad) == 0, (
+            f"t={t!r}: first mismatches at cov={cov[bad[:5]]}: "
+            f"got {got[bad[:5]]}, want {want[bad[:5]]}")
+
+
+BENCH_THRESHOLDS = [0.25, 0.5, 0.75, 1 / 3, 2 / 3, 0.1, 0.9, 0.999999, 1.0]
+
+
+def test_exhaustive_small_cov():
+    _check(BENCH_THRESHOLDS, np.arange(0, 100000, dtype=np.int32))
+
+
+def test_random_doubles_exhaustive():
+    rng = np.random.default_rng(7)
+    _check(list(rng.random(20)), np.arange(0, 20000, dtype=np.int32))
+
+
+def test_large_cov_random():
+    rng = np.random.default_rng(8)
+    cov = rng.integers(0, 2 ** 31, 100000, dtype=np.int64).astype(np.int32)
+    _check(BENCH_THRESHOLDS + list(rng.random(10)), cov)
+
+
+def test_pow2_boundaries():
+    cov = []
+    for b in range(1, 31):
+        cov += [(1 << b) - 2, (1 << b) - 1, 1 << b, (1 << b) + 1]
+    cov += [2 ** 31 - 1, 2 ** 31 - 2, 0, 1, 2, 3]
+    _check(BENCH_THRESHOLDS, np.asarray(cov, dtype=np.int32))
+
+
+def test_extreme_thresholds():
+    """Sub/near-denormal, tiny and huge thresholds stay exact or clamp."""
+    cov = np.asarray([0, 1, 2, 3, 1000, 2 ** 20, 2 ** 31 - 1],
+                     dtype=np.int32)
+    _check([1e-9, 1e-300, 5e-324, 2.5, 1000.0, 1e9], cov)
+
+
+def test_rne_tie_cases():
+    """Thresholds whose products hit exact .5 ulp ties (RNE must match)."""
+    # t = (2^53-1)/2^54 * 2: mantissa all-ones patterns provoke ties
+    ts = [np.nextafter(0.5, 1.0), np.nextafter(0.5, 0.0),
+          np.nextafter(0.25, 1.0), float.fromhex("0x1.fffffffffffffp-2")]
+    _check([float(t) for t in ts], np.arange(0, 50000, dtype=np.int32))
+
+
+def test_rejects_bad_thresholds():
+    for bad in (0.0, -0.25, float("nan"), float("inf")):
+        with pytest.raises(ValueError):
+            encode_thresholds([bad])
+
+
+def test_matches_threshold_luts_matrix():
+    """Row-for-row against the LUT builder (the round-2 production path)."""
+    ts = [0.25, 0.5, 0.75]
+    luts = threshold_luts(ts, 4096)
+    enc = encode_thresholds(ts)
+    cov = np.arange(0, 4097, dtype=np.int32)
+    for i in range(len(ts)):
+        got = np.asarray(_f(jnp.asarray(cov), jnp.asarray(enc[i])))
+        np.testing.assert_array_equal(got, luts[i])

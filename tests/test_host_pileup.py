@@ -1,0 +1,158 @@
+"""Host-counts pileup strategy: native accumulate, wire narrowing, parity.
+
+The host path (ops/pileup.py HostPileupAccumulator) accumulates the count
+tensor in native code and ships it to the device once, dtype-narrowed —
+the least-wire strategy on deep/small-genome workloads (see its docstring
+for the tunnel measurements).  These tests pin:
+
+* count parity: native C++ slab walk == numpy fallback == device scatter;
+* dtype narrowing thresholds (uint8 / uint16 / int32) and vote parity
+  across them;
+* full-backend byte identity vs the CPU oracle with --pileup host,
+  including checkpoints/resume composition.
+"""
+
+import io
+import os
+import tempfile
+
+import numpy as np
+
+from sam2consensus_tpu.backends.cpu import CpuBackend
+from sam2consensus_tpu.backends.jax_backend import JaxBackend
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.encoder.events import GenomeLayout, ReadEncoder
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.io.sam import iter_records, read_header
+from sam2consensus_tpu.ops.pileup import (HOST_PILEUP_MAX_LEN,
+                                          HostPileupAccumulator,
+                                          PileupAccumulator)
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+
+def _encode_all(text):
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    layout = GenomeLayout(contigs)
+    enc = ReadEncoder(layout)
+    chunks = list(enc.encode_segments(iter_records(handle, first),
+                                      chunk_reads=64))
+    return layout, chunks
+
+
+def test_host_counts_equal_device_scatter():
+    text = simulate(SimSpec(n_contigs=4, contig_len=250, n_reads=700,
+                            read_len=50, ins_read_rate=0.1,
+                            del_read_rate=0.1, seed=41))
+    layout, chunks = _encode_all(text)
+
+    dev = PileupAccumulator(layout.total_len, strategy="scatter")
+    host = HostPileupAccumulator(layout.total_len)
+    for c in chunks:
+        dev.add(c)
+        host.add(c)
+    np.testing.assert_array_equal(host.counts_host(),
+                                  np.asarray(dev.counts))
+
+
+def test_native_accumulate_equals_numpy_fallback():
+    from sam2consensus_tpu import native
+
+    if native.load() is None:
+        import pytest
+
+        pytest.skip("native decoder unavailable")
+    text = simulate(SimSpec(n_contigs=3, contig_len=200, n_reads=400,
+                            read_len=40, seed=42))
+    layout, chunks = _encode_all(text)
+    a = HostPileupAccumulator(layout.total_len)
+    b = HostPileupAccumulator(layout.total_len)
+    b._lib = None                       # force the numpy fallback
+    for c in chunks:
+        a.add(c)
+        b.add(c)
+    np.testing.assert_array_equal(a.counts_host(), b.counts_host())
+
+
+def test_wire_dtype_narrowing_and_vote_parity():
+    import jax.numpy as jnp
+
+    from sam2consensus_tpu.ops.cutoff import encode_thresholds
+    from sam2consensus_tpu.ops.vote import vote_positions
+
+    thr = jnp.asarray(encode_thresholds([0.25, 0.75]))
+    rng = np.random.default_rng(5)
+    for peak, want_dtype in ((200, "uint8"), (60000, "uint16"),
+                             (70000, "int32")):
+        acc = HostPileupAccumulator(64)
+        acc._counts[:] = rng.integers(0, 7, (64, 6)).astype(np.int32)
+        acc._counts[3, 2] = peak
+        dev = acc.counts
+        assert acc.strategy_used["host_wire_dtype"] == want_dtype
+        syms_narrow, cov_narrow = vote_positions(dev, thr, 1)
+        syms_full, cov_full = vote_positions(
+            jnp.asarray(acc.counts_host()), thr, 1)
+        np.testing.assert_array_equal(np.asarray(syms_narrow),
+                                      np.asarray(syms_full))
+        np.testing.assert_array_equal(np.asarray(cov_narrow),
+                                      np.asarray(cov_full))
+
+
+def _run(text, backend, cfg):
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    res = backend.run(contigs, iter_records(handle, first), cfg)
+    return {n: render_file(r, 0) for n, r in res.fastas.items()}, res.stats
+
+
+def test_backend_host_pileup_byte_identical():
+    text = simulate(SimSpec(n_contigs=5, contig_len=180, n_reads=600,
+                            read_len=40, ins_read_rate=0.15,
+                            del_read_rate=0.15, seed=43))
+    cfg = RunConfig(prefix="t", thresholds=[0.25, 0.5, 0.75], shards=1)
+    out_cpu, _ = _run(text, CpuBackend(), cfg)
+    cfg_h = RunConfig(prefix="t", thresholds=[0.25, 0.5, 0.75], shards=1,
+                      pileup="host")
+    out_host, st = _run(text, JaxBackend(), cfg_h)
+    assert out_host == out_cpu
+    assert st.extra["pileup"]["host"] > 0
+    assert "host_wire_dtype" in st.extra["pileup"]
+
+
+def test_auto_picks_host_below_threshold():
+    text = simulate(SimSpec(n_contigs=2, contig_len=150, n_reads=200,
+                            read_len=30, seed=44))
+    cfg = RunConfig(prefix="t", thresholds=[0.25], shards=1, pileup="auto")
+    _out, st = _run(text, JaxBackend(), cfg)
+    assert "host" in st.extra["pileup"]
+    assert HOST_PILEUP_MAX_LEN >= 300          # policy sanity
+
+
+def test_host_pileup_checkpoint_resume():
+    """Kill mid-run, resume with --pileup host: same bytes as one-shot."""
+    from sam2consensus_tpu.io.sam import ReadStream, opener
+
+    text = simulate(SimSpec(n_contigs=3, contig_len=120, n_reads=300,
+                            read_len=30, seed=45))
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "in.sam")
+        with open(path, "w") as fh:
+            fh.write(text)
+        ckdir = os.path.join(tmp, "ck")
+
+        def run_stream(cfg):
+            handle = opener(path, binary=True)
+            contigs, _n, first = read_header(handle)
+            res = JaxBackend().run(contigs, ReadStream(handle, first), cfg)
+            handle.close()
+            return {n: render_file(r, 0) for n, r in res.fastas.items()}
+
+        base = RunConfig(prefix="t", thresholds=[0.25], shards=1,
+                         pileup="host")
+        want = run_stream(base)
+
+        cfg_ck = RunConfig(prefix="t", thresholds=[0.25], shards=1,
+                           pileup="host", checkpoint_dir=ckdir,
+                           checkpoint_every=100)
+        got = run_stream(cfg_ck)               # writes + clears checkpoints
+        assert got == want

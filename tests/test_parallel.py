@@ -18,8 +18,8 @@ from sam2consensus_tpu.config import RunConfig
 from sam2consensus_tpu.encoder.events import GenomeLayout, ReadEncoder
 from sam2consensus_tpu.io.fasta import render_file
 from sam2consensus_tpu.io.sam import Contig, iter_records, read_header
+from sam2consensus_tpu.ops.cutoff import encode_thresholds
 from sam2consensus_tpu.ops.pileup import PileupAccumulator
-from sam2consensus_tpu.ops.vote import threshold_luts
 from sam2consensus_tpu.parallel.dp import ShardedConsensus
 from sam2consensus_tpu.parallel.mesh import factor_mesh, make_mesh
 from sam2consensus_tpu.utils.simulate import SimSpec, sam_text, simulate
@@ -71,16 +71,25 @@ def test_sharded_vote_equals_single_vote():
     sharded = ShardedConsensus(make_mesh(8), layout.total_len)
     for c in chunks:
         sharded.add(c)
-    max_cov = int(sharded.counts_host().sum(axis=1).max())
-    luts = threshold_luts([0.25, 0.75], max_cov)
-    syms, cov = sharded.vote(luts, min_depth=1)
+    thr_enc = encode_thresholds([0.25, 0.75])
+    syms = sharded.vote(thr_enc, min_depth=1)
 
     from sam2consensus_tpu.ops.vote import vote_positions
     import jax.numpy as jnp
     syms1, cov1 = vote_positions(jnp.asarray(sharded.counts_host()),
-                                 jnp.asarray(luts), 1)
+                                 jnp.asarray(thr_enc), 1)
     np.testing.assert_array_equal(syms, np.asarray(syms1))
-    np.testing.assert_array_equal(cov, np.asarray(cov1))
+
+    # device-side tail stats == host recomputation (contig sums + site cov)
+    cov_host = np.asarray(cov1, dtype=np.int64)
+    site_keys = np.asarray([0, 5, layout.total_len - 1, -1], dtype=np.int32)
+    contig_sums, site_cov = sharded.tail_stats(
+        layout.offsets.astype(np.int32), site_keys)
+    want = [cov_host[int(layout.offsets[i]):int(layout.offsets[i + 1])].sum()
+            for i in range(len(layout.names))]
+    np.testing.assert_array_equal(contig_sums, want)
+    np.testing.assert_array_equal(
+        site_cov, [cov_host[0], cov_host[5], cov_host[-1], 0])
 
 
 def test_restore_roundtrip():

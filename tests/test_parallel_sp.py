@@ -15,7 +15,7 @@ jax = pytest.importorskip("jax")
 
 from sam2consensus_tpu.encoder.events import SegmentBatch  # noqa: E402
 from sam2consensus_tpu.ops.pileup import PileupAccumulator  # noqa: E402
-from sam2consensus_tpu.ops.vote import threshold_luts  # noqa: E402
+from sam2consensus_tpu.ops.cutoff import encode_thresholds  # noqa: E402
 from sam2consensus_tpu.parallel.mesh import make_mesh  # noqa: E402
 from sam2consensus_tpu.parallel.sp import PositionShardedConsensus  # noqa: E402
 
@@ -101,12 +101,14 @@ def test_sp_vote_matches_dp_vote():
     dp.add(_batch(starts, codes))
     assert np.array_equal(sp.counts_host(), dp.counts_host())
 
-    luts = threshold_luts([0.25, 0.75],
-                          int(sp.counts_host().sum(axis=1).max()))
-    syms_sp, cov_sp = sp.vote(luts, 1)
-    syms_dp, cov_dp = dp.vote(luts, 1)
+    thr_enc = encode_thresholds([0.25, 0.75])
+    syms_sp = sp.vote(thr_enc, 1)
+    syms_dp = dp.vote(thr_enc, 1)
     assert np.array_equal(syms_sp, syms_dp)
-    assert np.array_equal(cov_sp, cov_dp)
+    offs = np.asarray([0, total_len], dtype=np.int32)
+    sums_sp, _ = sp.tail_stats(offs, np.zeros(0, dtype=np.int32))
+    sums_dp, _ = dp.tail_stats(offs, np.zeros(0, dtype=np.int32))
+    assert np.array_equal(sums_sp, sums_dp)
 
 
 def test_sp_restore_roundtrip():

@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""Characterize the host<->device link and the tail ops' real costs.
+
+Run on the tunneled TPU to answer: how much of vote_sec / accumulate_sec
+is (a) dispatch round-trip latency, (b) transfer bytes, (c) device compute.
+Prints one human-readable line per measurement to stderr and a JSON summary
+to stdout.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.abspath(__file__)) + "/..")
+
+
+def timed(fn, n=5):
+    fn()  # warm
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts), sorted(ts)[len(ts) // 2]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    out = {"platform": dev.platform}
+    log = lambda m: print(m, file=sys.stderr, flush=True)
+
+    # 1. null dispatch + scalar fetch round trip
+    one = jnp.ones((8,), jnp.int32)
+    f = jax.jit(lambda x: x + 1)
+    mn, md = timed(lambda: np.asarray(f(one)))
+    out["rt_null_ms"] = round(md * 1e3, 2)
+    log(f"null dispatch+fetch: min {mn*1e3:.1f}ms median {md*1e3:.1f}ms")
+
+    # 2. h2d bandwidth
+    for mb in (1, 16, 64):
+        a = np.random.randint(0, 250, (mb << 20,), dtype=np.uint8)
+        mn, md = timed(lambda: jax.device_put(a).block_until_ready())
+        out[f"h2d_{mb}mb_ms"] = round(md * 1e3, 1)
+        log(f"h2d {mb}MB: {md*1e3:.1f}ms ({mb/md:.0f} MB/s)")
+
+    # 3. d2h bandwidth
+    for mb in (1, 16, 64):
+        d = jax.device_put(np.zeros((mb << 20,), dtype=np.uint8))
+        d.block_until_ready()
+        mn, md = timed(lambda: np.asarray(d))
+        out[f"d2h_{mb}mb_ms"] = round(md * 1e3, 1)
+        log(f"d2h {mb}MB: {md*1e3:.1f}ms ({mb/md:.0f} MB/s)")
+
+    # 4. vote_block on-device at ecoli scale (scalar-forced execution;
+    #    block_until_ready returns early over the tunnel)
+    from sam2consensus_tpu.ops.cutoff import encode_thresholds
+    from sam2consensus_tpu.ops.vote import vote_block
+    L = 4_600_000
+    counts = jax.device_put(
+        np.random.randint(0, 40, (L, 6), dtype=np.int32))
+    thr = jax.device_put(encode_thresholds([0.25]))
+    vb = jax.jit(vote_block, static_argnames=("min_depth",))
+    vbs = jax.jit(lambda c, t: vote_block(c, t, 1)[0].sum())
+    mn, md = timed(lambda: np.asarray(vbs(counts, thr)))
+    out["vote_4p6m_dev_ms"] = round(md * 1e3, 1)
+    log(f"vote_block L=4.6M -> scalar: {md*1e3:.1f}ms")
+
+    # 5. vote + fetch syms only
+    mn, md = timed(lambda: np.asarray(vb(counts, thr, min_depth=1)[0]))
+    out["vote_4p6m_fetch_ms"] = round(md * 1e3, 1)
+    log(f"vote_block L=4.6M +fetch syms(4.6MB): {md*1e3:.1f}ms")
+
+    # 6. coverage + full-cov fetch (the current tail's first round trip)
+    from sam2consensus_tpu.ops import fused
+    mn, md = timed(lambda: np.asarray(fused.coverage(counts)))
+    out["cov_fetch_4p6m_ms"] = round(md * 1e3, 1)
+    log(f"coverage+fetch int32[4.6M] (18MB): {md*1e3:.1f}ms")
+
+    # 7. scatter slab: 65536 rows x 128 wide (one SCATTER_CELL_BUDGET slab)
+    from sam2consensus_tpu.ops.pileup import _scatter_segments
+    rows, w = 65536, 128
+    starts = np.random.randint(0, L - 200, rows).astype(np.int32)
+    codes = np.random.randint(0, 6, (rows, w), dtype=np.uint8)
+    cbuf = jax.device_put(np.zeros((L + 8, 6), np.int32))
+
+    def scat():
+        nonlocal cbuf
+        cbuf = _scatter_segments(cbuf, jnp.asarray(starts),
+                                 jnp.asarray(codes), L)
+        cbuf.block_until_ready()
+    mn, md = timed(scat)
+    out["scatter_slab_ms"] = round(md * 1e3, 1)
+    log(f"scatter slab 64k x 128 (8.4MB h2d + scatter): {md*1e3:.1f}ms "
+        f"({rows*w/md/1e6:.0f} Mcells/s end-to-end)")
+
+    # 8. device-side transfer-free scatter (same slab resident)
+    dstarts = jax.device_put(starts)
+    dcodes = jax.device_put(codes)
+    jax.block_until_ready((dstarts, dcodes))
+
+    def scat_res():
+        nonlocal cbuf
+        cbuf = _scatter_segments(cbuf, dstarts, dcodes, L)
+        cbuf.block_until_ready()
+    mn, md = timed(scat_res)
+    out["scatter_slab_resident_ms"] = round(md * 1e3, 1)
+    log(f"scatter slab resident (no h2d): {md*1e3:.1f}ms "
+        f"({rows*w/md/1e6:.0f} Mcells/s device)")
+
+    # 9. dispatch of vote_packed-sized jit without fetch, measuring dispatch
+    #    overhead of a big fused call
+    t0 = time.perf_counter()
+    r = vb(counts, thr, min_depth=1)
+    disp = time.perf_counter() - t0
+    jax.block_until_ready(r)
+    out["vote_dispatch_only_ms"] = round(disp * 1e3, 1)
+    log(f"vote dispatch (async, no block): {disp*1e3:.1f}ms")
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
