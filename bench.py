@@ -114,34 +114,56 @@ def build_configs(n_devices: int):
         read_len=int(os.environ.get("BENCH_READ_LEN", "100")),
         ins_read_rate=0.05, del_read_rate=0.05, seed=42)
 
+    # the north star workload the >=100x target is defined on (BASELINE.md:
+    # 1M reads / 500 contigs)
+    north_star_spec = SimSpec(
+        n_contigs=500, contig_len=2000, n_reads=n(1_000_000), read_len=100,
+        ins_read_rate=0.05, del_read_rate=0.05, seed=77,
+        contig_prefix="ns")
+
+    # long-context: >= 2^25 positions on real hardware.  The CPU oracle
+    # cannot run at this scale — it allocates one dict per position up
+    # front, the reference design flaw sp exists to escape
+    # (/root/reference/sam2consensus.py:167) — so the baseline comes from
+    # an oracle anchor at 1/16 scale (same depth profile), extrapolated
+    # linearly and marked estimated; identity is checked at anchor scale.
+    wide_spec = SimSpec(
+        n_contigs=1, contig_len=40_000_000, n_reads=n(100_000),
+        read_len=100, contig_len_jitter=0.0, seed=88, contig_prefix="chr")
+
     # the five BASELINE.md scenarios (bench-scaled shapes; the spec-scaled
-    # originals live in utils.simulate.BASELINE_SPECS for tests)
+    # originals live in utils.simulate.BASELINE_SPECS for tests), plus the
+    # north-star and long-context rows.  Optional per-config key
+    # "oracle_shrink": run the CPU oracle at spec scaled by 1/k.
     return [
-        # (name, spec, cfg_kwargs, jax_variants)
+        # (name, spec, cfg_kwargs, jax_variants, extras)
         ("headline", headline_spec, {"thresholds": [0.25]},
-         {"sharded": {"shards": 0}} if n_devices > 1 else {}),
+         {"sharded": {"shards": 0}} if n_devices > 1 else {}, {}),
         ("phix", SimSpec(n_contigs=1, contig_len=5386, n_reads=n(20000),
                          read_len=100, seed=101, contig_prefix="phiX"),
-         {"thresholds": [0.25]}, {}),
+         {"thresholds": [0.25]}, {}, {}),
         ("phix_multithreshold",
          SimSpec(n_contigs=1, contig_len=5386, n_reads=n(20000),
                  read_len=100, seed=101, contig_prefix="phiX"),
-         {"thresholds": [0.25, 0.50, 0.75]}, {}),
+         {"thresholds": [0.25, 0.50, 0.75]}, {}, {}),
         ("target_capture",
          SimSpec(n_contigs=350, contig_len=1200, n_reads=n(100000),
                  read_len=100, seed=202, contig_prefix="gene"),
-         {"thresholds": [0.25]}, {}),
+         {"thresholds": [0.25]}, {}, {}),
         ("ecoli_scale",
          SimSpec(n_contigs=1, contig_len=4_600_000, n_reads=n(150000),
                  read_len=100, contig_len_jitter=0.0, seed=404,
                  contig_prefix="ecoli"),
-         {"thresholds": [0.25]}, {}),
+         {"thresholds": [0.25]}, {}, {}),
         ("amplicon_deep",
          SimSpec(n_contigs=1, contig_len=400, n_reads=n(100000),
                  read_len=80, ins_read_rate=0.3, del_read_rate=0.2,
                  seed=303, contig_prefix="amplicon"),
          {"thresholds": [0.25], "min_depth": 10},
-         {"pallas": {"ins_kernel": "pallas"}}),
+         {"pallas": {"ins_kernel": "pallas"}}, {}),
+        ("north_star", north_star_spec, {"thresholds": [0.25]}, {}, {}),
+        ("wide_genome", wide_spec, {"thresholds": [0.25]}, {},
+         {"oracle_shrink": 16}),
     ]
 
 
@@ -161,14 +183,34 @@ def run_once(backend, path, cfg, binary):
 
 def phase_split(stats):
     return {k: stats.extra[k]
-            for k in ("accumulate_sec", "vote_sec", "insertions_sec",
-                      "render_sec") if k in stats.extra}
+            for k in ("decode_sec", "pileup_dispatch_sec", "accumulate_sec",
+                      "vote_sec", "insertions_sec", "render_sec")
+            if k in stats.extra}
 
 
-def bench_config(name, spec, cfg_kwargs, jax_variants, tmp):
-    from sam2consensus_tpu.backends.cpu import CpuBackend
-    from sam2consensus_tpu.backends.jax_backend import JaxBackend
-    from sam2consensus_tpu.config import RunConfig
+def util_fields(stats, jax_time):
+    """Wire/throughput accounting so regressions are attributable
+    (VERDICT r2 #5): bytes each way, effective link rate, pileup cell
+    rate, host decode rate."""
+    u = {}
+    h2d = stats.extra.get("h2d_bytes", 0)
+    d2h = stats.extra.get("d2h_bytes", 0)
+    u["h2d_mb"] = round(h2d / 1e6, 2)
+    u["d2h_mb"] = round(d2h / 1e6, 2)
+    if jax_time > 0:
+        u["wire_mbps"] = round((h2d + d2h) / 1e6 / jax_time, 1)
+    ps = stats.extra.get("pileup_dispatch_sec", 0)
+    if ps > 0:
+        u["pileup_mcells_per_s"] = round(
+            stats.aligned_bases / ps / 1e6, 1)
+    ds = stats.extra.get("decode_sec", 0)
+    if ds > 0:
+        u["decode_mbases_per_s"] = round(
+            stats.aligned_bases / ds / 1e6, 1)
+    return u
+
+
+def _write_sim(spec, name, tmp):
     from sam2consensus_tpu.utils.simulate import simulate
 
     t0 = time.perf_counter()
@@ -178,9 +220,85 @@ def bench_config(name, spec, cfg_kwargs, jax_variants, tmp):
         fh.write(text)
     log(f"[{name}] simulated {spec.n_reads} reads in "
         f"{time.perf_counter() - t0:.1f}s")
-    del text
+    return path
 
+
+def _jax_row(name, path, cfg_kwargs, overrides, cpu_time, cpu_out):
+    """Warm + timed jax run; returns the result row (identical vs cpu_out
+    unless cpu_out is None)."""
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+    from sam2consensus_tpu.config import RunConfig
+
+    vcfg = RunConfig(prefix="bench", **{"shards": 1, **cfg_kwargs,
+                                        **overrides})
+    backend = JaxBackend()
+    # warm-up pays the jit compiles for this genome length / buckets
+    _s, _t, _o = run_once(backend, path, vcfg, binary=True)
+    jax_stats, jax_time, jax_out = run_once(backend, path, vcfg,
+                                            binary=True)
+    bases = jax_stats.consensus_bases
+    row = {
+        "config": name,
+        "reads": jax_stats.reads_mapped,
+        "aligned_bases": jax_stats.aligned_bases,
+        "consensus_bases": bases,
+        "cpu_sec": round(cpu_time, 3),
+        "jax_sec": round(jax_time, 3),
+        "bases_per_sec": round(bases / jax_time, 1),
+        "vs_baseline": round(cpu_time / jax_time, 3),
+        "phases": phase_split(jax_stats),
+        "util": util_fields(jax_stats, jax_time),
+        "pileup": jax_stats.extra.get("pileup", {}),
+    }
+    if cpu_out is not None:
+        row["identical"] = jax_out == cpu_out
+    if "insertion_kernel" in jax_stats.extra:
+        row["insertion_kernel"] = jax_stats.extra["insertion_kernel"]
+    log(f"[{name}] jax: {jax_time:.2f}s "
+        f"({row['bases_per_sec']:,.0f} bases/s, "
+        f"{row['vs_baseline']}x cpu, "
+        f"identical={row.get('identical', 'n/a')}) "
+        f"phases={row['phases']} util={row['util']}")
+    if row.get("identical") is False:
+        log(f"[{name}] BYTE MISMATCH — row marked identical=false")
+    return row
+
+
+def bench_config(name, spec, cfg_kwargs, jax_variants, tmp, extras=None):
+    from dataclasses import replace
+
+    from sam2consensus_tpu.backends.cpu import CpuBackend
+    from sam2consensus_tpu.config import RunConfig
+
+    extras = extras or {}
+    shrink = int(extras.get("oracle_shrink", 1))
     cfg = RunConfig(prefix="bench", **{"shards": 1, **cfg_kwargs})
+
+    if shrink > 1:
+        # oracle anchor at 1/shrink scale: the oracle's per-position dict
+        # allocation cannot survive the full genome (that reference design
+        # flaw is this config's raison d'etre); both its accumulate
+        # (∝ reads) and vote (∝ positions) phases scale linearly, so the
+        # full-size baseline is cpu_anchor * shrink, marked estimated.
+        anchor = replace(spec, contig_len=spec.contig_len // shrink,
+                         n_reads=max(1000, spec.n_reads // shrink))
+        apath = _write_sim(anchor, f"{name}_anchor", tmp)
+        cpu_stats, cpu_anchor, cpu_out = run_once(CpuBackend(), apath, cfg,
+                                                  binary=False)
+        log(f"[{name}] cpu oracle anchor (1/{shrink} scale): "
+            f"{cpu_anchor:.2f}s")
+        anchor_row = _jax_row(f"{name}_anchor", apath, cfg_kwargs, {},
+                              cpu_anchor, cpu_out)
+        path = _write_sim(spec, name, tmp)
+        row = _jax_row(name, path, cfg_kwargs, {}, cpu_anchor * shrink,
+                       None)
+        row["cpu_sec_estimated"] = True
+        row["oracle_anchor"] = {
+            "shrink": shrink, "cpu_sec": round(cpu_anchor, 3),
+            "identical": anchor_row.get("identical")}
+        return [anchor_row, row]
+
+    path = _write_sim(spec, name, tmp)
     cpu_stats, cpu_time, cpu_out = run_once(CpuBackend(), path, cfg,
                                             binary=False)
     log(f"[{name}] cpu oracle: {cpu_time:.2f}s "
@@ -190,48 +308,21 @@ def bench_config(name, spec, cfg_kwargs, jax_variants, tmp):
     variants = {"": {}}
     variants.update(jax_variants)
     for vname, overrides in variants.items():
-        vcfg = RunConfig(prefix="bench", **{"shards": 1, **cfg_kwargs,
-                                            **overrides})
-        backend = JaxBackend()
-        # warm-up pays the jit compiles for this genome length / buckets
-        _s, _t, _o = run_once(backend, path, vcfg, binary=True)
-        jax_stats, jax_time, jax_out = run_once(backend, path, vcfg,
-                                                binary=True)
-        identical = jax_out == cpu_out
         row_name = name if not vname else f"{name}+{vname}"
-        bases = jax_stats.consensus_bases
-        row = {
-            "config": row_name,
-            "reads": jax_stats.reads_mapped,
-            "aligned_bases": jax_stats.aligned_bases,
-            "consensus_bases": bases,
-            "cpu_sec": round(cpu_time, 3),
-            "jax_sec": round(jax_time, 3),
-            "bases_per_sec": round(bases / jax_time, 1),
-            "vs_baseline": round(cpu_time / jax_time, 3),
-            "identical": identical,
-            "phases": phase_split(jax_stats),
-            "pileup": jax_stats.extra.get("pileup", {}),
-        }
-        if "insertion_kernel" in jax_stats.extra:
-            row["insertion_kernel"] = jax_stats.extra["insertion_kernel"]
-        rows.append(row)
-        log(f"[{row_name}] jax: {jax_time:.2f}s "
-            f"({row['bases_per_sec']:,.0f} bases/s, "
-            f"{row['vs_baseline']}x cpu, identical={identical}) "
-            f"phases={row['phases']}")
-        if not identical:
-            log(f"[{row_name}] BYTE MISMATCH — row marked identical=false")
+        rows.append(_jax_row(row_name, path, cfg_kwargs, overrides,
+                             cpu_time, cpu_out))
     return rows
 
 
 def main():
+    # the headline value/vs_baseline fields are inserted LAST so a
+    # tail-truncated capture of the JSON line always retains them
+    # (VERDICT r2 weak #7)
     result = {
         "metric": "consensus_bases_per_sec",
-        "value": 0.0,
         "unit": "bases/sec",
-        "vs_baseline": 0.0,
     }
+    value, vs_baseline = 0.0, 0.0
     try:
         ok, platform, n_dev, probe_err = probe_accelerator()
         if not ok:
@@ -254,13 +345,13 @@ def main():
                 if s]
         rows = []
         with tempfile.TemporaryDirectory() as tmp:
-            for name, spec, cfg_kwargs, variants in build_configs(
+            for name, spec, cfg_kwargs, variants, extras in build_configs(
                     n_dev if ok else 1):
                 if only and name not in only:
                     continue
                 try:
                     rows.extend(bench_config(name, spec, cfg_kwargs,
-                                             variants, tmp))
+                                             variants, tmp, extras))
                 except Exception as exc:  # keep earlier rows on any failure
                     log(f"[{name}] FAILED: {type(exc).__name__}: {exc}")
                     rows.append({"config": name, "error": repr(exc)})
@@ -269,20 +360,32 @@ def main():
         head = next((r for r in rows
                      if r.get("config") == "headline" and "error" not in r),
                     None)
+        # fallback pool: clean, byte-verified, non-degenerate rows (a
+        # 460-base amplicon "throughput" is an identity check, not a
+        # headline — VERDICT r2 weak #6); oracle-anchor rows are shrunken
+        # sub-benchmarks, never headline material
         scored = [r for r in rows
-                  if "error" not in r and r.get("identical")]
+                  if "error" not in r and r.get("identical")
+                  and r.get("consensus_bases", 0) >= 10_000
+                  and not r.get("config", "").endswith("_anchor")]
         if head is not None and head.get("identical"):
-            result["value"] = head["bases_per_sec"]
-            result["vs_baseline"] = head["vs_baseline"]
+            value = head["bases_per_sec"]
+            vs_baseline = head["vs_baseline"]
         elif scored:  # headline missing: fall back to the first clean row
-            result["value"] = scored[0]["bases_per_sec"]
-            result["vs_baseline"] = scored[0]["vs_baseline"]
+            value = scored[0]["bases_per_sec"]
+            vs_baseline = scored[0]["vs_baseline"]
             result["headline_fallback"] = scored[0]["config"]
         if any(not r.get("identical", True) for r in rows):
             result["byte_mismatch"] = True
+        ns = next((r for r in rows if r.get("config") == "north_star"
+                   and "error" not in r), None)
+        if ns is not None:
+            result["north_star_vs_baseline"] = ns["vs_baseline"]
     except Exception as exc:
         result["error"] = repr(exc)
         log(f"[bench] FATAL: {exc!r}")
+    result["value"] = value
+    result["vs_baseline"] = vs_baseline
     print(json.dumps(result))
     return 0
 

@@ -136,7 +136,8 @@ class JaxBackend:
         from ..ops import fused
         from ..ops.cutoff import encode_thresholds
         from ..ops.insertions import build_insertion_table, vote_insertions
-        from ..ops.pileup import PileupAccumulator
+        from ..ops.pileup import (HOST_PILEUP_MAX_LEN, HostPileupAccumulator,
+                                  PileupAccumulator)
 
         from ..io.sam import ReadStream
 
@@ -191,9 +192,6 @@ class JaxBackend:
                                        pileup=getattr(cfg, "pileup", "auto"))
             stats.extra["shard_mode"] = mode
         else:
-            from ..ops.pileup import HOST_PILEUP_MAX_LEN, \
-                HostPileupAccumulator
-
             strategy = getattr(cfg, "pileup", "auto")
             if strategy == "host" or (
                     strategy == "auto"
@@ -331,10 +329,12 @@ class JaxBackend:
         # packed uint8 buffer.  Nothing depends on max(cov) because the
         # threshold cutoffs are computed exactly on device (ops/cutoff.py).
         t0 = time.perf_counter()
-        if stats.aligned_bases > np.iinfo(np.int32).max:
-            raise RuntimeError(
-                "total aligned bases exceed int32 — beyond the count "
-                "tensor's supported scale")
+        # Per-position coverage always fits int32 (the count lanes are
+        # int32), but GLOBAL coverage sums can overflow the device-side
+        # int32 cumsum once total aligned bases pass 2^31.  The fused
+        # tail's site coverage is a per-position gather (safe); only the
+        # per-contig sums need the round-2 style full-coverage fetch then.
+        overflow_sums = stats.aligned_bases > np.iinfo(np.int32).max
         thr_enc_np = encode_thresholds(cfg.thresholds)
         thr_enc = jnp.asarray(thr_enc_np)
         offsets32 = layout.offsets.astype(np.int32)
@@ -449,7 +449,26 @@ class JaxBackend:
                 split = n_thresholds * total_len
                 syms = out[:split].reshape(n_thresholds, total_len)
                 contig_sums = fused.unpack_i32(out[split:], n_contigs)
+        if overflow_sums:
+            if isinstance(acc, HostPileupAccumulator):
+                cov64 = acc.counts_host().sum(axis=-1, dtype=np.int64)
+            else:
+                cov64 = np.asarray(fused.coverage(
+                    acc.counts))[:total_len].astype(np.int64)
+            contig_sums = np.asarray([
+                cov64[int(layout.offsets[i]):int(layout.offsets[i + 1])]
+                .sum() for i in range(n_contigs)], dtype=np.int64)
+            stats.extra["contig_sums_host_fallback"] = True
         stats.extra["vote_sec"] = round(time.perf_counter() - t0, 4)
+        # wire accounting (bench utilization rows): bytes shipped up during
+        # accumulation and fetched back by the fused tail
+        stats.extra["h2d_bytes"] = int(getattr(acc, "bytes_h2d", 0))
+        if use_sharded:
+            stats.extra["d2h_bytes"] = int(
+                syms.nbytes + (ins_syms.nbytes if ins_syms is not None
+                               else 0))
+        else:
+            stats.extra["d2h_bytes"] = int(out.nbytes)
         if getattr(acc, "strategy_used", None):
             # refresh: the host-counts path records its wire dtype at upload
             stats.extra["pileup"] = dict(acc.strategy_used)

@@ -46,8 +46,9 @@ def next_pow2(n: int) -> int:
 
 @jax.jit
 def coverage(counts: jax.Array) -> jax.Array:
-    """Per-position depth ``[L]`` — gaps and Ns count (quirk 5)."""
-    return counts.sum(axis=-1)
+    """Per-position depth ``[L]`` — gaps and Ns count (quirk 5).
+    Widens first: the host-counts path stores uint8/uint16 on device."""
+    return counts.astype(jnp.int32).sum(axis=-1)
 
 
 def _bytes_of_i32(x: jax.Array) -> jax.Array:

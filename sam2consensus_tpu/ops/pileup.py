@@ -38,6 +38,27 @@ from ..encoder.events import SegmentBatch
 SCATTER_CELL_BUDGET = 1 << 23
 
 
+def pack_nibbles(codes: np.ndarray) -> np.ndarray:
+    """Host-side 4-bit wire packing: ``[S, W]`` codes → ``[S, W/2]`` bytes.
+
+    Symbol codes are 0..5 and PAD is 255; a nibble holds both (PAD → 15,
+    still ``>= NUM_SYMBOLS`` so validity tests are unchanged after unpack).
+    Halves the dominant host→device transfer on the ~40 MB/s tunneled link
+    (tools/tunnel_probe.py); bucket widths are powers of two ≥ 32, so W is
+    always even.  Even columns ride the low nibble.
+    """
+    nib = np.where(codes < NUM_SYMBOLS, codes, 15).astype(np.uint8)
+    return nib[:, 0::2] | (nib[:, 1::2] << 4)
+
+
+def unpack_nibbles(packed: jax.Array) -> jax.Array:
+    """Device-side inverse of :func:`pack_nibbles` (PAD comes back as 15)."""
+    lo = packed & 0xF
+    hi = packed >> 4
+    s, half = packed.shape
+    return jnp.stack([lo, hi], axis=-1).reshape(s, half * 2)
+
+
 def expand_segment_positions(starts: jax.Array, codes: jax.Array,
                              sacrificial) -> tuple:
     """Expand segment rows to flat (pos, code) scatter operands.
@@ -59,6 +80,16 @@ def expand_segment_positions(starts: jax.Array, codes: jax.Array,
 def _scatter_segments(counts: jax.Array, starts: jax.Array,
                       codes: jax.Array, sacrificial: int) -> jax.Array:
     pos, code = expand_segment_positions(starts, codes, sacrificial)
+    return counts.at[pos, code].add(1)
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=3)
+def _scatter_segments_packed(counts: jax.Array, starts: jax.Array,
+                             packed: jax.Array, sacrificial: int
+                             ) -> jax.Array:
+    """Scatter path fed by the 4-bit wire format (pack_nibbles)."""
+    pos, code = expand_segment_positions(starts, unpack_nibbles(packed),
+                                         sacrificial)
     return counts.at[pos, code].add(1)
 
 
@@ -195,6 +226,7 @@ class HostPileupAccumulator:
         self._lib = native.load()              # None -> numpy fallback
         self._device_counts = None
         self.strategy_used: dict = {"host": 0}
+        self.bytes_h2d = 0                     # wire accounting for bench
 
     def add(self, batch: SegmentBatch) -> None:
         self._device_counts = None
@@ -227,6 +259,7 @@ class HostPileupAccumulator:
             else:
                 arr = self._counts
             self.strategy_used["host_wire_dtype"] = str(arr.dtype)
+            self.bytes_h2d += arr.nbytes
             self._device_counts = jax.device_put(arr)
         return self._device_counts
 
@@ -236,6 +269,47 @@ class HostPileupAccumulator:
     def set_counts(self, counts) -> None:
         self._counts = np.array(counts, dtype=np.int32)
         self._device_counts = None
+
+
+def run_tuned_slab(tuner, static_choice: str, n_rows: int, width: int,
+                   plan_mxu, exec_mxu, exec_scatter, block) -> str:
+    """Shared driver for one slab of the autotune protocol.
+
+    Used by both the single-device and the dp-sharded accumulators so the
+    choose → execute → report_skew/complete sequencing (subtle: timing
+    must start before host planning, a skewed mxu plan must clear the
+    timing flag, stats publish after every slab) lives in exactly one
+    place.  ``plan_mxu() -> plan | None`` (None = skew), ``exec_mxu(plan)``
+    / ``exec_scatter()`` run the slab, ``block()`` forces completion for
+    an honest timing sample.  Returns the strategy key actually used.
+    """
+    if tuner is not None:
+        chosen, timing = tuner.choose(n_rows, width)
+    else:
+        chosen, timing = static_choice, False
+    t0 = time.perf_counter()           # before host planning: the mxu
+    plan = None                        # number must be end-to-end
+    skewed = False
+    if chosen == "mxu":
+        plan = plan_mxu()
+        if plan is None:               # skew (padding blowup): scatter
+            skewed = True
+            if tuner is not None:
+                tuner.report_skew()
+                timing = False
+    if plan is not None:
+        exec_mxu(plan)
+        key = "mxu"
+    else:
+        exec_scatter()
+        key = "scatter"
+    if tuner is not None and not skewed:
+        if timing:
+            block()
+            tuner.complete((time.perf_counter() - t0) / (n_rows * width))
+        else:
+            tuner.complete()
+    return key
 
 
 class PileupAccumulator:
@@ -248,26 +322,22 @@ class PileupAccumulator:
       coverage) or a bucket is tiny;
     * ``"mxu"``: one-hot matmul + overlap-add (``ops.mxu_pileup``,
       compact slot transfer) — the FLOPs land on the systolic array;
-    * ``"auto"``: ONLINE AUTOTUNE.  Rather than hard-coding a winner
-      that depends on the runtime (round 1's padded-transfer MXU layout
-      won on-device microbenchmarks ~11x yet lost end-to-end through the
-      tunneled link), auto measures each strategy on early steady-state
-      slabs — warm a strategy on one slab, time it on the NEXT slab of
-      the same shape (so jit compilation never pollutes the number),
-      scatter first, then mxu — and locks in the winner by per-cell
-      throughput from then on.  The mxu measurement starts before host
-      slot planning, so it is honestly end-to-end (host plan + transfer
-      + device); a trial that keeps hitting skewed slabs gives up after
-      ``_MAX_SKEW_RETRIES`` and locks in scatter.  Runs too small to
-      finish the trial stay on scatter; every trial slab still
+    * ``"auto"``: ONLINE AUTOTUNE via ``PileupAutoTuner`` (shared with the
+      dp-sharded accumulator, parallel/dp.py).  Rather than hard-coding a
+      winner that depends on the runtime (round 1's padded-transfer MXU
+      layout won on-device microbenchmarks ~11x yet lost end-to-end
+      through the tunneled link), auto measures each strategy on early
+      steady-state slabs — warm a strategy on one slab, time it on the
+      NEXT slab of the same shape (so jit compilation never pollutes the
+      number), scatter first, then mxu — and locks in the winner by
+      per-cell throughput from then on.  The mxu measurement starts
+      before host slot planning, so it is honestly end-to-end (host plan
+      + transfer + device); a trial that keeps hitting skewed slabs gives
+      up after ``MAX_SKEW_RETRIES`` and locks in scatter.  Runs too small
+      to finish the trial stay on scatter; every trial slab still
       accumulates exactly (both strategies are exact), so the tuning is
       free of correctness cost.
     """
-
-    #: autotune stages: warm scatter, time scatter, warm mxu, time mxu
-    _STAGES = (("scatter", False), ("scatter", True),
-               ("mxu", False), ("mxu", True))
-    _MAX_SKEW_RETRIES = 3
 
     def __init__(self, total_len: int, device=None, strategy: str = "auto"):
         from . import mxu_pileup
@@ -284,90 +354,41 @@ class PileupAccumulator:
             counts = jax.device_put(counts, device)
         self._counts = counts
         self.strategy_used: dict = {}
-        self._stage = 0
-        self._warm_shape = None
-        self._skew_retries = 0
-        self._trial_times: dict = {}       # strategy -> sec per cell
-
-    def _lock_winner(self, winner: str, **extra) -> None:
-        self._trial_times["winner"] = winner
-        self.strategy_used["autotune"] = {
-            "scatter_sec_per_mcell": round(
-                self._trial_times.get("scatter", 0.0) * 1e6, 5),
-            "mxu_sec_per_mcell": round(
-                self._trial_times.get("mxu", 0.0) * 1e6, 5),
-            "winner": winner, **extra}
-
-    def _record_trial(self, strategy: str, sec_per_cell: float) -> None:
-        self._trial_times[strategy] = sec_per_cell
-        if "scatter" in self._trial_times and "mxu" in self._trial_times:
-            self._lock_winner(min(("scatter", "mxu"),
-                                  key=self._trial_times.get))
+        self.bytes_h2d = 0                 # wire accounting for bench
+        self._tuner = PileupAutoTuner() if strategy == "auto" else None
 
     def add(self, batch: SegmentBatch) -> None:
         from . import mxu_pileup
 
         for w, (starts, codes) in sorted(batch.buckets.items()):
-            # strategy + trial role for this slab
-            timing = False
-            advance = False
-            if self.strategy != "auto":
-                chosen = self.strategy
-            elif "winner" in self._trial_times:
-                chosen = self._trial_times["winner"]
-            elif len(starts) * w < (SCATTER_CELL_BUDGET >> 3):
-                # tiny slab: timing would be noise, cost is negligible
-                chosen = "scatter"
-            else:
-                chosen, is_timing_stage = self._STAGES[self._stage]
-                shape = (len(starts), w)
-                if not is_timing_stage:
-                    self._warm_shape = shape        # warm slab
-                    advance = True
-                elif shape != self._warm_shape:
-                    # shape changed since the warm slab: this run would
-                    # include jit compilation — re-warm, stay in stage
-                    self._warm_shape = shape
-                else:
-                    timing = advance = True
-
-            t0 = time.perf_counter()       # before host planning: the mxu
-            plan = None                    # number must be end-to-end
-            if chosen == "mxu":
-                # plan_slots returns None on skew (padding blowup): scatter
-                plan = mxu_pileup.plan_slots(
+            def plan_mxu():
+                return mxu_pileup.plan_slots(
                     np.asarray(starts), w, self.padded_len, self._tile)
-                if plan is None:
-                    if self.strategy == "auto" \
-                            and "winner" not in self._trial_times:
-                        # skewed trial slab can't measure mxu; give up
-                        # after a few — persistent skew means mxu would
-                        # rarely engage anyway, and each retry pays the
-                        # host planning scan
-                        self._skew_retries += 1
-                        if self._skew_retries >= self._MAX_SKEW_RETRIES:
-                            self._lock_winner("scatter", reason="mxu_skew")
-                    timing = advance = False
-            if plan is not None:
-                key = f"mxu_w{w}"
+
+            def exec_mxu(plan):
+                self.bytes_h2d += (starts.nbytes + codes.nbytes
+                                   + plan.slot.nbytes)
                 self._counts = mxu_pileup.pileup_mxu_compact(
                     self._counts, jnp.asarray(starts), jnp.asarray(codes),
                     jnp.asarray(plan.slot), tile=self._tile,
                     n_tiles=plan.n_tiles,
                     rows_per_tile=plan.rows_per_tile, width=plan.width)
-            else:
-                key = f"scatter_w{w}"
+
+            def exec_scatter():
+                packed = pack_nibbles(codes)
+                self.bytes_h2d += starts.nbytes + packed.nbytes
                 for lo, hi in iter_row_slices(len(starts), w):
-                    self._counts = _scatter_segments(
+                    self._counts = _scatter_segments_packed(
                         self._counts, jnp.asarray(starts[lo:hi]),
-                        jnp.asarray(codes[lo:hi]), self.total_len)
-            if timing:
-                jax.block_until_ready(self._counts)
-                self._record_trial(
-                    chosen,
-                    (time.perf_counter() - t0) / (len(starts) * w))
-            if advance:
-                self._stage += 1
+                        jnp.asarray(packed[lo:hi]), self.total_len)
+
+            key = run_tuned_slab(
+                self._tuner, self.strategy, len(starts), w, plan_mxu,
+                exec_mxu, exec_scatter,
+                lambda: jax.block_until_ready(self._counts))
+            if self._tuner is not None and self._tuner.stats is not None:
+                self.strategy_used["autotune"] = self._tuner.stats
+            key = f"{key}_w{w}"
             self.strategy_used[key] = self.strategy_used.get(key, 0) + 1
 
     @property

@@ -34,7 +34,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import SegmentBatch
-from ..ops.pileup import expand_segment_positions, iter_row_slices
+from ..ops.pileup import (expand_segment_positions, iter_row_slices,
+                          pack_nibbles, unpack_nibbles)
 from .base import ALL, ShardedCountsBase, shard_map
 
 __all__ = ["ShardedConsensus", "ALL"]
@@ -46,9 +47,12 @@ class ShardedConsensus(ShardedCountsBase):
     ``pileup`` picks the per-device accumulation strategy: ``"mxu"`` plans
     one tile-sorted chunk per device and runs the one-hot-matmul pileup
     (``ops.mxu_pileup``) locally before the reduce-scatter; ``"scatter"``
-    (and, until the MXU path is proven on hardware, ``"auto"``) keeps the
-    XLA scatter.  Skewed slabs fall back to scatter per bucket, exactly as
-    on a single device.
+    keeps the XLA scatter; ``"auto"`` runs the same measured
+    scatter-vs-mxu trial as the single-device accumulator
+    (``ops.pileup.PileupAutoTuner``) and locks in the per-cell winner —
+    the sharded promise of ``--pileup auto`` holds under ``--shards``.
+    Skewed slabs fall back to scatter per bucket, exactly as on a single
+    device.
     """
 
     def __init__(self, mesh: Mesh, total_len: int, pileup: str = "auto"):
@@ -56,9 +60,11 @@ class ShardedConsensus(ShardedCountsBase):
         # sacrificial scatter row (index total_len) lives inside the pad.
         super().__init__(mesh, total_len)
         from ..ops import mxu_pileup
+        from ..ops.pileup import PileupAutoTuner
 
         self.pileup = pileup
         self.strategy_used: dict = {}
+        self._tuner = PileupAutoTuner() if pileup == "auto" else None
         self._tile = mxu_pileup.TILE_POSITIONS
         self._tiles_len = -(-self.padded_len // self._tile) * self._tile
         self._n_tiles = self._tiles_len // self._tile
@@ -67,8 +73,11 @@ class ShardedConsensus(ShardedCountsBase):
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(ALL, None), P(ALL), P(ALL, None)),
                  out_specs=P(ALL, None))
-        def accumulate(counts_blk, starts, codes):
-            pos, code = expand_segment_positions(starts, codes, total_len)
+        def accumulate(counts_blk, starts, packed):
+            # rows arrive 4-bit packed (ops.pileup.pack_nibbles): half the
+            # host->device bytes on the tunneled link
+            pos, code = expand_segment_positions(
+                starts, unpack_nibbles(packed), total_len)
             local = jnp.zeros((self.padded_len, NUM_SYMBOLS), dtype=jnp.int32)
             local = local.at[pos, code].add(1)
             # reduce over every device AND scatter position blocks: each
@@ -142,34 +151,48 @@ class ShardedConsensus(ShardedCountsBase):
 
     # -- streaming input --------------------------------------------------
     def add(self, batch: SegmentBatch) -> None:
+        from ..ops.pileup import run_tuned_slab
+
         for w, (starts, codes) in sorted(batch.buckets.items()):
-            plan = None
-            if self.pileup == "mxu":
-                plan = self._plan_mxu(np.asarray(starts), np.asarray(codes))
-            if plan is not None:
+            def plan_mxu():
+                return self._plan_mxu(np.asarray(starts), np.asarray(codes))
+
+            def exec_mxu(plan):
                 p_starts, p_codes, slots, e = plan
                 fn = self._mxu_accumulate(e, w)
+                self.bytes_h2d += (p_starts.nbytes + p_codes.nbytes
+                                   + slots.nbytes)
                 self._counts = fn(
-                    self._counts,
+                    self.counts,
                     jax.device_put(p_starts, self._row_spec),
                     jax.device_put(p_codes, self._mat_spec),
                     jax.device_put(slots, self._row_spec))
-                key = f"mxu_w{w}"
-            else:
+
+            def exec_scatter():
                 s = len(starts)
                 # rows must shard evenly over the mesh (matters for
                 # non-power-of-two device counts)
                 target = -(-s // self.n) * self.n
+                sts, cds = starts, codes
                 if target != s:
-                    starts = np.concatenate(
-                        [starts, np.zeros(target - s, dtype=np.int32)])
-                    codes = np.concatenate(
-                        [codes, np.full((target - s, codes.shape[1]),
-                                        PAD_CODE, dtype=np.uint8)])
+                    sts = np.concatenate(
+                        [sts, np.zeros(target - s, dtype=np.int32)])
+                    cds = np.concatenate(
+                        [cds, np.full((target - s, cds.shape[1]),
+                                      PAD_CODE, dtype=np.uint8)])
+                packed = pack_nibbles(cds)
+                self.bytes_h2d += sts.nbytes + packed.nbytes
                 for lo, hi in iter_row_slices(target, w, multiple_of=self.n):
                     self._counts = self._accumulate(
-                        self._counts,
-                        jax.device_put(starts[lo:hi], self._row_spec),
-                        jax.device_put(codes[lo:hi], self._mat_spec))
-                key = f"scatter_w{w}"
+                        self.counts,
+                        jax.device_put(sts[lo:hi], self._row_spec),
+                        jax.device_put(packed[lo:hi], self._mat_spec))
+
+            key = run_tuned_slab(
+                self._tuner, self.pileup, len(starts), w, plan_mxu,
+                exec_mxu, exec_scatter,
+                lambda: jax.block_until_ready(self._counts))
+            if self._tuner is not None and self._tuner.stats is not None:
+                self.strategy_used["autotune"] = self._tuner.stats
+            key = f"{key}_w{w}"
             self.strategy_used[key] = self.strategy_used.get(key, 0) + 1

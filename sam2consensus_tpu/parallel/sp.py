@@ -55,7 +55,8 @@ import numpy as np
 
 from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import SegmentBatch
-from ..ops.pileup import expand_segment_positions, iter_row_slices
+from ..ops.pileup import (expand_segment_positions, iter_row_slices,
+                          pack_nibbles, unpack_nibbles)
 from .base import ALL, ShardedCountsBase, block_for, shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -91,7 +92,7 @@ class PositionShardedConsensus(ShardedCountsBase):
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(ALL, None), P(ALL), P(ALL, None)),
                  out_specs=P(ALL, None))
-        def accumulate(counts_blk, starts, codes):
+        def accumulate(counts_blk, starts, packed):
             # device index along the flattened ("dp","sp") axes
             di = jax.lax.axis_index(ALL)
             # one slot PAST the halo is the PAD-cell sacrifice: it must
@@ -100,7 +101,7 @@ class PositionShardedConsensus(ShardedCountsBase):
             local = jnp.zeros((block + halo + 1, NUM_SYMBOLS),
                               dtype=jnp.int32)
             pos, code = expand_segment_positions(
-                starts - di * block, codes, block + halo)
+                starts - di * block, unpack_nibbles(packed), block + halo)
             local = local.at[pos, code].add(1)
             # one neighbor shift moves every halo to its owner; the last
             # device's halo covers pad positions only (valid cells never
@@ -122,10 +123,11 @@ class PositionShardedConsensus(ShardedCountsBase):
             @partial(shard_map, mesh=self.mesh,
                      in_specs=(P(ALL, None), P(ALL), P(ALL, None), P()),
                      out_specs=P(ALL, None))
-            def accumulate_window(counts_blk, starts, codes, wlo):
+            def accumulate_window(counts_blk, starts, packed, wlo):
                 di = jax.lax.axis_index(ALL)
                 local = jnp.zeros((wp + 1, NUM_SYMBOLS), dtype=jnp.int32)
-                pos, code = expand_segment_positions(starts - wlo, codes, wp)
+                pos, code = expand_segment_positions(
+                    starts - wlo, unpack_nibbles(packed), wp)
                 local = local.at[pos, code].add(1)
                 # one window-sized all-reduce rides ICI; every device then
                 # folds the slice overlapping its resident position block
@@ -203,11 +205,13 @@ class PositionShardedConsensus(ShardedCountsBase):
                         [codes, np.full((n_rows - len(codes), w), PAD_CODE,
                                         dtype=np.uint8)])
                 fn = self._window_accumulate(wp)
+                packed = pack_nibbles(codes)
+                self.bytes_h2d += starts.nbytes + packed.nbytes
                 for lo, hi in iter_row_slices(n_rows, w, multiple_of=self.n):
                     self._counts = fn(
-                        self._counts,
+                        self.counts,
                         jax.device_put(starts[lo:hi], self._row_spec),
-                        jax.device_put(codes[lo:hi], self._mat_spec),
+                        jax.device_put(packed[lo:hi], self._mat_spec),
                         np.int32(wlo))
                     self.rows_shipped += hi - lo
                 key = f"window_w{w}"
@@ -241,14 +245,13 @@ class PositionShardedConsensus(ShardedCountsBase):
             # cap expanded cells per device call (same budget discipline
             # as the unsharded and dp paths, ops.pileup.iter_row_slices)
             for lo, hi_r in iter_row_slices(r, w):
+                s_slab = s_routed[:, lo:hi_r].reshape(-1).copy()
+                p_slab = pack_nibbles(c_routed[:, lo:hi_r].reshape(-1, w))
+                self.bytes_h2d += s_slab.nbytes + p_slab.nbytes
                 self._counts = self._accumulate(
-                    self._counts,
-                    jax.device_put(
-                        s_routed[:, lo:hi_r].reshape(-1).copy(),
-                        self._row_spec),
-                    jax.device_put(
-                        c_routed[:, lo:hi_r].reshape(-1, w).copy(),
-                        self._mat_spec))
+                    self.counts,
+                    jax.device_put(s_slab, self._row_spec),
+                    jax.device_put(p_slab, self._mat_spec))
                 self.rows_shipped += self.n * (hi_r - lo)
             key = f"routed_w{w}"
             self.strategy_used[key] = self.strategy_used.get(key, 0) + 1

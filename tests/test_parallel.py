@@ -92,6 +92,33 @@ def test_sharded_vote_equals_single_vote():
         site_cov, [cov_host[0], cov_host[5], cov_host[-1], 0])
 
 
+def test_sharded_auto_autotunes_and_stays_exact():
+    """--shards + --pileup auto runs the measured scatter-vs-mxu trial
+    (the same PileupAutoTuner as single-device) and locks a winner, with
+    every trial slab still accumulating exactly (VERDICT r2 #3)."""
+    from sam2consensus_tpu.encoder.events import SegmentBatch
+
+    rng = np.random.default_rng(58)
+    total_len = 16000
+    width = 32
+    rows = 1 << 15                 # x32 = 1M cells: enters the trial
+    auto = ShardedConsensus(make_mesh(8), total_len, pileup="auto")
+    plain = ShardedConsensus(make_mesh(8), total_len, pileup="scatter")
+    for _ in range(6):
+        starts = rng.integers(0, total_len - width, rows).astype(np.int32)
+        codes = rng.integers(0, 6, (rows, width)).astype(np.uint8)
+        batch = SegmentBatch(buckets={width: (starts, codes)},
+                             n_reads=rows, n_events=rows * width)
+        auto.add(batch)
+        plain.add(batch)
+    tune = auto.strategy_used.get("autotune")
+    assert tune is not None and tune["winner"] in ("scatter", "mxu"), \
+        auto.strategy_used
+    assert tune["scatter_sec_per_mcell"] > 0
+    assert tune["mxu_sec_per_mcell"] > 0
+    np.testing.assert_array_equal(auto.counts_host(), plain.counts_host())
+
+
 def test_restore_roundtrip():
     layout = GenomeLayout([Contig("a", 40), Contig("b", 25)])
     sharded = ShardedConsensus(make_mesh(8), layout.total_len)

@@ -120,6 +120,51 @@ def test_sp_restore_roundtrip():
     assert np.array_equal(sp.counts_host(), counts)
 
 
+def test_sp_memory_o_block_at_250mbp():
+    """Per-device memory of the sp accumulate stays O(L/n + H) at true
+    chromosome scale (250 Mbp), vs the dp path's O(L) transient — the
+    scenario where the reference's per-position dict allocation dies
+    (/root/reference/sam2consensus.py:167).  Compiled via ShapeDtypeStruct
+    so nothing is materialized; XLA's static memory analysis reports
+    per-device buffer sizes (VERDICT r2 #6)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sam2consensus_tpu.parallel.base import ALL
+    from sam2consensus_tpu.parallel.dp import ShardedConsensus
+
+    mesh = make_mesh(8)
+    total_len = 250_000_000
+    halo = 1 << 16
+    rows, w = 8192, 128
+    sp = PositionShardedConsensus(mesh, total_len, halo=halo)
+    dp = ShardedConsensus(mesh, total_len, pileup="scatter")
+
+    row_s = NamedSharding(mesh, P(ALL))
+    mat_s = NamedSharding(mesh, P(ALL, None))
+    cts = jax.ShapeDtypeStruct((sp.padded_len, 6), jnp.int32,
+                               sharding=mat_s)
+    sts = jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=row_s)
+    pk = jax.ShapeDtypeStruct((rows, w // 2), jnp.uint8, sharding=mat_s)
+
+    sp_mem = sp._accumulate.lower(cts, sts, pk).compile().memory_analysis()
+    dp_mem = dp._accumulate.lower(cts, sts, pk).compile().memory_analysis()
+
+    block_bytes = (sp.block + halo + 1) * 6 * 4
+    # sp temporaries: the [block+halo+1, 6] local tensor + slab expansion
+    # + halo shift buffers — all O(block + H), nothing O(L) beyond the
+    # resident counts argument itself
+    slab_bytes = rows * w * 8 // 8          # expanded pos+code per device
+    assert sp_mem.temp_size_in_bytes <= 2 * block_bytes + 8 * slab_bytes, (
+        sp_mem.temp_size_in_bytes, block_bytes)
+    # dp's transient full-length local tensor is O(L) per device — the
+    # contrast that motivates sp for long genomes
+    full_bytes = dp.padded_len * 6 * 4
+    assert dp_mem.temp_size_in_bytes >= full_bytes
+    assert sp_mem.temp_size_in_bytes * 4 < dp_mem.temp_size_in_bytes
+
+
 def test_sp_rejects_tiny_blocks():
     with pytest.raises(ValueError, match="smaller than halo"):
         PositionShardedConsensus(make_mesh(8), 100, halo=1 << 16)
