@@ -273,3 +273,23 @@ def pileup_mxu_compact(counts: jax.Array, starts: jax.Array,
                                    rows_per_tile=rows_per_tile, width=width)
     return _accumulate_tiles(counts, loc, cod, tile=tile, n_tiles=n_tiles,
                              rows_per_tile=rows_per_tile, width=width)
+
+
+@functools.partial(jax.jit, donate_argnums=0,
+                   static_argnames=("tile", "n_tiles", "rows_per_tile",
+                                    "width"))
+def pileup_mxu_packed(counts: jax.Array, starts: jax.Array,
+                      packed: jax.Array, slot: jax.Array, *, tile: int,
+                      n_tiles: int, rows_per_tile: int,
+                      width: int) -> jax.Array:
+    """Compact layout fed by the 4-bit wire format (ops.pileup
+    pack_nibbles): half the code bytes on the link.  The unpacked PAD
+    nibble (15) one-hots to zero exactly like the uint8 PAD, so no
+    translation is needed before the tile matmuls."""
+    from .pileup import unpack_nibbles
+
+    loc, cod = build_padded_layout(starts, unpack_nibbles(packed), slot,
+                                   tile=tile, n_tiles=n_tiles,
+                                   rows_per_tile=rows_per_tile, width=width)
+    return _accumulate_tiles(counts, loc, cod, tile=tile, n_tiles=n_tiles,
+                             rows_per_tile=rows_per_tile, width=width)

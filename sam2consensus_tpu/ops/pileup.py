@@ -366,10 +366,11 @@ class PileupAccumulator:
                     np.asarray(starts), w, self.padded_len, self._tile)
 
             def exec_mxu(plan):
-                self.bytes_h2d += (starts.nbytes + codes.nbytes
+                packed = pack_nibbles(codes)
+                self.bytes_h2d += (starts.nbytes + packed.nbytes
                                    + plan.slot.nbytes)
-                self._counts = mxu_pileup.pileup_mxu_compact(
-                    self._counts, jnp.asarray(starts), jnp.asarray(codes),
+                self._counts = mxu_pileup.pileup_mxu_packed(
+                    self._counts, jnp.asarray(starts), jnp.asarray(packed),
                     jnp.asarray(plan.slot), tile=self._tile,
                     n_tiles=plan.n_tiles,
                     rows_per_tile=plan.rows_per_tile, width=plan.width)
@@ -382,10 +383,14 @@ class PileupAccumulator:
                         self._counts, jnp.asarray(starts[lo:hi]),
                         jnp.asarray(packed[lo:hi]), self.total_len)
 
+            # completion is forced with a one-element fetch, NOT
+            # block_until_ready: the latter returns early over the axon
+            # tunnel (tools/tunnel_probe.py) and would bias the trial
+            # toward whichever strategy does more device-side work
             key = run_tuned_slab(
                 self._tuner, self.strategy, len(starts), w, plan_mxu,
                 exec_mxu, exec_scatter,
-                lambda: jax.block_until_ready(self._counts))
+                lambda: np.asarray(self._counts[0, 0]))
             if self._tuner is not None and self._tuner.stats is not None:
                 self.strategy_used["autotune"] = self._tuner.stats
             key = f"{key}_w{w}"

@@ -102,9 +102,10 @@ class ShardedConsensus(ShardedCountsBase):
             @partial(shard_map, mesh=self.mesh,
                      in_specs=(P(ALL, None), P(ALL), P(ALL, None), P(ALL)),
                      out_specs=P(ALL, None))
-            def accumulate_mxu(counts_blk, starts, codes, slot):
+            def accumulate_mxu(counts_blk, starts, packed, slot):
                 loc, cod = mxu_pileup.build_padded_layout(
-                    starts, codes, slot, tile=tile, n_tiles=n_tiles,
+                    starts, unpack_nibbles(packed), slot, tile=tile,
+                    n_tiles=n_tiles,
                     rows_per_tile=rows_per_tile, width=width)
                 local = mxu_pileup._accumulate_tiles(
                     jnp.zeros((tiles_len, NUM_SYMBOLS), dtype=jnp.int32),
@@ -160,12 +161,13 @@ class ShardedConsensus(ShardedCountsBase):
             def exec_mxu(plan):
                 p_starts, p_codes, slots, e = plan
                 fn = self._mxu_accumulate(e, w)
-                self.bytes_h2d += (p_starts.nbytes + p_codes.nbytes
+                p_packed = pack_nibbles(p_codes)
+                self.bytes_h2d += (p_starts.nbytes + p_packed.nbytes
                                    + slots.nbytes)
                 self._counts = fn(
                     self.counts,
                     jax.device_put(p_starts, self._row_spec),
-                    jax.device_put(p_codes, self._mat_spec),
+                    jax.device_put(p_packed, self._mat_spec),
                     jax.device_put(slots, self._row_spec))
 
             def exec_scatter():
@@ -188,10 +190,12 @@ class ShardedConsensus(ShardedCountsBase):
                         jax.device_put(sts[lo:hi], self._row_spec),
                         jax.device_put(packed[lo:hi], self._mat_spec))
 
+            # one-element fetch, not block_until_ready: the latter returns
+            # early over the tunneled runtime (tools/tunnel_probe.py)
             key = run_tuned_slab(
                 self._tuner, self.pileup, len(starts), w, plan_mxu,
                 exec_mxu, exec_scatter,
-                lambda: jax.block_until_ready(self._counts))
+                lambda: np.asarray(self._counts[0, 0]))
             if self._tuner is not None and self._tuner.stats is not None:
                 self.strategy_used["autotune"] = self._tuner.stats
             key = f"{key}_w{w}"

@@ -257,3 +257,42 @@ def test_auto_strategy_persistent_skew_locks_scatter():
     tune = acc.strategy_used.get("autotune")
     assert tune is not None and tune["winner"] == "scatter" \
         and tune.get("reason") == "mxu_skew", acc.strategy_used
+
+
+def test_pack_nibbles_roundtrip():
+    """4-bit wire pack/unpack: codes 0..5 survive, PAD (255) -> 15, both
+    invalid after unpack exactly where they were before."""
+    from sam2consensus_tpu.ops.pileup import pack_nibbles, unpack_nibbles
+
+    rng = np.random.default_rng(60)
+    codes = rng.integers(0, 6, (37, 64)).astype(np.uint8)
+    codes[rng.random(codes.shape) < 0.3] = 255
+    packed = pack_nibbles(codes)
+    assert packed.shape == (37, 32)
+    back = np.asarray(unpack_nibbles(jnp.asarray(packed)))
+    want = np.where(codes < 6, codes, 15)
+    np.testing.assert_array_equal(back, want)
+    # validity semantics identical: invalid iff >= NUM_SYMBOLS
+    np.testing.assert_array_equal(back < 6, codes < 6)
+
+
+def test_mxu_packed_equals_compact():
+    """The 4-bit-wire MXU entry point == the uint8 compact entry point."""
+    from sam2consensus_tpu.ops.pileup import pack_nibbles
+
+    rng = np.random.default_rng(61)
+    tile, n, width = 512, 400, 64
+    span = 4 * tile
+    padded_len = 4 * tile
+    starts, codes = _random_rows(rng, n, width, span - width)
+    plan = mxu_pileup.plan_slots(starts, width, padded_len, tile,
+                                 max_blowup=float("inf"))
+    args = dict(tile=tile, n_tiles=plan.n_tiles,
+                rows_per_tile=plan.rows_per_tile, width=width)
+    a = mxu_pileup.pileup_mxu_compact(
+        jnp.zeros((padded_len, 6), jnp.int32), jnp.asarray(starts),
+        jnp.asarray(codes), jnp.asarray(plan.slot), **args)
+    b = mxu_pileup.pileup_mxu_packed(
+        jnp.zeros((padded_len, 6), jnp.int32), jnp.asarray(starts),
+        jnp.asarray(pack_nibbles(codes)), jnp.asarray(plan.slot), **args)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -41,12 +41,20 @@ def emit(**kw):
 
 def timed(fn, repeats):
     """Median wall seconds over ``repeats`` calls (after the caller's
-    warm-up), blocking on the result."""
+    warm-up), forcing completion with a one-element fetch.
+
+    ``block_until_ready`` returns EARLY over the axon tunnel (measured:
+    a 47 ms vote "completes" in 0.0 ms, then the first fetch pays it —
+    tools/tunnel_probe.py), so every repeat fetches one element of the
+    first output leaf instead.  That adds one ~65 ms round trip per
+    repeat, identically for every variant being compared.
+    """
     ts = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         out = fn()
-        jax.block_until_ready(out)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        np.asarray(leaf.ravel()[0])
         ts.append(time.perf_counter() - t0)
     return float(np.median(ts)), out
 
@@ -81,7 +89,29 @@ def bench_pileup(rows, width, genome_len, repeats):
     t_scatter, out_scatter = timed(run_scatter, repeats)
     emit(op="pileup", impl="scatter", rows=rows, width=width,
          genome_len=genome_len, sec=round(t_scatter, 5),
+         wire_bytes=int(starts.nbytes + codes.nbytes),
          cells_per_sec=round(cells / t_scatter))
+
+    # --- scatter, 4-bit packed wire (production path) ---------------------
+    from sam2consensus_tpu.ops.pileup import (_scatter_segments_packed,
+                                              pack_nibbles)
+
+    packed_host = pack_nibbles(codes)
+    _ = _scatter_segments_packed(
+        jnp.zeros((padded_len, NUM_SYMBOLS), jnp.int32),
+        jax.device_put(starts), jax.device_put(packed_host), genome_len)
+
+    def run_scatter_packed():
+        pk = pack_nibbles(codes)          # host pack is part of the cost
+        return _scatter_segments_packed(
+            jnp.zeros((padded_len, NUM_SYMBOLS), jnp.int32),
+            jax.device_put(starts), jax.device_put(pk), genome_len)
+
+    t_packed, out_packed = timed(run_scatter_packed, repeats)
+    emit(op="pileup", impl="scatter_packed", rows=rows, width=width,
+         genome_len=genome_len, sec=round(t_packed, 5),
+         wire_bytes=int(starts.nbytes + packed_host.nbytes),
+         cells_per_sec=round(cells / t_packed))
 
     # --- mxu, padded transfer (round-1 layout) ---------------------------
     plan = mxu_pileup.plan_tiles(starts, codes, padded_len, tile,
@@ -147,7 +177,9 @@ def bench_pileup(rows, width, genome_len, repeats):
     same = (np.array_equal(np.asarray(out_scatter)[:genome_len],
                            np.asarray(out_padded)[:genome_len])
             and np.array_equal(np.asarray(out_scatter)[:genome_len],
-                               np.asarray(out_compact)[:genome_len]))
+                               np.asarray(out_compact)[:genome_len])
+            and np.array_equal(np.asarray(out_scatter)[:genome_len],
+                               np.asarray(out_packed)[:genome_len]))
     emit(op="pileup", check="all_impls_equal", ok=bool(same))
     return {"scatter": t_scatter, "mxu_padded": t_padded,
             "mxu_compact": t_compact}
