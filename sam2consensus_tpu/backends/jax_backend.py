@@ -345,6 +345,17 @@ class JaxBackend:
         n_thresholds = len(cfg.thresholds)
         total_len = layout.total_len
         n_contigs = len(layout.names)
+        # sparse-output gate: covered positions are bounded by aligned
+        # bases, so when coverage is sparse the emit bitmask + compacted
+        # chars cost far fewer d2h bytes than the dense [T, L] fetch
+        # (ops/fused.py _sparse_syms; the 40 Mbp bench config is ~99.5%
+        # fill bytes otherwise)
+        sparse_cap = fused.next_pow2(
+            min(total_len, max(1, stats.aligned_bases)) + 1)
+        nbits = (total_len + 7) // 8
+        if (nbits + n_thresholds * sparse_cap
+                >= (n_thresholds * total_len) // 2):
+            sparse_cap = None                      # dense fetch is cheaper
         if ins is not None:
             k = len(ins["key_flat"])
             # pad sites and columns to powers of two: pad events scatter
@@ -419,22 +430,32 @@ class JaxBackend:
                     jnp.asarray(eplan.key3), jnp.asarray(eplan.cc3),
                     jnp.asarray(eplan.blk_lo), jnp.asarray(eplan.blk_n),
                     cfg.min_depth, cp, eplan.kp, eplan.c6p,
-                    eplan.max_blocks, interp)
+                    eplan.max_blocks, interp, sparse_cap)
                 out = np.asarray(packed)
                 syms, ins_syms, contig_sums, site_cov = self._unpack_tail(
-                    out, n_thresholds, total_len, eplan.kp, cp, n_contigs, k)
+                    out, n_thresholds, total_len, eplan.kp, cp, n_contigs,
+                    k, sparse_cap=sparse_cap)
                 stats.extra["insertion_kernel"] = "pallas"
             else:
                 sk, ncp = padded_sites(kp)
                 ev_key, ev_col, ev_code = padded_events(kp)
-                packed = fused.vote_packed(
-                    acc.counts, thr_enc, jnp.asarray(offsets32),
-                    jnp.asarray(sk), jnp.asarray(ncp), jnp.asarray(ev_key),
-                    jnp.asarray(ev_col), jnp.asarray(ev_code),
-                    cfg.min_depth, cp)
+                if sparse_cap is not None:
+                    packed = fused.vote_packed_sparse(
+                        acc.counts, thr_enc, jnp.asarray(offsets32),
+                        jnp.asarray(sk), jnp.asarray(ncp),
+                        jnp.asarray(ev_key), jnp.asarray(ev_col),
+                        jnp.asarray(ev_code), cfg.min_depth, cp,
+                        sparse_cap)
+                else:
+                    packed = fused.vote_packed(
+                        acc.counts, thr_enc, jnp.asarray(offsets32),
+                        jnp.asarray(sk), jnp.asarray(ncp),
+                        jnp.asarray(ev_key), jnp.asarray(ev_col),
+                        jnp.asarray(ev_code), cfg.min_depth, cp)
                 out = np.asarray(packed)
                 syms, ins_syms, contig_sums, site_cov = self._unpack_tail(
-                    out, n_thresholds, total_len, kp, cp, n_contigs, k)
+                    out, n_thresholds, total_len, kp, cp, n_contigs, k,
+                    sparse_cap=sparse_cap)
         else:
             site_cov = None
             ins_syms = None
@@ -443,11 +464,18 @@ class JaxBackend:
                     offsets32, np.zeros(0, dtype=np.int32))
                 syms = acc.vote(thr_enc_np, cfg.min_depth)
             else:
-                out = np.asarray(fused.vote_packed_simple(
-                    acc.counts, thr_enc, jnp.asarray(offsets32),
-                    cfg.min_depth))
-                split = n_thresholds * total_len
-                syms = out[:split].reshape(n_thresholds, total_len)
+                if sparse_cap is not None:
+                    out = np.asarray(fused.vote_packed_sparse_simple(
+                        acc.counts, thr_enc, jnp.asarray(offsets32),
+                        cfg.min_depth, sparse_cap))
+                    syms, split = self._expand_sparse(
+                        out, n_thresholds, total_len, sparse_cap)
+                else:
+                    out = np.asarray(fused.vote_packed_simple(
+                        acc.counts, thr_enc, jnp.asarray(offsets32),
+                        cfg.min_depth))
+                    split = n_thresholds * total_len
+                    syms = out[:split].reshape(n_thresholds, total_len)
                 contig_sums = fused.unpack_i32(out[split:], n_contigs)
         if overflow_sums:
             if isinstance(acc, HostPileupAccumulator):
@@ -521,15 +549,36 @@ class JaxBackend:
             stats.extra.get("checkpoints_written", 0) + 1)
 
     @staticmethod
-    def _unpack_tail(out: np.ndarray, n_thresholds: int, total_len: int,
-                     kp: int, cp: int, n_contigs: int, k: int):
+    def _expand_sparse(out: np.ndarray, n_thresholds: int, total_len: int,
+                       cap: int):
+        """Inflate the sparse-output prefix (emit bitmask + compacted
+        chars, ops/fused.py ``_sparse_syms``) back to dense ``[T, L]``.
+        Returns (syms, bytes consumed)."""
+        nbits = (total_len + 7) // 8
+        emit = np.unpackbits(out[:nbits], bitorder="little",
+                             count=total_len).astype(bool)
+        kcov = int(emit.sum())
+        compact = out[nbits:nbits + n_thresholds * cap].reshape(
+            n_thresholds, cap)
+        syms = np.zeros((n_thresholds, total_len), np.uint8)
+        syms[:, emit] = compact[:, :kcov]
+        return syms, nbits + n_thresholds * cap
+
+    @classmethod
+    def _unpack_tail(cls, out: np.ndarray, n_thresholds: int,
+                     total_len: int, kp: int, cp: int, n_contigs: int,
+                     k: int, sparse_cap=None):
         """Split the fused tail's packed uint8 buffer (ops/fused.py)."""
         from ..ops import fused
 
-        split1 = n_thresholds * total_len
+        if sparse_cap is None:
+            split1 = n_thresholds * total_len
+            syms = out[:split1].reshape(n_thresholds, total_len)
+        else:
+            syms, split1 = cls._expand_sparse(out, n_thresholds, total_len,
+                                              sparse_cap)
         split2 = split1 + n_thresholds * kp * cp
         split3 = split2 + 4 * n_contigs
-        syms = out[:split1].reshape(n_thresholds, total_len)
         ins_syms = out[split1:split2].reshape(
             n_thresholds, kp, cp)[:, :k, :]                   # [T, K, Cp]
         contig_sums = fused.unpack_i32(out[split2:split3], n_contigs)

@@ -86,6 +86,73 @@ def vote_packed_simple(counts: jax.Array, thr_enc: jax.Array,
     return jnp.concatenate([syms.reshape(-1), _bytes_of_i32(contig_sums)])
 
 
+def _pack_bits_le(mask: jax.Array) -> jax.Array:
+    """Bool ``[L]`` → uint8 ``[ceil(L/8)]``, little bit order (host inverse
+    is ``np.unpackbits(..., bitorder="little")``)."""
+    length = mask.shape[0]
+    pad = (-length) % 8
+    m = mask.astype(jnp.int32)
+    if pad:
+        m = jnp.concatenate([m, jnp.zeros((pad,), jnp.int32)])
+    m = m.reshape(-1, 8)
+    return jnp.sum(m << jnp.arange(8, dtype=jnp.int32)[None, :],
+                   axis=1).astype(jnp.uint8)
+
+
+def _sparse_syms(syms: jax.Array, emit: jax.Array, cap: int):
+    """Compact the per-threshold output to covered positions only.
+
+    The emit gate (cov>0 ∧ cov>=min_depth) is threshold-INDEPENDENT, so
+    one L/8-byte bitmask plus ``T × cap`` compacted characters replaces
+    the dense ``T × L`` fetch — the d2h win for sparse-coverage genomes
+    (a 40 Mbp contig with 100k reads is ~99.5% fill bytes otherwise).
+    Emitted characters are never FILL_SENTINEL, so compaction is exact.
+    """
+    bits = _pack_bits_le(emit)
+    idx = jnp.cumsum(emit.astype(jnp.int32)) - 1
+    tgt = jnp.where(emit, idx, cap)               # pad writes -> row cap
+    compact = jnp.zeros((syms.shape[0], cap + 1),
+                        jnp.uint8).at[:, tgt].set(syms)
+    return bits, compact[:, :cap]
+
+
+@partial(jax.jit, static_argnames=("min_depth", "cap"))
+def vote_packed_sparse_simple(counts: jax.Array, thr_enc: jax.Array,
+                              offsets: jax.Array, min_depth: int,
+                              cap: int) -> jax.Array:
+    """Sparse-output no-insertion tail:
+    ``[emit bits L/8 | compact T*cap | contig sums C*4]``."""
+    syms, cov = vote_block(counts, thr_enc, min_depth)          # [T, L]
+    contig_sums, _ = _tail_stats(cov, offsets,
+                                 jnp.full((1,), -1, jnp.int32))
+    emit = (cov > 0) & (cov >= min_depth)
+    bits, compact = _sparse_syms(syms, emit, cap)
+    return jnp.concatenate([bits, compact.reshape(-1),
+                            _bytes_of_i32(contig_sums)])
+
+
+@partial(jax.jit, static_argnames=("min_depth", "cp", "cap"))
+def vote_packed_sparse(counts: jax.Array, thr_enc: jax.Array,
+                       offsets: jax.Array, site_keys: jax.Array,
+                       n_cols: jax.Array, ev_key: jax.Array,
+                       ev_col: jax.Array, ev_code: jax.Array,
+                       min_depth: int, cp: int, cap: int) -> jax.Array:
+    """Sparse-output tail with insertions:
+    ``[emit bits | compact T*cap | ins T*Kp*Cp | contig sums | site cov]``.
+    """
+    syms, cov = vote_block(counts, thr_enc, min_depth)          # [T, L]
+    contig_sums, site_cov = _tail_stats(cov, offsets, site_keys)
+    emit = (cov > 0) & (cov >= min_depth)
+    bits, compact = _sparse_syms(syms, emit, cap)
+    kp = site_keys.shape[0]
+    table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
+    table = build_insertion_table(table, ev_key, ev_col, ev_code)
+    ins_syms = vote_insertions(table, site_cov, n_cols, thr_enc)
+    return jnp.concatenate([
+        bits, compact.reshape(-1), ins_syms.reshape(-1),
+        _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)])
+
+
 @partial(jax.jit, static_argnames=("min_depth", "cp"))
 def vote_packed(counts: jax.Array, thr_enc: jax.Array, offsets: jax.Array,
                 site_keys: jax.Array, n_cols: jax.Array, ev_key: jax.Array,
@@ -110,13 +177,14 @@ def vote_packed(counts: jax.Array, thr_enc: jax.Array, offsets: jax.Array,
 
 
 @partial(jax.jit, static_argnames=("min_depth", "cp", "kp", "c6p",
-                                   "max_blocks", "interpret"))
+                                   "max_blocks", "interpret", "sparse_cap"))
 def vote_packed_pallas(counts: jax.Array, thr_enc: jax.Array,
                        offsets: jax.Array, site_keys: jax.Array,
                        n_cols: jax.Array, key3: jax.Array, cc3: jax.Array,
                        blk_lo: jax.Array, blk_n: jax.Array,
                        min_depth: int, cp: int, kp: int, c6p: int,
-                       max_blocks: int, interpret: bool = False) -> jax.Array:
+                       max_blocks: int, interpret: bool = False,
+                       sparse_cap=None) -> jax.Array:
     """``vote_packed`` with the insertion table built by the Pallas
     segmented-reduce kernel (ops/pallas_insertion.py) instead of the XLA
     scatter — still one dispatch, one packed uint8 result.
@@ -124,6 +192,8 @@ def vote_packed_pallas(counts: jax.Array, thr_enc: jax.Array,
     Inputs are the kernel's host-planned arrays (key-sorted event blocks +
     CSR block ranges); ``site_keys``/``n_cols`` are padded to the KERNEL's
     key padding ``kp`` (a KEY_BLOCK multiple), not the scatter padding.
+    With ``sparse_cap`` the position symbols travel sparse (emit bitmask +
+    compacted chars), same layout as :func:`vote_packed_sparse`.
     """
     from .pallas_insertion import _table_call
 
@@ -133,6 +203,12 @@ def vote_packed_pallas(counts: jax.Array, thr_enc: jax.Array,
                       max_blocks=max_blocks, interpret=interpret)
     table = out.reshape(kp, c6p)[:, : cp * 6].reshape(kp, cp, 6)
     ins_syms = vote_insertions(table, site_cov, n_cols, thr_enc)
-    return jnp.concatenate([
-        syms.reshape(-1), ins_syms.reshape(-1),
+    if sparse_cap is None:
+        head = [syms.reshape(-1)]
+    else:
+        emit = (cov > 0) & (cov >= min_depth)
+        bits, compact = _sparse_syms(syms, emit, sparse_cap)
+        head = [bits, compact.reshape(-1)]
+    return jnp.concatenate(head + [
+        ins_syms.reshape(-1),
         _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)])

@@ -156,3 +156,45 @@ def test_host_pileup_checkpoint_resume():
                            checkpoint_every=100)
         got = run_stream(cfg_ck)               # writes + clears checkpoints
         assert got == want
+
+
+def test_sparse_output_tail_byte_identical():
+    """Sparse-coverage genome routes through the sparse-output tail
+    (emit bitmask + compacted chars) and stays byte-identical, with and
+    without insertions."""
+    from sam2consensus_tpu.utils.simulate import sam_text
+
+    # big genome, few reads -> aligned_bases << L triggers the gate
+    text = simulate(SimSpec(n_contigs=2, contig_len=200_000, n_reads=300,
+                            read_len=60, ins_read_rate=0.3,
+                            del_read_rate=0.2, seed=46))
+    cfg = RunConfig(prefix="t", thresholds=[0.25, 0.75], shards=1)
+    out_cpu, _ = _run(text, CpuBackend(), cfg)
+    out_jax, st = _run(text, JaxBackend(), cfg)
+    assert out_jax == out_cpu
+    # the gate must actually have chosen sparse for this shape
+    assert st.extra["d2h_bytes"] < 2 * 200_000 * 2, st.extra
+
+    # no-insertion flavor
+    text2 = sam_text([("big", 150_000)],
+                     [("big", 5, "30M", "ACGTACGTACGTACGTACGTACGTACGTAC"),
+                      ("big", 120_000, "30M",
+                       "ACGTACGTACGTACGTACGTACGTACGTAC")])
+    out_cpu2, _ = _run(text2, CpuBackend(), cfg)
+    out_jax2, st2 = _run(text2, JaxBackend(), cfg)
+    assert out_jax2 == out_cpu2
+
+
+def test_sparse_output_tail_pallas_byte_identical():
+    """The Pallas insertion-kernel variant honors the sparse-output gate."""
+    text = simulate(SimSpec(n_contigs=2, contig_len=200_000, n_reads=300,
+                            read_len=60, ins_read_rate=0.3,
+                            del_read_rate=0.2, seed=47))
+    cfg = RunConfig(prefix="t", thresholds=[0.25, 0.75], shards=1)
+    out_cpu, _ = _run(text, CpuBackend(), cfg)
+    cfg_p = RunConfig(prefix="t", thresholds=[0.25, 0.75], shards=1,
+                      ins_kernel="pallas")
+    out_jax, st = _run(text, JaxBackend(), cfg_p)
+    assert out_jax == out_cpu
+    assert st.extra["insertion_kernel"] == "pallas"
+    assert st.extra["d2h_bytes"] < 2 * 200_000 * 2, st.extra
