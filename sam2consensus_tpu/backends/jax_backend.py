@@ -338,6 +338,12 @@ class JaxBackend:
         thr_enc_np = encode_thresholds(cfg.thresholds)
         thr_enc = jnp.asarray(thr_enc_np)
         offsets32 = layout.offsets.astype(np.int32)
+        if isinstance(acc, HostPileupAccumulator):
+            # touch counts now: the host-counts upload (cached in the
+            # accumulator) starts asynchronously here and overlaps the
+            # host-side insertion grouping below.  Device accumulators are
+            # excluded — their counts property is an uncached slice.
+            _ = acc.counts
         ins = group_insertions(encoder.insertions, layout)
         stats.extra["insertions_sec"] = round(time.perf_counter() - t0, 4)
 
@@ -439,19 +445,11 @@ class JaxBackend:
             else:
                 sk, ncp = padded_sites(kp)
                 ev_key, ev_col, ev_code = padded_events(kp)
-                if sparse_cap is not None:
-                    packed = fused.vote_packed_sparse(
-                        acc.counts, thr_enc, jnp.asarray(offsets32),
-                        jnp.asarray(sk), jnp.asarray(ncp),
-                        jnp.asarray(ev_key), jnp.asarray(ev_col),
-                        jnp.asarray(ev_code), cfg.min_depth, cp,
-                        sparse_cap)
-                else:
-                    packed = fused.vote_packed(
-                        acc.counts, thr_enc, jnp.asarray(offsets32),
-                        jnp.asarray(sk), jnp.asarray(ncp),
-                        jnp.asarray(ev_key), jnp.asarray(ev_col),
-                        jnp.asarray(ev_code), cfg.min_depth, cp)
+                packed = fused.vote_packed(
+                    acc.counts, thr_enc, jnp.asarray(offsets32),
+                    jnp.asarray(sk), jnp.asarray(ncp),
+                    jnp.asarray(ev_key), jnp.asarray(ev_col),
+                    jnp.asarray(ev_code), cfg.min_depth, cp, sparse_cap)
                 out = np.asarray(packed)
                 syms, ins_syms, contig_sums, site_cov = self._unpack_tail(
                     out, n_thresholds, total_len, kp, cp, n_contigs, k,
@@ -464,16 +462,13 @@ class JaxBackend:
                     offsets32, np.zeros(0, dtype=np.int32))
                 syms = acc.vote(thr_enc_np, cfg.min_depth)
             else:
+                out = np.asarray(fused.vote_packed_simple(
+                    acc.counts, thr_enc, jnp.asarray(offsets32),
+                    cfg.min_depth, sparse_cap))
                 if sparse_cap is not None:
-                    out = np.asarray(fused.vote_packed_sparse_simple(
-                        acc.counts, thr_enc, jnp.asarray(offsets32),
-                        cfg.min_depth, sparse_cap))
                     syms, split = self._expand_sparse(
                         out, n_thresholds, total_len, sparse_cap)
                 else:
-                    out = np.asarray(fused.vote_packed_simple(
-                        acc.counts, thr_enc, jnp.asarray(offsets32),
-                        cfg.min_depth))
                     split = n_thresholds * total_len
                     syms = out[:split].reshape(n_thresholds, total_len)
                 contig_sums = fused.unpack_i32(out[split:], n_contigs)

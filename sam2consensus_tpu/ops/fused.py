@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from .insertions import build_insertion_table, vote_insertions
-from .vote import vote_block
+from .vote import emit_gate, vote_block
 
 
 def next_pow2(n: int) -> int:
@@ -76,16 +76,6 @@ def _tail_stats(cov: jax.Array, offsets: jax.Array, site_keys: jax.Array):
     return contig_sums.astype(jnp.int32), site_cov
 
 
-@partial(jax.jit, static_argnames=("min_depth",))
-def vote_packed_simple(counts: jax.Array, thr_enc: jax.Array,
-                       offsets: jax.Array, min_depth: int) -> jax.Array:
-    """No-insertion tail: position vote + contig sums, one packed buffer."""
-    syms, cov = vote_block(counts, thr_enc, min_depth)          # [T, L]
-    contig_sums, _ = _tail_stats(cov, offsets,
-                                 jnp.full((1,), -1, jnp.int32))
-    return jnp.concatenate([syms.reshape(-1), _bytes_of_i32(contig_sums)])
-
-
 def _pack_bits_le(mask: jax.Array) -> jax.Array:
     """Bool ``[L]`` → uint8 ``[ceil(L/8)]``, little bit order (host inverse
     is ``np.unpackbits(..., bitorder="little")``)."""
@@ -116,54 +106,43 @@ def _sparse_syms(syms: jax.Array, emit: jax.Array, cap: int):
     return bits, compact[:, :cap]
 
 
-@partial(jax.jit, static_argnames=("min_depth", "cap"))
-def vote_packed_sparse_simple(counts: jax.Array, thr_enc: jax.Array,
-                              offsets: jax.Array, min_depth: int,
-                              cap: int) -> jax.Array:
-    """Sparse-output no-insertion tail:
-    ``[emit bits L/8 | compact T*cap | contig sums C*4]``."""
+def _syms_head(syms, cov, min_depth: int, sparse_cap):
+    """Position-symbol section of the packed buffer: dense ``[T*L]`` or,
+    with ``sparse_cap``, emit bitmask + compacted chars (the gate is
+    :func:`ops.vote.emit_gate` — the same definition that placed the FILL
+    sentinels, so mask and symbols cannot drift apart)."""
+    if sparse_cap is None:
+        return [syms.reshape(-1)]
+    bits, compact = _sparse_syms(syms, emit_gate(cov, min_depth),
+                                 sparse_cap)
+    return [bits, compact.reshape(-1)]
+
+
+@partial(jax.jit, static_argnames=("min_depth", "sparse_cap"))
+def vote_packed_simple(counts: jax.Array, thr_enc: jax.Array,
+                       offsets: jax.Array, min_depth: int,
+                       sparse_cap=None) -> jax.Array:
+    """No-insertion tail: position vote + contig sums, one packed buffer.
+    With ``sparse_cap``: ``[emit bits L/8 | compact T*cap | sums C*4]``."""
     syms, cov = vote_block(counts, thr_enc, min_depth)          # [T, L]
     contig_sums, _ = _tail_stats(cov, offsets,
                                  jnp.full((1,), -1, jnp.int32))
-    emit = (cov > 0) & (cov >= min_depth)
-    bits, compact = _sparse_syms(syms, emit, cap)
-    return jnp.concatenate([bits, compact.reshape(-1),
-                            _bytes_of_i32(contig_sums)])
+    return jnp.concatenate(_syms_head(syms, cov, min_depth, sparse_cap)
+                           + [_bytes_of_i32(contig_sums)])
 
 
-@partial(jax.jit, static_argnames=("min_depth", "cp", "cap"))
-def vote_packed_sparse(counts: jax.Array, thr_enc: jax.Array,
-                       offsets: jax.Array, site_keys: jax.Array,
-                       n_cols: jax.Array, ev_key: jax.Array,
-                       ev_col: jax.Array, ev_code: jax.Array,
-                       min_depth: int, cp: int, cap: int) -> jax.Array:
-    """Sparse-output tail with insertions:
-    ``[emit bits | compact T*cap | ins T*Kp*Cp | contig sums | site cov]``.
-    """
-    syms, cov = vote_block(counts, thr_enc, min_depth)          # [T, L]
-    contig_sums, site_cov = _tail_stats(cov, offsets, site_keys)
-    emit = (cov > 0) & (cov >= min_depth)
-    bits, compact = _sparse_syms(syms, emit, cap)
-    kp = site_keys.shape[0]
-    table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
-    table = build_insertion_table(table, ev_key, ev_col, ev_code)
-    ins_syms = vote_insertions(table, site_cov, n_cols, thr_enc)
-    return jnp.concatenate([
-        bits, compact.reshape(-1), ins_syms.reshape(-1),
-        _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)])
-
-
-@partial(jax.jit, static_argnames=("min_depth", "cp"))
+@partial(jax.jit, static_argnames=("min_depth", "cp", "sparse_cap"))
 def vote_packed(counts: jax.Array, thr_enc: jax.Array, offsets: jax.Array,
                 site_keys: jax.Array, n_cols: jax.Array, ev_key: jax.Array,
                 ev_col: jax.Array, ev_code: jax.Array,
-                min_depth: int, cp: int) -> jax.Array:
+                min_depth: int, cp: int, sparse_cap=None) -> jax.Array:
     """Position vote + insertion table + insertion vote + stats, packed.
 
     ``site_keys``/``n_cols`` are the padded ``[Kp]`` site arrays
     (flat genome position, -1 for end-of-contig and pad sites); ``cp`` is
     the padded insertion-table column count (static).  Pad events scatter
-    into the sacrificial row Kp-1.
+    into the sacrificial row Kp-1.  With ``sparse_cap`` the position
+    symbols travel as emit bitmask + compacted chars.
     """
     syms, cov = vote_block(counts, thr_enc, min_depth)          # [T, L]
     contig_sums, site_cov = _tail_stats(cov, offsets, site_keys)
@@ -171,8 +150,8 @@ def vote_packed(counts: jax.Array, thr_enc: jax.Array, offsets: jax.Array,
     table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
     table = build_insertion_table(table, ev_key, ev_col, ev_code)
     ins_syms = vote_insertions(table, site_cov, n_cols, thr_enc)  # [T,Kp,Cp]
-    return jnp.concatenate([
-        syms.reshape(-1), ins_syms.reshape(-1),
+    return jnp.concatenate(_syms_head(syms, cov, min_depth, sparse_cap) + [
+        ins_syms.reshape(-1),
         _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)])
 
 
@@ -203,12 +182,6 @@ def vote_packed_pallas(counts: jax.Array, thr_enc: jax.Array,
                       max_blocks=max_blocks, interpret=interpret)
     table = out.reshape(kp, c6p)[:, : cp * 6].reshape(kp, cp, 6)
     ins_syms = vote_insertions(table, site_cov, n_cols, thr_enc)
-    if sparse_cap is None:
-        head = [syms.reshape(-1)]
-    else:
-        emit = (cov > 0) & (cov >= min_depth)
-        bits, compact = _sparse_syms(syms, emit, sparse_cap)
-        head = [bits, compact.reshape(-1)]
-    return jnp.concatenate(head + [
+    return jnp.concatenate(_syms_head(syms, cov, min_depth, sparse_cap) + [
         ins_syms.reshape(-1),
         _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)])

@@ -78,6 +78,14 @@ def iupac_select(mask: jax.Array) -> jax.Array:
     return jnp.sum(jnp.where(onehot, lut, 0), axis=-1).astype(jnp.uint8)
 
 
+def emit_gate(cov: jax.Array, min_depth: int) -> jax.Array:
+    """Positions the reference emits a real character for (others get the
+    fill char): ``cov > 0 ∧ cov >= min_depth``.  Single definition shared
+    by the vote's FILL sentinel and the sparse-output bitmask
+    (ops/fused.py) so the two can never drift apart."""
+    return (cov > 0) & (cov >= min_depth)
+
+
 def vote_block(counts: jax.Array, thr_enc: jax.Array,
                min_depth: int) -> tuple:
     """Vote every position of a counts block for every threshold.
@@ -108,7 +116,7 @@ def vote_block(counts: jax.Array, thr_enc: jax.Array,
     nonzero = counts != 0
     bit = (1 << jnp.arange(6, dtype=jnp.int32))[None, :]
 
-    emit = (cov > 0) & (cov >= min_depth)                      # [L]
+    emit = emit_gate(cov, min_depth)                           # [L]
 
     def per_threshold(enc_row):
         cutoff = exact_cutoff(cov, enc_row)                    # [L]

@@ -198,3 +198,45 @@ def test_sparse_output_tail_pallas_byte_identical():
     assert out_jax == out_cpu
     assert st.extra["insertion_kernel"] == "pallas"
     assert st.extra["d2h_bytes"] < 2 * 200_000 * 2, st.extra
+
+
+def test_overflow_sums_host_fallback():
+    """Total aligned bases past int32 route contig sums through the host
+    recomputation (the device cumsum is int32); per-position values stay
+    int32-safe by construction.  Exercised by resuming from a crafted
+    checkpoint whose counts already hold >2^31 events."""
+    from sam2consensus_tpu.io.sam import ReadStream, opener
+    from sam2consensus_tpu.utils import checkpoint as ckpt
+    from sam2consensus_tpu.encoder.events import InsertionEvents
+
+    length = 8
+    big = 1 << 29                       # per-lane, per-position: int32-safe
+    counts = np.zeros((length, 6), np.int32)
+    counts[:, 1] = big                  # 8 * 2^29 = 2^32 total events
+    text = ("@SQ\tSN:z\tLN:8\n"
+            "r1\t0\tz\t1\t60\t4M\t*\t0\t0\tACGT\t*\n")
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "in.sam")
+        with open(path, "w") as fh:
+            fh.write(text)
+        ckdir = os.path.join(tmp, "ck")
+        ckpt.save(ckdir, ckpt.CheckpointState(
+            counts=counts, lines_consumed=0, reads_mapped=0,
+            reads_skipped=0, aligned_bases=8 * big,
+            insertions=InsertionEvents(), byte_offset=-1))
+
+        handle = opener(path, binary=True)
+        contigs, _n, first = read_header(handle)
+        cfg = RunConfig(prefix="t", thresholds=[0.25], shards=1,
+                        checkpoint_dir=ckdir)
+        res = JaxBackend().run(contigs, ReadStream(handle, first), cfg)
+        handle.close()
+        assert res.stats.extra.get("contig_sums_host_fallback") is True
+        # header's mean coverage comes from the exact >2^31 sum:
+        # (8*2^29 + 4 new bases) / 8 positions = 536870912.5 — an int32
+        # cumsum would have wrapped this
+        header = res.fastas["z"][0].header
+        assert f"coverage:{(8 * big + 4) / 8}" in header, header
+        # the called bases are all A — lane 1 in the ASCII-sorted alphabet
+        # ('-', A, C, G, N, T); 2^29 As drown the 4 new read bases
+        assert res.fastas["z"][0].seq == "AAAAAAAA"

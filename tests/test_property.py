@@ -148,3 +148,27 @@ def test_sharded_counts_equal_unsharded(inp):
                      shards=len(jax.devices()), **cfg_kw)
     assert _render(JaxBackend(), text, cfg8) == \
         _render(JaxBackend(), text, cfg1)
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    t=st.floats(min_value=1e-12, max_value=4.0, allow_nan=False,
+                allow_infinity=False),
+    covs=st.lists(st.integers(min_value=0, max_value=2 ** 31 - 1),
+                  min_size=1, max_size=64))
+def test_exact_cutoff_matches_float64_oracle(t, covs):
+    """Device int32-limb cutoff == ceil(numpy float64 product), any double
+    threshold, any int32 coverage (the reference's float compare,
+    sam2consensus.py:359-367)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sam2consensus_tpu.ops.cutoff import encode_thresholds, exact_cutoff
+
+    cov = np.asarray(covs, dtype=np.int32)
+    enc = encode_thresholds([t])
+    got = np.asarray(jax.jit(exact_cutoff)(jnp.asarray(cov),
+                                           jnp.asarray(enc[0])))
+    want = np.minimum(np.ceil(np.float64(t) * cov.astype(np.float64)),
+                      2 ** 31 - 1).astype(np.int64)
+    np.testing.assert_array_equal(got.astype(np.int64), want)
