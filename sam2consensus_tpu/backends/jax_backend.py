@@ -264,7 +264,7 @@ class JaxBackend:
 
         # host decode: native C++ text path when a ReadStream is available
         # (SURVEY.md §2b native component), python record path otherwise
-        encoder, batches = self._make_encoder(layout, records, cfg)
+        encoder, batches = self._make_encoder(layout, records, cfg, acc)
         if skip_input:
             # already-absorbed shard: decode nothing (its contribution is in
             # the checkpointed counts; re-reading it would double-count)
@@ -631,18 +631,26 @@ class JaxBackend:
                     "host recomputation")
         stats.extra["paranoid_result_ok"] = True
 
-    def _make_encoder(self, layout, records, cfg: RunConfig):
+    def _make_encoder(self, layout, records, cfg: RunConfig, acc=None):
         """Pick the host decode path; returns (encoder, batch iterator)."""
         from ..encoder.events import GenomeLayout, ReadEncoder  # noqa: F811
         from ..io.sam import ReadStream
+        from ..ops.pileup import HostPileupAccumulator
 
         if isinstance(records, ReadStream) and cfg.decoder != "py":
             from ..encoder import native_encoder
 
             if native_encoder.available():
+                # host-counts strategy: fuse accumulation into the C++
+                # decode pass (single memory walk — the one-core-host
+                # fast path).  Paranoid mode keeps the two-pass row path
+                # so batches can be re-validated.
+                fuse = (isinstance(acc, HostPileupAccumulator)
+                        and not cfg.paranoid)
                 enc = native_encoder.NativeReadEncoder(
                     layout, maxdel=cfg.maxdel, strict=cfg.strict,
-                    on_lines=records.add_lines, on_bytes=records.add_bytes)
+                    on_lines=records.add_lines, on_bytes=records.add_bytes,
+                    accumulate_into=acc.counts_host() if fuse else None)
                 return enc, enc.encode_blocks(records.blocks())
             if cfg.decoder == "native":
                 from .. import native

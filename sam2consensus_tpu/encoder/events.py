@@ -83,6 +83,10 @@ class SegmentBatch:
     buckets: Dict[int, Tuple[np.ndarray, np.ndarray]]
     n_reads: int = 0
     n_events: int = 0          # countable (non-PAD) symbols in the batch
+    #: True when the fused decode path already counted this batch's cells
+    #: into the host count tensor (encoder/native_encoder.py): buckets are
+    #: empty and consumers must not re-accumulate
+    accumulated: bool = False
 
 
 @dataclass

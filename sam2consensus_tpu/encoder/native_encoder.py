@@ -54,7 +54,8 @@ class NativeReadEncoder:
 
     def __init__(self, layout: GenomeLayout, maxdel: Optional[int] = 150,
                  strict: bool = True, width: int = 256,
-                 on_lines=None, on_bytes=None):
+                 on_lines=None, on_bytes=None,
+                 accumulate_into: Optional[np.ndarray] = None):
         lib = native.load()
         if lib is None:  # pragma: no cover - callers check available()
             raise RuntimeError(f"native decoder unavailable: "
@@ -66,6 +67,22 @@ class NativeReadEncoder:
         self.width = width
         self.on_lines = on_lines
         self.on_bytes = on_bytes
+        # fused host pileup: the C decoder increments this [L, 6] int32
+        # tensor per committed row (single pass, no slab re-walk — the
+        # one-core-host fast path); rows become scratch and batches carry
+        # only counters.  Python-fallback reads accumulate via numpy.
+        self._acc = accumulate_into
+        if accumulate_into is not None:
+            if accumulate_into.shape != (layout.total_len, 6) \
+                    or accumulate_into.dtype != np.int32 \
+                    or not accumulate_into.flags.c_contiguous:
+                raise ValueError("accumulate_into must be C-contiguous "
+                                 "int32 [total_len, 6]")
+            self._acc_flat = accumulate_into.reshape(-1)
+            self._acc_len = layout.total_len
+        else:
+            self._acc_flat = np.zeros(6, dtype=np.int32)   # dummy, len 0
+            self._acc_len = 0
         # python twin for overflow/error-replay fallback; shares counters
         # and the insertion store so fallback reads land in the same place
         self._py = ReadEncoder(layout, maxdel=maxdel, strict=strict)
@@ -139,13 +156,17 @@ class NativeReadEncoder:
                     ic, il, im, ins_cap,
                     ich, chars_cap,
                     ovf, ovf_cap,
-                    out)
+                    out,
+                    self._acc_flat, self._acc_len)
 
                 (n_rows, n_reads, n_skipped, consumed, n_ins, n_chars,
                  status, _err_off, n_events, n_lines, n_overflow,
                  _max_span) = out[:12]
 
-                self._fill = fill + int(n_rows)
+                # fused pileup: rows were counted inside the C pass; the
+                # slab is scratch, reuse it from the top
+                self._fill = 0 if self._acc is not None \
+                    else fill + int(n_rows)
                 if n_ins:
                     self.insertions.array_chunks.append(
                         (ic[:n_ins].copy(), il[:n_ins].copy(),
@@ -194,6 +215,14 @@ class NativeReadEncoder:
                         ovf_cap *= 2
                     # else: per-call insertion buffers were the constraint;
                     # they were copied out above, so just keep going
+
+            if self._acc is not None and self._batch_reads:
+                # fused pileup: the slab never fills (it is scratch), so
+                # yield a counters-only batch per text block to keep the
+                # backend's checkpoint cadence and stats ticking
+                batch = self._flush()
+                if batch is not None:
+                    yield batch
 
         batch = self._flush()
         if batch is not None:
@@ -247,8 +276,17 @@ class NativeReadEncoder:
             self._py.n_reads += 1
             self._batch_reads += 1
             for start_flat, row in rows:
-                self._fallback_rows.append((start_flat, row))
-                self._batch_events += len(row) - int((row == PAD_CODE).sum())
+                if self._acc is not None:
+                    # fused pileup: count the replayed row immediately
+                    cols = np.nonzero(row < 6)[0]
+                    pos = start_flat + cols
+                    ok = (pos >= 0) & (pos < self._acc_len)
+                    np.add.at(self._acc, (pos[ok], row[cols[ok]]), 1)
+                    self._batch_events += len(cols)
+                else:
+                    self._fallback_rows.append((start_flat, row))
+                    self._batch_events += (len(row)
+                                           - int((row == PAD_CODE).sum()))
 
     def _build_batch(self, native_parts, fallback_rows, n_reads, n_events
                      ) -> Optional[SegmentBatch]:
@@ -295,4 +333,5 @@ class NativeReadEncoder:
         if not buckets and n_reads == 0:
             return None
         return SegmentBatch(buckets=buckets, n_reads=n_reads,
-                            n_events=n_events)
+                            n_events=n_events,
+                            accumulated=self._acc is not None)

@@ -96,6 +96,7 @@ def load() -> Optional[ctypes.CDLL]:
         u8p, ctypes.c_long,                    # ins_chars, cap
         i64p, ctypes.c_long,                   # overflow_off, cap
         i64p,                                  # out stats
+        i32p, ctypes.c_int64,                  # fused pileup counts, len
     ]
     lib.s2c_accumulate_rows.restype = None
     lib.s2c_accumulate_rows.argtypes = [

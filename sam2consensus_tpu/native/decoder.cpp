@@ -182,7 +182,14 @@ extern "C" long s2c_decode(
     int32_t* ins_contig, int32_t* ins_local, int32_t* ins_mlen, long ins_cap,
     unsigned char* ins_chars, long ins_chars_cap,
     int64_t* overflow_off, long overflow_cap,
-    int64_t* out) {
+    int64_t* out,
+    // fused host pileup (ops/pileup.py HostPileupAccumulator): when
+    // acc_total_len > 0, every committed row's cells are accumulated into
+    // acc_counts [acc_total_len * 6] right here, while the translated row
+    // is still in cache — the single-pass path that replaces the separate
+    // slab walk on one-core hosts.  Rows are still written to the slab
+    // (the wrapper treats it as scratch and resets its fill).
+    int32_t* acc_counts, int64_t acc_total_len) {
   NameTable table;
   table.build(names, name_off, n_contigs);
 
@@ -451,6 +458,15 @@ extern "C" long s2c_decode(
         starts[n_rows] = static_cast<int32_t>(ctg_offset[ci] + pos);
         ++n_rows;
         n_events += span - pads;
+        if (acc_total_len > 0) {
+          const int64_t g0 = ctg_offset[ci] + pos;
+          for (long k = 0; k < span; ++k) {
+            const unsigned char code = dst[k];
+            const int64_t gp = g0 + k;
+            if (code < 6 && gp >= 0 && gp < acc_total_len)
+              ++acc_counts[gp * 6 + code];
+          }
+        }
       }
       ++n_reads;
       i = next;
@@ -592,6 +608,16 @@ extern "C" long s2c_decode(
       }
       for (long k = 0; k < span; ++k)
         if (row[k] != kPad) ++n_events;
+      if (acc_total_len > 0) {
+        for (long k = 0; k < span; ++k) {
+          const unsigned char code = rp[k];
+          if (code >= 6) continue;
+          const int64_t gp = (k < neg)
+              ? base_off + reflen + pos + k
+              : base_off + (pos < 0 ? 0 : pos) + (k - neg);
+          if (gp >= 0 && gp < acc_total_len) ++acc_counts[gp * 6 + code];
+        }
+      }
     }
     ++n_reads;
     i = next;

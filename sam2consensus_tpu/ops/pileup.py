@@ -230,6 +230,13 @@ class HostPileupAccumulator:
 
     def add(self, batch: SegmentBatch) -> None:
         self._device_counts = None
+        if batch.accumulated:
+            # fused decode path: the C++ decoder already counted this
+            # batch's rows in-pass (encoder/native_encoder.py); nothing to
+            # walk, just record that the fused path ran
+            self.strategy_used["host_fused"] = (
+                self.strategy_used.get("host_fused", 0) + 1)
+            return
         flat = self._counts.reshape(-1)
         for w, (starts, codes) in sorted(batch.buckets.items()):
             if self._lib is not None:
@@ -267,7 +274,9 @@ class HostPileupAccumulator:
         return self._counts
 
     def set_counts(self, counts) -> None:
-        self._counts = np.array(counts, dtype=np.int32)
+        # in place: the fused decode path (encoder/native_encoder.py)
+        # captures this buffer by reference; rebinding would orphan it
+        self._counts[:] = np.asarray(counts, dtype=np.int32)
         self._device_counts = None
 
 

@@ -240,3 +240,46 @@ def test_overflow_sums_host_fallback():
         # the called bases are all A — lane 1 in the ASCII-sorted alphabet
         # ('-', A, C, G, N, T); 2^29 As drown the 4 new read bases
         assert res.fastas["z"][0].seq == "AAAAAAAA"
+
+
+def test_fused_decode_accumulate_equals_two_pass():
+    """The C++ fused decode+accumulate path (accumulate_into) produces
+    identical counts/read counts to decode-then-walk, including
+    python-replayed fallback reads (negative-POS wraps)."""
+    from sam2consensus_tpu import native
+
+    if native.load() is None:
+        import pytest
+
+        pytest.skip("native decoder unavailable")
+    from sam2consensus_tpu.encoder.native_encoder import NativeReadEncoder
+    from sam2consensus_tpu.io.sam import ReadStream
+
+    text = simulate(SimSpec(n_contigs=3, contig_len=400, n_reads=500,
+                            read_len=60, ins_read_rate=0.2,
+                            del_read_rate=0.2, seed=48))
+    # negative-POS wrap rides the C slow path
+    text += "neg\t0\tcontig0000\t0\t60\t4M\t*\t0\t0\tACGT\t*\n"
+    # span 300 > default width (256): overflow -> python-fallback replay,
+    # exercising the fused numpy-accumulate branch in _fallback_line
+    # (contig0002 is 474 long at this seed, so the span fits)
+    text += ("wide\t0\tcontig0002\t1\t60\t2M296D2M\t*\t0\t0\tACGT\t*\n")
+
+    def run(fused):
+        handle = io.StringIO(text)
+        contigs, _n, first = read_header(handle)
+        layout = GenomeLayout(contigs)
+        acc = HostPileupAccumulator(layout.total_len)
+        enc = NativeReadEncoder(
+            layout,
+            accumulate_into=acc.counts_host() if fused else None)
+        for b in enc.encode_blocks(ReadStream(handle, first).blocks()):
+            acc.add(b)
+        return acc, enc
+
+    acc_two, enc_two = run(False)
+    acc_fused, enc_fused = run(True)
+    np.testing.assert_array_equal(acc_two.counts_host(),
+                                  acc_fused.counts_host())
+    assert enc_two.n_reads == enc_fused.n_reads
+    assert acc_fused.strategy_used.get("host_fused", 0) > 0
