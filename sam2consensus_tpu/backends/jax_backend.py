@@ -36,6 +36,12 @@ from .base import BackendResult, BackendStats, FastaRecord, format_header
 #: to 1<<16 on overflow, encoder/native_encoder.py)
 SP_HALO = 1 << 16
 
+#: largest L * n_thresholds the host-counts tail runs on the local XLA
+#: CPU backend instead of the tunneled chip: below this the vote costs
+#: single-digit ms anywhere, so the ~2 x 65 ms link round trips dominate
+#: (tools/tunnel_probe.py); above it the chip's bandwidth wins
+HOST_TAIL_MAX_CELLS = 1 << 19
+
 
 def _timed_iter(it, times, key: str = "decode_sec"):
     """Yield from ``it``, accumulating the time spent inside ``next``."""
@@ -336,21 +342,45 @@ class JaxBackend:
         # per-contig sums need the round-2 style full-coverage fetch then.
         overflow_sums = stats.aligned_bases > np.iinfo(np.int32).max
         thr_enc_np = encode_thresholds(cfg.thresholds)
-        thr_enc = jnp.asarray(thr_enc_np)
         offsets32 = layout.offsets.astype(np.int32)
+        n_thresholds = len(cfg.thresholds)
+        total_len = layout.total_len
+        n_contigs = len(layout.names)
         if isinstance(acc, HostPileupAccumulator):
-            # touch counts now: the host-counts upload (cached in the
-            # accumulator) starts asynchronously here and overlaps the
-            # host-side insertion grouping below.  Device accumulators are
-            # excluded — their counts property is an uncached slice.
+            # small-genome gate: at ~65 ms per tunneled round trip, a tail
+            # this small finishes faster on the LOCAL XLA CPU backend than
+            # the link's latency alone — the counts are already host-side.
+            # JAX computations follow committed operands, so committing the
+            # counts upload to the cpu device routes the whole fused tail
+            # (same jitted functions) there.
+            if total_len * n_thresholds <= HOST_TAIL_MAX_CELLS:
+                try:
+                    cpus = jax.devices("cpu")
+                    acc.tail_device = cpus[0] if cpus else None
+                except RuntimeError:
+                    acc.tail_device = None
+                if acc.tail_device is not None:
+                    stats.extra["tail_device"] = "cpu"
+            # touch counts now: the upload (cached in the accumulator)
+            # starts here and overlaps the host-side insertion grouping
+            # below.  Device accumulators are excluded — their counts
+            # property is an uncached slice.
             _ = acc.counts
+        tail_dev = getattr(acc, "tail_device", None)
+
+        def put(x):
+            """Tail-operand placement: EVERY operand must land on the
+            tail's device up front — an uncommitted jnp.asarray would
+            materialize on the default (tunneled) device first and bounce
+            back over the link to join the cpu-committed computation."""
+            return (jax.device_put(x, tail_dev) if tail_dev is not None
+                    else jnp.asarray(x))
+
+        thr_enc = put(thr_enc_np)
         ins = group_insertions(encoder.insertions, layout)
         stats.extra["insertions_sec"] = round(time.perf_counter() - t0, 4)
 
         t0 = time.perf_counter()
-        n_thresholds = len(cfg.thresholds)
-        total_len = layout.total_len
-        n_contigs = len(layout.names)
         # sparse-output gate: covered positions are bounded by aligned
         # bases, so when coverage is sparse the emit bitmask + compacted
         # chars cost far fewer d2h bytes than the dense [T, L] fetch
@@ -400,7 +430,8 @@ class JaxBackend:
                 eplan = pallas_insertion.plan_events(
                     ins["ev_key"], ins["ev_col"], ins["ev_code"], k, cp)
                 sk_pl, nc_pl = padded_sites(eplan.kp)
-                interp = jax.default_backend() != "tpu"
+                interp = (jax.default_backend() != "tpu"
+                          or getattr(acc, "tail_device", None) is not None)
 
             if use_sharded:
                 # position vote + stats run position-sharded; the insertion
@@ -431,10 +462,10 @@ class JaxBackend:
                     thr_enc))[:, :k, :]                       # [T, K, Cp]
             elif use_pallas:
                 packed = fused.vote_packed_pallas(
-                    acc.counts, thr_enc, jnp.asarray(offsets32),
-                    jnp.asarray(sk_pl), jnp.asarray(nc_pl),
-                    jnp.asarray(eplan.key3), jnp.asarray(eplan.cc3),
-                    jnp.asarray(eplan.blk_lo), jnp.asarray(eplan.blk_n),
+                    acc.counts, thr_enc, put(offsets32),
+                    put(sk_pl), put(nc_pl),
+                    put(eplan.key3), put(eplan.cc3),
+                    put(eplan.blk_lo), put(eplan.blk_n),
                     cfg.min_depth, cp, eplan.kp, eplan.c6p,
                     eplan.max_blocks, interp, sparse_cap)
                 out = np.asarray(packed)
@@ -446,10 +477,10 @@ class JaxBackend:
                 sk, ncp = padded_sites(kp)
                 ev_key, ev_col, ev_code = padded_events(kp)
                 packed = fused.vote_packed(
-                    acc.counts, thr_enc, jnp.asarray(offsets32),
-                    jnp.asarray(sk), jnp.asarray(ncp),
-                    jnp.asarray(ev_key), jnp.asarray(ev_col),
-                    jnp.asarray(ev_code), cfg.min_depth, cp, sparse_cap)
+                    acc.counts, thr_enc, put(offsets32),
+                    put(sk), put(ncp),
+                    put(ev_key), put(ev_col),
+                    put(ev_code), cfg.min_depth, cp, sparse_cap)
                 out = np.asarray(packed)
                 syms, ins_syms, contig_sums, site_cov = self._unpack_tail(
                     out, n_thresholds, total_len, kp, cp, n_contigs, k,
@@ -463,7 +494,7 @@ class JaxBackend:
                 syms = acc.vote(thr_enc_np, cfg.min_depth)
             else:
                 out = np.asarray(fused.vote_packed_simple(
-                    acc.counts, thr_enc, jnp.asarray(offsets32),
+                    acc.counts, thr_enc, put(offsets32),
                     cfg.min_depth, sparse_cap))
                 if sparse_cap is not None:
                     syms, split = self._expand_sparse(
@@ -491,7 +522,10 @@ class JaxBackend:
                 syms.nbytes + (ins_syms.nbytes if ins_syms is not None
                                else 0))
         else:
-            stats.extra["d2h_bytes"] = int(out.nbytes)
+            # a cpu-routed tail never crosses the link: keep the wire
+            # accounting symmetric with the suppressed h2d side
+            stats.extra["d2h_bytes"] = \
+                0 if tail_dev is not None else int(out.nbytes)
         if getattr(acc, "strategy_used", None):
             # refresh: the host-counts path records its wire dtype at upload
             stats.extra["pileup"] = dict(acc.strategy_used)

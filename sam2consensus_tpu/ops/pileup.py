@@ -227,6 +227,11 @@ class HostPileupAccumulator:
         self._device_counts = None
         self.strategy_used: dict = {"host": 0}
         self.bytes_h2d = 0                     # wire accounting for bench
+        #: when set (backends/jax_backend.py small-genome gate), counts
+        #: upload COMMITS to this device and the whole fused tail follows
+        #: it — e.g. the local XLA CPU backend, whose dispatch costs ~ms
+        #: where the tunneled chip costs ~65 ms per round trip
+        self.tail_device = None
 
     def add(self, batch: SegmentBatch) -> None:
         self._device_counts = None
@@ -266,8 +271,9 @@ class HostPileupAccumulator:
             else:
                 arr = self._counts
             self.strategy_used["host_wire_dtype"] = str(arr.dtype)
-            self.bytes_h2d += arr.nbytes
-            self._device_counts = jax.device_put(arr)
+            if self.tail_device is None:
+                self.bytes_h2d += arr.nbytes   # real wire bytes
+            self._device_counts = jax.device_put(arr, self.tail_device)
         return self._device_counts
 
     def counts_host(self) -> np.ndarray:
