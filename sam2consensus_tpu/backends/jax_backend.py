@@ -352,8 +352,11 @@ class JaxBackend:
             # the link's latency alone — the counts are already host-side.
             # JAX computations follow committed operands, so committing the
             # counts upload to the cpu device routes the whole fused tail
-            # (same jitted functions) there.
-            if total_len * n_thresholds <= HOST_TAIL_MAX_CELLS:
+            # (same jitted functions) there.  An explicit pallas insertion
+            # kernel keeps the device tail: interpret-mode Pallas on CPU
+            # can dwarf the saved link latency at scale.
+            if (total_len * n_thresholds <= HOST_TAIL_MAX_CELLS
+                    and getattr(cfg, "ins_kernel", "scatter") != "pallas"):
                 try:
                     cpus = jax.devices("cpu")
                     acc.tail_device = cpus[0] if cpus else None

@@ -241,6 +241,22 @@ def main():
          n_devices=len(jax.devices()))
     p = bench_pileup(rows, width, genome, repeats)
     i = bench_insertion(ins_sites, ins_events, repeats)
+    # insertion-kernel decision sweep (VERDICT r2 #4): pallas vs scatter
+    # across event scales, from a phiX-like trickle to amplicon-heavy.
+    # Off by default away from TPU: the large cases in interpret-mode
+    # Pallas multiply CPU wall time severalfold.
+    sweep = {}
+    sweep_default = "1" if jax.default_backend() == "tpu" else "0"
+    if os.environ.get("MB_INS_SWEEP", sweep_default) != "0":
+        for sites, events in ((500, 20_000), (5_000, 200_000),
+                              (20_000, 2_000_000), (50_000, 8_000_000)):
+            if (sites, events) == (ins_sites, ins_events):
+                sweep[(sites, events)] = i
+                continue
+            sweep[(sites, events)] = bench_insertion(sites, events, repeats)
+        wins = {f"{s}x{e}": round(r["scatter"] / r["pallas"], 2)
+                for (s, e), r in sweep.items()}
+        emit(op="insertion_sweep", pallas_speedup_vs_scatter=wins)
     emit(op="summary",
          pileup_winner=min(p, key=p.get),
          pileup_speedup_vs_scatter=round(p["scatter"] / min(p.values()), 2),

@@ -1,0 +1,65 @@
+#!/bin/bash
+# Detached TPU measurement campaign: waits for the tunnel, then runs the
+# full evidence sequence (cpu-coexist check, bench, microbench, probe).
+# Logs land in /root/repo/campaign/.
+set -u
+cd /root/repo
+mkdir -p campaign
+LOG=campaign/campaign.log
+echo "$(date +%H:%M:%S) campaign start" >> "$LOG"
+
+probe() {
+  timeout -k 15 150 python -c "import jax; print(jax.devices()[0].platform)" \
+      2>/dev/null | tail -1
+}
+
+# 1. wait for the tunnel (up to ~5h)
+up=0
+for i in $(seq 1 120); do
+  p=$(probe)
+  if [ "$p" = "tpu" ]; then
+    echo "$(date +%H:%M:%S) tunnel UP after $i tries" >> "$LOG"
+    up=1
+    break
+  fi
+  echo "$(date +%H:%M:%S) try $i: tunnel down" >> "$LOG"
+  sleep 90
+done
+if [ "$up" != "1" ]; then
+  echo "$(date +%H:%M:%S) giving up: tunnel never came up" >> "$LOG"
+  exit 1
+fi
+
+# 2. cpu backend coexistence (the host-tail gate depends on it)
+timeout -k 15 300 python -c "
+import jax, numpy as np
+print('default:', jax.default_backend(),
+      [d.platform for d in jax.devices()])
+try:
+    cpus = jax.devices('cpu')
+    x = jax.device_put(np.arange(8, dtype=np.int32), cpus[0])
+    y = jax.jit(lambda a: a * 2)(x)
+    print('cpu-routed jit OK:', np.asarray(y).tolist(), y.devices())
+except Exception as e:
+    print('NO CPU BACKEND:', type(e).__name__, e)
+" > campaign/cpu_coexist.txt 2>&1
+echo "$(date +%H:%M:%S) cpu_coexist done" >> "$LOG"
+
+# 3. full bench (all configs incl. north_star + wide_genome)
+BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 \
+  timeout -k 30 5400 python bench.py > campaign/bench_preview.json \
+  2> campaign/bench_stderr.log
+rc=$?
+echo "$(date +%H:%M:%S) bench done rc=$rc" >> "$LOG"
+
+# 4. device-op microbench (pallas-vs-scatter evidence, mxu rates)
+timeout -k 30 1800 python tools/microbench.py > campaign/microbench_tpu.jsonl \
+  2> campaign/microbench_stderr.log
+rc=$?
+echo "$(date +%H:%M:%S) microbench done rc=$rc" >> "$LOG"
+
+# 5. link probe (refresh PERF.md numbers)
+timeout -k 30 900 python tools/tunnel_probe.py > campaign/tunnel_probe.json \
+  2> campaign/tunnel_probe_stderr.log
+rc=$?
+echo "$(date +%H:%M:%S) probe done rc=$rc; campaign complete" >> "$LOG"
