@@ -684,6 +684,22 @@ class JaxBackend:
                 # so batches can be re-validated.
                 fuse = (isinstance(acc, HostPileupAccumulator)
                         and not cfg.paranoid)
+                threads = getattr(cfg, "decode_threads", 1)
+                if threads == 0:
+                    threads = min(4, os.cpu_count() or 1)
+                if fuse and threads > 1 and not cfg.checkpoint_dir:
+                    # multi-core hosts: parallel fused decode (per-worker
+                    # count tensors summed at the end; checkpointing
+                    # needs ordered offsets, so it keeps the serial path)
+                    from ..encoder.parallel_decode import \
+                        ParallelFusedDecoder
+
+                    enc = ParallelFusedDecoder(
+                        layout, acc.counts_host(), threads,
+                        maxdel=cfg.maxdel, strict=cfg.strict,
+                        on_lines=records.add_lines,
+                        on_bytes=records.add_bytes)
+                    return enc, enc.encode_blocks(records.blocks())
                 enc = native_encoder.NativeReadEncoder(
                     layout, maxdel=cfg.maxdel, strict=cfg.strict,
                     on_lines=records.add_lines, on_bytes=records.add_bytes,

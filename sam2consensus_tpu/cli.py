@@ -93,6 +93,12 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["scatter", "pallas"], default="scatter",
                    help="insertion-table build on device: XLA scatter "
                         "(default) or the Pallas segmented-reduce kernel")
+    p.add_argument("--decode-threads", dest="decode_threads", type=int,
+                   default=1,
+                   help="fused host-pileup decode workers (multi-core "
+                        "hosts; 0 = auto, up to 4). Engages on the "
+                        "host-counts strategy without --checkpoint-dir; "
+                        "per-worker count tensors sum exactly at the end")
     p.add_argument("--decoder", choices=["auto", "native", "py"],
                    default="auto",
                    help="host SAM decode path for the jax backend: the C++ "
@@ -151,6 +157,7 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         py2_compat=args.py2_compat,
         decoder=args.decoder,
         pileup=args.pileup,
+        decode_threads=args.decode_threads,
         ins_kernel=args.ins_kernel,
         chunk_reads=args.chunk_reads,
         profile_dir=args.profile_dir,

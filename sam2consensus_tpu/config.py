@@ -36,6 +36,7 @@ class RunConfig:
     py2_compat: bool = False
     decoder: str = "auto"        # auto | native | py (jax backend host decode)
     pileup: str = "auto"         # auto | mxu | scatter | host (pileup strategy)
+    decode_threads: int = 1      # fused-decode workers; 0 = auto (<=4)
     ins_kernel: str = "scatter"  # scatter | pallas (insertion table build)
     shard_mode: str = "auto"     # auto | dp | sp (sharded accumulator layout)
     incremental: bool = False    # keep/extend checkpoints across input files

@@ -1,0 +1,167 @@
+"""Multi-threaded fused decode+accumulate for multi-core hosts.
+
+The fused host-counts path (``native_encoder.NativeReadEncoder`` with
+``accumulate_into``) is a single pass over the SAM text at ~500 MB/s per
+core.  The measurement host fronting the tunneled chip has ONE core, but
+production TPU-VM hosts have many — and the count tensor is
+sum-decomposable, so the pass parallelizes exactly:
+
+* the input stream's line-aligned blocks round-robin into bounded
+  per-worker queues;
+* each worker owns a full fused decoder — its own slab scratch, its own
+  insertion store, its own ``[L, 6]`` count tensor — and the C decode
+  releases the GIL, so workers run truly parallel;
+* counts sum at the end (addition commutes: same guarantee the dp
+  reduce-scatter relies on, SURVEY.md §5); insertion stores concatenate
+  (grouping sorts by site key, so inter-store order is irrelevant);
+* strict-mode error parity: the serial path raises at the FIRST bad
+  input line.  Blocks are fed in stream order and processed in order
+  within each worker, so when workers fail the smallest failing block
+  index is exactly the first bad line of the stream; its exception is
+  re-raised after the join.  Feeding stops at the first observed
+  failure (the serial path would not have read further either).
+
+Not composable with checkpointing (checkpoints need ordered consumption
+offsets) or paranoid mode (which wants row batches); the backend gates
+accordingly.  With one worker the class degrades to the serial fused
+path plus one queue hop.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from .events import GenomeLayout, InsertionEvents, SegmentBatch
+from .native_encoder import NativeReadEncoder
+
+
+class ParallelFusedDecoder:
+    """Same surface as NativeReadEncoder for the backend's accumulate loop
+    (``insertions`` / ``n_reads`` / ``n_skipped`` / ``encode_blocks``)."""
+
+    _DONE = object()
+
+    #: per-worker count tensors are capped to this much extra memory in
+    #: total; workers clamp down on huge genomes rather than OOM the
+    #: large-genome runs the feature exists to speed up
+    EXTRA_COUNTS_BUDGET = 512 << 20
+
+    def __init__(self, layout: GenomeLayout, counts: np.ndarray,
+                 n_threads: int, maxdel: Optional[int] = 150,
+                 strict: bool = True, on_lines=None, on_bytes=None):
+        self.layout = layout
+        self._counts = counts                 # worker 0 writes here
+        extra_each = max(1, counts.nbytes)    # workers 1..n allocate this
+        cap = 1 + self.EXTRA_COUNTS_BUDGET // extra_each
+        self.n_threads = max(1, min(n_threads, cap))
+        self.insertions = InsertionEvents()
+        self.n_reads = 0
+        self.n_skipped = 0
+        self._on_lines = on_lines
+        self._on_bytes = on_bytes
+        self._workers: List[dict] = []
+        for w in range(self.n_threads):
+            target = counts if w == 0 else np.zeros_like(counts)
+            state = {
+                "counts": target, "q": queue.Queue(maxsize=2),
+                "batches": [], "error": None, "lines": 0, "bytes": 0,
+            }
+
+            def _count(key, st=state):
+                def cb(k):
+                    st[key] += k
+                return cb
+
+            enc = NativeReadEncoder(layout, maxdel=maxdel, strict=strict,
+                                    accumulate_into=target,
+                                    on_lines=_count("lines"),
+                                    on_bytes=_count("bytes"))
+            state["enc"] = enc
+            self._workers.append(state)
+
+    def _any_error(self) -> bool:
+        return any(st["error"] is not None for st in self._workers)
+
+    # -- worker ------------------------------------------------------------
+    def _work(self, state: dict) -> None:
+        enc: NativeReadEncoder = state["enc"]
+        current_idx = [None]
+
+        def feed():
+            while True:
+                item = state["q"].get()
+                if item is self._DONE:
+                    return
+                current_idx[0] = item[0]
+                yield item[1]
+
+        try:
+            for batch in enc.encode_blocks(feed()):
+                state["batches"].append(batch)
+        except BaseException as exc:
+            state["error"] = (current_idx[0], exc)
+
+    # -- coordinator -------------------------------------------------------
+    def encode_blocks(self, blocks) -> Iterator[SegmentBatch]:
+        threads = [threading.Thread(target=self._work, args=(st,),
+                                    daemon=True)
+                   for st in self._workers]
+        for t in threads:
+            t.start()
+
+        def tolerant_put(st, thread, item) -> bool:
+            """Bounded put that gives up if the worker died."""
+            while thread.is_alive():
+                try:
+                    st["q"].put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        try:
+            for idx, block in enumerate(blocks):
+                if self._any_error():
+                    break                 # serial parity: stop reading
+                w = idx % self.n_threads
+                tolerant_put(self._workers[w], threads[w], (idx, block))
+                # drain finished batches opportunistically so the
+                # backend's stats cadence ticks while decoding continues
+                for st in self._workers:
+                    while st["batches"]:
+                        yield st["batches"].pop(0)
+        finally:
+            for st, t in zip(self._workers, threads):
+                tolerant_put(st, t, self._DONE)
+            for t in threads:
+                t.join()
+
+        # error parity: smallest failing block index == first bad line
+        errors = [st["error"] for st in self._workers
+                  if st["error"] is not None]
+        if errors:
+            errors.sort(key=lambda e: (e[0] is None, e[0]))
+            raise errors[0][1]
+
+        # merge: counts sum into worker 0's tensor (the accumulator's
+        # buffer), insertion stores concatenate, counters total
+        n_lines = n_bytes = 0
+        for w, st in enumerate(self._workers):
+            enc: NativeReadEncoder = st["enc"]
+            if w > 0:
+                self._counts += st["counts"]
+            self.insertions.extend(enc.insertions)
+            self.n_reads += enc.n_reads
+            self.n_skipped += enc.n_skipped
+            n_lines += st["lines"]
+            n_bytes += st["bytes"]
+            for batch in st["batches"]:
+                yield batch
+        if self._on_lines is not None and n_lines:
+            self._on_lines(n_lines)
+        if self._on_bytes is not None and n_bytes:
+            self._on_bytes(n_bytes)

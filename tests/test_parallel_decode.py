@@ -1,0 +1,101 @@
+"""Multi-threaded fused decode: exact parity with the serial path.
+
+The count tensor is sum-decomposable, so per-worker tensors summed at the
+end must equal the serial fused pass bit-for-bit; insertion grouping
+sorts by site key, so store concatenation order is irrelevant; strict
+errors must surface as the FIRST bad line of the stream exactly like the
+serial path (encoder/parallel_decode.py).
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from sam2consensus_tpu import native
+from sam2consensus_tpu.backends.cpu import CpuBackend
+from sam2consensus_tpu.backends.jax_backend import JaxBackend
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.encoder.events import GenomeLayout
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.io.sam import ReadStream, read_header
+from sam2consensus_tpu.ops.pileup import HostPileupAccumulator
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+pytestmark = pytest.mark.skipif(native.load() is None,
+                                reason="native decoder unavailable")
+
+
+def _decode(text, n_threads, block_bytes=4096):
+    from sam2consensus_tpu.encoder.parallel_decode import \
+        ParallelFusedDecoder
+
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    layout = GenomeLayout(contigs)
+    acc = HostPileupAccumulator(layout.total_len)
+    dec = ParallelFusedDecoder(layout, acc.counts_host(), n_threads)
+    stream = ReadStream(handle, first)
+    events = 0
+    for b in dec.encode_blocks(stream.blocks(max_bytes=block_bytes)):
+        acc.add(b)
+        events += b.n_events
+    return acc, dec, events
+
+
+@pytest.mark.parametrize("n_threads", [1, 2, 4])
+def test_parallel_counts_equal_serial(n_threads):
+    text = simulate(SimSpec(n_contigs=4, contig_len=300, n_reads=1200,
+                            read_len=60, ins_read_rate=0.2,
+                            del_read_rate=0.2, seed=51))
+    acc1, dec1, ev1 = _decode(text, 1)
+    accn, decn, evn = _decode(text, n_threads)
+    np.testing.assert_array_equal(acc1.counts_host(), accn.counts_host())
+    assert dec1.n_reads == decn.n_reads
+    assert dec1.n_skipped == decn.n_skipped
+    assert ev1 == evn
+    assert len(dec1.insertions) == len(decn.insertions)
+
+
+def test_parallel_error_is_first_bad_line():
+    """A bad line mid-stream raises the SAME first error regardless of
+    which worker hits which block."""
+    text = simulate(SimSpec(n_contigs=2, contig_len=200, n_reads=400,
+                            read_len=40, seed=52))
+    lines = text.splitlines(keepends=True)
+    # malformed body line (too few fields -> IndexError parity) spliced
+    # near the middle, then another later — only the FIRST must surface
+    mid = len(lines) // 2
+    lines.insert(mid, "broken\tline\n")
+    lines.insert(mid + 50, "also\tbroken\n")
+    bad_text = "".join(lines)
+
+    errs = []
+    for n_threads in (1, 3):
+        with pytest.raises(Exception) as ei:
+            _decode(bad_text, n_threads, block_bytes=1024)
+        errs.append((type(ei.value), str(ei.value)))
+    assert errs[0] == errs[1]
+
+
+def _run_cli_style(text, cfg):
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    res = JaxBackend().run(contigs, ReadStream(handle, first), cfg)
+    return {n: render_file(r, 0) for n, r in res.fastas.items()}
+
+
+def test_backend_decode_threads_byte_identical():
+    text = simulate(SimSpec(n_contigs=3, contig_len=250, n_reads=900,
+                            read_len=50, ins_read_rate=0.25,
+                            del_read_rate=0.15, seed=53))
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    from sam2consensus_tpu.io.sam import iter_records
+    res_cpu = CpuBackend().run(contigs, iter_records(handle, first),
+                               RunConfig(prefix="t", thresholds=[0.25]))
+    want = {n: render_file(r, 0) for n, r in res_cpu.fastas.items()}
+
+    got = _run_cli_style(text, RunConfig(prefix="t", thresholds=[0.25],
+                                         shards=1, decode_threads=3))
+    assert got == want

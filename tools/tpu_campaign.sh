@@ -13,7 +13,7 @@ probe() {
       2>/dev/null | tail -1
 }
 
-# 1. wait for the tunnel (up to ~5h)
+# 1. wait for the tunnel (up to ~8.5h: 120 x (150s probe + grace + 90s))
 up=0
 for i in $(seq 1 120); do
   p=$(probe)
