@@ -1,19 +1,30 @@
-"""JAX/TPU backend: encoder → scatter-add pileup → jit vote → host render.
+"""JAX/TPU backend: decode → pileup → fused one-round-trip tail → render.
 
-The TPU-native pipeline replacing the reference's interpreter loops
-(SURVEY.md §1 "new-framework layer map", §7 steps 3-7):
+The pipeline replacing the reference's interpreter loops (SURVEY.md §1
+"new-framework layer map", §7 steps 3-7), shaped by the measured link
+roofline (PERF.md):
 
-1. host encoder turns records into flat (position, code) event arrays
-   (``encoder/events.py``);
-2. device scatter-add accumulates the ``[total_len, 6]`` count tensor
-   (``ops/pileup.py``) — the count tensor is the entire job state and is
-   sum-decomposable, which is what makes DP/psum and checkpointing exact;
-3. the threshold vote runs as a closed-form int32 reduction vmapped over
-   thresholds (``ops/vote.py``), and the insertion "mini-alignment" table is
-   scatter-built and voted the same way (``ops/insertions.py``);
-4. the host splices insertion columns after their site's base (right-shift
-   placement, quirk 3), substitutes the fill character for sentinel bytes and
-   renders FASTA records byte-identically to the CPU oracle.
+1. the host decoder turns SAM text into segment rows
+   (``encoder/events.py`` / ``native/decoder.cpp``), prefetched on a
+   background thread; the count tensor — the entire job state, and
+   sum-decomposable, which is what makes DP/psum and checkpointing
+   exact — accumulates by the least-wire strategy (``ops/pileup.py``):
+   4-bit-packed rows into a device scatter or MXU one-hot matmul
+   (autotuned), or, for deep/small genomes, fused into the C++ decode
+   pass itself and shipped as dtype-narrowed counts once
+   (optionally multi-threaded, ``encoder/parallel_decode.py``);
+2. the whole post-accumulation tail is ONE dispatch returning ONE packed
+   buffer (``ops/fused.py``): the closed-form threshold vote with exact
+   device-side float64 cutoffs (``ops/vote.py``, ``ops/cutoff.py``), the
+   insertion "mini-alignment" table and vote (``ops/insertions.py``),
+   per-contig coverage sums and per-site coverage — position symbols
+   travel sparse (emit bitmask + compacted chars) when coverage is;
+   genomes small enough that link latency dominates route the same
+   jitted tail to the local XLA CPU backend;
+3. the host splices insertion columns after their site's base
+   (right-shift placement, quirk 3), substitutes the fill character for
+   sentinel bytes and renders FASTA records byte-identically to the CPU
+   oracle.
 
 Output equality with ``CpuBackend`` over the whole fixture corpus is the
 framework's correctness gate (tests/test_differential.py).

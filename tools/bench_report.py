@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Render a bench JSON line (bench.py stdout / BENCH_r*.json payload)
+as a markdown table for PERF.md — one row per config with phases and
+utilization inline.  Usage: python tools/bench_report.py <file.json>
+(accepts either the raw one-line JSON or the driver's wrapper with a
+"tail" field)."""
+
+import json
+import sys
+
+
+def load(path):
+    text = open(path).read().strip()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        obj = json.loads(text.splitlines()[-1])
+    if "configs" not in obj and "tail" in obj:      # driver wrapper
+        obj = json.loads(obj["tail"].strip().splitlines()[-1])
+    return obj
+
+
+def main():
+    obj = load(sys.argv[1])
+    print(f"device: {obj.get('device')}  headline: "
+          f"{obj.get('value'):,} bases/s  vs_baseline: "
+          f"{obj.get('vs_baseline')}x\n")
+    print("| config | reads | jax s | cpu s | vs cpu | identical "
+          "| phases | util |")
+    print("|---|---|---|---|---|---|---|---|")
+    for r in obj.get("configs", []):
+        if "error" in r:
+            print(f"| {r['config']} | — | — | — | — | ERROR | "
+                  f"{r['error'][:60]} | |")
+            continue
+        ph = " ".join(f"{k.replace('_sec', '')}={v}"
+                      for k, v in r.get("phases", {}).items())
+        ut = " ".join(f"{k}={v}" for k, v in r.get("util", {}).items())
+        est = "~" if r.get("cpu_sec_estimated") else ""
+        print(f"| {r['config']} | {r.get('reads'):,} | {r.get('jax_sec')} "
+              f"| {est}{r.get('cpu_sec')} | {est}{r.get('vs_baseline')}x "
+              f"| {r.get('identical', 'n/a')} | {ph} | {ut} |")
+
+
+if __name__ == "__main__":
+    main()
