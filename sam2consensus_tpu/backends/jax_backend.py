@@ -71,7 +71,8 @@ class _Prefetcher:
 
     The producer thread drains the encoder generator (timing its decode
     work into ``times``) into a depth-2 queue; the consumer iterates
-    batches as they land.  Exceptions — including strict-mode EncodeErrors,
+    batches as they land.  Exceptions — including strict-mode decode
+    errors (the oracle's KeyError/IndexError types),
     whose type/message parity with the serial path is contract — are
     re-raised in the consumer at the point of consumption.
     """

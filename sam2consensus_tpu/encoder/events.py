@@ -133,7 +133,13 @@ class InsertionEvents:
 
 
 class EncodeError(ValueError):
-    pass
+    """Base for encoder-contract violations.
+
+    Strict-mode validation failures raise the ORACLE's exact exception
+    types and messages (KeyError / IndexError, backends/cpu.py) so the
+    jax backend's tracebacks match the reference's; permissive-mode
+    catch sites accept ``(EncodeError, KeyError, IndexError)``.
+    """
 
 
 def _bucket_width(span: int) -> int:
@@ -185,7 +191,7 @@ class ReadEncoder:
                 # encode_record validates fully before committing anything,
                 # so a raise here leaves the pending rows untouched.
                 new_rows = self.encode_record(rec)
-            except EncodeError:
+            except (EncodeError, KeyError, IndexError):
                 if self.strict:
                     raise
                 self.n_skipped += 1
@@ -207,37 +213,46 @@ class ReadEncoder:
     def encode_record(self, rec: SamRecord) -> List[Tuple[int, np.ndarray]]:
         """Encode one record into (flat_start, code_row) segment rows.
 
-        Raises EncodeError (before any side effect) on contract violations;
+        Raises the oracle's exact KeyError/IndexError (before any side
+        effect) on contract violations;
         on success also appends the read's insertion events.
         """
         layout = self.layout
         ci = layout.index.get(rec.refname)
         if ci is None:
-            raise EncodeError(f"unknown reference {rec.refname!r}")
+            # oracle-identical type AND message (backends/cpu.py): the jax
+            # backend's strict errors must match the reference's
+            raise KeyError(
+                f"read mapped to unknown reference {rec.refname!r} "
+                "(reference would KeyError here too)")
         reflen = int(layout.lengths[ci])
         offset = int(layout.offsets[ci])
 
         seq_codes = BASE_TO_CODE[
             np.frombuffer(rec.seq.encode("ascii"), dtype=np.uint8)]
 
-        # walk ops, collecting local runs first (validation before commit)
-        my_base: List[Tuple[int, np.ndarray]] = []    # (local_start, codes)
-        my_gaps: List[Tuple[int, int]] = []           # (local_start, length)
+        # walk ops, collecting runs by OUTPUT offset (validation before
+        # commit).  The reference builds ``seqout`` by string
+        # CONCATENATION (sam2consensus.py:46-82): an M op shorter than
+        # its claim (SEQ exhausted — out-of-contract input) shifts every
+        # later op left, and the read's span is len(seqout), not the
+        # CIGAR-claimed sum.  For in-contract reads the two are equal.
+        my_base: List[Tuple[int, np.ndarray]] = []    # (out_offset, codes)
+        my_gaps: List[Tuple[int, int]] = []           # (out_offset, length)
         my_ins: List[Tuple[int, str]] = []
         rc = 0
-        ref_cursor = rec.pos
-        gap_total = 0
+        out = 0
         for length, op in split_ops(rec.cigar):
             if op in "M=X":
-                my_base.append((ref_cursor, seq_codes[rc:rc + length]))
+                codes = seq_codes[rc:rc + length]
+                my_base.append((out, codes))
                 rc += length
-                ref_cursor += length
+                out += len(codes)
             elif op in "DNP":
-                my_gaps.append((ref_cursor, length))
-                gap_total += length
-                ref_cursor += length
+                my_gaps.append((out, length))
+                out += length
             elif op == "I":
-                my_ins.append((ref_cursor, rec.seq[rc:rc + length]))
+                my_ins.append((rec.pos + out, rec.seq[rc:rc + length]))
                 rc += length
             elif op == "S":
                 rc += length
@@ -246,23 +261,28 @@ class ReadEncoder:
         # validation (quirk 7 contract): bounds incl. negative-wrap, alphabet.
         # A zero-span read (all S/H/I ops) touches no position and is accepted
         # at any POS, like the reference's zero-iteration pileup loop.
-        span = ref_cursor - rec.pos
-        if span > 0 and (rec.pos < -reflen or ref_cursor > reflen):
-            raise EncodeError(
-                f"read at pos {rec.pos} spans [{rec.pos}, {ref_cursor}) "
-                f"outside reference {rec.refname!r} of length {reflen}")
+        span = out
+        if span > 0 and (rec.pos < -reflen or rec.pos + span > reflen):
+            raise IndexError(
+                f"read at pos {rec.pos} spans [{rec.pos}, {rec.pos + span})"
+                f" outside reference {rec.refname!r} of length {reflen} "
+                "(reference would IndexError here too)")
+        def bad_alphabet():
+            # constructed lazily: valid reads (the hot path) pay nothing
+            raise KeyError(
+                f"read at pos {rec.pos} contains an out-of-alphabet base "
+                "(input contract is uppercase ACGTN; the reference would "
+                "KeyError here too, though for insertion motifs only "
+                "later, in its reformat pass)")
+
         for _start, codes in my_base:
             if codes.size and codes.max() == INVALID_SYMBOL:
-                raise EncodeError(
-                    "read contains out-of-alphabet base "
-                    "(input contract is uppercase ACGTN)")
+                bad_alphabet()
         for _local, motif in my_ins:
             mcodes = BASE_TO_CODE[
                 np.frombuffer(motif.encode("ascii"), dtype=np.uint8)]
             if mcodes.size and mcodes.max() == INVALID_SYMBOL:
-                raise EncodeError(
-                    "insertion motif contains out-of-alphabet base "
-                    "(the reference KeyErrors on these in its reformat pass)")
+                bad_alphabet()
 
         # commit: insertion side channel
         for local, motif in my_ins:
@@ -272,18 +292,16 @@ class ReadEncoder:
         if span == 0:
             return []
 
-        # build the span row: M runs + GAP runs partition [pos, ref_cursor)
+        # build the span row: M runs + GAP runs partition [0, span) by
+        # construction (concatenation leaves no holes)
         if len(my_base) == 1 and not my_gaps:
             row = my_base[0][1]
         else:
-            # PAD-filled, not empty: a SEQ shorter than its CIGAR claims
-            # (out-of-contract input) leaves deterministic no-event cells
-            # instead of garbage.
-            row = np.full(span, PAD_CODE, dtype=np.uint8)
+            row = np.empty(span, dtype=np.uint8)
             for start, codes in my_base:
-                row[start - rec.pos: start - rec.pos + len(codes)] = codes
+                row[start: start + len(codes)] = codes
             for start, length in my_gaps:
-                row[start - rec.pos: start - rec.pos + length] = GAP
+                row[start: start + length] = GAP
 
         # maxdel gate (sam2consensus.py:210-218): the reference counts
         # seqout's "-" characters — D/N/P runs AND literal '-' in SEQ alike —

@@ -268,7 +268,7 @@ class NativeReadEncoder:
         for rec in recs:
             try:
                 rows = self._py.encode_record(rec)
-            except EncodeError:
+            except (EncodeError, KeyError, IndexError):
                 if self.strict:
                     raise
                 self._py.n_skipped += 1

@@ -319,20 +319,19 @@ extern "C" long s2c_decode(
         switch (op) {
           case 'M': case '=': case 'X':
             // guard absurd lengths: such a span can only fail the bounds
-            // check, which the python replay will report
-            if (span + num > 2 * reflen + 64) {
+            // check, which the python replay will report.  pre_rc keeps
+            // accumulating so the short-SEQ test below stays decisive.
+            if (huge_span || span + num > 2 * reflen + 64)
               huge_span = true;
-              break;
-            }
-            span += num;
+            else
+              span += num;
             pre_rc += num;
             break;
           case 'D': case 'N': case 'P':
-            if (span + num > 2 * reflen + 64) {
+            if (huge_span || span + num > 2 * reflen + 64)
               huge_span = true;
-              break;
-            }
-            span += num;
+            else
+              span += num;
             break;
           case 'I': {
             long take = seq_len - pre_rc;
@@ -349,10 +348,19 @@ extern "C" long s2c_decode(
           default:  // 'H'
             break;
         }
-        if (huge_span) break;
       }
     }
     if (span > max_span) max_span = span;
+
+    // SEQ shorter than its CIGAR claims: the reference's concatenation
+    // semantics shift every later op left of its claimed position
+    // (python encoder reproduces them exactly, encoder/events.py) —
+    // too rare to mirror here, replay the line
+    if (pre_rc > seq_len) {
+      status = kErrorLine;
+      err_off = ls;
+      break;
+    }
 
     // --- structural validation (bad bases are found during translation;
     //     the python replay reproduces the exact message either way) ---

@@ -141,15 +141,49 @@ def test_literal_dash_in_seq_counts_toward_maxdel():
 
 
 def test_invalid_motif_base_both_backends_raise():
+    """Strict errors match the oracle in TYPE and MESSAGE — the jax
+    backend's tracebacks are the reference's tracebacks."""
     text = sam_text([("r", 6)], [("r", 1, "2M2I2M", "AAxxGG")])
     cfg = RunConfig(prefix="p")
-    with pytest.raises(KeyError):
+    with pytest.raises(KeyError) as e_cpu:
         rendered(CpuBackend(), text, cfg)
-    from sam2consensus_tpu.encoder.events import EncodeError
-    with pytest.raises(EncodeError):
+    with pytest.raises(KeyError) as e_jax:
         rendered(JaxBackend(), text, cfg)
+    assert str(e_cpu.value) == str(e_jax.value)
     # permissive mode: both skip the read entirely, identical output
     assert_identical(text, strict=False)
+
+
+def test_short_seq_concatenation_semantics_identical():
+    """SEQ shorter than its CIGAR claims (out-of-contract): the reference
+    builds seqout by CONCATENATION, shifting later ops left — a '10M' with
+    a 2-base SEQ spans 2 positions, not 10, and is ACCEPTED on a 6-long
+    contig; a '4M2D' with 2 bases puts the gap at positions 2-3, not 4-5.
+    Both backends must agree byte-for-byte (and with the native decoder,
+    which replays such lines through the python encoder)."""
+    text = sam_text([("r", 6)], [
+        ("r", 1, "10M", "AC"),        # claimed span 10 > contig; emitted 2
+        ("r", 1, "4M2D", "GG"),       # gap shifts left to output cols 2-3
+        ("r", 1, "6M", "TTTTTT"),     # in-contract anchor
+    ])
+    assert_identical(text, thresholds=[0.25, 0.75])
+    assert_identical(text, strict=False)
+
+
+@pytest.mark.parametrize("record,exc", [
+    (("other", 1, "2M", "AC"), KeyError),      # unknown reference
+    (("r", 5, "3M", "ACG"), IndexError),       # overruns the contig
+    (("r", 1, "2M", "ac"), KeyError),          # out-of-alphabet SEQ
+])
+def test_strict_error_parity_types_and_messages(record, exc):
+    text = sam_text([("r", 6)], [record])
+    cfg = RunConfig(prefix="p")
+    with pytest.raises(exc) as e_cpu:
+        rendered(CpuBackend(), text, cfg)
+    with pytest.raises(exc) as e_jax:
+        rendered(JaxBackend(), text, cfg)
+    assert str(e_cpu.value) == str(e_jax.value)
+    assert_identical(text, strict=False)       # permissive: both skip
 
 
 def test_zero_span_read_beyond_contig_accepted():

@@ -264,6 +264,9 @@ def test_fused_decode_accumulate_equals_two_pass():
     # exercising the fused numpy-accumulate branch in _fallback_line
     # (contig0002 is 474 long at this seed, so the span fits)
     text += ("wide\t0\tcontig0002\t1\t60\t2M296D2M\t*\t0\t0\tACGT\t*\n")
+    # SEQ shorter than its CIGAR claims: the C decoder flags it and the
+    # python replay applies the reference's concatenation semantics
+    text += ("short\t0\tcontig0001\t1\t60\t200M\t*\t0\t0\tACGT\t*\n")
 
     def run(fused):
         handle = io.StringIO(text)
