@@ -200,7 +200,9 @@ def util_fields(stats, jax_time):
     if jax_time > 0:
         u["wire_mbps"] = round((h2d + d2h) / 1e6 / jax_time, 1)
     ps = stats.extra.get("pileup_dispatch_sec", 0)
-    if ps > 0:
+    if ps > 0.005:
+        # meaningless in fused-decode mode, where accumulation happens
+        # inside the decode pass and this phase is ~0
         u["pileup_mcells_per_s"] = round(
             stats.aligned_bases / ps / 1e6, 1)
     ds = stats.extra.get("decode_sec", 0)

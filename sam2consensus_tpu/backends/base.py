@@ -40,14 +40,19 @@ class ConsensusBackend(Protocol):
 
 
 def format_header(prefix: str, threshold: float, refname: str,
-                  sumcov: int, seq: str) -> str:
+                  sumcov: int, seq: str, stripped_len=None) -> str:
     """FASTA header, field-for-field per sam2consensus.py:394-397.
 
     ``coverage`` is ``round(sumcov/len(seq), 2)`` rendered via ``str``;
-    ``length`` strips only ``"-"`` so a non-gap fill char counts (quirk 10).
+    ``length`` strips only ``"-"`` so a non-gap fill char counts (quirk
+    10).  ``stripped_len`` is an optional precomputed ``len(seq)`` minus
+    dash count (the jax backend counts it vectorized; value must equal
+    ``len(seq.replace("-", ""))``).
     """
+    if stripped_len is None:
+        stripped_len = len(seq.replace("-", ""))
     return (">" + prefix + "|c" + str(int(threshold * 100))
             + " reference:" + refname
             + " coverage:" + str(round(float(sumcov) / float(len(seq)), 2))
-            + " length:" + str(len(seq.replace("-", "")))
+            + " length:" + str(stripped_len)
             + " consensus_threshold:" + str(int(threshold * 100)) + "%")

@@ -237,22 +237,31 @@ class ReadEncoder:
         # its claim (SEQ exhausted — out-of-contract input) shifts every
         # later op left, and the read's span is len(seqout), not the
         # CIGAR-claimed sum.  For in-contract reads the two are equal.
+        # NOTE the reference's MIXED semantics: seqout is concatenated
+        # (short M ops shift later BASE/GAP cells left), but its insertion
+        # keys use the reference cursor, which advances by the CLAIMED op
+        # lengths (core/cigar.py walk) — so a short-SEQ read can key an
+        # insertion past its emitted span.  Both cursors are tracked; they
+        # agree for in-contract reads.
         my_base: List[Tuple[int, np.ndarray]] = []    # (out_offset, codes)
         my_gaps: List[Tuple[int, int]] = []           # (out_offset, length)
         my_ins: List[Tuple[int, str]] = []
         rc = 0
         out = 0
+        claim = rec.pos
         for length, op in split_ops(rec.cigar):
             if op in "M=X":
                 codes = seq_codes[rc:rc + length]
                 my_base.append((out, codes))
                 rc += length
                 out += len(codes)
+                claim += length
             elif op in "DNP":
                 my_gaps.append((out, length))
                 out += length
+                claim += length
             elif op == "I":
-                my_ins.append((rec.pos + out, rec.seq[rc:rc + length]))
+                my_ins.append((claim, rec.seq[rc:rc + length]))
                 rc += length
             elif op == "S":
                 rc += length

@@ -353,10 +353,13 @@ extern "C" long s2c_decode(
     if (span > max_span) max_span = span;
 
     // SEQ shorter than its CIGAR claims: the reference's concatenation
-    // semantics shift every later op left of its claimed position
-    // (python encoder reproduces them exactly, encoder/events.py) —
-    // too rare to mirror here, replay the line
-    if (pre_rc > seq_len) {
+    // semantics shift every later BASE/GAP op left of its claimed
+    // position (python encoder reproduces them exactly,
+    // encoder/events.py) — too rare to mirror here, replay the line.
+    // Carve-out: SEQ "*" with a real CIGAR (common for secondary
+    // alignments) is doomed to the bad-base path anyway; let the fast
+    // path skip it in C instead of replaying every such line.
+    if (pre_rc > seq_len && !(seq_len == 1 && text[ss] == '*')) {
       status = kErrorLine;
       err_off = ls;
       break;
@@ -408,6 +411,8 @@ extern "C" long s2c_decode(
               dst[o + k] = code;
             }
             if (num > take) {
+              // reachable only for SEQ "*" reads (short-SEQ carve-out
+              // above): memory safety until bad_base aborts the commit
               memset(dst + o + take, kPad, num - take);
               pads += num - take;
             }

@@ -195,3 +195,25 @@ def test_zero_span_read_beyond_contig_accepted():
         ("r", 1, "4M", "ACGT"),
     ])
     assert_identical(text)
+
+
+def test_short_seq_insertion_key_uses_claimed_cursor():
+    """The reference's MIXED out-of-contract semantics: seqout is built by
+    concatenation (bases/gaps shift left on short M ops) but insertion
+    keys advance by CLAIMED lengths — a '6M2I2M' read with a 5-base SEQ
+    keys its insertion at 6, past the 5 emitted cells.  Encoder must match
+    the golden walker exactly."""
+    from sam2consensus_tpu.core.cigar import walk
+    from sam2consensus_tpu.encoder.events import GenomeLayout, ReadEncoder
+    from sam2consensus_tpu.io.sam import Contig, SamRecord
+
+    seqout, insert = walk("6M2I2M", "ACGGT", 0)
+    layout = GenomeLayout([Contig("r", 20)])
+    enc = ReadEncoder(layout)
+    enc.encode_record(SamRecord("r", 0, "6M2I2M", "ACGGT"))
+    assert insert == [(6, "")], insert
+    assert enc.insertions.local_pos == [6]
+    # and both backends agree byte-for-byte on such input
+    text = sam_text([("r", 20)], [("r", 1, "6M2I2M", "ACGGT"),
+                                  ("r", 1, "20M", "A" * 20)])
+    assert_identical(text)

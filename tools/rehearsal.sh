@@ -1,0 +1,19 @@
+#!/bin/bash
+# CPU dress rehearsal of the full-scale bench configs; aborts the moment
+# the campaign reports the tunnel UP so it never contends with the real
+# bench on this one-core host.
+cd /root/repo
+JAX_PLATFORMS=cpu BENCH_INIT_TIMEOUT=30 BENCH_INIT_RETRIES=1 \
+  BENCH_CONFIGS=north_star,wide_genome \
+  timeout -k 30 2400 python bench.py > campaign/rehearsal.json \
+  2> campaign/rehearsal_stderr.log &
+BPID=$!
+while kill -0 $BPID 2>/dev/null; do
+  if grep -q "tunnel UP" campaign/campaign.log 2>/dev/null; then
+    kill -TERM $BPID 2>/dev/null
+    echo "aborted: tunnel came up" >> campaign/rehearsal_stderr.log
+    exit 0
+  fi
+  sleep 20
+done
+echo "rehearsal done" >> campaign/rehearsal_stderr.log
