@@ -183,8 +183,9 @@ def run_once(backend, path, cfg, binary):
 
 def phase_split(stats):
     return {k: stats.extra[k]
-            for k in ("decode_sec", "pileup_dispatch_sec", "accumulate_sec",
-                      "vote_sec", "insertions_sec", "render_sec")
+            for k in ("decode_sec", "stage_sec", "pileup_dispatch_sec",
+                      "accumulate_sec", "vote_sec", "insertions_sec",
+                      "render_sec")
             if k in stats.extra}
 
 
@@ -303,6 +304,12 @@ def bench_config(name, spec, cfg_kwargs, jax_variants, tmp, extras=None):
     path = _write_sim(spec, name, tmp)
     cpu_stats, cpu_time, cpu_out = run_once(CpuBackend(), path, cfg,
                                             binary=False)
+    if cpu_time < 3.0:
+        # sub-second oracle runs are dominated by first-touch noise (page
+        # cache, allocator warmup) while the jax side gets a warm run —
+        # take the best of two so small-config ratios are stable
+        _s2, t2, _o2 = run_once(CpuBackend(), path, cfg, binary=False)
+        cpu_time = min(cpu_time, t2)
     log(f"[{name}] cpu oracle: {cpu_time:.2f}s "
         f"({cpu_stats.consensus_bases / cpu_time:,.0f} bases/s)")
 

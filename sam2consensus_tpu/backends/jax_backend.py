@@ -39,6 +39,7 @@ from typing import Dict, Iterable, List
 import numpy as np
 
 from ..config import RunConfig
+from ..constants import NUM_SYMBOLS
 from ..io.sam import Contig, SamRecord
 from .base import BackendResult, BackendStats, FastaRecord, format_header
 
@@ -47,11 +48,38 @@ from .base import BackendResult, BackendStats, FastaRecord, format_header
 #: to 1<<16 on overflow, encoder/native_encoder.py)
 SP_HALO = 1 << 16
 
-#: largest L * n_thresholds the host-counts tail runs on the local XLA
-#: CPU backend instead of the tunneled chip: below this the vote costs
-#: single-digit ms anywhere, so the ~2 x 65 ms link round trips dominate
-#: (tools/tunnel_probe.py); above it the chip's bandwidth wins
-HOST_TAIL_MAX_CELLS = 1 << 19
+#: tail-placement cost model for the host-counts path (counts already in
+#: host memory).  The chip's vote compute is free but the link bills a
+#: dispatch round trip, the counts upload, and the output fetch; the
+#: local XLA CPU backend is wire-free but votes at a measured per-core
+#: rate.  Constants are the bench rig's (tools/tunnel_probe.py and
+#: tools/tail_crossover.py: the sweep's T=1 crossover sits at ~4M
+#: positions, the T=3 crossover at ~200k — no single cell-count gate
+#: represents both).  Override via env for a different link or host.
+TAIL_RT_SEC = float(os.environ.get("S2C_TAIL_RT_MS", "65")) / 1e3
+TAIL_LINK_BPS = float(os.environ.get("S2C_TAIL_LINK_MBPS", "40")) * 1e6
+TAIL_CPU_POS_PER_SEC = float(os.environ.get(
+    "S2C_TAIL_CPU_MPOS_S", "5.2")) * 1e6
+#: per-position overhead of the sparse output path: device compaction
+#: scatter (~12 ns) + host re-expansion (~8 ns), measured round 3 at
+#: L = 40M (see the sparse-output gate below)
+SPARSE_NS_PER_POS = float(os.environ.get("S2C_SPARSE_NS_PER_POS", "20"))
+
+
+def _tail_cpu_wins(total_len: int, n_thresholds: int,
+                   upload_bytes: int) -> bool:
+    """True when the local CPU tail beats shipping the tail to the chip."""
+    forced = os.environ.get("S2C_TAIL_DEVICE", "")
+    if forced not in ("", "auto"):
+        if forced not in ("cpu", "default"):
+            raise RuntimeError(
+                f"S2C_TAIL_DEVICE={forced!r}: use 'cpu' (local XLA CPU "
+                f"tail), 'default' (the accelerator), or 'auto'")
+        return forced == "cpu"
+    cpu_sec = total_len * n_thresholds / TAIL_CPU_POS_PER_SEC
+    chip_sec = (TAIL_RT_SEC
+                + (upload_bytes + n_thresholds * total_len) / TAIL_LINK_BPS)
+    return cpu_sec < chip_sec
 
 
 def _timed_iter(it, times, key: str = "decode_sec"):
@@ -79,13 +107,14 @@ class _Prefetcher:
 
     _DONE = object()
 
-    def __init__(self, gen, times, depth: int = 2):
+    def __init__(self, gen, times, depth: int = 2, stage=None):
         import queue
         import threading
 
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._exc = None
         self._times = times
+        self._stage = stage
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._work, args=(gen,), daemon=True)
@@ -112,6 +141,16 @@ class _Prefetcher:
                 except StopIteration:
                     break
                 self._times["decode_sec"] += time.perf_counter() - t0
+                if self._stage is not None:
+                    # start this batch's h2d transfer now, overlapping the
+                    # consumer's dispatch of the previous batch (the device
+                    # pileup otherwise serializes transfer with dispatch on
+                    # the link); timed separately from decode
+                    t0 = time.perf_counter()
+                    self._stage(batch)
+                    self._times["stage_sec"] = (
+                        self._times.get("stage_sec", 0.0)
+                        + time.perf_counter() - t0)
                 if not self._put(batch):
                     return                 # consumer gone; drop the rest
         except BaseException as exc:  # re-raised on the consumer side
@@ -166,6 +205,12 @@ class JaxBackend:
 
         n_dev = len(jax.devices())
         shards = cfg.shards if cfg.shards > 0 else n_dev
+        if getattr(cfg, "pileup", "auto") == "host" and cfg.shards == 0:
+            # host pileup implies single-device: an unspecified --shards
+            # (0 = all devices) must not turn the explicit host strategy
+            # into an error on multi-device hosts; explicit --shards N>1
+            # still conflicts below
+            shards = 1
         use_sharded = shards > 1
 
         if use_sharded:
@@ -303,8 +348,15 @@ class JaxBackend:
             # overlap host decode with pileup work (SURVEY.md §7(d)): a
             # bounded prefetch thread decodes the next slabs while this
             # thread feeds the accumulator (ctypes/C++ decode releases the
-            # GIL, so the overlap is real)
-            batch_iter = _Prefetcher(iter(batches), decode_times)
+            # GIL, so the overlap is real).  Accumulators exposing
+            # ``stage`` additionally get their h2d transfers issued from
+            # the prefetch thread, overlapping transfer with dispatch —
+            # except under --paranoid, whose contract is that batches are
+            # re-validated BEFORE anything ships to the device.
+            batch_iter = _Prefetcher(
+                iter(batches), decode_times,
+                stage=None if cfg.paranoid
+                else getattr(acc, "stage", None))
         pileup_sec = 0.0
         try:
             for batch in batch_iter:
@@ -334,6 +386,8 @@ class JaxBackend:
         if getattr(acc, "strategy_used", None):
             stats.extra["pileup"] = dict(acc.strategy_used)
         stats.extra["decode_sec"] = round(decode_times["decode_sec"], 4)
+        if "stage_sec" in decode_times:
+            stats.extra["stage_sec"] = round(decode_times["stage_sec"], 4)
         stats.extra["pileup_dispatch_sec"] = round(pileup_sec, 4)
         stats.extra["accumulate_sec"] = round(time.perf_counter() - t0, 4)
         if ck is not None and "incremental_base" not in stats.extra:
@@ -359,15 +413,18 @@ class JaxBackend:
         total_len = layout.total_len
         n_contigs = len(layout.names)
         if isinstance(acc, HostPileupAccumulator):
-            # small-genome gate: at ~65 ms per tunneled round trip, a tail
-            # this small finishes faster on the LOCAL XLA CPU backend than
-            # the link's latency alone — the counts are already host-side.
-            # JAX computations follow committed operands, so committing the
-            # counts upload to the cpu device routes the whole fused tail
-            # (same jitted functions) there.  An explicit pallas insertion
-            # kernel keeps the device tail: interpret-mode Pallas on CPU
-            # can dwarf the saved link latency at scale.
-            if (total_len * n_thresholds <= HOST_TAIL_MAX_CELLS
+            # tail placement: the counts are already host-side, so run the
+            # tail wherever the measured cost model says it finishes first
+            # (_tail_cpu_wins — link RT + upload + fetch vs the local
+            # core's vote rate).  JAX computations follow committed
+            # operands, so committing the counts upload to the cpu device
+            # routes the whole fused tail (same jitted functions) there.
+            # An explicit pallas insertion kernel keeps the device tail:
+            # interpret-mode Pallas on CPU can dwarf the saved link
+            # latency at scale.
+            if (_tail_cpu_wins(total_len, n_thresholds,
+                               total_len * NUM_SYMBOLS
+                               * acc.wire_itemsize())
                     and getattr(cfg, "ins_kernel", "scatter") != "pallas"):
                 try:
                     cpus = jax.devices("cpu")
@@ -397,15 +454,32 @@ class JaxBackend:
 
         t0 = time.perf_counter()
         # sparse-output gate: covered positions are bounded by aligned
-        # bases, so when coverage is sparse the emit bitmask + compacted
-        # chars cost far fewer d2h bytes than the dense [T, L] fetch
-        # (ops/fused.py _sparse_syms; the 40 Mbp bench config is ~99.5%
-        # fill bytes otherwise)
-        sparse_cap = fused.next_pow2(
+        # bases, so for sparse coverage the emit bitmask + compacted chars
+        # cost far fewer d2h bytes than the dense [T, L] fetch (ops/fused.py
+        # _sparse_syms).  But sparse is not free: the device-side
+        # compaction is an XLA scatter (~12 ns/position measured on the
+        # chip at L = 40M) and the host re-expansion costs ~8 ns/position
+        # (np.unpackbits + masked assign), so sparse must save MORE link
+        # time than that — at T=1 the crossover sits near 8% fill; extra
+        # thresholds amortize the fixed per-position cost and push it up.
+        # A cpu-routed tail has no link to save and skips sparse outright.
+        sparse_cap = fused.pad_cap(
             min(total_len, max(1, stats.aligned_bases)) + 1)
         nbits = (total_len + 7) // 8
-        if (nbits + n_thresholds * sparse_cap
-                >= (n_thresholds * total_len) // 2):
+        dense_bytes = n_thresholds * total_len
+        sparse_bytes = nbits + n_thresholds * sparse_cap
+        sparse_mode = os.environ.get("S2C_SPARSE_OUTPUT", "auto")
+        if sparse_mode not in ("auto", "force", "off"):
+            raise RuntimeError(
+                f"S2C_SPARSE_OUTPUT={sparse_mode!r}: use auto|force|off")
+        # a tail with no link to save skips sparse outright: cpu-routed
+        # tails AND runs whose default backend is already the local cpu
+        # (there the "saved" dense fetch is a memcpy, not 40 MB/s wire)
+        link_free = tail_dev is not None or jax.default_backend() == "cpu"
+        if sparse_mode == "off" or (sparse_mode == "auto" and (
+                link_free
+                or (dense_bytes - sparse_bytes) / TAIL_LINK_BPS
+                <= total_len * SPARSE_NS_PER_POS * 1e-9)):
             sparse_cap = None                      # dense fetch is cheaper
         if ins is not None:
             k = len(ins["key_flat"])
@@ -632,8 +706,6 @@ class JaxBackend:
     # -- paranoid mode (SURVEY.md §5 sanitizers) ---------------------------
     def _paranoid_batch(self, batch, total_len: int, stats) -> None:
         """Re-validate scatter inputs before they reach the device."""
-        from ..constants import NUM_SYMBOLS
-
         for w, (starts, codes) in batch.buckets.items():
             rows, cols = np.nonzero(codes < NUM_SYMBOLS)
             pos = starts[rows].astype(np.int64) + cols
@@ -784,18 +856,19 @@ class JaxBackend:
                     sumcov = sumcov_base
 
                 if len(cfg.fill) == 1 and ord(cfg.fill) < 256:
-                    # vectorized fill substitution + dash count: three
-                    # str passes over multi-MB sequences become one numpy
-                    # pass (matters at 40 Mbp scale)
-                    arr = np.frombuffer(raw, dtype=np.uint8)
-                    if arr.size and (arr == 0).any():
-                        arr = np.where(arr == 0, np.uint8(ord(cfg.fill)),
-                                       arr)
-                    stripped = arr.size - int(
-                        np.count_nonzero(arr == ord("-")))
+                    # fill substitution via bytes.translate — the fastest
+                    # measured pass at 40 Mbp (45 ms vs 187 ms for
+                    # np.where); the find() probe skips the copy when no
+                    # position needs filling, and the dash count rides
+                    # the decoded str's memchr path (11 ms vs 25 ms on
+                    # the uint8 view)
+                    if raw.find(b"\x00") >= 0:
+                        raw = raw.translate(bytes.maketrans(
+                            b"\x00", cfg.fill.encode("latin-1")))
+                    seq = raw.decode("latin-1")
+                    stripped = len(seq) - seq.count("-")
                     if stripped == 0:
                         continue  # empty-sequence drop (:400-406)
-                    seq = arr.tobytes().decode("latin-1")
                     header = format_header(cfg.prefix, cfg.thresholds[t],
                                            name, sumcov, seq,
                                            stripped_len=stripped)

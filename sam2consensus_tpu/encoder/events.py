@@ -87,6 +87,12 @@ class SegmentBatch:
     #: into the host count tensor (encoder/native_encoder.py): buckets are
     #: empty and consumers must not re-accumulate
     accumulated: bool = False
+    #: optional device-staged operands ``{w: (starts_dev, packed_dev,
+    #: wire_bytes)}`` placed by the decode prefetch thread
+    #: (``PileupAccumulator.stage``) so the h2d transfer of this batch
+    #: overlaps the previous batch's dispatch instead of serializing
+    #: with it on the link
+    staged: Dict[int, Tuple] = field(default_factory=dict)
 
 
 @dataclass

@@ -311,11 +311,15 @@ extern "C" long s2c_decode(
     long pre_rc = 0;       // read-cursor simulation (M/I/S advance it)
     long pre_ins = 0, pre_chars = 0;
     bool huge_span = false;
+    char first_rc_op = 0;  // first read-consuming op (M/=/X/I/S, num>0)
     {
       long c = cs;
       int64_t num;
       char op;
       while (next_cigar_op(text, ce, c, num, op)) {
+        if (num > 0 && first_rc_op == 0 &&
+            (op == 'M' || op == '=' || op == 'X' || op == 'I' || op == 'S'))
+          first_rc_op = (op == '=' || op == 'X') ? 'M' : op;
         switch (op) {
           case 'M': case '=': case 'X':
             // guard absurd lengths: such a span can only fail the bounds
@@ -357,9 +361,15 @@ extern "C" long s2c_decode(
     // position (python encoder reproduces them exactly,
     // encoder/events.py) — too rare to mirror here, replay the line.
     // Carve-out: SEQ "*" with a real CIGAR (common for secondary
-    // alignments) is doomed to the bad-base path anyway; let the fast
-    // path skip it in C instead of replaying every such line.
-    if (pre_rc > seq_len && !(seq_len == 1 && text[ss] == '*')) {
+    // alignments) whose FIRST read-consuming op is M/=/X — that op reads
+    // the '*' immediately, so the line is doomed to the bad-base path
+    // and the fast path can skip it in C instead of replaying it.  A
+    // leading S or I would consume the '*' first and reach the
+    // reference's concatenation-shift semantics after all (later gap
+    // cells land left of their claimed offsets, an I records an
+    // empty-or-'*' motif): those lines still replay exactly.
+    if (pre_rc > seq_len &&
+        !(seq_len == 1 && text[ss] == '*' && first_rc_op == 'M')) {
       status = kErrorLine;
       err_off = ls;
       break;

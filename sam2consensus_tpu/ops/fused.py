@@ -44,6 +44,25 @@ def next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
 
 
+#: bucket width for :func:`pad_cap` above 1 MiB — coarse enough to keep
+#: the jit cache small, fine enough that padding waste stays < 1 MiB
+_CAP_BUCKET = 1 << 20
+
+
+def pad_cap(n: int) -> int:
+    """Jit-bucketed padding for the sparse-output capacity.
+
+    Power-of-two below 1 MiB (small recompiles are cheap), then the next
+    multiple of 1 MiB: pow2 padding at 10 M+ covered positions would
+    inflate the d2h fetch by up to 2x and flip the dense-vs-sparse
+    decision against sparse exactly where sparse matters most (the
+    40 Mbp bench config), while 1 MiB buckets bound both the padding
+    waste and the number of distinct compiled shapes."""
+    if n <= _CAP_BUCKET:
+        return next_pow2(n)
+    return -(-n // _CAP_BUCKET) * _CAP_BUCKET
+
+
 @jax.jit
 def coverage(counts: jax.Array) -> jax.Array:
     """Per-position depth ``[L]`` — gaps and Ns count (quirk 5).

@@ -225,6 +225,7 @@ class HostPileupAccumulator:
         self._counts = np.zeros((total_len, NUM_SYMBOLS), dtype=np.int32)
         self._lib = native.load()              # None -> numpy fallback
         self._device_counts = None
+        self._wire_itemsize = None
         self.strategy_used: dict = {"host": 0}
         self.bytes_h2d = 0                     # wire accounting for bench
         #: when set (backends/jax_backend.py small-genome gate), counts
@@ -235,6 +236,7 @@ class HostPileupAccumulator:
 
     def add(self, batch: SegmentBatch) -> None:
         self._device_counts = None
+        self._wire_itemsize = None
         if batch.accumulated:
             # fused decode path: the C++ decoder already counted this
             # batch's rows in-pass (encoder/native_encoder.py); nothing to
@@ -257,19 +259,28 @@ class HostPileupAccumulator:
                           (pos[ok], codes[rows[ok], cols[ok]]), 1)
             self.strategy_used["host"] += 1
 
+    def wire_itemsize(self) -> int:
+        """Bytes/cell of the narrowed upload dtype (cached one-pass max);
+        the tail-placement cost model needs the wire bill before the
+        upload happens."""
+        if self._wire_itemsize is None:
+            m = int(self._counts.max(initial=0))
+            self._wire_itemsize = 1 if m < (1 << 8) else \
+                2 if m < (1 << 16) else 4
+        return self._wire_itemsize
+
     @property
     def counts(self):
         """Device copy of the counts, wire-narrowed; vote widens on chip."""
         import jax
 
         if self._device_counts is None:
-            m = int(self._counts.max(initial=0))
-            if m < (1 << 8):
-                arr = self._counts.astype(np.uint8)
-            elif m < (1 << 16):
-                arr = self._counts.astype(np.uint16)
-            else:
+            it = self.wire_itemsize()
+            if it == 4:        # already int32: ship the buffer, no copy
                 arr = self._counts
+            else:
+                arr = self._counts.astype(np.uint8 if it == 1
+                                          else np.uint16)
             self.strategy_used["host_wire_dtype"] = str(arr.dtype)
             if self.tail_device is None:
                 self.bytes_h2d += arr.nbytes   # real wire bytes
@@ -284,6 +295,7 @@ class HostPileupAccumulator:
         # captures this buffer by reference; rebinding would orphan it
         self._counts[:] = np.asarray(counts, dtype=np.int32)
         self._device_counts = None
+        self._wire_itemsize = None
 
 
 def run_tuned_slab(tuner, static_choice: str, n_rows: int, width: int,
@@ -372,31 +384,57 @@ class PileupAccumulator:
         self.bytes_h2d = 0                 # wire accounting for bench
         self._tuner = PileupAutoTuner() if strategy == "auto" else None
 
+    def stage(self, batch: SegmentBatch) -> None:
+        """Device-stage a batch's bucket operands.
+
+        Called from the decode prefetch thread (backends/jax_backend.py
+        ``_Prefetcher``): nibble-packing and ``device_put`` here overlap
+        this batch's h2d transfer with the consumer's dispatch of the
+        PREVIOUS batch — the transfers otherwise serialize on the link,
+        which round-3 bench profiles showed capping the device pileup at
+        ~half the link rate (ecoli `pileup_dispatch_sec`)."""
+        for w, (starts, codes) in batch.buckets.items():
+            packed = pack_nibbles(codes)
+            batch.staged[w] = (jax.device_put(starts, self.device),
+                               jax.device_put(packed, self.device),
+                               starts.nbytes + packed.nbytes)
+
     def add(self, batch: SegmentBatch) -> None:
         from . import mxu_pileup
 
         for w, (starts, codes) in sorted(batch.buckets.items()):
+            staged = batch.staged.get(w)
+
+            def put_operands():
+                """(starts_dev, packed_dev): staged by the prefetch
+                thread when available, transferred here otherwise."""
+                if staged is not None:
+                    st, pk, nbytes = staged
+                    self.bytes_h2d += nbytes
+                    return st, pk
+                packed = pack_nibbles(codes)
+                self.bytes_h2d += starts.nbytes + packed.nbytes
+                return jnp.asarray(starts), jnp.asarray(packed)
+
             def plan_mxu():
                 return mxu_pileup.plan_slots(
                     np.asarray(starts), w, self.padded_len, self._tile)
 
             def exec_mxu(plan):
-                packed = pack_nibbles(codes)
-                self.bytes_h2d += (starts.nbytes + packed.nbytes
-                                   + plan.slot.nbytes)
+                st, pk = put_operands()
+                self.bytes_h2d += plan.slot.nbytes
                 self._counts = mxu_pileup.pileup_mxu_packed(
-                    self._counts, jnp.asarray(starts), jnp.asarray(packed),
+                    self._counts, st, pk,
                     jnp.asarray(plan.slot), tile=self._tile,
                     n_tiles=plan.n_tiles,
                     rows_per_tile=plan.rows_per_tile, width=plan.width)
 
             def exec_scatter():
-                packed = pack_nibbles(codes)
-                self.bytes_h2d += starts.nbytes + packed.nbytes
+                st, pk = put_operands()
                 for lo, hi in iter_row_slices(len(starts), w):
                     self._counts = _scatter_segments_packed(
-                        self._counts, jnp.asarray(starts[lo:hi]),
-                        jnp.asarray(packed[lo:hi]), self.total_len)
+                        self._counts, st[lo:hi],
+                        pk[lo:hi], self.total_len)
 
             # completion is forced with a one-element fetch, NOT
             # block_until_ready: the latter returns early over the axon

@@ -158,13 +158,16 @@ def test_host_pileup_checkpoint_resume():
         assert got == want
 
 
-def test_sparse_output_tail_byte_identical():
+def test_sparse_output_tail_byte_identical(monkeypatch):
     """Sparse-coverage genome routes through the sparse-output tail
     (emit bitmask + compacted chars) and stays byte-identical, with and
-    without insertions."""
+    without insertions.  The CI platform is link-free (everything runs
+    on the local cpu backend), where the auto gate correctly refuses
+    sparse — S2C_SPARSE_OUTPUT=force exercises the path anyway."""
     from sam2consensus_tpu.utils.simulate import sam_text
 
-    # big genome, few reads -> aligned_bases << L triggers the gate
+    monkeypatch.setenv("S2C_SPARSE_OUTPUT", "force")
+    # big genome, few reads -> aligned_bases << L keeps the cap small
     text = simulate(SimSpec(n_contigs=2, contig_len=200_000, n_reads=300,
                             read_len=60, ins_read_rate=0.3,
                             del_read_rate=0.2, seed=46))
@@ -172,7 +175,7 @@ def test_sparse_output_tail_byte_identical():
     out_cpu, _ = _run(text, CpuBackend(), cfg)
     out_jax, st = _run(text, JaxBackend(), cfg)
     assert out_jax == out_cpu
-    # the gate must actually have chosen sparse for this shape
+    # the fetch must actually have been sparse for this shape
     assert st.extra["d2h_bytes"] < 2 * 200_000 * 2, st.extra
 
     # no-insertion flavor
@@ -185,8 +188,26 @@ def test_sparse_output_tail_byte_identical():
     assert out_jax2 == out_cpu2
 
 
-def test_sparse_output_tail_pallas_byte_identical():
-    """The Pallas insertion-kernel variant honors the sparse-output gate."""
+def test_sparse_output_auto_gate_link_free(monkeypatch):
+    """On a link-free platform (default backend == cpu) the auto gate
+    refuses sparse even for shapes where a tunneled link would pick it —
+    the 'saved' dense fetch would be a local memcpy while the compaction
+    scatter + host re-expansion are real costs."""
+    monkeypatch.delenv("S2C_SPARSE_OUTPUT", raising=False)
+    text = simulate(SimSpec(n_contigs=2, contig_len=200_000, n_reads=300,
+                            read_len=60, seed=46))
+    cfg = RunConfig(prefix="t", thresholds=[0.25, 0.75], shards=1)
+    _out, st = _run(text, JaxBackend(), cfg)
+    # dense fetch: ~2 thresholds x ~400k positions (contig jitter), far
+    # above what the sparse encoding would ship for 300 short reads
+    assert st.extra["d2h_bytes"] == 0 \
+        or st.extra["d2h_bytes"] >= 2 * 350_000, st.extra
+
+
+def test_sparse_output_tail_pallas_byte_identical(monkeypatch):
+    """The Pallas insertion-kernel variant composes with the sparse
+    output encoding."""
+    monkeypatch.setenv("S2C_SPARSE_OUTPUT", "force")
     text = simulate(SimSpec(n_contigs=2, contig_len=200_000, n_reads=300,
                             read_len=60, ins_read_rate=0.3,
                             del_read_rate=0.2, seed=47))

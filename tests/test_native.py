@@ -180,6 +180,36 @@ def test_permissive_skip_parity():
     assert py.n_skipped == 3
 
 
+def test_star_seq_parity():
+    """SEQ '*' with a real CIGAR (secondary alignments).
+
+    The C fast path may only short-circuit these when the FIRST
+    read-consuming op is M/=/X (it reads the '*' and dies on the bad
+    base, matching the reference); a leading S or I consumes the '*'
+    first and reaches the reference's concatenation-shift semantics —
+    gap cells land LEFT of their claimed offsets, an I records an
+    empty motif — which only the exact python replay reproduces
+    (regression: round-3 review found the unconditioned carve-out
+    committing '1S2M4D' gap cells two positions right of the oracle's).
+    """
+    reads = [
+        ("s", 3, "4M", "*"),        # M reads '*': bad base -> skip
+        ("s", 3, "1S2M4D", "*"),    # S eats '*': later D cells shift left
+        ("s", 3, "2S2I4D", "*"),    # S eats '*': empty-motif I recorded
+        ("s", 13, "2S1M", "*"),     # claimed span past end, emitted span 0
+        ("s", 2, "6M", "ACGTAC"),   # plain neighbor
+    ]
+    text = sam_text([("s", 14)], reads)
+    py, nat = _assert_equivalent(text, strict=False)
+    assert py.n_skipped == 1       # only the M-first '*' read skips
+    # strict mode: the M-first '*' read raises the same error both ways
+    with pytest.raises(KeyError) as ep:
+        _py_encode(text)
+    with pytest.raises(KeyError) as en:
+        _native_encode(text)
+    assert str(ep.value) == str(en.value)
+
+
 def test_end_to_end_stream_byte_identity():
     text = simulate(SimSpec(n_contigs=4, contig_len=250, n_reads=900,
                             read_len=50, ins_read_rate=0.2,

@@ -3,13 +3,19 @@
 # the campaign reports the tunnel UP so it never contends with the real
 # bench on this one-core host.
 cd /root/repo
+mkdir -p campaign
 JAX_PLATFORMS=cpu BENCH_INIT_TIMEOUT=30 BENCH_INIT_RETRIES=1 \
   BENCH_CONFIGS=north_star,wide_genome \
   timeout -k 30 2400 python bench.py > campaign/rehearsal.json \
   2> campaign/rehearsal_stderr.log &
 BPID=$!
+# only react to "tunnel UP" lines appended AFTER this rehearsal started —
+# campaign.log persists across campaigns, so a historical match must not
+# abort a fresh rehearsal
+LOG_OFFSET=$(wc -c < campaign/campaign.log 2>/dev/null || echo 0)
 while kill -0 $BPID 2>/dev/null; do
-  if grep -q "tunnel UP" campaign/campaign.log 2>/dev/null; then
+  if tail -c +$((LOG_OFFSET + 1)) campaign/campaign.log 2>/dev/null \
+      | grep -q "tunnel UP"; then
     kill -TERM $BPID 2>/dev/null
     echo "aborted: tunnel came up" >> campaign/rehearsal_stderr.log
     exit 0

@@ -1,0 +1,75 @@
+"""Measure the host-counts tail crossover: local XLA CPU vs the chip.
+
+The host-counts pileup (``HostPileupAccumulator``) finishes with the
+count tensor in HOST memory, so the fused tail can run in two places:
+
+* the local XLA CPU backend — zero bytes on the link, one-core compute;
+* the accelerator — free compute, but the link bills L*6 upload bytes,
+  a ~65 ms dispatch round trip, and the packed-output fetch.
+
+This sweeps genome length L and threshold count T, timing the SAME
+jitted tail (``ops.fused.vote_packed_simple``) with every operand
+committed to each device, and prints one JSON line per (L, T, device).
+``_tail_cpu_wins`` in backends/jax_backend.py carries this sweep's
+constants (S2C_TAIL_RT_MS / S2C_TAIL_LINK_MBPS / S2C_TAIL_CPU_MPOS_S
+override them for a different link or host; S2C_TAIL_DEVICE=cpu|default
+forces the placement outright).
+
+Usage:  python tools/tail_crossover.py  (runs on the default platform;
+        the cpu rows use jax.devices("cpu") either way)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def sweep():
+    import jax
+    import numpy as np
+
+    from sam2consensus_tpu.ops import fused
+    from sam2consensus_tpu.ops.cutoff import encode_thresholds
+
+    rng = np.random.default_rng(0)
+    default = jax.devices()[0]
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu = None
+    devices = [("default", default)]
+    if cpu is not None and cpu != default:
+        devices.append(("cpu", cpu))
+
+    for log_l in (18, 19, 20, 21, 22):
+        length = 1 << log_l
+        counts = rng.integers(0, 120, size=(length, 6), dtype=np.uint8)
+        offsets = np.array([0, length // 2, length], dtype=np.int32)
+        for n_thr in (1, 3):
+            thr = encode_thresholds([0.25, 0.5, 0.75][:n_thr])
+            for tag, dev in devices:
+                def once():
+                    t0 = time.perf_counter()
+                    out = fused.vote_packed_simple(
+                        jax.device_put(counts, dev),
+                        jax.device_put(thr, dev),
+                        jax.device_put(offsets, dev),
+                        1, None)
+                    np.asarray(out)
+                    return time.perf_counter() - t0
+
+                once()                        # compile + warm
+                best = min(once() for _ in range(3))
+                print(json.dumps({
+                    "L": length, "T": n_thr, "cells": length * n_thr,
+                    "device": tag, "sec": round(best, 4),
+                    "upload_mb": round(length * 6 / 1e6, 2),
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    sweep()
