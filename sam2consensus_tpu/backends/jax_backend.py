@@ -18,9 +18,10 @@ roofline (PERF.md):
    device-side float64 cutoffs (``ops/vote.py``, ``ops/cutoff.py``), the
    insertion "mini-alignment" table and vote (``ops/insertions.py``),
    per-contig coverage sums and per-site coverage — position symbols
-   travel sparse (emit bitmask + compacted chars) when coverage is;
-   genomes small enough that link latency dominates route the same
-   jitted tail to the local XLA CPU backend;
+   travel by the cheapest modeled wire encoding (dense ASCII, 5-bit
+   packed planes, or emit-bitmask sparse; the output-encoding gate
+   below); tails whose modeled link cost exceeds the local vote rate
+   route the same jitted functions to the local XLA CPU backend;
 3. the host splices insertion columns after their site's base
    (right-shift placement, quirk 3), substitutes the fill character for
    sentinel bytes and renders FASTA records byte-identically to the CPU
@@ -62,8 +63,21 @@ TAIL_CPU_POS_PER_SEC = float(os.environ.get(
     "S2C_TAIL_CPU_MPOS_S", "5.2")) * 1e6
 #: per-position overhead of the sparse output path: device compaction
 #: scatter (~12 ns) + host re-expansion (~8 ns), measured round 3 at
-#: L = 40M (see the sparse-output gate below)
+#: L = 40M (see the output-encoding gate below)
 SPARSE_NS_PER_POS = float(os.environ.get("S2C_SPARSE_NS_PER_POS", "20"))
+#: host decode cost of the 5-bit packed output encoding (pair-LUT gather
+#: + high-bit fixups, _expand_packed5): 5.5 ns/char measured at L = 40M
+#: with 2% high-plane fill
+P5_HOST_NS_PER_CHAR = float(os.environ.get("S2C_P5_HOST_NS", "5.5"))
+#: device-side cost of the packed5 plane split.  The first formulation
+#: (32-way one-hot re-select of the ASCII output + stride-2 slicing)
+#: measured ~22 ns/char on the chip at L = 40M — worse than the wire it
+#: saved on the 40 MB/s link; the current one votes directly in code5
+#: (zero re-encode) and packs with contiguous reshapes.  The default
+#: keeps the measured pessimistic value until the rewrite is measured
+#: on hardware: with it, auto picks packed5 only where even the slow
+#: formulation would genuinely win (modeled links under ~14 MB/s).
+P5_DEV_NS_PER_CHAR = float(os.environ.get("S2C_P5_DEV_NS", "22"))
 
 
 def _tail_cpu_wins(total_len: int, n_thresholds: int,
@@ -453,34 +467,46 @@ class JaxBackend:
         stats.extra["insertions_sec"] = round(time.perf_counter() - t0, 4)
 
         t0 = time.perf_counter()
-        # sparse-output gate: covered positions are bounded by aligned
-        # bases, so for sparse coverage the emit bitmask + compacted chars
-        # cost far fewer d2h bytes than the dense [T, L] fetch (ops/fused.py
-        # _sparse_syms).  But sparse is not free: the device-side
-        # compaction is an XLA scatter (~12 ns/position measured on the
-        # chip at L = 40M) and the host re-expansion costs ~8 ns/position
-        # (np.unpackbits + masked assign), so sparse must save MORE link
-        # time than that — at T=1 the crossover sits near 8% fill; extra
-        # thresholds amortize the fixed per-position cost and push it up.
-        # A cpu-routed tail has no link to save and skips sparse outright.
+        # output-encoding gate: the position symbols can travel dense
+        # ASCII (T*L bytes), 5-bit packed (0.625 B/char — the vote's
+        # whole alphabet is 32 symbols, constants.SYM32_ASCII), or sparse
+        # (emit bitmask + chars compacted to the covered positions, which
+        # aligned bases bound).  None is free: packed5 costs a host
+        # decode pass (~P5 ns/char), sparse costs a device compaction
+        # scatter (~12 ns/position — XLA scatters serialize on TPU) plus
+        # host re-expansion (~8 ns/position).  Pick the cheapest modeled
+        # time; a link-free tail (cpu-routed, or the default backend IS
+        # the local cpu) always ships dense — the "saved" fetch would be
+        # a memcpy while the decode costs stay real.
         sparse_cap = fused.pad_cap(
             min(total_len, max(1, stats.aligned_bases)) + 1)
         nbits = (total_len + 7) // 8
-        dense_bytes = n_thresholds * total_len
-        sparse_bytes = nbits + n_thresholds * sparse_cap
-        sparse_mode = os.environ.get("S2C_SPARSE_OUTPUT", "auto")
-        if sparse_mode not in ("auto", "force", "off"):
+        if "S2C_SPARSE_OUTPUT" in os.environ:
             raise RuntimeError(
-                f"S2C_SPARSE_OUTPUT={sparse_mode!r}: use auto|force|off")
-        # a tail with no link to save skips sparse outright: cpu-routed
-        # tails AND runs whose default backend is already the local cpu
-        # (there the "saved" dense fetch is a memcpy, not 40 MB/s wire)
+                "S2C_SPARSE_OUTPUT was renamed: use "
+                "S2C_TAIL_ENCODING=auto|dense|sparse|packed5")
+        enc_mode = os.environ.get("S2C_TAIL_ENCODING", "auto")
+        if enc_mode not in ("auto", "dense", "sparse", "packed5"):
+            raise RuntimeError(
+                f"S2C_TAIL_ENCODING={enc_mode!r}: use "
+                f"auto|dense|sparse|packed5")
         link_free = tail_dev is not None or jax.default_backend() == "cpu"
-        if sparse_mode == "off" or (sparse_mode == "auto" and (
-                link_free
-                or (dense_bytes - sparse_bytes) / TAIL_LINK_BPS
-                <= total_len * SPARSE_NS_PER_POS * 1e-9)):
-            sparse_cap = None                      # dense fetch is cheaper
+        if enc_mode == "auto":
+            costs = {
+                None: n_thresholds * total_len / TAIL_LINK_BPS,
+                "packed5":
+                    n_thresholds * ((total_len + 1) // 2 + nbits)
+                    / TAIL_LINK_BPS
+                    + n_thresholds * total_len
+                    * (P5_HOST_NS_PER_CHAR + P5_DEV_NS_PER_CHAR) * 1e-9,
+                sparse_cap:
+                    (nbits + n_thresholds * sparse_cap) / TAIL_LINK_BPS
+                    + total_len * SPARSE_NS_PER_POS * 1e-9,
+            }
+            out_enc = None if link_free else min(costs, key=costs.get)
+        else:
+            out_enc = {"dense": None, "packed5": "packed5",
+                       "sparse": sparse_cap}[enc_mode]
         if ins is not None:
             k = len(ins["key_flat"])
             # pad sites and columns to powers of two: pad events scatter
@@ -556,11 +582,11 @@ class JaxBackend:
                     put(eplan.key3), put(eplan.cc3),
                     put(eplan.blk_lo), put(eplan.blk_n),
                     cfg.min_depth, cp, eplan.kp, eplan.c6p,
-                    eplan.max_blocks, interp, sparse_cap)
+                    eplan.max_blocks, interp, out_enc)
                 out = np.asarray(packed)
                 syms, ins_syms, contig_sums, site_cov = self._unpack_tail(
                     out, n_thresholds, total_len, eplan.kp, cp, n_contigs,
-                    k, sparse_cap=sparse_cap)
+                    k, out_enc=out_enc)
                 stats.extra["insertion_kernel"] = "pallas"
             else:
                 sk, ncp = padded_sites(kp)
@@ -569,11 +595,11 @@ class JaxBackend:
                     acc.counts, thr_enc, put(offsets32),
                     put(sk), put(ncp),
                     put(ev_key), put(ev_col),
-                    put(ev_code), cfg.min_depth, cp, sparse_cap)
+                    put(ev_code), cfg.min_depth, cp, out_enc)
                 out = np.asarray(packed)
                 syms, ins_syms, contig_sums, site_cov = self._unpack_tail(
                     out, n_thresholds, total_len, kp, cp, n_contigs, k,
-                    sparse_cap=sparse_cap)
+                    out_enc=out_enc)
         else:
             site_cov = None
             ins_syms = None
@@ -584,10 +610,13 @@ class JaxBackend:
             else:
                 out = np.asarray(fused.vote_packed_simple(
                     acc.counts, thr_enc, put(offsets32),
-                    cfg.min_depth, sparse_cap))
-                if sparse_cap is not None:
+                    cfg.min_depth, out_enc))
+                if out_enc == "packed5":
+                    syms, split = self._expand_packed5(
+                        out, n_thresholds, total_len)
+                elif out_enc is not None:
                     syms, split = self._expand_sparse(
-                        out, n_thresholds, total_len, sparse_cap)
+                        out, n_thresholds, total_len, out_enc)
                 else:
                     split = n_thresholds * total_len
                     syms = out[:split].reshape(n_thresholds, total_len)
@@ -682,19 +711,61 @@ class JaxBackend:
         syms[:, emit] = compact[:, :kcov]
         return syms, nbits + n_thresholds * cap
 
+    @staticmethod
+    def _expand_packed5(out: np.ndarray, n_thresholds: int,
+                        total_len: int):
+        """Decode the 5-bit packed symbol planes (ops/fused.py
+        ``_packed5_syms``) back to dense ASCII ``[T, L]``.
+
+        The common case — high bit clear — decodes two characters per
+        nibble byte through one 256-entry uint16 pair-LUT gather; only
+        bytes of the high-bit plane that are nonzero (lowercase calls,
+        'B', 'n' — rare) get per-position fixups.  Returns
+        (syms, bytes consumed)."""
+        from ..constants import SYM32_ASCII
+
+        nb = (total_len + 1) // 2
+        hb = (total_len + 7) // 8
+        nibs = out[:n_thresholds * nb].reshape(n_thresholds, nb)
+        hbits = out[n_thresholds * nb:
+                    n_thresholds * (nb + hb)].reshape(n_thresholds, hb)
+        # pair LUT: byte b -> ASCII of (b & 15) | ASCII of (b >> 4) << 8
+        # (little-endian uint16 view puts the low-nibble char first)
+        lo16 = SYM32_ASCII[:16].astype(np.uint16)
+        pair_lut = (lo16[np.arange(256) & 15]
+                    | (lo16[np.arange(256) >> 4] << 8)).astype("<u2")
+        pairs = pair_lut[nibs]                       # [T, nb] uint16
+        syms = np.ascontiguousarray(pairs).view(np.uint8).reshape(
+            n_thresholds, nb * 2)[:, :total_len].copy()
+        rows, bytecols = np.nonzero(hbits)
+        if rows.size:
+            bits = np.unpackbits(hbits[rows, bytecols][:, None], axis=1,
+                                 bitorder="little")            # [n, 8]
+            brow, bbit = np.nonzero(bits)
+            prow = rows[brow]
+            ppos = bytecols[brow] * 8 + bbit
+            ok = ppos < total_len
+            prow, ppos = prow[ok], ppos[ok]
+            low = (nibs[prow, ppos // 2] >> (4 * (ppos & 1))) & 15
+            syms[prow, ppos] = SYM32_ASCII[16 + low]
+        return syms, n_thresholds * (nb + hb)
+
     @classmethod
     def _unpack_tail(cls, out: np.ndarray, n_thresholds: int,
                      total_len: int, kp: int, cp: int, n_contigs: int,
-                     k: int, sparse_cap=None):
+                     k: int, out_enc=None):
         """Split the fused tail's packed uint8 buffer (ops/fused.py)."""
         from ..ops import fused
 
-        if sparse_cap is None:
+        if out_enc is None:
             split1 = n_thresholds * total_len
             syms = out[:split1].reshape(n_thresholds, total_len)
+        elif out_enc == "packed5":
+            syms, split1 = cls._expand_packed5(out, n_thresholds,
+                                               total_len)
         else:
             syms, split1 = cls._expand_sparse(out, n_thresholds, total_len,
-                                              sparse_cap)
+                                              out_enc)
         split2 = split1 + n_thresholds * kp * cp
         split3 = split2 + 4 * n_contigs
         ins_syms = out[split1:split2].reshape(

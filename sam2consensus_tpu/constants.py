@@ -110,3 +110,20 @@ CODE_TO_BASE = np.frombuffer(ALPHABET.encode("ascii"), dtype=np.uint8).copy()
 #: invalid input bases never reach a committed row (strict mode raises,
 #: permissive mode skips the read).
 PAD_CODE = 255
+
+# -- 5-bit output symbol space -------------------------------------------
+#
+# The vote emits exactly 32 distinct bytes: the FILL sentinel (0), '-',
+# the 15 uppercase IUPAC codes, and the 15 lowercase forms (a called set
+# that mixes nucleotides with gap/N lowers the code; {-,N} gives 'n').
+# That is 5 bits of information per position, which the fused tail
+# exploits to ship the dense consensus at 5/8 of a byte per character
+# over the slow host link (ops/fused.py "packed5"): a nibble plane
+# (codes 0-15) plus a high-bit plane.  The LOW half holds the sentinel,
+# '-', and the frequent uppercase calls so the host can decode the
+# common case with one 256-entry pair-LUT gather and touch the high
+# plane only where a bit is set ('B' — the rarest call, needing C,G,T
+# to pass without A — rides with the lowercase half).
+SYM32_ASCII = np.frombuffer(
+    b"\x00-ACGTNMRWSYKVHD" + b"Bacgtnmrwsykvhdb", dtype=np.uint8).copy()
+assert len(SYM32_ASCII) == 32 and len(set(SYM32_ASCII)) == 32

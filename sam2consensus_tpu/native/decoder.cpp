@@ -482,12 +482,16 @@ extern "C" long s2c_decode(
         ++n_rows;
         n_events += span - pads;
         if (acc_total_len > 0) {
-          const int64_t g0 = ctg_offset[ci] + pos;
+          // bounds are guaranteed here: the fast path requires pos >= 0
+          // and structural validation pins pos + span <= reflen, so
+          // [g0, g0 + span) sits inside this contig's slice of the
+          // genome; only the code test (PAD cells from the maxdel gate)
+          // remains in the loop
+          int32_t* const base =
+              acc_counts + (ctg_offset[ci] + pos) * 6;
           for (long k = 0; k < span; ++k) {
             const unsigned char code = dst[k];
-            const int64_t gp = g0 + k;
-            if (code < 6 && gp >= 0 && gp < acc_total_len)
-              ++acc_counts[gp * 6 + code];
+            if (code < 6) ++base[k * 6 + code];
           }
         }
       }

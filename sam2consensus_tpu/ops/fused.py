@@ -125,64 +125,110 @@ def _sparse_syms(syms: jax.Array, emit: jax.Array, cap: int):
     return bits, compact[:, :cap]
 
 
-def _syms_head(syms, cov, min_depth: int, sparse_cap):
-    """Position-symbol section of the packed buffer: dense ``[T*L]`` or,
-    with ``sparse_cap``, emit bitmask + compacted chars (the gate is
-    :func:`ops.vote.emit_gate` — the same definition that placed the FILL
-    sentinels, so mask and symbols cannot drift apart)."""
-    if sparse_cap is None:
+def _pack5_planes(code5: jax.Array):
+    """Split ``[T, L]`` 5-bit symbol codes into the wire planes.
+
+    The vote emits exactly 32 distinct symbols, so the dense block
+    carries 5 bits/char of information; shipping a nibble plane
+    (``[T, ceil(L/2)]``) plus a high-bit plane (``[T, ceil(L/8)]``)
+    costs 0.625 B/char on the link instead of 1 — with NO compaction
+    scatter (unlike the sparse path, whose scatter measured
+    ~12 ns/position).  The codes arrive straight from the vote's one-hot
+    select (``ops.vote.IUPAC_MASK_LUT5``), so re-encoding is free; this
+    is pure shifts + CONTIGUOUS reshapes (stride-2 slicing lowered
+    poorly on the chip).
+    """
+    c = code5.astype(jnp.int32)
+    t, length = c.shape
+    pad = (-length) % 8
+    if pad:
+        c = jnp.concatenate([c, jnp.zeros((t, pad), jnp.int32)], axis=1)
+    pairs = (c & 15).reshape(t, -1, 2)
+    nibs = (pairs[..., 0] | (pairs[..., 1] << 4)).astype(jnp.uint8)
+    nibs = nibs[:, : (length + 1) // 2]
+    octs = (c >> 4).reshape(t, -1, 8)
+    hbits = jnp.sum(octs << jnp.arange(8, dtype=jnp.int32)[None, None, :],
+                    axis=-1).astype(jnp.uint8)
+    hbits = hbits[:, : (length + 7) // 8]
+    return nibs, hbits
+
+
+def _sym_space(out_enc) -> str:
+    """The vote's symbol space for a wire encoding: packed5 votes
+    directly in 5-bit codes (``ops.vote.IUPAC_MASK_LUT5``); dense and
+    sparse ship ASCII."""
+    return "code5" if out_enc == "packed5" else "ascii"
+
+
+def _syms_head(syms, cov, min_depth: int, out_enc):
+    """Position-symbol section of the packed buffer.
+
+    ``out_enc`` selects the wire encoding: ``None`` → dense ``[T*L]``
+    ASCII; an int → sparse (emit bitmask + chars compacted to that
+    capacity; the gate is :func:`ops.vote.emit_gate` — the same
+    definition that placed the FILL sentinels, so mask and symbols
+    cannot drift apart); ``"packed5"`` → 5-bit planes
+    (:func:`_pack5_planes`; ``syms`` must then hold code5 symbols —
+    :func:`_sym_space`).  The backend picks by measured cost
+    (backends/jax_backend.py output-encoding gate)."""
+    if out_enc is None:
         return [syms.reshape(-1)]
+    if out_enc == "packed5":
+        nibs, hbits = _pack5_planes(syms)
+        return [nibs.reshape(-1), hbits.reshape(-1)]
     bits, compact = _sparse_syms(syms, emit_gate(cov, min_depth),
-                                 sparse_cap)
+                                 out_enc)
     return [bits, compact.reshape(-1)]
 
 
-@partial(jax.jit, static_argnames=("min_depth", "sparse_cap"))
+@partial(jax.jit, static_argnames=("min_depth", "out_enc"))
 def vote_packed_simple(counts: jax.Array, thr_enc: jax.Array,
                        offsets: jax.Array, min_depth: int,
-                       sparse_cap=None) -> jax.Array:
+                       out_enc=None) -> jax.Array:
     """No-insertion tail: position vote + contig sums, one packed buffer.
-    With ``sparse_cap``: ``[emit bits L/8 | compact T*cap | sums C*4]``."""
-    syms, cov = vote_block(counts, thr_enc, min_depth)          # [T, L]
+    ``out_enc`` as in :func:`_syms_head`."""
+    syms, cov = vote_block(counts, thr_enc, min_depth,
+                           _sym_space(out_enc))             # [T, L]
     contig_sums, _ = _tail_stats(cov, offsets,
                                  jnp.full((1,), -1, jnp.int32))
-    return jnp.concatenate(_syms_head(syms, cov, min_depth, sparse_cap)
+    return jnp.concatenate(_syms_head(syms, cov, min_depth, out_enc)
                            + [_bytes_of_i32(contig_sums)])
 
 
-@partial(jax.jit, static_argnames=("min_depth", "cp", "sparse_cap"))
+@partial(jax.jit, static_argnames=("min_depth", "cp", "out_enc"))
 def vote_packed(counts: jax.Array, thr_enc: jax.Array, offsets: jax.Array,
                 site_keys: jax.Array, n_cols: jax.Array, ev_key: jax.Array,
                 ev_col: jax.Array, ev_code: jax.Array,
-                min_depth: int, cp: int, sparse_cap=None) -> jax.Array:
+                min_depth: int, cp: int, out_enc=None) -> jax.Array:
     """Position vote + insertion table + insertion vote + stats, packed.
 
     ``site_keys``/``n_cols`` are the padded ``[Kp]`` site arrays
     (flat genome position, -1 for end-of-contig and pad sites); ``cp`` is
     the padded insertion-table column count (static).  Pad events scatter
-    into the sacrificial row Kp-1.  With ``sparse_cap`` the position
-    symbols travel as emit bitmask + compacted chars.
+    into the sacrificial row Kp-1.  ``out_enc`` selects the
+    position-symbol wire encoding (:func:`_syms_head`).
     """
-    syms, cov = vote_block(counts, thr_enc, min_depth)          # [T, L]
+    syms, cov = vote_block(counts, thr_enc, min_depth,
+                           _sym_space(out_enc))             # [T, L]
     contig_sums, site_cov = _tail_stats(cov, offsets, site_keys)
     kp = site_keys.shape[0]
     table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
     table = build_insertion_table(table, ev_key, ev_col, ev_code)
     ins_syms = vote_insertions(table, site_cov, n_cols, thr_enc)  # [T,Kp,Cp]
-    return jnp.concatenate(_syms_head(syms, cov, min_depth, sparse_cap) + [
+    return jnp.concatenate(_syms_head(syms, cov, min_depth, out_enc) + [
         ins_syms.reshape(-1),
         _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)])
 
 
 @partial(jax.jit, static_argnames=("min_depth", "cp", "kp", "c6p",
-                                   "max_blocks", "interpret", "sparse_cap"))
+                                   "max_blocks", "interpret", "out_enc"))
 def vote_packed_pallas(counts: jax.Array, thr_enc: jax.Array,
                        offsets: jax.Array, site_keys: jax.Array,
                        n_cols: jax.Array, key3: jax.Array, cc3: jax.Array,
                        blk_lo: jax.Array, blk_n: jax.Array,
                        min_depth: int, cp: int, kp: int, c6p: int,
                        max_blocks: int, interpret: bool = False,
-                       sparse_cap=None) -> jax.Array:
+                       out_enc=None) -> jax.Array:
     """``vote_packed`` with the insertion table built by the Pallas
     segmented-reduce kernel (ops/pallas_insertion.py) instead of the XLA
     scatter — still one dispatch, one packed uint8 result.
@@ -190,17 +236,18 @@ def vote_packed_pallas(counts: jax.Array, thr_enc: jax.Array,
     Inputs are the kernel's host-planned arrays (key-sorted event blocks +
     CSR block ranges); ``site_keys``/``n_cols`` are padded to the KERNEL's
     key padding ``kp`` (a KEY_BLOCK multiple), not the scatter padding.
-    With ``sparse_cap`` the position symbols travel sparse (emit bitmask +
-    compacted chars), same layout as :func:`vote_packed_sparse`.
+    ``out_enc`` selects the position-symbol wire encoding
+    (:func:`_syms_head`).
     """
     from .pallas_insertion import _table_call
 
-    syms, cov = vote_block(counts, thr_enc, min_depth)          # [T, L]
+    syms, cov = vote_block(counts, thr_enc, min_depth,
+                           _sym_space(out_enc))             # [T, L]
     contig_sums, site_cov = _tail_stats(cov, offsets, site_keys)
     out = _table_call(key3, cc3, blk_lo, blk_n, kp=kp, c6p=c6p,
                       max_blocks=max_blocks, interpret=interpret)
     table = out.reshape(kp, c6p)[:, : cp * 6].reshape(kp, cp, 6)
     ins_syms = vote_insertions(table, site_cov, n_cols, thr_enc)
-    return jnp.concatenate(_syms_head(syms, cov, min_depth, sparse_cap) + [
+    return jnp.concatenate(_syms_head(syms, cov, min_depth, out_enc) + [
         ins_syms.reshape(-1),
         _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)])

@@ -40,8 +40,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..constants import IUPAC_MASK_LUT
+from ..constants import IUPAC_MASK_LUT, SYM32_ASCII
 from .cutoff import exact_cutoff
+
+#: 64-entry LUT mapping the called-set mask straight to the 5-bit symbol
+#: code (index into ``SYM32_ASCII``) — the packed5 output encoding
+#: replaces the ASCII select with this one, so re-encoding costs ZERO
+#: extra device work (ops/fused.py ``_pack5_planes``).
+IUPAC_MASK_LUT5 = np.array(
+    [{int(b): i for i, b in enumerate(SYM32_ASCII)}[int(v)]
+     for v in IUPAC_MASK_LUT], dtype=np.uint8)
 
 #: device output byte marking "fill this position on host" (cov==0 or
 #: cov<min_depth); never collides with real output chars (all >= ord('-')).
@@ -66,14 +74,15 @@ def threshold_luts(thresholds: Sequence[float], max_cov: int) -> np.ndarray:
     return lut.astype(np.int32)
 
 
-def iupac_select(mask: jax.Array) -> jax.Array:
+def iupac_select(mask: jax.Array, table=IUPAC_MASK_LUT) -> jax.Array:
     """Map 6-bit called-set masks to output bytes, gather-free.
 
-    One-hot select over the 64-entry IUPAC LUT: elementwise compares fuse
-    into the vote for ~free where a table gather measured ~46 ms at
-    L = 4.6 M (tools/tunnel_probe.py).
+    One-hot select over a 64-entry LUT (ASCII by default; the packed5
+    encoding passes ``IUPAC_MASK_LUT5``): elementwise compares fuse into
+    the vote for ~free where a table gather measured ~46 ms at L = 4.6 M
+    (tools/tunnel_probe.py).
     """
-    lut = jnp.asarray(IUPAC_MASK_LUT).astype(jnp.int32)
+    lut = jnp.asarray(table).astype(jnp.int32)
     onehot = mask[..., None] == jnp.arange(64, dtype=jnp.int32)
     return jnp.sum(jnp.where(onehot, lut, 0), axis=-1).astype(jnp.uint8)
 
@@ -87,7 +96,7 @@ def emit_gate(cov: jax.Array, min_depth: int) -> jax.Array:
 
 
 def vote_block(counts: jax.Array, thr_enc: jax.Array,
-               min_depth: int) -> tuple:
+               min_depth: int, sym_space: str = "ascii") -> tuple:
     """Vote every position of a counts block for every threshold.
 
     Pure traceable function (no jit) so it can run inside ``jax.jit``,
@@ -99,11 +108,17 @@ def vote_block(counts: jax.Array, thr_enc: jax.Array,
       thr_enc: int32 ``[T, 5]`` encoded thresholds
         (``ops.cutoff.encode_thresholds``).
       min_depth: static minimum depth gate.
+      sym_space: ``"ascii"`` (output bytes) or ``"code5"`` (5-bit symbol
+        codes, ``constants.SYM32_ASCII`` order) — the same one-hot
+        select through a different table, so the packed5 wire encoding
+        costs no extra device work.  The FILL sentinel is 0 in both
+        spaces (``SYM32_ASCII[0] == 0``).
 
     Returns:
-      syms: uint8 ``[T, L]`` output byte per position (FILL_SENTINEL where
+      syms: uint8 ``[T, L]`` symbol per position (FILL_SENTINEL where
         the reference emits the fill character), and cov: int32 ``[L]``.
     """
+    table = IUPAC_MASK_LUT if sym_space == "ascii" else IUPAC_MASK_LUT5
     # widen on chip: the host-counts path uploads uint8/uint16 to spare the
     # ~40 MB/s link (ops/pileup.py HostPileupAccumulator)
     counts = counts.astype(jnp.int32)
@@ -122,7 +137,7 @@ def vote_block(counts: jax.Array, thr_enc: jax.Array,
         cutoff = exact_cutoff(cov, enc_row)                    # [L]
         included = nonzero & (strictly_greater_sum < cutoff[:, None])
         mask = jnp.sum(jnp.where(included, bit, 0), axis=-1)   # [L]
-        syms = iupac_select(mask)
+        syms = iupac_select(mask, table)
         return jnp.where(emit, syms, jnp.uint8(FILL_SENTINEL))
 
     return jax.vmap(per_threshold)(thr_enc), cov

@@ -163,10 +163,10 @@ def test_sparse_output_tail_byte_identical(monkeypatch):
     (emit bitmask + compacted chars) and stays byte-identical, with and
     without insertions.  The CI platform is link-free (everything runs
     on the local cpu backend), where the auto gate correctly refuses
-    sparse — S2C_SPARSE_OUTPUT=force exercises the path anyway."""
+    sparse — S2C_TAIL_ENCODING=sparse exercises the path anyway."""
     from sam2consensus_tpu.utils.simulate import sam_text
 
-    monkeypatch.setenv("S2C_SPARSE_OUTPUT", "force")
+    monkeypatch.setenv("S2C_TAIL_ENCODING", "sparse")
     # big genome, few reads -> aligned_bases << L keeps the cap small
     text = simulate(SimSpec(n_contigs=2, contig_len=200_000, n_reads=300,
                             read_len=60, ins_read_rate=0.3,
@@ -193,7 +193,7 @@ def test_sparse_output_auto_gate_link_free(monkeypatch):
     refuses sparse even for shapes where a tunneled link would pick it —
     the 'saved' dense fetch would be a local memcpy while the compaction
     scatter + host re-expansion are real costs."""
-    monkeypatch.delenv("S2C_SPARSE_OUTPUT", raising=False)
+    monkeypatch.delenv("S2C_TAIL_ENCODING", raising=False)
     text = simulate(SimSpec(n_contigs=2, contig_len=200_000, n_reads=300,
                             read_len=60, seed=46))
     cfg = RunConfig(prefix="t", thresholds=[0.25, 0.75], shards=1)
@@ -204,10 +204,33 @@ def test_sparse_output_auto_gate_link_free(monkeypatch):
         or st.extra["d2h_bytes"] >= 2 * 350_000, st.extra
 
 
+def test_packed5_output_byte_identical(monkeypatch):
+    """The 5-bit packed output encoding (nibble plane + high-bit plane,
+    constants.SYM32_ASCII) decodes byte-identically — including
+    lowercase/'B'/'n' calls, which live on the high plane and take the
+    per-position fixup path in _expand_packed5."""
+    monkeypatch.setenv("S2C_TAIL_ENCODING", "packed5")
+    # high indel rates force gap/nucleotide ties -> lowercase IUPAC calls
+    text = simulate(SimSpec(n_contigs=3, contig_len=5_000, n_reads=4_000,
+                            read_len=60, ins_read_rate=0.3,
+                            del_read_rate=0.35, seed=48))
+    for thr in ([0.25], [0.25, 0.5, 0.75]):
+        cfg = RunConfig(prefix="t", thresholds=thr, shards=1)
+        out_cpu, _ = _run(text, CpuBackend(), cfg)
+        out_jax, st = _run(text, JaxBackend(), cfg)
+        assert out_jax == out_cpu
+    # the output must actually be lowercase-bearing (high-plane symbols)
+    # for the fixup branch to have been exercised
+    assert any(ch.islower()
+               for f in out_cpu.values()
+               for line in f.split("\n") if not line.startswith(">")
+               for ch in line), "fixture produced no lowercase calls"
+
+
 def test_sparse_output_tail_pallas_byte_identical(monkeypatch):
     """The Pallas insertion-kernel variant composes with the sparse
     output encoding."""
-    monkeypatch.setenv("S2C_SPARSE_OUTPUT", "force")
+    monkeypatch.setenv("S2C_TAIL_ENCODING", "sparse")
     text = simulate(SimSpec(n_contigs=2, contig_len=200_000, n_reads=300,
                             read_len=60, ins_read_rate=0.3,
                             del_read_rate=0.2, seed=47))
