@@ -910,18 +910,19 @@ class JaxBackend:
             for t in range(n_thresholds):
                 base = syms[t, off:off + length]
                 if len(site_rows):
-                    pieces: List[bytes] = []
-                    prev = 0
-                    extra_cov = 0
-                    for row, loc in zip(site_rows, locs):
-                        cols = ins_syms[t, row][ins_syms[t, row] != 0]
-                        pieces.append(base[prev:loc + 1].tobytes())
-                        pieces.append(cols.tobytes())
-                        extra_cov += int(site_cov[row]) * len(cols)
-                        prev = loc + 1
-                    pieces.append(base[prev:].tobytes())
-                    raw = b"".join(pieces)
-                    sumcov = sumcov_base + extra_cov
+                    # splice every site's surviving columns after its
+                    # base position in ONE vectorized pass: np.insert
+                    # with repeated positions places each site's chars
+                    # in order at loc+1 (right-shift placement, quirk 3).
+                    # A python per-site loop here measured ~3 us/site —
+                    # the dominant render cost at 40k+ sites.
+                    block = ins_syms[t, site_rows]             # [S, Cp]
+                    nz = block != 0
+                    lens = nz.sum(axis=1)
+                    raw = np.insert(base, np.repeat(locs + 1, lens),
+                                    block[nz]).tobytes()
+                    sumcov = sumcov_base + int(
+                        (site_cov[site_rows] * lens).sum())
                 else:
                     raw = base.tobytes()
                     sumcov = sumcov_base

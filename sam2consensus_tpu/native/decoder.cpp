@@ -400,6 +400,14 @@ extern "C" long s2c_decode(
         break;  // consumed stops at this line's start
       }
       unsigned char* dst = codes + static_cast<int64_t>(n_rows) * width;
+      // fused pileup: count cells while they are still in registers --
+      // bounds are guaranteed (pos >= 0, and for span > 0 structural
+      // validation pinned pos + span <= reflen; span == 0 rows have no
+      // ref cells and may carry an unvalidated pos, so don't even form
+      // the pointer), and the rare aborts below roll back
+      int32_t* arow = (acc_total_len > 0 && span > 0)
+                          ? acc_counts + (ctg_offset[ci] + pos) * 6
+                          : nullptr;
       long o = 0, rc = 0, gaps = 0, pads = 0;
       bool bad_base = false;
       long ins_base = n_ins, chars_base = n_ins_chars;
@@ -413,12 +421,24 @@ extern "C" long s2c_decode(
             if (take < 0) take = 0;
             if (take > num) take = num;
             const char* sp = text + ss + rc;
-            for (long k = 0; k < take; ++k) {
-              unsigned char code =
-                  kLut.m[static_cast<unsigned char>(sp[k])];
-              bad_base |= (code == 255);
-              gaps += (code == kGap);
-              dst[o + k] = code;
+            if (arow) {
+              int32_t* ap = arow + o * 6;
+              for (long k = 0; k < take; ++k) {
+                unsigned char code =
+                    kLut.m[static_cast<unsigned char>(sp[k])];
+                bad_base |= (code == 255);
+                gaps += (code == kGap);
+                dst[o + k] = code;
+                if (code < 6) ++ap[k * 6 + code];
+              }
+            } else {
+              for (long k = 0; k < take; ++k) {
+                unsigned char code =
+                    kLut.m[static_cast<unsigned char>(sp[k])];
+                bad_base |= (code == 255);
+                gaps += (code == kGap);
+                dst[o + k] = code;
+              }
             }
             if (num > take) {
               // reachable only for SEQ "*" reads (short-SEQ carve-out
@@ -433,6 +453,10 @@ extern "C" long s2c_decode(
           case 'D': case 'N': case 'P':
             memset(dst + o, kGap, num);
             gaps += num;
+            if (arow) {
+              int32_t* ap = arow + o * 6 + kGap;
+              for (long k = 0; k < num; ++k, ap += 6) ++*ap;
+            }
             o += num;
             break;
           case 'I': {
@@ -460,6 +484,13 @@ extern "C" long s2c_decode(
         }
       }
       if (bad_base) {
+        // every ref cell of dst[0..span) was written (pads where SEQ ran
+        // short), and exactly the code<6 cells were counted above
+        if (arow)
+          for (long k = 0; k < span; ++k) {
+            const unsigned char cd = dst[k];
+            if (cd < 6) --arow[k * 6 + cd];
+          }
         n_ins = ins_base;
         n_ins_chars = chars_base;
         if (strict) {
@@ -472,8 +503,13 @@ extern "C" long s2c_decode(
         continue;
       }
       if (maxdel >= 0 && gaps > maxdel) {
+        // counted inline above: retro-decrement each GAP cell as it
+        // turns into PAD (skipped but advancing)
         for (long k = 0; k < span; ++k)
-          if (dst[k] == kGap) dst[k] = kPad;
+          if (dst[k] == kGap) {
+            dst[k] = kPad;
+            if (arow) --arow[k * 6 + kGap];
+          }
         pads += gaps;
       }
       if (span > 0) {
@@ -481,19 +517,6 @@ extern "C" long s2c_decode(
         starts[n_rows] = static_cast<int32_t>(ctg_offset[ci] + pos);
         ++n_rows;
         n_events += span - pads;
-        if (acc_total_len > 0) {
-          // bounds are guaranteed here: the fast path requires pos >= 0
-          // and structural validation pins pos + span <= reflen, so
-          // [g0, g0 + span) sits inside this contig's slice of the
-          // genome; only the code test (PAD cells from the maxdel gate)
-          // remains in the loop
-          int32_t* const base =
-              acc_counts + (ctg_offset[ci] + pos) * 6;
-          for (long k = 0; k < span; ++k) {
-            const unsigned char code = dst[k];
-            if (code < 6) ++base[k * 6 + code];
-          }
-        }
       }
       ++n_reads;
       i = next;

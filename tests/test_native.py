@@ -210,6 +210,33 @@ def test_star_seq_parity():
     assert str(ep.value) == str(en.value)
 
 
+def test_fused_counts_rollback_paths():
+    """Inline counting in the fused decode pass (counts incremented while
+    cells are translated) must roll back exactly on its two abort paths:
+    a bad base in permissive mode (whole row un-counted) and the maxdel
+    gate (counted GAP cells retro-decremented when converted to PAD)."""
+    reads = [
+        ("f", 1, "4M", "ACGT"),                    # plain
+        ("f", 2, "4M", "ACXT"),                    # bad base -> rollback
+        ("f", 1, "2M6D2M", "ACGT"),                # 6 gaps > maxdel=4
+        ("f", 3, "2M2D2M", "ACGT"),                # 2 gaps <= maxdel
+        ("f", 1, "3M", "A-G"),                     # literal '-' counts
+    ]
+    text = sam_text([("f", 20)], reads)
+    layout, py, pb = _py_encode(text, strict=False, maxdel=4)
+    want = _counts(pb, layout.total_len)
+
+    layout2, handle, first = _layout(text)
+    acc = np.zeros((layout2.total_len, 6), np.int32)
+    enc = native_encoder.NativeReadEncoder(
+        layout2, strict=False, maxdel=4, accumulate_into=acc)
+    from sam2consensus_tpu.io.sam import ReadStream
+    for _ in enc.encode_blocks(ReadStream(handle, first).blocks()):
+        pass
+    np.testing.assert_array_equal(acc, want)
+    assert py.n_skipped == enc.n_skipped == 1
+
+
 def test_end_to_end_stream_byte_identity():
     text = simulate(SimSpec(n_contigs=4, contig_len=250, n_reads=900,
                             read_len=50, ins_read_rate=0.2,
