@@ -61,6 +61,11 @@ TAIL_RT_SEC = float(os.environ.get("S2C_TAIL_RT_MS", "65")) / 1e3
 TAIL_LINK_BPS = float(os.environ.get("S2C_TAIL_LINK_MBPS", "40")) * 1e6
 TAIL_CPU_POS_PER_SEC = float(os.environ.get(
     "S2C_TAIL_CPU_MPOS_S", "5.2")) * 1e6
+#: the C++ vote's measured costs (native/decoder.cpp s2c_vote at L=1M:
+#: 31 ms for T=1, +3 ms per extra threshold) — used by the placement
+#: model instead of the XLA rate whenever the native library loads
+TAIL_NATIVE_NS_PER_POS = float(os.environ.get("S2C_TAIL_NATIVE_NS", "31"))
+TAIL_NATIVE_THR_NS = float(os.environ.get("S2C_TAIL_NATIVE_THR_NS", "3"))
 #: per-position overhead of the sparse output path: device compaction
 #: scatter (~12 ns) + host re-expansion (~8 ns), measured round 3 at
 #: L = 40M (see the output-encoding gate below)
@@ -90,7 +95,20 @@ def _tail_cpu_wins(total_len: int, n_thresholds: int,
                 f"S2C_TAIL_DEVICE={forced!r}: use 'cpu' (local XLA CPU "
                 f"tail), 'default' (the accelerator), or 'auto'")
         return forced == "cpu"
-    cpu_sec = total_len * n_thresholds / TAIL_CPU_POS_PER_SEC
+    from .. import native
+
+    # the native C++ vote only serves auto-encoded tails (a forced
+    # S2C_TAIL_ENCODING runs the fused XLA wire path), so the model must
+    # price whichever implementation would actually execute
+    native_tail = (native.load() is not None
+                   and os.environ.get("S2C_TAIL_ENCODING", "auto")
+                   == "auto")
+    if native_tail:
+        cpu_sec = total_len * (
+            TAIL_NATIVE_NS_PER_POS
+            + TAIL_NATIVE_THR_NS * (n_thresholds - 1)) * 1e-9
+    else:
+        cpu_sec = total_len * n_thresholds / TAIL_CPU_POS_PER_SEC
     chip_sec = (TAIL_RT_SEC
                 + (upload_bytes + n_thresholds * total_len) / TAIL_LINK_BPS)
     return cpu_sec < chip_sec
@@ -588,6 +606,27 @@ class JaxBackend:
                     out, n_thresholds, total_len, eplan.kp, cp, n_contigs,
                     k, out_enc=out_enc)
                 stats.extra["insertion_kernel"] = "pallas"
+            elif tail_dev is not None and enc_mode == "auto" \
+                    and (native_tail := self._native_vote(
+                        acc, cfg, layout)) is not None:
+                # cpu-routed tail with the C++ vote: the position vote and
+                # coverage run at memory speed (native/decoder.cpp
+                # s2c_vote); only the K-small insertion table + vote stay
+                # on the XLA CPU backend.  A forced S2C_TAIL_ENCODING
+                # explicitly asks for the fused wire path, so it skips
+                # this branch (tests exercise those encodings that way).
+                syms, cov_np, contig_sums = native_tail
+                sk, ncp = padded_sites(kp)
+                site_cov_p = np.where(
+                    sk >= 0, cov_np[np.maximum(sk, 0)], 0).astype(np.int32)
+                site_cov = site_cov_p[:k].astype(np.int64)
+                ev_key, ev_col, ev_code = padded_events(kp)
+                table = build_insertion_table(
+                    put(np.zeros((kp, cp, 6), dtype=np.int32)),
+                    put(ev_key), put(ev_col), put(ev_code))
+                ins_syms = np.asarray(vote_insertions(
+                    table, put(site_cov_p), put(ncp),
+                    thr_enc))[:, :k, :]                       # [T, K, Cp]
             else:
                 sk, ncp = padded_sites(kp)
                 ev_key, ev_col, ev_code = padded_events(kp)
@@ -607,6 +646,10 @@ class JaxBackend:
                 contig_sums, _ = acc.tail_stats(
                     offsets32, np.zeros(0, dtype=np.int32))
                 syms = acc.vote(thr_enc_np, cfg.min_depth)
+            elif tail_dev is not None and enc_mode == "auto" \
+                    and (native_tail := self._native_vote(
+                        acc, cfg, layout)) is not None:
+                syms, _cov_np, contig_sums = native_tail
             else:
                 out = np.asarray(fused.vote_packed_simple(
                     acc.counts, thr_enc, put(offsets32),
@@ -694,6 +737,23 @@ class JaxBackend:
             byte_offset=stream.byte_offset()))
         stats.extra["checkpoints_written"] = (
             stats.extra.get("checkpoints_written", 0) + 1)
+
+    @staticmethod
+    def _native_vote(acc, cfg: RunConfig, layout):
+        """C++ position vote + int64 contig sums for a cpu-routed tail
+        (native/decoder.cpp ``s2c_vote``); None when the native library
+        is unavailable (the XLA CPU fused tail handles it then)."""
+        from ..ops.vote import vote_positions_native
+
+        nat = vote_positions_native(acc.counts_host(), cfg.thresholds,
+                                    cfg.min_depth)
+        if nat is None:
+            return None
+        syms, cov = nat
+        csum = np.concatenate([np.zeros(1, np.int64),
+                               np.cumsum(cov, dtype=np.int64)])
+        contig_sums = csum[layout.offsets[1:]] - csum[layout.offsets[:-1]]
+        return syms, cov, contig_sums
 
     @staticmethod
     def _expand_sparse(out: np.ndarray, n_thresholds: int, total_len: int,

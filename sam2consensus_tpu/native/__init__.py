@@ -104,6 +104,14 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_long, ctypes.c_long,          # n_rows, width
         i32p, ctypes.c_long,                   # counts [L*6], total_len
     ]
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    lib.s2c_vote.restype = None
+    lib.s2c_vote.argtypes = [
+        i32p, ctypes.c_int64,                  # counts [L*6], L
+        f64p, ctypes.c_long, ctypes.c_long,    # thresholds, T, min_depth
+        u8p,                                   # 64-entry mask->byte LUT
+        u8p, i32p,                             # out syms [T*L], out cov [L]
+    ]
     _lib = lib
     return _lib
 

@@ -718,3 +718,53 @@ extern "C" void s2c_accumulate_rows(
     }
   }
 }
+
+// ---------------------------------------------------------------------------
+// Threshold consensus vote over a host-resident count tensor.
+//
+// The closed-form greedy vote (ops/vote.py: lane i is included iff
+// c_i != 0 and S_i < ceil(float64(t) * cov), S_i = sum of lanes with a
+// strictly greater count), in C++ for tails routed to the host: the XLA
+// CPU backend votes at ~5 M positions/s/threshold on this one-core host,
+// while this loop — S[6] hoisted per position, ~12 ops per threshold —
+// runs at memory speed.  The float64 product + ceil matches the oracle's
+// semantics directly (the device needed int32 limb arithmetic only
+// because the chip lacks float64, ops/cutoff.py).  lut64 is the 64-entry
+// called-set-mask -> output-byte table (constants.IUPAC_MASK_LUT), so
+// symbol mapping shares one definition with the device path.  Positions
+// failing the emit gate (cov == 0 or cov < min_depth) get sentinel 0.
+extern "C" void s2c_vote(
+    const int32_t* counts /* [L * 6] */, int64_t L,
+    const double* thresholds, long T, long min_depth,
+    const unsigned char* lut64,
+    unsigned char* out_syms /* [T * L] */, int32_t* out_cov /* [L] */) {
+  for (int64_t p = 0; p < L; ++p) {
+    const int32_t* c = counts + p * 6;
+    const int32_t cov =
+        c[0] + c[1] + c[2] + c[3] + c[4] + c[5];
+    out_cov[p] = cov;
+    if (cov <= 0 || cov < min_depth) {
+      for (long t = 0; t < T; ++t) out_syms[t * L + p] = 0;
+      continue;
+    }
+    int32_t S[6];
+    for (int i = 0; i < 6; ++i) {
+      int32_t s = 0;
+      for (int j = 0; j < 6; ++j)
+        if (c[j] > c[i]) s += c[j];
+      S[i] = s;
+    }
+    const double dcov = static_cast<double>(cov);
+    for (long t = 0; t < T; ++t) {
+      // S < t*cov for integer S  <=>  S < ceil(t*cov) (oracle float
+      // comparison, sam2consensus semantics; ops/vote.threshold_luts)
+      const double cut = __builtin_ceil(thresholds[t] * dcov);
+      const int64_t cutoff =
+          cut > 2147483647.0 ? 2147483647 : static_cast<int64_t>(cut);
+      unsigned mask = 0;
+      for (int i = 0; i < 6; ++i)
+        if (c[i] != 0 && S[i] < cutoff) mask |= (1u << i);
+      out_syms[t * L + p] = lut64[mask];
+    }
+  }
+}

@@ -145,3 +145,32 @@ def vote_block(counts: jax.Array, thr_enc: jax.Array,
 
 #: jitted single-device entry point over a full counts tensor
 vote_positions = partial(jax.jit, static_argnames=("min_depth",))(vote_block)
+
+
+def vote_positions_native(counts: np.ndarray, thresholds: Sequence[float],
+                          min_depth: int):
+    """C++ vote over host-resident counts (``native/decoder.cpp
+    s2c_vote``), or None when the native library is unavailable.
+
+    Same closed form and the same 64-entry mask LUT as the device vote;
+    the float64 ``ceil(t * cov)`` cutoff is computed directly (the host
+    has float64 — only the chip needed ops/cutoff.py's limb arithmetic).
+    Used by the backend for cpu-routed tails, where the XLA CPU vote's
+    ~5 M positions/s/threshold was the measured bottleneck.
+
+    Returns (syms uint8 [T, L] with FILL sentinel, cov int32 [L]).
+    """
+    from .. import native
+
+    lib = native.load()
+    if lib is None:
+        return None
+    counts = np.ascontiguousarray(counts, dtype=np.int32)
+    length = counts.shape[0]
+    n_thr = len(thresholds)
+    syms = np.empty(n_thr * length, np.uint8)
+    cov = np.empty(length, np.int32)
+    lib.s2c_vote(counts.reshape(-1), length,
+                 np.asarray(thresholds, np.float64), n_thr, min_depth,
+                 IUPAC_MASK_LUT, syms, cov)
+    return syms.reshape(n_thr, length), cov

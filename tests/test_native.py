@@ -210,6 +210,57 @@ def test_star_seq_parity():
     assert str(ep.value) == str(en.value)
 
 
+def test_native_vote_differential():
+    """s2c_vote (the C++ tail vote) pinned against the device vote AND
+    the independent float64 LUT oracle (ops.vote.threshold_luts) over
+    adversarial count tensors: exact-integer threshold products (the
+    strict-< boundary), min_depth edges, single-lane and all-tied
+    positions, and max-int32-adjacent counts."""
+    import jax.numpy as jnp
+
+    from sam2consensus_tpu.constants import IUPAC_MASK_LUT
+    from sam2consensus_tpu.ops.cutoff import encode_thresholds
+    from sam2consensus_tpu.ops.vote import (threshold_luts, vote_positions,
+                                            vote_positions_native)
+
+    rng = np.random.default_rng(11)
+    blocks = [
+        rng.integers(0, 50, size=(4096, 6)),
+        rng.integers(0, 3, size=(4096, 6)),           # ties + zeros
+        np.zeros((64, 6), dtype=np.int64),            # all uncovered
+        np.eye(6, dtype=np.int64)[rng.integers(0, 6, 256)] * 8,  # t*cov int
+        np.full((32, 6), (1 << 27) // 6),             # near int32 sums
+    ]
+    counts = np.concatenate(blocks).astype(np.int32)
+    length = counts.shape[0]
+    thresholds = [0.25, 0.5, 0.75, 1.0 / 3.0, 0.9999999]
+    for md in (1, 2, 9):
+        got = vote_positions_native(counts, thresholds, md)
+        assert got is not None, "native lib unavailable"
+        syms_n, cov_n = got
+        want_syms, want_cov = vote_positions(
+            jnp.asarray(counts), jnp.asarray(encode_thresholds(thresholds)),
+            md)
+        np.testing.assert_array_equal(syms_n, np.asarray(want_syms))
+        np.testing.assert_array_equal(cov_n, np.asarray(want_cov))
+        # independent oracle: greedy vote via the float64 cutoff LUT
+        lut = threshold_luts(thresholds, int(cov_n.max()))
+        for p in rng.integers(0, length, 200):
+            c = counts[p]
+            cov = int(c.sum())
+            for t in range(len(thresholds)):
+                if cov == 0 or cov < md:
+                    assert syms_n[t, p] == 0
+                    continue
+                cutoff = lut[t, cov]
+                mask = 0
+                for i in range(6):
+                    s_i = int(c[c > c[i]].sum())
+                    if c[i] != 0 and s_i < cutoff:
+                        mask |= 1 << i
+                assert syms_n[t, p] == IUPAC_MASK_LUT[mask], (p, t)
+
+
 def test_fused_counts_rollback_paths():
     """Inline counting in the fused decode pass (counts incremented while
     cells are translated) must roll back exactly on its two abort paths:
