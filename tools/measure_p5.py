@@ -1,0 +1,66 @@
+"""On-chip measurement of the packed5 output encoding vs dense.
+
+Times ``ops.fused.vote_packed_simple`` with ``out_enc=None`` (dense)
+and ``out_enc="packed5"`` at two genome scales, splitting dispatch
+(block_until_ready) from fetch, and prints one JSON line per variant
+plus a derived device-side cost in ns/char — the number that belongs in
+``S2C_P5_DEV_NS`` (backends/jax_backend.py P5_DEV_NS_PER_CHAR).  Run on
+the real chip; compiles are warmed before timing.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sam2consensus_tpu.ops import fused
+    from sam2consensus_tpu.ops.cutoff import encode_thresholds
+
+    thr = jnp.asarray(encode_thresholds([0.25]))
+    for length in (4_600_000, 40_000_000):
+        key = jax.random.PRNGKey(0)
+        cov_mask = jax.random.uniform(key, (length,)) < 0.25
+        counts = (jnp.where(cov_mask[:, None], 3, 0).astype(jnp.uint8)
+                  * jnp.ones((1, 6), jnp.uint8))
+        counts.block_until_ready()
+        offsets = jnp.asarray(np.array([0, length], dtype=np.int32))
+        results = {}
+        for tag, enc in (("dense", None), ("packed5", "packed5")):
+            out = fused.vote_packed_simple(counts, thr, offsets, 1, enc)
+            out.block_until_ready()
+            np.asarray(out)                       # warm compile + fetch
+            best_c, best_f = 1e9, 1e9
+            for _ in range(2):
+                t0 = time.perf_counter()
+                out = fused.vote_packed_simple(counts, thr, offsets, 1,
+                                               enc)
+                out.block_until_ready()
+                t1 = time.perf_counter()
+                host = np.asarray(out)
+                t2 = time.perf_counter()
+                best_c, best_f = min(best_c, t1 - t0), min(best_f, t2 - t1)
+            results[tag] = (best_c, best_f)
+            print(json.dumps({
+                "L": length, "enc": tag, "compute_sec": round(best_c, 4),
+                "fetch_sec": round(best_f, 4),
+                "bytes": int(host.nbytes)}), flush=True)
+        dev_delta = results["packed5"][0] - results["dense"][0]
+        print(json.dumps({
+            "L": length,
+            "p5_dev_ns_per_char": round(dev_delta / length * 1e9, 2),
+            "p5_total_sec": round(sum(results["packed5"]), 4),
+            "dense_total_sec": round(sum(results["dense"]), 4)}),
+            flush=True)
+
+
+if __name__ == "__main__":
+    main()

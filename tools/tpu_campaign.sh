@@ -58,7 +58,13 @@ timeout -k 30 1800 python tools/microbench.py > campaign/microbench_tpu.jsonl \
 rc=$?
 echo "$(date +%H:%M:%S) microbench done rc=$rc" >> "$LOG"
 
-# 5. link probe (refresh PERF.md numbers)
+# 5. packed5 output-encoding measurement (sets S2C_P5_DEV_NS evidence)
+timeout -k 30 1200 python tools/measure_p5.py > campaign/measure_p5.jsonl \
+  2> campaign/measure_p5_stderr.log
+rc=$?
+echo "$(date +%H:%M:%S) measure_p5 done rc=$rc" >> "$LOG"
+
+# 6. link probe (refresh PERF.md numbers)
 timeout -k 30 900 python tools/tunnel_probe.py > campaign/tunnel_probe.json \
   2> campaign/tunnel_probe_stderr.log
 rc=$?
