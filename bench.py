@@ -154,7 +154,11 @@ def build_configs(n_devices: int):
          SimSpec(n_contigs=1, contig_len=4_600_000, n_reads=n(150000),
                  read_len=100, contig_len_jitter=0.0, seed=404,
                  contig_prefix="ecoli"),
-         {"thresholds": [0.25]}, {}, {}),
+         # auto picks the link-free host path here when the native lib
+         # builds (the row's "pileup" field records which path actually
+         # ran — host_fused vs scatter_*); the +device variant pins the
+         # chip pileup so the device path keeps a measured row
+         {"thresholds": [0.25]}, {"device": {"pileup": "scatter"}}, {}),
         ("amplicon_deep",
          SimSpec(n_contigs=1, contig_len=400, n_reads=n(100000),
                  read_len=80, ins_read_rate=0.3, del_read_rate=0.2,

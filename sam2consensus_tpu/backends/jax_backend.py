@@ -86,8 +86,10 @@ P5_DEV_NS_PER_CHAR = float(os.environ.get("S2C_P5_DEV_NS", "22"))
 
 
 def _tail_cpu_wins(total_len: int, n_thresholds: int,
-                   upload_bytes: int) -> bool:
-    """True when the local CPU tail beats shipping the tail to the chip."""
+                   upload_bytes: int, native_tail: bool) -> bool:
+    """True when the local CPU tail beats shipping the tail to the chip.
+    ``native_tail`` (from :func:`_native_tail_possible`) says which cpu
+    implementation would actually execute, so the model prices that one."""
     forced = os.environ.get("S2C_TAIL_DEVICE", "")
     if forced not in ("", "auto"):
         if forced not in ("cpu", "default"):
@@ -95,14 +97,6 @@ def _tail_cpu_wins(total_len: int, n_thresholds: int,
                 f"S2C_TAIL_DEVICE={forced!r}: use 'cpu' (local XLA CPU "
                 f"tail), 'default' (the accelerator), or 'auto'")
         return forced == "cpu"
-    from .. import native
-
-    # the native C++ vote only serves auto-encoded tails (a forced
-    # S2C_TAIL_ENCODING runs the fused XLA wire path), so the model must
-    # price whichever implementation would actually execute
-    native_tail = (native.load() is not None
-                   and os.environ.get("S2C_TAIL_ENCODING", "auto")
-                   == "auto")
     if native_tail:
         cpu_sec = total_len * (
             TAIL_NATIVE_NS_PER_POS
@@ -112,6 +106,24 @@ def _tail_cpu_wins(total_len: int, n_thresholds: int,
     chip_sec = (TAIL_RT_SEC
                 + (upload_bytes + n_thresholds * total_len) / TAIL_LINK_BPS)
     return cpu_sec < chip_sec
+
+
+def _native_tail_possible(cfg) -> bool:
+    """True when a cpu-routed tail would actually run the native C++
+    vote: the library loads and nothing forces the tail elsewhere — a
+    forced S2C_TAIL_ENCODING runs the fused XLA wire path, S2C_TAIL_DEVICE
+    =default pins the chip, and an explicit pallas insertion kernel
+    keeps the device tail.  Gates both the host-pileup genome bound
+    (ops.pileup.host_pileup_max_len) and the placement model's rate."""
+    if os.environ.get("S2C_TAIL_ENCODING", "auto") != "auto":
+        return False
+    if os.environ.get("S2C_TAIL_DEVICE", "") == "default":
+        return False
+    if getattr(cfg, "ins_kernel", "scatter") == "pallas":
+        return False
+    from .. import native
+
+    return native.load() is not None
 
 
 def _timed_iter(it, times, key: str = "decode_sec"):
@@ -225,8 +237,8 @@ class JaxBackend:
         from ..ops import fused
         from ..ops.cutoff import encode_thresholds
         from ..ops.insertions import build_insertion_table, vote_insertions
-        from ..ops.pileup import (HOST_PILEUP_MAX_LEN, HostPileupAccumulator,
-                                  PileupAccumulator)
+        from ..ops.pileup import (HostPileupAccumulator, PileupAccumulator,
+                                  host_pileup_max_len)
 
         from ..io.sam import ReadStream
 
@@ -290,9 +302,12 @@ class JaxBackend:
             strategy = getattr(cfg, "pileup", "auto")
             if strategy == "host" or (
                     strategy == "auto"
-                    and layout.total_len <= HOST_PILEUP_MAX_LEN):
+                    and layout.total_len <= host_pileup_max_len(
+                        _native_tail_possible(cfg))):
                 # wire-cost policy, measured on the tunneled chip: see
-                # HostPileupAccumulator's docstring
+                # HostPileupAccumulator's docstring and
+                # ops.pileup.host_pileup_max_len (the bound widens when
+                # the native tail vote makes host runs link-free)
                 acc = HostPileupAccumulator(layout.total_len)
             else:
                 acc = PileupAccumulator(layout.total_len, strategy=strategy)
@@ -456,7 +471,8 @@ class JaxBackend:
             # latency at scale.
             if (_tail_cpu_wins(total_len, n_thresholds,
                                total_len * NUM_SYMBOLS
-                               * acc.wire_itemsize())
+                               * acc.wire_itemsize(),
+                               _native_tail_possible(cfg))
                     and getattr(cfg, "ins_kernel", "scatter") != "pallas"):
                 try:
                     cpus = jax.devices("cpu")

@@ -198,6 +198,34 @@ class PileupAutoTuner:
 HOST_PILEUP_MAX_LEN = 1 << 21
 
 
+def host_pileup_max_len(native_tail: bool = False) -> int:
+    """The auto gate's genome-length bound, by what the tail would cost.
+
+    When the caller can actually serve the tail with the native C++ vote
+    (``native_tail`` — the library loads AND nothing forces the tail
+    onto the device or a fused wire encoding; the backend computes
+    this), a host-counts run never touches the link at all: the tail
+    votes at ~31 ns/position locally, while the device path's FLOOR is
+    two link round trips plus ~0.5 B/aligned-base of rows up and the
+    symbols back.  Up to ~2^23 positions the local vote stays under
+    that floor for any read depth, so the gate widens 4x.  Otherwise
+    the tail would fall to the XLA CPU vote (~5 M positions/s/thread)
+    or a counts upload, and the narrow bound is the measured choice
+    (PERF.md).  Override with S2C_HOST_PILEUP_MAX_LEN.
+    """
+    import os
+
+    env = os.environ.get("S2C_HOST_PILEUP_MAX_LEN")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            raise RuntimeError(
+                f"S2C_HOST_PILEUP_MAX_LEN={env!r}: expected a plain "
+                f"integer position count (e.g. 8388608)") from None
+    return (1 << 23) if native_tail else HOST_PILEUP_MAX_LEN
+
+
 class HostPileupAccumulator:
     """Host-side counts accumulation: ship the count tensor, not the reads.
 
