@@ -456,7 +456,8 @@ class JaxBackend:
         overflow_sums = stats.aligned_bases > np.iinfo(np.int32).max
         thr_enc_np = encode_thresholds(cfg.thresholds)
         offsets32 = layout.offsets.astype(np.int32)
-        n_thresholds = len(cfg.thresholds)
+        out = None               # packed tail fetch; stays None when the
+        n_thresholds = len(cfg.thresholds)  # native link-free tail runs
         total_len = layout.total_len
         n_contigs = len(layout.names)
         if isinstance(acc, HostPileupAccumulator):
@@ -484,8 +485,14 @@ class JaxBackend:
             # touch counts now: the upload (cached in the accumulator)
             # starts here and overlaps the host-side insertion grouping
             # below.  Device accumulators are excluded — their counts
-            # property is an uncached slice.
-            _ = acc.counts
+            # property is an uncached slice.  Skipped when the native
+            # link-free tail will serve instead: it reads counts_host()
+            # directly and the dtype-narrowed copy + device_put would be
+            # pure wasted memory traffic.
+            if not ((acc.tail_device is not None
+                     or jax.default_backend() == "cpu")
+                    and _native_tail_possible(cfg)):
+                _ = acc.counts
         tail_dev = getattr(acc, "tail_device", None)
 
         def put(x):
@@ -622,15 +629,21 @@ class JaxBackend:
                     out, n_thresholds, total_len, eplan.kp, cp, n_contigs,
                     k, out_enc=out_enc)
                 stats.extra["insertion_kernel"] = "pallas"
-            elif tail_dev is not None and enc_mode == "auto" \
+            elif link_free and enc_mode == "auto" \
                     and (native_tail := self._native_vote(
                         acc, cfg, layout)) is not None:
-                # cpu-routed tail with the C++ vote: the position vote and
+                # link-free tail with the C++ vote: cpu-routed host
+                # counts, OR any accumulator when the default backend is
+                # already the local cpu (counts_host() is then a host
+                # memcpy and the fused XLA vote — ~5 M pos/s/thread —
+                # would be the bottleneck; the 40 Mbp config measured
+                # 28 s there vs ~1.3 s native).  The position vote and
                 # coverage run at memory speed (native/decoder.cpp
-                # s2c_vote); only the K-small insertion table + vote stay
-                # on the XLA CPU backend.  A forced S2C_TAIL_ENCODING
-                # explicitly asks for the fused wire path, so it skips
-                # this branch (tests exercise those encodings that way).
+                # s2c_vote); only the K-small insertion table + vote
+                # stay on the XLA CPU backend.  A forced
+                # S2C_TAIL_ENCODING explicitly asks for the fused wire
+                # path, so it skips this branch (tests exercise those
+                # encodings that way).
                 syms, cov_np, contig_sums = native_tail
                 sk, ncp = padded_sites(kp)
                 site_cov_p = np.where(
@@ -662,7 +675,7 @@ class JaxBackend:
                 contig_sums, _ = acc.tail_stats(
                     offsets32, np.zeros(0, dtype=np.int32))
                 syms = acc.vote(thr_enc_np, cfg.min_depth)
-            elif tail_dev is not None and enc_mode == "auto" \
+            elif link_free and enc_mode == "auto" \
                     and (native_tail := self._native_vote(
                         acc, cfg, layout)) is not None:
                 syms, _cov_np, contig_sums = native_tail
@@ -699,10 +712,12 @@ class JaxBackend:
                 syms.nbytes + (ins_syms.nbytes if ins_syms is not None
                                else 0))
         else:
-            # a cpu-routed tail never crosses the link: keep the wire
-            # accounting symmetric with the suppressed h2d side
+            # a link-free tail never crosses the link: keep the wire
+            # accounting symmetric with the suppressed h2d side.  The
+            # native tail fetches no packed buffer at all (out stays
+            # None).
             stats.extra["d2h_bytes"] = \
-                0 if tail_dev is not None else int(out.nbytes)
+                0 if (link_free or out is None) else int(out.nbytes)
         if getattr(acc, "strategy_used", None):
             # refresh: the host-counts path records its wire dtype at upload
             stats.extra["pileup"] = dict(acc.strategy_used)

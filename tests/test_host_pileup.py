@@ -105,7 +105,7 @@ def _run(text, backend, cfg):
     return {n: render_file(r, 0) for n, r in res.fastas.items()}, res.stats
 
 
-def test_backend_host_pileup_byte_identical():
+def test_backend_host_pileup_byte_identical(monkeypatch):
     text = simulate(SimSpec(n_contigs=5, contig_len=180, n_reads=600,
                             read_len=40, ins_read_rate=0.15,
                             del_read_rate=0.15, seed=43))
@@ -116,7 +116,14 @@ def test_backend_host_pileup_byte_identical():
     out_host, st = _run(text, JaxBackend(), cfg_h)
     assert out_host == out_cpu
     assert st.extra["pileup"]["host"] > 0
-    assert "host_wire_dtype" in st.extra["pileup"]
+
+    # wire-dtype narrowing: only observable on the fused wire path —
+    # the native link-free tail ships nothing, so pin the tail to the
+    # default device for this check
+    monkeypatch.setenv("S2C_TAIL_DEVICE", "default")
+    out_wire, st2 = _run(text, JaxBackend(), cfg_h)
+    assert out_wire == out_cpu
+    assert "host_wire_dtype" in st2.extra["pileup"]
 
 
 def test_auto_picks_host_below_threshold():
@@ -225,6 +232,40 @@ def test_packed5_output_byte_identical(monkeypatch):
                for f in out_cpu.values()
                for line in f.split("\n") if not line.startswith(">")
                for ch in line), "fixture produced no lowercase calls"
+
+
+def test_tail_routing_matrix(monkeypatch):
+    """The placement gates must agree with each other: a condition that
+    disables the native cpu tail (explicit pallas kernel, forced wire
+    encoding) must also stop the host-pileup gate from widening on the
+    native tail's economics — otherwise counts accumulate host-side and
+    then ship over the link (round-3 review finding)."""
+    from sam2consensus_tpu.backends.jax_backend import _native_tail_possible
+    from sam2consensus_tpu.ops.pileup import host_pileup_max_len
+
+    monkeypatch.delenv("S2C_TAIL_ENCODING", raising=False)
+    monkeypatch.delenv("S2C_TAIL_DEVICE", raising=False)
+    cfg_auto = RunConfig(prefix="t", thresholds=[0.25], shards=1)
+    cfg_pallas = RunConfig(prefix="t", thresholds=[0.25], shards=1,
+                           ins_kernel="pallas")
+    from sam2consensus_tpu import native
+    if native.load() is None:
+        assert not _native_tail_possible(cfg_auto)
+        return
+    assert _native_tail_possible(cfg_auto)
+    wide = host_pileup_max_len(_native_tail_possible(cfg_auto))
+    assert wide == (1 << 23)
+    # explicit pallas keeps the device tail -> narrow gate
+    assert not _native_tail_possible(cfg_pallas)
+    assert host_pileup_max_len(
+        _native_tail_possible(cfg_pallas)) == (1 << 21)
+    # forced wire encoding runs the fused XLA path -> narrow gate
+    monkeypatch.setenv("S2C_TAIL_ENCODING", "packed5")
+    assert not _native_tail_possible(cfg_auto)
+    monkeypatch.delenv("S2C_TAIL_ENCODING")
+    # forced device tail -> narrow gate
+    monkeypatch.setenv("S2C_TAIL_DEVICE", "default")
+    assert not _native_tail_possible(cfg_auto)
 
 
 def test_sparse_output_tail_pallas_byte_identical(monkeypatch):
