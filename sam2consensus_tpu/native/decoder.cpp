@@ -34,6 +34,10 @@
 #include <cstring>
 #include <vector>
 
+#ifdef __AVX512BW__
+#include <immintrin.h>
+#endif
+
 namespace {
 
 constexpr unsigned char kPad = 255;   // == encoder PAD_CODE
@@ -226,6 +230,28 @@ extern "C" long s2c_decode(
     int nf = 0;
     long p = ls;
     fs[0] = p;
+#ifdef __AVX512BW__
+    // the first 9 tabs of a SAM line sit within the first few dozen
+    // bytes (QNAME..MAPQ are short); one masked 64-byte compare finds
+    // them all where per-field memchr paid call overhead on ~5-byte
+    // spans.  Semantically identical to the memchr loop.
+    while (nf < 10 && p < line_end) {
+      long span = line_end - p;
+      if (span > 64) span = 64;
+      const __mmask64 lm =
+          (span == 64) ? ~0ULL : ((1ULL << span) - 1);
+      __mmask64 m = _mm512_mask_cmpeq_epi8_mask(
+          lm, _mm512_maskz_loadu_epi8(lm, text + p),
+          _mm512_set1_epi8('\t'));
+      while (m && nf < 10) {
+        const int off = __builtin_ctzll(m);
+        fe[nf++] = p + off;
+        fs[nf] = p + off + 1;
+        m &= m - 1;
+      }
+      p += span;
+    }
+#else
     while (nf < 10) {
       const char* tab = static_cast<const char*>(
           memchr(text + p, '\t', line_end - p));
@@ -234,6 +260,7 @@ extern "C" long s2c_decode(
       p = (tab - text) + 1;
       fs[nf] = p;
     }
+#endif
     if (nf < 10) fe[nf++] = line_end;
 
     if (nf < 6) {  // python: line.split("\t")[5] -> IndexError
