@@ -172,3 +172,65 @@ def test_exact_cutoff_matches_float64_oracle(t, covs):
     want = np.minimum(np.ceil(np.float64(t) * cov.astype(np.float64)),
                       2 ** 31 - 1).astype(np.int64)
     np.testing.assert_array_equal(got.astype(np.int64), want)
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    codes=st.lists(st.integers(min_value=0, max_value=31),
+                   min_size=1, max_size=300),
+    n_thr=st.integers(min_value=1, max_value=3))
+def test_packed5_roundtrip(codes, n_thr):
+    """Device 5-bit plane packing -> host expansion is the identity over
+    every code value and any (length, threshold-count) shape, including
+    odd lengths and non-multiple-of-8 tails."""
+    import jax.numpy as jnp
+
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+    from sam2consensus_tpu.constants import SYM32_ASCII
+    from sam2consensus_tpu.ops.fused import _pack5_planes
+
+    code5 = np.asarray(codes, dtype=np.uint8)[None, :].repeat(n_thr, 0)
+    # distinct per-threshold rows: shift each row's codes mod 32
+    code5 = (code5 + np.arange(n_thr, dtype=np.uint8)[:, None]) % 32
+    nibs, hbits = _pack5_planes(jnp.asarray(code5))
+    buf = np.concatenate([np.asarray(nibs).reshape(-1),
+                          np.asarray(hbits).reshape(-1),
+                          np.zeros(8, np.uint8)])
+    syms, used = JaxBackend._expand_packed5(buf, n_thr, len(codes))
+    want = SYM32_ASCII[code5]
+    np.testing.assert_array_equal(syms, want)
+    assert used == n_thr * ((len(codes) + 1) // 2 + (len(codes) + 7) // 8)
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    counts=st.lists(
+        st.lists(st.integers(min_value=0, max_value=5000),
+                 min_size=6, max_size=6),
+        min_size=1, max_size=200),
+    t=st.floats(min_value=1e-9, max_value=1.5, allow_nan=False,
+                allow_infinity=False),
+    min_depth=st.sampled_from([0, 1, 2, 7]))
+def test_native_vote_matches_device_vote(counts, t, min_depth):
+    """The C++ tail vote == the device vote over arbitrary count tensors,
+    thresholds and min_depth (both pinned to the oracle's greedy walk
+    elsewhere; this pins them to each other under hypothesis).  Counts
+    pad to a fixed length so the jitted device vote compiles once per
+    min_depth instead of once per example (pad rows have cov 0 -> the
+    sentinel on both sides)."""
+    import jax.numpy as jnp
+
+    from sam2consensus_tpu import native
+    from sam2consensus_tpu.ops.cutoff import encode_thresholds
+    from sam2consensus_tpu.ops.vote import (vote_positions,
+                                            vote_positions_native)
+
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    arr = np.zeros((256, 6), dtype=np.int32)
+    arr[:len(counts)] = np.asarray(counts, dtype=np.int32)
+    got = vote_positions_native(arr, [t], min_depth)
+    want_syms, want_cov = vote_positions(
+        jnp.asarray(arr), jnp.asarray(encode_thresholds([t])), min_depth)
+    np.testing.assert_array_equal(got[0], np.asarray(want_syms))
+    np.testing.assert_array_equal(got[1], np.asarray(want_cov))
