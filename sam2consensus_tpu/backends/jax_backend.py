@@ -108,6 +108,15 @@ def _tail_cpu_wins(total_len: int, n_thresholds: int,
     return cpu_sec < chip_sec
 
 
+def _resolve_decode_threads(cfg) -> int:
+    """--decode-threads with 0 = auto (up to 4 cores); one policy shared
+    by the fused decode and the native vote tail."""
+    threads = getattr(cfg, "decode_threads", 1)
+    if threads == 0:
+        threads = min(4, os.cpu_count() or 1)
+    return max(1, threads)
+
+
 def _native_tail_possible(cfg) -> bool:
     """True when a cpu-routed tail would actually run the native C++
     vote: the library loads and nothing forces the tail elsewhere — a
@@ -777,7 +786,8 @@ class JaxBackend:
         from ..ops.vote import vote_positions_native
 
         nat = vote_positions_native(acc.counts_host(), cfg.thresholds,
-                                    cfg.min_depth)
+                                    cfg.min_depth,
+                                    threads=_resolve_decode_threads(cfg))
         if nat is None:
             return None
         syms, cov = nat
@@ -930,9 +940,7 @@ class JaxBackend:
                 # so batches can be re-validated.
                 fuse = (isinstance(acc, HostPileupAccumulator)
                         and not cfg.paranoid)
-                threads = getattr(cfg, "decode_threads", 1)
-                if threads == 0:
-                    threads = min(4, os.cpu_count() or 1)
+                threads = _resolve_decode_threads(cfg)
                 if fuse and threads > 1 and not cfg.checkpoint_dir:
                     # multi-core hosts: parallel fused decode (per-worker
                     # count tensors summed at the end; checkpointing

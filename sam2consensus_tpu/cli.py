@@ -95,10 +95,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default) or the Pallas segmented-reduce kernel")
     p.add_argument("--decode-threads", dest="decode_threads", type=int,
                    default=1,
-                   help="fused host-pileup decode workers (multi-core "
-                        "hosts; 0 = auto, up to 4). Engages on the "
-                        "host-counts strategy without --checkpoint-dir; "
-                        "per-worker count tensors sum exactly at the end")
+                   help="host worker threads (multi-core hosts; 0 = auto, "
+                        "up to 4): parallel fused host-pileup decode "
+                        "(host-counts strategy without --checkpoint-dir; "
+                        "per-worker count tensors sum exactly at the end) "
+                        "AND the native C++ tail vote's position ranges")
     p.add_argument("--decoder", choices=["auto", "native", "py"],
                    default="auto",
                    help="host SAM decode path for the jax backend: the C++ "

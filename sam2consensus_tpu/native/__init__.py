@@ -26,7 +26,8 @@ _lib = None
 _lib_err: Optional[str] = None
 
 
-_FLAGS = ["-O3", "-march=native", "-std=c++17", "-shared", "-fPIC"]
+_FLAGS = ["-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+          "-pthread"]
 
 
 def _cpu_fingerprint() -> bytes:
@@ -111,6 +112,7 @@ def load() -> Optional[ctypes.CDLL]:
         f64p, ctypes.c_long, ctypes.c_long,    # thresholds, T, min_depth
         u8p,                                   # 64-entry mask->byte LUT
         u8p, i32p,                             # out syms [T*L], out cov [L]
+        ctypes.c_long,                         # worker threads
     ]
     _lib = lib
     return _lib

@@ -32,6 +32,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 #ifdef __AVX512BW__
@@ -760,12 +761,16 @@ extern "C" void s2c_accumulate_rows(
 // called-set-mask -> output-byte table (constants.IUPAC_MASK_LUT), so
 // symbol mapping shares one definition with the device path.  Positions
 // failing the emit gate (cov == 0 or cov < min_depth) get sentinel 0.
-extern "C" void s2c_vote(
-    const int32_t* counts /* [L * 6] */, int64_t L,
-    const double* thresholds, long T, long min_depth,
-    const unsigned char* lut64,
-    unsigned char* out_syms /* [T * L] */, int32_t* out_cov /* [L] */) {
-  for (int64_t p = 0; p < L; ++p) {
+namespace {
+
+// one position range of the vote; [lo, hi) is an independent slice, so
+// multi-core hosts split the genome across threads (out_syms rows are
+// strided by the FULL length)
+void vote_range(const int32_t* counts, int64_t L, int64_t lo, int64_t hi,
+                const double* thresholds, long T, long min_depth,
+                const unsigned char* lut64, unsigned char* out_syms,
+                int32_t* out_cov) {
+  for (int64_t p = lo; p < hi; ++p) {
     const int32_t* c = counts + p * 6;
     const int32_t cov =
         c[0] + c[1] + c[2] + c[3] + c[4] + c[5];
@@ -794,4 +799,32 @@ extern "C" void s2c_vote(
       out_syms[t * L + p] = lut64[mask];
     }
   }
+}
+
+}  // namespace
+
+extern "C" void s2c_vote(
+    const int32_t* counts /* [L * 6] */, int64_t L,
+    const double* thresholds, long T, long min_depth,
+    const unsigned char* lut64,
+    unsigned char* out_syms /* [T * L] */, int32_t* out_cov /* [L] */,
+    long n_threads) {
+  if (n_threads < 2 || L < (1 << 20)) {
+    vote_range(counts, L, 0, L, thresholds, T, min_depth, lut64,
+               out_syms, out_cov);
+    return;
+  }
+  // position ranges are independent: one thread per contiguous slice
+  // (multi-core hosts scale the tail the way --decode-threads scales
+  // decode; below 1M positions the spawn overhead isn't worth it)
+  std::vector<std::thread> workers;
+  const int64_t step = (L + n_threads - 1) / n_threads;
+  for (long w = 0; w < n_threads; ++w) {
+    const int64_t lo = w * step;
+    const int64_t hi = (lo + step < L) ? lo + step : L;
+    if (lo >= hi) break;
+    workers.emplace_back(vote_range, counts, L, lo, hi, thresholds, T,
+                         min_depth, lut64, out_syms, out_cov);
+  }
+  for (auto& th : workers) th.join();
 }

@@ -148,15 +148,17 @@ vote_positions = partial(jax.jit, static_argnames=("min_depth",))(vote_block)
 
 
 def vote_positions_native(counts: np.ndarray, thresholds: Sequence[float],
-                          min_depth: int):
+                          min_depth: int, threads: int = 1):
     """C++ vote over host-resident counts (``native/decoder.cpp
     s2c_vote``), or None when the native library is unavailable.
 
     Same closed form and the same 64-entry mask LUT as the device vote;
     the float64 ``ceil(t * cov)`` cutoff is computed directly (the host
     has float64 — only the chip needed ops/cutoff.py's limb arithmetic).
-    Used by the backend for cpu-routed tails, where the XLA CPU vote's
-    ~5 M positions/s/threshold was the measured bottleneck.
+    Used by the backend for link-free tails, where the XLA CPU vote's
+    ~5 M positions/s/threshold was the measured bottleneck.  Position
+    ranges split across ``threads`` workers on multi-core hosts (the
+    ranges are independent; below 1M positions the C side stays serial).
 
     Returns (syms uint8 [T, L] with FILL sentinel, cov int32 [L]).
     """
@@ -172,5 +174,5 @@ def vote_positions_native(counts: np.ndarray, thresholds: Sequence[float],
     cov = np.empty(length, np.int32)
     lib.s2c_vote(counts.reshape(-1), length,
                  np.asarray(thresholds, np.float64), n_thr, min_depth,
-                 IUPAC_MASK_LUT, syms, cov)
+                 IUPAC_MASK_LUT, syms, cov, max(1, threads))
     return syms.reshape(n_thr, length), cov

@@ -261,6 +261,25 @@ def test_native_vote_differential():
                 assert syms_n[t, p] == IUPAC_MASK_LUT[mask], (p, t)
 
 
+def test_native_vote_threaded_matches_serial():
+    """The multi-threaded vote (position ranges across workers) must be
+    bit-identical to serial at a length that actually engages the
+    threaded branch (>= 2^20 positions; below that the C side stays
+    serial and this test would assert nothing)."""
+    from sam2consensus_tpu.ops.vote import vote_positions_native
+
+    rng = np.random.default_rng(7)
+    length = (1 << 20) + 12_345          # odd tail -> uneven last slice
+    counts = rng.integers(0, 120, size=(length, 6)).astype(np.int32)
+    counts[rng.random(length) < 0.2] = 0
+    serial = vote_positions_native(counts, [0.25, 0.75], 1, threads=1)
+    for n in (2, 3, 8):
+        threaded = vote_positions_native(counts, [0.25, 0.75], 1,
+                                         threads=n)
+        np.testing.assert_array_equal(serial[0], threaded[0])
+        np.testing.assert_array_equal(serial[1], threaded[1])
+
+
 def test_fused_counts_rollback_paths():
     """Inline counting in the fused decode pass (counts incremented while
     cells are translated) must roll back exactly on its two abort paths:
