@@ -236,8 +236,10 @@ def _jax_row(name, path, cfg_kwargs, overrides, cpu_time, cpu_out):
     from sam2consensus_tpu.backends.jax_backend import JaxBackend
     from sam2consensus_tpu.config import RunConfig
 
-    vcfg = RunConfig(prefix="bench", **{"shards": 1, **cfg_kwargs,
-                                        **overrides})
+    # decode_threads 0 = auto: engages the parallel fused decode and the
+    # threaded native vote on multi-core hosts (no-op on one core)
+    vcfg = RunConfig(prefix="bench", **{"shards": 1, "decode_threads": 0,
+                                        **cfg_kwargs, **overrides})
     backend = JaxBackend()
     # warm-up pays the jit compiles for this genome length / buckets
     _s, _t, _o = run_once(backend, path, vcfg, binary=True)
