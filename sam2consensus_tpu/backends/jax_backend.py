@@ -648,8 +648,9 @@ class JaxBackend:
                 # would be the bottleneck; the 40 Mbp config measured
                 # 28 s there vs ~1.3 s native).  The position vote and
                 # coverage run at memory speed (native/decoder.cpp
-                # s2c_vote); only the K-small insertion table + vote
-                # stay on the XLA CPU backend.  A forced
+                # s2c_vote); the insertion table + vote run host-side
+                # too (s2c_ins_table / s2c_ins_vote via
+                # ops.insertions.insertion_tail_host).  A forced
                 # S2C_TAIL_ENCODING explicitly asks for the fused wire
                 # path, so it skips this branch (tests exercise those
                 # encodings that way).
@@ -659,12 +660,14 @@ class JaxBackend:
                     sk >= 0, cov_np[np.maximum(sk, 0)], 0).astype(np.int32)
                 site_cov = site_cov_p[:k].astype(np.int64)
                 ev_key, ev_col, ev_code = padded_events(kp)
-                table = build_insertion_table(
-                    put(np.zeros((kp, cp, 6), dtype=np.int32)),
-                    put(ev_key), put(ev_col), put(ev_code))
-                ins_syms = np.asarray(vote_insertions(
-                    table, put(site_cov_p), put(ncp),
-                    thr_enc))[:, :k, :]                       # [T, K, Cp]
+                # host twins keep the whole tail off XLA: the CPU-backend
+                # scatter + vote dispatches measured ~125 ms warm at
+                # north-star scale vs ~5 ms native (PERF.md round 4)
+                from ..ops.insertions import insertion_tail_host
+
+                ins_syms = insertion_tail_host(
+                    kp, cp, ev_key, ev_col, ev_code, site_cov_p, ncp,
+                    cfg.thresholds, k)                        # [T, K, Cp]
             else:
                 sk, ncp = padded_sites(kp)
                 ev_key, ev_col, ev_code = padded_events(kp)
@@ -765,6 +768,11 @@ class JaxBackend:
                           base_mapped, base_skipped, sources) -> None:
         from ..utils import checkpoint as ckpt
 
+        # fused decode keeps in-flight counts in a uint8 shadow; a
+        # checkpoint must snapshot the merged int32 pileup
+        merge = getattr(encoder, "merge_shadow", None)
+        if merge is not None:
+            merge()
         ckpt.save(cfg.checkpoint_dir, ckpt.CheckpointState(
             counts=acc.counts_host(),
             lines_consumed=stream.n_lines,

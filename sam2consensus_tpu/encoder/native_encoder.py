@@ -67,10 +67,14 @@ class NativeReadEncoder:
         self.width = width
         self.on_lines = on_lines
         self.on_bytes = on_bytes
-        # fused host pileup: the C decoder increments this [L, 6] int32
-        # tensor per committed row (single pass, no slab re-walk — the
-        # one-core-host fast path); rows become scratch and batches carry
-        # only counters.  Python-fallback reads accumulate via numpy.
+        # fused host pileup: the C decoder counts each committed row into
+        # a uint8 shadow tensor (4x fewer cache lines than int32 on the
+        # hot random-access increments; SIMD one-hot adds where the ISA
+        # allows) with saturation wraps banked as +256 in a lazily-paged
+        # int32 tensor; ``merge_shadow`` folds both into ``accumulate_into``
+        # at stream end / checkpoint boundaries.  Rows become scratch and
+        # batches carry only counters.  Python-fallback reads accumulate
+        # into ``accumulate_into`` directly via numpy.
         self._acc = accumulate_into
         if accumulate_into is not None:
             if accumulate_into.shape != (layout.total_len, 6) \
@@ -80,8 +84,14 @@ class NativeReadEncoder:
                                  "int32 [total_len, 6]")
             self._acc_flat = accumulate_into.reshape(-1)
             self._acc_len = layout.total_len
+            # np.zeros -> calloc: the overflow bank's pages only material-
+            # ize where depth actually passes 255
+            self._acc_u8 = np.zeros(layout.total_len * 6, dtype=np.uint8)
+            self._acc_ovf = np.zeros(layout.total_len * 6, dtype=np.int32)
         else:
             self._acc_flat = np.zeros(6, dtype=np.int32)   # dummy, len 0
+            self._acc_u8 = np.zeros(6, dtype=np.uint8)
+            self._acc_ovf = np.zeros(6, dtype=np.int32)
             self._acc_len = 0
         # python twin for overflow/error-replay fallback; shares counters
         # and the insertion store so fallback reads land in the same place
@@ -157,7 +167,7 @@ class NativeReadEncoder:
                     ich, chars_cap,
                     ovf, ovf_cap,
                     out,
-                    self._acc_flat, self._acc_len)
+                    self._acc_u8, self._acc_ovf, self._acc_len)
 
                 (n_rows, n_reads, n_skipped, consumed, n_ins, n_chars,
                  status, _err_off, n_events, n_lines, n_overflow,
@@ -224,9 +234,25 @@ class NativeReadEncoder:
                 if batch is not None:
                     yield batch
 
+        self.merge_shadow()
         batch = self._flush()
         if batch is not None:
             yield batch
+
+    def merge_shadow(self) -> None:
+        """Fold the C decoder's uint8 shadow counts + overflow bank into
+        the int32 pileup, then reset both (idempotent; exact — cell + bank
+        always equals the true count).  Runs automatically at stream end;
+        the backend also calls it before snapshotting a checkpoint, whose
+        contract is that ``accumulate_into`` reflects every committed
+        batch."""
+        if self._acc is None:
+            return
+        np.add(self._acc_flat, self._acc_u8[:self._acc_len * 6],
+               out=self._acc_flat)
+        np.add(self._acc_flat, self._acc_ovf, out=self._acc_flat)
+        self._acc_u8[:] = 0
+        self._acc_ovf[:] = 0
 
     # ------------------------------------------------------------------
     def _new_slab(self) -> None:

@@ -97,7 +97,8 @@ def load() -> Optional[ctypes.CDLL]:
         u8p, ctypes.c_long,                    # ins_chars, cap
         i64p, ctypes.c_long,                   # overflow_off, cap
         i64p,                                  # out stats
-        i32p, ctypes.c_int64,                  # fused pileup counts, len
+        u8p, i32p, ctypes.c_int64,             # fused pileup u8 shadow,
+                                               #   +256 overflow bank, len
     ]
     lib.s2c_accumulate_rows.restype = None
     lib.s2c_accumulate_rows.argtypes = [
@@ -106,6 +107,18 @@ def load() -> Optional[ctypes.CDLL]:
         i32p, ctypes.c_long,                   # counts [L*6], total_len
     ]
     f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    lib.s2c_ins_table.restype = None
+    lib.s2c_ins_table.argtypes = [
+        i32p, i32p, i32p, ctypes.c_long,       # ev key/col/code, n_events
+        i32p, ctypes.c_long,                   # table [K*C*6], C
+    ]
+    lib.s2c_ins_vote.restype = None
+    lib.s2c_ins_vote.argtypes = [
+        i32p, ctypes.c_long, ctypes.c_long,    # table, K, C
+        i32p, i32p,                            # site_cov, n_cols
+        f64p, ctypes.c_long,                   # thresholds, T
+        u8p, u8p,                              # lut64, out [T*K*C]
+    ]
     lib.s2c_vote.restype = None
     lib.s2c_vote.argtypes = [
         i32p, ctypes.c_int64,                  # counts [L*6], L

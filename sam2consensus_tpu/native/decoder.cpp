@@ -39,6 +39,10 @@
 #include <immintrin.h>
 #endif
 
+#if defined(__AVX512BW__) && defined(__AVX512VBMI__)
+#define S2C_SIMD 1
+#endif
+
 namespace {
 
 constexpr unsigned char kPad = 255;   // == encoder PAD_CODE
@@ -57,6 +61,140 @@ struct BaseLut {
   }
 };
 const BaseLut kLut;
+
+// Saturating uint8 count cell: the pileup accumulates into a uint8 shadow
+// tensor (6 B/position instead of 24 — 4x fewer cache lines on the hot
+// random-access increments) with wraps banked as +256 in a lazily-touched
+// int32 tensor of the same shape.  Exact: cell + ovf == true count; the
+// Python wrapper merges both into the int32 pileup at stream end
+// (encoder/native_encoder.py merge_shadow).
+inline void u8_inc(unsigned char* cell, int32_t* ovf_cell) {
+  const unsigned char v = *cell;
+  if (__builtin_expect(v == 255, 0)) {
+    *cell = 0;
+    *ovf_cell += 256;
+  } else {
+    *cell = v + 1;
+  }
+}
+
+#ifdef S2C_SIMD
+// AVX-512VBMI tables for the vectorized base translation and the one-hot
+// count expansion.  Translation: ascii & 63 is collision-free over the
+// 6-symbol alphabet {-,A,C,G,N,T}, so one vpermb maps 64 chars to codes
+// and a second vpermb reconstructs the expected ascii for validation —
+// any byte whose reconstruction mismatches is out-of-alphabet (code 255),
+// replicating the scalar LUT's 255 marker.  Counting: for 10 consecutive
+// positions (60 cells of the [L, 6] uint8 tensor), expand codes with
+// vpermb (j -> code[j/6]), compare against the j%6 lane pattern, and
+// masked-add the resulting 0/1 bytes onto the cells — the host-SIMD twin
+// of the device MXU's one-hot matmul pileup (ops/mxu_pileup.py).
+struct SimdTables {
+  __m512i code, chr, expand, rem;
+  SimdTables() {
+    alignas(64) unsigned char c[64], a[64], e[64], r[64];
+    memset(c, 255, 64);
+    memset(a, 0, 64);
+    const char* bases = "-ACGNT";
+    for (int i = 0; i < 6; ++i) {
+      const unsigned char ch = static_cast<unsigned char>(bases[i]);
+      c[ch & 63] = static_cast<unsigned char>(i);
+      a[ch & 63] = ch;
+    }
+    for (int j = 0; j < 64; ++j) {
+      e[j] = static_cast<unsigned char>(j / 6);
+      r[j] = static_cast<unsigned char>(j % 6);
+    }
+    code = _mm512_load_si512(c);
+    chr = _mm512_load_si512(a);
+    expand = _mm512_load_si512(e);
+    rem = _mm512_load_si512(r);
+  }
+};
+const SimdTables kSimd;
+
+// Translate n ascii bases into codes; accumulates the bad-base flag and
+// the gap ('-') count exactly like the scalar loop.
+inline void simd_translate(const char* src, unsigned char* dst, long n,
+                           bool& bad, long& gaps) {
+  long k = 0;
+  while (k < n) {
+    const long rem_n = n - k;
+    const __mmask64 lm =
+        (rem_n >= 64) ? ~0ULL : ((1ULL << rem_n) - 1);
+    const __m512i s = _mm512_maskz_loadu_epi8(lm, src + k);
+    const __m512i idx = _mm512_and_si512(s, _mm512_set1_epi8(63));
+    __m512i code = _mm512_permutexvar_epi8(idx, kSimd.code);
+    const __m512i expect = _mm512_permutexvar_epi8(idx, kSimd.chr);
+    const __mmask64 valid = _mm512_cmpeq_epi8_mask(expect, s);
+    code = _mm512_mask_blend_epi8(valid, _mm512_set1_epi8((char)255),
+                                  code);
+    _mm512_mask_storeu_epi8(dst + k, lm, code);
+    bad |= ((valid & lm) != lm);
+    gaps += __builtin_popcountll(
+        _mm512_mask_cmpeq_epi8_mask(lm, code, _mm512_setzero_si512()));
+    k += 64;
+  }
+}
+
+// Validation-only walk for insertion motifs (no code store needed).
+inline bool simd_validate(const char* src, long n) {
+  bool bad = false;
+  long k = 0;
+  while (k < n) {
+    const long rem_n = n - k;
+    const __mmask64 lm =
+        (rem_n >= 64) ? ~0ULL : ((1ULL << rem_n) - 1);
+    const __m512i s = _mm512_maskz_loadu_epi8(lm, src + k);
+    const __m512i idx = _mm512_and_si512(s, _mm512_set1_epi8(63));
+    const __m512i expect = _mm512_permutexvar_epi8(idx, kSimd.chr);
+    bad |= ((_mm512_cmpeq_epi8_mask(expect, s) & lm) != lm);
+    k += 64;
+  }
+  return bad;
+}
+#endif  // S2C_SIMD
+
+// Accumulate one translated row (codes[0..span), PAD cells skipped) into
+// the uint8 shadow pileup at genome position gstart.  Bounds are the
+// caller's contract (fast path: 0 <= gstart, gstart + span <= total).
+inline void count_row_u8(const unsigned char* codes, long span,
+                         int64_t gstart, unsigned char* u8, int32_t* ovf) {
+  unsigned char* ap = u8 + gstart * 6;
+#ifdef S2C_SIMD
+  for (long k0 = 0; k0 < span; k0 += 10) {
+    long npos = span - k0;
+    if (npos > 10) npos = 10;
+    const __mmask64 mc = (1ULL << (npos * 6)) - 1;
+    const __m512i cvec = _mm512_maskz_loadu_epi8(
+        (__mmask64)((1ULL << npos) - 1), codes + k0);
+    const __m512i ce = _mm512_permutexvar_epi8(kSimd.expand, cvec);
+    __mmask64 inc = _mm512_mask_cmpeq_epi8_mask(mc, ce, kSimd.rem);
+    unsigned char* cp = ap + k0 * 6;
+    __m512i cells = _mm512_maskz_loadu_epi8(mc, cp);
+    const __mmask64 sat = _mm512_mask_cmpeq_epi8_mask(
+        inc, cells, _mm512_set1_epi8((char)255));
+    if (__builtin_expect(sat != 0, 0)) {
+      unsigned long long s = sat;
+      while (s) {
+        const int j = __builtin_ctzll(s);
+        cp[j] = 0;
+        ovf[(gstart + k0) * 6 + j] += 256;
+        s &= s - 1;
+      }
+      inc &= ~sat;
+      cells = _mm512_maskz_loadu_epi8(mc, cp);
+    }
+    cells = _mm512_mask_add_epi8(cells, inc, cells, _mm512_set1_epi8(1));
+    _mm512_mask_storeu_epi8(cp, mc, cells);
+  }
+#else
+  for (long k = 0; k < span; ++k) {
+    const unsigned char c = codes[k];
+    if (c < 6) u8_inc(ap + k * 6 + c, ovf + (gstart + k) * 6 + c);
+  }
+#endif
+}
 
 inline bool is_ws(char c) {
   // ASCII subset of Python str.split() whitespace (input is ascii-decoded)
@@ -189,12 +327,14 @@ extern "C" long s2c_decode(
     int64_t* overflow_off, long overflow_cap,
     int64_t* out,
     // fused host pileup (ops/pileup.py HostPileupAccumulator): when
-    // acc_total_len > 0, every committed row's cells are accumulated into
-    // acc_counts [acc_total_len * 6] right here, while the translated row
-    // is still in cache — the single-pass path that replaces the separate
-    // slab walk on one-core hosts.  Rows are still written to the slab
-    // (the wrapper treats it as scratch and resets its fill).
-    int32_t* acc_counts, int64_t acc_total_len) {
+    // acc_total_len > 0, every committed row is accumulated — AFTER its
+    // bad-base / maxdel fate is settled, so no rollback paths exist —
+    // into the uint8 shadow tensor acc_u8 [acc_total_len * 6] with
+    // saturation wraps banked in acc_ovf (+256 per wrap; see u8_inc /
+    // count_row_u8).  The wrapper merges shadow + bank into the int32
+    // pileup at stream end.  Rows are still written to the slab (the
+    // wrapper treats it as scratch and resets its fill).
+    unsigned char* acc_u8, int32_t* acc_ovf, int64_t acc_total_len) {
   NameTable table;
   table.build(names, name_off, n_contigs);
 
@@ -428,14 +568,6 @@ extern "C" long s2c_decode(
         break;  // consumed stops at this line's start
       }
       unsigned char* dst = codes + static_cast<int64_t>(n_rows) * width;
-      // fused pileup: count cells while they are still in registers --
-      // bounds are guaranteed (pos >= 0, and for span > 0 structural
-      // validation pinned pos + span <= reflen; span == 0 rows have no
-      // ref cells and may carry an unvalidated pos, so don't even form
-      // the pointer), and the rare aborts below roll back
-      int32_t* arow = (acc_total_len > 0 && span > 0)
-                          ? acc_counts + (ctg_offset[ci] + pos) * 6
-                          : nullptr;
       long o = 0, rc = 0, gaps = 0, pads = 0;
       bool bad_base = false;
       long ins_base = n_ins, chars_base = n_ins_chars;
@@ -449,25 +581,17 @@ extern "C" long s2c_decode(
             if (take < 0) take = 0;
             if (take > num) take = num;
             const char* sp = text + ss + rc;
-            if (arow) {
-              int32_t* ap = arow + o * 6;
-              for (long k = 0; k < take; ++k) {
-                unsigned char code =
-                    kLut.m[static_cast<unsigned char>(sp[k])];
-                bad_base |= (code == 255);
-                gaps += (code == kGap);
-                dst[o + k] = code;
-                if (code < 6) ++ap[k * 6 + code];
-              }
-            } else {
-              for (long k = 0; k < take; ++k) {
-                unsigned char code =
-                    kLut.m[static_cast<unsigned char>(sp[k])];
-                bad_base |= (code == 255);
-                gaps += (code == kGap);
-                dst[o + k] = code;
-              }
+#ifdef S2C_SIMD
+            simd_translate(sp, dst + o, take, bad_base, gaps);
+#else
+            for (long k = 0; k < take; ++k) {
+              unsigned char code =
+                  kLut.m[static_cast<unsigned char>(sp[k])];
+              bad_base |= (code == 255);
+              gaps += (code == kGap);
+              dst[o + k] = code;
             }
+#endif
             if (num > take) {
               // reachable only for SEQ "*" reads (short-SEQ carve-out
               // above): memory safety until bad_base aborts the commit
@@ -481,10 +605,6 @@ extern "C" long s2c_decode(
           case 'D': case 'N': case 'P':
             memset(dst + o, kGap, num);
             gaps += num;
-            if (arow) {
-              int32_t* ap = arow + o * 6 + kGap;
-              for (long k = 0; k < num; ++k, ap += 6) ++*ap;
-            }
             o += num;
             break;
           case 'I': {
@@ -492,8 +612,12 @@ extern "C" long s2c_decode(
             if (take < 0) take = 0;
             if (take > num) take = num;
             const char* sp = text + ss + rc;
+#ifdef S2C_SIMD
+            bad_base |= simd_validate(sp, take);
+#else
             for (long k = 0; k < take; ++k)
               bad_base |= (kLut.m[static_cast<unsigned char>(sp[k])] == 255);
+#endif
             // commit now (capacity pre-checked); rolled back on bad_base
             ins_contig[n_ins] = static_cast<int32_t>(ci);
             ins_local[n_ins] = static_cast<int32_t>(pos + o);
@@ -512,13 +636,8 @@ extern "C" long s2c_decode(
         }
       }
       if (bad_base) {
-        // every ref cell of dst[0..span) was written (pads where SEQ ran
-        // short), and exactly the code<6 cells were counted above
-        if (arow)
-          for (long k = 0; k < span; ++k) {
-            const unsigned char cd = dst[k];
-            if (cd < 6) --arow[k * 6 + cd];
-          }
+        // nothing was counted yet (the pileup accumulates below, after
+        // the row's fate is settled): only the insertions roll back
         n_ins = ins_base;
         n_ins_chars = chars_base;
         if (strict) {
@@ -531,13 +650,8 @@ extern "C" long s2c_decode(
         continue;
       }
       if (maxdel >= 0 && gaps > maxdel) {
-        // counted inline above: retro-decrement each GAP cell as it
-        // turns into PAD (skipped but advancing)
         for (long k = 0; k < span; ++k)
-          if (dst[k] == kGap) {
-            dst[k] = kPad;
-            if (arow) --arow[k * 6 + kGap];
-          }
+          if (dst[k] == kGap) dst[k] = kPad;
         pads += gaps;
       }
       if (span > 0) {
@@ -545,6 +659,11 @@ extern "C" long s2c_decode(
         starts[n_rows] = static_cast<int32_t>(ctg_offset[ci] + pos);
         ++n_rows;
         n_events += span - pads;
+        // fused pileup: the row's final codes are still cache-hot —
+        // bounds guaranteed (pos >= 0, structural validation pinned
+        // pos + span <= reflen)
+        if (acc_total_len > 0)
+          count_row_u8(dst, span, ctg_offset[ci] + pos, acc_u8, acc_ovf);
       }
       ++n_reads;
       i = next;
@@ -693,7 +812,8 @@ extern "C" long s2c_decode(
           const int64_t gp = (k < neg)
               ? base_off + reflen + pos + k
               : base_off + (pos < 0 ? 0 : pos) + (k - neg);
-          if (gp >= 0 && gp < acc_total_len) ++acc_counts[gp * 6 + code];
+          if (gp >= 0 && gp < acc_total_len)
+            u8_inc(acc_u8 + gp * 6 + code, acc_ovf + gp * 6 + code);
         }
       }
     }
@@ -802,6 +922,61 @@ void vote_range(const int32_t* counts, int64_t L, int64_t lo, int64_t hi,
 }
 
 }  // namespace
+
+// ---------------------------------------------------------------------------
+// Insertion-table build + vote for link-free tails (the C++ twin of
+// ops/insertions.py build_insertion_table / vote_insertions — same
+// greedy semantics, measured ~10x the numpy twin and ~25x the XLA CPU
+// dispatches at north-star scale).  The caller passes the PADDED table
+// (K rows including the sacrificial pad row) but votes only the first
+// k_valid rows.
+extern "C" void s2c_ins_table(
+    const int32_t* ev_key, const int32_t* ev_col, const int32_t* ev_code,
+    long n_events, int32_t* table /* [K * C * 6], zeroed */, long C) {
+  for (long e = 0; e < n_events; ++e)
+    ++table[(static_cast<int64_t>(ev_key[e]) * C + ev_col[e]) * 6 +
+            ev_code[e]];
+}
+
+extern "C" void s2c_ins_vote(
+    const int32_t* table /* [K * C * 6] */, long K, long C,
+    const int32_t* site_cov, const int32_t* n_cols,
+    const double* thresholds, long T, const unsigned char* lut64,
+    unsigned char* out /* [T * K * C], sentinel 0 where skipped */) {
+  for (long k = 0; k < K; ++k) {
+    const int32_t cov = site_cov[k];
+    const double dcov = static_cast<double>(cov);
+    const long nc = n_cols[k];
+    for (long c = 0; c < C; ++c) {
+      const int32_t* cell = table + (k * C + c) * 6;
+      // gap-lane completion: cov - sum(all lanes); may go negative
+      // (quirk 4, sam2consensus.py:294)
+      int64_t v[6];
+      int64_t colsum = 0;
+      for (int i = 0; i < 6; ++i) colsum += cell[i];
+      v[0] = cov - colsum;
+      for (int i = 1; i < 6; ++i) v[i] = cell[i];
+      int64_t S[6];
+      for (int i = 0; i < 6; ++i) {
+        int64_t s = 0;
+        for (int j = 0; j < 6; ++j)
+          if (v[j] > v[i]) s += v[j];
+        S[i] = s;
+      }
+      const bool col_valid = c < nc;
+      for (long t = 0; t < T; ++t) {
+        const double cut = __builtin_ceil(thresholds[t] * dcov);
+        unsigned mask = 0;
+        for (int i = 0; i < 6; ++i)
+          if (v[i] != 0 && static_cast<double>(S[i]) < cut)
+            mask |= (1u << i);
+        const unsigned char sym = lut64[mask];
+        out[(t * K + k) * C + c] =
+            (!col_valid || sym == '-') ? 0 : sym;
+      }
+    }
+  }
+}
 
 extern "C" void s2c_vote(
     const int32_t* counts /* [L * 6] */, int64_t L,
