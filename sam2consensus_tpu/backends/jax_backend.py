@@ -53,12 +53,44 @@ SP_HALO = 1 << 16
 #: host memory).  The chip's vote compute is free but the link bills a
 #: dispatch round trip, the counts upload, and the output fetch; the
 #: local XLA CPU backend is wire-free but votes at a measured per-core
-#: rate.  Constants are the bench rig's (tools/tunnel_probe.py and
-#: tools/tail_crossover.py: the sweep's T=1 crossover sits at ~4M
+#: rate.  The link constants SELF-CALIBRATE at first use via a cheap
+#: cached probe of the real device (utils/linkprobe: one null-dispatch
+#: round trip + one 1 MB put, ~3 RTs once per process), so routing is
+#: correct on an un-tuned host — a TPU-VM's PCIe link (~GB/s, sub-ms RT)
+#: flips the same decisions the 40 MB/s tunnel pins host-side.  Env
+#: overrides win and skip the probe; the defaults below are the bench
+#: rig's measured numbers (tools/tunnel_probe.py), used when probing is
+#: disabled (S2C_LINK_PROBE=0) or impossible.  The cpu-side rates come
+#: from tools/tail_crossover.py (the sweep's T=1 crossover sits at ~4M
 #: positions, the T=3 crossover at ~200k — no single cell-count gate
-#: represents both).  Override via env for a different link or host.
-TAIL_RT_SEC = float(os.environ.get("S2C_TAIL_RT_MS", "65")) / 1e3
-TAIL_LINK_BPS = float(os.environ.get("S2C_TAIL_LINK_MBPS", "40")) * 1e6
+#: represents both).
+TAIL_RT_SEC_DEFAULT = 65e-3
+TAIL_LINK_BPS_DEFAULT = 40e6
+
+
+def _link_constants() -> tuple:
+    """(rt_sec, link_bps) for the placement model: env override, else
+    the cached startup probe (real accelerators only), else the bench
+    rig's defaults."""
+    rt_env = os.environ.get("S2C_TAIL_RT_MS")
+    bps_env = os.environ.get("S2C_TAIL_LINK_MBPS")
+    rt = float(rt_env) / 1e3 if rt_env else None
+    bps = float(bps_env) * 1e6 if bps_env else None
+    if (rt is None or bps is None) \
+            and os.environ.get("S2C_LINK_PROBE", "1") != "0":
+        import jax
+
+        if jax.default_backend() != "cpu":
+            from ..utils.linkprobe import probe_link
+
+            probed = probe_link()
+            if probed is not None:
+                if rt is None:
+                    rt = probed[0]
+                if bps is None:
+                    bps = probed[1]
+    return (TAIL_RT_SEC_DEFAULT if rt is None else rt,
+            TAIL_LINK_BPS_DEFAULT if bps is None else bps)
 TAIL_CPU_POS_PER_SEC = float(os.environ.get(
     "S2C_TAIL_CPU_MPOS_S", "5.2")) * 1e6
 #: the C++ vote's measured costs (native/decoder.cpp s2c_vote at L=1M:
@@ -86,10 +118,16 @@ P5_DEV_NS_PER_CHAR = float(os.environ.get("S2C_P5_DEV_NS", "22"))
 
 
 def _tail_cpu_wins(total_len: int, n_thresholds: int,
-                   upload_bytes: int, native_tail: bool) -> bool:
+                   upload_bytes: int, native_tail: bool,
+                   aligned_bases: int = 0) -> bool:
     """True when the local CPU tail beats shipping the tail to the chip.
     ``native_tail`` (from :func:`_native_tail_possible`) says which cpu
-    implementation would actually execute, so the model prices that one."""
+    implementation would actually execute, so the model prices that one.
+    The chip's fetch is priced as the CHEAPEST modeled output encoding
+    (dense / packed5 / sparse — mirroring the output-encoding gate, which
+    would pick exactly that one), so tails near the crossover are not
+    mis-routed to the cpu by a dense-only pessimistic bill (round-3
+    advisor finding)."""
     forced = os.environ.get("S2C_TAIL_DEVICE", "")
     if forced not in ("", "auto"):
         if forced not in ("cpu", "default"):
@@ -103,9 +141,40 @@ def _tail_cpu_wins(total_len: int, n_thresholds: int,
             + TAIL_NATIVE_THR_NS * (n_thresholds - 1)) * 1e-9
     else:
         cpu_sec = total_len * n_thresholds / TAIL_CPU_POS_PER_SEC
-    chip_sec = (TAIL_RT_SEC
-                + (upload_bytes + n_thresholds * total_len) / TAIL_LINK_BPS)
+    rt_sec, link_bps = _link_constants()
+    if aligned_bases > 0:
+        from ..ops import fused as _fused
+
+        sparse_cap = _fused.pad_cap(min(total_len, aligned_bases) + 1)
+    else:
+        sparse_cap = None
+    fetch = min(_fetch_costs(total_len, n_thresholds, sparse_cap,
+                             link_bps).values())
+    chip_sec = rt_sec + upload_bytes / link_bps + fetch
     return cpu_sec < chip_sec
+
+
+def _fetch_costs(total_len: int, n_thresholds: int,
+                 sparse_cap, link_bps: float) -> dict:
+    """Modeled d2h time per output encoding — THE shared pricing for the
+    output-encoding gate (which picks the cheapest key) and for
+    ``_tail_cpu_wins`` (which bills the chip with the cheapest value):
+    one source, so placement and encoding can never disagree.  Keys:
+    ``None`` dense ASCII, ``"packed5"`` 5-bit planes, ``sparse_cap``
+    (the pad_cap'd capacity, when given) emit-bitmask sparse."""
+    nbits = (total_len + 7) // 8
+    costs = {
+        None: n_thresholds * total_len / link_bps,
+        "packed5":
+            n_thresholds * ((total_len + 1) // 2 + nbits) / link_bps
+            + n_thresholds * total_len
+            * (P5_HOST_NS_PER_CHAR + P5_DEV_NS_PER_CHAR) * 1e-9,
+    }
+    if sparse_cap is not None:
+        costs[sparse_cap] = (
+            (nbits + n_thresholds * sparse_cap) / link_bps
+            + total_len * SPARSE_NS_PER_POS * 1e-9)
+    return costs
 
 
 def _resolve_decode_threads(cfg) -> int:
@@ -117,18 +186,21 @@ def _resolve_decode_threads(cfg) -> int:
     return max(1, threads)
 
 
-def _native_tail_possible(cfg) -> bool:
+def _native_tail_possible(cfg, has_insertions: bool = True) -> bool:
     """True when a cpu-routed tail would actually run the native C++
     vote: the library loads and nothing forces the tail elsewhere — a
     forced S2C_TAIL_ENCODING runs the fused XLA wire path, S2C_TAIL_DEVICE
     =default pins the chip, and an explicit pallas insertion kernel
-    keeps the device tail.  Gates both the host-pileup genome bound
-    (ops.pileup.host_pileup_max_len) and the placement model's rate."""
+    keeps the device tail (irrelevant when the run produced no insertion
+    events — pass ``has_insertions=False`` then, so a pallas request
+    doesn't forfeit the fast native vote for nothing).  Gates both the
+    host-pileup genome bound (ops.pileup.host_pileup_max_len) and the
+    placement model's rate."""
     if os.environ.get("S2C_TAIL_ENCODING", "auto") != "auto":
         return False
     if os.environ.get("S2C_TAIL_DEVICE", "") == "default":
         return False
-    if getattr(cfg, "ins_kernel", "scatter") == "pallas":
+    if has_insertions and getattr(cfg, "ins_kernel", "scatter") == "pallas":
         return False
     from .. import native
 
@@ -482,7 +554,8 @@ class JaxBackend:
             if (_tail_cpu_wins(total_len, n_thresholds,
                                total_len * NUM_SYMBOLS
                                * acc.wire_itemsize(),
-                               _native_tail_possible(cfg))
+                               _native_tail_possible(cfg),
+                               aligned_bases=stats.aligned_bases)
                     and getattr(cfg, "ins_kernel", "scatter") != "pallas"):
                 try:
                     cpus = jax.devices("cpu")
@@ -530,7 +603,6 @@ class JaxBackend:
         # a memcpy while the decode costs stay real.
         sparse_cap = fused.pad_cap(
             min(total_len, max(1, stats.aligned_bases)) + 1)
-        nbits = (total_len + 7) // 8
         if "S2C_SPARSE_OUTPUT" in os.environ:
             raise RuntimeError(
                 "S2C_SPARSE_OUTPUT was renamed: use "
@@ -542,17 +614,9 @@ class JaxBackend:
                 f"auto|dense|sparse|packed5")
         link_free = tail_dev is not None or jax.default_backend() == "cpu"
         if enc_mode == "auto":
-            costs = {
-                None: n_thresholds * total_len / TAIL_LINK_BPS,
-                "packed5":
-                    n_thresholds * ((total_len + 1) // 2 + nbits)
-                    / TAIL_LINK_BPS
-                    + n_thresholds * total_len
-                    * (P5_HOST_NS_PER_CHAR + P5_DEV_NS_PER_CHAR) * 1e-9,
-                sparse_cap:
-                    (nbits + n_thresholds * sparse_cap) / TAIL_LINK_BPS
-                    + total_len * SPARSE_NS_PER_POS * 1e-9,
-            }
+            _rt, link_bps = _link_constants()
+            costs = _fetch_costs(total_len, n_thresholds, sparse_cap,
+                                 link_bps)
             out_enc = None if link_free else min(costs, key=costs.get)
         else:
             out_enc = {"dense": None, "packed5": "packed5",
@@ -638,7 +702,7 @@ class JaxBackend:
                     out, n_thresholds, total_len, eplan.kp, cp, n_contigs,
                     k, out_enc=out_enc)
                 stats.extra["insertion_kernel"] = "pallas"
-            elif link_free and enc_mode == "auto" \
+            elif link_free and _native_tail_possible(cfg) \
                     and (native_tail := self._native_vote(
                         acc, cfg, layout)) is not None:
                 # link-free tail with the C++ vote: cpu-routed host
@@ -650,10 +714,13 @@ class JaxBackend:
                 # coverage run at memory speed (native/decoder.cpp
                 # s2c_vote); the insertion table + vote run host-side
                 # too (s2c_ins_table / s2c_ins_vote via
-                # ops.insertions.insertion_tail_host).  A forced
+                # ops.insertions.insertion_tail_host).
+                # _native_tail_possible is the ONE definition of when
+                # this branch may serve (shared with the skip-upload
+                # gate above and the host-pileup genome bound): a forced
                 # S2C_TAIL_ENCODING explicitly asks for the fused wire
-                # path, so it skips this branch (tests exercise those
-                # encodings that way).
+                # path and S2C_TAIL_DEVICE=default pins the device tail,
+                # so both fall through (round-3 advisor finding).
                 syms, cov_np, contig_sums = native_tail
                 sk, ncp = padded_sites(kp)
                 site_cov_p = np.where(
@@ -687,7 +754,8 @@ class JaxBackend:
                 contig_sums, _ = acc.tail_stats(
                     offsets32, np.zeros(0, dtype=np.int32))
                 syms = acc.vote(thr_enc_np, cfg.min_depth)
-            elif link_free and enc_mode == "auto" \
+            elif link_free and _native_tail_possible(cfg,
+                                                     has_insertions=False) \
                     and (native_tail := self._native_vote(
                         acc, cfg, layout)) is not None:
                 syms, _cov_np, contig_sums = native_tail
