@@ -361,18 +361,26 @@ class JaxBackend:
                         and block >= SP_HALO
                         and getattr(cfg, "pileup", "auto") != "mxu"
                         else "dp")
+            if mode in ("sp", "dpsp") \
+                    and getattr(cfg, "pileup", "auto") == "mxu":
+                raise RuntimeError(
+                    "--pileup mxu composes with the dp shard layout "
+                    "only; use --shard-mode dp (position-block routing "
+                    "is not modeled by the MXU tile plan yet)")
             if mode == "sp":
                 from ..parallel.sp import PositionShardedConsensus
 
-                if getattr(cfg, "pileup", "auto") == "mxu":
-                    raise RuntimeError(
-                        "--pileup mxu composes with the dp shard layout "
-                        "only; use --shard-mode dp (sp routes rows to "
-                        "position blocks, which the MXU tile plan does not "
-                        "model yet)")
                 acc = PositionShardedConsensus(
                     make_mesh(shards), layout.total_len,
                     halo=min(block, SP_HALO))
+            elif mode == "dpsp":
+                from ..parallel.dpsp import ProductShardedConsensus
+
+                mesh = make_mesh(shards)
+                macro = block * shards // mesh.shape["sp"]
+                acc = ProductShardedConsensus(
+                    mesh, layout.total_len,
+                    halo=max(1, min(macro, SP_HALO)))
             else:
                 from ..parallel.dp import ShardedConsensus
 

@@ -106,11 +106,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "decoder when available (auto), required (native), "
                         "or pure python (py)")
     p.add_argument("--shard-mode", dest="shard_mode",
-                   choices=["auto", "dp", "sp"], default="auto",
+                   choices=["auto", "dp", "sp", "dpsp"], default="auto",
                    help="sharded accumulator layout: full-length local "
-                        "scatter + reduce-scatter (dp) or position-sharded "
-                        "blocks with halo exchange for huge genomes (sp); "
-                        "auto picks by genome size")
+                        "scatter + reduce-scatter (dp), position-sharded "
+                        "blocks with halo exchange for huge genomes (sp), "
+                        "or the dp x sp product — read shards x macro "
+                        "position blocks on the 2-D mesh, for huge-genome "
+                        "+ deep-coverage workloads (dpsp; needs a mesh "
+                        "with both axes > 1); auto picks dp or sp by "
+                        "genome size")
     p.add_argument("--shards", type=int, default=0,
                    help="data-parallel shards for the jax backend; 0 = all devices")
     p.add_argument("--chunk-reads", dest="chunk_reads", type=int, default=262144,
@@ -196,7 +200,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if cfg.shards and cfg.backend != "jax":
         raise SystemExit("--shards requires --backend jax")
-    if cfg.pileup == "mxu" and cfg.shard_mode == "sp":
+    if cfg.pileup == "mxu" and cfg.shard_mode in ("sp", "dpsp"):
         raise SystemExit("--pileup mxu composes with the dp shard layout "
                          "only; use --shard-mode dp")
     if cfg.pileup == "host" and cfg.shards > 1:

@@ -38,7 +38,7 @@ class RunConfig:
     pileup: str = "auto"         # auto | mxu | scatter | host (pileup strategy)
     decode_threads: int = 1      # fused-decode workers; 0 = auto (<=4)
     ins_kernel: str = "scatter"  # scatter | pallas (insertion table build)
-    shard_mode: str = "auto"     # auto | dp | sp (sharded accumulator layout)
+    shard_mode: str = "auto"     # auto | dp | sp | dpsp (accumulator layout)
     incremental: bool = False    # keep/extend checkpoints across input files
     source_id: str = ""          # identity of the input (for incremental)
     chunk_reads: int = 262144    # reads per host->device batch (jax backend)

@@ -24,7 +24,7 @@ try:
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
-from ..constants import NUM_SYMBOLS
+from ..constants import NUM_SYMBOLS, PAD_CODE
 
 #: both mesh axes flattened: every collective treats the mesh as one ring
 ALL = ("dp", "sp")
@@ -36,12 +36,45 @@ def block_for(total_len: int, n_devices: int) -> int:
     return -(-(total_len + 1) // n_devices)
 
 
-class ShardedCountsBase:
-    """Position-sharded count-tensor state + vote, layout-agnostic."""
+def split_wide_rows(starts: np.ndarray, codes: np.ndarray, w: int,
+                    halo: int, padded_len: int):
+    """Split rows wider than the halo into halo-width pieces.
 
-    def __init__(self, mesh: Mesh, total_len: int):
+    Exact because segment rows are position-contiguous.  Trailing all-PAD
+    pieces may nominally start past the genome; their starts clamp to the
+    pad region (their cells are PAD and never count).  Shared by the sp
+    and dpsp accumulators so the clamp/pad semantics cannot diverge.
+    Returns (starts, codes, halo) — the new bucket width is the halo.
+    """
+    k = -(-w // halo)
+    wp = k * halo
+    if wp != w:
+        codes = np.concatenate(
+            [codes, np.full((len(codes), wp - w), PAD_CODE,
+                            dtype=np.uint8)], axis=1)
+    starts = (starts[:, None]
+              + (np.arange(k) * halo)[None, :]).reshape(-1)
+    starts = np.minimum(starts, padded_len - 1).astype(np.int32)
+    return starts, codes.reshape(-1, halo), halo
+
+
+class ShardedCountsBase:
+    """Position-sharded count-tensor state + vote, layout-agnostic.
+
+    ``pos_axes`` is the mesh-axis ordering of the position-axis sharding:
+    the flattened ``("dp", "sp")`` ring for the pure dp and sp pipelines,
+    ``("sp", "dp")`` for the dp x sp product mode (parallel/dpsp.py),
+    whose reduce-scatter over ``dp`` leaves device (d, s) holding
+    sub-block d of macro-block s — i.e. global block ``s * n_dp + d``.
+    Every state/vote/stats spec derives from it, so the layouts cannot
+    drift between accumulation and the tail.
+    """
+
+    def __init__(self, mesh: Mesh, total_len: int,
+                 pos_axes: Tuple[str, str] = ALL):
         self.mesh = mesh
         self.n = mesh.size
+        self.pos_axes = pos_axes
         self.total_len = total_len
         self.block = block_for(total_len, self.n)
         self.padded_len = self.block * self.n
@@ -54,6 +87,13 @@ class ShardedCountsBase:
         self._mat_spec = NamedSharding(mesh, P(ALL, None))
         self.bytes_h2d = 0                     # wire accounting for bench
 
+    def _flat_pos_index(self):
+        """Device's block index along the position axis (traceable; call
+        inside shard_map)."""
+        a0, a1 = self.pos_axes
+        return (jax.lax.axis_index(a0) * self.mesh.shape[a1]
+                + jax.lax.axis_index(a1))
+
     # -- state ------------------------------------------------------------
     @property
     def counts(self) -> jax.Array:
@@ -61,7 +101,7 @@ class ShardedCountsBase:
         if self._counts is None:
             self._counts = jax.device_put(
                 jnp.zeros((self.padded_len, NUM_SYMBOLS), dtype=jnp.int32),
-                NamedSharding(self.mesh, P(ALL, None)))
+                NamedSharding(self.mesh, P(self.pos_axes, None)))
         return self._counts
 
     def counts_host(self) -> np.ndarray:
@@ -73,7 +113,8 @@ class ShardedCountsBase:
         padded = np.zeros((self.padded_len, NUM_SYMBOLS), dtype=np.int32)
         padded[: self.total_len] = counts
         self._counts = jax.device_put(
-            jnp.asarray(padded), NamedSharding(self.mesh, P(ALL, None)))
+            jnp.asarray(padded),
+            NamedSharding(self.mesh, P(self.pos_axes, None)))
 
     # -- vote -------------------------------------------------------------
     def vote(self, thr_enc: np.ndarray, min_depth: int) -> np.ndarray:
@@ -86,8 +127,8 @@ class ShardedCountsBase:
         from ..ops.vote import vote_block
 
         @partial(shard_map, mesh=self.mesh,
-                 in_specs=(P(ALL, None), P(None, None)),
-                 out_specs=P(None, ALL))
+                 in_specs=(P(self.pos_axes, None), P(None, None)),
+                 out_specs=P(None, self.pos_axes))
         def voted(counts_blk, enc):
             syms, _cov = vote_block(counts_blk, enc, min_depth)
             return syms
@@ -107,15 +148,14 @@ class ShardedCountsBase:
         """
         from jax import lax
 
-        n_sp = self.mesh.shape["sp"]
         block = self.padded_len // self.n
 
         @partial(shard_map, mesh=self.mesh,
-                 in_specs=(P(ALL, None), P(None), P(None)),
+                 in_specs=(P(self.pos_axes, None), P(None), P(None)),
                  out_specs=(P(None), P(None)))
         def stats(counts_blk, offs, keys):
             cov_blk = counts_blk.sum(axis=-1)                  # [Lb]
-            i = lax.axis_index("dp") * n_sp + lax.axis_index("sp")
+            i = self._flat_pos_index()
             lo = i * block
             prefix = jnp.concatenate(
                 [jnp.zeros(1, dtype=cov_blk.dtype), jnp.cumsum(cov_blk)])

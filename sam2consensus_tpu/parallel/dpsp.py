@@ -1,0 +1,174 @@
+"""dp x sp product mode: read shards x position blocks on the TRUE 2-D mesh.
+
+The pure pipelines flatten the ("dp", "sp") mesh into one ring: dp
+scatters full-length local tensors (transient O(L) per device), sp routes
+every row to the single device owning its position (host routing fans out
+to all n devices, and scattered input inflates the dense slot grid ~n x).
+For huge-genome + deep-coverage workloads neither fits (round-3 verdict
+item 5).  This mode composes both axes the way the mesh was designed to
+be used (parallel/mesh.py: dp maps to DCN, sp to ICI on multi-host
+layouts):
+
+* reads split EVENLY into ``n_dp`` shards — no routing across dp at all;
+* within each dp shard, rows route among only ``n_sp`` macro position
+  blocks of ``B_sp = padded_len / n_sp`` (the counting-workload analogue
+  of 2-D context parallelism: slot-grid inflation is bounded by n_sp,
+  not n);
+* device (d, s) scatters its shard's rows for macro-block s into a local
+  ``[B_sp + H + 1, 6]`` tensor; one ``lax.ppermute`` over **sp** shifts
+  each halo to the next macro-block (within the dp group), then one
+  ``lax.psum_scatter`` over **dp** both sums the dp partials and leaves
+  device d holding sub-block d of the macro-block — addition commutes,
+  so the result is exactly the unsharded pileup
+  (tests/test_parallel_dpsp.py pins byte-identity on (2,4) and (4,2)
+  meshes).
+
+Resulting state layout: position axis sharded ``P(("sp", "dp"))`` —
+macro-blocks over sp, sub-blocks over dp — which the shared base
+(``ShardedCountsBase(pos_axes=("sp", "dp"))``) threads through the vote,
+tail stats, and checkpoint restore, so the whole tail runs on the 2-D
+layout with zero resharding.
+
+Memory per device: O(L / n_sp + H) transient, O(L / n) resident.
+Communication per chunk: one [H, 6] neighbor shift over sp (ICI) + one
+reduce-scatter of [B_sp, 6] over dp — the dp term is the price of never
+routing reads across dp groups, the right trade precisely when decode
+throughput (many reads) meets a genome too big for dp's O(L) transient.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..constants import NUM_SYMBOLS, PAD_CODE
+from ..encoder.events import SegmentBatch
+from ..ops.pileup import (expand_segment_positions, iter_row_slices,
+                          pack_nibbles, unpack_nibbles)
+from .base import ALL, ShardedCountsBase, shard_map, split_wide_rows
+
+__all__ = ["ProductShardedConsensus"]
+
+
+class ProductShardedConsensus(ShardedCountsBase):
+    """Streaming dp x sp accumulate + vote over the 2-D mesh."""
+
+    def __init__(self, mesh, total_len: int, halo: int = 1 << 16):
+        super().__init__(mesh, total_len, pos_axes=("sp", "dp"))
+        self.n_dp = mesh.shape["dp"]
+        self.n_sp = mesh.shape["sp"]
+        if self.n_dp < 2 or self.n_sp < 2:
+            raise ValueError(
+                f"dp x sp product mode needs a true 2-D mesh, got "
+                f"dp={self.n_dp} x sp={self.n_sp}; use --shard-mode dp "
+                f"or sp on a 1-D mesh")
+        self.halo = halo
+        self.block_sp = self.padded_len // self.n_sp    # macro block
+        if self.block_sp < halo:
+            raise ValueError(
+                f"macro position block {self.block_sp} smaller than halo "
+                f"{halo}: use the DP pipeline for genomes this small")
+        self.strategy_used: dict = {}
+        self.rows_shipped = 0
+        self.rows_real = 0
+
+        block_sp, n_sp = self.block_sp, self.n_sp
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(self.pos_axes, None), P(ALL), P(ALL, None)),
+                 out_specs=P(self.pos_axes, None))
+        def accumulate(counts_blk, starts, packed):
+            s = jax.lax.axis_index("sp")
+            # slot past the halo is the PAD-cell sacrifice (outside
+            # [0, block_sp + halo) so pad garbage never rides the shift)
+            local = jnp.zeros((block_sp + halo + 1, NUM_SYMBOLS),
+                              dtype=jnp.int32)
+            pos, code = expand_segment_positions(
+                starts - s * block_sp, unpack_nibbles(packed),
+                block_sp + halo)
+            local = local.at[pos, code].add(1)
+            # halo -> next macro-block, within each dp group; the last
+            # macro-block's halo covers pad positions only (valid cells
+            # never pass padded_len), so the non-wrapping drop is exact
+            shifted = jax.lax.ppermute(
+                local[block_sp:block_sp + halo], "sp",
+                perm=[(i, i + 1) for i in range(n_sp - 1)])
+            acc = local[:block_sp].at[:halo].add(shifted)
+            # reduce the dp partials AND scatter sub-blocks: device d
+            # leaves holding sub-block d of macro-block s, which is
+            # exactly the P(("sp","dp")) resident layout
+            return counts_blk + jax.lax.psum_scatter(
+                acc, "dp", scatter_dimension=0, tiled=True)
+
+        self._accumulate = jax.jit(accumulate, donate_argnums=0)
+
+    # -- streaming input --------------------------------------------------
+    def add(self, batch: SegmentBatch) -> None:
+        for w, (starts, codes) in sorted(batch.buckets.items()):
+            starts = np.asarray(starts)
+            codes = np.asarray(codes)
+            if w > self.halo:
+                starts, codes, w = split_wide_rows(
+                    starts, codes, w, self.halo, self.padded_len)
+
+            self.rows_real += len(starts)
+            # dp split: contiguous even chunks (order irrelevant — the
+            # count tensor is sum-decomposable); within each chunk, route
+            # rows to their macro block via one counting sort over n_sp
+            # targets
+            n_rows = len(starts)
+            per_dp = -(-n_rows // self.n_dp)
+            macro = np.minimum(starts // self.block_sp, self.n_sp - 1)
+            # slot capacity: max rows any (dp chunk, macro block) pair
+            # receives, pow2 so the jit cache stays O(log)
+            counts_dm = np.zeros((self.n_dp, self.n_sp), dtype=np.int64)
+            for d in range(self.n_dp):
+                lo, hi = d * per_dp, min((d + 1) * per_dp, n_rows)
+                if lo < hi:
+                    counts_dm[d] = np.bincount(macro[lo:hi],
+                                               minlength=self.n_sp)
+            r = 1 << max(3, int(counts_dm.max(initial=1) - 1).bit_length())
+
+            s_routed = np.zeros((self.n_dp, self.n_sp, r), dtype=np.int32)
+            c_routed = np.full((self.n_dp, self.n_sp, r, w), PAD_CODE,
+                               dtype=np.uint8)
+            for d in range(self.n_dp):
+                lo, hi = d * per_dp, min((d + 1) * per_dp, n_rows)
+                if lo >= hi:
+                    continue
+                m = macro[lo:hi]
+                order = np.argsort(m, kind="stable")
+                m_sorted = m[order]
+                per = counts_dm[d]
+                base = np.cumsum(per) - per
+                slot = np.arange(hi - lo) - base[m_sorted]
+                s_routed[d, m_sorted, slot] = starts[lo:hi][order]
+                c_routed[d, m_sorted, slot] = codes[lo:hi][order]
+            # pad slots must keep an in-block start so the shifted scatter
+            # index stays in range (their cells are PAD and redirect)
+            filled = np.zeros((self.n_dp, self.n_sp, r), dtype=bool)
+            for d in range(self.n_dp):
+                for s in range(self.n_sp):
+                    filled[d, s, : counts_dm[d, s]] = True
+            pad_starts = (np.arange(self.n_sp, dtype=np.int32)
+                          * self.block_sp)[None, :, None]
+            s_routed = np.where(filled, s_routed,
+                                np.broadcast_to(pad_starts, s_routed.shape))
+
+            for lo_r, hi_r in iter_row_slices(r, w):
+                s_slab = np.ascontiguousarray(
+                    s_routed[:, :, lo_r:hi_r]).reshape(-1)
+                p_slab = pack_nibbles(np.ascontiguousarray(
+                    c_routed[:, :, lo_r:hi_r]).reshape(-1, w))
+                self.bytes_h2d += s_slab.nbytes + p_slab.nbytes
+                self._counts = self._accumulate(
+                    self.counts,
+                    jax.device_put(s_slab, self._row_spec),
+                    jax.device_put(p_slab, self._mat_spec))
+                self.rows_shipped += self.n * (hi_r - lo_r)
+            key = f"dpsp_w{w}"
+            self.strategy_used[key] = self.strategy_used.get(key, 0) + 1

@@ -57,7 +57,8 @@ from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
                           pack_nibbles, unpack_nibbles)
-from .base import ALL, ShardedCountsBase, block_for, shard_map
+from .base import (ALL, ShardedCountsBase, block_for, shard_map,
+                   split_wide_rows)
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["PositionShardedConsensus", "block_for"]
@@ -147,22 +148,8 @@ class PositionShardedConsensus(ShardedCountsBase):
             starts = np.asarray(starts)
             codes = np.asarray(codes)
             if w > self.halo:
-                # split wide rows into halo-width pieces: segment rows are
-                # position-contiguous, so the split is exact.  Trailing
-                # all-PAD pieces may nominally start past the genome;
-                # clamp them (their cells are PAD and never count)
-                k = -(-w // self.halo)
-                wp = k * self.halo
-                if wp != w:
-                    codes = np.concatenate(
-                        [codes, np.full((len(codes), wp - w), PAD_CODE,
-                                        dtype=np.uint8)], axis=1)
-                starts = (starts[:, None]
-                          + (np.arange(k) * self.halo)[None, :]).reshape(-1)
-                starts = np.minimum(starts, self.padded_len - 1)
-                starts = starts.astype(np.int32)
-                codes = codes.reshape(-1, self.halo)
-                w = self.halo
+                starts, codes, w = split_wide_rows(
+                    starts, codes, w, self.halo, self.padded_len)
 
             self.rows_real += len(starts)
             # strategy pick: a narrow position span (coordinate-sorted

@@ -66,8 +66,14 @@ def probe_link(force: bool = False) -> Optional[Tuple[float, float]]:
         dev.block_until_ready()           # warm h2d
         put = min(_timed(lambda: jax.device_put(buf).block_until_ready())
                   for _ in range(2))
-        np.asarray(dev)                   # warm d2h
-        get = min(_timed(lambda: np.asarray(dev)) for _ in range(2))
+        # d2h must read DISTINCT device arrays: jax caches the host copy
+        # per array, so re-reading one array times a memcpy, not the link
+        g = jax.jit(lambda a, s: a + s)
+        outs = [g(dev, jnp.uint8(i + 1)) for i in range(3)]
+        for o in outs:
+            o.block_until_ready()
+        np.asarray(outs[0])               # warm d2h
+        get = min(_timed(lambda o=o: np.asarray(o)) for o in outs[1:])
         bw = PROBE_BYTES / max(max(put, get) - rt / 2, 1e-9)
     except Exception:
         _failed = True

@@ -1,0 +1,128 @@
+"""dp x sp product-mode tests on the 8 virtual CPU devices (conftest).
+
+Round-4 verdict item 5: the 2-D mesh must COMPOSE — read shards across
+``dp`` groups x macro position blocks across ``sp``, halo exchange over
+sp, reduce-scatter over dp — byte-identically to the unsharded pipeline
+on (2, 4) and (4, 2) meshes.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from sam2consensus_tpu.backends.cpu import CpuBackend
+from sam2consensus_tpu.backends.jax_backend import JaxBackend
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.encoder.events import GenomeLayout, ReadEncoder
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.io.sam import iter_records, read_header
+from sam2consensus_tpu.ops.cutoff import encode_thresholds
+from sam2consensus_tpu.ops.pileup import PileupAccumulator
+from sam2consensus_tpu.parallel.dpsp import ProductShardedConsensus
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+
+def _mesh(n_dp, n_sp):
+    devs = np.asarray(jax.devices()[: n_dp * n_sp]).reshape(n_dp, n_sp)
+    return Mesh(devs, ("dp", "sp"))
+
+
+def _encode_all(text):
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    layout = GenomeLayout(contigs)
+    enc = ReadEncoder(layout)
+    chunks = list(enc.encode_segments(iter_records(handle, first),
+                                      chunk_reads=64))
+    return layout, chunks
+
+
+@pytest.mark.parametrize("n_dp,n_sp", [(2, 4), (4, 2)])
+def test_product_counts_equal_single_device(n_dp, n_sp):
+    text = simulate(SimSpec(n_contigs=4, contig_len=200, n_reads=500,
+                            read_len=50, ins_read_rate=0.1,
+                            del_read_rate=0.1, seed=61))
+    layout, chunks = _encode_all(text)
+
+    single = PileupAccumulator(layout.total_len)
+    for c in chunks:
+        single.add(c)
+    expected = np.asarray(single.counts)
+
+    # small halo so rows actually overhang macro blocks and wide rows split
+    prod = ProductShardedConsensus(_mesh(n_dp, n_sp), layout.total_len,
+                                   halo=32)
+    for c in chunks:
+        prod.add(c)
+    np.testing.assert_array_equal(prod.counts_host(), expected)
+    assert prod.rows_real > 0
+
+
+def test_product_vote_and_tail_stats_match_flat_layout():
+    text = simulate(SimSpec(n_contigs=3, contig_len=150, n_reads=400,
+                            read_len=40, seed=62))
+    layout, chunks = _encode_all(text)
+    prod = ProductShardedConsensus(_mesh(2, 4), layout.total_len, halo=32)
+    for c in chunks:
+        prod.add(c)
+    thr_enc = encode_thresholds([0.25, 0.75])
+    syms = prod.vote(thr_enc, min_depth=1)
+
+    import jax.numpy as jnp
+
+    from sam2consensus_tpu.ops.vote import vote_positions
+    syms1, _cov1 = vote_positions(jnp.asarray(prod.counts_host()),
+                                  jnp.asarray(thr_enc), 1)
+    np.testing.assert_array_equal(syms, np.asarray(syms1))
+
+    counts = prod.counts_host()
+    cov = counts.sum(axis=-1)
+    offsets = layout.offsets.astype(np.int32)
+    keys = np.asarray([0, 5, layout.total_len - 1], dtype=np.int32)
+    contig_sums, site_cov = prod.tail_stats(offsets, keys)
+    expect_sums = np.asarray(
+        [cov[offsets[i]:offsets[i + 1]].sum()
+         for i in range(len(layout.names))])
+    np.testing.assert_array_equal(contig_sums, expect_sums)
+    np.testing.assert_array_equal(site_cov, cov[keys])
+
+
+def test_product_checkpoint_restore_roundtrip():
+    layout_len = 700
+    rng = np.random.default_rng(0)
+    counts = rng.integers(0, 300, (layout_len, 6)).astype(np.int32)
+    prod = ProductShardedConsensus(_mesh(2, 4), layout_len, halo=32)
+    prod.restore(counts)
+    np.testing.assert_array_equal(prod.counts_host(), counts)
+
+
+def test_product_needs_true_2d_mesh():
+    with pytest.raises(ValueError, match="2-D mesh"):
+        ProductShardedConsensus(_mesh(1, 8), 1000, halo=32)
+    with pytest.raises(ValueError, match="2-D mesh"):
+        ProductShardedConsensus(_mesh(8, 1), 1000, halo=32)
+
+
+def _run(text, backend, cfg):
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    res = backend.run(contigs, iter_records(handle, first), cfg)
+    return {n: render_file(r, 0) for n, r in res.fastas.items()}, res.stats
+
+
+def test_backend_dpsp_byte_identical_to_oracle():
+    text = simulate(SimSpec(n_contigs=3, contig_len=400, n_reads=800,
+                            read_len=60, ins_read_rate=0.15,
+                            del_read_rate=0.15, seed=63))
+    cfg = RunConfig(prefix="t", thresholds=[0.25, 0.5], shards=1)
+    out_cpu, _ = _run(text, CpuBackend(), cfg)
+    cfg8 = RunConfig(prefix="t", thresholds=[0.25, 0.5], shards=8,
+                     shard_mode="dpsp")
+    out_dpsp, st = _run(text, JaxBackend(), cfg8)
+    assert out_dpsp == out_cpu
+    assert st.extra["shard_mode"] == "dpsp"
+    assert st.extra["shards"] == 8
