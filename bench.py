@@ -162,15 +162,26 @@ def build_configs(n_devices: int):
          # auto picks the link-free host path here when the native lib
          # builds (the row's "pileup" field records which path actually
          # ran — host_fused vs scatter_*); the +device variant pins the
-         # chip pileup so the device path keeps a measured row
-         {"thresholds": [0.25]}, {"device": {"pileup": "scatter"}}, {}),
+         # chip pileup AND the device tail so the chip does all the work
+         # and its efficiency is a measured number (VERDICT r3 #3); the
+         # +mxu variant measures the one-hot-matmul pileup's occupancy
+         {"thresholds": [0.25]},
+         {"device": {"pileup": "scatter",
+                     "_env": {"S2C_TAIL_DEVICE": "default"}},
+          "mxu": {"pileup": "mxu",
+                  "_env": {"S2C_TAIL_DEVICE": "default"}}}, {}),
         ("amplicon_deep",
          SimSpec(n_contigs=1, contig_len=400, n_reads=n(100000),
                  read_len=80, ins_read_rate=0.3, del_read_rate=0.2,
                  seed=303, contig_prefix="amplicon"),
          {"thresholds": [0.25], "min_depth": 10},
          {"pallas": {"ins_kernel": "pallas"}}, {}),
-        ("north_star", north_star_spec, {"thresholds": [0.25]}, {}, {}),
+        ("north_star", north_star_spec, {"thresholds": [0.25]},
+         # forced-chip leg: device pileup + device tail, so the flagship
+         # workload has a row where the TPU does the work even when the
+         # placement model (correctly, on a slow link) routes host-side
+         {"device": {"pileup": "scatter",
+                     "_env": {"S2C_TAIL_DEVICE": "default"}}}, {}),
         ("wide_genome", wide_spec, {"thresholds": [0.25]}, {},
          {"oracle_shrink":
           int(os.environ.get("BENCH_WIDE_ORACLE_SHRINK", "1"))}),
@@ -200,22 +211,43 @@ def phase_split(stats):
 
 
 def util_fields(stats, jax_time):
-    """Wire/throughput accounting so regressions are attributable
-    (VERDICT r2 #5): bytes each way, effective link rate, pileup cell
-    rate, host decode rate."""
+    """Wire/throughput/efficiency accounting so regressions are
+    attributable (VERDICT r2 #5) and chip efficiency is a number
+    (VERDICT r3 #3): bytes each way, effective link rate + utilization %
+    against the modeled link, pileup cell rate + % of the measured
+    scatter roofline, MXU padded-lane occupancy, host decode rate."""
     u = {}
     h2d = stats.extra.get("h2d_bytes", 0)
     d2h = stats.extra.get("d2h_bytes", 0)
     u["h2d_mb"] = round(h2d / 1e6, 2)
     u["d2h_mb"] = round(d2h / 1e6, 2)
+    pileup = stats.extra.get("pileup", {})
     if jax_time > 0:
         u["wire_mbps"] = round((h2d + d2h) / 1e6 / jax_time, 1)
+        if h2d + d2h > 0:
+            # % of the modeled link rate (self-calibrated / env / default
+            # — the same constant the placement gates price with)
+            from sam2consensus_tpu.backends.jax_backend import \
+                _link_constants
+
+            _rt, link_bps = _link_constants()
+            u["link_util_pct"] = round(
+                100.0 * (h2d + d2h) / jax_time / link_bps, 1)
     ps = stats.extra.get("pileup_dispatch_sec", 0)
     if ps > 0.005:
         # meaningless in fused-decode mode, where accumulation happens
         # inside the decode pass and this phase is ~0
-        u["pileup_mcells_per_s"] = round(
-            stats.aligned_bases / ps / 1e6, 1)
+        mcells = stats.aligned_bases / ps / 1e6
+        u["pileup_mcells_per_s"] = round(mcells, 1)
+        if any(k.startswith("scatter_") for k in pileup):
+            # % of the measured on-chip scatter roofline (PERF.md §1:
+            # ~53 M cells/s data-resident; override for other chips)
+            roof = float(os.environ.get(
+                "S2C_BENCH_SCATTER_ROOFLINE_MCELLS", "53"))
+            u["scatter_roofline_pct"] = round(100.0 * mcells / roof, 1)
+    if "mxu_blowup" in pileup:
+        # 100% = every MXU lane carried a real row; padding is the loss
+        u["mxu_occupancy_pct"] = round(100.0 / pileup["mxu_blowup"], 1)
     ds = stats.extra.get("decode_sec", 0)
     if ds > 0:
         u["decode_mbases_per_s"] = round(
@@ -238,19 +270,34 @@ def _write_sim(spec, name, tmp):
 
 def _jax_row(name, path, cfg_kwargs, overrides, cpu_time, cpu_out):
     """Warm + timed jax run; returns the result row (identical vs cpu_out
-    unless cpu_out is None)."""
+    unless cpu_out is None).  ``overrides`` may carry a ``"_env"`` dict
+    applied around the runs — forced-placement variants (e.g.
+    S2C_TAIL_DEVICE=default) use it so the chip path gets first-class
+    measured rows even where auto would route host-side (VERDICT r3 #3)."""
     from sam2consensus_tpu.backends.jax_backend import JaxBackend
     from sam2consensus_tpu.config import RunConfig
 
-    # decode_threads 0 = auto: engages the parallel fused decode and the
-    # threaded native vote on multi-core hosts (no-op on one core)
-    vcfg = RunConfig(prefix="bench", **{"shards": 1, "decode_threads": 0,
-                                        **cfg_kwargs, **overrides})
-    backend = JaxBackend()
-    # warm-up pays the jit compiles for this genome length / buckets
-    _s, _t, _o = run_once(backend, path, vcfg, binary=True)
-    jax_stats, jax_time, jax_out = run_once(backend, path, vcfg,
-                                            binary=True)
+    overrides = dict(overrides)
+    env = overrides.pop("_env", {})
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        # decode_threads 0 = auto: engages the parallel fused decode and
+        # the threaded native vote on multi-core hosts (no-op on 1 core)
+        vcfg = RunConfig(prefix="bench",
+                         **{"shards": 1, "decode_threads": 0,
+                            **cfg_kwargs, **overrides})
+        backend = JaxBackend()
+        # warm-up pays the jit compiles for this genome length / buckets
+        _s, _t, _o = run_once(backend, path, vcfg, binary=True)
+        jax_stats, jax_time, jax_out = run_once(backend, path, vcfg,
+                                                binary=True)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     bases = jax_stats.consensus_bases
     row = {
         "config": name,

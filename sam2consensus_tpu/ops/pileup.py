@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..constants import NUM_SYMBOLS
+from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import SegmentBatch
 
 
@@ -410,6 +410,8 @@ class PileupAccumulator:
         self._counts = counts
         self.strategy_used: dict = {}
         self.bytes_h2d = 0                 # wire accounting for bench
+        self._mxu_rows_real = 0            # occupancy accounting: run
+        self._mxu_rows_padded = 0          # aggregate, not last-slab
         self._tuner = PileupAutoTuner() if strategy == "auto" else None
 
     def stage(self, batch: SegmentBatch) -> None:
@@ -432,6 +434,18 @@ class PileupAccumulator:
 
         for w, (starts, codes) in sorted(batch.buckets.items()):
             staged = batch.staged.get(w)
+            # slab pow2 padding appends a contiguous all-PAD tail at
+            # start 0; those rows count nothing (scatter self-redirects
+            # them) but would pile into MXU tile 0 and trip the skew
+            # gate.  Find the all-PAD suffix with two vectorized scans
+            # (first-cell prefilter, then full rows over the candidate
+            # tail only) and plan/run the MXU path on real rows only.
+            codes_np = np.asarray(codes)
+            nz = np.nonzero(codes_np[:, 0] != PAD_CODE)[0]
+            tail_lo = int(nz[-1]) + 1 if len(nz) else 0
+            row_pad = (codes_np[tail_lo:] == PAD_CODE).all(axis=1)
+            nz2 = np.nonzero(~row_pad)[0]
+            n_real = tail_lo + (int(nz2[-1]) + 1 if len(nz2) else 0)
 
             def put_operands():
                 """(starts_dev, packed_dev): staged by the prefetch
@@ -445,14 +459,31 @@ class PileupAccumulator:
                 return jnp.asarray(starts), jnp.asarray(packed)
 
             def plan_mxu():
+                if n_real == 0:
+                    return None
+                # auto keeps the tight blowup gate (padding waste loses
+                # the tuner trial anyway); an EXPLICIT --pileup mxu
+                # tolerates more padding before falling back — the user
+                # asked for the MXU formulation, and 4-16x lane waste is
+                # an efficiency question, not a memory-safety one
                 return mxu_pileup.plan_slots(
-                    np.asarray(starts), w, self.padded_len, self._tile)
+                    np.asarray(starts)[:n_real], w, self.padded_len,
+                    self._tile,
+                    max_blowup=(16.0 if self.strategy == "mxu"
+                                else mxu_pileup.MAX_BLOWUP))
 
             def exec_mxu(plan):
                 st, pk = put_operands()
                 self.bytes_h2d += plan.slot.nbytes
+                # occupancy accounting for the bench: padded/real row
+                # ratio aggregated over the run (a last-slab snapshot
+                # would report whichever bucket happened to run last)
+                self._mxu_rows_real += n_real
+                self._mxu_rows_padded += plan.n_tiles * plan.rows_per_tile
+                self.strategy_used["mxu_blowup"] = round(
+                    self._mxu_rows_padded / self._mxu_rows_real, 3)
                 self._counts = mxu_pileup.pileup_mxu_packed(
-                    self._counts, st, pk,
+                    self._counts, st[:n_real], pk[:n_real],
                     jnp.asarray(plan.slot), tile=self._tile,
                     n_tiles=plan.n_tiles,
                     rows_per_tile=plan.rows_per_tile, width=plan.width)
@@ -464,12 +495,14 @@ class PileupAccumulator:
                         self._counts, st[lo:hi],
                         pk[lo:hi], self.total_len)
 
+            if n_real == 0:
+                continue               # all-pad bucket: counts nothing
             # completion is forced with a one-element fetch, NOT
             # block_until_ready: the latter returns early over the axon
             # tunnel (tools/tunnel_probe.py) and would bias the trial
             # toward whichever strategy does more device-side work
             key = run_tuned_slab(
-                self._tuner, self.strategy, len(starts), w, plan_mxu,
+                self._tuner, self.strategy, n_real, w, plan_mxu,
                 exec_mxu, exec_scatter,
                 lambda: np.asarray(self._counts[0, 0]))
             if self._tuner is not None and self._tuner.stats is not None:

@@ -58,6 +58,33 @@ def split_wide_rows(starts: np.ndarray, codes: np.ndarray, w: int,
     return starts, codes.reshape(-1, halo), halo
 
 
+def route_to_slots(targets: np.ndarray, n_targets: int, r: int,
+                   starts: np.ndarray, codes: np.ndarray,
+                   pin_starts: np.ndarray):
+    """Counting-sort rows into an ``[n_targets, r]`` slot grid.
+
+    Shared by the sp (targets = owning devices) and dpsp (targets = macro
+    position blocks) routers so the slot math and pad-slot pinning cannot
+    diverge.  Unfilled slots carry ``pin_starts[target]`` (a start inside
+    the target's block, so shifted scatter indices stay in range) and
+    all-PAD codes (which never count).  Returns
+    ``(s_grid [n_targets, r] int32, c_grid [n_targets, r, w] uint8)``.
+    """
+    w = codes.shape[1]
+    order = np.argsort(targets, kind="stable")
+    t_sorted = targets[order]
+    per = np.bincount(t_sorted, minlength=n_targets)
+    s_grid = np.broadcast_to(
+        pin_starts.astype(np.int32)[:, None], (n_targets, r)).copy()
+    c_grid = np.full((n_targets, r, w), PAD_CODE, dtype=np.uint8)
+    hi = np.cumsum(per)
+    flat = (t_sorted * r
+            + (np.arange(len(targets)) - (hi - per)[t_sorted]))
+    s_grid.reshape(-1)[flat] = starts[order]
+    c_grid.reshape(-1, w)[flat] = codes[order]
+    return s_grid, c_grid
+
+
 class ShardedCountsBase:
     """Position-sharded count-tensor state + vote, layout-agnostic.
 

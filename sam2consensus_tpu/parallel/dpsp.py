@@ -45,11 +45,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..constants import NUM_SYMBOLS, PAD_CODE
+from ..constants import NUM_SYMBOLS
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
                           pack_nibbles, unpack_nibbles)
-from .base import ALL, ShardedCountsBase, shard_map, split_wide_rows
+from .base import (ALL, ShardedCountsBase, route_to_slots, shard_map,
+                   split_wide_rows)
 
 __all__ = ["ProductShardedConsensus"]
 
@@ -119,7 +120,7 @@ class ProductShardedConsensus(ShardedCountsBase):
             # dp split: contiguous even chunks (order irrelevant — the
             # count tensor is sum-decomposable); within each chunk, route
             # rows to their macro block via one counting sort over n_sp
-            # targets
+            # targets (route_to_slots: the same slot math as sp routing)
             n_rows = len(starts)
             per_dp = -(-n_rows // self.n_dp)
             macro = np.minimum(starts // self.block_sp, self.n_sp - 1)
@@ -133,31 +134,15 @@ class ProductShardedConsensus(ShardedCountsBase):
                                                minlength=self.n_sp)
             r = 1 << max(3, int(counts_dm.max(initial=1) - 1).bit_length())
 
-            s_routed = np.zeros((self.n_dp, self.n_sp, r), dtype=np.int32)
-            c_routed = np.full((self.n_dp, self.n_sp, r, w), PAD_CODE,
-                               dtype=np.uint8)
+            pins = np.arange(self.n_sp, dtype=np.int32) * self.block_sp
+            s_routed = np.empty((self.n_dp, self.n_sp, r), dtype=np.int32)
+            c_routed = np.empty((self.n_dp, self.n_sp, r, w),
+                                dtype=np.uint8)
             for d in range(self.n_dp):
                 lo, hi = d * per_dp, min((d + 1) * per_dp, n_rows)
-                if lo >= hi:
-                    continue
-                m = macro[lo:hi]
-                order = np.argsort(m, kind="stable")
-                m_sorted = m[order]
-                per = counts_dm[d]
-                base = np.cumsum(per) - per
-                slot = np.arange(hi - lo) - base[m_sorted]
-                s_routed[d, m_sorted, slot] = starts[lo:hi][order]
-                c_routed[d, m_sorted, slot] = codes[lo:hi][order]
-            # pad slots must keep an in-block start so the shifted scatter
-            # index stays in range (their cells are PAD and redirect)
-            filled = np.zeros((self.n_dp, self.n_sp, r), dtype=bool)
-            for d in range(self.n_dp):
-                for s in range(self.n_sp):
-                    filled[d, s, : counts_dm[d, s]] = True
-            pad_starts = (np.arange(self.n_sp, dtype=np.int32)
-                          * self.block_sp)[None, :, None]
-            s_routed = np.where(filled, s_routed,
-                                np.broadcast_to(pad_starts, s_routed.shape))
+                s_routed[d], c_routed[d] = route_to_slots(
+                    macro[lo:hi], self.n_sp, r, starts[lo:hi],
+                    codes[lo:hi], pins)
 
             for lo_r, hi_r in iter_row_slices(r, w):
                 s_slab = np.ascontiguousarray(

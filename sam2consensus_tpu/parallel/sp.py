@@ -57,8 +57,8 @@ from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
                           pack_nibbles, unpack_nibbles)
-from .base import (ALL, ShardedCountsBase, block_for, shard_map,
-                   split_wide_rows)
+from .base import (ALL, ShardedCountsBase, block_for, route_to_slots,
+                   shard_map, split_wide_rows)
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["PositionShardedConsensus", "block_for"]
@@ -209,25 +209,11 @@ class PositionShardedConsensus(ShardedCountsBase):
             # rows (all-PAD codes, start 0) follow start 0 to device 0
             # where expand() redirects their cells to the sacrificial slot
             dev = starts // self.block
-            order = np.argsort(dev, kind="stable")
-            dev_sorted = dev[order]
-            per_dev = np.bincount(dev_sorted, minlength=self.n)
+            per_dev = np.bincount(dev, minlength=self.n)
             r = 1 << max(3, int(per_dev.max(initial=1) - 1).bit_length())
-
-            s_routed = np.zeros((self.n, r), dtype=np.int32)
-            c_routed = np.full((self.n, r, w), PAD_CODE, dtype=np.uint8)
-            hi = np.cumsum(per_dev)
-            flat = (dev_sorted * r
-                    + (np.arange(len(starts)) - (hi - per_dev)[dev_sorted]))
-            s_routed.reshape(-1)[flat] = starts[order]
-            c_routed.reshape(-1, w)[flat] = codes[order]
-            # pad-row starts must stay on their assigned device's block so
-            # local offsets stay in range; PAD cells never count anyway
-            pad_mask = np.ones(self.n * r, dtype=bool)
-            pad_mask[flat] = False
-            pad_dev = np.repeat(np.arange(self.n), r)
-            s_routed.reshape(-1)[pad_mask] = (
-                pad_dev[pad_mask] * self.block).astype(np.int32)
+            s_routed, c_routed = route_to_slots(
+                dev, self.n, r, starts, codes,
+                np.arange(self.n) * self.block)
 
             # cap expanded cells per device call (same budget discipline
             # as the unsharded and dp paths, ops.pileup.iter_row_slices)
