@@ -446,6 +446,14 @@ class PileupAccumulator:
             row_pad = (codes_np[tail_lo:] == PAD_CODE).all(axis=1)
             nz2 = np.nonzero(~row_pad)[0]
             n_real = tail_lo + (int(nz2[-1]) + 1 if len(nz2) else 0)
+            # round the working row count back UP to a power of two: jit
+            # trace keys are operand shapes, so slicing to the exact
+            # n_real would compile per slab and break the autotuner's
+            # warm/time shape pairing; pow2 keeps the cache O(log) while
+            # still excluding the bulk of the pad tail from MXU tile 0
+            n_rows = min(len(starts),
+                         1 << max(3, (n_real - 1).bit_length())) \
+                if n_real else 0
 
             def put_operands():
                 """(starts_dev, packed_dev): staged by the prefetch
@@ -459,7 +467,7 @@ class PileupAccumulator:
                 return jnp.asarray(starts), jnp.asarray(packed)
 
             def plan_mxu():
-                if n_real == 0:
+                if n_rows == 0:
                     return None
                 # auto keeps the tight blowup gate (padding waste loses
                 # the tuner trial anyway); an EXPLICIT --pileup mxu
@@ -467,7 +475,7 @@ class PileupAccumulator:
                 # asked for the MXU formulation, and 4-16x lane waste is
                 # an efficiency question, not a memory-safety one
                 return mxu_pileup.plan_slots(
-                    np.asarray(starts)[:n_real], w, self.padded_len,
+                    np.asarray(starts)[:n_rows], w, self.padded_len,
                     self._tile,
                     max_blowup=(16.0 if self.strategy == "mxu"
                                 else mxu_pileup.MAX_BLOWUP))
@@ -477,32 +485,39 @@ class PileupAccumulator:
                 self.bytes_h2d += plan.slot.nbytes
                 # occupancy accounting for the bench: padded/real row
                 # ratio aggregated over the run (a last-slab snapshot
-                # would report whichever bucket happened to run last)
-                self._mxu_rows_real += n_real
-                self._mxu_rows_padded += plan.n_tiles * plan.rows_per_tile
-                self.strategy_used["mxu_blowup"] = round(
-                    self._mxu_rows_padded / self._mxu_rows_real, 3)
+                # would report whichever bucket ran last) — and only for
+                # runs whose COMMITTED strategy is mxu: a locked-scatter
+                # autotune run must not report occupancy for two trial
+                # slabs that did ~0% of the work
+                if self.strategy == "mxu" or (
+                        self._tuner is not None
+                        and self._tuner.winner == "mxu"):
+                    self._mxu_rows_real += n_real
+                    self._mxu_rows_padded += (plan.n_tiles
+                                              * plan.rows_per_tile)
+                    self.strategy_used["mxu_blowup"] = round(
+                        self._mxu_rows_padded / self._mxu_rows_real, 3)
                 self._counts = mxu_pileup.pileup_mxu_packed(
-                    self._counts, st[:n_real], pk[:n_real],
+                    self._counts, st[:n_rows], pk[:n_rows],
                     jnp.asarray(plan.slot), tile=self._tile,
                     n_tiles=plan.n_tiles,
                     rows_per_tile=plan.rows_per_tile, width=plan.width)
 
             def exec_scatter():
                 st, pk = put_operands()
-                for lo, hi in iter_row_slices(len(starts), w):
+                for lo, hi in iter_row_slices(n_rows, w):
                     self._counts = _scatter_segments_packed(
                         self._counts, st[lo:hi],
                         pk[lo:hi], self.total_len)
 
-            if n_real == 0:
+            if n_rows == 0:
                 continue               # all-pad bucket: counts nothing
             # completion is forced with a one-element fetch, NOT
             # block_until_ready: the latter returns early over the axon
             # tunnel (tools/tunnel_probe.py) and would bias the trial
             # toward whichever strategy does more device-side work
             key = run_tuned_slab(
-                self._tuner, self.strategy, n_real, w, plan_mxu,
+                self._tuner, self.strategy, n_rows, w, plan_mxu,
                 exec_mxu, exec_scatter,
                 lambda: np.asarray(self._counts[0, 0]))
             if self._tuner is not None and self._tuner.stats is not None:

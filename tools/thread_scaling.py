@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Measure decode and native-vote throughput at 1/2/4 threads.
+
+The multi-threaded paths (--decode-threads: ``encoder/parallel_decode.py``
+fused decode workers; the threaded ``s2c_vote`` position ranges) carry the
+framework's multi-core story, but the round-3 verdict noted every claim
+about them was unmeasured (the bench host has one core).  This tool
+records what the current host CAN measure — per-thread-count rates plus
+the host's core count, so the artifact is honest about whether the run
+could exhibit scaling at all — as one JSON line per measurement.
+
+Usage: python tools/thread_scaling.py [> artifact.jsonl]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def emit(row):
+    row["host_cores"] = os.cpu_count()
+    print(json.dumps(row), flush=True)
+
+
+def measure_decode(threads_list, n_reads=500_000):
+    from sam2consensus_tpu.encoder.events import GenomeLayout
+    from sam2consensus_tpu.encoder.parallel_decode import ParallelFusedDecoder
+    from sam2consensus_tpu.io.sam import ReadStream, opener, read_header
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+    import io
+    import tempfile
+
+    spec = SimSpec(n_contigs=200, contig_len=2000, n_reads=n_reads,
+                   read_len=100, ins_read_rate=0.05, del_read_rate=0.05,
+                   seed=99)
+    log(f"[decode] simulating {n_reads} reads ...")
+    text = simulate(spec)
+    with tempfile.NamedTemporaryFile("w", suffix=".sam",
+                                     delete=False) as fh:
+        fh.write(text)
+        path = fh.name
+    try:
+        handle = opener(path, binary=True)
+        contigs, _n, first = read_header(handle)
+        layout = GenomeLayout(contigs)
+        blocks = list(ReadStream(handle, first).blocks())
+        handle.close()
+        total_mb = sum(len(b) for b in blocks) / 1e6
+        for nt in threads_list:
+            best = None
+            for _rep in range(3):
+                counts = np.zeros((layout.total_len, 6), dtype=np.int32)
+                dec = ParallelFusedDecoder(layout, counts, n_threads=nt)
+                t0 = time.perf_counter()
+                for _ in dec.encode_blocks(iter(blocks)):
+                    pass
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            emit({"metric": "fused_decode", "threads": nt,
+                  "effective_threads": dec.n_threads,
+                  "sec": round(best, 4),
+                  "mb_per_s": round(total_mb / best, 1),
+                  "reads": dec.n_reads})
+            log(f"[decode] threads={nt}: {best:.3f}s "
+                f"({total_mb / best:.0f} MB/s)")
+    finally:
+        os.unlink(path)
+
+
+def measure_vote(threads_list, L=4 << 20):
+    from sam2consensus_tpu import native
+    from sam2consensus_tpu.ops.vote import vote_positions_native
+
+    if native.load() is None:
+        emit({"metric": "native_vote", "error": "native lib unavailable"})
+        return
+    rng = np.random.default_rng(7)
+    counts = rng.integers(0, 60, (L, 6)).astype(np.int32)
+    for T, thresholds in ((1, [0.25]), (3, [0.25, 0.5, 0.75])):
+        for nt in threads_list:
+            best = None
+            for _rep in range(3):
+                t0 = time.perf_counter()
+                out = vote_positions_native(counts, thresholds, 1,
+                                            threads=nt)
+                dt = time.perf_counter() - t0
+                assert out is not None
+                best = dt if best is None else min(best, dt)
+            emit({"metric": "native_vote", "threads": nt,
+                  "n_thresholds": T, "positions": L,
+                  "sec": round(best, 4),
+                  "mpos_per_s_per_thr": round(L / best / 1e6 / T, 1)})
+            log(f"[vote] T={T} threads={nt}: {best:.3f}s "
+                f"({L / best / 1e6:.0f} Mpos/s)")
+
+
+def main():
+    threads_list = [int(t) for t in os.environ.get(
+        "S2C_SCALING_THREADS", "1,2,4").split(",")]
+    measure_decode(threads_list)
+    measure_vote(threads_list)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
