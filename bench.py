@@ -241,10 +241,16 @@ def util_fields(stats, jax_time):
         u["pileup_mcells_per_s"] = round(mcells, 1)
         if any(k.startswith("scatter_") for k in pileup):
             # % of the measured on-chip scatter roofline (PERF.md §1:
-            # ~53 M cells/s data-resident; override for other chips)
-            roof = float(os.environ.get(
-                "S2C_BENCH_SCATTER_ROOFLINE_MCELLS", "53"))
-            u["scatter_roofline_pct"] = round(100.0 * mcells / roof, 1)
+            # ~53 M cells/s data-resident; override for other chips).
+            # Only meaningful when the device IS the chip — the
+            # cpu-fallback bench would report nonsense percentages
+            import jax
+
+            if jax.default_backend() == "tpu":
+                roof = float(os.environ.get(
+                    "S2C_BENCH_SCATTER_ROOFLINE_MCELLS", "53"))
+                u["scatter_roofline_pct"] = round(
+                    100.0 * mcells / roof, 1)
     if "mxu_blowup" in pileup:
         # 100% = every MXU lane carried a real row; padding is the loss
         u["mxu_occupancy_pct"] = round(100.0 / pileup["mxu_blowup"], 1)
