@@ -97,7 +97,7 @@ def probe_accelerator():
     return False, "", 0, last_err
 
 
-def build_configs(n_devices: int):
+def build_configs(n_devices: int, platform: str = ""):
     """Per-config rows pin ``shards=1`` so every row is a clean single-chip
     number (BASELINE.md's primary metric is bases/sec/chip); when more than
     one device is up, the headline also runs a ``sharded`` variant over all
@@ -164,12 +164,16 @@ def build_configs(n_devices: int):
          # ran — host_fused vs scatter_*); the +device variant pins the
          # chip pileup AND the device tail so the chip does all the work
          # and its efficiency is a measured number (VERDICT r3 #3); the
-         # +mxu variant measures the one-hot-matmul pileup's occupancy
+         # +mxu variant measures the one-hot-matmul pileup's occupancy —
+         # on the REAL chip only: the one-hot matmul is ~5000 FLOPs per
+         # aligned base, free on the systolic array and ~80 s of scalar
+         # work on the XLA-CPU fallback
          {"thresholds": [0.25]},
          {"device": {"pileup": "scatter",
                      "_env": {"S2C_TAIL_DEVICE": "default"}},
-          "mxu": {"pileup": "mxu",
-                  "_env": {"S2C_TAIL_DEVICE": "default"}}}, {}),
+          **({"mxu": {"pileup": "mxu",
+                      "_env": {"S2C_TAIL_DEVICE": "default"}}}
+             if platform == "tpu" else {})}, {}),
         ("amplicon_deep",
          SimSpec(n_contigs=1, contig_len=400, n_reads=n(100000),
                  read_len=80, ins_read_rate=0.3, del_read_rate=0.2,
@@ -242,11 +246,11 @@ def util_fields(stats, jax_time):
         if any(k.startswith("scatter_") for k in pileup):
             # % of the measured on-chip scatter roofline (PERF.md §1:
             # ~53 M cells/s data-resident; override for other chips).
-            # Only meaningful when the device IS the chip — the
-            # cpu-fallback bench would report nonsense percentages
+            # Only meaningful when the device is a real accelerator —
+            # the cpu-fallback bench would report nonsense percentages
             import jax
 
-            if jax.default_backend() == "tpu":
+            if jax.default_backend() != "cpu":
                 roof = float(os.environ.get(
                     "S2C_BENCH_SCATTER_ROOFLINE_MCELLS", "53"))
                 u["scatter_roofline_pct"] = round(
@@ -420,7 +424,7 @@ def main():
         rows = []
         with tempfile.TemporaryDirectory() as tmp:
             for name, spec, cfg_kwargs, variants, extras in build_configs(
-                    n_dev if ok else 1):
+                    n_dev if ok else 1, platform if ok else "cpu"):
                 if only and name not in only:
                     continue
                 try:

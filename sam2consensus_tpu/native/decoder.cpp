@@ -883,13 +883,12 @@ extern "C" void s2c_accumulate_rows(
 // failing the emit gate (cov == 0 or cov < min_depth) get sentinel 0.
 namespace {
 
-// one position range of the vote; [lo, hi) is an independent slice, so
-// multi-core hosts split the genome across threads (out_syms rows are
-// strided by the FULL length)
-void vote_range(const int32_t* counts, int64_t L, int64_t lo, int64_t hi,
-                const double* thresholds, long T, long min_depth,
-                const unsigned char* lut64, unsigned char* out_syms,
-                int32_t* out_cov) {
+// scalar position vote over [lo, hi) (the semantics reference for the
+// SIMD path below, and the portable fallback / remainder handler)
+void vote_range_scalar(const int32_t* counts, int64_t L, int64_t lo,
+                       int64_t hi, const double* thresholds, long T,
+                       long min_depth, const unsigned char* lut64,
+                       unsigned char* out_syms, int32_t* out_cov) {
   for (int64_t p = lo; p < hi; ++p) {
     const int32_t* c = counts + p * 6;
     const int32_t cov =
@@ -919,6 +918,144 @@ void vote_range(const int32_t* counts, int64_t L, int64_t lo, int64_t hi,
       out_syms[t * L + p] = lut64[mask];
     }
   }
+}
+
+#ifdef S2C_SIMD
+// AVX-512 position vote: 16 positions per iteration.
+//
+// Layout: 16 positions x 6 lanes = 96 interleaved int32 = six zmm loads,
+// transposed to per-lane vectors C[0..5] with three maskz_permutex2var
+// picks (disjoint masks, OR-merged) per lane.  The strictly-greater sums
+// and the threshold comparison run in the DOUBLE domain — every count
+// converts exactly (|c| < 2^31 < 2^53) and sums of five lanes stay
+// exact, so the comparison `S < ceil(t*cov)` reproduces the device's
+// exact-integer semantics (ops/cutoff.py).  Shared precondition with
+// the scalar path and the device: per-position coverage < 2^31 (the
+// scalar's int32 sums are signed-overflow UB past that; here only the
+// results would diverge).
+// The 64-entry mask->byte LUT is one vpermb over a zmm-resident table.
+// Byte output per threshold goes through the same emit gate as the
+// scalar path (cov > 0 and cov >= min_depth, else sentinel 0).
+void vote_range_simd(const int32_t* counts, int64_t L, int64_t lo,
+                     int64_t hi, const double* thresholds, long T,
+                     long min_depth, const unsigned char* lut64,
+                     unsigned char* out_syms, int32_t* out_cov) {
+  // transpose pick tables: lane i's 16 values sit at flat index i + 6j
+  // (j = 0..15) across the six source registers
+  __m512i idx[6][3];
+  __mmask16 pm[6][3];
+  for (int i = 0; i < 6; ++i) {
+    alignas(64) int32_t ix[3][16];
+    uint16_t m[3] = {0, 0, 0};
+    for (int j = 0; j < 16; ++j) {
+      const int f = i + 6 * j;
+      const int grp = f >> 5;            // which (z2g, z2g+1) pair
+      ix[0][j] = ix[1][j] = ix[2][j] = 0;
+      ix[grp][j] = f & 31;
+      m[grp] = static_cast<uint16_t>(m[grp] | (1u << j));
+    }
+    for (int g = 0; g < 3; ++g) {
+      idx[i][g] = _mm512_load_si512(ix[g]);
+      pm[i][g] = m[g];
+    }
+  }
+  const __m512i lut_z = _mm512_loadu_si512(lut64);
+  const int64_t md = min_depth < 1 ? 1 : min_depth;
+  const __m512i md_v = _mm512_set1_epi32(static_cast<int32_t>(
+      md > 2147483647 ? 2147483647 : md));
+
+  int64_t p = lo;
+  for (; p + 16 <= hi; p += 16) {
+    const int32_t* base = counts + p * 6;
+    __m512i z[6];
+    for (int g = 0; g < 6; ++g)
+      z[g] = _mm512_loadu_si512(base + 16 * g);
+    __m512i C[6];
+    for (int i = 0; i < 6; ++i) {
+      __m512i r = _mm512_maskz_permutex2var_epi32(
+          pm[i][0], z[0], idx[i][0], z[1]);
+      r = _mm512_or_si512(r, _mm512_maskz_permutex2var_epi32(
+          pm[i][1], z[2], idx[i][1], z[3]));
+      C[i] = _mm512_or_si512(r, _mm512_maskz_permutex2var_epi32(
+          pm[i][2], z[4], idx[i][2], z[5]));
+    }
+    __m512i cov = C[0];
+    for (int i = 1; i < 6; ++i) cov = _mm512_add_epi32(cov, C[i]);
+    _mm512_storeu_si512(out_cov + p, cov);
+    const __mmask16 emit =
+        _mm512_cmpge_epi32_mask(cov, md_v);      // cov >= max(1, md)
+
+    // exact doubles: counts, cov, and the strictly-greater sums
+    __m512d Cd[6][2], Sd[6][2];
+    for (int i = 0; i < 6; ++i) {
+      Cd[i][0] = _mm512_cvtepi32_pd(_mm512_castsi512_si256(C[i]));
+      Cd[i][1] = _mm512_cvtepi32_pd(_mm512_extracti32x8_epi32(C[i], 1));
+    }
+    for (int i = 0; i < 6; ++i)
+      for (int h = 0; h < 2; ++h) {
+        __m512d s = _mm512_setzero_pd();
+        for (int j = 0; j < 6; ++j) {
+          if (j == i) continue;
+          const __mmask8 gt =
+              _mm512_cmp_pd_mask(Cd[j][h], Cd[i][h], _CMP_GT_OQ);
+          s = _mm512_mask_add_pd(s, gt, s, Cd[j][h]);
+        }
+        Sd[i][h] = s;
+      }
+    const __m512d covd0 = _mm512_cvtepi32_pd(_mm512_castsi512_si256(cov));
+    const __m512d covd1 =
+        _mm512_cvtepi32_pd(_mm512_extracti32x8_epi32(cov, 1));
+    __mmask16 nonzero[6];
+    for (int i = 0; i < 6; ++i)
+      nonzero[i] = _mm512_cmpneq_epi32_mask(C[i], _mm512_setzero_si512());
+
+    for (long t = 0; t < T; ++t) {
+      const __m512d tv = _mm512_set1_pd(thresholds[t]);
+      // ceil via roundscale toward +inf (suppress exceptions): the
+      // float64 product rounds RNE exactly like the scalar/oracle path
+      const __m512d cut0 = _mm512_roundscale_pd(
+          _mm512_mul_pd(tv, covd0), 0x0A);
+      const __m512d cut1 = _mm512_roundscale_pd(
+          _mm512_mul_pd(tv, covd1), 0x0A);
+      __m512i mv = _mm512_setzero_si512();
+      for (int i = 0; i < 6; ++i) {
+        const __mmask8 lt0 =
+            _mm512_cmp_pd_mask(Sd[i][0], cut0, _CMP_LT_OQ);
+        const __mmask8 lt1 =
+            _mm512_cmp_pd_mask(Sd[i][1], cut1, _CMP_LT_OQ);
+        const __mmask16 inc = nonzero[i]
+            & static_cast<__mmask16>(lt0 | (static_cast<unsigned>(lt1)
+                                            << 8));
+        mv = _mm512_mask_or_epi32(mv, inc, mv,
+                                  _mm512_set1_epi32(1 << i));
+      }
+      // 6-bit mask -> output byte: one vpermb over the 64-entry table
+      const __m128i mb = _mm512_cvtepi32_epi8(mv);
+      const __m512i sym_z = _mm512_permutexvar_epi8(
+          _mm512_castsi128_si512(mb), lut_z);
+      const __m128i sym = _mm_maskz_mov_epi8(
+          emit, _mm512_castsi512_si128(sym_z));
+      _mm_storeu_si128(
+          reinterpret_cast<__m128i*>(out_syms + t * L + p), sym);
+    }
+  }
+  if (p < hi)
+    vote_range_scalar(counts, L, p, hi, thresholds, T, min_depth, lut64,
+                      out_syms, out_cov);
+}
+#endif  // S2C_SIMD
+
+inline void vote_range(const int32_t* counts, int64_t L, int64_t lo,
+                       int64_t hi, const double* thresholds, long T,
+                       long min_depth, const unsigned char* lut64,
+                       unsigned char* out_syms, int32_t* out_cov) {
+#ifdef S2C_SIMD
+  vote_range_simd(counts, L, lo, hi, thresholds, T, min_depth, lut64,
+                  out_syms, out_cov);
+#else
+  vote_range_scalar(counts, L, lo, hi, thresholds, T, min_depth, lut64,
+                    out_syms, out_cov);
+#endif
 }
 
 }  // namespace
