@@ -392,7 +392,8 @@ class JaxBackend:
             if strategy == "host" or (
                     strategy == "auto"
                     and layout.total_len <= host_pileup_max_len(
-                        _native_tail_possible(cfg))):
+                        _native_tail_possible(cfg),
+                        link_free=jax.default_backend() == "cpu")):
                 # wire-cost policy, measured on the tunneled chip: see
                 # HostPileupAccumulator's docstring and
                 # ops.pileup.host_pileup_max_len (the bound widens when
@@ -559,11 +560,16 @@ class JaxBackend:
             # An explicit pallas insertion kernel keeps the device tail:
             # interpret-mode Pallas on CPU can dwarf the saved link
             # latency at scale.
-            if (_tail_cpu_wins(total_len, n_thresholds,
-                               total_len * NUM_SYMBOLS
-                               * acc.wire_itemsize(),
-                               _native_tail_possible(cfg),
-                               aligned_bases=stats.aligned_bases)
+            # when the default backend IS the local cpu there is no link
+            # and nothing to route (link_free covers the tail below); the
+            # cost-model call would still pay wire_itemsize's full-tensor
+            # max scan (~0.1 s at 40 M positions) for nothing
+            if (jax.default_backend() != "cpu"
+                    and _tail_cpu_wins(total_len, n_thresholds,
+                                       total_len * NUM_SYMBOLS
+                                       * acc.wire_itemsize(),
+                                       _native_tail_possible(cfg),
+                                       aligned_bases=stats.aligned_bases)
                     and getattr(cfg, "ins_kernel", "scatter") != "pallas"):
                 try:
                     cpus = jax.devices("cpu")
@@ -875,9 +881,20 @@ class JaxBackend:
         if nat is None:
             return None
         syms, cov = nat
-        csum = np.concatenate([np.zeros(1, np.int64),
-                               np.cumsum(cov, dtype=np.int64)])
-        contig_sums = csum[layout.offsets[1:]] - csum[layout.offsets[:-1]]
+        # per-contig coverage sums via one segmented reduction — a full
+        # int64 prefix sum measured ~0.6 s at 40 M positions, ~10x this.
+        # reduceat runs over NON-EMPTY contigs only: empty segments make
+        # reduceat return cov[start] (and shift their neighbors' spans
+        # when clamped), so they are zeroed structurally instead.  The
+        # filtered starts are strictly increasing, and zero-width
+        # contigs between two non-empty ones add no positions, so each
+        # reduceat segment is exactly that contig's position range.
+        offs = layout.offsets
+        nonempty = offs[1:] > offs[:-1]
+        contig_sums = np.zeros(len(offs) - 1, dtype=np.int64)
+        if nonempty.any():
+            contig_sums[nonempty] = np.add.reduceat(
+                cov, offs[:-1][nonempty], dtype=np.int64)
         return syms, cov, contig_sums
 
     @staticmethod

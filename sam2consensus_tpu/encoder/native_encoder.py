@@ -22,6 +22,7 @@ corpus is pinned by tests/test_native.py.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -35,6 +36,15 @@ from .events import (EncodeError, GenomeLayout, MIN_BUCKET_W, ReadEncoder,
 
 def available() -> bool:
     return native.load() is not None
+
+
+def fused_direct_mode(total_len: int) -> bool:
+    """True when the fused pileup counts straight into the int32 tensor
+    (huge genomes: sparse per-line coverage, and the uint8 shadow's
+    L-proportional merge would dominate).  One definition shared by the
+    encoder and ParallelFusedDecoder's memory cap."""
+    return total_len >= int(os.environ.get(
+        "S2C_FUSED_DIRECT_MIN_LEN", str(1 << 23)))
 
 
 def _line_end(data: np.ndarray, start: int) -> int:
@@ -84,11 +94,24 @@ class NativeReadEncoder:
                                  "int32 [total_len, 6]")
             self._acc_flat = accumulate_into.reshape(-1)
             self._acc_len = layout.total_len
-            # np.zeros -> calloc: the overflow bank's pages only material-
-            # ize where depth actually passes 255
-            self._acc_u8 = np.zeros(layout.total_len * 6, dtype=np.uint8)
-            self._acc_ovf = np.zeros(layout.total_len * 6, dtype=np.int32)
+            # counting mode by genome size: the uint8 shadow wins when
+            # coverage is deep (count lines revisited many times) but
+            # pays an L-proportional merge; huge genomes are sparse per
+            # line, so counts go STRAIGHT into the int32 pileup (passed
+            # as the C side's acc_ovf) — no shadow, no merge
+            self._acc_direct = fused_direct_mode(layout.total_len)
+            if self._acc_direct:
+                self._acc_u8 = np.zeros(6, dtype=np.uint8)   # unused
+                self._acc_ovf = self._acc_flat
+            else:
+                # np.zeros -> calloc: the overflow bank's pages only
+                # materialize where depth actually passes 255
+                self._acc_u8 = np.zeros(layout.total_len * 6,
+                                        dtype=np.uint8)
+                self._acc_ovf = np.zeros(layout.total_len * 6,
+                                         dtype=np.int32)
         else:
+            self._acc_direct = False
             self._acc_flat = np.zeros(6, dtype=np.int32)   # dummy, len 0
             self._acc_u8 = np.zeros(6, dtype=np.uint8)
             self._acc_ovf = np.zeros(6, dtype=np.int32)
@@ -167,7 +190,8 @@ class NativeReadEncoder:
                     ich, chars_cap,
                     ovf, ovf_cap,
                     out,
-                    self._acc_u8, self._acc_ovf, self._acc_len)
+                    self._acc_u8, self._acc_ovf, self._acc_len,
+                    1 if self._acc_direct else 0)
 
                 (n_rows, n_reads, n_skipped, consumed, n_ins, n_chars,
                  status, _err_off, n_events, n_lines, n_overflow,
@@ -245,8 +269,9 @@ class NativeReadEncoder:
         always equals the true count).  Runs automatically at stream end;
         the backend also calls it before snapshotting a checkpoint, whose
         contract is that ``accumulate_into`` reflects every committed
-        batch."""
-        if self._acc is None:
+        batch.  Direct-mode runs (huge genomes) counted straight into
+        the pileup — nothing to merge."""
+        if self._acc is None or self._acc_direct:
             return
         np.add(self._acc_flat, self._acc_u8[:self._acc_len * 6],
                out=self._acc_flat)

@@ -55,10 +55,17 @@ class ParallelFusedDecoder:
                  strict: bool = True, on_lines=None, on_bytes=None):
         self.layout = layout
         self._counts = counts                 # worker 0 writes here
-        # per-extra-worker memory: its int32 count tensor plus the fused
-        # decoder's uint8 shadow and (worst case, deep coverage) the int32
-        # overflow bank — 2.25x the count tensor alone
-        extra_each = max(1, counts.nbytes + (counts.nbytes * 5) // 4)
+        # per-extra-worker memory: its int32 count tensor, plus — in
+        # shadow mode only — the fused decoder's uint8 shadow and (worst
+        # case, deep coverage) int32 overflow bank, 2.25x the tensor
+        # alone.  Direct mode (huge genomes) allocates neither, and is
+        # exactly where under-capping would hurt most.
+        from .native_encoder import fused_direct_mode
+
+        if fused_direct_mode(layout.total_len):
+            extra_each = max(1, counts.nbytes)
+        else:
+            extra_each = max(1, counts.nbytes + (counts.nbytes * 5) // 4)
         cap = 1 + self.EXTRA_COUNTS_BUDGET // extra_each
         self.n_threads = max(1, min(n_threads, cap))
         self.insertions = InsertionEvents()

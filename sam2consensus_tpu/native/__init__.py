@@ -99,6 +99,7 @@ def load() -> Optional[ctypes.CDLL]:
         i64p,                                  # out stats
         u8p, i32p, ctypes.c_int64,             # fused pileup u8 shadow,
                                                #   +256 overflow bank, len
+        ctypes.c_long,                         # direct int32 mode flag
     ]
     lib.s2c_accumulate_rows.restype = None
     lib.s2c_accumulate_rows.argtypes = [

@@ -328,13 +328,23 @@ extern "C" long s2c_decode(
     int64_t* out,
     // fused host pileup (ops/pileup.py HostPileupAccumulator): when
     // acc_total_len > 0, every committed row is accumulated — AFTER its
-    // bad-base / maxdel fate is settled, so no rollback paths exist —
-    // into the uint8 shadow tensor acc_u8 [acc_total_len * 6] with
-    // saturation wraps banked in acc_ovf (+256 per wrap; see u8_inc /
-    // count_row_u8).  The wrapper merges shadow + bank into the int32
-    // pileup at stream end.  Rows are still written to the slab (the
-    // wrapper treats it as scratch and resets its fill).
-    unsigned char* acc_u8, int32_t* acc_ovf, int64_t acc_total_len) {
+    // bad-base / maxdel fate is settled, so no rollback paths exist.
+    // Two counting modes (the wrapper picks by genome size):
+    //  * acc_direct == 0: SIMD one-hot increments into the uint8 shadow
+    //    tensor acc_u8 [acc_total_len * 6], saturation wraps banked in
+    //    acc_ovf (+256 per wrap; see u8_inc / count_row_u8) — 4x fewer
+    //    cache lines on the hot increments, right when coverage is deep
+    //    (counts revisited many times); the wrapper merges shadow+bank
+    //    into the int32 pileup at stream end.
+    //  * acc_direct != 0: plain int32 increments straight into acc_ovf,
+    //    which IS the pileup tensor then (acc_u8 unused) — no shadow
+    //    init and no L-proportional merge, right for huge sparse
+    //    genomes where each count line is touched ~once and a 240 MB
+    //    shadow merge would dominate (measured: 40 Mbp config).
+    // Rows are still written to the slab (the wrapper treats it as
+    // scratch and resets its fill).
+    unsigned char* acc_u8, int32_t* acc_ovf, int64_t acc_total_len,
+    long acc_direct) {
   NameTable table;
   table.build(names, name_off, n_contigs);
 
@@ -655,15 +665,30 @@ extern "C" long s2c_decode(
         pads += gaps;
       }
       if (span > 0) {
-        memset(dst + span, kPad, width - span);
+        if (acc_total_len == 0) {
+          // fused mode skips the pad-tail memset: the slab is scratch
+          // there (the wrapper resets its fill; counting below reads
+          // only [0, span)) — ~width-span bytes/row of saved writes
+          memset(dst + span, kPad, width - span);
+        }
         starts[n_rows] = static_cast<int32_t>(ctg_offset[ci] + pos);
         ++n_rows;
         n_events += span - pads;
         // fused pileup: the row's final codes are still cache-hot —
         // bounds guaranteed (pos >= 0, structural validation pinned
         // pos + span <= reflen)
-        if (acc_total_len > 0)
-          count_row_u8(dst, span, ctg_offset[ci] + pos, acc_u8, acc_ovf);
+        if (acc_total_len > 0) {
+          if (acc_direct) {
+            int32_t* ap = acc_ovf + (ctg_offset[ci] + pos) * 6;
+            for (long k = 0; k < span; ++k) {
+              const unsigned char cd = dst[k];
+              if (cd < 6) ++ap[k * 6 + cd];
+            }
+          } else {
+            count_row_u8(dst, span, ctg_offset[ci] + pos, acc_u8,
+                         acc_ovf);
+          }
+        }
       }
       ++n_reads;
       i = next;
@@ -812,8 +837,12 @@ extern "C" long s2c_decode(
           const int64_t gp = (k < neg)
               ? base_off + reflen + pos + k
               : base_off + (pos < 0 ? 0 : pos) + (k - neg);
-          if (gp >= 0 && gp < acc_total_len)
-            u8_inc(acc_u8 + gp * 6 + code, acc_ovf + gp * 6 + code);
+          if (gp >= 0 && gp < acc_total_len) {
+            if (acc_direct)
+              ++acc_ovf[gp * 6 + code];
+            else
+              u8_inc(acc_u8 + gp * 6 + code, acc_ovf + gp * 6 + code);
+          }
         }
       }
     }

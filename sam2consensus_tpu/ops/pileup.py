@@ -198,19 +198,25 @@ class PileupAutoTuner:
 HOST_PILEUP_MAX_LEN = 1 << 21
 
 
-def host_pileup_max_len(native_tail: bool = False) -> int:
+def host_pileup_max_len(native_tail: bool = False,
+                        link_free: bool = False) -> int:
     """The auto gate's genome-length bound, by what the tail would cost.
 
     When the caller can actually serve the tail with the native C++ vote
     (``native_tail`` — the library loads AND nothing forces the tail
     onto the device or a fused wire encoding; the backend computes
     this), a host-counts run never touches the link at all: the tail
-    votes at ~31 ns/position locally, while the device path's FLOOR is
+    votes at ~7 ns/position locally, while the device path's FLOOR is
     two link round trips plus ~0.5 B/aligned-base of rows up and the
     symbols back.  Up to ~2^23 positions the local vote stays under
-    that floor for any read depth, so the gate widens 4x.  Otherwise
-    the tail would fall to the XLA CPU vote (~5 M positions/s/thread)
-    or a counts upload, and the narrow bound is the measured choice
+    that floor for any read depth, so the gate widens 4x.  When the
+    default backend additionally IS the local cpu (``link_free`` — the
+    "device" shares the host's memory), the bound vanishes entirely:
+    there is no wire to bill at any genome size, and the fused C++
+    decode+count runs at memory speed where the XLA-CPU scatter pays
+    ~100 ns/cell (measured: the 40 Mbp config's accumulate fell ~1 s →
+    ~0.1 s).  Otherwise the tail would fall to the XLA CPU vote or a
+    counts upload, and the narrow bound is the measured choice
     (PERF.md).  Override with S2C_HOST_PILEUP_MAX_LEN.
     """
     import os
@@ -223,6 +229,8 @@ def host_pileup_max_len(native_tail: bool = False) -> int:
             raise RuntimeError(
                 f"S2C_HOST_PILEUP_MAX_LEN={env!r}: expected a plain "
                 f"integer position count (e.g. 8388608)") from None
+    if native_tail and link_free:
+        return 1 << 62
     return (1 << 23) if native_tail else HOST_PILEUP_MAX_LEN
 
 

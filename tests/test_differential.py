@@ -217,3 +217,17 @@ def test_short_seq_insertion_key_uses_claimed_cursor():
     text = sam_text([("r", 20)], [("r", 1, "6M2I2M", "ACGGT"),
                                   ("r", 1, "20M", "A" * 20)])
     assert_identical(text)
+
+
+def test_trailing_empty_contig_contig_sums():
+    """A zero-length contig at the END of the layout must not shift or
+    truncate its neighbors' per-contig coverage sums (round-4 review:
+    the segmented-reduction rewrite clamped the empty contig's start
+    into the last real position and dropped cov[L-1] from the final
+    non-empty contig)."""
+    text = sam_text([("a", 3), ("mid0", 0), ("b", 4), ("z", 0)], [
+        ("a", 1, "3M", "ACG"),
+        ("b", 1, "4M", "TTTT"),
+        ("b", 4, "1M", "T"),       # covers b's last position, cov[L-1]
+    ])
+    assert_identical(text)
