@@ -371,3 +371,34 @@ def test_fused_decode_accumulate_equals_two_pass():
                                   acc_fused.counts_host())
     assert enc_two.n_reads == enc_fused.n_reads
     assert acc_fused.strategy_used.get("host_fused", 0) > 0
+
+
+def test_fused_direct_and_shadow_modes_byte_identical(monkeypatch):
+    """The fused pileup's two counting modes — uint8 shadow (+256
+    overflow bank, merged at stream end) and direct int32 (huge-genome
+    mode, no shadow) — are one semantics: forcing each on the same
+    input produces byte-identical output and identical counts vs the
+    oracle (round 4: the mode gate is genome size, S2C_FUSED_DIRECT_MIN_LEN)."""
+    text = simulate(SimSpec(n_contigs=4, contig_len=300, n_reads=2000,
+                            read_len=50, ins_read_rate=0.1,
+                            del_read_rate=0.1, seed=77))
+    from sam2consensus_tpu.io.sam import ReadStream
+
+    def run_stream(backend, cfg):
+        handle = io.StringIO(text)
+        contigs, _n, first = read_header(handle)
+        res = backend.run(contigs, ReadStream(handle, first), cfg)
+        return ({n: render_file(r, 0) for n, r in res.fastas.items()},
+                res.stats)
+
+    cfg = RunConfig(prefix="t", thresholds=[0.25, 0.75], shards=1,
+                    pileup="host")
+    out_cpu, _ = _run(text, CpuBackend(), cfg)
+    monkeypatch.setenv("S2C_FUSED_DIRECT_MIN_LEN", "1")   # force direct
+    out_direct, st_d = run_stream(JaxBackend(), cfg)
+    monkeypatch.setenv("S2C_FUSED_DIRECT_MIN_LEN", str(1 << 60))  # shadow
+    out_shadow, st_s = run_stream(JaxBackend(), cfg)
+    assert out_direct == out_cpu
+    assert out_shadow == out_cpu
+    assert st_d.extra["pileup"].get("host_fused", 0) > 0
+    assert st_s.extra["pileup"].get("host_fused", 0) > 0
