@@ -302,6 +302,11 @@ def _jax_row(name, path, cfg_kwargs, overrides, cpu_time, cpu_out):
         _s, _t, _o = run_once(backend, path, vcfg, binary=True)
         jax_stats, jax_time, jax_out = run_once(backend, path, vcfg,
                                                 binary=True)
+        if jax_time < 10.0:
+            # same noise argument as the oracle side: best of two
+            s3, t3, o3 = run_once(backend, path, vcfg, binary=True)
+            if t3 < jax_time:
+                jax_stats, jax_time, jax_out = s3, t3, o3
     finally:
         for k, v in saved.items():
             if v is None:
@@ -373,10 +378,12 @@ def bench_config(name, spec, cfg_kwargs, jax_variants, tmp, extras=None):
     path = _write_sim(spec, name, tmp)
     cpu_stats, cpu_time, cpu_out = run_once(CpuBackend(), path, cfg,
                                             binary=False)
-    if cpu_time < 3.0:
-        # sub-second oracle runs are dominated by first-touch noise (page
-        # cache, allocator warmup) while the jax side gets a warm run —
-        # take the best of two so small-config ratios are stable
+    if cpu_time < 60.0:
+        # the one-core host's absolute speed swings ~2x run to run
+        # (page cache, allocator warmup, background probes), which is
+        # most of the row-to-row ratio noise — take the best of two
+        # whenever the re-run is affordable (covers every config except
+        # the ~200 s wide-genome oracle)
         _s2, t2, _o2 = run_once(CpuBackend(), path, cfg, binary=False)
         cpu_time = min(cpu_time, t2)
     log(f"[{name}] cpu oracle: {cpu_time:.2f}s "
