@@ -16,6 +16,8 @@ import io
 import os
 import tempfile
 
+import pytest
+
 import numpy as np
 
 from sam2consensus_tpu.backends.cpu import CpuBackend
@@ -135,10 +137,18 @@ def test_auto_picks_host_below_threshold():
     assert HOST_PILEUP_MAX_LEN >= 300          # policy sanity
 
 
-def test_host_pileup_checkpoint_resume():
-    """Kill mid-run, resume with --pileup host: same bytes as one-shot."""
+@pytest.mark.parametrize("direct_min", [None, "1"])
+def test_host_pileup_checkpoint_resume(monkeypatch, direct_min):
+    """Kill mid-run, resume with --pileup host: same bytes as one-shot.
+    Parametrized over both fused-counting modes (direct_min="1" forces
+    the direct-int32 path; checkpoints there snapshot the pileup with no
+    shadow merge pending)."""
     from sam2consensus_tpu.io.sam import ReadStream, opener
 
+    if direct_min is None:
+        monkeypatch.delenv("S2C_FUSED_DIRECT_MIN_LEN", raising=False)
+    else:
+        monkeypatch.setenv("S2C_FUSED_DIRECT_MIN_LEN", direct_min)
     text = simulate(SimSpec(n_contigs=3, contig_len=120, n_reads=300,
                             read_len=30, seed=45))
     with tempfile.TemporaryDirectory() as tmp:
