@@ -16,7 +16,6 @@ variant of the same contraction lives in ``pallas_insertion.py``.
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp
