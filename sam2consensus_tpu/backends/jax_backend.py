@@ -76,21 +76,42 @@ def _link_constants() -> tuple:
     bps_env = os.environ.get("S2C_TAIL_LINK_MBPS")
     rt = float(rt_env) / 1e3 if rt_env else None
     bps = float(bps_env) * 1e6 if bps_env else None
-    if (rt is None or bps is None) \
-            and os.environ.get("S2C_LINK_PROBE", "1") != "0":
+    if rt is None or bps is None:
+        probed = _probed_link()
+        if probed is not None:
+            if rt is None:
+                rt = probed[0]
+            if bps is None:
+                bps = probed[1]
+    return (TAIL_RT_SEC_DEFAULT if rt is None else rt,
+            TAIL_LINK_BPS_DEFAULT if bps is None else bps)
+
+
+def _probed_link():
+    """(rt_sec, bps) from the cached startup probe, or None when probing
+    is disabled (S2C_LINK_PROBE=0), impossible, or failed.  The one
+    probe-gating definition shared by every link-rate consumer."""
+    if os.environ.get("S2C_LINK_PROBE", "1") != "0":
         import jax
 
         if jax.default_backend() != "cpu":
             from ..utils.linkprobe import probe_link
 
-            probed = probe_link()
-            if probed is not None:
-                if rt is None:
-                    rt = probed[0]
-                if bps is None:
-                    bps = probed[1]
-    return (TAIL_RT_SEC_DEFAULT if rt is None else rt,
-            TAIL_LINK_BPS_DEFAULT if bps is None else bps)
+            return probe_link()
+    return None
+
+
+def _measured_link_bps():
+    """Link rate for gate-WIDENING decisions (host_pileup_max_len's
+    slow-link bypass): an env override or a successful probe only —
+    never the baked rig default, which (at 40 MB/s, below the bypass
+    threshold) would unbound the host gate on a fast-linked machine
+    whose probe didn't run."""
+    bps_env = os.environ.get("S2C_TAIL_LINK_MBPS")
+    if bps_env:
+        return float(bps_env) * 1e6
+    probed = _probed_link()
+    return probed[1] if probed is not None else None
 TAIL_CPU_POS_PER_SEC = float(os.environ.get(
     "S2C_TAIL_CPU_MPOS_S", "5.2")) * 1e6
 #: the C++ vote's measured costs (native/decoder.cpp s2c_vote at L=1M:
@@ -109,12 +130,38 @@ P5_HOST_NS_PER_CHAR = float(os.environ.get("S2C_P5_HOST_NS", "5.5"))
 #: device-side cost of the packed5 plane split.  The first formulation
 #: (32-way one-hot re-select of the ASCII output + stride-2 slicing)
 #: measured ~22 ns/char on the chip at L = 40M — worse than the wire it
-#: saved on the 40 MB/s link; the current one votes directly in code5
-#: (zero re-encode) and packs with contiguous reshapes.  The default
-#: keeps the measured pessimistic value until the rewrite is measured
-#: on hardware: with it, auto picks packed5 only where even the slow
-#: formulation would genuinely win (modeled links under ~14 MB/s).
-P5_DEV_NS_PER_CHAR = float(os.environ.get("S2C_P5_DEV_NS", "22"))
+#: saved on the 40 MB/s link.  The current one votes directly in code5
+#: (zero re-encode) and packs with contiguous reshapes; measured on the
+#: TPU v5 lite at 1.3 ns/char (L = 40M) and 1.9 ns/char (L = 4.6M)
+#: (tools/measure_p5.py, campaign/measure_p5.jsonl round 4: packed5
+#: end-to-end 1.75 s vs dense 2.78 s at L = 40M on the ~15 MB/s
+#: tunnel).  The default prices the slower small-L figure, so auto
+#: picks packed5 whenever the link is below ~190 MB/s — on faster
+#: links the 0.375 B/char wire saving stops covering even 2 ns of
+#: device packing.
+P5_DEV_NS_PER_CHAR = float(os.environ.get("S2C_P5_DEV_NS", "2"))
+#: --insertion-kernel auto window: the Pallas segmented reduce beats
+#: XLA scatter on-chip only for middling event counts (TPU v5 lite
+#: sweep, campaign/microbench_tpu.jsonl round 4: 0.91x at 2e4 events,
+#: 1.26x at 2e5, 1.09x at 2e6, 0.97x at 8e6) — the bounds below are
+#: the geometric means of the bracketing sweep points.  Outside the
+#: window, and for any host-routed or interpret-mode tail, scatter is
+#: the measured choice.
+PALLAS_INS_MIN_EVENTS = 65536
+PALLAS_INS_MAX_EVENTS = 4000000
+
+
+def _pallas_ins_auto(n_events: int, chip_tail: bool) -> bool:
+    """``--insertion-kernel auto``: pallas for chip-resident tails whose
+    insertion-event count falls in the kernel's measured winning window;
+    XLA scatter everywhere else (see the window constants above).  The
+    env overrides are read per call so a tuned rig's values apply
+    without import-order games."""
+    lo = int(float(os.environ.get("S2C_PALLAS_INS_MIN_EVENTS",
+                                  PALLAS_INS_MIN_EVENTS)))
+    hi = int(float(os.environ.get("S2C_PALLAS_INS_MAX_EVENTS",
+                                  PALLAS_INS_MAX_EVENTS)))
+    return chip_tail and lo <= n_events <= hi
 
 
 def _tail_cpu_wins(total_len: int, n_thresholds: int,
@@ -389,15 +436,22 @@ class JaxBackend:
             stats.extra["shard_mode"] = mode
         else:
             strategy = getattr(cfg, "pileup", "auto")
+            _link_free = jax.default_backend() == "cpu"
+            _native_ok = _native_tail_possible(cfg)
             if strategy == "host" or (
                     strategy == "auto"
                     and layout.total_len <= host_pileup_max_len(
-                        _native_tail_possible(cfg),
-                        link_free=jax.default_backend() == "cpu")):
+                        _native_ok,
+                        link_free=_link_free,
+                        # only pay the startup probe when the bound
+                        # would actually consult the link rate
+                        link_bps=_measured_link_bps()
+                        if _native_ok and not _link_free else None)):
                 # wire-cost policy, measured on the tunneled chip: see
                 # HostPileupAccumulator's docstring and
                 # ops.pileup.host_pileup_max_len (the bound widens when
-                # the native tail vote makes host runs link-free)
+                # the native tail vote makes host runs link-free, and
+                # vanishes when the probed link is tunnel-class slow)
                 acc = HostPileupAccumulator(layout.total_len)
             else:
                 acc = PileupAccumulator(layout.total_len, strategy=strategy)
@@ -526,6 +580,15 @@ class JaxBackend:
         if "stage_sec" in decode_times:
             stats.extra["stage_sec"] = round(decode_times["stage_sec"], 4)
         stats.extra["pileup_dispatch_sec"] = round(pileup_sec, 4)
+        if (os.environ.get("S2C_SYNC_ACCUMULATE") == "1"
+                and hasattr(acc, "sync")):
+            # opt-in (bench forced-device rows): device scatters are
+            # async — without this barrier accumulate_sec ends with the
+            # dispatch queue still draining and the drain is billed to
+            # the tail's first fetch, so the chip's cell rate is not
+            # attributable to any one phase
+            acc.sync()
+            stats.extra["accumulate_synced"] = True
         stats.extra["accumulate_sec"] = round(time.perf_counter() - t0, 4)
         if ck is not None and "incremental_base" not in stats.extra:
             stats.extra["resumed_from_line"] = ck.lines_consumed
@@ -642,7 +705,18 @@ class JaxBackend:
             # vote past n_cols and come back as skip sentinels
             kp = fused.next_pow2(k + 1)
             cp = fused.next_pow2(ins["max_cols"])
-            use_pallas = getattr(cfg, "ins_kernel", "scatter") == "pallas"
+            ik = getattr(cfg, "ins_kernel", "auto")
+            if ik == "auto":
+                # chip-resident tails only (never preempts the
+                # link-free native tail or the cpu-routed tail, never
+                # runs the kernel in interpret mode), and only inside
+                # the measured winning event-count window
+                chip_tail = (jax.default_backend() == "tpu"
+                             and tail_dev is None)
+                use_pallas = _pallas_ins_auto(len(ins["ev_key"]),
+                                              chip_tail)
+            else:
+                use_pallas = ik == "pallas"
 
             def padded_sites(pad_to):
                 sk = np.full(pad_to, -1, dtype=np.int32)

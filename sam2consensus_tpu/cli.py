@@ -90,9 +90,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "tensor once; single-device). scatter/mxu compose "
                         "with --shards in the dp shard layout")
     p.add_argument("--insertion-kernel", dest="ins_kernel",
-                   choices=["scatter", "pallas"], default="scatter",
-                   help="insertion-table build on device: XLA scatter "
-                        "(default) or the Pallas segmented-reduce kernel")
+                   choices=["auto", "scatter", "pallas"], default="auto",
+                   help="insertion-table build on device: XLA scatter or "
+                        "the Pallas segmented-reduce kernel. auto "
+                        "(default) picks pallas only for chip-resident "
+                        "tails inside its measured winning event-count "
+                        "window (TPU sweep, PERF.md) and never preempts "
+                        "the link-free native tail")
     p.add_argument("--decode-threads", dest="decode_threads", type=int,
                    default=1,
                    help="host worker threads (multi-core hosts; 0 = auto, "
@@ -197,6 +201,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     echo = (lambda *a, **k: None) if args.quiet else print
+
+    # A user's JAX_PLATFORMS must win even where a sitecustomize hook
+    # pre-registered a remote accelerator and overrode jax.config (the
+    # config trumps the env var; utils/platform.py) — without this,
+    # JAX_PLATFORMS=cpu against the CLI silently dials the remote chip
+    from .utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
 
     if cfg.shards and cfg.backend != "jax":
         raise SystemExit("--shards requires --backend jax")

@@ -37,7 +37,7 @@ class RunConfig:
     decoder: str = "auto"        # auto | native | py (jax backend host decode)
     pileup: str = "auto"         # auto | mxu | scatter | host (pileup strategy)
     decode_threads: int = 1      # fused-decode workers; 0 = auto (<=4)
-    ins_kernel: str = "scatter"  # scatter | pallas (insertion table build)
+    ins_kernel: str = "auto"  # auto | scatter | pallas (insertion table)
     shard_mode: str = "auto"     # auto | dp | sp | dpsp (accumulator layout)
     incremental: bool = False    # keep/extend checkpoints across input files
     source_id: str = ""          # identity of the input (for incremental)

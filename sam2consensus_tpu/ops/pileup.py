@@ -199,7 +199,8 @@ HOST_PILEUP_MAX_LEN = 1 << 21
 
 
 def host_pileup_max_len(native_tail: bool = False,
-                        link_free: bool = False) -> int:
+                        link_free: bool = False,
+                        link_bps=None) -> int:
     """The auto gate's genome-length bound, by what the tail would cost.
 
     When the caller can actually serve the tail with the native C++ vote
@@ -215,9 +216,23 @@ def host_pileup_max_len(native_tail: bool = False,
     there is no wire to bill at any genome size, and the fused C++
     decode+count runs at memory speed where the XLA-CPU scatter pays
     ~100 ns/cell (measured: the 40 Mbp config's accumulate fell ~1 s →
-    ~0.1 s).  Otherwise the tail would fall to the XLA CPU vote or a
-    counts upload, and the narrow bound is the measured choice
-    (PERF.md).  Override with S2C_HOST_PILEUP_MAX_LEN.
+    ~0.1 s).
+
+    The bound also vanishes on a slow enough LINK (``link_bps``, the
+    placement model's probed/modeled rate): below
+    S2C_HOST_ALWAYS_LINK_MBPS (default 80 MB/s — tunnel-class), the
+    device pileup's wire floor beats the host at no genome size.  Rows
+    up cost >= 0.5 B/aligned base (>= 6 ns at 80 MB/s) against ~0.9 ns
+    fused host counting, and the output fetch costs >= 0.625 B/position
+    (packed5, >= 7.8 ns at 80 MB/s) against the ~7 ns/position SIMD
+    native vote — both terms favor the host at every L and depth (the
+    measured round-4 wide-genome mis-route: host 1.2 s vs chip 3.5 s on
+    the ~8-40 MB/s tunnel).  On a PCIe-class link (~GB/s) both
+    inequalities flip and the narrow 2^23 bound below applies.
+
+    Otherwise the tail would fall to the XLA CPU vote or a counts
+    upload, and the narrow bound is the measured choice (PERF.md).
+    Override with S2C_HOST_PILEUP_MAX_LEN.
     """
     import os
 
@@ -231,6 +246,11 @@ def host_pileup_max_len(native_tail: bool = False,
                 f"integer position count (e.g. 8388608)") from None
     if native_tail and link_free:
         return 1 << 62
+    if native_tail and link_bps is not None:
+        slow = float(os.environ.get(
+            "S2C_HOST_ALWAYS_LINK_MBPS", "80")) * 1e6
+        if link_bps < slow:
+            return 1 << 62
     return (1 << 23) if native_tail else HOST_PILEUP_MAX_LEN
 
 
@@ -421,6 +441,15 @@ class PileupAccumulator:
         self._mxu_rows_real = 0            # occupancy accounting: run
         self._mxu_rows_padded = 0          # aggregate, not last-slab
         self._tuner = PileupAutoTuner() if strategy == "auto" else None
+
+    def sync(self) -> None:
+        """Block until every dispatched scatter/matmul has landed in the
+        count tensor.  Profiling hook (S2C_SYNC_ACCUMULATE): dispatches
+        are async, so without a barrier the accumulate phase's clock
+        stops while the device queue is still draining.  A one-element
+        fetch, not block_until_ready — the tunneled runtime returns
+        early from the latter (same reason run_tuned_slab fetches)."""
+        np.asarray(self._counts[0, 0])
 
     def stage(self, batch: SegmentBatch) -> None:
         """Device-stage a batch's bucket operands.

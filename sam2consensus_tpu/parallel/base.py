@@ -114,6 +114,15 @@ class ShardedCountsBase:
         self._mat_spec = NamedSharding(mesh, P(ALL, None))
         self.bytes_h2d = 0                     # wire accounting for bench
 
+    def sync(self) -> None:
+        """Profiling barrier (S2C_SYNC_ACCUMULATE): block until every
+        dispatched accumulation has landed in the sharded count tensor —
+        see ops.pileup.PileupAccumulator.sync.  One-element fetch (the
+        tunneled runtime returns early from block_until_ready); no-op
+        before the first add() materializes the counts."""
+        if self._counts is not None:
+            np.asarray(self._counts[(0,) * self._counts.ndim])
+
     def _flat_pos_index(self):
         """Device's block index along the position axis (traceable; call
         inside shard_map)."""

@@ -278,6 +278,51 @@ def test_tail_routing_matrix(monkeypatch):
     assert not _native_tail_possible(cfg_auto)
 
 
+def test_host_gate_link_aware(monkeypatch):
+    """A tunnel-class modeled link removes the host-pileup genome bound
+    entirely (the device path's wire floor loses at every L); a
+    PCIe-class link keeps the narrow 2^23 bound (round-4 wide-genome
+    mis-route: chip-routed 40 Mbp ran 3.5 s vs the host's 1.2 s on the
+    ~8-40 MB/s tunnel)."""
+    from sam2consensus_tpu.ops.pileup import host_pileup_max_len
+
+    monkeypatch.delenv("S2C_HOST_PILEUP_MAX_LEN", raising=False)
+    monkeypatch.delenv("S2C_HOST_ALWAYS_LINK_MBPS", raising=False)
+    # tunnel-class link: no bound
+    assert host_pileup_max_len(True, link_bps=40e6) == (1 << 62)
+    assert host_pileup_max_len(True, link_bps=8e6) == (1 << 62)
+    # PCIe-class link: the narrow native-tail bound
+    assert host_pileup_max_len(True, link_bps=3e9) == (1 << 23)
+    # unknown link (no probe): conservative narrow bound
+    assert host_pileup_max_len(True) == (1 << 23)
+    # without the native tail the link rate is irrelevant (the tail
+    # would ship counts anyway)
+    assert host_pileup_max_len(False, link_bps=8e6) == (1 << 21)
+    # threshold is env-tunable
+    monkeypatch.setenv("S2C_HOST_ALWAYS_LINK_MBPS", "5000")
+    assert host_pileup_max_len(True, link_bps=3e9) == (1 << 62)
+
+
+def test_insertion_kernel_auto_window(monkeypatch):
+    """--insertion-kernel auto: pallas only for chip-resident tails in
+    the TPU-measured winning event-count window (round-4 sweep:
+    0.91x/1.26x/1.09x/0.97x vs scatter at 2e4/2e5/2e6/8e6 events)."""
+    from sam2consensus_tpu.backends import jax_backend as jb
+
+    monkeypatch.delenv("S2C_PALLAS_INS_MIN_EVENTS", raising=False)
+    monkeypatch.delenv("S2C_PALLAS_INS_MAX_EVENTS", raising=False)
+    # inside the window, chip tail: pallas
+    assert jb._pallas_ins_auto(200_000, True)
+    assert jb._pallas_ins_auto(2_000_000, True)
+    # outside the window: scatter
+    assert not jb._pallas_ins_auto(20_000, True)
+    assert not jb._pallas_ins_auto(8_000_000, True)
+    # host-routed / interpret-mode tail: never pallas
+    assert not jb._pallas_ins_auto(200_000, False)
+    # default config routes through auto (a RunConfig regression pin)
+    assert RunConfig(prefix="t", thresholds=[0.25]).ins_kernel == "auto"
+
+
 def test_sparse_output_tail_pallas_byte_identical(monkeypatch):
     """The Pallas insertion-kernel variant composes with the sparse
     output encoding."""
