@@ -1156,6 +1156,16 @@ class JaxBackend:
         n_thresholds = syms.shape[0]
         fastas: Dict[str, List[FastaRecord]] = {}
 
+        if ins is not None:
+            # per-contig site ranges in one searchsorted: key_contig is
+            # sorted by construction (group_insertions orders sites by
+            # (contig, local) via np.unique on a packed composite key),
+            # so the old per-contig boolean mask — O(contigs x sites),
+            # ~25 M compares on the 500-contig north-star config — is a
+            # binary search instead
+            _kc_bounds = np.searchsorted(
+                ins["key_contig"], np.arange(len(layout.names) + 1))
+
         for ci, name in enumerate(layout.names):
             off = int(layout.offsets[ci])
             length = int(layout.lengths[ci])
@@ -1170,13 +1180,14 @@ class JaxBackend:
             # cov[off + local] for these rows (fused tail gather).
             site_rows = np.zeros(0, dtype=np.int64)
             if ins is not None:
-                mask = ((ins["key_contig"] == ci)
-                        & (ins["key_local"] >= 0)
-                        & (ins["key_local"] < length))
-                site_rows = np.nonzero(mask)[0]
-                locs = ins["key_local"][site_rows].astype(np.int64)
-                order = np.argsort(locs, kind="stable")
-                site_rows, locs = site_rows[order], locs[order]
+                lo, hi = int(_kc_bounds[ci]), int(_kc_bounds[ci + 1])
+                loc_all = ins["key_local"][lo:hi]
+                keep = (loc_all >= 0) & (loc_all < length)
+                site_rows = np.arange(lo, hi, dtype=np.int64)[keep]
+                # loc_all is already ascending within the contig (same
+                # np.unique ordering), so the splice order matches the
+                # oracle without a sort
+                locs = loc_all[keep].astype(np.int64)
                 sc = site_cov[site_rows]
                 depth_ok = (sc > 0) & (sc >= cfg.min_depth)
                 site_rows, locs = site_rows[depth_ok], locs[depth_ok]

@@ -219,9 +219,41 @@ class ReadStream:
     def blocks(self, max_bytes: int = 1 << 23):
         """Raw blocks of whole lines, str or bytes per the handle's mode
         (line counting is the consumer's job via ``add_lines`` — the native
-        decoder counts in C++)."""
+        decoder counts in C++).
+
+        Plain binary files take a zero-copy path: the file is mmapped and
+        line-aligned ``memoryview`` windows are yielded straight off the
+        page cache — no per-block ``read()`` memcpy or bytes allocation
+        (~tens of ms on the 241 MB north-star input).  Consumers already
+        accept anything ``np.frombuffer`` does.  Gzip and text handles
+        keep the buffered-read path.
+        """
         pending = self.first
         self.first = ""
+        mm = self._mmap_body()
+        if mm is not None:
+            if pending:
+                yield pending.encode("ascii") \
+                    if isinstance(pending, str) else pending
+            pos = self.handle.tell()
+            size = len(mm)
+            mv = memoryview(mm)
+            while pos < size:
+                end = min(pos + max_bytes, size)
+                if end < size:
+                    nl = mm.rfind(b"\n", pos, end)
+                    if nl < pos:
+                        # one line longer than the window: extend to its
+                        # terminating newline (or EOF)
+                        nl = mm.find(b"\n", end)
+                        end = size if nl < 0 else nl + 1
+                    else:
+                        end = nl + 1
+                yield mv[pos:end]
+                pos = end
+            # leave the handle where the content ended, as read() would
+            self.handle.seek(size)
+            return
         while True:
             chunk = self.handle.read(max_bytes)
             if not chunk:
@@ -236,3 +268,19 @@ class ReadStream:
                 chunk += self.handle.readline()
             block, pending = pending + chunk, chunk[:0]
             yield block
+
+    def _mmap_body(self):
+        """An ACCESS_READ mmap of the whole file when the handle is a
+        plain uncompressed binary file; None otherwise (gzip handles
+        would map COMPRESSED bytes — their fileno() is the raw file)."""
+        import io as _io
+        import mmap as _mmap
+
+        h = self.handle
+        if not (isinstance(h, _io.BufferedReader)
+                and isinstance(getattr(h, "raw", None), _io.FileIO)):
+            return None
+        try:
+            return _mmap.mmap(h.fileno(), 0, access=_mmap.ACCESS_READ)
+        except (ValueError, OSError):
+            return None                    # empty file, pipe, ...

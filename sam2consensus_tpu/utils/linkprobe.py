@@ -24,6 +24,8 @@ entirely; S2C_LINK_PROBE=0 disables it.
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 from typing import Optional, Tuple
 
@@ -41,13 +43,33 @@ def probe_link(force: bool = False) -> Optional[Tuple[float, float]]:
     """Measure (round_trip_sec, h2d_bytes_per_sec) on the default device.
 
     Returns None (and remembers the failure) if the device cannot be
-    reached — the placement model falls back to its defaults then.
+    reached.  The measurement runs on a watchdog thread with a deadline
+    (S2C_LINK_PROBE_TIMEOUT_S, default 20 s): a tunneled accelerator
+    whose transport died AFTER backend init blocks forever inside
+    ``block_until_ready`` — without the deadline the probe (and the
+    placement gate consulting it) would hang instead of falling back to
+    the default constants, which route host-side and complete link-free
+    on every workload the gates would have kept local anyway.
     """
     global _cached, _failed
     if _cached is not None and not force:
         return _cached
     if _failed and not force:
         return None
+    timeout = float(os.environ.get("S2C_LINK_PROBE_TIMEOUT_S", "20"))
+    box: list = []
+    t = threading.Thread(target=_probe_into, args=(box,), daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive() or not box or box[0] is None:
+        # hung (thread left blocked; it is a daemon) or raised
+        _failed = True
+        return None
+    _cached = box[0]
+    return _cached
+
+
+def _probe_into(box: list) -> None:
     try:
         import jax
         import jax.numpy as jnp
@@ -76,15 +98,14 @@ def probe_link(force: bool = False) -> Optional[Tuple[float, float]]:
         get = min(_timed(lambda o=o: np.asarray(o)) for o in outs[1:])
         bw = PROBE_BYTES / max(max(put, get) - rt / 2, 1e-9)
     except Exception:
-        _failed = True
-        return None
+        box.append(None)
+        return
     # clamp to sane bounds: a sub-us "RT" (fully async dispatch) or a
     # TB/s "bandwidth" (buffer donation / page sharing) would make the
     # model treat the link as free and ship everything
     rt = float(min(max(rt, 1e-6), 10.0))
     bw = float(min(max(bw, 1e5), 1e12))
-    _cached = (rt, bw)
-    return _cached
+    box.append((rt, bw))
 
 
 def _timed(fn) -> float:

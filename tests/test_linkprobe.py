@@ -74,6 +74,22 @@ def test_probe_failure_falls_back(monkeypatch):
                                     jb.TAIL_LINK_BPS_DEFAULT)
 
 
+def test_probe_watchdog_times_out_hung_device(monkeypatch):
+    """A transport that died after backend init blocks forever inside the
+    probe's device calls; the watchdog deadline must turn that into a
+    remembered failure (gates fall back to defaults) instead of a hang."""
+    import time as _time
+
+    monkeypatch.setenv("S2C_LINK_PROBE_TIMEOUT_S", "0.2")
+    monkeypatch.setattr(linkprobe, "_probe_into",
+                        lambda box: _time.sleep(30))
+    t0 = _time.perf_counter()
+    assert linkprobe.probe_link(force=True) is None
+    assert _time.perf_counter() - t0 < 5
+    # failure is remembered: no second hang
+    assert linkprobe.probe_link() is None
+
+
 def test_real_probe_on_cpu_device_measures_sane_numbers():
     # the probe itself (against the test CPU backend, forced): returns
     # clamped, positive numbers and caches
