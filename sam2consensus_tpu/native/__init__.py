@@ -120,6 +120,10 @@ def load() -> Optional[ctypes.CDLL]:
         f64p, ctypes.c_long,                   # thresholds, T
         u8p, u8p,                              # lut64, out [T*K*C]
     ]
+    lib.s2c_merge_u8.restype = None
+    lib.s2c_merge_u8.argtypes = [
+        i32p, u8p, ctypes.c_int64,             # acc [n], u8 shadow [n], n
+    ]
     lib.s2c_vote.restype = None
     lib.s2c_vote.argtypes = [
         i32p, ctypes.c_int64,                  # counts [L*6], L

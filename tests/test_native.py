@@ -339,3 +339,42 @@ def test_line_accounting_matches_python():
         return stream.n_lines
 
     assert count("native") == count("py")
+
+
+def test_fused_shadow_saturation_banked_exact():
+    """Depth > 255 wraps the uint8 shadow cell and banks +256 in the
+    overflow tensor (decoder.cpp u8_inc / count_row_u8 saturation
+    branch); merge_shadow folds cell + bank exactly, including at a
+    mid-stream checkpoint-style merge boundary.  Pins the banked-wrap
+    counter (out[12]) that gates the bank fold: a counting path that
+    wrote the bank without reporting a wrap would silently lose
+    multiples of 256 at >255x depth and no other test would notice."""
+    depth = 300
+    motif = "ACGTACGTAC"
+    reads = [("r", 2, "10M", motif)] * depth
+    text = sam_text([("r", 40)], reads)
+    layout, handle, first = _layout(text)
+    acc = np.zeros((layout.total_len, 6), np.int32)
+    enc = native_encoder.NativeReadEncoder(layout, accumulate_into=acc)
+
+    body = text.split("\n", 2)[2]          # read lines only
+    mid_counts = []
+
+    def blocks():
+        yield body
+        # checkpoint-style mid-stream merge: the wrap path must have
+        # engaged (cells wrapped at 256), and the fold must be exact
+        assert enc._banked > 0
+        enc.merge_shadow()
+        assert enc._banked == 0
+        mid_counts.append(acc.copy())
+        yield body
+
+    for _ in enc.encode_blocks(blocks()):
+        pass
+
+    want = np.zeros_like(acc)
+    for col, base in enumerate(motif):
+        want[1 + col, "-ACGNT".index(base)] = 2 * depth
+    np.testing.assert_array_equal(acc, want)
+    np.testing.assert_array_equal(mid_counts[0], want // 2)
