@@ -39,15 +39,26 @@ SCATTER_CELL_BUDGET = 1 << 23
 
 
 def pack_nibbles(codes: np.ndarray) -> np.ndarray:
-    """Host-side 4-bit wire packing: ``[S, W]`` codes → ``[S, W/2]`` bytes.
+    """Host-side 4-bit wire packing: ``[S, W]`` codes → ``[S, ⌈W/2⌉]`` bytes.
 
     Symbol codes are 0..5 and PAD is 255; a nibble holds both (PAD → 15,
     still ``>= NUM_SYMBOLS`` so validity tests are unchanged after unpack).
     Halves the dominant host→device transfer on the ~40 MB/s tunneled link
-    (tools/tunnel_probe.py); bucket widths are powers of two ≥ 32, so W is
-    always even.  Even columns ride the low nibble.
+    (tools/tunnel_probe.py).  Encoder buckets are even (powers of two
+    ≥ 32), but the sp/dpsp halo splits can produce an ODD width (halo =
+    min(block, cap) with an odd position block): those pad one extra PAD
+    column, so ``unpack_nibbles`` returns W+1 columns.  That is safe for
+    every scatter consumer — they expand via
+    ``expand_segment_positions``, which redirects PAD cells to the
+    sacrificial slot — but NOT for the MXU packed layout
+    (``ops.mxu_pileup.build_padded_layout`` allocates at the static
+    pre-pack width): only even encoder buckets may take the MXU path.
+    Even columns ride the low nibble.
     """
     nib = np.where(codes < NUM_SYMBOLS, codes, 15).astype(np.uint8)
+    if nib.shape[1] % 2:
+        nib = np.concatenate(
+            [nib, np.full((len(nib), 1), 15, dtype=np.uint8)], axis=1)
     return nib[:, 0::2] | (nib[:, 1::2] << 4)
 
 

@@ -30,6 +30,23 @@ from ..constants import NUM_SYMBOLS, PAD_CODE
 ALL = ("dp", "sp")
 
 
+def fetch_host(x: jax.Array) -> np.ndarray:
+    """Host copy of a possibly process-spanning sharded array.
+
+    Single-controller meshes (every shard addressable) and fully
+    replicated outputs take the plain fetch.  On a multi-host mesh
+    (``jax.distributed`` — DCN topology; validated by
+    ``tools/multihost_dryrun.py``) a position-sharded array spans
+    processes, so each process assembles the global value with one
+    ``process_allgather`` (tiled: shards land in their global slots).
+    """
+    if x.is_fully_addressable or x.sharding.is_fully_replicated:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def block_for(total_len: int, n_devices: int) -> int:
     """Rows of the position axis each device owns (+1 covers the
     scatter path's sacrificial row inside the pad)."""
@@ -142,7 +159,7 @@ class ShardedCountsBase:
 
     def counts_host(self) -> np.ndarray:
         """Valid counts on host, ``[total_len, 6]``."""
-        return np.asarray(self.counts)[: self.total_len]
+        return fetch_host(self.counts)[: self.total_len]
 
     def restore(self, counts: np.ndarray) -> None:
         """Load checkpointed counts (``[total_len, 6]``), re-sharded."""
@@ -170,7 +187,7 @@ class ShardedCountsBase:
             return syms
 
         syms = jax.jit(voted)(self.counts, jnp.asarray(thr_enc))
-        return np.asarray(syms)[:, : self.total_len]
+        return fetch_host(syms)[:, : self.total_len]
 
     def tail_stats(self, offsets: np.ndarray, site_keys: np.ndarray
                    ) -> Tuple[np.ndarray, np.ndarray]:

@@ -1,0 +1,38 @@
+"""Multi-PROCESS (DCN-topology) validation of the sharded pipeline.
+
+The other parallel tests run every collective on a virtual mesh inside
+one controller; this one shells out to ``tools/multihost_dryrun.py``,
+which runs the production dp / sp / dpsp accumulators over a
+``jax.distributed`` mesh spanning two OS processes (gloo cross-process
+collectives — the CPU stand-in for DCN) and asserts counts, vote and
+tail stats byte-equal to the single-device oracle in every process.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_multihost_two_processes_byte_equal():
+    env = dict(os.environ)
+    # the workers set their own JAX_PLATFORMS/XLA_FLAGS; drop the
+    # conftest's 8-device forcing so each worker gets exactly 4
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "multihost_dryrun.py"),
+         "--procs", "2", "--devs", "4", "--port", str(_free_port())],
+        env=env, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "MULTIHOST OK" in proc.stdout, proc.stdout + proc.stderr

@@ -273,3 +273,23 @@ def test_sp_window_spanning_block_boundaries():
     assert any(k.startswith("window") for k in sp.strategy_used)
     assert np.array_equal(sp.counts_host(),
                           _ref_counts(total_len, starts, codes))
+
+
+def test_sp_odd_halo_from_odd_block_byte_exact():
+    """An odd position block (total_len 967 over 8 devices -> block 121)
+    makes halo = min(block, cap) odd; split_wide_rows then produces
+    odd-width pieces and pack_nibbles must pad the odd column (one extra
+    PAD column that self-redirects) instead of crashing on the nibble
+    fold.  Regression: found driving the CLI sp mode on a jittered
+    3-contig fixture."""
+    total_len = 967
+    rng = np.random.default_rng(5)
+    sp = PositionShardedConsensus(make_mesh(8), total_len,
+                                  halo=min(121, 1 << 16))
+    assert sp.block == 121 and sp.halo % 2 == 1
+    w = 128                       # bucket wider than the odd halo
+    starts = rng.integers(0, total_len - w, 600).astype(np.int32)
+    codes = rng.integers(0, 6, (600, w)).astype(np.uint8)
+    sp.add(_batch(starts, codes))
+    assert np.array_equal(sp.counts_host(),
+                          _ref_counts(total_len, starts, codes))

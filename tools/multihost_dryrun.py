@@ -1,0 +1,165 @@
+#!/usr/bin/env python3
+"""True multi-PROCESS validation of the sharded pipeline (DCN topology).
+
+The single-process dryrun (``__graft_entry__.dryrun_multichip``) proves
+the collectives on a virtual mesh inside one controller.  This harness
+proves the stronger claim PERF.md §6 makes — "nothing in the code
+distinguishes single-host ICI from multi-host DCN" — by actually running
+the production ``parallel.dp.ShardedConsensus`` over a mesh that SPANS
+OS PROCESSES: ``jax.distributed`` multi-controller, N processes x M
+virtual CPU devices each, cross-process collectives over gloo (the CPU
+stand-in for DCN).  Each process executes the same SPMD program; the
+count tensor's shards live in different address spaces; psum_scatter /
+psum run across the process boundary; ``fetch_host`` assembles results
+via ``process_allgather``.
+
+Checks (every process asserts, process 0 reports):
+  * sharded counts == single-device oracle counts (exact integers);
+  * sharded vote symbols == unsharded ``vote_positions``;
+  * ``tail_stats`` contig sums == oracle coverage sums.
+
+Usage:
+  python tools/multihost_dryrun.py              # spawn 2 procs x 4 devs
+  python tools/multihost_dryrun.py --procs 2 --devs 4
+  (workers are re-invocations of this script with --worker <pid>)
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def worker(pid: int, n_procs: int, n_devs: int, port: int) -> int:
+    from sam2consensus_tpu.utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
+    import jax
+
+    jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                               num_processes=n_procs, process_id=pid)
+    import numpy as np
+
+    from sam2consensus_tpu.encoder.events import GenomeLayout, ReadEncoder
+    from sam2consensus_tpu.io.sam import iter_records, read_header
+    from sam2consensus_tpu.ops.cutoff import encode_thresholds
+    from sam2consensus_tpu.ops.vote import vote_positions
+    from sam2consensus_tpu.parallel.dp import ShardedConsensus
+    from sam2consensus_tpu.parallel.mesh import make_mesh
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+    import io as _io
+    import jax.numpy as jnp
+
+    n_global = n_procs * n_devs
+    assert len(jax.devices()) == n_global, \
+        f"expected {n_global} global devices, got {len(jax.devices())}"
+    assert len(jax.local_devices()) == n_devs
+
+    # identical fixture on every process (same seed): multi-controller
+    # SPMD requires every process to feed the same global values
+    text = simulate(SimSpec(n_contigs=3, contig_len=160, n_reads=400,
+                            read_len=24, max_indel=2, seed=77))
+    handle = _io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    layout = GenomeLayout(contigs)
+    enc = ReadEncoder(layout)
+    batches = list(enc.encode_segments(iter_records(handle, first), 10 ** 9))
+
+    from sam2consensus_tpu.parallel.dpsp import ProductShardedConsensus
+    from sam2consensus_tpu.parallel.sp import PositionShardedConsensus
+
+    mesh = make_mesh(n_global)
+    assert mesh.size == n_global
+
+    # oracle: single-device accumulation from the same batches
+    want = np.zeros((layout.total_len, 6), dtype=np.int32)
+    for b in batches:
+        for _w, (starts, codes) in b.buckets.items():
+            rows, cols = np.nonzero(codes != 255)
+            pos = starts[rows] + cols
+            ok = pos < layout.total_len
+            np.add.at(want, (pos[ok], codes[rows, cols][ok]), 1)
+
+    thr_enc = encode_thresholds([0.25, 0.75])
+    syms1, cov1 = vote_positions(jnp.asarray(want), jnp.asarray(thr_enc), 1)
+    want_sums = [np.asarray(cov1)[int(layout.offsets[i]):
+                                  int(layout.offsets[i + 1])].sum()
+                 for i in range(len(layout.names))]
+
+    # all three production layouts over the process-spanning mesh: dp
+    # (scatter + psum_scatter), sp (row routing + ppermute halo), dp x sp
+    # (both axes product mode)
+    modes = {
+        "dp": lambda: ShardedConsensus(mesh, layout.total_len,
+                                       pileup="scatter"),
+        "sp": lambda: PositionShardedConsensus(mesh, layout.total_len,
+                                               halo=64),
+        "dpsp": lambda: ProductShardedConsensus(mesh, layout.total_len,
+                                                halo=64),
+    }
+    for mode, build in modes.items():
+        sharded = build()
+        for b in batches:
+            sharded.add(b)
+        np.testing.assert_array_equal(sharded.counts_host(), want,
+                                      err_msg=f"{mode}: counts diverge")
+        syms = sharded.vote(thr_enc, min_depth=1)
+        np.testing.assert_array_equal(syms, np.asarray(syms1),
+                                      err_msg=f"{mode}: vote diverges")
+        contig_sums, _ = sharded.tail_stats(
+            layout.offsets.astype(np.int32), np.zeros(0, dtype=np.int32))
+        np.testing.assert_array_equal(contig_sums, want_sums,
+                                      err_msg=f"{mode}: stats diverge")
+        if pid == 0:
+            print(f"  [{mode}] counts+vote+stats byte-equal", flush=True)
+
+    if pid == 0:
+        print(f"MULTIHOST OK: {n_procs} processes x {n_devs} devices, "
+              f"dp/sp/dpsp byte-equal across the process-spanning mesh",
+              flush=True)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--devs", type=int, default=4)
+    ap.add_argument("--port", type=int, default=9977)
+    ap.add_argument("--worker", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.worker is not None:
+        rc = worker(args.worker, args.procs, args.devs, args.port)
+        # gloo/distributed client teardown can abort at interpreter
+        # exit; the asserts have already decided the outcome
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        f" --xla_force_host_platform_device_count="
+                        f"{args.devs}").strip()
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--worker", str(i), "--procs", str(args.procs),
+         "--devs", str(args.devs), "--port", str(args.port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(args.procs)]
+    out0, _ = procs[0].communicate(timeout=600)
+    rcs = [procs[0].returncode] + [p.wait(timeout=600) for p in procs[1:]]
+    sys.stdout.write(out0.decode())
+    if any(rcs):
+        for i, p in enumerate(procs[1:], 1):
+            sys.stdout.write(p.stdout.read().decode())
+        print(f"MULTIHOST FAILED: rcs={rcs}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
