@@ -6,9 +6,11 @@ sweeps random SimSpecs x config knobs — threshold lists including 1.0 /
 0.0001 / 1/3 / 0.9999999, min_depth, fill characters, maxdel including
 0, strict and permissive modes, heavy indel rates, tiny and many
 contigs — and asserts byte-identical FASTA output between the oracle
-and the jax backend for every runnable draw.  Round-4 record: 80/80
-clean (the new SIMD vote, direct/shadow fused counting, native
-insertion tail, and segmented contig sums all in the loop).
+and the jax backend for every runnable draw.  ~1 in 4 trials runs
+SHARDED on the 8-virtual-device mesh with a random dp/sp/dpsp layout.
+Round-4 records: 80/80 clean mid-round; 200/200 clean after the
+late-round kernel pass (SIMD shadow merge, banked gate, scan-free
+placement); sharded draws added after the odd-halo pack_nibbles fix.
 
 Usage: python tools/fuzz_differential.py [n_trials] [seed]
 """
@@ -32,6 +34,15 @@ from sam2consensus_tpu.io.sam import iter_records, read_header   # noqa: E402
 from sam2consensus_tpu.utils.simulate import SimSpec, simulate   # noqa: E402
 
 
+def _n_devices() -> int:
+    import jax
+
+    try:
+        return len(jax.devices())
+    except RuntimeError:
+        return 1
+
+
 def main() -> int:
     n_trials = int(sys.argv[1]) if len(sys.argv) > 1 else 80
     seed = int(sys.argv[2]) if len(sys.argv) > 2 else 4242
@@ -46,8 +57,22 @@ def main() -> int:
             ins_read_rate=rng.choice([0.0, 0.1, 0.5]),
             del_read_rate=rng.choice([0.0, 0.1, 0.5]),
             seed=rng.randrange(10 ** 6))
+        # ~1 in 4 trials runs SHARDED on the virtual mesh, random layout:
+        # dp (scatter + reduce-scatter), sp (routing + halo), dpsp
+        # (product mode) — the odd-halo pack_nibbles crash only lived in
+        # shard-mode x genome-shape combinations no fixed test drew.
+        # Clamp draws to the devices actually up, so a standalone run
+        # without --xla_force_host_platform_device_count still fuzzes
+        # (single-device only) instead of tripping make_mesh.
+        shards, shard_mode = 1, "auto"
+        shard_pool = [s for s in (2, 4, 8) if s <= _n_devices()]
+        if shard_pool and rng.random() < 0.25:
+            shards = rng.choice(shard_pool)
+            # dpsp needs a true 2-D mesh (factor_mesh(2) is 2x1 -> refused)
+            shard_mode = rng.choice(
+                ["dp", "sp", "dpsp"] if shards >= 4 else ["dp", "sp"])
         kw = dict(
-            prefix="f", shards=1,
+            prefix="f", shards=shards, shard_mode=shard_mode,
             thresholds=rng.choice(
                 [[0.25], [0.5, 0.75], [1.0], [0.0001],
                  [1.0 / 3.0, 0.9999999], [0.25, 0.5, 0.75, 1.0]]),

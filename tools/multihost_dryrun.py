@@ -144,19 +144,48 @@ def main() -> int:
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                         f" --xla_force_host_platform_device_count="
                         f"{args.devs}").strip()
+    import signal
+    import threading
+
+    # each worker gets its own process group (start_new_session) so a
+    # hang can be killed wholesale; one drain thread per pipe so a
+    # worker writing a large failure traceback can never block on a
+    # full unread pipe while the launcher waits on another worker
     procs = [subprocess.Popen(
         [sys.executable, os.path.abspath(__file__),
          "--worker", str(i), "--procs", str(args.procs),
          "--devs", str(args.devs), "--port", str(args.port)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        start_new_session=True)
         for i in range(args.procs)]
-    out0, _ = procs[0].communicate(timeout=600)
-    rcs = [procs[0].returncode] + [p.wait(timeout=600) for p in procs[1:]]
-    sys.stdout.write(out0.decode())
-    if any(rcs):
-        for i, p in enumerate(procs[1:], 1):
-            sys.stdout.write(p.stdout.read().decode())
-        print(f"MULTIHOST FAILED: rcs={rcs}")
+    outs = [b""] * args.procs
+
+    def drain(i):
+        outs[i] = procs[i].communicate()[0]
+
+    threads = [threading.Thread(target=drain, args=(i,), daemon=True)
+               for i in range(args.procs)]
+    for t in threads:
+        t.start()
+    deadline = 480  # shorter than the suite test's outer timeout
+    for t in threads:
+        t.join(timeout=deadline)
+    timed_out = any(t.is_alive() for t in threads)
+    if timed_out:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        for t in threads:
+            t.join(timeout=10)
+    rcs = [p.poll() for p in procs]
+    sys.stdout.write(outs[0].decode(errors="replace"))
+    if timed_out or any(rcs):
+        for i in range(1, args.procs):
+            sys.stdout.write(outs[i].decode(errors="replace"))
+        print(f"MULTIHOST FAILED: rcs={rcs} timed_out={timed_out}")
         return 1
     return 0
 
