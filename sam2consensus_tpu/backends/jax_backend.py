@@ -966,20 +966,17 @@ class JaxBackend:
         if nat is None:
             return None
         syms, cov = nat
-        # per-contig coverage sums via one segmented reduction — a full
-        # int64 prefix sum measured ~0.6 s at 40 M positions, ~10x this.
-        # reduceat runs over NON-EMPTY contigs only: empty segments make
-        # reduceat return cov[start] (and shift their neighbors' spans
-        # when clamped), so they are zeroed structurally instead.  The
-        # filtered starts are strictly increasing, and zero-width
-        # contigs between two non-empty ones add no positions, so each
-        # reduceat segment is exactly that contig's position range.
-        offs = layout.offsets
-        nonempty = offs[1:] > offs[:-1]
-        contig_sums = np.zeros(len(offs) - 1, dtype=np.int64)
-        if nonempty.any():
-            contig_sums[nonempty] = np.add.reduceat(
-                cov, offs[:-1][nonempty], dtype=np.int64)
+        # per-contig coverage sums in C (s2c_cov_sums: SIMD
+        # widen-accumulate at memory speed) — the numpy alternatives
+        # both measured slow at 40 M positions: a full int64 prefix sum
+        # ~0.6 s, np.add.reduceat ~0.21 s (no SIMD through the dtype
+        # cast); the C segmented sum is ~0.02 s and handles empty
+        # contigs structurally.
+        from .. import native
+
+        offs = np.ascontiguousarray(layout.offsets, dtype=np.int64)
+        contig_sums = np.empty(len(offs) - 1, dtype=np.int64)
+        native.load().s2c_cov_sums(cov, offs, len(offs) - 1, contig_sums)
         return syms, cov, contig_sums
 
     @staticmethod
@@ -1215,26 +1212,43 @@ class JaxBackend:
                     block = ins_syms[t, site_rows]             # [S, Cp]
                     nz = block != 0
                     lens = nz.sum(axis=1)
-                    raw = np.insert(base, np.repeat(locs + 1, lens),
-                                    block[nz]).tobytes()
+                    arr = np.insert(base, np.repeat(locs + 1, lens),
+                                    block[nz])
                     sumcov = sumcov_base + int(
                         (site_cov[site_rows] * lens).sum())
                 else:
-                    raw = base.tobytes()
+                    arr = base
                     sumcov = sumcov_base
 
                 if len(cfg.fill) == 1 and ord(cfg.fill) < 256:
-                    # fill substitution via bytes.translate — the fastest
-                    # measured pass at 40 Mbp (45 ms vs 187 ms for
-                    # np.where); the find() probe skips the copy when no
-                    # position needs filling, and the dash count rides
-                    # the decoded str's memchr path (11 ms vs 25 ms on
-                    # the uint8 view)
-                    if raw.find(b"\x00") >= 0:
-                        raw = raw.translate(bytes.maketrans(
-                            b"\x00", cfg.fill.encode("latin-1")))
-                    seq = raw.decode("latin-1")
-                    stripped = len(seq) - seq.count("-")
+                    nat = None
+                    if len(arr) >= (1 << 20):
+                        from .. import native
+
+                        nat = native.load()
+                    if nat is not None:
+                        # one C pass does fill substitution + '-' count
+                        # (s2c_finalize); the python chain below walks
+                        # the sequence ~4x (~0.1 s at 40 Mbp)
+                        buf = np.empty(len(arr), np.uint8)
+                        dashes = nat.s2c_finalize(
+                            np.ascontiguousarray(arr), len(arr),
+                            ord(cfg.fill), buf)
+                        seq = buf.tobytes().decode("latin-1")
+                        stripped = len(seq) - dashes
+                    else:
+                        # fill substitution via bytes.translate — the
+                        # fastest measured PYTHON pass at 40 Mbp (45 ms
+                        # vs 187 ms for np.where); the find() probe
+                        # skips the copy when no position needs filling,
+                        # and the dash count rides the decoded str's
+                        # memchr path (11 ms vs 25 ms on the uint8 view)
+                        raw = arr.tobytes()
+                        if raw.find(b"\x00") >= 0:
+                            raw = raw.translate(bytes.maketrans(
+                                b"\x00", cfg.fill.encode("latin-1")))
+                        seq = raw.decode("latin-1")
+                        stripped = len(seq) - seq.count("-")
                     if stripped == 0:
                         continue  # empty-sequence drop (:400-406)
                     header = format_header(cfg.prefix, cfg.thresholds[t],
@@ -1242,7 +1256,8 @@ class JaxBackend:
                                            stripped_len=stripped)
                 else:
                     # multi-char (or non-latin) fill: the plain-string path
-                    seq = raw.decode("latin-1").replace("\x00", cfg.fill)
+                    seq = arr.tobytes().decode("latin-1").replace(
+                        "\x00", cfg.fill)
                     if len(seq) - seq.count("-") == 0:
                         continue  # empty-sequence drop (:400-406)
                     header = format_header(cfg.prefix, cfg.thresholds[t],

@@ -124,6 +124,16 @@ def load() -> Optional[ctypes.CDLL]:
     lib.s2c_merge_u8.argtypes = [
         i32p, u8p, ctypes.c_int64,             # acc [n], u8 shadow [n], n
     ]
+    lib.s2c_cov_sums.restype = None
+    lib.s2c_cov_sums.argtypes = [
+        i32p, i64p,                            # cov [L], offsets [C+1]
+        ctypes.c_long, i64p,                   # n_contigs, out sums [C]
+    ]
+    lib.s2c_finalize.restype = ctypes.c_int64  # returns '-' count
+    lib.s2c_finalize.argtypes = [
+        u8p, ctypes.c_int64,                   # syms [n] (0 = fill), n
+        ctypes.c_long, u8p,                    # fill char, out ascii [n]
+    ]
     lib.s2c_vote.restype = None
     lib.s2c_vote.argtypes = [
         i32p, ctypes.c_int64,                  # counts [L*6], L

@@ -167,9 +167,14 @@ def main() -> int:
                for i in range(args.procs)]
     for t in threads:
         t.start()
-    deadline = 480  # shorter than the suite test's outer timeout
+    import time
+
+    # one SHARED deadline across all joins (sequential per-thread
+    # timeouts would sum to procs x 480 s and outlive the suite test's
+    # 560 s outer timeout, leaking killed-launcher worker groups)
+    end = time.monotonic() + 480
     for t in threads:
-        t.join(timeout=deadline)
+        t.join(timeout=max(0.0, end - time.monotonic()))
     timed_out = any(t.is_alive() for t in threads)
     if timed_out:
         for p in procs:
