@@ -530,10 +530,16 @@ class JaxBackend:
         t0 = time.perf_counter()
         reads_at_ckpt = 0
         decode_times = {"decode_sec": 0.0}
-        if cfg.checkpoint_dir:
-            # serial decode: a checkpoint must snapshot stream/encoder state
-            # consistent with the batches already committed to the counts,
-            # which a decode thread running ahead would break
+        if cfg.checkpoint_dir or getattr(encoder, "counts_fused", False):
+            # serial decode, two reasons share the branch:
+            # - checkpointing must snapshot stream/encoder state
+            #   consistent with the batches already committed to the
+            #   counts, which a decode thread running ahead would break;
+            # - fused host counting makes the consumer loop stats-only
+            #   (counts land inside the decode pass, acc.add is a no-op),
+            #   so a prefetch thread buys zero overlap while its spawn
+            #   costs ~6 ms — the entire fixed budget of a small-input
+            #   run (measured: phix 14.6 -> ~9 ms)
             batch_iter = _timed_iter(iter(batches), decode_times)
         else:
             # overlap host decode with pileup work (SURVEY.md §7(d)): a

@@ -135,6 +135,13 @@ class NativeReadEncoder:
         self._ctg_len = layout.lengths.astype(np.int64).copy()
 
     @property
+    def counts_fused(self) -> bool:
+        """True when counting is fused into the decode pass — batches
+        are counters-only and the backend's consumer loop is stats-only
+        (it skips the prefetch thread then)."""
+        return self._acc is not None
+
+    @property
     def n_reads(self) -> int:
         return self._py.n_reads
 

@@ -68,6 +68,10 @@ class ParallelFusedDecoder:
             extra_each = max(1, counts.nbytes + (counts.nbytes * 5) // 4)
         cap = 1 + self.EXTRA_COUNTS_BUDGET // extra_each
         self.n_threads = max(1, min(n_threads, cap))
+        #: counting is fused into the worker decode passes (batches are
+        #: counters-only), and the workers already overlap — the
+        #: backend's extra prefetch thread would be pure overhead
+        self.counts_fused = True
         self.insertions = InsertionEvents()
         self.n_reads = 0
         self.n_skipped = 0
