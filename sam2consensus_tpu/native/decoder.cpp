@@ -497,11 +497,26 @@ extern "C" long s2c_decode(
     long pre_ins = 0, pre_chars = 0;
     bool huge_span = false;
     char first_rc_op = 0;  // first read-consuming op (M/=/X/I/S, num>0)
+    // op cache: the translate walk below replays these instead of
+    // re-parsing the CIGAR string (digit loop + bounds per op, ~tens of
+    // ms per 1M reads); CIGARs longer than the cache re-parse exactly
+    // as before
+    int64_t cig_num[32];
+    char cig_op[32];
+    int n_ops = 0;
+    bool ops_cached = true;
     {
       long c = cs;
       int64_t num;
       char op;
       while (next_cigar_op(text, ce, c, num, op)) {
+        if (n_ops < 32) {
+          cig_num[n_ops] = num;
+          cig_op[n_ops] = op;
+          ++n_ops;
+        } else {
+          ops_cached = false;
+        }
         if (num > 0 && first_rc_op == 0 &&
             (op == 'M' || op == '=' || op == 'X' || op == 'I' || op == 'S'))
           first_rc_op = (op == '=' || op == 'X') ? 'M' : op;
@@ -591,7 +606,11 @@ extern "C" long s2c_decode(
       long c = cs;
       int64_t num;
       char op;
-      while (next_cigar_op(text, ce, c, num, op)) {
+      int oi = 0;
+      while (ops_cached
+                 ? (oi < n_ops
+                    && (num = cig_num[oi], op = cig_op[oi], ++oi, true))
+                 : next_cigar_op(text, ce, c, num, op)) {
         switch (op) {
           case 'M': case '=': case 'X': {
             long take = seq_len - rc;
