@@ -501,8 +501,9 @@ extern "C" long s2c_decode(
     // re-parsing the CIGAR string (digit loop + bounds per op, ~tens of
     // ms per 1M reads); CIGARs longer than the cache re-parse exactly
     // as before
-    int64_t cig_num[32];
-    char cig_op[32];
+    constexpr int kCigCache = 32;
+    int64_t cig_num[kCigCache];
+    char cig_op[kCigCache];
     int n_ops = 0;
     bool ops_cached = true;
     {
@@ -510,7 +511,7 @@ extern "C" long s2c_decode(
       int64_t num;
       char op;
       while (next_cigar_op(text, ce, c, num, op)) {
-        if (n_ops < 32) {
+        if (n_ops < kCigCache) {
           cig_num[n_ops] = num;
           cig_op[n_ops] = op;
           ++n_ops;

@@ -378,3 +378,91 @@ def test_fused_shadow_saturation_banked_exact():
         want[1 + col, "-ACGNT".index(base)] = 2 * depth
     np.testing.assert_array_equal(acc, want)
     np.testing.assert_array_equal(mid_counts[0], want // 2)
+
+
+def test_cov_sums_matches_reduceat():
+    """s2c_cov_sums (SIMD segmented widen-accumulate) == the numpy
+    reduction it replaced, including empty contigs and odd lengths."""
+    lib = native_encoder.native.load()
+    rng = np.random.default_rng(3)
+    cov = rng.integers(0, 1000, 100_003).astype(np.int32)
+    offs = np.array([0, 17, 17, 4099, 4099, 50_000, 100_003],
+                    dtype=np.int64)
+    out = np.empty(len(offs) - 1, dtype=np.int64)
+    lib.s2c_cov_sums(cov, offs, len(offs) - 1, out)
+    want = [cov[offs[i]:offs[i + 1]].sum(dtype=np.int64)
+            for i in range(len(offs) - 1)]
+    np.testing.assert_array_equal(out, want)
+
+
+def test_finalize_matches_python_chain():
+    """s2c_finalize (one-pass fill substitution + '-' count) == the
+    python translate/count chain, across fill chars incl. '-' itself
+    and lengths around the 64-byte SIMD boundary."""
+    lib = native_encoder.native.load()
+    rng = np.random.default_rng(4)
+    for fill in (b"-", b"N", b"?"):
+        for n in (0, 1, 63, 64, 65, 1000, 4096 + 17):
+            syms = rng.choice(
+                np.frombuffer(b"\x00-ACGTRYacgtn", dtype=np.uint8),
+                size=n).astype(np.uint8)
+            buf = np.empty(n, np.uint8)
+            dashes = lib.s2c_finalize(
+                np.ascontiguousarray(syms), n, fill[0], buf)
+            raw = syms.tobytes().translate(
+                bytes.maketrans(b"\x00", fill))
+            assert buf.tobytes() == raw
+            assert dashes == raw.count(b"-")
+
+
+def test_vote_zero_block_fast_path_matches_scalar():
+    """The SIMD vote's all-zero-block skip emits exactly what the
+    scalar path does: cov 0 and the sentinel symbol for every
+    threshold — interleaving covered and empty 16-position blocks."""
+    from sam2consensus_tpu.ops.vote import vote_positions_native
+
+    rng = np.random.default_rng(6)
+    L = 4096 + 5
+    counts = np.zeros((L, 6), dtype=np.int32)
+    # cover scattered short runs so some 16-blocks are empty, some
+    # partial, some full
+    for s in rng.integers(0, L - 40, 60):
+        counts[s:s + 30, rng.integers(0, 6)] += rng.integers(1, 9)
+    got_syms, got_cov = vote_positions_native(
+        counts, [0.25, 1.0], 2, threads=1)
+    # scalar reference: force the remainder handler over the whole
+    # range by voting tiny slices (each < 16 positions wide)
+    parts = [vote_positions_native(counts[i:i + 7], [0.25, 1.0], 2,
+                                   threads=1)
+             for i in range(0, L, 7)]
+    ref_syms = np.concatenate([p[0] for p in parts], axis=1)
+    ref_cov = np.concatenate([p[1] for p in parts])
+    np.testing.assert_array_equal(got_syms, ref_syms)
+    np.testing.assert_array_equal(got_cov, ref_cov)
+
+
+@pytest.mark.parametrize("n_ops", [31, 32, 33, 64])
+def test_cigar_op_cache_boundary(n_ops):
+    """The fast path caches up to 32 CIGAR ops and re-parses longer
+    strings; pin both sides of the boundary against the python encoder
+    (a regression in the cache/fallback split would otherwise pass the
+    suite: simulated CIGARs carry at most ~4 ops)."""
+    pairs = (n_ops - 1) // 2
+    cigar = "".join(["1M1I"] * pairs)
+    cigar += "2M" if (n_ops - 1) % 2 == 0 else ""
+    # read length: pairs M + pairs I (+ maybe 2M tail)
+    rlen = pairs * 2 + (2 if (n_ops - 1) % 2 == 0 else 0)
+    reads = [("r", 3, cigar, "ACGT" * (rlen // 4 + 1))]
+    reads = [(c, p, cg, seq[:rlen]) for (c, p, cg, seq) in reads]
+    text = sam_text([("r", 400)], reads)
+    layout, py, pb = _py_encode(text)
+    want = _counts(pb, layout.total_len)
+
+    layout2, handle, first = _layout(text)
+    acc = np.zeros((layout2.total_len, 6), np.int32)
+    enc = native_encoder.NativeReadEncoder(layout2, accumulate_into=acc)
+    for _ in enc.encode_blocks(ReadStream(handle, first).blocks()):
+        pass
+    np.testing.assert_array_equal(acc, want.astype(np.int32))
+    assert py.insertions.to_arrays()[2].tolist() == \
+        enc.insertions.to_arrays()[2].tolist()
