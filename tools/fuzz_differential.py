@@ -10,7 +10,8 @@ and the jax backend for every runnable draw.  ~1 in 4 trials runs
 SHARDED on the 8-virtual-device mesh with a random dp/sp/dpsp layout.
 Round-4 records: 80/80 clean mid-round; 200/200 clean after the
 late-round kernel pass (SIMD shadow merge, banked gate, scan-free
-placement); sharded draws added after the odd-halo pack_nibbles fix.
+placement); 200/200 + 400/400 clean WITH sharded draws after the
+odd-halo pack_nibbles fix (~930 clean trials total this round).
 
 Usage: python tools/fuzz_differential.py [n_trials] [seed]
 """
