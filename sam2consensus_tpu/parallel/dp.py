@@ -35,7 +35,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
-                          pack_nibbles, unpack_nibbles)
+                          pack_nibbles, round_rows_grid,
+                          round_rows_pow2, unpack_nibbles)
 from .base import ALL, ShardedCountsBase, shard_map
 
 __all__ = ["ShardedConsensus", "ALL"]
@@ -142,8 +143,14 @@ class ShardedConsensus(ShardedCountsBase):
             hists.append((tile_of, np.bincount(tile_of,
                                                minlength=self._n_tiles)))
         emax = max(int(pt.max(initial=1)) for _t, pt in hists)
-        e = 1 << max(3, (emax - 1).bit_length())
-        if self.n * self._n_tiles * e / total > mxu_pileup.MAX_BLOWUP:
+        e_fine = round_rows_grid(emax)
+        e = e_fine
+        if self._tuner is not None and self._tuner.winner is None:
+            # autotune timing phase: stay on the pow2 grid so warm and
+            # timed slabs share one compiled shape (see _plan_prelude)
+            e = round_rows_pow2(e_fine)
+        # gate on the fine-grid economics (same rule as _plan_prelude)
+        if self.n * self._n_tiles * e_fine / total > mxu_pileup.MAX_BLOWUP:
             return None
         slots = np.empty(per * self.n, dtype=np.int32)
         for (lo, hi), (tile_of, per_tile) in zip(bounds, hists):

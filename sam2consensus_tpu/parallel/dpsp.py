@@ -48,7 +48,7 @@ from jax.sharding import PartitionSpec as P
 from ..constants import NUM_SYMBOLS
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
-                          pack_nibbles, unpack_nibbles)
+                          pack_nibbles, round_rows_grid, unpack_nibbles)
 from .base import (ALL, ShardedCountsBase, route_to_slots, shard_map,
                    split_wide_rows)
 
@@ -125,14 +125,16 @@ class ProductShardedConsensus(ShardedCountsBase):
             per_dp = -(-n_rows // self.n_dp)
             macro = np.minimum(starts // self.block_sp, self.n_sp - 1)
             # slot capacity: max rows any (dp chunk, macro block) pair
-            # receives, pow2 so the jit cache stays O(log)
+            # receives, rounded on the shared eighth-pow2 grid
+            # (ops.pileup.round_rows_grid: O(log) jit cache, <=12.5%
+            # wire padding)
             counts_dm = np.zeros((self.n_dp, self.n_sp), dtype=np.int64)
             for d in range(self.n_dp):
                 lo, hi = d * per_dp, min((d + 1) * per_dp, n_rows)
                 if lo < hi:
                     counts_dm[d] = np.bincount(macro[lo:hi],
                                                minlength=self.n_sp)
-            r = 1 << max(3, int(counts_dm.max(initial=1) - 1).bit_length())
+            r = round_rows_grid(int(counts_dm.max(initial=1)))
 
             pins = np.arange(self.n_sp, dtype=np.int32) * self.block_sp
             s_routed = np.empty((self.n_dp, self.n_sp, r), dtype=np.int32)

@@ -56,7 +56,7 @@ import numpy as np
 from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
-                          pack_nibbles, unpack_nibbles)
+                          pack_nibbles, round_rows_grid, unpack_nibbles)
 from .base import (ALL, ShardedCountsBase, block_for, route_to_slots,
                    shard_map, split_wide_rows)
 from jax.sharding import PartitionSpec as P
@@ -210,7 +210,7 @@ class PositionShardedConsensus(ShardedCountsBase):
             # where expand() redirects their cells to the sacrificial slot
             dev = starts // self.block
             per_dev = np.bincount(dev, minlength=self.n)
-            r = 1 << max(3, int(per_dev.max(initial=1) - 1).bit_length())
+            r = round_rows_grid(int(per_dev.max(initial=1)))
             s_routed, c_routed = route_to_slots(
                 dev, self.n, r, starts, codes,
                 np.arange(self.n) * self.block)

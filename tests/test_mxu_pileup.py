@@ -296,3 +296,19 @@ def test_mxu_packed_equals_compact():
         jnp.zeros((padded_len, 6), jnp.int32), jnp.asarray(starts),
         jnp.asarray(pack_nibbles(codes)), jnp.asarray(plan.slot), **args)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_round_rows_grid_contract():
+    """Pin the shared row-capacity grid (ops.pileup.round_rows_grid):
+    result >= max(8, m), overshoot <= 12.5%, idempotent (always ON the
+    grid, so jit caches stay O(8 log))."""
+    from sam2consensus_tpu.ops.pileup import round_rows_grid
+
+    probes = list(range(1, 1026)) + [
+        (1 << k) + d for k in range(10, 25) for d in (-1, 0, 1, 137)]
+    for m in probes:
+        g = round_rows_grid(m)
+        base = max(8, m)
+        assert g >= base, (m, g)
+        assert g <= base * 1.125, (m, g)
+        assert round_rows_grid(g) == g, (m, g)
