@@ -6,9 +6,11 @@ Prints ONE JSON line to stdout:
    "vs_baseline": N, "device": "...", "configs": [...], ...}
 
 ``value`` is the end-to-end jax-backend throughput (SAM text -> FASTA
-records, warm compile) on the headline workload; ``vs_baseline`` is the
-speedup over the CPU golden backend on the identical workload (BASELINE.md's
-primary metric).  ``configs`` carries one row per BASELINE.md scenario
+records, warm compile) on the north-star workload (1M reads / 500 contigs —
+the row BASELINE.md defines the >=100x target on); ``vs_baseline`` is the
+speedup over the CPU golden backend on that identical workload (BASELINE.md's
+primary metric).  The smaller ``headline`` row remains in ``configs`` as the
+round-over-round comparable workload.  ``configs`` carries one row per BASELINE.md scenario
 (phiX, multi-threshold, target capture, E. coli scale, insertion-heavy
 amplicon — plus the Pallas-kernel variant of the amplicon) with per-phase
 timings.  Every row asserts FASTA byte-identity between the two backends —
@@ -467,30 +469,42 @@ def main():
                     rows.append({"config": name, "error": repr(exc)})
         result["configs"] = rows
 
-        head = next((r for r in rows
-                     if r.get("config") == "headline" and "error" not in r),
-                    None)
-        # fallback pool: clean, byte-verified, non-degenerate rows (a
+        # the driver-recorded metric IS the north_star row: BASELINE.md
+        # defines the >=100x target on the 1M-read/500-contig north-star
+        # workload, so that row is THE number (VERDICT r4 weak #5 — the
+        # smaller headline config is oracle-noise-bound with ~0.09 s of
+        # fixed cost visible, and was under-reporting the target metric).
+        # The headline row stays in configs[] as the round-over-round
+        # comparable workload; fallback chain: north_star -> headline ->
+        # first clean row.  Fallback pool excludes degenerate rows (a
         # 460-base amplicon "throughput" is an identity check, not a
-        # headline — VERDICT r2 weak #6); oracle-anchor rows are shrunken
-        # sub-benchmarks, never headline material
+        # headline — VERDICT r2 weak #6) and oracle-anchor rows (shrunken
+        # sub-benchmarks).
         scored = [r for r in rows
                   if "error" not in r and r.get("identical")
                   and r.get("consensus_bases", 0) >= 10_000
                   and not r.get("config", "").endswith("_anchor")]
-        if head is not None and head.get("identical"):
-            value = head["bases_per_sec"]
-            vs_baseline = head["vs_baseline"]
-        elif scored:  # headline missing: fall back to the first clean row
-            value = scored[0]["bases_per_sec"]
-            vs_baseline = scored[0]["vs_baseline"]
-            result["headline_fallback"] = scored[0]["config"]
-        if any(not r.get("identical", True) for r in rows):
-            result["byte_mismatch"] = True
+
+        def clean_row(name):
+            return next((r for r in rows
+                         if r.get("config") == name and "error" not in r
+                         and r.get("identical")), None)
+
         ns = next((r for r in rows if r.get("config") == "north_star"
                    and "error" not in r), None)
         if ns is not None:
             result["north_star_vs_baseline"] = ns["vs_baseline"]
+        head = clean_row("north_star") or clean_row("headline")
+        if head is not None:
+            value = head["bases_per_sec"]
+            vs_baseline = head["vs_baseline"]
+            result["metric_config"] = head["config"]
+        elif scored:
+            value = scored[0]["bases_per_sec"]
+            vs_baseline = scored[0]["vs_baseline"]
+            result["metric_config"] = scored[0]["config"]
+        if any(not r.get("identical", True) for r in rows):
+            result["byte_mismatch"] = True
     except Exception as exc:
         result["error"] = repr(exc)
         log(f"[bench] FATAL: {exc!r}")

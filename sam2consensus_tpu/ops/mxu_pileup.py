@@ -98,13 +98,20 @@ def _plan_prelude(starts: np.ndarray, padded_len: int, tile: int,
         # the production fine grid would serve
         if n_tiles * e_fine / n > max_blowup:
             return None
+        # the REPORTED blowup is likewise the fine-grid (gated) economics:
+        # a coarse timing-phase layout pads the device up to 2x more, but
+        # that waste is transient (two trial slabs) and the actual padded
+        # rows stay derivable from n_tiles * rows_per_tile — reporting the
+        # coarse figure would let callers observe blowup > max_blowup and
+        # misread the production layout's cost (ADVICE r4)
+        blowup = n_tiles * e_fine / n
     else:
         e = rows_per_tile
         if int(per_tile.max(initial=0)) > e:
             return None
         if n_tiles * e / n > max_blowup:
             return None
-    blowup = n_tiles * e / n
+        blowup = n_tiles * e / n
     return n_tiles, tile_of, per_tile, e, blowup
 
 
@@ -248,7 +255,18 @@ def build_padded_layout(starts: jax.Array, codes: jax.Array,
     than the N*W cell scatter of the scatter pileup, and with no duplicate
     accumulation).  Slots are unique by construction, so ``.set`` is
     deterministic.
+
+    Only even widths may reach this layout: the 4-bit wire packing
+    (ops.pileup.pack_nibbles) widens ODD rows to W+1 columns on unpack,
+    which would silently mis-lay rows against the static pre-pack width
+    (safe for scatter consumers, whose PAD cells self-redirect).  Encoder
+    buckets are even by construction; this guard turns a future odd-width
+    (halo-split) routing mistake into an immediate error (ADVICE r4).
     """
+    assert width % 2 == 0, (
+        f"MXU packed layout requires an even row width, got {width}: "
+        f"odd (halo-split) rows unpack to width+1 and must stay on the "
+        f"scatter path")
     e = rows_per_tile
     tile_of = slot // e
     loc = jnp.zeros(n_tiles * e, dtype=jnp.int32).at[slot].set(
