@@ -79,16 +79,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--paranoid", action="store_true",
                    help="re-validate device inputs and outputs every batch "
                         "(index bounds, symbol codes, count invariants)")
-    p.add_argument("--pileup", choices=["auto", "mxu", "scatter", "host"],
+    p.add_argument("--pileup",
+                   choices=["auto", "pallas", "mxu", "scatter", "host"],
                    default="auto",
                    help="pileup strategy: auto (host-counts on genomes up "
                         "to ~2M positions — least wire on a tunneled chip "
-                        "— else online autotune between the device "
-                        "kernels), XLA scatter-add, MXU one-hot matmul "
-                        "(falls back to scatter on skewed coverage), or "
-                        "host (accumulate counts in native code, ship the "
-                        "tensor once; single-device). scatter/mxu compose "
-                        "with --shards in the dp shard layout")
+                        "— else online autotune between scatter and the "
+                        "device kernel), pallas (tile-CSR VMEM histogram "
+                        "kernel — the measured TPU winner), XLA "
+                        "scatter-add, MXU one-hot matmul (retired from "
+                        "auto on TPU — PERF.md; falls back to scatter on "
+                        "skewed coverage), or host (accumulate counts in "
+                        "native code, ship the tensor once; "
+                        "single-device). scatter/mxu compose with "
+                        "--shards in the dp shard layout")
     p.add_argument("--insertion-kernel", dest="ins_kernel",
                    choices=["auto", "scatter", "pallas"], default="auto",
                    help="insertion-table build on device: XLA scatter or "
@@ -117,8 +121,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "or the dp x sp product — read shards x macro "
                         "position blocks on the 2-D mesh, for huge-genome "
                         "+ deep-coverage workloads (dpsp; needs a mesh "
-                        "with both axes > 1); auto picks dp or sp by "
-                        "genome size")
+                        "with both axes > 1); auto prices all three from "
+                        "the first decoded slab's shape, the mesh, and "
+                        "the calibrated link/ICI constants "
+                        "(sam2consensus_tpu/parallel/auto.py)")
     p.add_argument("--shards", type=int, default=0,
                    help="data-parallel shards for the jax backend; 0 = all devices")
     p.add_argument("--chunk-reads", dest="chunk_reads", type=int, default=262144,
@@ -212,9 +218,6 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if cfg.shards and cfg.backend != "jax":
         raise SystemExit("--shards requires --backend jax")
-    if cfg.pileup == "mxu" and cfg.shard_mode in ("sp", "dpsp"):
-        raise SystemExit("--pileup mxu composes with the dp shard layout "
-                         "only; use --shard-mode dp")
     if cfg.pileup == "host" and cfg.shards > 1:
         raise SystemExit("--pileup host accumulates on the single host; "
                          "it does not compose with --shards")

@@ -9,11 +9,13 @@ kernel instead contracts one-hot matrices on the MXU, CSR-style:
 * the host sorts events by site key and computes, per 128-key block, the
   range of 512-event blocks that can contain its events (scalar-prefetched
   ``blk_lo``/``blk_n``);
-* the grid walks ``(key block, event block)``; each step builds
-  ``A[e, k] = [key_e == block_base + k]`` and
-  ``B[e, m] = [col_e*6 + code_e == m]`` as f32 one-hots and accumulates
-  ``AᵀB`` into a VMEM scratch block — all shapes static and lane-aligned,
-  so Mosaic needs no dynamic-offset vector stores;
+* the grid walks ``(key block, event block)``; each step builds the
+  TRANSPOSED f32 one-hots ``Aᵀ[k, e] = [key_e == block_base + k]`` and
+  ``Bᵀ[m, e] = [col_e*6 + code_e == m]`` by broadcast compare (events
+  on lanes — see ``_accumulate_block`` for why) and accumulates their
+  lane-contracted product into a VMEM scratch block — all shapes
+  static and lane-aligned, so Mosaic needs no dynamic-offset vector
+  stores and no relayouts;
 * events belonging to other key blocks one-hot to zero rows (keys are
   disjoint across blocks), so the event-range skipping is purely a
   performance device, not a correctness one — except for clamped re-visits
@@ -46,6 +48,30 @@ KEY_BLOCK = 128
 EVENT_BLOCK = 512
 
 
+def _accumulate_block(key_ref, cc_ref, acc_ref, i: int, c6p: int) -> None:
+    """One event block into the key block's VMEM accumulator.
+
+    Events live on the LANE axis (``[1, EB]`` blocks): the round-4
+    ``[EB, 1]`` layout put one scalar per sublane row, which XLA/Mosaic
+    tile-padded 128x in HBM — 256 KB of DMA per block visit for 2 KB of
+    events, most of the kernel's measured cost (and a 9.5 GB HLO temp
+    at 2e7 events).  The one-hots are built TRANSPOSED by broadcast
+    compares (iota on sublanes vs events on lanes — no relayout), and
+    ``dot_general`` contracts the shared lane axis; the MXU takes both
+    operand orientations natively, so the MAC count is unchanged.
+    """
+    key = key_ref[0]                                     # [1, EB] int32
+    cc = cc_ref[0]                                       # [1, EB] int32
+    local = key - i * KEY_BLOCK
+    at = (local == jax.lax.broadcasted_iota(
+        jnp.int32, (KEY_BLOCK, EVENT_BLOCK), 0)).astype(jnp.float32)
+    bt = (cc == jax.lax.broadcasted_iota(
+        jnp.int32, (c6p, EVENT_BLOCK), 0)).astype(jnp.float32)
+    acc_ref[...] += jax.lax.dot_general(
+        at, bt, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
 def _kernel(blk_lo_ref, blk_n_ref, key_ref, cc_ref, out_ref, acc_ref, *,
             c6p: int, n_event_blocks: int):
     i = pl.program_id(0)
@@ -58,16 +84,7 @@ def _kernel(blk_lo_ref, blk_n_ref, key_ref, cc_ref, out_ref, acc_ref, *,
 
     @pl.when(j < blk_n_ref[i])
     def _accumulate():
-        key = key_ref[0]                                     # [EB, 1] int32
-        cc = cc_ref[0]                                       # [EB, 1] int32
-        local = key - i * KEY_BLOCK
-        a = (local == jax.lax.broadcasted_iota(
-            jnp.int32, (EVENT_BLOCK, KEY_BLOCK), 1)).astype(jnp.float32)
-        b = (cc == jax.lax.broadcasted_iota(
-            jnp.int32, (EVENT_BLOCK, c6p), 1)).astype(jnp.float32)
-        acc_ref[...] += jax.lax.dot_general(
-            a, b, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _accumulate_block(key_ref, cc_ref, acc_ref, i, c6p)
 
     @pl.when(j == nb - 1)
     def _emit():
@@ -89,8 +106,8 @@ def _table_call(key3, cc3, blk_lo, blk_n, *, kp, c6p, max_blocks,
         num_scalar_prefetch=2,
         grid=(kp // KEY_BLOCK, max_blocks),
         in_specs=[
-            pl.BlockSpec((1, EVENT_BLOCK, 1), ev_index),
-            pl.BlockSpec((1, EVENT_BLOCK, 1), ev_index),
+            pl.BlockSpec((1, 1, EVENT_BLOCK), ev_index),
+            pl.BlockSpec((1, 1, EVENT_BLOCK), ev_index),
         ],
         out_specs=pl.BlockSpec((1, KEY_BLOCK, c6p),
                                lambda i, j, lo, n: (i, 0, 0)),
@@ -107,8 +124,8 @@ def _table_call(key3, cc3, blk_lo, blk_n, *, kp, c6p, max_blocks,
 
 class EventPlan(NamedTuple):
     """Host-side kernel plan: key-sorted event blocks + CSR block ranges."""
-    key3: np.ndarray       # [NEB, EVENT_BLOCK, 1] int32, key-sorted
-    cc3: np.ndarray        # [NEB, EVENT_BLOCK, 1] int32, col*6+code
+    key3: np.ndarray       # [NEB, 1, EVENT_BLOCK] int32, key-sorted
+    cc3: np.ndarray        # [NEB, 1, EVENT_BLOCK] int32, col*6+code
     blk_lo: np.ndarray     # [kp/KEY_BLOCK] int32 first event block per key blk
     blk_n: np.ndarray      # [kp/KEY_BLOCK] int32 event blocks per key blk
     kp: int                # padded key count (KEY_BLOCK multiple)
@@ -148,9 +165,156 @@ def plan_events(ev_key: np.ndarray, ev_col: np.ndarray,
                       last // EVENT_BLOCK + 1, blk_lo)
     blk_n = (blk_hi - blk_lo).astype(np.int32)
     return EventPlan(
-        key_s.reshape(n_event_blocks, EVENT_BLOCK, 1),
-        cc_s.reshape(n_event_blocks, EVENT_BLOCK, 1),
+        key_s.reshape(n_event_blocks, 1, EVENT_BLOCK),
+        cc_s.reshape(n_event_blocks, 1, EVENT_BLOCK),
         blk_lo, blk_n, kp, c6p, max(1, int(blk_n.max(initial=1))))
+
+
+def _vote_kernel(blk_lo_ref, blk_n_ref, key_ref, cc_ref, cov_ref, enc_ref,
+                 out_ref, acc_ref, *, c6p: int, cpp: int,
+                 n_thresholds: int):
+    """Fused table + vote: the count table never leaves VMEM.
+
+    Accumulation is identical to :func:`_kernel`; at the key block's
+    last event step the vote runs in-registers — six static one-hot
+    matmuls de-interleave the ``[KB, c6p]`` accumulator into symbol
+    planes (an MXU relayout costing ~KB*c6p*cpp flops ONCE per key
+    block, vs. paying 6x wider one-hots on every event block), the gap
+    lane completes from site coverage (quirk 4 — may go negative), the
+    strictly-greater sums and the exact float64 cutoffs
+    (``ops.cutoff.exact_cutoff`` — pure elementwise int32, so it runs
+    unchanged inside the kernel) gate the included set, and the
+    IUPAC *bitmask* is emitted per threshold.  The host-side LUT lookup
+    and skip logic stay outside (a 64-entry gather is XLA-cheap; the
+    [K, C, 6] HBM table round trip was not).
+    """
+    from .cutoff import exact_cutoff
+
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j < blk_n_ref[i])
+    def _accumulate():
+        _accumulate_block(key_ref, cc_ref, acc_ref, i, c6p)
+
+    @pl.when(j == nb - 1)
+    def _vote():
+        acc = acc_ref[...]                                   # [KB, c6p]
+        cov = cov_ref[0, :, :]                               # [KB, 1] int32
+        m_iota = jax.lax.broadcasted_iota(jnp.int32, (c6p, cpp), 0)
+        c_iota = jax.lax.broadcasted_iota(jnp.int32, (c6p, cpp), 1)
+        planes = []
+        for sym in range(NUM_SYMBOLS):
+            sel = (m_iota == c_iota * NUM_SYMBOLS + sym).astype(
+                jnp.float32)
+            planes.append(jax.lax.dot_general(
+                acc, sel, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(jnp.int32))
+        colsum = planes[0]
+        for p in planes[1:]:
+            colsum = colsum + p
+        planes[0] = cov - colsum      # gap completion; negative is real
+        nonzero = [p != 0 for p in planes]
+        sgs = []
+        for sym in range(NUM_SYMBOLS):
+            s = jnp.zeros_like(planes[0])
+            for k in range(NUM_SYMBOLS):
+                s = s + planes[k] * (planes[k] > planes[sym])
+            sgs.append(s)
+        for t in range(n_thresholds):
+            enc_row = (enc_ref[t, 0], enc_ref[t, 1], enc_ref[t, 2],
+                       enc_ref[t, 3], enc_ref[t, 4])
+            cutoff = exact_cutoff(cov, enc_row)              # [KB, 1]
+            mask = jnp.zeros_like(planes[0])
+            for sym in range(NUM_SYMBOLS):
+                mask = mask + jnp.where(
+                    nonzero[sym] & (sgs[sym] < cutoff), 1 << sym, 0)
+            out_ref[0, t] = mask
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "kp", "c6p", "cpp", "n_thresholds", "max_blocks", "interpret"))
+def _table_vote_call(key3, cc3, blk_lo, blk_n, site_cov, thr_enc, *, kp,
+                     c6p, cpp, n_thresholds, max_blocks, interpret=False):
+    """[NKB, T, KEY_BLOCK, cpp] int32 IUPAC bitmasks, voted in-kernel."""
+    n_event_blocks = key3.shape[0]
+    n_key_blocks = kp // KEY_BLOCK
+    kernel = functools.partial(_vote_kernel, c6p=c6p, cpp=cpp,
+                               n_thresholds=n_thresholds)
+
+    def ev_index(i, j, blk_lo, blk_n):
+        return (jnp.minimum(blk_lo[i] + j, n_event_blocks - 1), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_key_blocks, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, EVENT_BLOCK), ev_index),
+            pl.BlockSpec((1, 1, EVENT_BLOCK), ev_index),
+            pl.BlockSpec((1, KEY_BLOCK, 1),
+                         lambda i, j, lo, n: (i, 0, 0)),
+            pl.BlockSpec((n_thresholds, 5),
+                         lambda i, j, lo, n: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, n_thresholds, KEY_BLOCK, cpp),
+                               lambda i, j, lo, n: (i, 0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((KEY_BLOCK, c6p), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (n_key_blocks, n_thresholds, KEY_BLOCK, cpp), jnp.int32),
+        interpret=interpret,
+    )(blk_lo, blk_n, key3, cc3,
+      site_cov.reshape(n_key_blocks, KEY_BLOCK, 1).astype(jnp.int32),
+      thr_enc)
+
+
+#: fused-vote kernel bound on the padded column count: the emit step's
+#: de-interleave selectors are [c6p, cpp] f32 VMEM temporaries, ~6 MB at
+#: cp=512; past that the two-dispatch path (table kernel + XLA vote) wins
+FUSED_VOTE_MAX_CP = 512
+
+
+def vote_insertions_fused(key3, cc3, blk_lo, blk_n, site_cov, n_cols,
+                          thr_enc, *, kp: int, c6p: int, cp: int,
+                          max_blocks: int, interpret: bool = False):
+    """Traceable twin of ``ops.insertions.vote_insertions`` riding the
+    fused kernel: returns uint8 ``[T, kp, cp]`` with FILL_SENTINEL in
+    skipped columns.  ``site_cov``/``n_cols`` must be padded to ``kp``.
+    """
+    from .vote import FILL_SENTINEL, iupac_select
+
+    n_thresholds = thr_enc.shape[0]
+    cpp = max(128, -(-cp // 128) * 128)
+    out = _table_vote_call(
+        key3, cc3, blk_lo, blk_n, site_cov, thr_enc, kp=kp, c6p=c6p,
+        cpp=cpp, n_thresholds=n_thresholds, max_blocks=max_blocks,
+        interpret=interpret)
+    mask = jnp.transpose(out, (1, 0, 2, 3)).reshape(
+        n_thresholds, kp, cpp)[:, :, :cp]
+    syms = iupac_select(mask)
+    valid = (jnp.arange(cp)[None, :] < n_cols[:, None])
+    skip = (syms == ord("-")) | ~valid[None]
+    return jnp.where(skip, jnp.uint8(FILL_SENTINEL), syms)
+
+
+def vote_insertions_pallas(eplan: "EventPlan", site_cov, n_cols, thr_enc,
+                           cp: int, interpret: bool = False):
+    """Host-array convenience wrapper of :func:`vote_insertions_fused`."""
+    return vote_insertions_fused(
+        jnp.asarray(eplan.key3), jnp.asarray(eplan.cc3),
+        jnp.asarray(eplan.blk_lo), jnp.asarray(eplan.blk_n),
+        jnp.asarray(site_cov), jnp.asarray(n_cols),
+        jnp.asarray(thr_enc), kp=eplan.kp, c6p=eplan.c6p, cp=cp,
+        max_blocks=eplan.max_blocks, interpret=interpret)
 
 
 def build_insertion_table_pallas(ev_key: np.ndarray, ev_col: np.ndarray,

@@ -1,0 +1,338 @@
+"""Pallas TPU kernel: the pileup as a tile-CSR VMEM histogram.
+
+This is the round-5 successor to the one-hot-matmul MXU pileup
+(``ops.mxu_pileup``).  That formulation put the FLOPs on the systolic
+array but paid ``6 * TILE`` MACs per counted cell *by construction* —
+its ``[E, TP]`` start one-hot has density ``1/TP``, so at TP=2048 the
+MXU multiplied 12k zeros per real cell and the measured end-to-end rate
+lost to the plain XLA scatter ~3x (round-4 verdict; PERF.md §"MXU
+retirement").  The scatter, in turn, is bounded by XLA's serialized
+duplicate-index handling at ~53 M cells/s on a v5e chip.
+
+The histogram the pileup actually is — ``counts[start_r + j,
+codes_r[j]] += 1`` (the reference's hot loop,
+``/root/reference/sam2consensus.py:211-218``) — wants neither a matmul
+nor a serialized scatter.  It wants what this kernel does:
+
+* the host counting-sorts segment rows by **position tile**
+  (``start // TP``, the same sort ``mxu_pileup`` planned with) and
+  computes, per tile, the range of fixed-size row blocks holding its
+  rows (CSR, scalar-prefetched ``blk_lo``/``blk_n`` — the same scheme
+  as ``pallas_insertion``); **nothing is padded per tile**, so the
+  lane-occupancy question of the MXU layout does not exist here;
+* rows ship exactly as the scatter path ships them (4-bit packed codes
+  + int32 start, +4 B/row for the dense sort rank) and are re-ordered
+  on device by one unique-index row scatter;
+* the grid walks ``(tile, row block)``; each step loops its block's
+  rows, builds the row's ``[8, W]`` symbol one-hot with one VPU
+  compare (PAD unpacks to 15, matches no symbol lane, and so
+  self-suppresses — no sacrificial slot), and accumulates it into a
+  ``[8, TP + W]`` int32 VMEM accumulator at the row's tile-local
+  offset.  Duplicate positions hit VMEM at VPU speed instead of
+  serializing an HBM scatter;
+* rows extending past the tile land in the accumulator's ``[TP,
+  TP+W)`` overhang, which is **carried in scratch to the next grid
+  step** (TPU grids iterate sequentially, tiles ascending) and folded
+  into that tile's head — so the kernel emits dense ``[NT, 8, TP]``
+  counts with no separate overlap-add pass;
+* boundary row blocks shared by adjacent tiles are visited by both;
+  rows outside the visiting tile mask to zero (their local offset
+  falls outside ``[0, TP)``), exactly like the insertion kernel's
+  key-block discipline.
+
+Everything is integer-exact (int32 accumulation).  ``interpret=True``
+runs the same kernel on CPU for CI; equivalence against the scatter
+path is pinned by tests/test_pallas_pileup.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: positions per tile.  The kernel's compute scales with rows, not TP
+#: (unlike the retired MXU matmul, whose MACs scaled as 6*TP per cell),
+#: so TP trades only VMEM footprint (~4.2 MB accumulator at 2^17)
+#: against boundary-block overlap; on-chip sweep (v5e, L=4.6M, 131k
+#: rows): 2^15 483, 2^17 573-735 Mcells/s.
+TILE_POSITIONS = 1 << 17
+
+#: cells (rows x width) per row block: bounds the block's VMEM window.
+#: On-chip sweep at W=128: 2^16 573, 2^17 735, 2^18 488 Mcells/s.
+ROW_BLOCK_CELLS = 1 << 17
+
+#: symbol lanes (6 real + 2 sublane pad — int32 tiles are 8x128)
+SYM_LANES = 8
+
+
+def _row_block(width: int) -> int:
+    """Rows per grid block for a bucket width (multiple of 8, >= 8)."""
+    return max(8, (ROW_BLOCK_CELLS // max(width, 1)) // 8 * 8)
+
+
+def _cw(width: int) -> int:
+    """Carry width: the overhang region rounded up to whole lane tiles
+    (Mosaic vector stores must start 128-aligned, so the accumulator is
+    addressed in 128-lane units)."""
+    return -(-width // 128) * 128
+
+
+def _kernel(blk_lo_ref, blk_n_ref, starts_ref, codes_ref, out_ref,
+            acc_ref, carry_ref, *, tile: int, width: int, row_block: int):
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+    cw = _cw(width)
+    ww = cw + 128                       # rolled one-hot window width
+
+    @pl.when(jnp.logical_and(t == 0, j == 0))
+    def _init_carry():
+        carry_ref[...] = jnp.zeros_like(carry_ref)
+
+    @pl.when(j == 0)
+    def _init_acc():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j < blk_n_ref[t])
+    def _accumulate():
+        sym = jax.lax.broadcasted_iota(jnp.int32, (SYM_LANES, width), 0)
+        base = t * tile
+
+        def body(r, _):
+            start = starts_ref[0, 0, r]
+            local = start - base
+            # rows of neighboring tiles sharing this boundary block mask
+            # to zero; their own tile's grid steps count them
+            ok = jnp.logical_and(local >= 0, local < tile)
+            lc = jnp.where(ok, local, 0)
+            # Mosaic needs 128-aligned dynamic lane offsets: store at
+            # the aligned base below lc and lane-rotate the one-hot up
+            # by the remainder (the rotate is a native VPU permute)
+            a = lc // 128
+            m = lc - a * 128
+            row = codes_ref[0, pl.ds(r, 1), :]              # [1, W]
+            oh = jnp.where(ok, (row == sym).astype(jnp.int32), 0)
+            rolled = pltpu.roll(
+                jnp.pad(oh, ((0, 0), (0, ww - width))), m, 1)
+            acc_ref[:, pl.ds(pl.multiple_of(a * 128, 128), ww)] += rolled
+            return 0
+
+        jax.lax.fori_loop(0, row_block, body, 0)
+
+    @pl.when(j == nb - 1)
+    def _emit():
+        # fold the PREVIOUS tile's overhang into this tile's head, then
+        # hand this tile's overhang to the next grid step via scratch
+        # (grids iterate tiles in ascending order on TPU)
+        out_ref[0, :, :cw] = acc_ref[:, :cw] + carry_ref[...]
+        out_ref[0, :, cw:] = acc_ref[:, cw:tile]
+        carry_ref[...] = acc_ref[:, tile:tile + cw]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "tile", "n_tiles", "width", "row_block", "max_blocks", "interpret"))
+def _pileup_call(starts2, codes3, blk_lo, blk_n, *, tile, n_tiles, width,
+                 row_block, max_blocks, interpret=False):
+    """[NT, 8, TP] int32 tile counts from sorted row blocks."""
+    n_row_blocks = codes3.shape[0]
+    cw = _cw(width)
+    kernel = functools.partial(_kernel, tile=tile, width=width,
+                               row_block=row_block)
+
+    def rb_index(t, j, blk_lo, blk_n):
+        # steps past the tile's real block range (j >= blk_n, compute
+        # skipped) clamp to the LAST real block, not the global tail:
+        # repeating the previous step's index lets pallas skip the DMA
+        # entirely, so skewed/sorted slabs (few dense tiles driving a
+        # large max_blocks axis) don't pay dead transfers on the rest
+        # outer clamp: an empty tile at the stream's end has
+        # blk_lo == n_row_blocks (cumsum boundary), which must not index
+        return (jnp.minimum(
+            jnp.minimum(blk_lo[t] + j,
+                        blk_lo[t] + jnp.maximum(blk_n[t] - 1, 0)),
+            n_row_blocks - 1), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_tiles, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, row_block), rb_index,
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, row_block, width), rb_index),
+        ],
+        out_specs=pl.BlockSpec((1, SYM_LANES, tile),
+                               lambda t, j, lo, n: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((SYM_LANES, tile + cw), jnp.int32),
+            pltpu.VMEM((SYM_LANES, cw), jnp.int32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_tiles, SYM_LANES, tile),
+                                       jnp.int32),
+        interpret=interpret,
+    )(blk_lo, blk_n, starts2, codes3)
+
+
+class RowPlan(NamedTuple):
+    """Host-side CSR plan: dense sort rank + per-tile row-block ranges.
+
+    Nothing is padded per tile — ``rank`` is a permutation of
+    ``[0, N)`` (plus a tail of PAD rows up to the row-block multiple),
+    so the kernel's only redundancy is boundary blocks shared by two
+    tiles.
+    """
+    rank: np.ndarray       # [N] int32: row -> position in tile-sorted order
+    blk_lo: np.ndarray     # [NT] int32 first row block per tile
+    blk_n: np.ndarray      # [NT] int32 row blocks per tile
+    n_tiles: int
+    n_rows_padded: int     # row-block multiple
+    row_block: int
+    max_blocks: int        # grid's row-block axis (pow2-rounded)
+
+
+def plan_rows(starts: np.ndarray, width: int, padded_len: int,
+              tile: int = TILE_POSITIONS) -> RowPlan:
+    """Counting-sort rows by position tile; CSR block ranges per tile."""
+    n = len(starts)
+    n_tiles = max(1, -(-padded_len // tile))
+    row_block = _row_block(width)
+    tile_of = starts // tile
+    order = np.argsort(tile_of, kind="stable")
+    rank = np.empty(n, dtype=np.int32)
+    rank[order] = np.arange(n, dtype=np.int32)
+    per_tile = np.bincount(tile_of, minlength=n_tiles)
+    hi = np.cumsum(per_tile)
+    lo = hi - per_tile
+    blk_lo = (lo // row_block).astype(np.int32)
+    last = np.maximum(hi - 1, lo)
+    blk_n = np.where(per_tile > 0,
+                     last // row_block + 1 - blk_lo, 0).astype(np.int32)
+    mb = int(blk_n.max(initial=1))
+    # pow2 grid rounding: the row-block axis is a static grid dimension,
+    # so per-slab max variation would otherwise recompile every slab
+    max_blocks = 1 << max(0, (max(mb, 1) - 1).bit_length())
+    n_rows_padded = -(-max(n, 1) // row_block) * row_block
+    return RowPlan(rank, blk_lo, blk_n, n_tiles, n_rows_padded,
+                   row_block, max_blocks)
+
+
+def local_tile_counts(starts: jax.Array, packed: jax.Array,
+                      rank: jax.Array, blk_lo: jax.Array,
+                      blk_n: jax.Array, *, tile: int, n_tiles: int,
+                      width: int, row_block: int, max_blocks: int,
+                      n_rows_padded: int, out_len: int,
+                      interpret: bool = False) -> jax.Array:
+    """Traceable core: one 4-bit-packed row slab -> dense ``[out_len, 6]``.
+
+    Shared by the single-device accumulator and the sharded (dp/sp/dpsp)
+    shard_map bodies, where ``starts`` are shard-local coordinates.  The
+    tile-sorted order is materialized on device by one unique-index row
+    scatter (``rank`` is dense — no padding blowup, unlike the retired
+    MXU slot layout).
+    """
+    from .pileup import unpack_nibbles
+
+    codes = unpack_nibbles(packed).astype(jnp.int32)        # [N, W]
+    sorted_codes = jnp.full((n_rows_padded, width), 15,
+                            dtype=jnp.int32).at[rank].set(codes)
+    sorted_starts = jnp.zeros((n_rows_padded,),
+                              dtype=jnp.int32).at[rank].set(starts)
+    # PAD-filled tail rows keep start 0: they visit tile 0 in-range but
+    # their codes (15) match no symbol lane, adding zero
+    n_row_blocks = n_rows_padded // row_block
+    out = _pileup_call(
+        sorted_starts.reshape(n_row_blocks, 1, row_block),
+        sorted_codes.reshape(n_row_blocks, row_block, width),
+        blk_lo, blk_n, tile=tile, n_tiles=n_tiles, width=width,
+        row_block=row_block, max_blocks=max_blocks, interpret=interpret)
+    return jnp.transpose(out, (0, 2, 1)).reshape(
+        n_tiles * tile, SYM_LANES)[:out_len, :6]
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=(
+    "tile", "n_tiles", "width", "row_block", "max_blocks", "n_rows_padded",
+    "interpret"))
+def pileup_pallas_packed(counts: jax.Array, starts: jax.Array,
+                         packed: jax.Array, rank: jax.Array, *, tile: int,
+                         n_tiles: int, width: int, row_block: int,
+                         max_blocks: int, n_rows_padded: int,
+                         blk_lo: jax.Array, blk_n: jax.Array,
+                         interpret: bool = False) -> jax.Array:
+    """Accumulate a 4-bit-packed row slab into ``counts`` [>=NT*TP, 6].
+
+    Rows ship exactly as the scatter path ships them (+4 B/row rank).
+    """
+    return counts + local_tile_counts(
+        starts, packed, rank, blk_lo, blk_n, tile=tile, n_tiles=n_tiles,
+        width=width, row_block=row_block, max_blocks=max_blocks,
+        n_rows_padded=n_rows_padded, out_len=counts.shape[0],
+        interpret=interpret)
+
+
+class StackedRowPlan(NamedTuple):
+    """Uniform-shape per-device CSR plans for SPMD (shard_map) use.
+
+    ``rank``/``blk_lo``/``blk_n`` carry one leading device axis; the
+    static fields (row_block, max_blocks, n_rows_padded) are maxima over
+    the devices so every shard traces one common shape.
+    """
+    rank: np.ndarray       # [D, R] int32
+    blk_lo: np.ndarray     # [D, NT] int32
+    blk_n: np.ndarray      # [D, NT] int32
+    n_tiles: int
+    n_rows_padded: int
+    row_block: int
+    max_blocks: int
+
+
+def plan_rows_stacked(starts2d: np.ndarray, width: int, local_len: int,
+                      tile: int = TILE_POSITIONS) -> StackedRowPlan:
+    """Per-device CSR plans over a common local coordinate space.
+
+    ``starts2d`` is ``[D, R]`` shard-local starts (the sp/dpsp routers'
+    dense slot grids, or dp's even row chunks); rows a device does not
+    own must be PAD rows parked at start 0 (they count nothing).
+    """
+    d, r = starts2d.shape
+    plans = [plan_rows(starts2d[i].astype(np.int64), width, local_len,
+                       tile) for i in range(d)]
+    row_block = plans[0].row_block
+    max_blocks = max(p.max_blocks for p in plans)
+    n_rows_padded = max(p.n_rows_padded for p in plans)
+    return StackedRowPlan(
+        np.stack([p.rank for p in plans]),
+        np.stack([p.blk_lo for p in plans]),
+        np.stack([p.blk_n for p in plans]),
+        plans[0].n_tiles, n_rows_padded, row_block, max_blocks)
+
+
+def pileup_pallas_host(counts_len: int, starts: np.ndarray,
+                       codes: np.ndarray, tile: int = TILE_POSITIONS,
+                       interpret: bool = False) -> np.ndarray:
+    """Convenience wrapper (tests/microbench): plan + run one slab
+    against zero counts; returns host ``[counts_len, 6]``."""
+    from .pileup import pack_nibbles
+
+    width = codes.shape[1]
+    assert width % 2 == 0, "pallas pileup rides the nibble wire (even W)"
+    padded_len = -(-(counts_len + 1) // tile) * tile
+    plan = plan_rows(starts.astype(np.int64), width, padded_len, tile)
+    counts = jnp.zeros((counts_len, 6), dtype=jnp.int32)
+    out = pileup_pallas_packed(
+        counts, jnp.asarray(starts.astype(np.int32)),
+        jnp.asarray(pack_nibbles(codes)), jnp.asarray(plan.rank),
+        tile=tile, n_tiles=plan.n_tiles, width=width,
+        row_block=plan.row_block, max_blocks=plan.max_blocks,
+        n_rows_padded=plan.n_rows_padded,
+        blk_lo=jnp.asarray(plan.blk_lo), blk_n=jnp.asarray(plan.blk_n),
+        interpret=interpret)
+    return np.asarray(out)

@@ -151,11 +151,18 @@ class PileupAutoTuner:
     ``stats`` is a dict once a winner is locked, else None.
     """
 
-    STAGES = (("scatter", False), ("scatter", True),
-              ("mxu", False), ("mxu", True))
     MAX_SKEW_RETRIES = 3
 
-    def __init__(self, min_cells: int = SCATTER_CELL_BUDGET >> 3):
+    def __init__(self, min_cells: int = SCATTER_CELL_BUDGET >> 3,
+                 kernel: str = "mxu"):
+        #: which device kernel the trial races against scatter: the
+        #: Pallas tile-CSR histogram on real TPUs (ops.pallas_pileup —
+        #: measured 5-9x the scatter rate on v5e), the MXU matmul
+        #: formulation elsewhere (kept for the CPU-mesh test surface;
+        #: retired from TPU auto — PERF.md "MXU retirement")
+        self.STAGES = (("scatter", False), ("scatter", True),
+                       (kernel, False), (kernel, True))
+        self.kernel = kernel
         self.min_cells = min_cells
         self.times: dict = {}
         self.stats = None
@@ -175,8 +182,8 @@ class PileupAutoTuner:
         self.stats = {
             "scatter_sec_per_mcell": round(
                 self.times.get("scatter", 0.0) * 1e6, 5),
-            "mxu_sec_per_mcell": round(
-                self.times.get("mxu", 0.0) * 1e6, 5),
+            f"{self.kernel}_sec_per_mcell": round(
+                self.times.get(self.kernel, 0.0) * 1e6, 5),
             "winner": winner, **extra}
 
     def choose(self, n_rows: int, width: int):
@@ -201,23 +208,24 @@ class PileupAutoTuner:
         return self._chosen, self._timing
 
     def report_skew(self) -> None:
-        """The mxu plan fell back to scatter on this slab."""
+        """The kernel plan fell back to scatter on this slab."""
         if self.winner is not None:
             return
         self._timing = self._advance = False
         self._skew += 1
         if self._skew >= self.MAX_SKEW_RETRIES:
-            # persistent skew: mxu would rarely engage anyway, and each
-            # retry pays the host planning scan — settle for scatter
-            self._lock("scatter", reason="mxu_skew")
+            # persistent skew: the kernel would rarely engage anyway, and
+            # each retry pays the host planning scan — settle for scatter
+            self._lock("scatter", reason=f"{self.kernel}_skew")
 
     def complete(self, sec_per_cell=None) -> None:
         if self.winner is not None:
             return
         if self._timing:
             self.times[self._chosen] = sec_per_cell
-            if "scatter" in self.times and "mxu" in self.times:
-                self._lock(min(("scatter", "mxu"), key=self.times.get))
+            if "scatter" in self.times and self.kernel in self.times:
+                self._lock(min(("scatter", self.kernel),
+                               key=self.times.get))
         if self._advance:
             self._stage += 1
 
@@ -388,34 +396,36 @@ class HostPileupAccumulator:
 
 
 def run_tuned_slab(tuner, static_choice: str, n_rows: int, width: int,
-                   plan_mxu, exec_mxu, exec_scatter, block) -> str:
+                   plan_kernel, exec_kernel, exec_scatter, block) -> str:
     """Shared driver for one slab of the autotune protocol.
 
     Used by both the single-device and the dp-sharded accumulators so the
     choose → execute → report_skew/complete sequencing (subtle: timing
-    must start before host planning, a skewed mxu plan must clear the
+    must start before host planning, a skewed kernel plan must clear the
     timing flag, stats publish after every slab) lives in exactly one
-    place.  ``plan_mxu() -> plan | None`` (None = skew), ``exec_mxu(plan)``
-    / ``exec_scatter()`` run the slab, ``block()`` forces completion for
-    an honest timing sample.  Returns the strategy key actually used.
+    place.  ``plan_kernel() -> plan | None`` (None = skew; only the MXU
+    matmul layout can skew — the Pallas CSR plan pads nothing),
+    ``exec_kernel(plan)`` / ``exec_scatter()`` run the slab, ``block()``
+    forces completion for an honest timing sample.  Returns the strategy
+    key actually used.
     """
     if tuner is not None:
         chosen, timing = tuner.choose(n_rows, width)
     else:
         chosen, timing = static_choice, False
-    t0 = time.perf_counter()           # before host planning: the mxu
+    t0 = time.perf_counter()           # before host planning: the kernel
     plan = None                        # number must be end-to-end
     skewed = False
-    if chosen == "mxu":
-        plan = plan_mxu()
+    if chosen != "scatter":
+        plan = plan_kernel()
         if plan is None:               # skew (padding blowup): scatter
             skewed = True
             if tuner is not None:
                 tuner.report_skew()
                 timing = False
     if plan is not None:
-        exec_mxu(plan)
-        key = "mxu"
+        exec_kernel(plan)
+        key = chosen
     else:
         exec_scatter()
         key = "scatter"
@@ -431,13 +441,23 @@ def run_tuned_slab(tuner, static_choice: str, n_rows: int, width: int,
 class PileupAccumulator:
     """Streaming accumulator for one device (sharded use lives in parallel/).
 
-    Three strategies (``strategy``):
+    Four strategies (``strategy``):
 
     * ``"scatter"``: XLA scatter-add — the semantics oracle, and the
       automatic fallback when per-tile padding would explode (skewed
       coverage) or a bucket is tiny;
+    * ``"pallas"``: the tile-CSR VMEM histogram kernel
+      (``ops.pallas_pileup``) — duplicate positions accumulate at VPU
+      speed instead of serializing an HBM scatter; measured 5-9x the
+      scatter rate on a v5e chip (PERF.md round 5);
     * ``"mxu"``: one-hot matmul + overlap-add (``ops.mxu_pileup``,
-      compact slot transfer) — the FLOPs land on the systolic array;
+      compact slot transfer) — RETIRED from auto on TPU backends: its
+      ``[E, TP]`` start one-hot has density 1/TP, so it pays ``6*TP``
+      MACs per counted cell and measured ~3x slower than scatter
+      end-to-end on the chip (round-4 verdict; PERF.md "MXU
+      retirement").  Kept as an explicit strategy: it is the only
+      device formulation whose FLOPs land on the systolic array, and
+      the CPU-mesh test surface pins its semantics;
     * ``"auto"``: ONLINE AUTOTUNE via ``PileupAutoTuner`` (shared with the
       dp-sharded accumulator, parallel/dp.py).  Rather than hard-coding a
       winner that depends on the runtime (round 1's padded-transfer MXU
@@ -445,18 +465,19 @@ class PileupAccumulator:
       through the tunneled link), auto measures each strategy on early
       steady-state slabs — warm a strategy on one slab, time it on the
       NEXT slab of the same shape (so jit compilation never pollutes the
-      number), scatter first, then mxu — and locks in the winner by
-      per-cell throughput from then on.  The mxu measurement starts
-      before host slot planning, so it is honestly end-to-end (host plan
-      + transfer + device); a trial that keeps hitting skewed slabs gives
-      up after ``MAX_SKEW_RETRIES`` and locks in scatter.  Runs too small
-      to finish the trial stay on scatter; every trial slab still
-      accumulates exactly (both strategies are exact), so the tuning is
+      number), scatter first, then the device kernel (pallas on real
+      TPUs, mxu elsewhere) — and locks in the winner by per-cell
+      throughput from then on.  The kernel measurement starts before
+      host planning, so it is honestly end-to-end (host plan + transfer
+      + device); a trial that keeps hitting skewed slabs gives up after
+      ``MAX_SKEW_RETRIES`` and locks in scatter.  Runs too small to
+      finish the trial stay on scatter; every trial slab still
+      accumulates exactly (all strategies are exact), so the tuning is
       free of correctness cost.
     """
 
     def __init__(self, total_len: int, device=None, strategy: str = "auto"):
-        from . import mxu_pileup
+        from . import mxu_pileup, pallas_pileup
 
         self.total_len = total_len
         self.device = device
@@ -473,7 +494,15 @@ class PileupAccumulator:
         self.bytes_h2d = 0                 # wire accounting for bench
         self._mxu_rows_real = 0            # occupancy accounting: run
         self._mxu_rows_padded = 0          # aggregate, not last-slab
-        self._tuner = PileupAutoTuner() if strategy == "auto" else None
+        # the pallas kernel compiles for the real TPU; anywhere else
+        # (CPU tests, cpu-fallback bench) it runs in interpret mode
+        plat = (device.platform if device is not None
+                else jax.default_backend())
+        self._pallas_interpret = plat != "tpu"
+        self._pallas_tile = pallas_pileup.TILE_POSITIONS
+        self._tuner = PileupAutoTuner(
+            kernel="pallas" if plat == "tpu" else "mxu") \
+            if strategy == "auto" else None
 
     def sync(self) -> None:
         """Block until every dispatched scatter/matmul has landed in the
@@ -500,8 +529,10 @@ class PileupAccumulator:
                                starts.nbytes + packed.nbytes)
 
     def add(self, batch: SegmentBatch) -> None:
-        from . import mxu_pileup
+        from . import mxu_pileup, pallas_pileup
 
+        kernel_name = (self._tuner.kernel if self._tuner is not None
+                       else self.strategy)
         for w, (starts, codes) in sorted(batch.buckets.items()):
             staged = batch.staged.get(w)
             # slab pow2 padding appends a contiguous all-PAD tail at
@@ -575,6 +606,30 @@ class PileupAccumulator:
                     n_tiles=plan.n_tiles,
                     rows_per_tile=plan.rows_per_tile, width=plan.width)
 
+            def plan_pallas():
+                if n_rows == 0:
+                    return None
+                if pallas_pileup._cw(w) * 2 > self._pallas_tile:
+                    return None        # overhang carry needs W <= TP/2
+                return pallas_pileup.plan_rows(
+                    np.asarray(starts)[:n_rows].astype(np.int64), w,
+                    self.padded_len, self._pallas_tile)
+
+            def exec_pallas(plan):
+                st, pk = put_operands()
+                self.bytes_h2d += (plan.rank.nbytes + plan.blk_lo.nbytes
+                                   + plan.blk_n.nbytes)
+                self._counts = pallas_pileup.pileup_pallas_packed(
+                    self._counts, st[:n_rows], pk[:n_rows],
+                    jax.device_put(plan.rank, self.device),
+                    tile=self._pallas_tile, n_tiles=plan.n_tiles,
+                    width=w, row_block=plan.row_block,
+                    max_blocks=plan.max_blocks,
+                    n_rows_padded=plan.n_rows_padded,
+                    blk_lo=jax.device_put(plan.blk_lo, self.device),
+                    blk_n=jax.device_put(plan.blk_n, self.device),
+                    interpret=self._pallas_interpret)
+
             def exec_scatter():
                 st, pk = put_operands()
                 for lo, hi in iter_row_slices(n_rows, w):
@@ -589,8 +644,10 @@ class PileupAccumulator:
             # tunnel (tools/tunnel_probe.py) and would bias the trial
             # toward whichever strategy does more device-side work
             key = run_tuned_slab(
-                self._tuner, self.strategy, n_rows, w, plan_mxu,
-                exec_mxu, exec_scatter,
+                self._tuner, self.strategy, n_rows, w,
+                plan_pallas if kernel_name == "pallas" else plan_mxu,
+                exec_pallas if kernel_name == "pallas" else exec_mxu,
+                exec_scatter,
                 lambda: np.asarray(self._counts[0, 0]))
             if self._tuner is not None and self._tuner.stats is not None:
                 self.strategy_used["autotune"] = self._tuner.stats

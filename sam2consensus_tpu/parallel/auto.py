@@ -1,0 +1,175 @@
+"""Model-driven shard-mode selection: dp vs sp vs dpsp from observed data.
+
+Replaces the round-4 single test ``total_len >= 2^25`` (round-4 verdict
+#3).  All three layouts ship the same row payload; what differs is the
+per-slab OVERHEAD each adds on top, priced here in seconds from the
+observed first slab and the calibrated machine constants:
+
+* **dp** adds one reduce-scatter of the full ``[Lp, 6]`` int32 tensor
+  per slab (each device sends ~``L*24*(n-1)/n`` bytes over ICI) plus an
+  O(L) local-tensor transient — zero host routing, so it wins whenever
+  the genome is small relative to a slab's row bytes;
+* **sp** adds only a ``[H, 6]`` halo shift (~free) but pays host-side
+  routing (one counting sort + slot-grid materialization per slab) and
+  ships the dense grid — ``n * max_rows_per_device`` row slots, which
+  inflates by the observed per-device imbalance.  Coordinate-sorted
+  slabs take sp's window strategy instead (even split, no routing), so
+  imbalance only bills the residual unsorted fraction;
+* **dpsp** splits reads evenly across dp (no routing, imbalance-immune)
+  and routes among only ``n_sp`` macro blocks, paying a
+  ``L/n_sp * 24``-byte reduce-scatter per slab — between the other two
+  on both axes, the right pick when a huge genome meets deep coverage
+  on a true 2-D mesh.
+
+The constants are deliberately coarse (decisions here flip on order-of-
+magnitude ratios, not percents) and env-overridable for other rigs:
+``S2C_ICI_GBPS`` (per-device collective bandwidth), ``S2C_ROUTE_MROWS``
+(host routing rate).  The row-payload wire term is common to all modes
+and cancels, so the link rate does not appear.  The decision table is
+pinned by tests/test_shard_auto.py; the measured sweep lives in
+``tools/shard_sweep.py`` → ``campaign/shard_sweep_r05.jsonl``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+#: int32 count-lane bytes per genome position ([*, 6] int32)
+_POS_BYTES = 24
+
+
+def _ici_bps() -> float:
+    """Per-device collective bandwidth for reduce-scatter terms.  The
+    default is deliberately conservative for a v5e ICI (~45 GB/s links);
+    the 8-virtual-device CPU "mesh" moves memcpy-speed (~5 GB/s), which
+    the same default models within the decision's tolerance."""
+    return float(os.environ.get("S2C_ICI_GBPS", "10")) * 1e9
+
+
+def _route_rows_per_sec() -> float:
+    """Host routing throughput: counting sort + slot-grid scatter,
+    measured ~5-20 M rows/s on one core (numpy argsort dominated)."""
+    return float(os.environ.get("S2C_ROUTE_MROWS", "8")) * 1e6
+
+
+def _dp_max_local_bytes() -> float:
+    """dp's per-device transient is a FULL-length [Lp, 6] int32 tensor
+    per slab; past this budget dp is memory-infeasible — which is the
+    original reason position sharding exists (SURVEY.md §5
+    long-context), so the gate is part of the model, not a tuning."""
+    return float(os.environ.get("S2C_DP_MAX_LOCAL_GB", "2")) * 2**30
+
+
+#: fixed per-slab plumbing the sp/dpsp paths add over dp (grid
+#: materialization, extra host passes, window dispatch) — a tie-break
+#: keeping tiny workloads on the simpler dp pipeline
+_SP_FIXED_SEC = 2e-4
+
+
+def slab_stats(buckets, total_len: int) -> tuple:
+    """(rows, row_bytes, max_width, peak_frac, sorted_frac) of one
+    decoded slab for :func:`choose_shard_mode`.
+
+    ``peak_frac`` is the heaviest 1/64th-of-genome bin's share of the
+    slab's rows — a device owning that region of the position axis
+    would receive ``peak_frac * rows``, so a router's slot grid (sized
+    by the fullest target) inflates to ``~peak_frac * n_targets``;
+    ``sorted_frac`` is the fraction of rows in buckets the sp WINDOW
+    strategy would absorb, judged by the window path's real gates
+    (parallel.sp: pow2 span within the cap and the density bound).
+    """
+    rows = 0
+    row_bytes = 0
+    max_w = 0
+    window_rows = 0
+    bins = np.zeros(64, dtype=np.int64)
+    scale = max(1, total_len)
+    for w, (starts, codes) in buckets.items():
+        from .base import real_row_mask
+
+        s = np.asarray(starts)
+        # drop encoder pad rows: they count nothing and would otherwise
+        # pile into bin 0, reading as phantom clustering on every
+        # shallow slab (pow2 slab padding can double the row count)
+        keep = real_row_mask(s, np.asarray(codes))
+        if not keep.all():
+            s = s[keep]
+        if len(s) == 0:
+            continue
+        rows += len(s)
+        row_bytes += len(s) * (w // 2 + 4)
+        max_w = max(max_w, w)
+        span = float(s.max()) + w - float(s.min())
+        wp = 1 << max(10, int(span - 1).bit_length())
+        if (wp * _POS_BYTES <= 16 * len(s) * w
+                and wp <= min(1 << 21, total_len)):
+            window_rows += len(s)
+        idx = (s / scale * 63).astype(np.int64)
+        bins += np.bincount(np.clip(idx, 0, 63), minlength=64)
+    if rows == 0:
+        return 0, 0, 0, 1.0, 0.0
+    return (rows, row_bytes, max_w, float(bins.max() / rows),
+            window_rows / rows)
+
+
+def choose_shard_mode(total_len: int, n_devices: int, mesh_shape: dict,
+                      rows_per_slab: int, row_bytes_per_slab: int,
+                      peak_frac: float, sorted_frac: float,
+                      halo: int, link_bps: float) -> str:
+    """Pick dp / sp / dpsp by modeled per-slab overhead (module doc).
+
+    The routers' dense slot grids ship ``targets * max_rows_per_target``
+    row slots, so a clustered-but-not-window-eligible slab inflates the
+    HOST→DEVICE wire by up to the target count — ``n`` for sp, only
+    ``n_sp`` for dpsp (its dp axis splits evenly, imbalance-immune).
+    That inflation bills the LINK (the scarce resource on a tunneled
+    chip), which is exactly where dpsp earns its reduce-scatter tax:
+    huge genome + clustered reads + 2-D mesh.  ``link_bps`` is the
+    placement model's calibrated rate (backends.jax_backend
+    ``_link_constants``).
+    """
+    n = max(1, n_devices)
+    n_sp = max(1, mesh_shape.get("sp", 1))
+    padded = -(-(total_len + 1) // n) * n
+    ici = _ici_bps()
+    route = _route_rows_per_sec()
+    rows = max(1, rows_per_slab)
+    rb = max(1, row_bytes_per_slab)
+
+    cost_dp = padded * _POS_BYTES / ici
+    # routing and grid inflation bill only the unsorted residue; the
+    # window strategy absorbs coordinate-sorted slabs at the cost of a
+    # window-sized psum instead
+    unsorted = max(0.0, 1.0 - sorted_frac)
+    # the slot grid sizes by the fullest target: peak_frac * n_targets
+    # for sp's n devices, bounded by n_sp macro blocks for dpsp
+    infl_sp = max(0.0, min(peak_frac * n, n) - 1.0)
+    infl_dpsp = max(0.0, min(peak_frac * n_sp, n_sp) - 1.0)
+    window = sorted_frac * min(padded, 1 << 21) * _POS_BYTES / ici
+    cost_sp = (_SP_FIXED_SEC + window
+               + rows * unsorted / route
+               + rb * unsorted * infl_sp / link_bps
+               + halo * _POS_BYTES / ici)
+    feasible_sp = padded // n >= halo
+    feasible_dpsp = (min(mesh_shape.get("dp", 1), n_sp) > 1
+                     and padded // n_sp >= halo)
+    cost_dpsp = (_SP_FIXED_SEC + window
+                 + rows * unsorted / route
+                 + rb * unsorted * infl_dpsp / link_bps
+                 + padded // n_sp * _POS_BYTES / ici
+                 + halo * _POS_BYTES / ici)
+
+    costs = {}
+    # dp's transient memory gate comes first: the full-length local
+    # tensor is the thing position sharding exists to avoid
+    if padded * _POS_BYTES <= _dp_max_local_bytes():
+        costs["dp"] = cost_dp
+    if feasible_sp:
+        costs["sp"] = cost_sp
+    if feasible_dpsp:
+        costs["dpsp"] = cost_dpsp
+    if not costs:
+        return "dp"                    # nothing feasible: dp, best effort
+    return min(costs, key=costs.get)

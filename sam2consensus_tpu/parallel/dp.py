@@ -65,11 +65,16 @@ class ShardedConsensus(ShardedCountsBase):
 
         self.pileup = pileup
         self.strategy_used: dict = {}
-        self._tuner = PileupAutoTuner() if pileup == "auto" else None
+        plat = jax.default_backend()
+        self._pallas_interpret = plat != "tpu"
+        self._tuner = PileupAutoTuner(
+            kernel="pallas" if plat == "tpu" else "mxu") \
+            if pileup == "auto" else None
         self._tile = mxu_pileup.TILE_POSITIONS
         self._tiles_len = -(-self.padded_len // self._tile) * self._tile
         self._n_tiles = self._tiles_len // self._tile
         self._mxu_cache: dict = {}
+        self._pallas_cache: dict = {}
 
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(ALL, None), P(ALL), P(ALL, None)),
@@ -157,13 +162,91 @@ class ShardedConsensus(ShardedCountsBase):
             slots[lo:hi] = mxu_pileup.assign_slots(tile_of, per_tile, e)
         return starts, codes, slots, e
 
+    def _pallas_accumulate(self, w: int, plan):
+        """Cached shard_map'd Pallas accumulate: per-device tile-CSR
+        histogram over the FULL padded position axis (dp's even row
+        chunks carry global starts), then the same reduce-scatter as
+        the scatter path."""
+        from ..ops import pallas_pileup as pp
+
+        key = (w, plan.row_block, plan.max_blocks, plan.n_rows_padded,
+               plan.n_tiles)
+        if key in self._pallas_cache:
+            return self._pallas_cache[key]
+        padded_len = self.padded_len
+        interp = self._pallas_interpret
+
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(P(ALL, None), P(ALL), P(ALL, None), P(ALL),
+                           P(ALL, None), P(ALL, None)),
+                 out_specs=P(ALL, None), check_vma=False)
+        def accumulate(counts_blk, starts, packed, rank, blk_lo, blk_n):
+            local = pp.local_tile_counts(
+                starts, packed, rank, blk_lo[0], blk_n[0],
+                tile=pp.TILE_POSITIONS, n_tiles=plan.n_tiles, width=w,
+                row_block=plan.row_block, max_blocks=plan.max_blocks,
+                n_rows_padded=plan.n_rows_padded, out_len=padded_len,
+                interpret=interp)
+            return counts_blk + jax.lax.psum_scatter(
+                local, ALL, scatter_dimension=0, tiled=True)
+
+        fn = jax.jit(accumulate, donate_argnums=0)
+        self._pallas_cache[key] = fn
+        return fn
+
+    def _plan_pallas(self, starts: np.ndarray, codes: np.ndarray):
+        """Even per-device chunks + stacked CSR plans; None only for
+        unsupported widths (odd halo-split or overhang > tile/2)."""
+        from ..ops import pallas_pileup as pp
+
+        total = len(starts)
+        if total == 0:
+            return None
+        w = codes.shape[1]
+        if w % 2 or pp._cw(w) * 2 > pp.TILE_POSITIONS:
+            return None
+        per = -(-total // self.n)
+        if per * self.n != total:
+            starts = np.concatenate(
+                [starts, np.zeros(per * self.n - total,
+                                  dtype=starts.dtype)])
+            codes = np.concatenate(
+                [codes, np.full((per * self.n - total, w), PAD_CODE,
+                                dtype=np.uint8)])
+        plan = pp.plan_rows_stacked(
+            starts.reshape(self.n, per), w, self.padded_len,
+            pp.TILE_POSITIONS)
+        return starts, codes, plan
+
     # -- streaming input --------------------------------------------------
     def add(self, batch: SegmentBatch) -> None:
         from ..ops.pileup import run_tuned_slab
 
+        kernel_name = (self._tuner.kernel if self._tuner is not None
+                       else self.pileup)
         for w, (starts, codes) in sorted(batch.buckets.items()):
             def plan_mxu():
                 return self._plan_mxu(np.asarray(starts), np.asarray(codes))
+
+            def plan_pallas():
+                return self._plan_pallas(np.asarray(starts),
+                                         np.asarray(codes))
+
+            def exec_pallas(planned):
+                p_starts, p_codes, plan = planned
+                fn = self._pallas_accumulate(w, plan)
+                p_packed = pack_nibbles(p_codes)
+                self.bytes_h2d += (p_starts.nbytes + p_packed.nbytes
+                                   + plan.rank.nbytes + plan.blk_lo.nbytes
+                                   + plan.blk_n.nbytes)
+                self._counts = fn(
+                    self.counts,
+                    jax.device_put(p_starts.astype(np.int32),
+                                   self._row_spec),
+                    jax.device_put(p_packed, self._mat_spec),
+                    jax.device_put(plan.rank.reshape(-1), self._row_spec),
+                    jax.device_put(plan.blk_lo, self._mat_spec),
+                    jax.device_put(plan.blk_n, self._mat_spec))
 
             def exec_mxu(plan):
                 p_starts, p_codes, slots, e = plan
@@ -200,8 +283,10 @@ class ShardedConsensus(ShardedCountsBase):
             # one-element fetch, not block_until_ready: the latter returns
             # early over the tunneled runtime (tools/tunnel_probe.py)
             key = run_tuned_slab(
-                self._tuner, self.pileup, len(starts), w, plan_mxu,
-                exec_mxu, exec_scatter,
+                self._tuner, self.pileup, len(starts), w,
+                plan_pallas if kernel_name == "pallas" else plan_mxu,
+                exec_pallas if kernel_name == "pallas" else exec_mxu,
+                exec_scatter,
                 lambda: np.asarray(self._counts[0, 0]))
             if self._tuner is not None and self._tuner.stats is not None:
                 self.strategy_used["autotune"] = self._tuner.stats

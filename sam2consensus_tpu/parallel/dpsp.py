@@ -49,8 +49,8 @@ from ..constants import NUM_SYMBOLS
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
                           pack_nibbles, round_rows_grid, unpack_nibbles)
-from .base import (ALL, ShardedCountsBase, route_to_slots, shard_map,
-                   split_wide_rows)
+from .base import (ALL, ShardedCountsBase, plan_mxu_grids, real_row_mask,
+                   route_to_slots, shard_map, split_wide_rows)
 
 __all__ = ["ProductShardedConsensus"]
 
@@ -58,7 +58,8 @@ __all__ = ["ProductShardedConsensus"]
 class ProductShardedConsensus(ShardedCountsBase):
     """Streaming dp x sp accumulate + vote over the 2-D mesh."""
 
-    def __init__(self, mesh, total_len: int, halo: int = 1 << 16):
+    def __init__(self, mesh, total_len: int, halo: int = 1 << 16,
+                 pileup: str = "scatter"):
         super().__init__(mesh, total_len, pos_axes=("sp", "dp"))
         self.n_dp = mesh.shape["dp"]
         self.n_sp = mesh.shape["sp"]
@@ -73,9 +74,14 @@ class ProductShardedConsensus(ShardedCountsBase):
             raise ValueError(
                 f"macro position block {self.block_sp} smaller than halo "
                 f"{halo}: use the DP pipeline for genomes this small")
+        #: per-device accumulation kernel for the routed slot grids,
+        #: same contract as PositionShardedConsensus.pileup (verdict
+        #: r4 #4): scatter (default) / pallas / mxu
+        self.pileup = pileup if pileup in ("mxu", "pallas") else "scatter"
         self.strategy_used: dict = {}
         self.rows_shipped = 0
         self.rows_real = 0
+        self._kernel_cache: dict = {}
 
         block_sp, n_sp = self.block_sp, self.n_sp
 
@@ -107,6 +113,136 @@ class ProductShardedConsensus(ShardedCountsBase):
 
         self._accumulate = jax.jit(accumulate, donate_argnums=0)
 
+    # -- routed-slab device kernels (pallas / mxu; verdict r4 #4) ---------
+    def _kernel_body(self):
+        """The dpsp collectives applied to a per-device local-counts
+        tensor: halo ppermute over sp, then psum_scatter over dp
+        (identical to the scatter accumulate's tail, so the result is
+        exact)."""
+        block_sp, halo, n_sp = self.block_sp, self.halo, self.n_sp
+
+        def tail(counts_blk, local):
+            shifted = jax.lax.ppermute(
+                local[block_sp:block_sp + halo], "sp",
+                perm=[(i, i + 1) for i in range(n_sp - 1)])
+            acc = local[:block_sp].at[:halo].add(shifted)
+            return counts_blk + jax.lax.psum_scatter(
+                acc, "dp", scatter_dimension=0, tiled=True)
+
+        return tail
+
+    def _pallas_fn(self, w: int, plan):
+        from ..ops import pallas_pileup as pp
+
+        key = ("pallas", w, plan.row_block, plan.max_blocks,
+               plan.n_rows_padded, plan.n_tiles)
+        if key in self._kernel_cache:
+            return self._kernel_cache[key]
+        local_len = self.block_sp + self.halo + 1
+        interp = jax.default_backend() != "tpu"
+        tail = self._kernel_body()
+
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(P(self.pos_axes, None), P(ALL), P(ALL, None),
+                           P(ALL), P(ALL, None), P(ALL, None)),
+                 out_specs=P(self.pos_axes, None), check_vma=False)
+        def accumulate(counts_blk, s_local, packed, rank, blk_lo, blk_n):
+            local = pp.local_tile_counts(
+                s_local, packed, rank, blk_lo[0], blk_n[0],
+                tile=pp.TILE_POSITIONS, n_tiles=plan.n_tiles, width=w,
+                row_block=plan.row_block, max_blocks=plan.max_blocks,
+                n_rows_padded=plan.n_rows_padded, out_len=local_len,
+                interpret=interp)
+            return tail(counts_blk, local)
+
+        fn = jax.jit(accumulate, donate_argnums=0)
+        self._kernel_cache[key] = fn
+        return fn
+
+    def _mxu_fn(self, w: int, e1: int, n_tiles_l: int):
+        from ..ops import mxu_pileup
+
+        key = ("mxu", w, e1, n_tiles_l)
+        if key in self._kernel_cache:
+            return self._kernel_cache[key]
+        local_len = self.block_sp + self.halo + 1
+        tile = mxu_pileup.TILE_POSITIONS
+        tiles_len = n_tiles_l * tile
+        tail = self._kernel_body()
+
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(P(self.pos_axes, None), P(ALL), P(ALL, None),
+                           P(ALL)),
+                 out_specs=P(self.pos_axes, None))
+        def accumulate(counts_blk, s_local, packed, slot):
+            loc, cod = mxu_pileup.build_padded_layout(
+                s_local, unpack_nibbles(packed), slot, tile=tile,
+                n_tiles=n_tiles_l, rows_per_tile=e1, width=w)
+            local = mxu_pileup._accumulate_tiles(
+                jnp.zeros((tiles_len, NUM_SYMBOLS), dtype=jnp.int32),
+                loc, cod, tile=tile, n_tiles=n_tiles_l,
+                rows_per_tile=e1, width=w)[:local_len]
+            return tail(counts_blk, local)
+
+        fn = jax.jit(accumulate, donate_argnums=0)
+        self._kernel_cache[key] = fn
+        return fn
+
+    def _routed_kernel_add(self, s_grid: np.ndarray, c_grid: np.ndarray,
+                           counts_dm: np.ndarray, w: int) -> bool:
+        """Accumulate routed ``[n_dp, n_sp, R]`` grids via the
+        configured kernel; False falls the slab back to scatter."""
+        if self.pileup == "scatter" or w % 2:
+            return False
+        from ..ops import pallas_pileup as pp
+
+        local_len = self.block_sp + self.halo + 1
+        if self.pileup == "pallas" and pp._cw(w) * 2 > pp.TILE_POSITIONS:
+            return False
+        pins = (np.arange(self.n_sp, dtype=np.int64)
+                * self.block_sp)[None, :, None]
+        s_local = (s_grid - pins).astype(np.int32)
+        r = s_grid.shape[2]
+        d_units = self.n_dp * self.n_sp
+        # two phases: plan EVERY slice before executing any, so an MXU
+        # skew fallback on a later slice cannot double-count the slab
+        # (see PositionShardedConsensus._routed_kernel_add)
+        staged = []
+        for lo, hi in iter_row_slices(r, w):
+            sl = np.ascontiguousarray(
+                s_local[:, :, lo:hi]).reshape(d_units, hi - lo)
+            reals = np.clip(counts_dm.reshape(-1) - lo, 0, hi - lo)
+            if self.pileup == "pallas":
+                plan = pp.plan_rows_stacked(sl, w, local_len,
+                                            pp.TILE_POSITIONS)
+                fn = self._pallas_fn(w, plan)
+                extra = (plan.rank.reshape(-1), plan.blk_lo, plan.blk_n)
+            else:
+                planned = plan_mxu_grids(sl, reals, w, local_len)
+                if planned is None:
+                    return False
+                slots, e1, nt = planned
+                fn = self._mxu_fn(w, e1, nt)
+                extra = (slots.reshape(-1),)
+            staged.append((lo, hi, sl, fn, extra))
+        for lo, hi, sl, fn, extra in staged:
+            extra_dev = tuple(
+                jax.device_put(a, self._row_spec if a.ndim == 1
+                               else self._mat_spec) for a in extra)
+            self.bytes_h2d += sum(a.nbytes for a in extra)
+            p_slab = pack_nibbles(np.ascontiguousarray(
+                c_grid[:, :, lo:hi]).reshape(-1, w))
+            s_slab = sl.reshape(-1)
+            self.bytes_h2d += s_slab.nbytes + p_slab.nbytes
+            self._counts = fn(
+                self.counts,
+                jax.device_put(s_slab, self._row_spec),
+                jax.device_put(p_slab, self._mat_spec), *extra_dev)
+            self.rows_shipped += self.n * (hi - lo)
+        key = f"dpsp_{self.pileup}_w{w}"
+        self.strategy_used[key] = self.strategy_used.get(key, 0) + 1
+        return True
+
     # -- streaming input --------------------------------------------------
     def add(self, batch: SegmentBatch) -> None:
         for w, (starts, codes) in sorted(batch.buckets.items()):
@@ -117,6 +253,14 @@ class ProductShardedConsensus(ShardedCountsBase):
                     starts, codes, w, self.halo, self.padded_len)
 
             self.rows_real += len(starts)
+            if self.pileup != "scatter":
+                # drop encoder pad rows: they count nothing and would
+                # only inflate device (0, 0)'s tile-0 kernel plans
+                keep = real_row_mask(starts, codes)
+                if not keep.all():
+                    starts, codes = starts[keep], codes[keep]
+                if len(starts) == 0:
+                    continue
             # dp split: contiguous even chunks (order irrelevant — the
             # count tensor is sum-decomposable); within each chunk, route
             # rows to their macro block via one counting sort over n_sp
@@ -146,6 +290,8 @@ class ProductShardedConsensus(ShardedCountsBase):
                     macro[lo:hi], self.n_sp, r, starts[lo:hi],
                     codes[lo:hi], pins)
 
+            if self._routed_kernel_add(s_routed, c_routed, counts_dm, w):
+                continue
             for lo_r, hi_r in iter_row_slices(r, w):
                 s_slab = np.ascontiguousarray(
                     s_routed[:, :, lo_r:hi_r]).reshape(-1)

@@ -57,8 +57,9 @@ from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
                           pack_nibbles, round_rows_grid, unpack_nibbles)
-from .base import (ALL, ShardedCountsBase, block_for, route_to_slots,
-                   shard_map, split_wide_rows)
+from .base import (ALL, ShardedCountsBase, block_for, plan_mxu_grids,
+                   real_row_mask, route_to_slots, shard_map,
+                   split_wide_rows)
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["PositionShardedConsensus", "block_for"]
@@ -75,17 +76,26 @@ class PositionShardedConsensus(ShardedCountsBase):
     #: device ([Wp, 6] int32 local + one psum of the same size over ICI)
     WINDOW_CAP = 1 << 21
 
-    def __init__(self, mesh, total_len: int, halo: int = 1 << 16):
+    def __init__(self, mesh, total_len: int, halo: int = 1 << 16,
+                 pileup: str = "scatter"):
         super().__init__(mesh, total_len)
         self.halo = halo
         if self.block < halo:
             raise ValueError(
                 f"position block {self.block} smaller than halo {halo}: "
                 "use the DP pipeline for genomes this small")
+        #: per-device accumulation kernel for ROUTED slabs: the XLA
+        #: scatter (default), the Pallas tile-CSR histogram, or the MXU
+        #: one-hot matmul — the router's counting sort already delivers
+        #: rows in exactly the per-device layout the kernel planners
+        #: consume (round-4 verdict #4); window-strategy slabs (narrow
+        #:  span) keep the scatter, whose window tensor is small
+        self.pileup = pileup if pileup in ("mxu", "pallas") else "scatter"
         self.strategy_used: dict = {}
         self.rows_shipped = 0
         self.rows_real = 0
         self._window_cache: dict = {}
+        self._kernel_cache: dict = {}
 
         block = self.block
         n = self.n
@@ -142,6 +152,133 @@ class PositionShardedConsensus(ShardedCountsBase):
                                              donate_argnums=0)
         return self._window_cache[wp]
 
+    # -- routed-slab device kernels (pallas / mxu; verdict r4 #4) ---------
+    def _pallas_fn(self, w: int, plan):
+        """Cached shard_map'd Pallas accumulate for one static shape:
+        per-device tile-CSR histogram over the local [block+halo+1]
+        coordinate space, then the same halo exchange as the scatter
+        path (addition commutes, so the result is exact)."""
+        from ..ops import pallas_pileup as pp
+
+        key = ("pallas", w, plan.row_block, plan.max_blocks,
+               plan.n_rows_padded, plan.n_tiles)
+        if key in self._kernel_cache:
+            return self._kernel_cache[key]
+        block, halo, n = self.block, self.halo, self.n
+        local_len = block + halo + 1
+        interp = jax.default_backend() != "tpu"
+
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(P(ALL, None), P(ALL), P(ALL, None), P(ALL),
+                           P(ALL, None), P(ALL, None)),
+                 out_specs=P(ALL, None), check_vma=False)
+        def accumulate(counts_blk, s_local, packed, rank, blk_lo, blk_n):
+            local = pp.local_tile_counts(
+                s_local, packed, rank, blk_lo[0], blk_n[0],
+                tile=pp.TILE_POSITIONS, n_tiles=plan.n_tiles, width=w,
+                row_block=plan.row_block, max_blocks=plan.max_blocks,
+                n_rows_padded=plan.n_rows_padded, out_len=local_len,
+                interpret=interp)
+            shifted = jax.lax.ppermute(
+                local[block:block + halo], ALL,
+                perm=[(i, i + 1) for i in range(n - 1)])
+            out = counts_blk + local[:block]
+            return out.at[:halo].add(shifted)
+
+        fn = jax.jit(accumulate, donate_argnums=0)
+        self._kernel_cache[key] = fn
+        return fn
+
+    def _mxu_fn(self, w: int, e1: int, n_tiles_l: int):
+        """Cached shard_map'd MXU accumulate (one-hot matmul tiles over
+        the local coordinate space + halo exchange)."""
+        from ..ops import mxu_pileup
+
+        key = ("mxu", w, e1, n_tiles_l)
+        if key in self._kernel_cache:
+            return self._kernel_cache[key]
+        block, halo, n = self.block, self.halo, self.n
+        local_len = block + halo + 1
+        tile = mxu_pileup.TILE_POSITIONS
+        tiles_len = n_tiles_l * tile
+
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(P(ALL, None), P(ALL), P(ALL, None), P(ALL)),
+                 out_specs=P(ALL, None))
+        def accumulate(counts_blk, s_local, packed, slot):
+            loc, cod = mxu_pileup.build_padded_layout(
+                s_local, unpack_nibbles(packed), slot, tile=tile,
+                n_tiles=n_tiles_l, rows_per_tile=e1, width=w)
+            local = mxu_pileup._accumulate_tiles(
+                jnp.zeros((tiles_len, NUM_SYMBOLS), dtype=jnp.int32),
+                loc, cod, tile=tile, n_tiles=n_tiles_l,
+                rows_per_tile=e1, width=w)[:local_len]
+            shifted = jax.lax.ppermute(
+                local[block:block + halo], ALL,
+                perm=[(i, i + 1) for i in range(n - 1)])
+            out = counts_blk + local[:block]
+            return out.at[:halo].add(shifted)
+
+        fn = jax.jit(accumulate, donate_argnums=0)
+        self._kernel_cache[key] = fn
+        return fn
+
+    def _routed_kernel_add(self, s_grid: np.ndarray, c_grid: np.ndarray,
+                           per_dev: np.ndarray, w: int) -> bool:
+        """Accumulate a routed slot grid via the configured device
+        kernel; False falls the slab back to the scatter route (odd
+        halo-split widths — the nibble wire widens them — or MXU
+        padding blowup)."""
+        if self.pileup == "scatter" or w % 2:
+            return False
+        from ..ops import pallas_pileup as pp
+
+        local_len = self.block + self.halo + 1
+        if self.pileup == "pallas" and pp._cw(w) * 2 > pp.TILE_POSITIONS:
+            return False
+        s_local = (s_grid
+                   - (np.arange(self.n) * self.block)[:, None]).astype(
+                       np.int32)
+        r = s_grid.shape[1]
+        # two phases: plan EVERY slice before executing any, so an MXU
+        # skew fallback on a later slice cannot leave earlier slices'
+        # counts committed and then re-count the whole slab via scatter
+        # (double-count; round-5 review finding)
+        staged = []
+        for lo, hi in iter_row_slices(r, w):
+            sl = np.ascontiguousarray(s_local[:, lo:hi])
+            reals = np.clip(per_dev - lo, 0, hi - lo)
+            if self.pileup == "pallas":
+                plan = pp.plan_rows_stacked(sl, w, local_len,
+                                            pp.TILE_POSITIONS)
+                fn = self._pallas_fn(w, plan)
+                extra = (plan.rank.reshape(-1), plan.blk_lo, plan.blk_n)
+            else:
+                planned = plan_mxu_grids(sl, reals, w, local_len)
+                if planned is None:
+                    return False       # skew: whole slab rides scatter
+                slots, e1, nt = planned
+                fn = self._mxu_fn(w, e1, nt)
+                extra = (slots.reshape(-1),)
+            staged.append((lo, hi, sl, fn, extra))
+        for lo, hi, sl, fn, extra in staged:
+            extra_dev = tuple(
+                jax.device_put(a, self._row_spec if a.ndim == 1
+                               else self._mat_spec) for a in extra)
+            self.bytes_h2d += sum(a.nbytes for a in extra)
+            p_slab = pack_nibbles(
+                np.ascontiguousarray(c_grid[:, lo:hi]).reshape(-1, w))
+            s_slab = sl.reshape(-1)
+            self.bytes_h2d += s_slab.nbytes + p_slab.nbytes
+            self._counts = fn(
+                self.counts,
+                jax.device_put(s_slab, self._row_spec),
+                jax.device_put(p_slab, self._mat_spec), *extra_dev)
+            self.rows_shipped += self.n * (hi - lo)
+        key = f"routed_{self.pileup}_w{w}"
+        self.strategy_used[key] = self.strategy_used.get(key, 0) + 1
+        return True
+
     # -- streaming input --------------------------------------------------
     def add(self, batch: SegmentBatch) -> None:
         for w, (starts, codes) in sorted(batch.buckets.items()):
@@ -156,16 +293,10 @@ class PositionShardedConsensus(ShardedCountsBase):
             # input) takes the window path — even row split, minimal
             # transfer, one O(window) psum — instead of routing, whose
             # dense slot grid would ship ~n x the real rows.
-            # Identifying encoder pad rows (all-PAD, start 0) needs no
-            # full-matrix scan: only zero-start rows can be padding, so
-            # only they are checked (a real row may still START with PAD
-            # cells — maxdel-skipped leading gaps — which is why the
-            # window math itself never relies on this mask; PAD cells
-            # self-redirect to the sacrificial slot regardless).
-            real = np.ones(len(starts), dtype=bool)
-            zero = np.nonzero(starts == 0)[0]
-            if len(zero):
-                real[zero[(codes[zero] == PAD_CODE).all(axis=1)]] = False
+            # Encoder pad rows (parallel.base.real_row_mask): the window
+            # math never relies on this mask for correctness — PAD cells
+            # self-redirect to the sacrificial slot regardless.
+            real = real_row_mask(starts, codes)
             if real.any():
                 wlo = int(starts[real].min())
                 span = int(starts[real].max()) + w - wlo
@@ -205,15 +336,21 @@ class PositionShardedConsensus(ShardedCountsBase):
                 self.strategy_used[key] = self.strategy_used.get(key, 0) + 1
                 continue
 
-            # route rows to the device owning their start position; PAD
-            # rows (all-PAD codes, start 0) follow start 0 to device 0
-            # where expand() redirects their cells to the sacrificial slot
+            # route rows to the device owning their start position.
+            # Encoder pad rows (all-PAD codes, start 0) are dropped
+            # first: they count nothing anywhere, and routed to device
+            # 0 they would only pile into its tile-0 kernel plans
+            # (inflating the MXU E) — grid rounding keeps the jit cache
+            # bounded without them
+            starts, codes = starts[real], codes[real]
             dev = starts // self.block
             per_dev = np.bincount(dev, minlength=self.n)
             r = round_rows_grid(int(per_dev.max(initial=1)))
             s_routed, c_routed = route_to_slots(
                 dev, self.n, r, starts, codes,
                 np.arange(self.n) * self.block)
+            if self._routed_kernel_add(s_routed, c_routed, per_dev, w):
+                continue
 
             # cap expanded cells per device call (same budget discipline
             # as the unsharded and dp paths, ops.pileup.iter_row_slices)

@@ -48,6 +48,10 @@ class CheckpointState:
     #: the consumed lines.  -1 = unknown (non-seekable stream): resume
     #: falls back to the line-skipping loop.
     byte_offset: int = -1
+    #: widest segment-row bucket the encoder emitted so far (0 =
+    #: unknown/old checkpoint); a resumed sharded run sizes its sp/dpsp
+    #: halo from this instead of re-observing (round-4 verdict #5)
+    max_row_width: int = 0
 
 
 def path_for(checkpoint_dir: str) -> str:
@@ -65,7 +69,7 @@ def save(checkpoint_dir: str, state: CheckpointState) -> None:
                 counts=state.counts.astype(np.int32),
                 meta=np.array([state.lines_consumed, state.reads_mapped,
                                state.reads_skipped, state.aligned_bases,
-                               state.byte_offset],
+                               state.byte_offset, state.max_row_width],
                               dtype=np.int64),
                 ins_contig=ic.astype(np.int32),
                 ins_local=il.astype(np.int32),
@@ -109,4 +113,5 @@ def load(checkpoint_dir: str, total_len: int) -> Optional[CheckpointState]:
             reads_mapped=int(meta[1]), reads_skipped=int(meta[2]),
             aligned_bases=int(meta[3]), insertions=ins, source=source,
             sources=sources,
-            byte_offset=int(meta[4]) if len(meta) > 4 else -1)
+            byte_offset=int(meta[4]) if len(meta) > 4 else -1,
+            max_row_width=int(meta[5]) if len(meta) > 5 else 0)

@@ -305,18 +305,21 @@ def test_host_gate_link_aware(monkeypatch):
 
 def test_insertion_kernel_auto_window(monkeypatch):
     """--insertion-kernel auto: pallas only for chip-resident tails in
-    the TPU-measured winning event-count window (round-4 sweep:
-    0.91x/1.26x/1.09x/0.97x vs scatter at 2e4/2e5/2e6/8e6 events)."""
+    the TPU-measured winning event-count window (round-5 fused-vote
+    sweep: 0.94x/0.75-0.97x/1.36x/2.28x vs the scatter tail at
+    2e4/2e5/2e6/8e6 events — the sub-1e6 regime is round-trip
+    dominated)."""
     from sam2consensus_tpu.backends import jax_backend as jb
 
     monkeypatch.delenv("S2C_PALLAS_INS_MIN_EVENTS", raising=False)
     monkeypatch.delenv("S2C_PALLAS_INS_MAX_EVENTS", raising=False)
     # inside the window, chip tail: pallas
-    assert jb._pallas_ins_auto(200_000, True)
     assert jb._pallas_ins_auto(2_000_000, True)
+    assert jb._pallas_ins_auto(8_000_000, True)
     # outside the window: scatter
     assert not jb._pallas_ins_auto(20_000, True)
-    assert not jb._pallas_ins_auto(8_000_000, True)
+    assert not jb._pallas_ins_auto(200_000, True)
+    assert not jb._pallas_ins_auto(32_000_000, True)
     # host-routed / interpret-mode tail: never pallas
     assert not jb._pallas_ins_auto(200_000, False)
     # default config routes through auto (a RunConfig regression pin)

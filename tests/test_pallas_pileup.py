@@ -1,0 +1,269 @@
+"""The Pallas tile-CSR pileup kernel vs the scatter oracle.
+
+``ops.pallas_pileup`` replaces the retired MXU one-hot-matmul pileup as
+the device-resident kernel (PERF.md round 5): rows counting-sorted by
+position tile, per-row VMEM histogram accumulation, overhang carried
+between tiles in scratch.  These tests pin, in interpret mode on CPU
+(SURVEY.md §4), that every layer — the raw kernel, the single-device
+strategy, and the sp/dpsp/dp sharded compositions (round-4 verdict #4)
+— is cell-exact against the XLA scatter path.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from sam2consensus_tpu.encoder.events import SegmentBatch  # noqa: E402
+from sam2consensus_tpu.ops import pallas_pileup as pp  # noqa: E402
+from sam2consensus_tpu.ops.pileup import PileupAccumulator  # noqa: E402
+
+
+def _batch(starts, codes):
+    return SegmentBatch(buckets={codes.shape[1]: (starts, codes)},
+                        n_reads=len(starts),
+                        n_events=int((codes < 6).sum()))
+
+
+def _ref_counts(total_len, starts, codes):
+    acc = PileupAccumulator(total_len, strategy="scatter")
+    acc.add(_batch(starts, codes))
+    return acc.counts_host()
+
+
+def _numpy_pileup(total_len, starts, codes):
+    counts = np.zeros((total_len, 6), np.int64)
+    for s, row in zip(starts, codes):
+        for j, c in enumerate(row):
+            if c < 6:
+                counts[s + j, c] += 1
+    return counts
+
+
+@pytest.mark.parametrize("w,tile", [(32, 2048), (128, 2048), (128, 8192),
+                                    (256, 4096)])
+def test_kernel_vs_numpy(w, tile):
+    rng = np.random.default_rng(hash((w, tile)) % 2**31)
+    total_len = 3 * tile + 77            # non-tile-multiple genome
+    n = 500
+    starts = rng.integers(0, total_len - w, n)
+    codes = rng.integers(0, 6, (n, w)).astype(np.uint8)
+    codes[rng.random((n, w)) < 0.15] = 255       # PAD cells
+    codes[:4] = 255                               # full PAD rows
+    starts[:4] = 0
+    got = pp.pileup_pallas_host(total_len, starts, codes, tile=tile,
+                                interpret=True)
+    assert np.array_equal(got, _numpy_pileup(total_len, starts, codes))
+
+
+def test_kernel_tile_boundaries_and_carry():
+    """Rows overhanging every tile boundary exercise the scratch carry."""
+    tile, w = 2048, 64
+    total_len = 5 * tile
+    starts = []
+    for t in range(4):
+        starts += [(t + 1) * tile - 1,            # maximal overhang
+                   (t + 1) * tile - w // 2,       # partial overhang
+                   (t + 1) * tile - w,            # flush with boundary
+                   (t + 1) * tile]                # next tile's start
+    starts.append(total_len - w)                  # genome end
+    starts = np.asarray(starts, dtype=np.int64)
+    codes = np.tile(np.arange(w) % 6, (len(starts), 1)).astype(np.uint8)
+    got = pp.pileup_pallas_host(total_len, starts, codes, tile=tile,
+                                interpret=True)
+    assert np.array_equal(got, _numpy_pileup(total_len, starts, codes))
+
+
+def test_kernel_duplicate_positions():
+    """Heavy duplicate accumulation (the scatter path's weak spot)."""
+    tile, w = 2048, 32
+    total_len = tile
+    starts = np.full(300, 100, dtype=np.int64)
+    codes = np.tile(np.arange(w) % 6, (300, 1)).astype(np.uint8)
+    got = pp.pileup_pallas_host(total_len, starts, codes, tile=tile,
+                                interpret=True)
+    want = _numpy_pileup(total_len, starts, codes)
+    assert got[100 + 5, 5] == want[100 + 5, 5] > 0
+    assert np.array_equal(got, want)
+
+
+def test_accumulator_strategy_pallas():
+    """PileupAccumulator(strategy='pallas') is cell-exact vs scatter and
+    records its strategy; streaming slabs accumulate."""
+    rng = np.random.default_rng(11)
+    total_len, w = 10_000, 64
+    acc = PileupAccumulator(total_len, strategy="pallas")
+    all_s, all_c = [], []
+    for _ in range(2):
+        starts = rng.integers(0, total_len - w, 300).astype(np.int32)
+        codes = rng.integers(0, 6, (300, w)).astype(np.uint8)
+        codes[rng.random(codes.shape) < 0.2] = 255
+        acc.add(_batch(starts, codes))
+        all_s.append(starts)
+        all_c.append(codes)
+    ref = _ref_counts(total_len, np.concatenate(all_s),
+                      np.concatenate(all_c))
+    assert np.array_equal(acc.counts_host(), ref)
+    assert any(k.startswith("pallas_w") for k in acc.strategy_used)
+
+
+def test_plan_rows_csr_ranges():
+    """CSR invariants: rank is a permutation; block ranges cover every
+    row's tile; empty tiles get zero blocks."""
+    starts = np.array([0, 5000, 5001, 2047, 2048, 9999], dtype=np.int64)
+    plan = pp.plan_rows(starts, 32, 10240, tile=2048)
+    assert sorted(plan.rank.tolist()) == list(range(len(starts)))
+    assert plan.n_tiles == 5
+    # tile 1 ([2048, 4096)) holds exactly one row; tile 3 none
+    assert plan.blk_n[3] == 0
+    assert plan.blk_n[1] >= 1
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 (virtual) devices")
+@pytest.mark.parametrize("pileup", ["pallas", "mxu"])
+def test_sp_routed_kernel(pileup):
+    """sp routing composes with both device kernels (verdict r4 #4)."""
+    from sam2consensus_tpu.parallel.mesh import make_mesh
+    from sam2consensus_tpu.parallel.sp import PositionShardedConsensus
+
+    rng = np.random.default_rng(3)
+    total_len, w = 9000, 64
+    starts = rng.integers(0, total_len - w, 700).astype(np.int32)
+    codes = rng.integers(0, 6, (700, w)).astype(np.uint8)
+    codes[rng.random(codes.shape) < 0.2] = 255
+    sp = PositionShardedConsensus(make_mesh(8), total_len, halo=128,
+                                  pileup=pileup)
+    sp.add(_batch(starts, codes))
+    assert np.array_equal(sp.counts_host(),
+                          _ref_counts(total_len, starts, codes))
+    assert any(k.startswith(f"routed_{pileup}") for k in sp.strategy_used)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 (virtual) devices")
+@pytest.mark.parametrize("pileup", ["pallas", "mxu"])
+def test_sp_routed_kernel_boundary_rows(pileup):
+    """Block-edge rows through the kernel + halo-exchange path."""
+    from sam2consensus_tpu.parallel.mesh import make_mesh
+    from sam2consensus_tpu.parallel.sp import PositionShardedConsensus
+
+    total_len, w = 8 * 1024 - 1, 32
+    sp = PositionShardedConsensus(make_mesh(8), total_len, halo=64,
+                                  pileup=pileup)
+    block = sp.block
+    edge = []
+    for d in range(7):
+        edge += [d * block + block - 1, d * block + block - w // 2,
+                 d * block]
+    edge.append(total_len - w)
+    starts = np.asarray(edge, dtype=np.int32)
+    codes = np.tile(np.arange(w) % 6, (len(starts), 1)).astype(np.uint8)
+    sp.add(_batch(starts, codes))
+    assert np.array_equal(sp.counts_host(),
+                          _ref_counts(total_len, starts, codes))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 (virtual) devices")
+@pytest.mark.parametrize("pileup", ["pallas", "mxu"])
+def test_dpsp_routed_kernel(pileup):
+    """dpsp routing composes with both device kernels (verdict r4 #4)."""
+    from sam2consensus_tpu.parallel.dpsp import ProductShardedConsensus
+    from sam2consensus_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(4)
+    total_len, w = 9000, 64
+    starts = rng.integers(0, total_len - w, 700).astype(np.int32)
+    codes = rng.integers(0, 6, (700, w)).astype(np.uint8)
+    codes[rng.random(codes.shape) < 0.2] = 255
+    acc = ProductShardedConsensus(make_mesh(8), total_len, halo=128,
+                                  pileup=pileup)
+    acc.add(_batch(starts, codes))
+    assert np.array_equal(acc.counts_host(),
+                          _ref_counts(total_len, starts, codes))
+    assert any(k.startswith(f"dpsp_{pileup}") for k in acc.strategy_used)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 (virtual) devices")
+def test_dp_explicit_pallas():
+    """dp's even-chunk layout drives the kernel over the full axis."""
+    from sam2consensus_tpu.parallel.dp import ShardedConsensus
+    from sam2consensus_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(5)
+    total_len, w = 9000, 64
+    starts = rng.integers(0, total_len - w, 600).astype(np.int32)
+    codes = rng.integers(0, 6, (600, w)).astype(np.uint8)
+    acc = ShardedConsensus(make_mesh(8), total_len, pileup="pallas")
+    acc.add(_batch(starts, codes))
+    assert np.array_equal(acc.counts_host(),
+                          _ref_counts(total_len, starts, codes))
+    assert any(k.startswith("pallas_w") for k in acc.strategy_used)
+
+
+def test_backend_end_to_end_pallas():
+    """CLI-level byte identity: --pileup pallas vs the CPU oracle."""
+    from sam2consensus_tpu.backends.cpu import CpuBackend
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.io.sam import ReadStream, opener, read_header
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+    import io
+
+    text = simulate(SimSpec(n_contigs=3, contig_len=400, n_reads=500,
+                            read_len=60, ins_read_rate=0.1,
+                            del_read_rate=0.1, seed=21))
+
+    def run(backend, cfg):
+        handle = io.StringIO(text) if cfg.backend == "cpu" \
+            else io.BytesIO(text.encode())
+        contigs, _n, first = read_header(handle)
+        return backend.run(contigs, ReadStream(handle, first), cfg)
+
+    cpu = run(CpuBackend(), RunConfig(prefix="t", backend="cpu"))
+    jx = run(JaxBackend(), RunConfig(prefix="t", backend="jax",
+                                     pileup="pallas", shards=1))
+    assert jx.fastas == cpu.fastas
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 (virtual) devices")
+def test_sp_mxu_skew_fallback_multi_slice_no_double_count(monkeypatch):
+    """An MXU skew fallback on a LATER row slice must not leave earlier
+    slices' counts committed and then rerun the whole slab via scatter
+    (round-5 review finding: plan-all-before-execute)."""
+    from sam2consensus_tpu.ops import pileup as pileup_mod
+    from sam2consensus_tpu.parallel.mesh import make_mesh
+    from sam2consensus_tpu.parallel.sp import PositionShardedConsensus
+
+    # shrink the slice budget so the routed grid spans multiple slices
+    monkeypatch.setattr(pileup_mod, "SCATTER_CELL_BUDGET", 64 * 64)
+    import sam2consensus_tpu.parallel.sp as sp_mod
+    import sam2consensus_tpu.parallel.dpsp as dpsp_mod
+    assert sp_mod.iter_row_slices is pileup_mod.iter_row_slices
+    assert dpsp_mod.iter_row_slices is pileup_mod.iter_row_slices
+
+    total_len, w = 60_000, 64
+    rng = np.random.default_rng(6)
+    sp = PositionShardedConsensus(make_mesh(8), total_len, halo=128,
+                                  pileup="mxu")
+    block = sp.block
+    # device 0 gets 128 rows all at ONE position; devices 1-7 get 64
+    # scattered rows each.  Slice 1 (64 rows/device) passes the blowup
+    # gate (512 real rows spread out); slice 2 holds ONLY device 0's
+    # remaining 64 concentrated rows -> 8 devices x 4 tiles x E=65
+    # slots / 64 real rows > 16 -> the gate trips on the LATER slice
+    starts = [np.full(128, 5, dtype=np.int32)]
+    for d in range(1, 8):
+        starts.append(rng.integers(d * block, (d + 1) * block - w,
+                                   64).astype(np.int32))
+    starts = np.concatenate(starts)
+    codes = np.tile(np.arange(w) % 6, (len(starts), 1)).astype(np.uint8)
+    sp.add(_batch(starts, codes))
+    # skew fell back: the whole slab must ride scatter EXACTLY once
+    assert any(k.startswith("routed_w") for k in sp.strategy_used), \
+        sp.strategy_used
+    assert np.array_equal(sp.counts_host(),
+                          _ref_counts(total_len, starts, codes))

@@ -213,12 +213,35 @@ def test_sharded_backend_with_fast_kernels_byte_identical(kernels):
         assert stats.extra.get("insertion_kernel") == "pallas"
 
 
-def test_sp_mode_rejects_mxu():
-    text = simulate(SimSpec(n_contigs=1, contig_len=100, n_reads=50,
-                            read_len=30, seed=43))
-    handle = io.StringIO(text)
-    contigs, _n, first = read_header(handle)
-    cfg = RunConfig(prefix="p", backend="jax", shards=8, shard_mode="sp",
-                    pileup="mxu")
-    with pytest.raises(RuntimeError, match="dp shard layout"):
-        JaxBackend().run(contigs, iter_records(handle, first), cfg)
+@pytest.mark.parametrize("mode,pileup", [
+    ("sp", "mxu"), ("sp", "pallas"),
+    ("dpsp", "mxu"), ("dpsp", "pallas"),
+])
+def test_sp_modes_compose_with_device_kernels(mode, pileup):
+    """--pileup mxu|pallas with --shard-mode sp|dpsp is byte-identical
+    (round-4 verdict #4: the position routers feed the kernel planners
+    directly; the old RuntimeError is gone)."""
+    # sparse coverage: the slab's position span fails the window
+    # strategy's density gate, so the ROUTED path (the kernel one) runs
+    text = simulate(SimSpec(n_contigs=1, contig_len=40_000, n_reads=200,
+                            read_len=30, ins_read_rate=0.15,
+                            del_read_rate=0.1, seed=43))
+
+    def run(cfg):
+        handle = io.StringIO(text)
+        contigs, _n, first = read_header(handle)
+        res = (CpuBackend() if cfg.backend == "cpu" else JaxBackend()).run(
+            contigs, iter_records(handle, first), cfg)
+        return ({n: render_file(r, 0) for n, r in res.fastas.items()},
+                res.stats)
+
+    out_cpu, _st = run(RunConfig(prefix="p"))
+    out_jax, stats = run(RunConfig(prefix="p", backend="jax", shards=8,
+                                   shard_mode=mode, pileup=pileup))
+    assert out_jax == out_cpu
+    assert stats.extra["shard_mode"] == mode
+    # the sparse fixture must actually exercise the routed kernel (a
+    # window_ key here would mean the density gate swallowed the slab)
+    prefix = ("routed_" if mode == "sp" else "dpsp_") + pileup
+    assert any(k.startswith(prefix)
+               for k in stats.extra["pileup"]), stats.extra["pileup"]

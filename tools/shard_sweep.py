@@ -1,0 +1,183 @@
+#!/usr/bin/env python3
+"""Shard-mode sweep: steady-state dp/sp/dpsp throughput vs auto's pick.
+
+The evidence harness for the model-driven ``--shard-mode auto``
+(parallel/auto.py; round-4 verdict #3).  Each cell fixes a workload
+shape — (genome length x slab depth x position pattern) — builds
+identical segment-row slabs, and measures every feasible layout's
+STEADY-STATE per-slab accumulate time (one warm pass pays the jit
+compiles, then timed repeats), asserting cell-exact equality against
+the unsharded scatter oracle.  ``auto`` is the model's pick for the
+cell's first slab; the summary reports how often that pick lands
+within 10% of the measured best (the verdict's done criterion).
+
+Why accumulator-level and not whole-backend: a full CLI run on the
+8-virtual-device CPU mesh is dominated by per-run jit compilation of
+the shard_map graphs (seconds, paid once per process in production)
+and one-core oracle noise — it measures the harness, not the layouts.
+The per-slab accumulate is exactly the quantity the model prices.
+
+Run:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/shard_sweep.py > campaign/shard_sweep_r05.jsonl
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+from sam2consensus_tpu.utils.platform import pin_platform_from_env  # noqa
+pin_platform_from_env()
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from sam2consensus_tpu.encoder.events import SegmentBatch  # noqa: E402
+from sam2consensus_tpu.ops.pileup import PileupAccumulator  # noqa: E402
+from sam2consensus_tpu.parallel import auto as shard_auto  # noqa: E402
+from sam2consensus_tpu.parallel.dp import ShardedConsensus  # noqa: E402
+from sam2consensus_tpu.parallel.dpsp import ProductShardedConsensus  # noqa: E402
+from sam2consensus_tpu.parallel.mesh import make_mesh  # noqa: E402
+from sam2consensus_tpu.parallel.sp import PositionShardedConsensus  # noqa: E402
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def make_slabs(L, rows, w, pattern, n_slabs, seed):
+    """Identical-shape slabs; ``pattern``: uniform | sorted | clustered.
+
+    ``sorted`` mimics a coordinate-sorted stream: each slab covers the
+    next contiguous position window.  ``clustered`` concentrates ~90%
+    of rows in one 1/16th of the genome without a narrow window
+    (window-ineligible but imbalanced — the dpsp case).
+    """
+    rng = np.random.default_rng(seed)
+    slabs = []
+    for i in range(n_slabs):
+        if pattern == "sorted":
+            lo = L * i // n_slabs
+            hi = max(lo + w + 1, L * (i + 1) // n_slabs)
+            starts = np.sort(rng.integers(lo, max(lo + 1, hi - w), rows))
+        elif pattern == "clustered":
+            k = int(rows * 0.9)
+            c0 = (L // 16) * (i % 8)
+            a = rng.integers(c0, max(c0 + 1, c0 + L // 16 - w), k)
+            b = rng.integers(0, max(1, L - w), rows - k)
+            starts = np.concatenate([a, b])
+        else:
+            starts = rng.integers(0, max(1, L - w), rows)
+        codes = rng.integers(0, 6, (rows, w)).astype(np.uint8)
+        codes[rng.random(codes.shape) < 0.05] = 255
+        slabs.append((starts.astype(np.int32), codes))
+    return slabs
+
+
+def batch_of(starts, codes):
+    return SegmentBatch(buckets={codes.shape[1]: (starts, codes)},
+                        n_reads=len(starts),
+                        n_events=int((codes < 6).sum()))
+
+
+def build_acc(mode, mesh, L, halo):
+    if mode == "sp":
+        return PositionShardedConsensus(mesh, L, halo=halo)
+    if mode == "dpsp":
+        return ProductShardedConsensus(mesh, L, halo=halo)
+    return ShardedConsensus(mesh, L, pileup="scatter")
+
+
+def main():
+    reps = int(os.environ.get("SWEEP_REPS", "3"))
+    n_slabs = int(os.environ.get("SWEEP_SLABS", "2"))
+    w = 128
+    cells = [
+        # (name, L, rows_per_slab, pattern)
+        ("small_uniform", 100_000, 32_768, "uniform"),
+        ("small_sorted", 100_000, 32_768, "sorted"),
+        ("mid_uniform", 4_000_000, 32_768, "uniform"),
+        ("mid_sorted", 4_000_000, 32_768, "sorted"),
+        ("mid_clustered", 4_000_000, 32_768, "clustered"),
+        ("large_uniform", 32_000_000, 32_768, "uniform"),
+        ("large_sorted", 32_000_000, 32_768, "sorted"),
+        ("large_clustered", 32_000_000, 32_768, "clustered"),
+        ("large_shallow", 32_000_000, 4_096, "uniform"),
+    ]
+    from sam2consensus_tpu.backends.jax_backend import _link_constants
+    # on the virtual CPU mesh "device_put" is a memcpy, not a tunnel;
+    # the model must price the rig it actually runs on (override with
+    # S2C_TAIL_LINK_MBPS to sweep the tunnel-rig decision surface)
+    os.environ.setdefault("S2C_TAIL_LINK_MBPS", "5000")
+    _rt, link_bps = _link_constants()
+    n = 8
+    within = 0
+    total = 0
+    for name, L, rows, pattern in cells:
+        slabs = make_slabs(L, rows, w, pattern, n_slabs,
+                           seed=hash(name) % 2**31)
+        # oracle counts (unsharded scatter)
+        oracle = PileupAccumulator(L, strategy="scatter")
+        for s, c in slabs:
+            oracle.add(batch_of(s, c))
+        want = oracle.counts_host()
+
+        stats = shard_auto.slab_stats(batch_of(*slabs[0]).buckets, L)
+        rows_obs, rb, max_w, peak, sfrac = stats
+        halo = min(1 << 16, max(64, max_w))
+        mesh = make_mesh(n)
+        pick = shard_auto.choose_shard_mode(
+            L, n, dict(mesh.shape), rows_obs, rb, peak, sfrac, halo,
+            link_bps)
+        row = {"cell": name, "L": L, "rows": rows, "pattern": pattern,
+               "auto_pick": pick,
+               "slab": {"peak_frac": round(peak, 3),
+                        "sorted_frac": round(sfrac, 3), "halo": halo}}
+        times = {}
+        for mode in ("dp", "sp", "dpsp"):
+            try:
+                acc = build_acc(mode, make_mesh(n), L, halo)
+                for s, c in slabs:            # warm: pays jit compiles
+                    acc.add(batch_of(s, c))
+                acc.sync()
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    for s, c in slabs:
+                        acc.add(batch_of(s, c))
+                acc.sync()
+                dt = (time.perf_counter() - t0) / (reps * n_slabs)
+                got = acc.counts_host()
+                ok = np.array_equal(got, want * (reps + 1))
+                times[mode] = dt
+                row[mode] = {"sec_per_slab": round(dt, 4),
+                             "identical": bool(ok)}
+                if not ok:
+                    row[mode]["identical"] = False
+            except (ValueError, MemoryError) as exc:
+                row[mode] = f"infeasible: {exc}"[:90]
+        if times and pick in times:
+            best = min(times, key=times.get)
+            ratio = times[pick] / times[best]
+            row["best"] = best
+            row["auto_vs_best"] = round(ratio, 3)
+            total += 1
+            if ratio <= 1.10:
+                within += 1
+        emit(**row)
+    emit(summary=True, cells=total, auto_within_10pct=within,
+         criterion_met=bool(within == total))
+
+
+if __name__ == "__main__":
+    main()

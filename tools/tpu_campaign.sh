@@ -42,30 +42,30 @@ try:
     print('cpu-routed jit OK:', np.asarray(y).tolist(), y.devices())
 except Exception as e:
     print('NO CPU BACKEND:', type(e).__name__, e)
-" > campaign/cpu_coexist.txt 2>&1
+" > campaign/cpu_coexist_r05.txt 2>&1
 echo "$(date +%H:%M:%S) cpu_coexist done" >> "$LOG"
 
 # 3. full bench (all configs incl. north_star + wide_genome)
 BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 \
-  timeout -k 30 5400 python bench.py > campaign/bench_preview.json \
-  2> campaign/bench_stderr.log
+  timeout -k 30 5400 python bench.py > campaign/bench_preview_r05.json \
+  2> campaign/bench_stderr_r05.log
 rc=$?
 echo "$(date +%H:%M:%S) bench done rc=$rc" >> "$LOG"
 
 # 4. device-op microbench (pallas-vs-scatter evidence, mxu rates)
-timeout -k 30 1800 python tools/microbench.py > campaign/microbench_tpu.jsonl \
-  2> campaign/microbench_stderr.log
+timeout -k 30 1800 python tools/microbench.py > campaign/microbench_tpu_r05.jsonl \
+  2> campaign/microbench_stderr_r05.log
 rc=$?
 echo "$(date +%H:%M:%S) microbench done rc=$rc" >> "$LOG"
 
 # 5. packed5 output-encoding measurement (sets S2C_P5_DEV_NS evidence)
-timeout -k 30 1200 python tools/measure_p5.py > campaign/measure_p5.jsonl \
-  2> campaign/measure_p5_stderr.log
+timeout -k 30 1200 python tools/measure_p5.py > campaign/measure_p5_r05.jsonl \
+  2> campaign/measure_p5_stderr_r05.log
 rc=$?
 echo "$(date +%H:%M:%S) measure_p5 done rc=$rc" >> "$LOG"
 
 # 6. link probe (refresh PERF.md numbers)
-timeout -k 30 900 python tools/tunnel_probe.py > campaign/tunnel_probe.json \
-  2> campaign/tunnel_probe_stderr.log
+timeout -k 30 900 python tools/tunnel_probe.py > campaign/tunnel_probe_r05.json \
+  2> campaign/tunnel_probe_stderr_r05.log
 rc=$?
 echo "$(date +%H:%M:%S) probe done rc=$rc; campaign complete" >> "$LOG"
