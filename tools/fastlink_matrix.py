@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""Placement-gate decision matrix: tunnel-class vs PCIe-class links.
+
+Round-4 verdict #7: the placement model's device-side story on a fast
+link rested on the cost model alone — no committed artifact showed the
+gates flipping.  This tool evaluates every link-priced gate — the
+host-pileup genome bound (ops.pileup.host_pileup_max_len), the tail
+routing crossover (backends.jax_backend._tail_cpu_wins), and the
+output-encoding pick (_fetch_costs) — for each BASELINE.md workload
+shape under the bench rig's measured tunnel constants (65 ms RT,
+40 MB/s) and PCIe-class constants (1 ms RT, 2 GB/s), asserts the flips
+are COHERENT (everything device-side on the fast link for large
+genomes, host-side on the tunnel), and emits one JSON line per
+(config, link) cell plus a summary.
+
+This is the offline half of the evidence; the campaign's
+``fastlink_bench`` step additionally runs a forced-constant bench row
+on the real chip so the flipped decisions appear in a measured row's
+``pileup``/``tail_device`` fields.
+
+Run:  python tools/fastlink_matrix.py > campaign/fastlink_matrix_r05.json
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["S2C_LINK_PROBE"] = "0"
+
+from sam2consensus_tpu.utils.platform import pin_platform_from_env  # noqa
+pin_platform_from_env()
+
+
+#: (name, total_len, aligned_bases, n_thresholds) — BASELINE.md shapes
+CONFIGS = [
+    ("phix", 5_386, 2_000_000, 1),
+    ("amplicon_deep", 400, 8_000_000, 1),
+    ("ecoli_scale", 4_600_000, 15_000_000, 1),
+    ("north_star", 1_000_000, 100_000_000, 1),
+    ("wide_genome", 40_000_000, 10_000_000, 1),
+]
+
+LINKS = {
+    # the bench rig's measured tunnel (tools/tunnel_probe.py round 4)
+    "tunnel": {"rt_ms": 65.0, "mbps": 40.0},
+    # PCIe-class TPU-VM link
+    "pcie": {"rt_ms": 1.0, "mbps": 2000.0},
+}
+
+
+def evaluate(link: dict) -> list:
+    os.environ["S2C_TAIL_RT_MS"] = str(link["rt_ms"])
+    os.environ["S2C_TAIL_LINK_MBPS"] = str(link["mbps"])
+    from sam2consensus_tpu.backends import jax_backend as jb
+    from sam2consensus_tpu.ops import fused
+    from sam2consensus_tpu.ops.pileup import host_pileup_max_len
+
+    rows = []
+    bps = link["mbps"] * 1e6
+    for name, total_len, aligned, n_thr in CONFIGS:
+        bound = host_pileup_max_len(True, link_free=False, link_bps=bps)
+        pileup_route = "host" if total_len <= bound else "device"
+        cpu_tail = jb._tail_cpu_wins(total_len, n_thr, total_len * 6,
+                                     True, aligned_bases=aligned)
+        sparse_cap = fused.pad_cap(min(total_len, aligned) + 1)
+        costs = jb._fetch_costs(total_len, n_thr, sparse_cap, bps)
+        pick = min(costs, key=costs.get)
+        enc = ("dense" if pick is None
+               else "packed5" if pick == "packed5" else "sparse")
+        rows.append({
+            "config": name, "total_len": total_len,
+            "aligned_bases": aligned,
+            "host_pileup_bound": int(min(bound, 1 << 62)),
+            "pileup_route": pileup_route,
+            "tail": "cpu" if cpu_tail else "device",
+            "out_encoding": enc,
+        })
+    return rows
+
+
+def main():
+    result = {"links": LINKS, "cells": {}}
+    for lname, link in LINKS.items():
+        result["cells"][lname] = evaluate(link)
+    by = {ln: {r["config"]: r for r in rows}
+          for ln, rows in result["cells"].items()}
+
+    # coherence checks (the artifact's point): EVERY link-priced gate
+    # must flip device-side together on the fast link for the large
+    # genomes, and host-side together on the tunnel
+    checks = {
+        # tunnel: the slow-link bypass unbounds the host-pileup gate,
+        # and every tail routes to the local cpu (native vote)
+        "tunnel_pileup_host_everywhere": all(
+            r["pileup_route"] == "host" for r in result["cells"]["tunnel"]),
+        "tunnel_tail_cpu_everywhere": all(
+            r["tail"] == "cpu" for r in result["cells"]["tunnel"]),
+        # pcie: large genomes cross the narrow bound -> device pileup
+        "pcie_wide_pileup_device":
+            by["pcie"]["wide_genome"]["pileup_route"] == "device",
+        # pcie: device tails win from ecoli scale up
+        "pcie_ecoli_tail_device": by["pcie"]["ecoli_scale"]["tail"]
+            == "device",
+        "pcie_wide_tail_device": by["pcie"]["wide_genome"]["tail"]
+            == "device",
+        "pcie_north_star_tail_device": by["pcie"]["north_star"]["tail"]
+            == "device",
+        # output encoding: the fast link ships dense ASCII (the decode
+        # passes stop paying for saved wire); the tunnel picks a packed
+        # encoding for every genome large enough to matter
+        "pcie_dense_everywhere": all(
+            r["out_encoding"] == "dense"
+            for r in result["cells"]["pcie"]),
+        "tunnel_packs_large_genomes": all(
+            by["tunnel"][c]["out_encoding"] != "dense"
+            for c in ("ecoli_scale", "north_star", "wide_genome")),
+    }
+    result["coherence"] = checks
+    result["coherent"] = all(checks.values())
+    print(json.dumps(result, indent=1))
+    return 0 if result["coherent"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

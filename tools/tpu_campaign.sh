@@ -64,6 +64,20 @@ timeout -k 30 1200 python tools/measure_p5.py > campaign/measure_p5_r05.jsonl \
 rc=$?
 echo "$(date +%H:%M:%S) measure_p5 done rc=$rc" >> "$LOG"
 
+# 5b. fast-link placement artifact, on-chip half (VERDICT r4 #7): force
+# PCIe-class constants so every placement gate flips device-side, and
+# record the flipped decisions in measured bench rows (the real link is
+# still the tunnel, so the absolute numbers are slow — the point is the
+# rows' pileup/tail_device/encoding fields showing the coherent flip;
+# the offline half is campaign/fastlink_matrix_r05.json)
+S2C_TAIL_RT_MS=1 S2C_TAIL_LINK_MBPS=2000 S2C_LINK_PROBE=0 \
+  BENCH_CONFIGS=ecoli_scale,wide_genome BENCH_WIDE_ORACLE_SHRINK=16 \
+  BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 \
+  timeout -k 30 3600 python bench.py > campaign/fastlink_bench_r05.json \
+  2> campaign/fastlink_bench_stderr_r05.log
+rc=$?
+echo "$(date +%H:%M:%S) fastlink bench done rc=$rc" >> "$LOG"
+
 # 6. link probe (refresh PERF.md numbers)
 timeout -k 30 900 python tools/tunnel_probe.py > campaign/tunnel_probe_r05.json \
   2> campaign/tunnel_probe_stderr_r05.log
