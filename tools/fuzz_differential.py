@@ -306,6 +306,13 @@ def main() -> int:
             min_depth=rng.choice([1, 2, 5]),
             fill=rng.choice(["-", "N", "?"]),
             maxdel=rng.choice([None, 0, 2, 150]),
+            # device-kernel draws: the Pallas insertion kernel (fused
+            # in-kernel vote) runs in interpret mode here, and the
+            # pileup kernels ride their interpret/CPU twins — tiny
+            # inputs keep that affordable
+            ins_kernel=rng.choice(["auto", "scatter", "pallas"]),
+            pileup=rng.choice(["auto", "auto", "scatter", "pallas",
+                               "mxu"]),
             strict=rng.choice([False, True]))
         try:
             text = simulate(spec)
