@@ -21,10 +21,18 @@ design mandate: put the hot loop where the hardware is):
   into the next tile's range with one small scatter of NT*W rows.
 
 Everything is integer-exact (int8 one-hots, int32 accumulation).
-Measured ~11x faster than the scatter path per slab on v5e; the scatter
-path remains both the semantics oracle (tests/test_mxu_pileup.py) and the
-fallback when coverage skew makes per-tile padding explode
-(``plan.blowup``).
+
+**RETIRED from the TPU autotuner (round 5, PERF.md R5.1)**: the start
+one-hot's density is ``1/TP``, so every counted cell structurally costs
+``6*TP`` MACs (12k at TP=2048) — the formulation measured ~3x slower
+than the plain scatter end-to-end on the chip, and the Pallas tile-CSR
+histogram (``ops.pallas_pileup``) supersedes it at ~9x the scatter
+rate.  It stays available as ``--pileup mxu`` (the one formulation
+whose FLOPs land on the systolic array; the CPU-mesh tests pin its
+semantics, and it remains the autotune trial kernel off-TPU).  The
+scatter path remains both the semantics oracle
+(tests/test_mxu_pileup.py) and the fallback when coverage skew makes
+per-tile padding explode (``plan.blowup``).
 """
 
 from __future__ import annotations

@@ -45,15 +45,17 @@ __all__ = ["ShardedConsensus", "ALL"]
 class ShardedConsensus(ShardedCountsBase):
     """Streaming sharded accumulate + vote over a ("dp", "sp") mesh.
 
-    ``pileup`` picks the per-device accumulation strategy: ``"mxu"`` plans
-    one tile-sorted chunk per device and runs the one-hot-matmul pileup
-    (``ops.mxu_pileup``) locally before the reduce-scatter; ``"scatter"``
-    keeps the XLA scatter; ``"auto"`` runs the same measured
-    scatter-vs-mxu trial as the single-device accumulator
-    (``ops.pileup.PileupAutoTuner``) and locks in the per-cell winner —
-    the sharded promise of ``--pileup auto`` holds under ``--shards``.
-    Skewed slabs fall back to scatter per bucket, exactly as on a single
-    device.
+    ``pileup`` picks the per-device accumulation strategy: ``"pallas"``
+    runs the tile-CSR histogram kernel (``ops.pallas_pileup``) over the
+    full position axis per device; ``"mxu"`` plans one tile-sorted
+    chunk per device and runs the one-hot-matmul pileup
+    (``ops.mxu_pileup``) locally before the reduce-scatter;
+    ``"scatter"`` keeps the XLA scatter; ``"auto"`` runs the same
+    measured scatter-vs-kernel trial as the single-device accumulator
+    (``ops.pileup.PileupAutoTuner``: pallas on TPU, mxu elsewhere) and
+    locks in the per-cell winner — the sharded promise of ``--pileup
+    auto`` holds under ``--shards``.  Skewed slabs fall back to scatter
+    per bucket, exactly as on a single device.
     """
 
     def __init__(self, mesh: Mesh, total_len: int, pileup: str = "auto"):
