@@ -227,6 +227,9 @@ def run_once(backend, path, cfg, binary):
 
 
 def phase_split(stats):
+    # the keys are the compat view over the observability metrics
+    # registry (observability.publish_stats_extra): one canonical
+    # source for every phase second this bench reports
     return {k: stats.extra[k]
             for k in ("decode_sec", "stage_sec", "pileup_dispatch_sec",
                       "accumulate_sec", "vote_sec", "insertions_sec",
@@ -299,6 +302,17 @@ def util_fields(stats, jax_time):
     if ds > 0:
         u["decode_mbases_per_s"] = round(
             stats.aligned_bases / ds / 1e6, 1)
+    # placement-gate decisions, from the observability registry's compat
+    # view (backends/jax_backend._tail_cpu_wins records the model's
+    # verdict with its cpu_sec/chip_sec/link inputs; the pileup gauge
+    # records host vs device vs sharded): a mis-routed row is
+    # diagnosable from the bench JSON alone
+    tail = stats.extra.get("tail_dispatch")
+    if tail:
+        u["dispatch"] = tail
+    pp = stats.extra.get("pileup_path")
+    if pp:
+        u["pileup_path"] = pp
     return u
 
 

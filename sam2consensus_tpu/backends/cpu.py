@@ -30,8 +30,10 @@ byte for byte.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Iterable, List, Tuple
 
+from .. import observability as obs
 from ..config import RunConfig
 from ..constants import AMB, ALPHABET
 from ..core.cigar import walk
@@ -48,11 +50,29 @@ class CpuBackend:
 
     def run(self, contigs: List[Contig], records: Iterable[SamRecord],
             cfg: RunConfig) -> BackendResult:
+        """Observability wrapper: the oracle gets the same per-run
+        tracer/registry scope as the jax backend, so ``--trace-out`` /
+        ``--metrics-out`` work on ``--backend cpu`` and its phase
+        seconds surface through the same compat view."""
+        robs = obs.start_run(
+            trace_out=getattr(cfg, "trace_out", None),
+            metrics_out=getattr(cfg, "metrics_out", None))
+        try:
+            result = self._run(contigs, records, cfg)
+            obs.publish_stats_extra(result.stats.extra)
+            return result
+        finally:
+            obs.finish_run(robs, meta={"backend": self.name})
+
+    def _run(self, contigs: List[Contig], records: Iterable[SamRecord],
+             cfg: RunConfig) -> BackendResult:
         from ..io.sam import ReadStream
 
         if isinstance(records, ReadStream):
             records = records.records()
         stats = BackendStats()
+        tr = obs.tracer()
+        reg = obs.metrics()
 
         # --- allocation (header pass, sam2consensus.py:160-169) ---
         # Duplicate @SQ names overwrite like the reference's dict assignment
@@ -67,6 +87,7 @@ class CpuBackend:
         insertions: Dict[str, list] = {name: [] for name in lengths}
 
         # --- accumulation (sam2consensus.py:191-221) ---
+        t0 = time.perf_counter()
         for rec in records:
             try:
                 seqs_ref = sequences[rec.refname]
@@ -118,8 +139,14 @@ class CpuBackend:
                     pos_ref += 1
             insertions[rec.refname] += insert
             stats.reads_mapped += 1
+        reg.add("phase/accumulate_sec", time.perf_counter() - t0)
+        tr.complete("accumulate", t0)
+        reg.add("reads/mapped", stats.reads_mapped)
+        reg.add("reads/skipped", stats.reads_skipped)
+        reg.add("pileup/cells", stats.aligned_bases)
 
         # --- reformat + insertion table (sam2consensus.py:233-311) ---
+        t0 = time.perf_counter()
         for refname in order:
             for pos in range(len(coverages[refname])):
                 coverages[refname][pos] = sum(sequences[refname][pos].values())
@@ -166,6 +193,8 @@ class CpuBackend:
                         ins_tmp2[pos_i][col] = [[cnt * len(nucs), nucs]
                                                 for cnt, nucs in groups]
                 insertions[refname] = ins_tmp2
+        reg.add("phase/reformat_sec", time.perf_counter() - t0)
+        tr.complete("reformat", t0)
 
         # --- zero-coverage prune (sam2consensus.py:334-340) ---
         for refname in list(order):
@@ -174,6 +203,7 @@ class CpuBackend:
                 del insertions[refname]
 
         # --- consensus call (sam2consensus.py:345-406) ---
+        t0 = time.perf_counter()
         fastas: Dict[str, List[FastaRecord]] = {}
         for refname in order:
             if refname not in sequences:
@@ -207,6 +237,8 @@ class CpuBackend:
                     fastas.setdefault(refname, []).append(
                         FastaRecord(header, seq))
                     stats.consensus_bases += len(seq)
+        reg.add("phase/consensus_sec", time.perf_counter() - t0)
+        tr.complete("consensus", t0)
 
         return BackendResult(fastas=fastas, stats=stats)
 

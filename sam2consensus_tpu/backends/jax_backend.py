@@ -39,6 +39,7 @@ from typing import Dict, Iterable, List
 
 import numpy as np
 
+from .. import observability as obs
 from ..config import RunConfig
 from ..constants import NUM_SYMBOLS
 from ..io.sam import Contig, SamRecord
@@ -184,6 +185,9 @@ def _tail_cpu_wins(total_len: int, n_thresholds: int,
             raise RuntimeError(
                 f"S2C_TAIL_DEVICE={forced!r}: use 'cpu' (local XLA CPU "
                 f"tail), 'default' (the accelerator), or 'auto'")
+        obs.metrics().gauge("dispatch/tail").set_info(
+            {"chosen": "cpu" if forced == "cpu" else "device",
+             "forced": forced})
         return forced == "cpu"
     if native_tail:
         cpu_sec = total_len * (
@@ -201,7 +205,22 @@ def _tail_cpu_wins(total_len: int, n_thresholds: int,
     fetch = min(_fetch_costs(total_len, n_thresholds, sparse_cap,
                              link_bps).values())
     chip_sec = rt_sec + upload_bytes / link_bps + fetch
-    return cpu_sec < chip_sec
+    cpu_wins = cpu_sec < chip_sec
+    # the placement model's verdict AND its inputs, as a structured
+    # record: the gauge feeds the stats.extra compat view (bench util
+    # block) and the tracer event lands in the exported trace, so a
+    # mis-route is diagnosable from the artifact alone
+    decision = {"chosen": "cpu" if cpu_wins else "device",
+                "cpu_sec": round(cpu_sec, 6),
+                "chip_sec": round(chip_sec, 6),
+                "rt_sec": round(rt_sec, 6), "link_bps": int(link_bps),
+                "upload_bytes": int(upload_bytes),
+                "total_len": int(total_len),
+                "n_thresholds": int(n_thresholds),
+                "native_tail": bool(native_tail)}
+    obs.metrics().gauge("dispatch/tail").set_info(decision)
+    obs.tracer().event("dispatch/tail", **decision)
+    return cpu_wins
 
 
 def _fetch_costs(total_len: int, n_thresholds: int,
@@ -257,38 +276,41 @@ def _native_tail_possible(cfg, has_insertions: bool = True) -> bool:
     return native.load() is not None
 
 
-def _timed_iter(it, times, key: str = "decode_sec"):
-    """Yield from ``it``, accumulating the time spent inside ``next``."""
+def _timed_iter(it, key: str = "decode"):
+    """Yield from ``it``, spanning each ``next`` and accumulating the
+    time into the ``phase/<key>_sec`` metric."""
+    reg = obs.metrics()
+    tr = obs.tracer()
     while True:
-        t0 = time.perf_counter()
-        try:
-            batch = next(it)
-        except StopIteration:
-            return
-        times[key] += time.perf_counter() - t0
+        with tr.span(key):
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            reg.add(f"phase/{key}_sec", time.perf_counter() - t0)
         yield batch
 
 
 class _Prefetcher:
     """Bounded background decode: overlap host decode with pileup work.
 
-    The producer thread drains the encoder generator (timing its decode
-    work into ``times``) into a depth-2 queue; the consumer iterates
-    batches as they land.  Exceptions — including strict-mode decode
-    errors (the oracle's KeyError/IndexError types),
+    The producer thread drains the encoder generator (spanning its
+    decode work into the run's tracer/metrics) into a depth-2 queue;
+    the consumer iterates batches as they land.  Exceptions — including
+    strict-mode decode errors (the oracle's KeyError/IndexError types),
     whose type/message parity with the serial path is contract — are
     re-raised in the consumer at the point of consumption.
     """
 
     _DONE = object()
 
-    def __init__(self, gen, times, depth: int = 2, stage=None):
+    def __init__(self, gen, depth: int = 2, stage=None):
         import queue
         import threading
 
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._exc = None
-        self._times = times
         self._stage = stage
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -308,24 +330,29 @@ class _Prefetcher:
         return False
 
     def _work(self, gen) -> None:
+        reg = obs.metrics()
+        tr = obs.tracer()
+        tr.name_thread("decode-prefetch")
         try:
             while True:
-                t0 = time.perf_counter()
-                try:
-                    batch = next(gen)
-                except StopIteration:
-                    break
-                self._times["decode_sec"] += time.perf_counter() - t0
+                with tr.span("decode"):
+                    t0 = time.perf_counter()
+                    try:
+                        batch = next(gen)
+                    except StopIteration:
+                        break
+                    reg.add("phase/decode_sec",
+                            time.perf_counter() - t0)
                 if self._stage is not None:
                     # start this batch's h2d transfer now, overlapping the
                     # consumer's dispatch of the previous batch (the device
                     # pileup otherwise serializes transfer with dispatch on
                     # the link); timed separately from decode
-                    t0 = time.perf_counter()
-                    self._stage(batch)
-                    self._times["stage_sec"] = (
-                        self._times.get("stage_sec", 0.0)
-                        + time.perf_counter() - t0)
+                    with tr.span("stage"):
+                        t0 = time.perf_counter()
+                        self._stage(batch)
+                        reg.add("phase/stage_sec",
+                                time.perf_counter() - t0)
                 if not self._put(batch):
                     return                 # consumer gone; drop the rest
         except BaseException as exc:  # re-raised on the consumer side
@@ -360,6 +387,22 @@ class JaxBackend:
 
     def run(self, contigs: List[Contig], records: Iterable[SamRecord],
             cfg: RunConfig) -> BackendResult:
+        """Wrap one pipeline run in a fresh tracer + metrics registry
+        (per-run, so the bench's warm/timed repetitions never bleed into
+        each other), then derive the legacy ``stats.extra`` keys from
+        the registry and write any requested exports."""
+        robs = obs.start_run(
+            trace_out=getattr(cfg, "trace_out", None),
+            metrics_out=getattr(cfg, "metrics_out", None))
+        try:
+            result = self._run(contigs, records, cfg)
+            obs.publish_stats_extra(result.stats.extra)
+            return result
+        finally:
+            obs.finish_run(robs, meta={"backend": self.name})
+
+    def _run(self, contigs: List[Contig], records: Iterable[SamRecord],
+             cfg: RunConfig) -> BackendResult:
         # jax imports deferred so `--backend cpu` never pays them
         import jax
         import jax.numpy as jnp
@@ -374,6 +417,8 @@ class JaxBackend:
         from ..io.sam import ReadStream
 
         stats = BackendStats()
+        tr = obs.tracer()
+        reg = obs.metrics()
         layout = GenomeLayout(contigs)
         if layout.total_len == 0:
             return BackendResult(fastas={}, stats=stats)
@@ -419,8 +464,16 @@ class JaxBackend:
                 # the native tail vote makes host runs link-free, and
                 # vanishes when the probed link is tunnel-class slow)
                 acc = HostPileupAccumulator(layout.total_len)
+                reg.gauge("dispatch/pileup").set_info(
+                    {"path": "host", "strategy": strategy,
+                     "total_len": int(layout.total_len),
+                     "native_tail": bool(_native_ok),
+                     "link_free": bool(_link_free)})
             else:
                 acc = PileupAccumulator(layout.total_len, strategy=strategy)
+                reg.gauge("dispatch/pileup").set_info(
+                    {"path": "device", "strategy": strategy,
+                     "total_len": int(layout.total_len)})
 
         # checkpoint resume: counts + insertion log + consumed-line offset
         # are the entire job state (SURVEY.md §5)
@@ -495,7 +548,6 @@ class JaxBackend:
 
         t0 = time.perf_counter()
         reads_at_ckpt = 0
-        decode_times = {"decode_sec": 0.0}
         max_row_width = ck.max_row_width if ck else 0
         src = iter(batches)
         if use_sharded and acc is None:
@@ -503,7 +555,8 @@ class JaxBackend:
             # halo and its slab shape feeds the auto-mode model
             td = time.perf_counter()
             first_batch = next(src, None)
-            decode_times["decode_sec"] += time.perf_counter() - td
+            reg.add("phase/decode_sec", time.perf_counter() - td)
+            tr.complete("decode", td)
             acc = self._build_sharded_acc(cfg, layout, shards, first_batch,
                                           max_row_width, stats)
             if ck is not None:
@@ -522,7 +575,7 @@ class JaxBackend:
             #   so a prefetch thread buys zero overlap while its spawn
             #   costs ~6 ms — the entire fixed budget of a small-input
             #   run (measured: phix 14.6 -> ~9 ms)
-            batch_iter = _timed_iter(src, decode_times)
+            batch_iter = _timed_iter(src)
         else:
             # overlap host decode with pileup work (SURVEY.md §7(d)): a
             # bounded prefetch thread decodes the next slabs while this
@@ -533,10 +586,9 @@ class JaxBackend:
             # except under --paranoid, whose contract is that batches are
             # re-validated BEFORE anything ships to the device.
             batch_iter = _Prefetcher(
-                src, decode_times,
+                src,
                 stage=None if cfg.paranoid
                 else getattr(acc, "stage", None))
-        pileup_sec = 0.0
         try:
             for batch in batch_iter:
                 if cfg.paranoid:
@@ -545,8 +597,10 @@ class JaxBackend:
                     max_row_width = max(max_row_width,
                                         max(batch.buckets))
                 ta = time.perf_counter()
-                acc.add(batch)
-                pileup_sec += time.perf_counter() - ta
+                with tr.span("pileup_dispatch", n_events=batch.n_events):
+                    acc.add(batch)
+                reg.add("phase/pileup_dispatch_sec",
+                        time.perf_counter() - ta)
                 stats.aligned_bases += batch.n_events
                 if (cfg.checkpoint_dir
                         and encoder.n_reads - reads_at_ckpt
@@ -563,24 +617,26 @@ class JaxBackend:
                 batch_iter.close()
         stats.reads_mapped = base_mapped + encoder.n_reads
         stats.reads_skipped = base_skipped + encoder.n_skipped
+        reg.add("reads/mapped", encoder.n_reads)
+        reg.add("reads/skipped", encoder.n_skipped)
+        reg.add("pileup/cells", stats.aligned_bases - base_aligned)
         stats.extra["shards"] = shards if use_sharded else 1
         stats.extra["decoder"] = encoder.__class__.__name__
         if getattr(acc, "strategy_used", None):
             stats.extra["pileup"] = dict(acc.strategy_used)
-        stats.extra["decode_sec"] = round(decode_times["decode_sec"], 4)
-        if "stage_sec" in decode_times:
-            stats.extra["stage_sec"] = round(decode_times["stage_sec"], 4)
-        stats.extra["pileup_dispatch_sec"] = round(pileup_sec, 4)
-        if (os.environ.get("S2C_SYNC_ACCUMULATE") == "1"
+        if ((os.environ.get("S2C_SYNC_ACCUMULATE") == "1" or tr.enabled)
                 and hasattr(acc, "sync")):
-            # opt-in (bench forced-device rows): device scatters are
-            # async — without this barrier accumulate_sec ends with the
-            # dispatch queue still draining and the drain is billed to
-            # the tail's first fetch, so the chip's cell rate is not
-            # attributable to any one phase
-            acc.sync()
+            # opt-in (bench forced-device rows) — and whenever tracing is
+            # on, so the accumulate span closes under a device barrier:
+            # device scatters are async — without this the accumulate
+            # window ends with the dispatch queue still draining and the
+            # drain is billed to the tail's first fetch, so the chip's
+            # cell rate is not attributable to any one phase
+            with tr.span("accumulate_sync"):
+                acc.sync()
             stats.extra["accumulate_synced"] = True
-        stats.extra["accumulate_sec"] = round(time.perf_counter() - t0, 4)
+        reg.add("phase/accumulate_sec", time.perf_counter() - t0)
+        tr.complete("accumulate", t0)
         if ck is not None and "incremental_base" not in stats.extra:
             stats.extra["resumed_from_line"] = ck.lines_consumed
 
@@ -666,7 +722,8 @@ class JaxBackend:
 
         thr_enc = put(thr_enc_np)
         ins = group_insertions(encoder.insertions, layout)
-        stats.extra["insertions_sec"] = round(time.perf_counter() - t0, 4)
+        reg.add("phase/insertions_sec", time.perf_counter() - t0)
+        tr.complete("insertions", t0)
 
         t0 = time.perf_counter()
         # output-encoding gate: the position symbols can travel dense
@@ -892,7 +949,12 @@ class JaxBackend:
                 cov64[int(layout.offsets[i]):int(layout.offsets[i + 1])]
                 .sum() for i in range(n_contigs)], dtype=np.int64)
             stats.extra["contig_sums_host_fallback"] = True
-        stats.extra["vote_sec"] = round(time.perf_counter() - t0, 4)
+        # the vote section's device work all completes under host fetches
+        # (np.asarray / the native vote), so this span's close already
+        # sits after device completion — the block_until_ready guarantee
+        # without an extra barrier
+        reg.add("phase/vote_sec", time.perf_counter() - t0)
+        tr.complete("vote", t0)
         # wire accounting (bench utilization rows): bytes shipped up during
         # accumulation and fetched back by the fused tail
         stats.extra["h2d_bytes"] = int(getattr(acc, "bytes_h2d", 0))
@@ -907,6 +969,8 @@ class JaxBackend:
             # None).
             stats.extra["d2h_bytes"] = \
                 0 if (link_free or out is None) else int(out.nbytes)
+        reg.add("wire/h2d_bytes", stats.extra["h2d_bytes"])
+        reg.add("wire/d2h_bytes", stats.extra["d2h_bytes"])
         if getattr(acc, "strategy_used", None):
             # refresh: the host-counts path records its wire dtype at upload
             stats.extra["pileup"] = dict(acc.strategy_used)
@@ -915,9 +979,10 @@ class JaxBackend:
                                   ins=ins, site_cov=site_cov)
 
         t0 = time.perf_counter()
-        fastas = self._assemble(layout, syms, contig_sums, ins, ins_syms,
-                                site_cov, cfg, stats)
-        stats.extra["render_sec"] = round(time.perf_counter() - t0, 4)
+        with tr.span("render"):
+            fastas = self._assemble(layout, syms, contig_sums, ins,
+                                    ins_syms, site_cov, cfg, stats)
+        reg.add("phase/render_sec", time.perf_counter() - t0)
 
         if cfg.checkpoint_dir:
             from ..utils import checkpoint as ckpt
@@ -1017,6 +1082,12 @@ class JaxBackend:
         stats.extra["shard_mode"] = mode
         if hasattr(acc, "halo"):
             stats.extra["halo"] = int(acc.halo)
+        obs.metrics().gauge("dispatch/pileup").set_info(
+            {"path": "sharded", "mode": mode, "shards": int(shards),
+             "pileup": sp_pileup if mode in ("sp", "dpsp")
+             else getattr(cfg, "pileup", "auto"),
+             "halo": int(getattr(acc, "halo", 0)),
+             "total_len": int(layout.total_len)})
         return acc
 
     # -- checkpointing -----------------------------------------------------

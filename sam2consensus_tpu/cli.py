@@ -65,6 +65,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write run metrics as JSON to this path ('-' = stdout)")
     p.add_argument("--profile-dir", dest="profile_dir", default=None,
                    help="write a jax.profiler trace to this directory")
+    p.add_argument("--trace-out", dest="trace_out", default=None,
+                   help="write a Chrome/Perfetto trace-event JSON of the "
+                        "run's span tree (decode/stage/pileup dispatch/"
+                        "accumulate/vote/insertions/render, device spans "
+                        "closed under a device barrier) to this path; "
+                        "open at https://ui.perfetto.dev")
+    p.add_argument("--metrics-out", dest="metrics_out", default=None,
+                   help="write the run's metrics registry (phase seconds, "
+                        "wire bytes, dispatch decisions, histograms with "
+                        "p50/p95/p99) as JSONL to this path")
+    p.add_argument("--log-level", dest="log_level", default=None,
+                   choices=["debug", "info", "warning", "error"],
+                   help="enable package logging to stderr at this level")
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None,
                    help="persist count-tensor checkpoints here and resume "
                         "from them if present (jax backend)")
@@ -177,6 +190,9 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         chunk_reads=args.chunk_reads,
         profile_dir=args.profile_dir,
         json_metrics=args.json_metrics,
+        trace_out=args.trace_out,
+        metrics_out=args.metrics_out,
+        log_level=args.log_level,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         paranoid=args.paranoid,
@@ -207,6 +223,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     echo = (lambda *a, **k: None) if args.quiet else print
+
+    from . import observability
+
+    observability.configure_logging(cfg.log_level)
 
     # A user's JAX_PLATFORMS must win even where a sitecustomize hook
     # pre-registered a remote accelerator and overrode jax.config (the

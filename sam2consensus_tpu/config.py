@@ -44,6 +44,9 @@ class RunConfig:
     chunk_reads: int = 262144    # reads per host->device batch (jax backend)
     profile_dir: Optional[str] = None
     json_metrics: Optional[str] = None
+    trace_out: Optional[str] = None      # Chrome/Perfetto trace JSON path
+    metrics_out: Optional[str] = None    # metrics-registry JSONL path
+    log_level: Optional[str] = None      # package logger level (CLI)
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 2_000_000  # reads between checkpoint writes
     paranoid: bool = False       # re-validate device inputs/outputs per batch

@@ -31,10 +31,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator, List, Optional
 
 import numpy as np
 
+from .. import observability as obs
 from .events import GenomeLayout, InsertionEvents, SegmentBatch
 from .native_encoder import NativeReadEncoder
 
@@ -83,6 +85,7 @@ class ParallelFusedDecoder:
             state = {
                 "counts": target, "q": queue.Queue(maxsize=2),
                 "batches": [], "error": None, "lines": 0, "bytes": 0,
+                "idx": w,
             }
 
             def _count(key, st=state):
@@ -104,6 +107,13 @@ class ParallelFusedDecoder:
     def _work(self, state: dict) -> None:
         enc: NativeReadEncoder = state["enc"]
         current_idx = [None]
+        # capture the RUN's tracer and registry at thread start: a
+        # worker that outlives the run (consumer aborted mid-stream)
+        # must not record into whatever registry is current at its exit
+        tr = obs.tracer()
+        reg = obs.metrics()
+        tr.name_thread(f"decode-worker-{state['idx']}")
+        t0 = time.perf_counter()
 
         def feed():
             while True:
@@ -118,6 +128,12 @@ class ParallelFusedDecoder:
                 state["batches"].append(batch)
         except BaseException as exc:
             state["error"] = (current_idx[0], exc)
+        # one span per worker lifetime (block-level spans would be
+        # noise: the fused C decode runs ~500 MB/s/core); the bytes/lines
+        # args make per-worker balance visible in the trace
+        tr.complete("decode_worker", t0, worker=state["idx"],
+                    lines=state["lines"], bytes=state["bytes"])
+        reg.add("decode/worker_sec", time.perf_counter() - t0)
 
     # -- coordinator -------------------------------------------------------
     def encode_blocks(self, blocks) -> Iterator[SegmentBatch]:

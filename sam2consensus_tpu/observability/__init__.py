@@ -1,0 +1,154 @@
+"""Unified tracing + metrics for the whole pipeline.
+
+One subsystem replaces the ad-hoc ``time.perf_counter()`` snippets and
+``stats.extra`` plumbing that every perf claim used to rest on:
+
+* :mod:`.trace` — thread-safe hierarchical spans (free when disabled,
+  device-aware ``sync`` on exit);
+* :mod:`.metrics` — process-current registry of counters / gauges /
+  histograms; the ``stats.extra`` keys bench.py reads are a compat view
+  derived from a snapshot of this registry;
+* :mod:`.export` — Chrome/Perfetto trace JSON + JSONL metrics sink
+  (CLI: ``--trace-out`` / ``--metrics-out``).
+
+Usage, backend side::
+
+    obs = observability.start_run(trace_out=cfg.trace_out,
+                                  metrics_out=cfg.metrics_out)
+    try:
+        with obs.tracer.span("decode"):
+            ...
+    finally:
+        observability.finish_run(obs, meta={"backend": "jax"})
+
+Deep call sites (ops/pileup dispatch, utils/linkprobe, the parallel
+accumulators) use :func:`tracer` / :func:`metrics` to reach the current
+run's instruments without a handle threaded through their signatures.
+Between runs both fall back to process-wide defaults — a disabled
+tracer and a throwaway registry — so recording is always safe.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+from . import metrics as _metrics
+from .export import (read_metrics_jsonl, write_chrome_trace,
+                     write_metrics_jsonl)
+from .metrics import MetricsRegistry
+from .trace import Tracer
+
+__all__ = [
+    "Tracer", "MetricsRegistry", "RunObservability", "PHASES",
+    "start_run", "finish_run", "tracer", "metrics",
+    "publish_stats_extra", "configure_logging",
+    "write_chrome_trace", "write_metrics_jsonl", "read_metrics_jsonl",
+]
+
+#: span/phase names in pipeline order — the canonical phase vocabulary
+#: shared by the tracer, the metrics registry (``phase/<name>_sec``
+#: counters), and the legacy ``stats.extra`` compat keys bench.py reads
+PHASES = ("decode", "stage", "pileup_dispatch", "accumulate",
+          "insertions", "vote", "render")
+
+#: the always-available fallback tracer; disabled, so every span call
+#: outside a run is the shared no-op
+_disabled_tracer = Tracer(enabled=False)
+_tracer_stack: List[Tracer] = [_disabled_tracer]
+_stack_lock = threading.Lock()
+
+
+def tracer() -> Tracer:
+    """The current run's tracer (a disabled one between runs)."""
+    return _tracer_stack[-1]
+
+
+def metrics() -> MetricsRegistry:
+    """The current run's metrics registry (see metrics.current)."""
+    return _metrics.current()
+
+
+@dataclass
+class RunObservability:
+    """Handle for one run's instruments + export destinations."""
+
+    tracer: Tracer
+    registry: MetricsRegistry
+    trace_out: Optional[str] = None
+    metrics_out: Optional[str] = None
+
+
+def start_run(trace_out: Optional[str] = None,
+              metrics_out: Optional[str] = None,
+              enabled: Optional[bool] = None) -> RunObservability:
+    """Install a fresh tracer + registry as the process-current pair.
+
+    The tracer is enabled iff a trace destination exists (``trace_out``
+    or S2C_TRACE_OUT) or ``enabled`` forces it; the registry always
+    collects — its cost is a few locked adds per *slab*, not per row,
+    and the compat ``stats.extra`` view needs it on every run.
+    """
+    trace_out = trace_out or os.environ.get("S2C_TRACE_OUT") or None
+    metrics_out = metrics_out or os.environ.get("S2C_METRICS_OUT") or None
+    if enabled is None:
+        enabled = trace_out is not None
+    t = Tracer(enabled=bool(enabled))
+    reg = _metrics.push_run()
+    with _stack_lock:
+        _tracer_stack.append(t)
+    return RunObservability(tracer=t, registry=reg, trace_out=trace_out,
+                            metrics_out=metrics_out)
+
+
+def finish_run(obs: RunObservability, meta: Optional[dict] = None) -> None:
+    """Uninstall the run's instruments and write any requested exports."""
+    with _stack_lock:
+        if len(_tracer_stack) > 1 and _tracer_stack[-1] is obs.tracer:
+            _tracer_stack.pop()
+        elif obs.tracer in _tracer_stack[1:]:
+            _tracer_stack.remove(obs.tracer)
+    _metrics.pop_run(obs.registry)
+    if obs.trace_out:
+        write_chrome_trace(obs.tracer, obs.trace_out)
+    if obs.metrics_out:
+        write_metrics_jsonl(obs.registry, obs.metrics_out, meta=meta)
+
+
+def publish_stats_extra(extra: dict) -> None:
+    """Compat view: derive the legacy ``stats.extra`` keys from the
+    current metrics registry — the one canonical source.  ``bench.py``
+    and ``--json-metrics`` keep reading the same keys they always did;
+    the registry (and its ``--metrics-out`` JSONL export) is where the
+    numbers actually live now."""
+    snap = metrics().snapshot()
+    for name, value in snap["counters"].items():
+        # every phase counter surfaces, not just the canonical PHASES —
+        # the cpu oracle's reformat/consensus phases ride the same view
+        if name.startswith("phase/") and name.endswith("_sec"):
+            extra[name[len("phase/"):]] = round(value, 4)
+    for gauge_name, extra_key in (("dispatch/tail", "tail_dispatch"),
+                                  ("dispatch/pileup", "pileup_path")):
+        g = snap["gauges"].get(gauge_name)
+        if g is not None and g.get("info"):
+            extra[extra_key] = g["info"]
+
+
+def configure_logging(level: Optional[str]) -> None:
+    """Wire the package logger to stderr at ``level`` (``--log-level``)."""
+    if not level:
+        return
+    lv = getattr(logging, level.upper(), None)
+    if not isinstance(lv, int):
+        raise SystemExit(f"error: unknown log level {level!r} "
+                         "(use debug|info|warning|error)")
+    logger = logging.getLogger("sam2consensus_tpu")
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s %(message)s"))
+        logger.addHandler(h)
+    logger.setLevel(lv)

@@ -1,0 +1,93 @@
+"""Exports: Chrome/Perfetto trace-event JSON and a JSONL metrics sink.
+
+* ``write_chrome_trace``: the trace-event "JSON object format"
+  (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU)
+  — ``ph: "X"`` complete events with ``ts``/``dur`` in microseconds,
+  ``ph: "i"`` instants for span events and gate decisions, plus
+  ``thread_name`` metadata so the decode prefetch / parallel-decode
+  worker threads are labeled.  Load via https://ui.perfetto.dev or
+  chrome://tracing.
+* ``write_metrics_jsonl``: one JSON object per line, one line per
+  instrument (``{"kind": "counter"|"gauge"|"histogram", "name": ...,
+  ...}``), preceded by one ``{"kind": "meta", ...}`` header line.
+  tools/bench_report.py renders the per-phase table from this sink.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from .metrics import MetricsRegistry
+from .trace import Tracer
+
+
+def chrome_trace_events(tracer: Tracer, pid: Optional[int] = None) -> list:
+    """Tracer spans -> a list of Chrome trace-event dicts."""
+    pid = os.getpid() if pid is None else pid
+    events = []
+    for tid, name in tracer.thread_names().items():
+        events.append({"ph": "M", "pid": pid, "tid": tid,
+                       "name": "thread_name", "args": {"name": name}})
+    for s in tracer.drain():
+        if s.dur_us < 0:
+            ev = {"ph": "i", "name": s.name, "pid": pid, "tid": s.tid,
+                  "ts": s.ts_us, "s": "t"}
+            if s.args:
+                ev["args"] = s.args
+            events.append(ev)
+            continue
+        ev = {"ph": "X", "name": s.name, "pid": pid, "tid": s.tid,
+              "ts": s.ts_us, "dur": s.dur_us}
+        if s.args:
+            ev["args"] = s.args
+        events.append(ev)
+        for ename, ets, eargs in (s.events or ()):
+            iev = {"ph": "i", "name": ename, "pid": pid, "tid": s.tid,
+                   "ts": ets, "s": "t"}
+            if eargs:
+                iev["args"] = eargs
+            events.append(iev)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events
+
+
+def write_chrome_trace(tracer: Tracer, path: str) -> None:
+    blob = {"traceEvents": chrome_trace_events(tracer),
+            "displayTimeUnit": "ms"}
+    with open(path, "w") as fh:
+        json.dump(blob, fh)
+        fh.write("\n")
+
+
+def write_metrics_jsonl(registry: MetricsRegistry, path: str,
+                        meta: Optional[dict] = None) -> None:
+    snap = registry.snapshot()
+    with open(path, "w") as fh:
+        header = {"kind": "meta", "pid": os.getpid()}
+        if meta:
+            header.update(meta)
+        fh.write(json.dumps(header) + "\n")
+        for name, value in snap["counters"].items():
+            fh.write(json.dumps({"kind": "counter", "name": name,
+                                 "value": value}) + "\n")
+        for name, entry in snap["gauges"].items():
+            row = {"kind": "gauge", "name": name, "value": entry["value"]}
+            if "info" in entry:
+                row["info"] = entry["info"]
+            fh.write(json.dumps(row) + "\n")
+        for name, entry in snap["histograms"].items():
+            fh.write(json.dumps({"kind": "histogram", "name": name,
+                                 **entry}) + "\n")
+
+
+def read_metrics_jsonl(path: str) -> list:
+    """Parse a metrics JSONL sink back into a list of row dicts."""
+    rows = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as obs
 from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import SegmentBatch
 
@@ -343,6 +344,7 @@ class HostPileupAccumulator:
             return
         flat = self._counts.reshape(-1)
         for w, (starts, codes) in sorted(batch.buckets.items()):
+            t0 = time.perf_counter()
             if self._lib is not None:
                 self._lib.s2c_accumulate_rows(
                     np.ascontiguousarray(starts),
@@ -355,6 +357,10 @@ class HostPileupAccumulator:
                 np.add.at(self._counts,
                           (pos[ok], codes[rows[ok], cols[ok]]), 1)
             self.strategy_used["host"] += 1
+            obs.tracer().complete("slab", t0, strategy="host",
+                                  n_rows=len(starts), width=w)
+            obs.metrics().observe("pileup/slab_sec/host",
+                                  time.perf_counter() - t0)
 
     def wire_itemsize(self) -> int:
         """Bytes/cell of the narrowed upload dtype (cached one-pass max);
@@ -372,16 +378,18 @@ class HostPileupAccumulator:
         import jax
 
         if self._device_counts is None:
-            it = self.wire_itemsize()
-            if it == 4:        # already int32: ship the buffer, no copy
-                arr = self._counts
-            else:
-                arr = self._counts.astype(np.uint8 if it == 1
-                                          else np.uint16)
-            self.strategy_used["host_wire_dtype"] = str(arr.dtype)
-            if self.tail_device is None:
-                self.bytes_h2d += arr.nbytes   # real wire bytes
-            self._device_counts = jax.device_put(arr, self.tail_device)
+            with obs.tracer().span("counts_upload"):
+                it = self.wire_itemsize()
+                if it == 4:    # already int32: ship the buffer, no copy
+                    arr = self._counts
+                else:
+                    arr = self._counts.astype(np.uint8 if it == 1
+                                              else np.uint16)
+                self.strategy_used["host_wire_dtype"] = str(arr.dtype)
+                if self.tail_device is None:
+                    self.bytes_h2d += arr.nbytes   # real wire bytes
+                self._device_counts = jax.device_put(arr,
+                                                     self.tail_device)
         return self._device_counts
 
     def counts_host(self) -> np.ndarray:
@@ -435,6 +443,19 @@ def run_tuned_slab(tuner, static_choice: str, n_rows: int, width: int,
             tuner.complete((time.perf_counter() - t0) / (n_rows * width))
         else:
             tuner.complete()
+    # per-slab observability: a child span under the backend's
+    # pileup_dispatch span (same thread), a slab-seconds histogram per
+    # strategy, and — once the autotuner locks — the trial's verdict as
+    # a structured gauge.  Non-timing slabs measure dispatch, not device
+    # compute (dispatches are async); the timed slabs blocked above.
+    dt = time.perf_counter() - t0
+    obs.tracer().complete("slab", t0, strategy=key, n_rows=n_rows,
+                          width=width, skewed=skewed, timed=timing)
+    reg = obs.metrics()
+    reg.observe(f"pileup/slab_sec/{key}", dt)
+    reg.add("pileup/slabs", 1)
+    if tuner is not None and tuner.stats is not None:
+        reg.gauge("pileup/autotune").set_info(dict(tuner.stats))
     return key
 
 

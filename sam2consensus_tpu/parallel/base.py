@@ -11,6 +11,8 @@ means a vote or checkpoint fix cannot silently diverge the two modes.
 
 from __future__ import annotations
 
+import inspect
+import time
 from functools import partial
 from typing import Tuple
 
@@ -23,6 +25,23 @@ try:
     from jax import shard_map  # jax >= 0.8
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
+
+try:
+    _SHARD_MAP_PARAMS = frozenset(
+        inspect.signature(shard_map).parameters)
+except (TypeError, ValueError):  # pragma: no cover - exotic wrappers
+    _SHARD_MAP_PARAMS = frozenset(("check_vma",))
+if "check_vma" not in _SHARD_MAP_PARAMS:
+    # version shim: the replication check's kwarg was renamed
+    # check_rep -> check_vma across jax releases; route whichever
+    # spelling the installed jax accepts so the sp/dpsp kernels (which
+    # pass check_vma=False) import everywhere
+    _shard_map_native = shard_map
+
+    def shard_map(*args, check_vma=None, **kwargs):  # noqa: F811
+        if check_vma is not None and "check_rep" in _SHARD_MAP_PARAMS:
+            kwargs["check_rep"] = check_vma
+        return _shard_map_native(*args, **kwargs)
 
 from ..constants import NUM_SYMBOLS, PAD_CODE
 
@@ -45,6 +64,19 @@ def fetch_host(x: jax.Array) -> np.ndarray:
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def record_slab(key: str, t0: float, n_rows: int, width: int) -> None:
+    """Per-slab observability for the sp/dpsp routers: a ``slab`` span
+    (child of the backend's pileup_dispatch span) plus a per-strategy
+    seconds histogram.  The dp path rides the identical instrumentation
+    in ``ops.pileup.run_tuned_slab``."""
+    from .. import observability as obs
+
+    obs.tracer().complete("slab", t0, strategy=key, n_rows=n_rows,
+                          width=width)
+    obs.metrics().observe(f"pileup/slab_sec/{key}",
+                          time.perf_counter() - t0)
 
 
 def block_for(total_len: int, n_devices: int) -> int:

@@ -56,6 +56,12 @@ class ShardedConsensus(ShardedCountsBase):
     locks in the per-cell winner — the sharded promise of ``--pileup
     auto`` holds under ``--shards``.  Skewed slabs fall back to scatter
     per bucket, exactly as on a single device.
+
+    Observability rides the shared slab driver
+    (``ops.pileup.run_tuned_slab``): every slab emits a ``slab`` span
+    and a ``pileup/slab_sec/<strategy>`` histogram sample, same as the
+    single-device accumulator — the sp/dpsp routers record theirs via
+    ``parallel.base.record_slab``.
     """
 
     def __init__(self, mesh: Mesh, total_len: int, pileup: str = "auto"):

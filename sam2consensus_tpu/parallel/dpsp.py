@@ -38,6 +38,7 @@ throughput (many reads) meets a genome too big for dp's O(L) transient.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -50,7 +51,8 @@ from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
                           pack_nibbles, round_rows_grid, unpack_nibbles)
 from .base import (ALL, ShardedCountsBase, plan_mxu_grids, real_row_mask,
-                   route_to_slots, shard_map, split_wide_rows)
+                   record_slab, route_to_slots, shard_map,
+                   split_wide_rows)
 
 __all__ = ["ProductShardedConsensus"]
 
@@ -246,6 +248,7 @@ class ProductShardedConsensus(ShardedCountsBase):
     # -- streaming input --------------------------------------------------
     def add(self, batch: SegmentBatch) -> None:
         for w, (starts, codes) in sorted(batch.buckets.items()):
+            t0 = time.perf_counter()
             starts = np.asarray(starts)
             codes = np.asarray(codes)
             if w > self.halo:
@@ -291,6 +294,8 @@ class ProductShardedConsensus(ShardedCountsBase):
                     codes[lo:hi], pins)
 
             if self._routed_kernel_add(s_routed, c_routed, counts_dm, w):
+                record_slab(f"dpsp_{self.pileup}_w{w}", t0,
+                            len(starts), w)
                 continue
             for lo_r, hi_r in iter_row_slices(r, w):
                 s_slab = np.ascontiguousarray(
@@ -305,3 +310,4 @@ class ProductShardedConsensus(ShardedCountsBase):
                 self.rows_shipped += self.n * (hi_r - lo_r)
             key = f"dpsp_w{w}"
             self.strategy_used[key] = self.strategy_used.get(key, 0) + 1
+            record_slab(key, t0, len(starts), w)

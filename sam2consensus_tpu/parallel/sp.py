@@ -47,6 +47,7 @@ received, pinning the sorted-input fix (tests/test_parallel_sp.py).
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -58,7 +59,7 @@ from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
                           pack_nibbles, round_rows_grid, unpack_nibbles)
 from .base import (ALL, ShardedCountsBase, block_for, plan_mxu_grids,
-                   real_row_mask, route_to_slots, shard_map,
+                   real_row_mask, record_slab, route_to_slots, shard_map,
                    split_wide_rows)
 from jax.sharding import PartitionSpec as P
 
@@ -282,6 +283,7 @@ class PositionShardedConsensus(ShardedCountsBase):
     # -- streaming input --------------------------------------------------
     def add(self, batch: SegmentBatch) -> None:
         for w, (starts, codes) in sorted(batch.buckets.items()):
+            t0 = time.perf_counter()
             starts = np.asarray(starts)
             codes = np.asarray(codes)
             if w > self.halo:
@@ -334,6 +336,7 @@ class PositionShardedConsensus(ShardedCountsBase):
                     self.rows_shipped += hi - lo
                 key = f"window_w{w}"
                 self.strategy_used[key] = self.strategy_used.get(key, 0) + 1
+                record_slab(key, t0, len(starts), w)
                 continue
 
             # route rows to the device owning their start position.
@@ -350,6 +353,8 @@ class PositionShardedConsensus(ShardedCountsBase):
                 dev, self.n, r, starts, codes,
                 np.arange(self.n) * self.block)
             if self._routed_kernel_add(s_routed, c_routed, per_dev, w):
+                record_slab(f"routed_{self.pileup}_w{w}", t0,
+                            len(starts), w)
                 continue
 
             # cap expanded cells per device call (same budget discipline
@@ -365,3 +370,4 @@ class PositionShardedConsensus(ShardedCountsBase):
                 self.rows_shipped += self.n * (hi_r - lo)
             key = f"routed_w{w}"
             self.strategy_used[key] = self.strategy_used.get(key, 0) + 1
+            record_slab(key, t0, len(starts), w)

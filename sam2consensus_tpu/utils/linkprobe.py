@@ -53,20 +53,41 @@ def probe_link(force: bool = False) -> Optional[Tuple[float, float]]:
     """
     global _cached, _failed
     if _cached is not None and not force:
+        _record_link(_cached)          # fresh per-run registry, cached probe
         return _cached
     if _failed and not force:
         return None
+    from .. import observability as obs
+
     timeout = float(os.environ.get("S2C_LINK_PROBE_TIMEOUT_S", "20"))
     box: list = []
-    t = threading.Thread(target=_probe_into, args=(box,), daemon=True)
-    t.start()
-    t.join(timeout)
-    if t.is_alive() or not box or box[0] is None:
-        # hung (thread left blocked; it is a daemon) or raised
-        _failed = True
-        return None
-    _cached = box[0]
+    with obs.tracer().span("link_probe") as sp:
+        t = threading.Thread(target=_probe_into, args=(box,),
+                             daemon=True)
+        t.start()
+        t.join(timeout)
+        if t.is_alive() or not box or box[0] is None:
+            # hung (thread left blocked; it is a daemon) or raised
+            _failed = True
+            sp.set_args(failed=True)
+            obs.metrics().gauge("link/probe_failed").set(1.0)
+            return None
+        _cached = box[0]
+        sp.set_args(rt_sec=_cached[0], bps=_cached[1])
+    _record_link(_cached)
     return _cached
+
+
+def _record_link(probed: Tuple[float, float]) -> None:
+    """Publish the measured link constants into the CURRENT run's
+    registry — called on fresh probes AND cache hits, because every run
+    after the first gets a fresh registry while the probe result is
+    process-cached."""
+    from .. import observability as obs
+
+    reg = obs.metrics()
+    reg.gauge("link/rt_sec").set(probed[0])
+    reg.gauge("link/bps").set(probed[1])
 
 
 def _probe_into(box: list) -> None:

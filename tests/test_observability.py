@@ -1,0 +1,282 @@
+"""The observability subsystem: spans, metrics, exports, compat view.
+
+Pins the tentpole contracts (the ISSUE's acceptance list):
+
+* span nesting/ordering and thread isolation (each thread's spans carry
+  its own tid while landing in one shared list);
+* disabled-mode no-op: the tracer adds < 2% to a tight loop when off;
+* exported Chrome trace JSON is valid trace-event format (``ph``,
+  ``ts``, ``dur``, ``pid``/``tid`` on every complete event);
+* a full jax-backend run under ``--trace-out`` produces the pipeline
+  span tree and a metrics JSONL whose phase counters agree with the
+  legacy ``stats.extra`` compat view bench.py reads.
+"""
+
+import io
+import json
+import threading
+import time
+
+import pytest
+
+from sam2consensus_tpu import observability as obs
+from sam2consensus_tpu.observability.export import (chrome_trace_events,
+                                                    read_metrics_jsonl)
+from sam2consensus_tpu.observability.metrics import MetricsRegistry
+from sam2consensus_tpu.observability.trace import Tracer
+
+
+# -- tracer core -----------------------------------------------------------
+def test_span_nesting_and_ordering():
+    tr = Tracer(enabled=True)
+    with tr.span("outer", kind="phase"):
+        time.sleep(0.002)
+        with tr.span("inner"):
+            time.sleep(0.001)
+    spans = {s.name: s for s in tr.drain()}
+    outer, inner = spans["outer"], spans["inner"]
+    # inner closed first (recorded first), nested strictly inside outer
+    assert [s.name for s in tr.drain()] == ["inner", "outer"]
+    assert outer.ts_us <= inner.ts_us
+    assert inner.ts_us + inner.dur_us <= outer.ts_us + outer.dur_us + 1.0
+    assert outer.args == {"kind": "phase"}
+
+
+def test_span_events_and_args():
+    tr = Tracer(enabled=True)
+    with tr.span("phase") as sp:
+        sp.event("decision", chosen="cpu", cpu_sec=0.1)
+        sp.set_args(rows=7)
+    (s,) = tr.drain()
+    assert s.args == {"rows": 7}
+    (name, ts, args) = s.events[0]
+    assert name == "decision" and args["chosen"] == "cpu"
+    assert s.ts_us <= ts <= s.ts_us + s.dur_us
+
+
+def test_span_sync_runs_inside_span():
+    tr = Tracer(enabled=True)
+    ran = []
+    with tr.span("device", sync=lambda: (time.sleep(0.003),
+                                         ran.append(True))):
+        pass
+    (s,) = tr.drain()
+    assert ran == [True]
+    assert s.dur_us >= 2000  # the sync's sleep is inside the duration
+
+
+def test_tracer_thread_safety():
+    tr = Tracer(enabled=True)
+    # the barrier holds every worker alive until all have started, so
+    # thread idents cannot be reused (a finished thread's ident may be
+    # recycled by the OS) and the 4-distinct-tids assertion is sound
+    gate = threading.Barrier(4)
+
+    def work(i):
+        gate.wait()
+        for k in range(50):
+            with tr.span(f"t{i}", k=k):
+                pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = tr.drain()
+    assert len(spans) == 200
+    # each thread's spans carry its own tid; 4 distinct tids
+    assert len({s.tid for s in spans}) == 4
+    for name in ("t0", "t1", "t2", "t3"):
+        assert sum(1 for s in spans if s.name == name) == 50
+
+
+def test_disabled_tracer_is_noop_and_cheap():
+    tr = Tracer(enabled=False)
+    with tr.span("x") as sp:
+        sp.event("e", a=1)
+        sp.set_args(b=2)
+    tr.event("top")
+    assert tr.drain() == []
+
+    # The < 2% budget, asserted per call: a wall-clock A/B of two loops
+    # cannot resolve 2% on a shared CI host (measured noise floor here
+    # is ~±10% even on 250 us bodies), so pin the absolute no-op cost
+    # instead.  The real hot paths call span() once per BATCH/SLAB —
+    # units of >= 100 us of work (one device dispatch ~ms, one decode
+    # batch ~10 ms) — so < 2 us per disabled call IS < 2% overhead on
+    # the tightest loop that actually exists, with a big margin held
+    # back for slower hosts (measured ~0.5 us/call).
+    n = 50_000
+
+    def loop_span():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with tr.span("hot"):
+                pass
+        return time.perf_counter() - t0
+
+    def loop_empty():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            pass
+        return time.perf_counter() - t0
+
+    per_call = (min(loop_span() for _ in range(5))
+                - min(loop_empty() for _ in range(5))) / n
+    assert per_call < 2e-6, \
+        f"disabled span costs {per_call * 1e9:.0f}ns/call (budget 2000)"
+
+
+# -- metrics registry ------------------------------------------------------
+def test_registry_counters_gauges_histograms():
+    reg = MetricsRegistry()
+    reg.add("c", 2)
+    reg.add("c", 3)
+    reg.gauge("g").set(1.5)
+    reg.gauge("g").set_info({"chosen": "cpu"})
+    for v in range(100):
+        reg.observe("h", float(v))
+    snap = reg.snapshot()
+    assert snap["counters"]["c"] == 5
+    assert snap["gauges"]["g"] == {"value": 1.5,
+                                   "info": {"chosen": "cpu"}}
+    h = snap["histograms"]["h"]
+    assert h["count"] == 100 and h["min"] == 0.0 and h["max"] == 99.0
+    assert 45 <= h["p50"] <= 55 and 90 <= h["p95"] <= 99
+    assert h["p99"] >= h["p95"] >= h["p50"]
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+
+    def work():
+        for _ in range(10_000):
+            reg.add("n")
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.value("n") == 40_000
+
+
+def test_run_scope_push_pop():
+    base = obs.metrics()
+    robs = obs.start_run()
+    assert obs.metrics() is robs.registry
+    assert obs.metrics() is not base
+    obs.metrics().add("phase/x_sec", 1.0)
+    extra = {}
+    obs.publish_stats_extra(extra)
+    assert extra["x_sec"] == 1.0
+    obs.finish_run(robs)
+    assert obs.metrics() is base
+    assert not obs.tracer().enabled
+
+
+# -- exports ---------------------------------------------------------------
+def test_chrome_trace_event_format(tmp_path):
+    tr = Tracer(enabled=True)
+    tr.name_thread("main-test")
+    with tr.span("outer"):
+        with tr.span("inner", rows=3) as sp:
+            sp.event("marker", x=1)
+    path = tmp_path / "trace.json"
+    obs.write_chrome_trace(tr, str(path))
+    blob = json.loads(path.read_text())
+    events = blob["traceEvents"]
+    assert isinstance(events, list) and events
+    complete = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in complete} == {"outer", "inner"}
+    for e in complete:
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert e["dur"] >= 0 and "pid" in e and "tid" in e
+    instants = [e for e in events if e["ph"] == "i"]
+    assert any(e["name"] == "marker" and e["args"] == {"x": 1}
+               for e in instants)
+    metas = [e for e in events if e["ph"] == "M"]
+    assert any(e["args"]["name"] == "main-test" for e in metas)
+    # sorted by timestamp (Perfetto requires no particular order, but
+    # sortedness makes the artifact diffable)
+    ts = [e.get("ts", 0.0) for e in events]
+    assert ts == sorted(ts)
+
+
+def test_metrics_jsonl_roundtrip(tmp_path):
+    reg = MetricsRegistry()
+    reg.add("phase/vote_sec", 0.25)
+    reg.gauge("dispatch/tail").set_info({"chosen": "device"})
+    reg.observe("pileup/slab_sec/scatter", 0.1)
+    path = tmp_path / "m.jsonl"
+    obs.write_metrics_jsonl(reg, str(path), meta={"backend": "jax"})
+    rows = read_metrics_jsonl(str(path))
+    assert rows[0]["kind"] == "meta" and rows[0]["backend"] == "jax"
+    kinds = {r["kind"] for r in rows}
+    assert kinds == {"meta", "counter", "gauge", "histogram"}
+    gauge = next(r for r in rows if r["kind"] == "gauge")
+    assert gauge["info"] == {"chosen": "device"}
+
+
+# -- end-to-end: the pipeline's span tree + compat view --------------------
+@pytest.mark.parametrize("pileup", ["auto", "scatter"])
+def test_backend_trace_and_metrics(tmp_path, pileup):
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.io.sam import ReadStream, read_header
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+    text = simulate(SimSpec(n_contigs=2, contig_len=200, n_reads=300,
+                            read_len=40, ins_read_rate=0.2,
+                            del_read_rate=0.1, seed=11))
+    trace_path = tmp_path / f"trace_{pileup}.json"
+    metrics_path = tmp_path / f"metrics_{pileup}.jsonl"
+    cfg = RunConfig(prefix="t", backend="jax", pileup=pileup,
+                    trace_out=str(trace_path),
+                    metrics_out=str(metrics_path))
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    res = JaxBackend().run(contigs, ReadStream(handle, first), cfg)
+
+    blob = json.loads(trace_path.read_text())
+    names = {e["name"] for e in blob["traceEvents"] if e["ph"] == "X"}
+    expect = {"decode", "accumulate", "vote", "insertions", "render"}
+    assert expect <= names, names
+    if pileup == "scatter":
+        # device pileup: staged transfers, per-slab spans, and the
+        # tracing-forced accumulate barrier all appear
+        assert {"pileup_dispatch", "slab", "accumulate_sync"} <= names
+
+    rows = read_metrics_jsonl(str(metrics_path))
+    counters = {r["name"]: r["value"] for r in rows
+                if r["kind"] == "counter"}
+    assert counters["reads/mapped"] == res.stats.reads_mapped
+    assert counters["pileup/cells"] == res.stats.aligned_bases
+    # the stats.extra compat view equals the registry's rounded values
+    for key in ("accumulate_sec", "vote_sec", "insertions_sec",
+                "render_sec"):
+        assert res.stats.extra[key] == round(
+            counters[f"phase/{key}"], 4)
+    gauges = {r["name"]: r for r in rows if r["kind"] == "gauge"}
+    assert "dispatch/pileup" in gauges
+    assert res.stats.extra["pileup_path"] == \
+        gauges["dispatch/pileup"]["info"]
+
+
+def test_tail_dispatch_decision_recorded():
+    """The placement model's verdict carries its modeled inputs."""
+    from sam2consensus_tpu.backends import jax_backend as jb
+
+    robs = obs.start_run()
+    try:
+        jb._tail_cpu_wins(total_len=10_000, n_thresholds=1,
+                          upload_bytes=60_000, native_tail=False)
+        snap = robs.registry.snapshot()
+        info = snap["gauges"]["dispatch/tail"]["info"]
+        assert info["chosen"] in ("cpu", "device")
+        for k in ("cpu_sec", "chip_sec", "rt_sec", "link_bps",
+                  "upload_bytes", "total_len"):
+            assert k in info
+    finally:
+        obs.finish_run(robs)

@@ -17,7 +17,11 @@ import random
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+# hypothesis is an optional [dev] extra (pyproject.toml): collection
+# must skip, not error, on environments without it
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from sam2consensus_tpu.backends.cpu import CpuBackend
 from sam2consensus_tpu.backends.jax_backend import JaxBackend

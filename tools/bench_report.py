@@ -1,9 +1,18 @@
 #!/usr/bin/env python3
-"""Render a bench JSON line (bench.py stdout / BENCH_r*.json payload)
-as a markdown table for PERF.md — one row per config with phases and
-utilization inline.  Usage: python tools/bench_report.py <file.json>
-(accepts either the raw one-line JSON or the driver's wrapper with a
-"tail" field)."""
+"""Render bench/observability artifacts as markdown tables for PERF.md.
+
+Two input shapes, auto-detected:
+
+* a bench JSON line (bench.py stdout / BENCH_r*.json payload, or the
+  driver's wrapper with a "tail" field) — one row per config with
+  phases and utilization inline;
+* a metrics JSONL sink (the CLI's ``--metrics-out`` /
+  ``observability.write_metrics_jsonl``) — a per-phase breakdown table
+  plus counters/gauges/histograms, sourced from the registry itself
+  instead of hand-parsing ``stats.extra`` keys.
+
+Usage: python tools/bench_report.py <file.json|metrics.jsonl>
+"""
 
 import json
 import sys
@@ -11,17 +20,97 @@ import sys
 
 def load(path):
     text = open(path).read().strip()
+    first = text.splitlines()[0] if text else ""
+    try:
+        head = json.loads(first)
+    except json.JSONDecodeError:
+        head = None
+    if isinstance(head, dict) and head.get("kind") == "meta":
+        return "metrics", [json.loads(ln) for ln in text.splitlines()
+                           if ln.strip()]
     try:
         obj = json.loads(text)
     except json.JSONDecodeError:
         obj = json.loads(text.splitlines()[-1])
-    if "configs" not in obj and "tail" in obj:      # driver wrapper
-        obj = json.loads(obj["tail"].strip().splitlines()[-1])
-    return obj
+    if "configs" not in obj and isinstance(obj.get("parsed"), dict):
+        obj = obj["parsed"]                         # driver wrapper
+    elif "configs" not in obj and "tail" in obj:
+        try:
+            obj = json.loads(obj["tail"].strip().splitlines()[-1])
+        except json.JSONDecodeError:
+            sys.exit(f"{path}: driver wrapper's 'tail' capture is "
+                     "truncated and 'parsed' is empty — re-run bench.py "
+                     "for a complete JSON line")
+    return "bench", obj
 
 
-def main():
-    obj = load(sys.argv[1])
+#: phases that are SUB-WINDOWS of the accumulate wall-clock window
+#: (backends/jax_backend._run times accumulate around the whole
+#: streaming loop, which contains decode/stage/pileup dispatch —
+#: summing them with it would double-count)
+SUB_OF_ACCUMULATE = ("decode", "stage", "pileup_dispatch")
+
+
+def _fmt_val(v):
+    return f"{v:,.0f}" if float(v).is_integer() else f"{v:.4f}"
+
+
+def report_metrics(rows):
+    """Per-phase breakdown + the rest of the registry, from the JSONL
+    sink — the same numbers the stats.extra compat view exposes, read
+    from the canonical source."""
+    meta = next((r for r in rows if r.get("kind") == "meta"), {})
+    print(f"metrics sink: backend={meta.get('backend', '?')} "
+          f"pid={meta.get('pid', '?')}\n")
+    phases = dict((r["name"][len("phase/"):-len("_sec")], r["value"])
+                  for r in rows if r.get("kind") == "counter"
+                  and r["name"].startswith("phase/")
+                  and r["name"].endswith("_sec"))
+    if phases:
+        top = [(k, v) for k, v in phases.items()
+               if k not in SUB_OF_ACCUMULATE]
+        total = sum(v for _k, v in top)
+        acc = phases.get("accumulate", 0.0)
+        print("| phase | sec | % |")
+        print("|---|---|---|")
+        for name, v in top:
+            pct = 100.0 * v / total if total > 0 else 0.0
+            print(f"| {name} | {v:.4f} | {pct:.1f}% |")
+            if name == "accumulate":
+                # overlapped sub-windows, shown against their window
+                for sub in SUB_OF_ACCUMULATE:
+                    if sub in phases:
+                        sv = phases[sub]
+                        spct = 100.0 * sv / acc if acc > 0 else 0.0
+                        print(f"| &nbsp;&nbsp;↳ {sub} | {sv:.4f} "
+                              f"| {spct:.1f}% of accumulate |")
+        print(f"| **total (non-overlapping)** | **{total:.4f}** | |\n")
+    other = [r for r in rows if r.get("kind") == "counter"
+             and not r["name"].startswith("phase/")]
+    if other:
+        print("| counter | value |")
+        print("|---|---|")
+        for r in other:
+            print(f"| {r['name']} | {_fmt_val(r['value'])} |")
+        print()
+    gauges = [r for r in rows if r.get("kind") == "gauge"]
+    for r in gauges:
+        if "info" in r:
+            info = " ".join(f"{k}={v}" for k, v in r["info"].items())
+            print(f"- {r['name']}: {info}")
+        else:
+            print(f"- {r['name']}: {r['value']}")
+    hists = [r for r in rows if r.get("kind") == "histogram"]
+    if hists:
+        print("\n| histogram | count | sum | p50 | p95 | p99 |")
+        print("|---|---|---|---|---|---|")
+        for r in hists:
+            print(f"| {r['name']} | {r['count']} | {r['sum']:.4f} "
+                  f"| {r['p50']:.4g} | {r['p95']:.4g} "
+                  f"| {r['p99']:.4g} |")
+
+
+def report_bench(obj):
     print(f"device: {obj.get('device')}  headline: "
           f"{obj.get('value'):,} bases/s  vs_baseline: "
           f"{obj.get('vs_baseline')}x\n")
@@ -35,11 +124,20 @@ def main():
             continue
         ph = " ".join(f"{k.replace('_sec', '')}={v}"
                       for k, v in r.get("phases", {}).items())
-        ut = " ".join(f"{k}={v}" for k, v in r.get("util", {}).items())
+        ut = " ".join(f"{k}={v}" for k, v in r.get("util", {}).items()
+                      if not isinstance(v, dict))
         est = "~" if r.get("cpu_sec_estimated") else ""
         print(f"| {r['config']} | {r.get('reads'):,} | {r.get('jax_sec')} "
               f"| {est}{r.get('cpu_sec')} | {est}{r.get('vs_baseline')}x "
               f"| {r.get('identical', 'n/a')} | {ph} | {ut} |")
+
+
+def main():
+    kind, payload = load(sys.argv[1])
+    if kind == "metrics":
+        report_metrics(payload)
+    else:
+        report_bench(payload)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""One-shot trace triage: print the top-N widest spans from a Chrome/
+Perfetto trace-event JSON (the CLI's ``--trace-out`` artifact).
+
+Usage: python tools/trace_summary.py <trace.json> [-n TOP]
+
+Reads ``ph: "X"`` complete events, ranks by ``dur``, and prints one
+line per span with its share of the trace's wall clock — the first
+question every perf investigation asks ("where did the time go?")
+answered without opening a UI.
+"""
+
+import argparse
+import json
+import sys
+
+
+def load_events(path):
+    with open(path) as fh:
+        obj = json.load(fh)
+    events = obj["traceEvents"] if isinstance(obj, dict) else obj
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("trace", help="trace-event JSON (--trace-out output)")
+    p.add_argument("-n", "--top", type=int, default=5,
+                   help="spans to print (default 5)")
+    args = p.parse_args(argv)
+
+    spans = load_events(args.trace)
+    if not spans:
+        print("no complete spans in trace", file=sys.stderr)
+        return 1
+    wall_us = max(e["ts"] + e["dur"] for e in spans) \
+        - min(e["ts"] for e in spans)
+    spans.sort(key=lambda e: e["dur"], reverse=True)
+    print(f"{len(spans)} spans, wall {wall_us / 1e6:.4f}s — "
+          f"top {min(args.top, len(spans))} by duration:")
+    print(f"{'span':<24} {'dur_s':>10} {'% wall':>7}  args")
+    for e in spans[:args.top]:
+        arg_txt = ""
+        if e.get("args"):
+            arg_txt = " ".join(f"{k}={v}" for k, v in e["args"].items())
+        pct = 100.0 * e["dur"] / wall_us if wall_us > 0 else 0.0
+        print(f"{e['name']:<24} {e['dur'] / 1e6:>10.4f} {pct:>6.1f}%  "
+              f"{arg_txt}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
