@@ -182,7 +182,9 @@ def _tail_cpu_wins(total_len: int, n_thresholds: int,
     forced = os.environ.get("S2C_TAIL_DEVICE", "")
     if forced not in ("", "auto"):
         if forced not in ("cpu", "default"):
-            raise RuntimeError(
+            # ValueError: PASSTHROUGH to the resilience policy (config
+            # typo, not a device failure)
+            raise ValueError(
                 f"S2C_TAIL_DEVICE={forced!r}: use 'cpu' (local XLA CPU "
                 f"tail), 'default' (the accelerator), or 'auto'")
         obs.metrics().gauge("dispatch/tail").set_info(
@@ -347,10 +349,23 @@ class _Prefetcher:
                     # start this batch's h2d transfer now, overlapping the
                     # consumer's dispatch of the previous batch (the device
                     # pileup otherwise serializes transfer with dispatch on
-                    # the link); timed separately from decode
+                    # the link); timed separately from decode.  Staging is
+                    # an OPTIMIZATION, so a device failure here must not
+                    # kill the decode thread: drop staging and deliver the
+                    # batch unstaged — the consumer's own dispatch then
+                    # hits the same failure under the retry policy, which
+                    # is the layer equipped to handle it.
                     with tr.span("stage"):
                         t0 = time.perf_counter()
-                        self._stage(batch)
+                        try:
+                            self._stage(batch)
+                        except Exception as exc:
+                            self._stage = None
+                            batch.staged.clear()
+                            reg.add("resilience/stage_failures", 1)
+                            tr.event(
+                                "resilience/stage_failure",
+                                error=f"{type(exc).__name__}: {exc}")
                         reg.add("phase/stage_sec",
                                 time.perf_counter() - t0)
                 if not self._put(batch):
@@ -390,15 +405,21 @@ class JaxBackend:
         """Wrap one pipeline run in a fresh tracer + metrics registry
         (per-run, so the bench's warm/timed repetitions never bleed into
         each other), then derive the legacy ``stats.extra`` keys from
-        the registry and write any requested exports."""
+        the registry and write any requested exports.  The fault
+        injector (resilience/faultinject.py) configures here too, so
+        its per-site call counters are per-run-deterministic."""
+        from ..resilience import faultinject
+
         robs = obs.start_run(
             trace_out=getattr(cfg, "trace_out", None),
             metrics_out=getattr(cfg, "metrics_out", None))
+        faultinject.configure(getattr(cfg, "fault_inject", "") or None)
         try:
             result = self._run(contigs, records, cfg)
             obs.publish_stats_extra(result.stats.extra)
             return result
         finally:
+            faultinject.configure("")
             obs.finish_run(robs, meta={"backend": self.name})
 
     def _run(self, contigs: List[Contig], records: Iterable[SamRecord],
@@ -407,10 +428,7 @@ class JaxBackend:
         import jax
         import jax.numpy as jnp
 
-        from ..encoder.events import GenomeLayout, ReadEncoder, group_insertions
-        from ..ops import fused
-        from ..ops.cutoff import encode_thresholds
-        from ..ops.insertions import build_insertion_table, vote_insertions
+        from ..encoder.events import GenomeLayout
         from ..ops.pileup import (HostPileupAccumulator, PileupAccumulator,
                                   host_pileup_max_len)
 
@@ -589,6 +607,41 @@ class JaxBackend:
                 src,
                 stage=None if cfg.paranoid
                 else getattr(acc, "stage", None))
+
+        # the accumulate loop's failure contract (resilience/): every
+        # device dispatch runs under the retry policy; persistent
+        # failures step down the degradation ladder (kernel -> scatter
+        # -> host pileup) under --on-device-error fallback, replaying
+        # the failed slab on the demoted path and writing an emergency
+        # checkpoint at the demotion boundary
+        from ..resilience import ladder as rladder
+        from ..resilience.policy import RetryPolicy
+
+        policy = RetryPolicy.from_config(cfg)
+
+        def _emergency_ckpt(acc_):
+            # only ever called with cfg.checkpoint_dir set, which forces
+            # SERIAL decode above — so stream.n_lines is exactly the
+            # consumed batch boundary.  A prefetching run would have the
+            # decode thread up to queue-depth batches ahead of the
+            # consumer, and a checkpoint taken then would resume past
+            # decoded-but-unaccumulated batches (silent count loss).
+            self._write_checkpoint(cfg, records, acc_, encoder, stats,
+                                   base_mapped, base_skipped,
+                                   prior_sources, max_row_width)
+
+        def _rebind_stage(acc_):
+            # a demoted accumulator must also re-route (or drop) the
+            # prefetch thread's device staging — the old accumulator's
+            # stage() would keep shipping batches to the failing device
+            if isinstance(batch_iter, _Prefetcher):
+                batch_iter._stage = None if cfg.paranoid \
+                    else getattr(acc_, "stage", None)
+
+        dispatcher = rladder.ResilientDispatcher(
+            policy, layout.total_len,
+            checkpoint_cb=_emergency_ckpt if cfg.checkpoint_dir else None,
+            on_demote=_rebind_stage)
         try:
             for batch in batch_iter:
                 if cfg.paranoid:
@@ -598,7 +651,7 @@ class JaxBackend:
                                         max(batch.buckets))
                 ta = time.perf_counter()
                 with tr.span("pileup_dispatch", n_events=batch.n_events):
-                    acc.add(batch)
+                    acc = dispatcher.add(acc, batch)
                 reg.add("phase/pileup_dispatch_sec",
                         time.perf_counter() - ta)
                 stats.aligned_bases += batch.n_events
@@ -615,6 +668,13 @@ class JaxBackend:
             # input stream open
             if isinstance(batch_iter, _Prefetcher):
                 batch_iter.close()
+        if dispatcher.demotions:
+            # the ladder may have landed the run on a different rung
+            # (scatter-pinned device acc, or the host accumulator): the
+            # tail must follow the accumulator it actually has
+            stats.extra["pileup_ladder"] = rladder.pileup_level(acc)
+            use_sharded = use_sharded and not isinstance(
+                acc, HostPileupAccumulator)
         stats.reads_mapped = base_mapped + encoder.n_reads
         stats.reads_skipped = base_skipped + encoder.n_skipped
         reg.add("reads/mapped", encoder.n_reads)
@@ -640,6 +700,124 @@ class JaxBackend:
         if ck is not None and "incremental_base" not in stats.extra:
             stats.extra["resumed_from_line"] = ck.lines_consumed
 
+        # Post-accumulation tail: ONE device round trip computing vote +
+        # insertion table + stats (moved to _tail_attempt; the original
+        # wire-cost rationale lives in its body).  The tail is a pure
+        # function of the accumulated counts, so the retry policy can
+        # recompute it whole on a transient device failure; a
+        # persistent failure demotes it host-side (resilience/ladder:
+        # emergency checkpoint first, then cpu-committed counts and the
+        # link-free tail), with injection suppressed on the demoted
+        # attempt -- the host rung is the ladder's bottom.
+        demoted_tail = False
+        while True:
+            try:
+                (syms, ins_syms, contig_sums, site_cov, ins, out,
+                 link_free) = policy.run(
+                    lambda: self._tail(acc, cfg, layout, encoder, stats,
+                                       use_sharded,
+                                       suppress_faults=demoted_tail),
+                    site="tail")
+                break
+            except BaseException as exc:
+                from ..resilience.policy import PASSTHROUGH, classify
+
+                if (demoted_tail or classify(exc) == PASSTHROUGH
+                        or policy.on_error != "fallback"):
+                    raise
+                acc = rladder.demote_tail_and_record(
+                    acc, layout.total_len, exc,
+                    checkpoint_cb=_emergency_ckpt
+                    if cfg.checkpoint_dir else None)
+                use_sharded = False
+                demoted_tail = True
+        # wire accounting (bench utilization rows): bytes shipped up during
+        # accumulation and fetched back by the fused tail
+        stats.extra["h2d_bytes"] = int(getattr(acc, "bytes_h2d", 0))
+        if use_sharded:
+            stats.extra["d2h_bytes"] = int(
+                syms.nbytes + (ins_syms.nbytes if ins_syms is not None
+                               else 0))
+        else:
+            # a link-free tail never crosses the link: keep the wire
+            # accounting symmetric with the suppressed h2d side.  The
+            # native tail fetches no packed buffer at all (out stays
+            # None).
+            stats.extra["d2h_bytes"] = \
+                0 if (link_free or out is None) else int(out.nbytes)
+        reg.add("wire/h2d_bytes", stats.extra["h2d_bytes"])
+        reg.add("wire/d2h_bytes", stats.extra["d2h_bytes"])
+        if getattr(acc, "strategy_used", None):
+            # refresh: the host-counts path records its wire dtype at upload
+            stats.extra["pileup"] = dict(acc.strategy_used)
+        if cfg.paranoid:
+            self._paranoid_result(acc, contig_sums, layout, stats,
+                                  ins=ins, site_cov=site_cov)
+
+        t0 = time.perf_counter()
+        with tr.span("render"):
+            fastas = self._assemble(layout, syms, contig_sums, ins,
+                                    ins_syms, site_cov, cfg, stats)
+        reg.add("phase/render_sec", time.perf_counter() - t0)
+
+        if cfg.checkpoint_dir:
+            from ..utils import checkpoint as ckpt
+
+            if getattr(cfg, "incremental", False):
+                # incremental: the checkpoint IS the accumulated base for
+                # the next shard — persist the final state, and record this
+                # input as FULLY absorbed so a later rerun of it (even with
+                # other shards in between) adds nothing
+                done = list(prior_sources)
+                if source_id and source_id not in done:
+                    done.append(source_id)
+                self._write_checkpoint(cfg, records, acc, encoder, stats,
+                                       base_mapped, base_skipped, done,
+                                       max_row_width)
+            else:
+                # a completed run invalidates its checkpoint: remove it so
+                # a rerun starts from scratch, not replaying a finished job
+                p = ckpt.path_for(cfg.checkpoint_dir)
+                if os.path.exists(p):
+                    os.unlink(p)
+        return BackendResult(fastas=fastas, stats=stats)
+
+    # -- post-accumulation tail (resilient) --------------------------------
+    def _tail(self, acc, cfg: RunConfig, layout, encoder, stats,
+              use_sharded: bool, suppress_faults: bool = False):
+        """One attempt of the post-accumulation tail; returns
+        ``(syms, ins_syms, contig_sums, site_cov, ins, out, link_free)``.
+
+        Pure with respect to the accumulated counts (it mutates nothing
+        a subsequent attempt reads), which is what makes the resilience
+        layer's retry/demote loop in ``_run`` sound: a transient device
+        failure recomputes the whole tail, a ladder demotion re-runs it
+        against host-committed counts.  ``suppress_faults`` exempts the
+        demoted attempt from fault injection (the host rung is the
+        ladder's bottom; resilience/faultinject.py)."""
+        from ..resilience import faultinject
+
+        if suppress_faults:
+            with faultinject.suppress():
+                return self._tail_attempt(acc, cfg, layout, encoder,
+                                          stats, use_sharded)
+        return self._tail_attempt(acc, cfg, layout, encoder, stats,
+                                  use_sharded)
+
+    def _tail_attempt(self, acc, cfg: RunConfig, layout, encoder, stats,
+                      use_sharded: bool):
+        import jax
+        import jax.numpy as jnp
+
+        from ..encoder.events import group_insertions
+        from ..ops import fused
+        from ..ops.cutoff import encode_thresholds
+        from ..ops.insertions import build_insertion_table, vote_insertions
+        from ..ops.pileup import HostPileupAccumulator
+        from ..resilience.faultinject import fault_check
+
+        tr = obs.tracer()
+        reg = obs.metrics()
         # Post-accumulation tail in ONE device round trip (a dispatch→fetch
         # costs ~65 ms on the tunneled chip and the link moves ~40 MB/s —
         # tools/tunnel_probe.py): the host groups insertion events, then a
@@ -726,6 +904,7 @@ class JaxBackend:
         tr.complete("insertions", t0)
 
         t0 = time.perf_counter()
+        fault_check("vote")
         # output-encoding gate: the position symbols can travel dense
         # ASCII (T*L bytes), 5-bit packed (0.625 B/char — the vote's
         # whole alphabet is 32 symbols, constants.SYM32_ASCII), or sparse
@@ -740,12 +919,16 @@ class JaxBackend:
         sparse_cap = fused.pad_cap(
             min(total_len, max(1, stats.aligned_bases)) + 1)
         if "S2C_SPARSE_OUTPUT" in os.environ:
-            raise RuntimeError(
+            # ValueError, not RuntimeError: config typos are PASSTHROUGH
+            # to the resilience policy — retrying/demoting a tail that
+            # failed env validation would record a phantom recovery and
+            # then die with the same error anyway
+            raise ValueError(
                 "S2C_SPARSE_OUTPUT was renamed: use "
                 "S2C_TAIL_ENCODING=auto|dense|sparse|packed5")
         enc_mode = os.environ.get("S2C_TAIL_ENCODING", "auto")
         if enc_mode not in ("auto", "dense", "sparse", "packed5"):
-            raise RuntimeError(
+            raise ValueError(
                 f"S2C_TAIL_ENCODING={enc_mode!r}: use "
                 f"auto|dense|sparse|packed5")
         link_free = tail_dev is not None or jax.default_backend() == "cpu"
@@ -758,6 +941,7 @@ class JaxBackend:
             out_enc = {"dense": None, "packed5": "packed5",
                        "sparse": sparse_cap}[enc_mode]
         if ins is not None:
+            fault_check("insertion_build")
             k = len(ins["key_flat"])
             # pad sites and columns to powers of two: pad events scatter
             # into the sacrificial last row (kp > k always), pad columns
@@ -955,56 +1139,8 @@ class JaxBackend:
         # without an extra barrier
         reg.add("phase/vote_sec", time.perf_counter() - t0)
         tr.complete("vote", t0)
-        # wire accounting (bench utilization rows): bytes shipped up during
-        # accumulation and fetched back by the fused tail
-        stats.extra["h2d_bytes"] = int(getattr(acc, "bytes_h2d", 0))
-        if use_sharded:
-            stats.extra["d2h_bytes"] = int(
-                syms.nbytes + (ins_syms.nbytes if ins_syms is not None
-                               else 0))
-        else:
-            # a link-free tail never crosses the link: keep the wire
-            # accounting symmetric with the suppressed h2d side.  The
-            # native tail fetches no packed buffer at all (out stays
-            # None).
-            stats.extra["d2h_bytes"] = \
-                0 if (link_free or out is None) else int(out.nbytes)
-        reg.add("wire/h2d_bytes", stats.extra["h2d_bytes"])
-        reg.add("wire/d2h_bytes", stats.extra["d2h_bytes"])
-        if getattr(acc, "strategy_used", None):
-            # refresh: the host-counts path records its wire dtype at upload
-            stats.extra["pileup"] = dict(acc.strategy_used)
-        if cfg.paranoid:
-            self._paranoid_result(acc, contig_sums, layout, stats,
-                                  ins=ins, site_cov=site_cov)
-
-        t0 = time.perf_counter()
-        with tr.span("render"):
-            fastas = self._assemble(layout, syms, contig_sums, ins,
-                                    ins_syms, site_cov, cfg, stats)
-        reg.add("phase/render_sec", time.perf_counter() - t0)
-
-        if cfg.checkpoint_dir:
-            from ..utils import checkpoint as ckpt
-
-            if getattr(cfg, "incremental", False):
-                # incremental: the checkpoint IS the accumulated base for
-                # the next shard — persist the final state, and record this
-                # input as FULLY absorbed so a later rerun of it (even with
-                # other shards in between) adds nothing
-                done = list(prior_sources)
-                if source_id and source_id not in done:
-                    done.append(source_id)
-                self._write_checkpoint(cfg, records, acc, encoder, stats,
-                                       base_mapped, base_skipped, done,
-                                       max_row_width)
-            else:
-                # a completed run invalidates its checkpoint: remove it so
-                # a rerun starts from scratch, not replaying a finished job
-                p = ckpt.path_for(cfg.checkpoint_dir)
-                if os.path.exists(p):
-                    os.unlink(p)
-        return BackendResult(fastas=fastas, stats=stats)
+        return (syms, ins_syms, contig_sums, site_cov, ins, out,
+                link_free)
 
     # -- sharded-accumulator construction ---------------------------------
     @staticmethod

@@ -142,6 +142,35 @@ def build_parser() -> argparse.ArgumentParser:
                    help="data-parallel shards for the jax backend; 0 = all devices")
     p.add_argument("--chunk-reads", dest="chunk_reads", type=int, default=262144,
                    help="reads per host->device batch (jax backend)")
+    # --- resilience (sam2consensus_tpu/resilience/) ---
+    p.add_argument("--retries", type=int, default=3,
+                   help="transient device-failure re-attempts per dispatch "
+                        "(RPC/link/timeout errors; exponential backoff + "
+                        "seeded jitter); default=3")
+    p.add_argument("--retry-backoff", dest="retry_backoff", type=float,
+                   default=0.25,
+                   help="base backoff seconds between retries (doubles per "
+                        "attempt, capped at 8 s); default=0.25")
+    p.add_argument("--on-device-error", dest="on_device_error",
+                   choices=["fail", "retry", "fallback"], default="retry",
+                   help="mid-run device failure policy: fail (raise "
+                        "immediately), retry (transient errors retry, OOM "
+                        "splits the slab, then raise), or fallback (after "
+                        "retries, step down the degradation ladder — device "
+                        "kernel -> scatter -> host pileup, device tail -> "
+                        "host tail — writing an emergency checkpoint at "
+                        "each demotion; counts are never lost). Env "
+                        "S2C_ON_DEVICE_ERROR overrides. default=retry")
+    p.add_argument("--fault-inject", dest="fault_inject", default="",
+                   help="deterministic fault injection for the device path "
+                        "(tests/chaos): comma-separated "
+                        "site:kind:after_n[:times] specs — sites "
+                        "device_put|pileup_dispatch|accumulate|vote|"
+                        "insertion_build|link_probe, kinds rpc|timeout|oom|"
+                        "fatal|trace, after_n an integer call count or "
+                        "pP probability (seeded by S2C_FAULT_SEED), times "
+                        "an integer or inf. Env S2C_FAULT_INJECT also "
+                        "activates it")
     return p
 
 
@@ -200,6 +229,10 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         source_id=os.path.abspath(args.filename),
         shards=args.shards,
         shard_mode=args.shard_mode,
+        retries=args.retries,
+        retry_backoff=args.retry_backoff,
+        on_device_error=args.on_device_error,
+        fault_inject=args.fault_inject,
     )
 
 
@@ -245,6 +278,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         raise SystemExit("--checkpoint-dir requires --backend jax")
     if cfg.incremental and not cfg.checkpoint_dir:
         raise SystemExit("--incremental requires --checkpoint-dir")
+    if cfg.fault_inject:
+        # validate up front: a typo'd spec must fail the run, not
+        # silently inject nothing
+        from .resilience.faultinject import parse_spec
+
+        try:
+            parse_spec(cfg.fault_inject)
+        except ValueError as exc:
+            raise SystemExit(f"error: {exc}") from None
 
     t0 = time.perf_counter()
     echo("\nProcessing file " + args.filename + ":\n")
@@ -314,9 +356,19 @@ def _accelerator_client_live() -> bool:
     actually initialized this process — the only case where interpreter
     teardown can abort in the client's C++ destructors ("FATAL: exception
     not rethrown", exit 134).  Introspects jax's backend cache without
-    triggering initialization; an unreadable cache counts as live (the
-    conservative side is skipping destructors, not crashing).  Override
-    with S2C_SAFE_EXIT=0 (never os._exit) / =1 (always)."""
+    triggering initialization: public accessors first (``jax.extend.
+    backend`` — nothing there enumerates without initializing today,
+    but ``backends_are_initialized`` may surface publicly; probing the
+    public namespace first means a future jax keeps working when the
+    private module moves), then ``jax._src.xla_bridge``'s
+    ``backends_are_initialized()`` + ``_backends`` cache.  The private
+    attribute is pinned by tests/test_cli.py
+    ``test_xla_bridge_private_surface_still_exists`` so a jax upgrade
+    that drops it fails the suite loudly instead of silently flipping
+    CPU-only runs onto the conservative ``os._exit`` branch (ADVICE r5
+    #3).  An unreadable cache counts as live (the conservative side is
+    skipping destructors, not crashing).  Override with S2C_SAFE_EXIT=0
+    (never os._exit) / =1 (always)."""
     import os as _os
 
     env = _os.environ.get("S2C_SAFE_EXIT")
@@ -326,8 +378,21 @@ def _accelerator_client_live() -> bool:
     if jax_mod is None:
         return False
     try:
+        inited = None
+        try:                          # public namespace first
+            from jax.extend import backend as jex_backend
+
+            inited = getattr(jex_backend, "backends_are_initialized",
+                             None)
+        except ImportError:
+            pass
         from jax._src import xla_bridge
 
+        if inited is None:
+            inited = getattr(xla_bridge, "backends_are_initialized",
+                             None)
+        if inited is not None and not inited():
+            return False              # no client exists at all
         return any(p != "cpu" for p in xla_bridge._backends)
     except Exception:
         return True

@@ -41,6 +41,12 @@ class RunConfig:
     shard_mode: str = "auto"     # auto | dp | sp | dpsp (accumulator layout)
     incremental: bool = False    # keep/extend checkpoints across input files
     source_id: str = ""          # identity of the input (for incremental)
+    # --- resilience (sam2consensus_tpu/resilience/) ---
+    retries: int = 3             # transient-failure re-attempts per dispatch
+    retry_backoff: float = 0.25  # base backoff seconds (exp + jitter)
+    on_device_error: str = "retry"   # fail | retry | fallback (ladder)
+    fault_inject: str = ""       # fault spec (tests/chaos; also env
+    #                              S2C_FAULT_INJECT), see resilience/faultinject
     chunk_reads: int = 262144    # reads per host->device batch (jax backend)
     profile_dir: Optional[str] = None
     json_metrics: Optional[str] = None

@@ -111,6 +111,14 @@ CODE_TO_BASE = np.frombuffer(ALPHABET.encode("ascii"), dtype=np.uint8).copy()
 #: permissive mode skips the read).
 PAD_CODE = 255
 
+#: largest position window the sp window strategy will materialize per
+#: device ([Wp, 6] int32 local + one psum of the same size over ICI).
+#: Lives here — the package's jax-free constants module — because it is
+#: shared by ``parallel.sp.PositionShardedConsensus`` (the strategy) and
+#: ``parallel.auto`` (the pure cost model, which must mirror the window
+#: gate without importing sp's jax machinery; ADVICE r5 #4).
+SP_WINDOW_CAP = 1 << 21
+
 # -- 5-bit output symbol space -------------------------------------------
 #
 # The vote emits exactly 32 distinct bytes: the FILL sentinel (0), '-',

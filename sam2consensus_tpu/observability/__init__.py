@@ -130,6 +130,11 @@ def publish_stats_extra(extra: dict) -> None:
         # the cpu oracle's reformat/consensus phases ride the same view
         if name.startswith("phase/") and name.endswith("_sec"):
             extra[name[len("phase/"):]] = round(value, 4)
+        # the recovery story (retries, demotions, emergency checkpoints,
+        # injected faults) rides into --json-metrics/bench rows too, so
+        # a degraded run is visible from any artifact
+        elif name.startswith(("resilience/", "fault/")):
+            extra[name] = int(value)
     for gauge_name, extra_key in (("dispatch/tail", "tail_dispatch"),
                                   ("dispatch/pileup", "pileup_path")):
         g = snap["gauges"].get(gauge_name)

@@ -32,6 +32,7 @@ import numpy as np
 from .. import observability as obs
 from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import SegmentBatch
+from ..resilience.faultinject import fault_check
 
 
 #: cap on expanded scatter cells (rows x width) per device call, bounding the
@@ -379,6 +380,7 @@ class HostPileupAccumulator:
 
         if self._device_counts is None:
             with obs.tracer().span("counts_upload"):
+                fault_check("device_put")
                 it = self.wire_itemsize()
                 if it == 4:    # already int32: ship the buffer, no copy
                     arr = self._counts
@@ -386,10 +388,13 @@ class HostPileupAccumulator:
                     arr = self._counts.astype(np.uint8 if it == 1
                                               else np.uint16)
                 self.strategy_used["host_wire_dtype"] = str(arr.dtype)
-                if self.tail_device is None:
-                    self.bytes_h2d += arr.nbytes   # real wire bytes
                 self._device_counts = jax.device_put(arr,
                                                      self.tail_device)
+                if self.tail_device is None:
+                    # bill the wire AFTER the put: a retried upload
+                    # (transient transfer failure under the resilience
+                    # policy) must not double-count the tensor
+                    self.bytes_h2d += arr.nbytes   # real wire bytes
         return self._device_counts
 
     def counts_host(self) -> np.ndarray:
@@ -401,6 +406,13 @@ class HostPileupAccumulator:
         self._counts[:] = np.asarray(counts, dtype=np.int32)
         self._device_counts = None
         self._wire_itemsize = None
+
+    def invalidate_upload(self) -> None:
+        """Drop any cached device copy of the counts — a tail demotion
+        (resilience/ladder.py) re-routes the upload to ``tail_device``,
+        and a cached default-device array would silently pin the fused
+        tail back on the path that just failed."""
+        self._device_counts = None
 
 
 def run_tuned_slab(tuner, static_choice: str, n_rows: int, width: int,
@@ -542,7 +554,13 @@ class PileupAccumulator:
         this batch's h2d transfer with the consumer's dispatch of the
         PREVIOUS batch — the transfers otherwise serialize on the link,
         which round-3 bench profiles showed capping the device pileup at
-        ~half the link rate (ecoli `pileup_dispatch_sec`)."""
+        ~half the link rate (ecoli `pileup_dispatch_sec`).
+
+        A device failure here (the ``device_put`` injection site) is
+        caught by the prefetcher, which disables staging and delivers
+        the batch unstaged — the consumer's own transfer then meets the
+        same failure under the retry policy (resilience/)."""
+        fault_check("device_put")
         for w, (starts, codes) in batch.buckets.items():
             packed = pack_nibbles(codes)
             batch.staged[w] = (jax.device_put(starts, self.device),
@@ -552,6 +570,7 @@ class PileupAccumulator:
     def add(self, batch: SegmentBatch) -> None:
         from . import mxu_pileup, pallas_pileup
 
+        fault_check("pileup_dispatch")
         kernel_name = (self._tuner.kernel if self._tuner is not None
                        else self.strategy)
         for w, (starts, codes) in sorted(batch.buckets.items()):
@@ -584,6 +603,7 @@ class PileupAccumulator:
                     st, pk, nbytes = staged
                     self.bytes_h2d += nbytes
                     return st, pk
+                fault_check("device_put")
                 packed = pack_nibbles(codes)
                 self.bytes_h2d += starts.nbytes + packed.nbytes
                 return jnp.asarray(starts), jnp.asarray(packed)
@@ -629,6 +649,15 @@ class PileupAccumulator:
 
             def plan_pallas():
                 if n_rows == 0:
+                    return None
+                if w % 2:
+                    # odd widths widen under the nibble wire (pack_nibbles
+                    # appends a PAD column, so unpack returns W+1 columns)
+                    # and would shape-mismatch the kernel at trace time;
+                    # scatter handles them — same guard as the sp/dpsp
+                    # routers' _routed_kernel_add.  Encoder buckets are
+                    # even today; this covers a future odd halo-split
+                    # bucket reaching the single-device path.
                     return None
                 if pallas_pileup._cw(w) * 2 > self._pallas_tile:
                     return None        # overhang carry needs W <= TP/2

@@ -39,6 +39,13 @@ import numpy as np
 #: int32 count-lane bytes per genome position ([*, 6] int32)
 _POS_BYTES = 24
 
+#: sp's window-strategy position cap — the ONE shared definition
+#: (constants.SP_WINDOW_CAP, also PositionShardedConsensus.WINDOW_CAP);
+#: a drifted copy here would mis-model which slabs the window path
+#: absorbs.  Imported from the jax-free constants module so the pure
+#: cost model stays jax-free (ADVICE r5 #4).
+from ..constants import SP_WINDOW_CAP as _WINDOW_CAP  # noqa: E402
+
 
 def _ici_bps() -> float:
     """Per-device collective bandwidth for reduce-scatter terms.  The
@@ -104,7 +111,7 @@ def slab_stats(buckets, total_len: int) -> tuple:
         span = float(s.max()) + w - float(s.min())
         wp = 1 << max(10, int(span - 1).bit_length())
         if (wp * _POS_BYTES <= 16 * len(s) * w
-                and wp <= min(1 << 21, total_len)):
+                and wp <= min(_WINDOW_CAP, total_len)):
             window_rows += len(s)
         idx = (s / scale * 63).astype(np.int64)
         bins += np.bincount(np.clip(idx, 0, 63), minlength=64)
@@ -147,7 +154,7 @@ def choose_shard_mode(total_len: int, n_devices: int, mesh_shape: dict,
     # for sp's n devices, bounded by n_sp macro blocks for dpsp
     infl_sp = max(0.0, min(peak_frac * n, n) - 1.0)
     infl_dpsp = max(0.0, min(peak_frac * n_sp, n_sp) - 1.0)
-    window = sorted_frac * min(padded, 1 << 21) * _POS_BYTES / ici
+    window = sorted_frac * min(padded, _WINDOW_CAP) * _POS_BYTES / ici
     cost_sp = (_SP_FIXED_SEC + window
                + rows * unsorted / route
                + rb * unsorted * infl_sp / link_bps

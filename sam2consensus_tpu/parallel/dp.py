@@ -229,7 +229,9 @@ class ShardedConsensus(ShardedCountsBase):
     # -- streaming input --------------------------------------------------
     def add(self, batch: SegmentBatch) -> None:
         from ..ops.pileup import run_tuned_slab
+        from ..resilience.faultinject import fault_check
 
+        fault_check("pileup_dispatch")
         kernel_name = (self._tuner.kernel if self._tuner is not None
                        else self.pileup)
         for w, (starts, codes) in sorted(batch.buckets.items()):

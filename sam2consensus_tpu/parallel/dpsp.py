@@ -247,6 +247,9 @@ class ProductShardedConsensus(ShardedCountsBase):
 
     # -- streaming input --------------------------------------------------
     def add(self, batch: SegmentBatch) -> None:
+        from ..resilience.faultinject import fault_check
+
+        fault_check("pileup_dispatch")
         for w, (starts, codes) in sorted(batch.buckets.items()):
             t0 = time.perf_counter()
             starts = np.asarray(starts)

@@ -54,7 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..constants import NUM_SYMBOLS, PAD_CODE
+from ..constants import NUM_SYMBOLS, PAD_CODE, SP_WINDOW_CAP
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
                           pack_nibbles, round_rows_grid, unpack_nibbles)
@@ -74,8 +74,10 @@ class PositionShardedConsensus(ShardedCountsBase):
     """
 
     #: largest position window the window strategy will materialize per
-    #: device ([Wp, 6] int32 local + one psum of the same size over ICI)
-    WINDOW_CAP = 1 << 21
+    #: device ([Wp, 6] int32 local + one psum of the same size over ICI);
+    #: the shared definition lives in constants.SP_WINDOW_CAP so the
+    #: parallel.auto cost model can mirror it without importing jax
+    WINDOW_CAP = SP_WINDOW_CAP
 
     def __init__(self, mesh, total_len: int, halo: int = 1 << 16,
                  pileup: str = "scatter"):
@@ -282,6 +284,9 @@ class PositionShardedConsensus(ShardedCountsBase):
 
     # -- streaming input --------------------------------------------------
     def add(self, batch: SegmentBatch) -> None:
+        from ..resilience.faultinject import fault_check
+
+        fault_check("pileup_dispatch")
         for w, (starts, codes) in sorted(batch.buckets.items()):
             t0 = time.perf_counter()
             starts = np.asarray(starts)

@@ -1,0 +1,43 @@
+"""Resilience subsystem: the device path's failure contract.
+
+The north-star is a production service on flaky infrastructure — round
+5's own measurement campaign died when the TPU tunnel dropped mid-sweep.
+The count tensor is fully sum-decomposable and checkpointable
+(utils/checkpoint.py), so no mid-run device failure has to be terminal;
+this package threads one consistent failure contract through every
+device-touching layer:
+
+* :mod:`.policy` — exception classification (transient / capacity /
+  fatal / passthrough) and configurable retry with exponential backoff
+  + deterministic jitter and optional per-attempt deadlines;
+* :mod:`.ladder` — the graceful-degradation ladder: device kernel →
+  device scatter → host pileup for accumulation, and device tail →
+  host-routed tail, demoting MID-RUN without losing accumulated counts
+  and writing an emergency checkpoint at each demotion boundary;
+* :mod:`.faultinject` — deterministic, seed-addressable fault injection
+  (``--fault-inject site:kind:after_n[:times]`` / ``S2C_FAULT_INJECT``)
+  used by tests and the campaign's chaos bench leg.
+
+Every retry, demotion, and emergency checkpoint is emitted as a
+structured observability event/counter (``resilience/*`` and
+``fault/*``), so ``--metrics-out`` / ``--trace-out`` show the full
+recovery story.
+
+This module deliberately imports only :mod:`.policy` and
+:mod:`.faultinject` (both jax-free); :mod:`.ladder` is imported as a
+submodule by its consumers to keep ``ops.pileup`` ↔ ``resilience``
+import-cycle-free.
+"""
+
+from __future__ import annotations
+
+from . import faultinject, policy
+from .faultinject import FaultInjector, fault_check
+from .policy import (CAPACITY, FATAL, PASSTHROUGH, TRANSIENT, RetryPolicy,
+                     RetriesExhausted, classify)
+
+__all__ = [
+    "faultinject", "policy", "FaultInjector", "fault_check",
+    "RetryPolicy", "RetriesExhausted", "classify",
+    "TRANSIENT", "CAPACITY", "FATAL", "PASSTHROUGH",
+]

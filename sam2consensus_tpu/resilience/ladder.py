@@ -1,0 +1,325 @@
+"""Graceful-degradation ladder for the device path.
+
+When retries can't fix it, demote it: the count tensor is a
+sum-decomposable sufficient statistic, so at any point the accumulated
+state can be fetched off the failing path and the run continued on a
+simpler one without losing a single counted base.
+
+Accumulation rungs (top = fastest, bottom = most survivable)::
+
+    device kernel (pallas / mxu / autotune)
+      └─> device scatter        (same accumulator, kernel pinned off)
+            └─> host pileup     (native C++ slab walk; no device at all)
+
+Tail rungs::
+
+    device fused tail  ──>  host-routed tail (cpu-committed counts;
+                            native C++ vote when the library loads,
+                            the XLA CPU fused tail otherwise)
+
+Demotion protocol (ResilientDispatcher.add / the backend's tail loop):
+
+1. the failing dispatch UNIT — one width bucket, or one half of a
+   capacity split; the same granularity at which the accumulators
+   commit — has made no committed contribution (injection sites raise
+   before dispatch; real transport errors mean the op never landed —
+   see the exactness note below);
+2. the accumulator demotes: kernel rungs mutate the existing
+   accumulator in place; the host rung fetches ``counts_host()`` into a
+   :class:`~..ops.pileup.HostPileupAccumulator`;
+3. ONLY the failed unit replays on the demoted path — units of the
+   batch that already committed are never re-dispatched;
+4. an EMERGENCY CHECKPOINT is written once the whole batch has landed
+   (the first consistent batch boundary), so a hard crash during the
+   degraded remainder still resumes — and the demotion itself is
+   durable evidence in the metrics/trace exports
+   (``resilience/demotions``, ``resilience/emergency_checkpoints``).
+
+Exactness note: retries and demotions are exact for every injected
+fault (sites raise before side effects, and the retry/replay unit
+matches the commit unit) and for transport failures where the dispatch
+never committed.  A REAL device failure that lands mid-UNIT (a bucket
+whose scatter ran some row slices before dying) can still double-count
+that unit's committed slices on replay; the paranoid-mode invariants
+(``--paranoid``) detect exactly that, and the emergency checkpoint
+keeps the blast radius to one bucket.  True exactly-once under
+arbitrary mid-unit loss would need per-slice idempotence tokens —
+out of scope here and called out in README "Failure semantics".
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from .. import observability as obs
+from . import faultinject
+from .policy import PASSTHROUGH, RetryPolicy, classify
+
+#: smallest bucket-row count a capacity split will produce; below this
+#: an OOM is not a batch-size problem and demotion is the answer
+MIN_SPLIT_ROWS = 8
+
+
+def _record_demotion(stage: str, frm: str, to: str, reason: str,
+                     checkpointed: bool) -> None:
+    reg = obs.metrics()
+    reg.add("resilience/demotions", 1)
+    reg.add(f"resilience/demotions/{stage}", 1)
+    reg.gauge(f"resilience/ladder/{stage}").set_info(
+        {"from": frm, "to": to, "reason": reason,
+         "emergency_checkpoint": bool(checkpointed)})
+    obs.tracer().event("resilience/demotion", stage=stage,
+                       **{"from": frm, "to": to}, reason=reason,
+                       emergency_checkpoint=bool(checkpointed))
+
+
+def pileup_level(acc) -> str:
+    """Name the accumulation rung ``acc`` currently sits on."""
+    from ..ops.pileup import HostPileupAccumulator, PileupAccumulator
+
+    if isinstance(acc, HostPileupAccumulator):
+        return "host"
+    if isinstance(acc, PileupAccumulator):
+        strat = acc.strategy
+    else:                           # sharded accumulators (parallel/*)
+        strat = getattr(acc, "pileup", "scatter")
+    if strat == "scatter" and getattr(acc, "_tuner", None) is None:
+        return "device_scatter"
+    return f"device_{strat}"
+
+
+def demote_pileup(acc, total_len: int) -> Tuple[Optional[object], str]:
+    """One rung down; returns ``(new_acc, level)`` or ``(None, "")``
+    when already on the bottom rung (host)."""
+    from ..ops.pileup import HostPileupAccumulator, PileupAccumulator
+
+    if isinstance(acc, HostPileupAccumulator):
+        return None, ""
+    # rung 1: pin the device kernel off — the autotuner and any explicit
+    # pallas/mxu choice demote to the plain XLA scatter (a trace/compile
+    # failure in a kernel must not kill the run when scatter would work)
+    if isinstance(acc, PileupAccumulator):
+        if acc.strategy != "scatter" or acc._tuner is not None:
+            acc.strategy = "scatter"
+            acc._tuner = None
+            return acc, "device_scatter"
+    elif getattr(acc, "pileup", "scatter") != "scatter" \
+            or getattr(acc, "_tuner", None) is not None:
+        acc.pileup = "scatter"
+        acc._tuner = None
+        return acc, "device_scatter"
+    # rung 2: off the device entirely — fetch the accumulated counts
+    # (sum-decomposable state, exact at any boundary) into the host
+    # accumulator; the remainder of the stream accumulates at native
+    # memory speed and the tail routes host-side
+    host = HostPileupAccumulator(total_len)
+    host.set_counts(np.asarray(acc.counts_host(), dtype=np.int32))
+    # carry the wire accounting: the pre-demotion transfers happened and
+    # must stay in the run's h2d bill
+    host.bytes_h2d = int(getattr(acc, "bytes_h2d", 0))
+    return host, "host"
+
+
+def demote_tail(acc, total_len: int):
+    """Demote the TAIL off the device: host-committed counts routed to
+    the local XLA CPU backend (or the native C++ vote, which the
+    link-free tail path picks on its own when the library loads).
+    Returns the (possibly new) accumulator."""
+    import jax
+
+    from ..ops.pileup import HostPileupAccumulator
+
+    if not isinstance(acc, HostPileupAccumulator):
+        host = HostPileupAccumulator(total_len)
+        host.set_counts(np.asarray(acc.counts_host(), dtype=np.int32))
+        host.bytes_h2d = int(getattr(acc, "bytes_h2d", 0))
+        acc = host
+    acc.invalidate_upload()            # drop any default-device upload
+    if jax.default_backend() != "cpu":
+        try:
+            cpus = jax.devices("cpu")
+            acc.tail_device = cpus[0] if cpus else None
+        except RuntimeError:
+            acc.tail_device = None
+    return acc
+
+
+def demote_tail_and_record(acc, total_len: int, exc: BaseException,
+                           checkpoint_cb: Optional[Callable] = None):
+    """Tail demotion with the full recovery story recorded: emergency
+    checkpoint FIRST (the accumulate phase is complete, so the current
+    counts are a consistent boundary — persist them before touching
+    anything), then route the tail host-side.  Returns the (possibly
+    new) accumulator; the caller re-runs the tail with injection
+    suppressed (the host rung is the ladder's bottom)."""
+    checkpointed = False
+    if checkpoint_cb is not None:
+        checkpoint_cb(acc)
+        checkpointed = True
+        obs.metrics().add("resilience/emergency_checkpoints", 1)
+        obs.tracer().event("resilience/emergency_checkpoint",
+                           stage="tail", level="host")
+    acc = demote_tail(acc, total_len)
+    _record_demotion("tail", "device", "host",
+                     f"{type(exc).__name__}: {exc}", checkpointed)
+    return acc
+
+
+def split_batch(batch):
+    """Split a SegmentBatch's buckets in half row-wise (capacity/OOM
+    recovery: the halves dispatch as two smaller slabs).  Staged device
+    operands are dropped — they belong to the failing dispatch.
+    Returns a list of 1-2 batches (1 when nothing is splittable)."""
+    from ..encoder.events import SegmentBatch
+
+    halves = ({}, {})
+    splittable = False
+    for w, (starts, codes) in batch.buckets.items():
+        n = len(starts)
+        if n >= 2 * MIN_SPLIT_ROWS:
+            mid = n // 2
+            halves[0][w] = (starts[:mid], codes[:mid])
+            halves[1][w] = (starts[mid:], codes[mid:])
+            splittable = True
+        else:
+            halves[0][w] = (starts, codes)
+    if not splittable:
+        return [batch]
+    return [SegmentBatch(buckets=h, n_reads=0, n_events=0)
+            for h in halves if h]
+
+
+class ResilientDispatcher:
+    """The accumulate loop's failure contract, in one place.
+
+    ``add(acc, batch)`` dispatches one batch under the retry policy and
+    returns the accumulator to use from now on (the same object, or the
+    demoted one).  ``checkpoint_cb(acc)`` — when provided — persists an
+    emergency checkpoint at each demotion boundary (the backend wires
+    it to its ``_write_checkpoint``); ``on_demote(acc)`` lets the
+    backend rebind prefetch staging to the new accumulator.
+
+    The RETRY/REPLAY UNIT matches the COMMIT UNIT: a batch is dispatched
+    as one single-bucket sub-batch per width (device commits happen per
+    bucket inside every accumulator's ``add``), and a capacity split's
+    halves are each their own unit.  A failure therefore only ever
+    retries or replays work that has NOT committed — a multi-bucket
+    batch whose second bucket dies does not re-scatter its first.
+    """
+
+    def __init__(self, policy: RetryPolicy, total_len: int,
+                 checkpoint_cb: Optional[Callable] = None,
+                 on_demote: Optional[Callable] = None):
+        self.policy = policy
+        self.total_len = total_len
+        self.checkpoint_cb = checkpoint_cb
+        self.on_demote = on_demote
+        self.demotions = 0             # ladder steps taken this run
+        self._acc = None
+        self._pending: list = []
+
+    # -- one dispatch attempt ------------------------------------------
+    def _attempt(self, unit) -> None:
+        from ..ops.pileup import HostPileupAccumulator
+
+        if not isinstance(self._acc, HostPileupAccumulator):
+            # the host rung carries no injection sites: it IS the
+            # bottom of the ladder
+            faultinject.fault_check("accumulate")
+        self._acc.add(unit)
+
+    def _dispatch_unit(self, unit, depth: int = 0) -> None:
+        """Policy-run one unit; CAPACITY splits it and recurses on the
+        halves (each its own unit), persistent failure demotes and
+        replays THIS unit only."""
+
+        def on_capacity(exc):
+            if depth >= 4:
+                raise exc              # splitting isn't helping: persist
+            parts = split_batch(unit)
+            if len(parts) == 1:
+                raise exc              # nothing left to split
+            reg = obs.metrics()
+            reg.add("resilience/capacity_splits", 1)
+            obs.tracer().event("resilience/capacity_split",
+                               depth=depth,
+                               error=f"{type(exc).__name__}: {exc}")
+            for part in parts:
+                self._dispatch_unit(part, depth + 1)
+
+        while True:
+            try:
+                self.policy.run(lambda: self._attempt(unit),
+                                site="pileup", on_capacity=on_capacity)
+                return
+            except BaseException as exc:
+                kind = classify(exc)
+                if kind == PASSTHROUGH \
+                        or self.policy.on_error != "fallback":
+                    raise
+                frm = pileup_level(self._acc)
+                new_acc, level = demote_pileup(self._acc, self.total_len)
+                if new_acc is None:
+                    raise              # bottom rung already: truly fatal
+                self._acc = new_acc
+                if self.on_demote is not None:
+                    self.on_demote(new_acc)
+                self._pending.append((frm, level, exc))
+                # loop: replay ONLY this unit on the demoted rung;
+                # already-committed units of the batch are not re-run
+
+    def _units(self, batch) -> list:
+        """One single-bucket sub-batch per width — the commit unit of
+        every accumulator's ``add`` (staged operands follow their
+        bucket).  Fused/empty batches pass through whole."""
+        from ..encoder.events import SegmentBatch
+
+        if batch.accumulated or not batch.buckets:
+            return [batch]
+        units = []
+        for w in sorted(batch.buckets):
+            staged = {w: batch.staged[w]} if w in batch.staged else {}
+            units.append(SegmentBatch(buckets={w: batch.buckets[w]},
+                                      staged=staged))
+        return units
+
+    # -- public entry ---------------------------------------------------
+    def add(self, acc, batch):
+        """Dispatch ``batch``; returns the accumulator for the NEXT
+        batch (demoted when the ladder stepped down).
+
+        A failing replay after a demotion continues DOWN the ladder
+        (kernel → scatter → host) until a rung absorbs the unit or the
+        bottom rung itself fails.  The emergency checkpoint is written
+        once per batch, after every unit has landed — the first
+        consistent batch boundary (the stream offsets already include
+        this batch's lines, so its counts must too; the backend runs
+        serial decode whenever checkpointing is on, so the stream never
+        reads ahead of the consumer).
+        """
+        self._acc = acc
+        self._pending = []
+        t0 = time.perf_counter()
+        for unit in self._units(batch):
+            self._dispatch_unit(unit)
+        acc = self._acc
+        if self._pending:
+            self.demotions += len(self._pending)
+            checkpointed = False
+            if self.checkpoint_cb is not None:
+                self.checkpoint_cb(acc)
+                checkpointed = True
+                obs.metrics().add("resilience/emergency_checkpoints", 1)
+                obs.tracer().event("resilience/emergency_checkpoint",
+                                   stage="pileup",
+                                   level=self._pending[-1][1])
+            for frm, level, exc in self._pending:
+                _record_demotion("pileup", frm, level,
+                                 f"{type(exc).__name__}: {exc}",
+                                 checkpointed)
+            obs.metrics().observe("resilience/demotion_sec",
+                                  time.perf_counter() - t0)
+        return acc

@@ -1,0 +1,229 @@
+"""Retry policy for device dispatches: classify, back off, retry.
+
+Every device-touching call site routes its failures through one
+classification so the retry/demote behavior cannot drift between
+layers:
+
+* ``TRANSIENT`` — RPC/link/timeout-shaped failures (the tunnel dropped,
+  a dispatch deadline expired, the transport reset): retry with
+  exponential backoff + deterministic jitter;
+* ``CAPACITY`` — device memory exhaustion (OOM): don't just retry the
+  same shape — split the slab / halve the work and retry the halves;
+* ``FATAL`` — a device-side failure that retrying the same path won't
+  fix (kernel trace failure, device core dump): no retry; under
+  ``--on-device-error fallback`` the degradation ladder demotes the
+  path instead (resilience/ladder.py);
+* ``PASSTHROUGH`` — plain Python errors (KeyError/ValueError/TypeError
+  …, including the oracle-parity strict-mode decode errors) and
+  process-control exceptions: never retried, never demoted — they are
+  bugs or contract errors, and masking them with a host fallback would
+  hide them while still costing a full recompute.
+
+The classifier is name/message-based for the jax runtime's exception
+types (``XlaRuntimeError`` carries its gRPC-style status in the
+message) so no jaxlib import is needed here.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import threading
+import time
+from typing import Callable, Optional
+
+from .faultinject import (InjectedFatalError, InjectedOomError,
+                          InjectedRpcError, InjectedTimeoutError,
+                          InjectedTraceError)
+
+TRANSIENT = "transient"
+CAPACITY = "capacity"
+FATAL = "fatal"
+PASSTHROUGH = "passthrough"
+
+#: status substrings the jax/gRPC runtime uses for retryable transport
+#: failures; checked case-sensitively first (they are SHOUTY status
+#: names), then a lowercase sweep for socket-ish message shapes
+_TRANSIENT_STATUS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "CANCELLED",
+                     "ABORTED", "UNKNOWN: Stream removed")
+_TRANSIENT_RE = re.compile(
+    r"connection (reset|refused|dropped|closed)|broken pipe|socket"
+    r"|timed? ?out|unreachable|transport|tunnel", re.IGNORECASE)
+_CAPACITY_RE = re.compile(
+    r"RESOURCE_EXHAUSTED|out of memory|\bOOM\b|failed to allocate"
+    r"|allocation .* exceeds", re.IGNORECASE)
+
+#: exception types that are never device failures: re-raise untouched.
+#: Strict-mode decode errors (KeyError/IndexError — reference parity is
+#: contract, tests/test_differential.py) land here by TYPE, so a retry
+#: wrapper around a dispatch can never eat them.
+_PASSTHROUGH_TYPES = (KeyboardInterrupt, SystemExit, GeneratorExit,
+                      StopIteration, TypeError, ValueError, KeyError,
+                      IndexError, AttributeError, NameError,
+                      AssertionError, NotImplementedError, ImportError)
+
+
+class RetriesExhausted(RuntimeError):
+    """Raised by :meth:`RetryPolicy.run` when transient/capacity retries
+    ran out; carries the last underlying failure as ``__cause__``."""
+
+
+class AttemptDeadlineExceeded(TimeoutError):
+    """A dispatch overran its per-attempt deadline (classified
+    transient: a hung tunnel round trip looks exactly like this)."""
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception to TRANSIENT / CAPACITY / FATAL / PASSTHROUGH."""
+    if isinstance(exc, (InjectedRpcError, InjectedTimeoutError)):
+        return TRANSIENT
+    if isinstance(exc, InjectedOomError):
+        return CAPACITY
+    if isinstance(exc, (InjectedFatalError, InjectedTraceError)):
+        return FATAL
+    if isinstance(exc, _PASSTHROUGH_TYPES):
+        return PASSTHROUGH
+    msg = str(exc)
+    if isinstance(exc, MemoryError) or _CAPACITY_RE.search(msg):
+        return CAPACITY
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return TRANSIENT
+    if any(s in msg for s in _TRANSIENT_STATUS) or _TRANSIENT_RE.search(msg):
+        return TRANSIENT
+    if isinstance(exc, OSError):
+        return TRANSIENT           # EIO/EPIPE-shaped transport failures
+    # XlaRuntimeError (a RuntimeError subclass) without a transient or
+    # capacity status, kernel lowering failures, anything else device-ish
+    return FATAL
+
+
+class RetryPolicy:
+    """Configurable retry with exponential backoff + deterministic jitter
+    and optional per-attempt deadlines.
+
+    ``retries`` counts RE-attempts (retries=3 → up to 4 attempts).
+    Backoff for attempt ``i`` is ``backoff * 2**i``, capped at
+    ``max_backoff``, jittered by ±``jitter`` fraction with a seeded PRNG
+    so a run's retry schedule is reproducible (seed-addressable, like
+    the fault injector).  ``deadline_s`` (or env
+    ``S2C_ATTEMPT_DEADLINE_S``) bounds each attempt: the call runs on a
+    watchdog thread and overruns raise :class:`AttemptDeadlineExceeded`
+    (transient) — same discipline as the link probe's watchdog, and the
+    same caveat: the abandoned attempt's daemon thread may still
+    complete later, so deadline-bounded calls must be idempotent (every
+    wrapped dispatch here is: accumulation retries replay the same
+    slab, tail retries recompute a pure function of the counts).
+    """
+
+    def __init__(self, retries: int = 3, backoff: float = 0.25,
+                 max_backoff: float = 8.0, jitter: float = 0.1,
+                 seed: int = 0, deadline_s: Optional[float] = None,
+                 on_error: str = "retry"):
+        if on_error not in ("fail", "retry", "fallback"):
+            raise ValueError(
+                f"on_error={on_error!r}: use fail|retry|fallback")
+        self.retries = max(0, int(retries)) if on_error != "fail" else 0
+        self.backoff = float(backoff)
+        self.max_backoff = float(max_backoff)
+        self.jitter = float(jitter)
+        self.seed = seed
+        self.deadline_s = deadline_s
+        self.on_error = on_error
+        self._rng = random.Random(seed)
+
+    @classmethod
+    def from_config(cls, cfg) -> "RetryPolicy":
+        """Policy from RunConfig (+ env overrides: S2C_ON_DEVICE_ERROR
+        wins over --on-device-error so the campaign's chaos leg can
+        flip an unmodified bench invocation to fallback mode;
+        S2C_ATTEMPT_DEADLINE_S enables per-attempt deadlines)."""
+        deadline = os.environ.get("S2C_ATTEMPT_DEADLINE_S")
+        return cls(
+            retries=getattr(cfg, "retries", 3),
+            backoff=getattr(cfg, "retry_backoff", 0.25),
+            seed=int(os.environ.get("S2C_FAULT_SEED", "0")),
+            deadline_s=float(deadline) if deadline else None,
+            on_error=os.environ.get(
+                "S2C_ON_DEVICE_ERROR",
+                getattr(cfg, "on_device_error", "retry")))
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before re-attempt ``attempt`` (0-based), jittered."""
+        base = min(self.backoff * (2 ** attempt), self.max_backoff)
+        return max(0.0, base * (1.0 + self.jitter
+                                * self._rng.uniform(-1.0, 1.0)))
+
+    def _call(self, fn: Callable):
+        if self.deadline_s is None:
+            return fn()
+        box: list = []
+
+        def work():
+            try:
+                box.append(("ok", fn()))
+            except BaseException as exc:  # re-raised on the caller side
+                box.append(("exc", exc))
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        t.join(self.deadline_s)
+        if not box:
+            raise AttemptDeadlineExceeded(
+                f"dispatch exceeded its {self.deadline_s:.3g}s "
+                f"per-attempt deadline")
+        tag, val = box[0]
+        if tag == "exc":
+            raise val
+        return val
+
+    def run(self, fn: Callable, site: str = "dispatch",
+            on_capacity: Optional[Callable] = None,
+            sleep: Callable[[float], None] = time.sleep):
+        """Run ``fn`` under the policy; returns its result.
+
+        TRANSIENT failures retry with backoff up to ``retries`` times,
+        then raise :class:`RetriesExhausted` (cause = last failure).
+        CAPACITY failures call ``on_capacity(exc)`` once per failure if
+        given — its return value becomes the result (the caller split
+        the work and dispatched the halves itself); without a handler
+        they retry like transients (the allocator may simply have been
+        fragmented by a peer).  FATAL and PASSTHROUGH raise immediately.
+        Every retry is recorded: ``resilience/retries`` counter + a
+        ``resilience/retry`` tracer event with site/kind/delay.
+        """
+        from .. import observability as obs
+
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            try:
+                return self._call(fn)
+            except BaseException as exc:
+                kind = classify(exc)
+                if kind in (PASSTHROUGH, FATAL):
+                    raise
+                if self.on_error == "fail":
+                    raise             # fail mode: no splits, no retries
+                if kind == CAPACITY and on_capacity is not None:
+                    return on_capacity(exc)
+                last = exc
+                if attempt >= self.retries:
+                    if self.retries == 0:
+                        # no retry budget (--on-device-error fail, or
+                        # --retries 0): surface the ORIGINAL exception,
+                        # not a wrapper — old-behavior parity
+                        raise
+                    break
+                d = self.delay(attempt)
+                reg = obs.metrics()
+                reg.add("resilience/retries", 1)
+                reg.add(f"resilience/retries/{site}", 1)
+                obs.tracer().event("resilience/retry", site=site,
+                                   kind=kind, attempt=attempt,
+                                   delay_s=round(d, 4),
+                                   error=f"{type(exc).__name__}: {exc}")
+                if d > 0:
+                    sleep(d)
+        raise RetriesExhausted(
+            f"{site}: {self.retries} retries exhausted "
+            f"(last: {type(last).__name__}: {last})") from last

@@ -20,6 +20,14 @@ Results are cached for the process.  The caller (``_link_constants``)
 only probes real accelerators — the XLA CPU backend is link-free — and
 env overrides (S2C_TAIL_RT_MS / S2C_TAIL_LINK_MBPS) skip the probe
 entirely; S2C_LINK_PROBE=0 disables it.
+
+Failure semantics (resilience subsystem): the measurement runs under a
+watchdog deadline (S2C_LINK_PROBE_TIMEOUT_S), and a failed or hung
+probe falls back to STALE constants — the last successful measurement
+this process (or, via S2C_LINK_CACHE, a previous process) took on this
+link — before resorting to the baked rig defaults.  Stale service is
+flagged in the run's metrics (``link/stale``).  The probe body carries
+the ``link_probe`` fault-injection site (resilience/faultinject.py).
 """
 
 from __future__ import annotations
@@ -33,10 +41,55 @@ import numpy as np
 
 _cached: Optional[Tuple[float, float]] = None
 _failed = False
+#: last SUCCESSFUL measurement, surviving later failures — the stale
+#: fallback a flaky tunnel gets instead of the rig defaults (a probe
+#: that worked ten minutes ago describes this link far better than
+#: constants measured on a different machine)
+_last_good: Optional[Tuple[float, float]] = None
 
 #: probe transfer size: big enough that bandwidth dominates the RT term
 #: after correction, small enough to cost <1 s even on a ~10 MB/s link
 PROBE_BYTES = 1 << 20
+
+
+def _cache_file() -> Optional[str]:
+    """Optional cross-process stale cache (S2C_LINK_CACHE: a json path).
+    Lets a re-launched run on a dropped tunnel reuse the previous
+    process's measured constants instead of the baked defaults."""
+    return os.environ.get("S2C_LINK_CACHE") or None
+
+
+def _read_cache() -> Optional[Tuple[float, float]]:
+    path = _cache_file()
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        import json
+
+        with open(path) as fh:
+            blob = json.load(fh)
+        return (float(blob["rt_sec"]), float(blob["bps"]))
+    except Exception:
+        return None
+
+
+def _write_cache(probed: Tuple[float, float]) -> None:
+    path = _cache_file()
+    if not path:
+        return
+    try:
+        import json
+
+        with open(path, "w") as fh:
+            json.dump({"rt_sec": probed[0], "bps": probed[1]}, fh)
+    except OSError:
+        pass
+
+
+def _stale_constants() -> Optional[Tuple[float, float]]:
+    """Last known-good constants (in-process first, then the optional
+    cache file), or None when the link was never measured."""
+    return _last_good if _last_good is not None else _read_cache()
 
 
 def probe_link(force: bool = False) -> Optional[Tuple[float, float]]:
@@ -51,12 +104,12 @@ def probe_link(force: bool = False) -> Optional[Tuple[float, float]]:
     the default constants, which route host-side and complete link-free
     on every workload the gates would have kept local anyway.
     """
-    global _cached, _failed
+    global _cached, _failed, _last_good
     if _cached is not None and not force:
         _record_link(_cached)          # fresh per-run registry, cached probe
         return _cached
     if _failed and not force:
-        return None
+        return _stale_fallback()
     from .. import observability as obs
 
     timeout = float(os.environ.get("S2C_LINK_PROBE_TIMEOUT_S", "20"))
@@ -71,11 +124,30 @@ def probe_link(force: bool = False) -> Optional[Tuple[float, float]]:
             _failed = True
             sp.set_args(failed=True)
             obs.metrics().gauge("link/probe_failed").set(1.0)
-            return None
+            return _stale_fallback()
         _cached = box[0]
+        _last_good = _cached
+        _write_cache(_cached)
         sp.set_args(rt_sec=_cached[0], bps=_cached[1])
     _record_link(_cached)
     return _cached
+
+
+def _stale_fallback() -> Optional[Tuple[float, float]]:
+    """On probe failure: serve the last known-good constants when any
+    exist (marked stale in the run's registry so the artifact shows the
+    placement model ran on memory, not measurement); None otherwise —
+    the consumers then fall to the baked rig defaults."""
+    stale = _stale_constants()
+    if stale is None:
+        return None
+    from .. import observability as obs
+
+    obs.metrics().gauge("link/stale").set(1.0)
+    obs.tracer().event("link/stale_constants", rt_sec=stale[0],
+                       bps=stale[1])
+    _record_link(stale)
+    return stale
 
 
 def _record_link(probed: Tuple[float, float]) -> None:
@@ -92,6 +164,9 @@ def _record_link(probed: Tuple[float, float]) -> None:
 
 def _probe_into(box: list) -> None:
     try:
+        from ..resilience.faultinject import fault_check
+
+        fault_check("link_probe")
         import jax
         import jax.numpy as jnp
 
@@ -135,7 +210,9 @@ def _timed(fn) -> float:
     return time.perf_counter() - t0
 
 
-def _reset_for_tests() -> None:
-    global _cached, _failed
+def _reset_for_tests(drop_last_good: bool = True) -> None:
+    global _cached, _failed, _last_good
     _cached = None
     _failed = False
+    if drop_last_good:
+        _last_good = None

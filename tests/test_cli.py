@@ -121,3 +121,41 @@ def test_nonpositive_threshold_rejected(tmp_path):
     for bad in ("abc", "0.25,", ""):
         with pytest.raises(SystemExit, match="could not parse"):
             main(["-i", sam, "-o", str(tmp_path / "o"), "-c", bad, "--quiet"])
+
+
+def test_xla_bridge_private_surface_still_exists():
+    """_accelerator_client_live falls back to jax._src.xla_bridge's
+    ``backends_are_initialized()`` + ``_backends`` cache (after probing
+    the public jax.extend.backend namespace).  Pin the private surface:
+    if a jax upgrade drops either attribute, fail HERE loudly instead
+    of silently flipping CPU-only runs onto the conservative os._exit
+    branch (ADVICE r5 #3)."""
+    from jax._src import xla_bridge
+
+    assert isinstance(xla_bridge._backends, dict)
+    assert callable(getattr(xla_bridge, "backends_are_initialized", None))
+
+
+def test_accelerator_client_live_cpu_only(monkeypatch):
+    """A CPU-only process must exit normally (no os._exit): with only
+    the cpu backend initialized, _accelerator_client_live is False; the
+    S2C_SAFE_EXIT override flips it both ways.  Skipped when the
+    process has a real accelerator client (e.g. the suite run without
+    conftest's cpu pin on the TPU rig) — the conservative True is
+    correct there."""
+    import jax
+    from jax._src import xla_bridge
+
+    jax.devices()                     # ensure a backend client exists
+    if any(p != "cpu" for p in xla_bridge._backends):
+        import pytest
+
+        pytest.skip("non-cpu accelerator client initialized")
+    from sam2consensus_tpu.cli import _accelerator_client_live
+
+    monkeypatch.delenv("S2C_SAFE_EXIT", raising=False)
+    assert _accelerator_client_live() is False
+    monkeypatch.setenv("S2C_SAFE_EXIT", "1")
+    assert _accelerator_client_live() is True
+    monkeypatch.setenv("S2C_SAFE_EXIT", "0")
+    assert _accelerator_client_live() is False

@@ -1,0 +1,492 @@
+"""Resilience subsystem: fault injection, retry/backoff, degradation ladder.
+
+The failure contract pinned here (ISSUE 2 acceptance): with
+``--fault-inject`` killing the device path mid-run — transient RPC
+faults xN, then a persistent fault forcing a ladder demotion to host —
+the run completes with FASTA bytes identical to the cpu oracle, the
+metrics record the retries / demotion / emergency checkpoint, and a
+kill+resume under injected faults recovers from the emergency
+checkpoint.
+"""
+
+import io
+
+import pytest
+
+from sam2consensus_tpu import observability as obs
+from sam2consensus_tpu.backends.cpu import CpuBackend
+from sam2consensus_tpu.backends.jax_backend import JaxBackend
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.io.sam import ReadStream, read_header
+from sam2consensus_tpu.resilience import faultinject, ladder, policy
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+TEXT = simulate(SimSpec(n_contigs=3, contig_len=300, n_reads=900,
+                        read_len=40, ins_read_rate=0.12, del_read_rate=0.12,
+                        seed=5))
+
+
+def _run(cfg, text=TEXT, handle_wrapper=None):
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    if handle_wrapper is not None:
+        handle = handle_wrapper(handle)
+    stream = ReadStream(handle, first)
+    backend = CpuBackend() if cfg.backend == "cpu" else JaxBackend()
+    res = backend.run(contigs, stream, cfg)
+    return ({n: render_file(r, 0) for n, r in res.fastas.items()},
+            res.stats)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    out, _ = _run(RunConfig(prefix="p", backend="cpu",
+                            thresholds=[0.25, 0.75]))
+    return out
+
+
+def _jax_cfg(**kw):
+    """A multi-batch device-pileup config: the python decoder honors
+    chunk_reads (the native decoder batches by input block), and fast
+    backoff keeps the suite quick."""
+    base = dict(prefix="p", backend="jax", thresholds=[0.25, 0.75],
+                decoder="py", pileup="scatter", chunk_reads=128,
+                retry_backoff=0.001, shards=1)
+    base.update(kw)
+    return RunConfig(**base)
+
+
+# ---------------------------------------------------------------- policy --
+def test_classification():
+    assert policy.classify(faultinject.InjectedRpcError("x")) \
+        == policy.TRANSIENT
+    assert policy.classify(TimeoutError("boom")) == policy.TRANSIENT
+    assert policy.classify(ConnectionResetError("x")) == policy.TRANSIENT
+    assert policy.classify(RuntimeError("UNAVAILABLE: socket closed")) \
+        == policy.TRANSIENT
+    assert policy.classify(RuntimeError(
+        "RESOURCE_EXHAUSTED: out of memory")) == policy.CAPACITY
+    assert policy.classify(MemoryError()) == policy.CAPACITY
+    assert policy.classify(RuntimeError("INTERNAL: core dumped")) \
+        == policy.FATAL
+    # oracle-parity strict-mode error types can never be retried/demoted
+    assert policy.classify(KeyError("'x'")) == policy.PASSTHROUGH
+    assert policy.classify(ValueError("bad")) == policy.PASSTHROUGH
+    assert policy.classify(KeyboardInterrupt()) == policy.PASSTHROUGH
+
+
+def test_backoff_schedule_deterministic_and_exponential():
+    a = policy.RetryPolicy(retries=5, backoff=0.1, jitter=0.1, seed=42)
+    b = policy.RetryPolicy(retries=5, backoff=0.1, jitter=0.1, seed=42)
+    da = [a.delay(i) for i in range(5)]
+    db = [b.delay(i) for i in range(5)]
+    assert da == db                       # seed-addressable jitter
+    for i, d in enumerate(da):
+        base = 0.1 * 2 ** i
+        assert base * 0.9 <= d <= base * 1.1
+
+
+def test_retry_run_retries_then_raises():
+    pol = policy.RetryPolicy(retries=2, backoff=0.0)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise ConnectionError("UNAVAILABLE")
+
+    with pytest.raises(policy.RetriesExhausted):
+        pol.run(flaky, sleep=lambda s: None)
+    assert len(calls) == 3                # 1 attempt + 2 retries
+
+    calls.clear()
+
+    def recovers():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TimeoutError("timed out")
+        return "ok"
+
+    assert pol.run(recovers, sleep=lambda s: None) == "ok"
+
+
+def test_retry_never_touches_passthrough():
+    pol = policy.RetryPolicy(retries=5, backoff=0.0)
+    calls = []
+
+    def bug():
+        calls.append(1)
+        raise KeyError("'N'")
+
+    with pytest.raises(KeyError):
+        pol.run(bug, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_attempt_deadline():
+    import time as _time
+
+    pol = policy.RetryPolicy(retries=0, deadline_s=0.05)
+    with pytest.raises(policy.AttemptDeadlineExceeded):
+        pol._call(lambda: _time.sleep(1.0))
+    # an overrun inside run() consumes the retry budget as a TRANSIENT
+    assert policy.classify(policy.AttemptDeadlineExceeded("x")) \
+        == policy.TRANSIENT
+    with pytest.raises(policy.AttemptDeadlineExceeded):
+        pol.run(lambda: _time.sleep(1.0))   # retries=0: original raises
+    assert pol._call(lambda: 7) == 7      # under-deadline value passes
+
+
+# ----------------------------------------------------------- faultinject --
+def test_spec_parsing_and_errors():
+    rules = faultinject.parse_spec(
+        "pileup_dispatch:rpc:3:2, vote:fatal:0:inf")
+    assert rules[0].site == "pileup_dispatch" and rules[0].after_n == 3 \
+        and rules[0].times == 2
+    assert rules[1].times == faultinject.PERSISTENT
+    for bad in ("nosite:rpc:0", "vote:nokind:0", "vote:rpc:x",
+                "vote:rpc", "vote:rpc:p2.0", "vote:rpc:0:0"):
+        with pytest.raises(ValueError):
+            faultinject.parse_spec(bad)
+
+
+def test_counted_injection_and_suppression():
+    inj = faultinject.FaultInjector(
+        faultinject.parse_spec("vote:rpc:2:2"))
+    inj.check("vote")                     # call 0: passes
+    inj.check("vote")                     # call 1: passes
+    for _ in range(2):                    # calls 2-3: fire
+        with pytest.raises(faultinject.InjectedRpcError):
+            inj.check("vote")
+    inj.check("vote")                     # call 4: times exhausted
+    assert inj.injected == {"vote": 2}
+
+    inj2 = faultinject.FaultInjector(
+        faultinject.parse_spec("vote:fatal:0:inf"))
+    faultinject._injector = inj2
+    try:
+        with pytest.raises(faultinject.InjectedFatalError):
+            faultinject.fault_check("vote")
+        with faultinject.suppress():
+            faultinject.fault_check("vote")   # suppressed: no raise
+        with pytest.raises(faultinject.InjectedFatalError):
+            faultinject.fault_check("vote")
+    finally:
+        faultinject._reset_for_tests()
+
+
+def test_probabilistic_budget_honored():
+    """An explicit times budget caps a probabilistic rule (p1.0 fires
+    on every call until the budget runs out, then never again)."""
+    inj = faultinject.FaultInjector(
+        faultinject.parse_spec("vote:rpc:p1.0:2"), seed=1)
+    fired = 0
+    for _ in range(10):
+        try:
+            inj.check("vote")
+        except faultinject.InjectedRpcError:
+            fired += 1
+    assert fired == 2
+    # without a budget, probabilistic rules keep rolling
+    assert faultinject.parse_spec("vote:rpc:p0.5")[0].times \
+        == faultinject.PERSISTENT
+
+
+def test_probabilistic_injection_seed_addressable():
+    def fire_pattern(seed):
+        inj = faultinject.FaultInjector(
+            faultinject.parse_spec("vote:rpc:p0.3"), seed=seed)
+        pat = []
+        for _ in range(64):
+            try:
+                inj.check("vote")
+                pat.append(0)
+            except faultinject.InjectedRpcError:
+                pat.append(1)
+        return pat
+
+    assert fire_pattern(7) == fire_pattern(7)      # deterministic
+    assert fire_pattern(7) != fire_pattern(8)      # seed-addressable
+    rate = sum(fire_pattern(7)) / 64
+    assert 0.1 < rate < 0.6                        # roughly the asked p
+
+
+# ---------------------------------------------------------------- ladder --
+def test_split_batch_halves_rows():
+    import numpy as np
+
+    from sam2consensus_tpu.encoder.events import SegmentBatch
+
+    starts = np.arange(32, dtype=np.int32)
+    codes = np.zeros((32, 8), dtype=np.uint8)
+    b = SegmentBatch(buckets={8: (starts, codes)}, n_reads=32,
+                     n_events=256)
+    halves = ladder.split_batch(b)
+    assert len(halves) == 2
+    got = np.concatenate([h.buckets[8][0] for h in halves])
+    assert np.array_equal(np.sort(got), starts)
+    # tiny buckets are not splittable
+    tiny = SegmentBatch(buckets={8: (starts[:8], codes[:8])})
+    assert ladder.split_batch(tiny) == [tiny]
+
+
+def test_demote_pileup_rungs():
+    from sam2consensus_tpu.ops.pileup import (HostPileupAccumulator,
+                                              PileupAccumulator)
+
+    acc = PileupAccumulator(64, strategy="auto")
+    assert ladder.pileup_level(acc) == "device_auto"
+    acc2, level = ladder.demote_pileup(acc, 64)
+    assert acc2 is acc and level == "device_scatter"
+    assert acc.strategy == "scatter" and acc._tuner is None
+    acc3, level = ladder.demote_pileup(acc, 64)
+    assert isinstance(acc3, HostPileupAccumulator) and level == "host"
+    assert ladder.demote_pileup(acc3, 64) == (None, "")
+
+
+# ------------------------------------------- end-to-end recovery (chaos) --
+def test_transient_faults_retry_to_identical_output(oracle):
+    """Transient RPC faults xN on the pileup dispatch: retried, then
+    byte-identical output; retries recorded in the metrics."""
+    got, stats = _run(_jax_cfg(
+        on_device_error="retry",
+        fault_inject="pileup_dispatch:rpc:1:2"))
+    assert got == oracle
+    assert stats.extra["fault/injected/pileup_dispatch"] == 2
+    assert stats.extra["resilience/retries"] >= 2
+
+
+def test_chaos_acceptance_metrics_jsonl(oracle, tmp_path):
+    """THE acceptance scenario: transient RPC faults xN, then a
+    persistent fatal fault forcing a ladder demotion to the host
+    pileup — run completes, FASTA bytes identical to the cpu oracle,
+    and the metrics JSONL records the retries, the demotion, and the
+    emergency checkpoint write."""
+    mpath = str(tmp_path / "metrics.jsonl")
+    ckdir = str(tmp_path / "ck")
+    got, stats = _run(_jax_cfg(
+        on_device_error="fallback",
+        checkpoint_dir=ckdir,
+        metrics_out=mpath,
+        fault_inject="pileup_dispatch:rpc:1:2,accumulate:fatal:4:inf"))
+    assert got == oracle
+    assert stats.extra["pileup_ladder"] == "host"
+    counters = {}
+    for row in obs.read_metrics_jsonl(mpath):
+        if row.get("kind") == "counter":
+            counters[row["name"]] = row["value"]
+    assert counters["resilience/retries"] >= 2
+    assert counters["resilience/demotions"] == 1
+    assert counters["resilience/emergency_checkpoints"] == 1
+    assert counters["fault/injected"] >= 3
+
+
+def test_oom_splits_slab_and_completes(oracle):
+    got, stats = _run(_jax_cfg(
+        on_device_error="retry", chunk_reads=256,
+        fault_inject="pileup_dispatch:oom:1:1"))
+    assert got == oracle
+    assert stats.extra["resilience/capacity_splits"] >= 1
+
+
+def test_device_put_fault_recovers(oracle):
+    got, stats = _run(_jax_cfg(
+        on_device_error="retry",
+        fault_inject="device_put:rpc:1:1"))
+    assert got == oracle
+    assert stats.extra["fault/injected/device_put"] == 1
+
+
+def test_tail_transient_fault_recomputes(oracle):
+    got, stats = _run(_jax_cfg(
+        on_device_error="retry", fault_inject="vote:rpc:0:1"))
+    assert got == oracle
+    assert stats.extra["resilience/retries/tail"] == 1
+
+
+def test_tail_persistent_fault_demotes_to_host(oracle):
+    got, stats = _run(_jax_cfg(
+        on_device_error="fallback", retries=1,
+        fault_inject="vote:fatal:0:inf"))
+    assert got == oracle
+    assert stats.extra["resilience/demotions/tail"] == 1
+
+
+def test_insertion_build_fault_recovers(oracle):
+    got, stats = _run(_jax_cfg(
+        on_device_error="retry",
+        fault_inject="insertion_build:rpc:0:1"))
+    assert got == oracle
+    assert stats.extra["fault/injected/insertion_build"] == 1
+
+
+def test_sharded_run_demotes_to_host(oracle):
+    """A persistent device fault under --shards steps the sharded
+    accumulator down to the host pileup; counts survive the demotion."""
+    got, stats = _run(_jax_cfg(
+        on_device_error="fallback", shards=2, shard_mode="dp",
+        fault_inject="accumulate:fatal:3:inf"))
+    assert got == oracle
+    assert stats.extra["pileup_ladder"] == "host"
+    assert stats.extra["resilience/demotions"] >= 1
+
+
+def test_on_device_error_fail_raises():
+    with pytest.raises(faultinject.InjectedRpcError):
+        _run(_jax_cfg(on_device_error="fail",
+                      fault_inject="pileup_dispatch:rpc:1:inf"))
+
+
+def test_on_device_error_fail_raises_oom_without_splitting():
+    """fail mode means 'raise immediately' for OOM too — no capacity
+    splits, old-behavior parity."""
+    with pytest.raises(faultinject.InjectedOomError):
+        _run(_jax_cfg(on_device_error="fail",
+                      fault_inject="pileup_dispatch:oom:1:inf"))
+
+
+def test_retry_mode_does_not_demote():
+    """Without fallback, a persistent fault stays fatal after retries."""
+    with pytest.raises(faultinject.InjectedFatalError):
+        _run(_jax_cfg(on_device_error="retry",
+                      fault_inject="accumulate:fatal:2:inf"))
+
+
+def test_multibucket_fault_retry_is_exact(tmp_path):
+    """The retry/replay unit is the COMMIT unit (one width bucket): a
+    transient fault on a batch's second bucket must not re-scatter its
+    already-committed first bucket.  Mixed read spans force two width
+    buckets per batch; serial decode (checkpoint on) keeps transfers on
+    the per-bucket put path where the device_put site fires."""
+    import random
+
+    from sam2consensus_tpu.utils.simulate import sam_text
+
+    rng = random.Random(0)
+    rows = []
+    for i in range(300):
+        span = 20 if i % 2 == 0 else 70
+        pos = rng.randrange(1, 400 - span)
+        seq = "".join(rng.choice("ACGT") for _ in range(span))
+        rows.append(("r", pos, f"{span}M", seq))
+    text2 = sam_text([("r", 400)], rows)
+
+    want, _ = _run(RunConfig(prefix="p", backend="cpu",
+                             thresholds=[0.25, 0.75]), text=text2)
+    got, stats = _run(_jax_cfg(
+        on_device_error="retry", chunk_reads=64,
+        checkpoint_dir=str(tmp_path / "ck"),
+        fault_inject="device_put:rpc:1:1"), text=text2)
+    assert got == want
+    assert stats.extra["fault/injected/device_put"] == 1
+    assert stats.extra["resilience/retries"] == 1
+
+
+# -------------------------------------------------------- kill + resume --
+class _CrashingHandle:
+    """File-handle proxy that dies after ``limit`` lines (hard-crash
+    injection on the DECODE side, which has no device ladder)."""
+
+    def __init__(self, handle, limit):
+        self.handle = handle
+        self.limit = limit
+        self.count = 0
+
+    def __iter__(self):
+        for line in self.handle:
+            self.count += 1
+            if self.count > self.limit:
+                raise RuntimeError("injected hard crash")
+            yield line
+
+    def read(self, n=-1):  # pragma: no cover - records() path only
+        raise RuntimeError("injected hard crash")
+
+    def readline(self):
+        line = self.handle.readline()
+        if line:
+            self.count += 1
+            if self.count > self.limit:
+                raise RuntimeError("injected hard crash")
+        return line
+
+    def tell(self):
+        return self.handle.tell()
+
+    def seek(self, pos):
+        return self.handle.seek(pos)
+
+
+def test_kill_after_demotion_resumes_from_emergency_checkpoint(
+        oracle, tmp_path):
+    """Demotion writes an emergency checkpoint; a hard crash AFTER the
+    demotion (decode-side, past the ladder's reach) then resumes from
+    that checkpoint and the resumed run's bytes match the oracle.
+    checkpoint_every is huge, so the emergency write is the ONLY
+    checkpoint the crashed run produced."""
+    ckdir = str(tmp_path / "ck")
+    from sam2consensus_tpu.utils import checkpoint as ckpt
+
+    cfg = _jax_cfg(on_device_error="fallback", checkpoint_dir=ckdir,
+                   checkpoint_every=10**9,
+                   fault_inject="accumulate:fatal:2:inf")
+    with pytest.raises(RuntimeError, match="injected hard crash"):
+        _run(cfg, handle_wrapper=lambda h: _CrashingHandle(h, 700))
+    contigs, _n, _first = read_header(io.StringIO(TEXT))
+    total_len = sum(c.length for c in contigs)
+    saved = ckpt.load(ckdir, total_len)
+    assert saved is not None and saved.lines_consumed > 0
+
+    cfg2 = _jax_cfg(on_device_error="retry", checkpoint_dir=ckdir)
+    got, stats = _run(cfg2)
+    assert got == oracle
+    assert "resumed_from_line" in stats.extra
+
+
+# ------------------------------------------------------- linkprobe stale --
+def test_linkprobe_stale_fallback(monkeypatch):
+    from sam2consensus_tpu.utils import linkprobe
+
+    linkprobe._reset_for_tests()
+    try:
+        linkprobe._last_good = (0.01, 5e7)
+        linkprobe._failed = True           # probe already failed once
+        robs = obs.start_run()
+        try:
+            assert linkprobe.probe_link() == (0.01, 5e7)
+            snap = obs.metrics().snapshot()
+            assert snap["gauges"]["link/stale"]["value"] == 1.0
+            assert snap["gauges"]["link/bps"]["value"] == 5e7
+        finally:
+            obs.finish_run(robs)
+    finally:
+        linkprobe._reset_for_tests()
+
+
+def test_linkprobe_injected_fault_falls_back(monkeypatch):
+    from sam2consensus_tpu.utils import linkprobe
+
+    linkprobe._reset_for_tests()
+    faultinject.configure("link_probe:rpc:0:inf")
+    try:
+        assert linkprobe.probe_link(force=True) is None
+    finally:
+        faultinject._reset_for_tests()
+        linkprobe._reset_for_tests()
+
+
+# ------------------------------------------------------------- cli flags --
+def test_cli_fault_inject_spec_validated(tmp_path):
+    from sam2consensus_tpu.cli import main
+    from sam2consensus_tpu.utils.simulate import sam_text, write_sam
+
+    sam = write_sam(sam_text([("r", 6)], [("r", 1, "4M", "ACGT")]),
+                    str(tmp_path / "x.sam"))
+    out = str(tmp_path / "out")
+    with pytest.raises(SystemExit):
+        main(["-i", sam, "-o", out, "--quiet", "--backend", "jax",
+              "--fault-inject", "bogus:rpc:0"])
+    # a valid spec that never fires runs clean end to end
+    assert main(["-i", sam, "-o", out, "--quiet", "--backend", "jax",
+                 "--fault-inject", "vote:rpc:999",
+                 "--retries", "2", "--on-device-error", "fallback"]) == 0

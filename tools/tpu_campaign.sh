@@ -1,16 +1,45 @@
 #!/bin/bash
 # Detached TPU measurement campaign: waits for the tunnel, then runs the
-# full evidence sequence (cpu-coexist check, bench, microbench, probe).
-# Logs land in /root/repo/campaign/.
+# full evidence sequence (cpu-coexist check, bench, microbench, probe,
+# chaos leg).  Logs land in /root/repo/campaign/.
+#
+# IDEMPOTENT / RESUMABLE (VERDICT r5 next-round #1): every step writes
+# through a .partial file and promotes it to the r-tagged artifact only
+# on rc=0, and a step whose artifact already exists is skipped — so a
+# mid-campaign tunnel drop keeps the finished artifacts and a re-launch
+# picks up at the first missing one.  CAMPAIGN_FORCE=1 redoes
+# everything; CAMPAIGN_ROUND retags (default r05).
 set -u
 cd /root/repo
 mkdir -p campaign
+R=${CAMPAIGN_ROUND:-r05}
 LOG=campaign/campaign.log
-echo "$(date +%H:%M:%S) campaign start" >> "$LOG"
+echo "$(date +%H:%M:%S) campaign start (round $R)" >> "$LOG"
 
 probe() {
   timeout -k 15 150 python -c "import jax; print(jax.devices()[0].platform)" \
       2>/dev/null | tail -1
+}
+
+# run_step <name> <artifact> <stderr-log-or-"-"> <timeout-s> <cmd...>
+# Skips when the artifact exists (unless CAMPAIGN_FORCE=1); writes
+# stdout to <artifact>.partial and promotes on success.
+run_step() {
+  local name=$1 artifact=$2 errlog=$3 tmo=$4
+  shift 4
+  if [ -s "$artifact" ] && [ "${CAMPAIGN_FORCE:-0}" != "1" ]; then
+    echo "$(date +%H:%M:%S) $name: SKIP ($artifact exists)" >> "$LOG"
+    return 0
+  fi
+  local err=/dev/null
+  [ "$errlog" != "-" ] && err=$errlog
+  timeout -k 30 "$tmo" "$@" > "$artifact.partial" 2> "$err"
+  local rc=$?
+  if [ $rc -eq 0 ]; then
+    mv "$artifact.partial" "$artifact"
+  fi
+  echo "$(date +%H:%M:%S) $name done rc=$rc" >> "$LOG"
+  return $rc
 }
 
 # 1. wait for the tunnel (up to ~8.5h: 120 x (150s probe + grace + 90s))
@@ -31,7 +60,7 @@ if [ "$up" != "1" ]; then
 fi
 
 # 2. cpu backend coexistence (the host-tail gate depends on it)
-timeout -k 15 300 python -c "
+run_step cpu_coexist "campaign/cpu_coexist_$R.txt" - 300 python -c "
 import jax, numpy as np
 print('default:', jax.default_backend(),
       [d.platform for d in jax.devices()])
@@ -42,44 +71,46 @@ try:
     print('cpu-routed jit OK:', np.asarray(y).tolist(), y.devices())
 except Exception as e:
     print('NO CPU BACKEND:', type(e).__name__, e)
-" > campaign/cpu_coexist_r05.txt 2>&1
-echo "$(date +%H:%M:%S) cpu_coexist done" >> "$LOG"
+"
 
 # 3. full bench (all configs incl. north_star + wide_genome)
 BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 \
-  timeout -k 30 5400 python bench.py > campaign/bench_preview_r05.json \
-  2> campaign/bench_stderr_r05.log
-rc=$?
-echo "$(date +%H:%M:%S) bench done rc=$rc" >> "$LOG"
+  run_step bench "campaign/bench_preview_$R.json" \
+  "campaign/bench_stderr_$R.log" 5400 python bench.py
 
 # 4. device-op microbench (pallas-vs-scatter evidence, mxu rates)
-timeout -k 30 1800 python tools/microbench.py > campaign/microbench_tpu_r05.jsonl \
-  2> campaign/microbench_stderr_r05.log
-rc=$?
-echo "$(date +%H:%M:%S) microbench done rc=$rc" >> "$LOG"
+run_step microbench "campaign/microbench_tpu_$R.jsonl" \
+  "campaign/microbench_stderr_$R.log" 1800 python tools/microbench.py
 
 # 5. packed5 output-encoding measurement (sets S2C_P5_DEV_NS evidence)
-timeout -k 30 1200 python tools/measure_p5.py > campaign/measure_p5_r05.jsonl \
-  2> campaign/measure_p5_stderr_r05.log
-rc=$?
-echo "$(date +%H:%M:%S) measure_p5 done rc=$rc" >> "$LOG"
+run_step measure_p5 "campaign/measure_p5_$R.jsonl" \
+  "campaign/measure_p5_stderr_$R.log" 1200 python tools/measure_p5.py
 
 # 5b. fast-link placement artifact, on-chip half (VERDICT r4 #7): force
 # PCIe-class constants so every placement gate flips device-side, and
 # record the flipped decisions in measured bench rows (the real link is
 # still the tunnel, so the absolute numbers are slow — the point is the
 # rows' pileup/tail_device/encoding fields showing the coherent flip;
-# the offline half is campaign/fastlink_matrix_r05.json)
+# the offline half is campaign/fastlink_matrix_$R.json)
 S2C_TAIL_RT_MS=1 S2C_TAIL_LINK_MBPS=2000 S2C_LINK_PROBE=0 \
   BENCH_CONFIGS=ecoli_scale,wide_genome BENCH_WIDE_ORACLE_SHRINK=16 \
   BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 \
-  timeout -k 30 3600 python bench.py > campaign/fastlink_bench_r05.json \
-  2> campaign/fastlink_bench_stderr_r05.log
-rc=$?
-echo "$(date +%H:%M:%S) fastlink bench done rc=$rc" >> "$LOG"
+  run_step fastlink_bench "campaign/fastlink_bench_$R.json" \
+  "campaign/fastlink_bench_stderr_$R.log" 3600 python bench.py
 
 # 6. link probe (refresh PERF.md numbers)
-timeout -k 30 900 python tools/tunnel_probe.py > campaign/tunnel_probe_r05.json \
-  2> campaign/tunnel_probe_stderr_r05.log
-rc=$?
-echo "$(date +%H:%M:%S) probe done rc=$rc; campaign complete" >> "$LOG"
+run_step tunnel_probe "campaign/tunnel_probe_$R.json" \
+  "campaign/tunnel_probe_stderr_$R.log" 900 python tools/tunnel_probe.py
+
+# 7. chaos-mode bench leg (resilience evidence): probabilistic fault
+# injection across the device path with the degradation ladder armed.
+# The rows' resilience/* and fault/* counters record the recovery story
+# (retries, splits, demotions) while FASTA correctness is still gated
+# by the bench's oracle comparison; deterministic via S2C_FAULT_SEED.
+S2C_FAULT_INJECT="pileup_dispatch:rpc:p0.03,vote:rpc:p0.15,device_put:rpc:p0.02" \
+  S2C_FAULT_SEED=7 S2C_ON_DEVICE_ERROR=fallback \
+  BENCH_CONFIGS=ecoli_scale BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 \
+  run_step chaos_bench "campaign/chaos_bench_$R.json" \
+  "campaign/chaos_bench_stderr_$R.log" 3600 python bench.py
+
+echo "$(date +%H:%M:%S) campaign complete" >> "$LOG"
