@@ -266,6 +266,26 @@ def util_fields(stats, jax_time):
             # conservatism, shown here so the % is interpretable
             u["link_util_pct"] = round(
                 100.0 * (h2d + d2h) / jax_time / link_bps, 1)
+    # R6 wire + pipeline story: what the row codec saved on the link and
+    # how much of the staging transfer work ran under accumulate
+    wire_info = stats.extra.get("wire")
+    if isinstance(wire_info, dict) and wire_info.get("chosen"):
+        u["wire_codec"] = wire_info["chosen"]
+    raw_b = stats.extra.get("wire/raw_bytes", 0)
+    wire_b = stats.extra.get("wire/bytes", 0)
+    if raw_b and wire_b:
+        u["wire_ratio"] = round(raw_b / wire_b, 2)
+    ov = stats.extra.get("pipeline/overlap_sec")
+    if ov is not None:
+        u["overlap_sec"] = round(ov, 4)
+        # denominator: the stager's own stage seconds (encode+transfer
+        # work only — the phase/stage_sec counter matches it now that
+        # slot backpressure is clocked outside the stage span)
+        pinfo = stats.extra.get("pipeline")
+        ssec = (pinfo or {}).get("stage_sec") \
+            or stats.extra.get("stage_sec", 0)
+        if ssec:
+            u["overlap_pct"] = round(100.0 * ov / ssec, 1)
     ps = stats.extra.get("pileup_dispatch_sec", 0)
     device_pileup = any(k.startswith(("scatter_", "mxu_", "pallas_",
                                       "window_", "routed_", "dpsp_"))

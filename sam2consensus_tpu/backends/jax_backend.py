@@ -150,7 +150,10 @@ P5_DEV_NS_PER_CHAR = float(os.environ.get("S2C_P5_DEV_NS", "2"))
 #: 0.77-2.23x at 1e7 (two runs; tunnel-state variance).  The window
 #: below keeps the kernel where it wins consistently; outside it, and
 #: for any host-routed or interpret-mode tail, scatter is the measured
-#: choice.
+#: choice.  Re-pins come from tools/ins_window_calibrate.py only —
+#: median of 3 independent runs per point with the per-run samples
+#: committed (campaign/ins_window_<round>.jsonl), never from a single
+#: run (VERDICT r5 #4).
 PALLAS_INS_MIN_EVENTS = 1_000_000
 PALLAS_INS_MAX_EVENTS = 16_000_000
 
@@ -303,17 +306,30 @@ class _Prefetcher:
     strict-mode decode errors (the oracle's KeyError/IndexError types),
     whose type/message parity with the serial path is contract — are
     re-raised in the consumer at the point of consumption.
+
+    ``stager`` (wire/pipeline.StageSlots, optional) runs each batch's
+    wire encode + h2d transfer on this thread through its two pinned
+    slots.  The slot ACQUIRE (backpressure — really the consumer's
+    dispatch time) happens outside the ``stage`` span/clock; only the
+    encode+transfer work is billed to ``phase/stage_sec``.
     """
 
     _DONE = object()
 
-    def __init__(self, gen, depth: int = 2, stage=None):
+    #: consecutive staging failures before the pipeline gives up for
+    #: the rest of the run — a single transient blip (one injected RPC
+    #: fault, one dropped tunnel packet) must not permanently serialize
+    #: every remaining transfer when the very next slab would stage fine
+    MAX_STAGE_FAILURES = 3
+
+    def __init__(self, gen, depth: int = 2, stager=None):
         import queue
         import threading
 
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._exc = None
-        self._stage = stage
+        self._stager = stager
+        self._stage_failures = 0       # consecutive; reset on success
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._work, args=(gen,), daemon=True)
@@ -345,27 +361,37 @@ class _Prefetcher:
                         break
                     reg.add("phase/decode_sec",
                             time.perf_counter() - t0)
-                if self._stage is not None:
+                if (self._stager is not None
+                        and self._stage_failures < self.MAX_STAGE_FAILURES
+                        and self._stager.acquire(batch)):
                     # start this batch's h2d transfer now, overlapping the
                     # consumer's dispatch of the previous batch (the device
                     # pileup otherwise serializes transfer with dispatch on
-                    # the link); timed separately from decode.  Staging is
-                    # an OPTIMIZATION, so a device failure here must not
-                    # kill the decode thread: drop staging and deliver the
-                    # batch unstaged — the consumer's own dispatch then
-                    # hits the same failure under the retry policy, which
-                    # is the layer equipped to handle it.
+                    # the link); timed separately from decode, and the slot
+                    # acquire above is OUTSIDE the clock (it is consumer
+                    # dispatch time).  Staging is an OPTIMIZATION, so a
+                    # failure here must not kill the decode thread: the
+                    # stager invalidates the batch's slot, the batch
+                    # delivers unstaged, and the consumer's own encode +
+                    # dispatch replays it under the retry policy — the
+                    # layer equipped to handle it.  Staging re-arms on the
+                    # next batch; only MAX_STAGE_FAILURES consecutive
+                    # failures turn it off for the run.
                     with tr.span("stage"):
                         t0 = time.perf_counter()
                         try:
-                            self._stage(batch)
+                            self._stager.run(batch)
+                            self._stage_failures = 0
                         except Exception as exc:
-                            self._stage = None
+                            self._stage_failures += 1
                             batch.staged.clear()
                             reg.add("resilience/stage_failures", 1)
                             tr.event(
                                 "resilience/stage_failure",
-                                error=f"{type(exc).__name__}: {exc}")
+                                error=f"{type(exc).__name__}: {exc}",
+                                consecutive=self._stage_failures,
+                                disabled=self._stage_failures
+                                >= self.MAX_STAGE_FAILURES)
                         reg.add("phase/stage_sec",
                                 time.perf_counter() - t0)
                 if not self._put(batch):
@@ -441,6 +467,28 @@ class JaxBackend:
         if layout.total_len == 0:
             return BackendResult(fastas={}, stats=stats)
 
+        # run-level row wire codec (sam2consensus_tpu/wire): explicit
+        # --wire wins; auto prices the SAME link constants the tail
+        # placement model uses, so wire compression and tail routing
+        # can never disagree about how fast the link is.  A link-free
+        # default backend ships packed5 — the "saved" wire would be a
+        # memcpy while the encode/decode passes stay real.
+        from ..wire import resolve_codec
+
+        _wire_link_free = jax.default_backend() == "cpu"
+        wire_mode = getattr(cfg, "wire", "auto")
+        _wire_bps = None
+        if wire_mode == "auto" and not _wire_link_free:
+            _rt_unused, _wire_bps = _link_constants()
+        wire_sel, wire_reason = resolve_codec(
+            wire_mode, _wire_bps, link_free=_wire_link_free)
+        winfo = {"requested": wire_mode, "chosen": wire_sel,
+                 "reason": wire_reason}
+        if _wire_bps is not None:
+            winfo["link_bps"] = int(_wire_bps)
+        reg.gauge("wire/codec").set_info(winfo)
+        tr.event("wire/codec", **winfo)
+
         n_dev = len(jax.devices())
         shards = cfg.shards if cfg.shards > 0 else n_dev
         if getattr(cfg, "pileup", "auto") == "host" and cfg.shards == 0:
@@ -488,9 +536,11 @@ class JaxBackend:
                      "native_tail": bool(_native_ok),
                      "link_free": bool(_link_free)})
             else:
-                acc = PileupAccumulator(layout.total_len, strategy=strategy)
+                acc = PileupAccumulator(layout.total_len, strategy=strategy,
+                                        wire=wire_sel)
                 reg.gauge("dispatch/pileup").set_info(
                     {"path": "device", "strategy": strategy,
+                     "wire": wire_sel,
                      "total_len": int(layout.total_len)})
 
         # checkpoint resume: counts + insertion log + consumed-line offset
@@ -576,7 +626,8 @@ class JaxBackend:
             reg.add("phase/decode_sec", time.perf_counter() - td)
             tr.complete("decode", td)
             acc = self._build_sharded_acc(cfg, layout, shards, first_batch,
-                                          max_row_width, stats)
+                                          max_row_width, stats,
+                                          wire=wire_sel)
             if ck is not None:
                 acc.restore(ck.counts)
             if first_batch is not None:
@@ -594,19 +645,29 @@ class JaxBackend:
             #   costs ~6 ms — the entire fixed budget of a small-input
             #   run (measured: phix 14.6 -> ~9 ms)
             batch_iter = _timed_iter(src)
+            stager = None
         else:
             # overlap host decode with pileup work (SURVEY.md §7(d)): a
             # bounded prefetch thread decodes the next slabs while this
             # thread feeds the accumulator (ctypes/C++ decode releases the
             # GIL, so the overlap is real).  Accumulators exposing
-            # ``stage`` additionally get their h2d transfers issued from
-            # the prefetch thread, overlapping transfer with dispatch —
-            # except under --paranoid, whose contract is that batches are
-            # re-validated BEFORE anything ships to the device.
-            batch_iter = _Prefetcher(
-                src,
-                stage=None if cfg.paranoid
-                else getattr(acc, "stage", None))
+            # ``stage`` additionally get their wire encode + h2d
+            # transfers issued from the prefetch thread through TWO
+            # pinned staging slots (wire/pipeline.StageSlots): slab N+1
+            # encodes and transfers while slab N accumulates, with
+            # backpressure when both slots are in flight, and the
+            # stage/accumulate overlap measured into
+            # ``pipeline/overlap_sec`` — except under --paranoid, whose
+            # contract is that batches are re-validated BEFORE anything
+            # ships to the device.
+            stage_fn = None if cfg.paranoid else getattr(acc, "stage",
+                                                         None)
+            stager = None
+            if stage_fn is not None:
+                from ..wire.pipeline import StageSlots
+
+                stager = StageSlots(stage_fn)
+            batch_iter = _Prefetcher(src, stager=stager)
 
         # the accumulate loop's failure contract (resilience/): every
         # device dispatch runs under the retry policy; persistent
@@ -633,9 +694,11 @@ class JaxBackend:
         def _rebind_stage(acc_):
             # a demoted accumulator must also re-route (or drop) the
             # prefetch thread's device staging — the old accumulator's
-            # stage() would keep shipping batches to the failing device
-            if isinstance(batch_iter, _Prefetcher):
-                batch_iter._stage = None if cfg.paranoid \
+            # stage() would keep shipping batches to the failing device.
+            # The stager rebinds in place (its slots and overlap log
+            # survive the demotion).
+            if stager is not None:
+                stager.stage_fn = None if cfg.paranoid \
                     else getattr(acc_, "stage", None)
 
         dispatcher = rladder.ResilientDispatcher(
@@ -654,6 +717,12 @@ class JaxBackend:
                     acc = dispatcher.add(acc, batch)
                 reg.add("phase/pileup_dispatch_sec",
                         time.perf_counter() - ta)
+                if stager is not None:
+                    # release this batch's staging slot (backpressure
+                    # window moves to the next slab) and log the
+                    # dispatch interval for the overlap measurement
+                    stager.note_consume(ta, time.perf_counter())
+                    stager.consumed(batch)
                 stats.aligned_bases += batch.n_events
                 if (cfg.checkpoint_dir
                         and encoder.n_reads - reads_at_ckpt
@@ -664,10 +733,26 @@ class JaxBackend:
                     reads_at_ckpt = encoder.n_reads
         finally:
             # consumer-side failure (paranoid reject, device error) must not
-            # leave the decode thread blocked on a full queue holding the
-            # input stream open
+            # leave the decode thread blocked on a full queue (or a
+            # backpressured staging slot) holding the input stream open
+            if stager is not None:
+                stager.close()
             if isinstance(batch_iter, _Prefetcher):
                 batch_iter.close()
+        if stager is not None:
+            # the pipeline's measured story: how much of the staging
+            # thread's encode+transfer work ran UNDER the consumer's
+            # dispatch windows (a serialized pipeline reports ~0)
+            ov = stager.overlap_sec()
+            ssec = stager.stage_sec()
+            reg.add("pipeline/overlap_sec", ov)
+            reg.add("pipeline/backpressure_sec", stager.backpressure_sec)
+            reg.gauge("pipeline/overlap").set_info({
+                "overlap_sec": round(ov, 4),
+                "stage_sec": round(ssec, 4),
+                "slots": stager.slots,
+                "staged_batches": stager.staged_batches,
+                "overlap_frac": round(ov / ssec, 3) if ssec > 0 else 0.0})
         if dispatcher.demotions:
             # the ladder may have landed the run on a different rung
             # (scatter-pinned device acc, or the host accumulator): the
@@ -1145,7 +1230,8 @@ class JaxBackend:
     # -- sharded-accumulator construction ---------------------------------
     @staticmethod
     def _build_sharded_acc(cfg, layout, shards: int, first_batch,
-                           ck_max_width: int, stats):
+                           ck_max_width: int, stats,
+                           wire: str = "packed5"):
         """Build the sharded accumulator from the first decoded batch.
 
         Two round-4 verdict items live here:
@@ -1178,8 +1264,10 @@ class JaxBackend:
         mesh = make_mesh(shards)
         if mode == "auto":
             if first_batch is not None:
+                # link terms bill POST-codec bytes: the routers ship the
+                # same slab payloads, through the same wire codec
                 rows, rb, _mw, imb, sfrac = shard_auto.slab_stats(
-                    first_batch.buckets, layout.total_len)
+                    first_batch.buckets, layout.total_len, wire=wire)
             else:
                 rows, rb, imb, sfrac = 0, 0, 1.0, 0.0
             _rt, link_bps = _link_constants()
@@ -1202,19 +1290,21 @@ class JaxBackend:
 
             acc = PositionShardedConsensus(
                 mesh, layout.total_len, halo=min(block, halo),
-                pileup=sp_pileup)
+                pileup=sp_pileup, wire=wire)
         elif mode == "dpsp":
             from ..parallel.dpsp import ProductShardedConsensus
 
             macro = block * shards // mesh.shape["sp"]
             acc = ProductShardedConsensus(
                 mesh, layout.total_len,
-                halo=max(1, min(macro, halo)), pileup=sp_pileup)
+                halo=max(1, min(macro, halo)), pileup=sp_pileup,
+                wire=wire)
         else:
             from ..parallel.dp import ShardedConsensus
 
             acc = ShardedConsensus(mesh, layout.total_len,
-                                   pileup=getattr(cfg, "pileup", "auto"))
+                                   pileup=getattr(cfg, "pileup", "auto"),
+                                   wire=wire)
         stats.extra["shard_mode"] = mode
         if hasattr(acc, "halo"):
             stats.extra["halo"] = int(acc.halo)

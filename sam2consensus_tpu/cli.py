@@ -106,6 +106,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "native code, ship the tensor once; "
                         "single-device). scatter/mxu compose with "
                         "--shards in the dp shard layout")
+    p.add_argument("--wire", choices=["auto", "packed5", "delta8"],
+                   default="auto",
+                   help="host->device row wire codec (jax backend): "
+                        "packed5 (the legacy packed lanes: int32 starts "
+                        "+ 4-bit code nibbles), delta8 (delta-compressed "
+                        "starts with an escape lane + 2-bit ACGT planes "
+                        "+ trailing-pad elision; a device-side unpack "
+                        "stage reconstitutes identical operands, so "
+                        "counts are byte-identical), or auto (default: "
+                        "delta8 below the modeled ~71 MB/s link "
+                        "crossover, packed5 on fast/link-free paths — "
+                        "same link constants as the tail placement "
+                        "model). Env S2C_WIRE overrides")
     p.add_argument("--insertion-kernel", dest="ins_kernel",
                    choices=["auto", "scatter", "pallas"], default="auto",
                    help="insertion-table build on device: XLA scatter or "
@@ -166,7 +179,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(tests/chaos): comma-separated "
                         "site:kind:after_n[:times] specs — sites "
                         "device_put|pileup_dispatch|accumulate|vote|"
-                        "insertion_build|link_probe, kinds rpc|timeout|oom|"
+                        "insertion_build|link_probe|wire_encode, kinds "
+                        "rpc|timeout|oom|"
                         "fatal|trace, after_n an integer call count or "
                         "pP probability (seeded by S2C_FAULT_SEED), times "
                         "an integer or inf. Env S2C_FAULT_INJECT also "
@@ -214,6 +228,7 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         py2_compat=args.py2_compat,
         decoder=args.decoder,
         pileup=args.pileup,
+        wire=args.wire,
         decode_threads=args.decode_threads,
         ins_kernel=args.ins_kernel,
         chunk_reads=args.chunk_reads,

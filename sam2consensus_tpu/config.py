@@ -36,6 +36,9 @@ class RunConfig:
     py2_compat: bool = False
     decoder: str = "auto"        # auto | native | py (jax backend host decode)
     pileup: str = "auto"         # auto | mxu | scatter | host (pileup strategy)
+    wire: str = "auto"           # auto | packed5 | delta8 (h2d row wire codec,
+    #                              sam2consensus_tpu/wire; auto prices the
+    #                              measured link rate)
     decode_threads: int = 1      # fused-decode workers; 0 = auto (<=4)
     ins_kernel: str = "auto"  # auto | scatter | pallas (insertion table)
     shard_mode: str = "auto"     # auto | dp | sp | dpsp (accumulator layout)

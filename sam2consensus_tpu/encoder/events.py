@@ -72,6 +72,32 @@ class GenomeLayout:
 
 
 @dataclass
+class StagedSlab:
+    """One bucket's device-staged wire payload.
+
+    Placed by the decode prefetch thread (``PileupAccumulator.stage``)
+    so this batch's h2d transfer overlaps the previous batch's dispatch
+    instead of serializing with it on the link.  ``codec`` names the
+    wire format the operands travelled in (``sam2consensus_tpu/wire``):
+    ``"packed5"`` operands are the legacy ``(starts_dev, packed_dev)``
+    pair; ``"delta8"`` operands are the compressed lanes, reconstituted
+    on device by ``wire.device.decode_to_packed`` using ``meta``
+    ``(width, sentinel)``.  ``nbytes`` is what actually crossed the
+    link; ``raw_nbytes`` the packed5-equivalent bill (the compression
+    ratio's denominator/numerator in the ``wire/*`` metrics).
+    """
+    codec: str
+    operands: Tuple
+    nbytes: int
+    raw_nbytes: int
+    meta: Optional[Tuple] = None
+    #: set once the slab's wire bytes have been billed — a retry/ladder
+    #: replay re-consumes the SAME device operands without re-crossing
+    #: the link, and must not re-bill them
+    billed: bool = False
+
+
+@dataclass
 class SegmentBatch:
     """One host→device batch of per-read pileup segments.
 
@@ -87,12 +113,11 @@ class SegmentBatch:
     #: into the host count tensor (encoder/native_encoder.py): buckets are
     #: empty and consumers must not re-accumulate
     accumulated: bool = False
-    #: optional device-staged operands ``{w: (starts_dev, packed_dev,
-    #: wire_bytes)}`` placed by the decode prefetch thread
-    #: (``PileupAccumulator.stage``) so the h2d transfer of this batch
-    #: overlaps the previous batch's dispatch instead of serializing
-    #: with it on the link
-    staged: Dict[int, Tuple] = field(default_factory=dict)
+    #: optional device-staged operands ``{w: StagedSlab}`` placed by the
+    #: decode prefetch thread (``PileupAccumulator.stage``); a staging
+    #: failure clears this dict and the batch replays unstaged through
+    #: the consumer's retry policy / ladder (resilience/)
+    staged: Dict[int, StagedSlab] = field(default_factory=dict)
 
 
 @dataclass

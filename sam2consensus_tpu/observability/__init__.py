@@ -135,8 +135,16 @@ def publish_stats_extra(extra: dict) -> None:
         # a degraded run is visible from any artifact
         elif name.startswith(("resilience/", "fault/")):
             extra[name] = int(value)
+        # the wire codec's compression story and the staging pipeline's
+        # measured overlap (wire/bytes vs wire/raw_bytes is the ratio;
+        # pipeline/overlap_sec is the R6 acceptance metric)
+        elif name.startswith(("wire/", "pipeline/")):
+            extra[name] = int(value) if float(value).is_integer() \
+                else round(value, 4)
     for gauge_name, extra_key in (("dispatch/tail", "tail_dispatch"),
-                                  ("dispatch/pileup", "pileup_path")):
+                                  ("dispatch/pileup", "pileup_path"),
+                                  ("wire/codec", "wire"),
+                                  ("pipeline/overlap", "pipeline")):
         g = snap["gauges"].get(gauge_name)
         if g is not None and g.get("info"):
             extra[extra_key] = g["info"]

@@ -31,8 +31,39 @@ import numpy as np
 
 from .. import observability as obs
 from ..constants import NUM_SYMBOLS, PAD_CODE
-from ..encoder.events import SegmentBatch
+from ..encoder.events import SegmentBatch, StagedSlab
 from ..resilience.faultinject import fault_check
+from ..wire import codec as wire_codec
+
+
+def account_wire(codec: str, nbytes: int, raw_nbytes: int) -> None:
+    """One slab's wire bill into the run's registry: ``wire/bytes`` is
+    what crossed the link, ``wire/raw_bytes`` the packed5-equivalent
+    bill — their ratio is the compression the bench rows report.
+    Shared by the single-device and sharded accumulators so the
+    accounting cannot drift between paths."""
+    reg = obs.metrics()
+    reg.add("wire/bytes", nbytes)
+    reg.add("wire/raw_bytes", raw_nbytes)
+    reg.add(f"wire/slabs/{codec}", 1)
+
+
+def encode_wire_slab(wire: str, starts, codes, chunks: int = 1):
+    """The delta8 encode gate shared by every row-shipping path:
+    ``None`` means ship the packed5 lanes (codec off, shape cannot
+    chunk, or the encoded slab would not shrink — escape-dense slabs
+    are billed honestly, per slab).  The ``wire_encode`` fault site
+    fires here, on whichever thread is encoding (staging or consumer
+    fallback)."""
+    if wire != "delta8":
+        return None
+    fault_check("wire_encode")
+    slab = wire_codec.encode_slab(np.asarray(starts), np.asarray(codes),
+                                  chunks=chunks)
+    if slab is None or not wire_codec.worthwhile(slab):
+        obs.metrics().add("wire/fallback_slabs", 1)
+        return None
+    return slab
 
 
 #: cap on expanded scatter cells (rows x width) per device call, bounding the
@@ -509,12 +540,17 @@ class PileupAccumulator:
       free of correctness cost.
     """
 
-    def __init__(self, total_len: int, device=None, strategy: str = "auto"):
+    def __init__(self, total_len: int, device=None, strategy: str = "auto",
+                 wire: str = "packed5"):
         from . import mxu_pileup, pallas_pileup
 
         self.total_len = total_len
         self.device = device
         self.strategy = strategy
+        #: resolved row wire codec (sam2consensus_tpu/wire): the backend
+        #: passes the run-level ``--wire`` decision; "delta8" compresses
+        #: every staged/shipped slab, with per-slab packed5 fallback
+        self.wire = wire
         self._tile = mxu_pileup.TILE_POSITIONS
         # position axis padded to whole tiles; the scatter path's
         # sacrificial row (index total_len) lives inside the pad
@@ -556,16 +592,64 @@ class PileupAccumulator:
         which round-3 bench profiles showed capping the device pileup at
         ~half the link rate (ecoli `pileup_dispatch_sec`).
 
-        A device failure here (the ``device_put`` injection site) is
-        caught by the prefetcher, which disables staging and delivers
-        the batch unstaged — the consumer's own transfer then meets the
-        same failure under the retry policy (resilience/)."""
+        A device failure here (the ``device_put`` / ``wire_encode``
+        injection sites) is caught by the staging pipeline, which
+        invalidates the batch's staging slot and delivers it unstaged —
+        the consumer's own encode + transfer then meets the same
+        failure under the retry policy (resilience/)."""
         fault_check("device_put")
         for w, (starts, codes) in batch.buckets.items():
-            packed = pack_nibbles(codes)
-            batch.staged[w] = (jax.device_put(starts, self.device),
-                               jax.device_put(packed, self.device),
-                               starts.nbytes + packed.nbytes)
+            if self.wire == "delta8":
+                # canonical (sorted) row order, written back into the
+                # batch so the consumer's host-side kernel planning sees
+                # exactly the rows the staged decode will produce
+                starts, codes = wire_codec.canonicalize_rows(starts,
+                                                             codes)
+                batch.buckets[w] = (starts, codes)
+            batch.staged[w] = self._ship_slab(starts, codes)
+
+    def _ship_slab(self, starts, codes) -> StagedSlab:
+        """Encode + device_put one bucket's rows under the run's wire
+        codec; returns the StagedSlab whose operands ``_consume_slab``
+        turns back into (starts_dev, packed_dev)."""
+        raw = wire_codec.packed5_slab_bytes(len(starts), codes.shape[1])
+        slab = encode_wire_slab(self.wire, starts, codes)
+        if slab is not None:
+            ops = tuple(jax.device_put(a, self.device)
+                        for a in slab.arrays())
+            return StagedSlab("delta8", ops, slab.wire_bytes, raw,
+                              meta=(slab.width, slab.sentinel))
+        packed = pack_nibbles(codes)
+        return StagedSlab(
+            "packed5",
+            (jax.device_put(starts, self.device),
+             jax.device_put(packed, self.device)),
+            starts.nbytes + packed.nbytes, raw)
+
+    def _consume_slab(self, staged: StagedSlab):
+        """(starts_dev, packed_dev) from a shipped slab — the delta8
+        unpack stage runs here, on device, reconstituting the exact
+        legacy operands before any kernel sees them."""
+        from ..wire import device as wire_device
+
+        if not staged.billed:
+            # bill once per slab, not per attempt: a retry / ladder
+            # replay re-consumes the same device operands without the
+            # bytes re-crossing the link
+            staged.billed = True
+            self.bytes_h2d += staged.nbytes
+            account_wire(staged.codec, staged.nbytes, staged.raw_nbytes)
+            if staged.codec == "delta8":
+                # recorded in strategy_used only when the codec engaged
+                # — the packed5 default is the absence of the key (and
+                # the wire/* metrics carry the full story either way)
+                self.strategy_used["wire_delta8"] = \
+                    self.strategy_used.get("wire_delta8", 0) + 1
+        if staged.codec == "delta8":
+            width, sentinel = staged.meta
+            return wire_device.decode_to_packed(
+                *staged.operands, width=width, sentinel=sentinel)
+        return staged.operands
 
     def add(self, batch: SegmentBatch) -> None:
         from . import mxu_pileup, pallas_pileup
@@ -575,6 +659,11 @@ class PileupAccumulator:
                        else self.strategy)
         for w, (starts, codes) in sorted(batch.buckets.items()):
             staged = batch.staged.get(w)
+            if self.wire == "delta8" and staged is None:
+                # unstaged delta8 slab: canonicalize here (the staging
+                # path already did, and wrote the batch back)
+                starts, codes = wire_codec.canonicalize_rows(starts,
+                                                             codes)
             # slab pow2 padding appends a contiguous all-PAD tail at
             # start 0; those rows count nothing (scatter self-redirects
             # them) but would pile into MXU tile 0 and trip the skew
@@ -598,15 +687,12 @@ class PileupAccumulator:
 
             def put_operands():
                 """(starts_dev, packed_dev): staged by the prefetch
-                thread when available, transferred here otherwise."""
+                thread when available, encoded + transferred here
+                otherwise (same wire codec either way)."""
                 if staged is not None:
-                    st, pk, nbytes = staged
-                    self.bytes_h2d += nbytes
-                    return st, pk
+                    return self._consume_slab(staged)
                 fault_check("device_put")
-                packed = pack_nibbles(codes)
-                self.bytes_h2d += starts.nbytes + packed.nbytes
-                return jnp.asarray(starts), jnp.asarray(packed)
+                return self._consume_slab(self._ship_slab(starts, codes))
 
             def plan_mxu():
                 if n_rows == 0:

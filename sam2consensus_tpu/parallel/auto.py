@@ -75,9 +75,16 @@ def _dp_max_local_bytes() -> float:
 _SP_FIXED_SEC = 2e-4
 
 
-def slab_stats(buckets, total_len: int) -> tuple:
+def slab_stats(buckets, total_len: int, wire: str = "packed5") -> tuple:
     """(rows, row_bytes, max_width, peak_frac, sorted_frac) of one
     decoded slab for :func:`choose_shard_mode`.
+
+    ``wire`` is the run's resolved row wire codec
+    (``sam2consensus_tpu/wire``): the routers ship the same slab
+    payloads as the single-device path, so the model's link terms must
+    bill POST-codec bytes — a delta8 run's grid-inflation penalty is
+    roughly halved, which can flip a clustered-tunnel decision from
+    dpsp back to sp (pinned by tests/test_wire.py).
 
     ``peak_frac`` is the heaviest 1/64th-of-genome bin's share of the
     slab's rows — a device owning that region of the position axis
@@ -87,6 +94,8 @@ def slab_stats(buckets, total_len: int) -> tuple:
     strategy would absorb, judged by the window path's real gates
     (parallel.sp: pow2 span within the cap and the density bound).
     """
+    from ..wire.codec import row_bytes_estimate
+
     rows = 0
     row_bytes = 0
     max_w = 0
@@ -106,7 +115,7 @@ def slab_stats(buckets, total_len: int) -> tuple:
         if len(s) == 0:
             continue
         rows += len(s)
-        row_bytes += len(s) * (w // 2 + 4)
+        row_bytes += int(len(s) * row_bytes_estimate(w, wire))
         max_w = max(max_w, w)
         span = float(s.max()) + w - float(s.min())
         wp = 1 << max(10, int(span - 1).bit_length())

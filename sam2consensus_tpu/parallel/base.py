@@ -204,13 +204,18 @@ class ShardedCountsBase:
     """
 
     def __init__(self, mesh: Mesh, total_len: int,
-                 pos_axes: Tuple[str, str] = ALL):
+                 pos_axes: Tuple[str, str] = ALL, wire: str = "packed5"):
         self.mesh = mesh
         self.n = mesh.size
         self.pos_axes = pos_axes
         self.total_len = total_len
         self.block = block_for(total_len, self.n)
         self.padded_len = self.block * self.n
+        #: resolved row wire codec (sam2consensus_tpu/wire); the routers
+        #: ship the SAME slab payloads as the single-device path, so the
+        #: same codec applies to every routed/windowed/dp slice
+        self.wire = wire
+        self._wire_decode = None               # lazily built sharded jit
 
         # counts allocate lazily: memory-bound tests compile the sharded
         # accumulate at chromosome scale (250 Mbp) via ShapeDtypeStruct
@@ -219,6 +224,46 @@ class ShardedCountsBase:
         self._row_spec = NamedSharding(mesh, P(ALL))
         self._mat_spec = NamedSharding(mesh, P(ALL, None))
         self.bytes_h2d = 0                     # wire accounting for bench
+
+    def put_rows(self, starts: np.ndarray, codes: np.ndarray):
+        """Ship one slice's row operands, wire-encoded when it pays.
+
+        Returns ``(starts_dev [S] row-sharded, packed_dev [S, ⌈W/2⌉])``
+        — exactly what every shard_map accumulate kernel consumes — so
+        the dp scatter, the sp window/routed paths and the dpsp product
+        router all compress through ONE shipping point.  The slice is
+        encoded in ``n`` chunks matching the row sharding (each device's
+        contiguous rows form one delta chain), and the decode runs as a
+        sharded jit with the legacy operand shardings, so the unpack is
+        local to the owning device.  Slices whose row count does not
+        chunk evenly, or whose encoding would not shrink, ship the
+        legacy packed5 lanes (recorded per slab).
+        """
+        from ..ops.pileup import (account_wire, encode_wire_slab,
+                                  pack_nibbles)
+        from ..wire import codec as wire_codec
+
+        raw = wire_codec.packed5_slab_bytes(len(starts), codes.shape[1])
+        slab = encode_wire_slab(self.wire, starts, codes, chunks=self.n)
+        if slab is None:
+            packed = pack_nibbles(codes)
+            self.bytes_h2d += starts.nbytes + packed.nbytes
+            account_wire("packed5", starts.nbytes + packed.nbytes, raw)
+            return (jax.device_put(starts, self._row_spec),
+                    jax.device_put(packed, self._mat_spec))
+        if self._wire_decode is None:
+            from ..wire import device as wire_device
+
+            self._wire_decode = wire_device.decode_fn(
+                out_shardings=(self._row_spec, self._mat_spec))
+        # every lane is chunk-major: sharding dim 0 over the flattened
+        # mesh puts each chunk's lanes on the device that owns its rows
+        ops = tuple(jax.device_put(a, NamedSharding(self.mesh, P(ALL)))
+                    for a in slab.arrays())
+        self.bytes_h2d += slab.wire_bytes
+        account_wire("delta8", slab.wire_bytes, raw)
+        return self._wire_decode(*ops, width=slab.width,
+                                 sentinel=slab.sentinel)
 
     def sync(self) -> None:
         """Profiling barrier (S2C_SYNC_ACCUMULATE): block until every

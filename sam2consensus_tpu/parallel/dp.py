@@ -35,8 +35,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
-                          pack_nibbles, round_rows_grid,
-                          round_rows_pow2, unpack_nibbles)
+                          round_rows_grid, round_rows_pow2,
+                          unpack_nibbles)
+from ..wire.codec import canonicalize_rows
 from .base import ALL, ShardedCountsBase, shard_map
 
 __all__ = ["ShardedConsensus", "ALL"]
@@ -64,10 +65,11 @@ class ShardedConsensus(ShardedCountsBase):
     ``parallel.base.record_slab``.
     """
 
-    def __init__(self, mesh: Mesh, total_len: int, pileup: str = "auto"):
+    def __init__(self, mesh: Mesh, total_len: int, pileup: str = "auto",
+                 wire: str = "packed5"):
         # position axis padded so every device owns an equal block; the
         # sacrificial scatter row (index total_len) lives inside the pad.
-        super().__init__(mesh, total_len)
+        super().__init__(mesh, total_len, wire=wire)
         from ..ops import mxu_pileup
         from ..ops.pileup import PileupAutoTuner
 
@@ -235,6 +237,11 @@ class ShardedConsensus(ShardedCountsBase):
         kernel_name = (self._tuner.kernel if self._tuner is not None
                        else self.pileup)
         for w, (starts, codes) in sorted(batch.buckets.items()):
+            if self.wire == "delta8":
+                # canonical sorted order: what makes the per-chunk
+                # delta chains uint8-tight (wire.codec.canonicalize_rows)
+                starts, codes = canonicalize_rows(starts, codes)
+
             def plan_mxu():
                 return self._plan_mxu(np.asarray(starts), np.asarray(codes))
 
@@ -245,15 +252,12 @@ class ShardedConsensus(ShardedCountsBase):
             def exec_pallas(planned):
                 p_starts, p_codes, plan = planned
                 fn = self._pallas_accumulate(w, plan)
-                p_packed = pack_nibbles(p_codes)
-                self.bytes_h2d += (p_starts.nbytes + p_packed.nbytes
-                                   + plan.rank.nbytes + plan.blk_lo.nbytes
+                self.bytes_h2d += (plan.rank.nbytes + plan.blk_lo.nbytes
                                    + plan.blk_n.nbytes)
+                st_dev, pk_dev = self.put_rows(
+                    p_starts.astype(np.int32), p_codes)
                 self._counts = fn(
-                    self.counts,
-                    jax.device_put(p_starts.astype(np.int32),
-                                   self._row_spec),
-                    jax.device_put(p_packed, self._mat_spec),
+                    self.counts, st_dev, pk_dev,
                     jax.device_put(plan.rank.reshape(-1), self._row_spec),
                     jax.device_put(plan.blk_lo, self._mat_spec),
                     jax.device_put(plan.blk_n, self._mat_spec))
@@ -261,13 +265,10 @@ class ShardedConsensus(ShardedCountsBase):
             def exec_mxu(plan):
                 p_starts, p_codes, slots, e = plan
                 fn = self._mxu_accumulate(e, w)
-                p_packed = pack_nibbles(p_codes)
-                self.bytes_h2d += (p_starts.nbytes + p_packed.nbytes
-                                   + slots.nbytes)
+                self.bytes_h2d += slots.nbytes
+                st_dev, pk_dev = self.put_rows(p_starts, p_codes)
                 self._counts = fn(
-                    self.counts,
-                    jax.device_put(p_starts, self._row_spec),
-                    jax.device_put(p_packed, self._mat_spec),
+                    self.counts, st_dev, pk_dev,
                     jax.device_put(slots, self._row_spec))
 
             def exec_scatter():
@@ -282,13 +283,13 @@ class ShardedConsensus(ShardedCountsBase):
                     cds = np.concatenate(
                         [cds, np.full((target - s, cds.shape[1]),
                                       PAD_CODE, dtype=np.uint8)])
-                packed = pack_nibbles(cds)
-                self.bytes_h2d += sts.nbytes + packed.nbytes
                 for lo, hi in iter_row_slices(target, w, multiple_of=self.n):
+                    # each slice ships through the run's wire codec,
+                    # chunked to match the slice's n-way row sharding
+                    st_dev, pk_dev = self.put_rows(sts[lo:hi],
+                                                   cds[lo:hi])
                     self._counts = self._accumulate(
-                        self.counts,
-                        jax.device_put(sts[lo:hi], self._row_spec),
-                        jax.device_put(packed[lo:hi], self._mat_spec))
+                        self.counts, st_dev, pk_dev)
 
             # one-element fetch, not block_until_ready: the latter returns
             # early over the tunneled runtime (tools/tunnel_probe.py)

@@ -49,7 +49,7 @@ from jax.sharding import PartitionSpec as P
 from ..constants import NUM_SYMBOLS
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
-                          pack_nibbles, round_rows_grid, unpack_nibbles)
+                          round_rows_grid, unpack_nibbles)
 from .base import (ALL, ShardedCountsBase, plan_mxu_grids, real_row_mask,
                    record_slab, route_to_slots, shard_map,
                    split_wide_rows)
@@ -61,8 +61,9 @@ class ProductShardedConsensus(ShardedCountsBase):
     """Streaming dp x sp accumulate + vote over the 2-D mesh."""
 
     def __init__(self, mesh, total_len: int, halo: int = 1 << 16,
-                 pileup: str = "scatter"):
-        super().__init__(mesh, total_len, pos_axes=("sp", "dp"))
+                 pileup: str = "scatter", wire: str = "packed5"):
+        super().__init__(mesh, total_len, pos_axes=("sp", "dp"),
+                         wire=wire)
         self.n_dp = mesh.shape["dp"]
         self.n_sp = mesh.shape["sp"]
         if self.n_dp < 2 or self.n_sp < 2:
@@ -232,14 +233,10 @@ class ProductShardedConsensus(ShardedCountsBase):
                 jax.device_put(a, self._row_spec if a.ndim == 1
                                else self._mat_spec) for a in extra)
             self.bytes_h2d += sum(a.nbytes for a in extra)
-            p_slab = pack_nibbles(np.ascontiguousarray(
-                c_grid[:, :, lo:hi]).reshape(-1, w))
-            s_slab = sl.reshape(-1)
-            self.bytes_h2d += s_slab.nbytes + p_slab.nbytes
-            self._counts = fn(
-                self.counts,
-                jax.device_put(s_slab, self._row_spec),
-                jax.device_put(p_slab, self._mat_spec), *extra_dev)
+            st_dev, pk_dev = self.put_rows(
+                sl.reshape(-1),
+                np.ascontiguousarray(c_grid[:, :, lo:hi]).reshape(-1, w))
+            self._counts = fn(self.counts, st_dev, pk_dev, *extra_dev)
             self.rows_shipped += self.n * (hi - lo)
         key = f"dpsp_{self.pileup}_w{w}"
         self.strategy_used[key] = self.strategy_used.get(key, 0) + 1
@@ -254,6 +251,10 @@ class ProductShardedConsensus(ShardedCountsBase):
             t0 = time.perf_counter()
             starts = np.asarray(starts)
             codes = np.asarray(codes)
+            if self.wire == "delta8":
+                from ..wire.codec import canonicalize_rows
+
+                starts, codes = canonicalize_rows(starts, codes)
             if w > self.halo:
                 starts, codes, w = split_wide_rows(
                     starts, codes, w, self.halo, self.padded_len)
@@ -301,15 +302,13 @@ class ProductShardedConsensus(ShardedCountsBase):
                             len(starts), w)
                 continue
             for lo_r, hi_r in iter_row_slices(r, w):
-                s_slab = np.ascontiguousarray(
-                    s_routed[:, :, lo_r:hi_r]).reshape(-1)
-                p_slab = pack_nibbles(np.ascontiguousarray(
-                    c_routed[:, :, lo_r:hi_r]).reshape(-1, w))
-                self.bytes_h2d += s_slab.nbytes + p_slab.nbytes
-                self._counts = self._accumulate(
-                    self.counts,
-                    jax.device_put(s_slab, self._row_spec),
-                    jax.device_put(p_slab, self._mat_spec))
+                st_dev, pk_dev = self.put_rows(
+                    np.ascontiguousarray(
+                        s_routed[:, :, lo_r:hi_r]).reshape(-1),
+                    np.ascontiguousarray(
+                        c_routed[:, :, lo_r:hi_r]).reshape(-1, w))
+                self._counts = self._accumulate(self.counts, st_dev,
+                                                pk_dev)
                 self.rows_shipped += self.n * (hi_r - lo_r)
             key = f"dpsp_w{w}"
             self.strategy_used[key] = self.strategy_used.get(key, 0) + 1

@@ -57,7 +57,7 @@ import numpy as np
 from ..constants import NUM_SYMBOLS, PAD_CODE, SP_WINDOW_CAP
 from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
-                          pack_nibbles, round_rows_grid, unpack_nibbles)
+                          round_rows_grid, unpack_nibbles)
 from .base import (ALL, ShardedCountsBase, block_for, plan_mxu_grids,
                    real_row_mask, record_slab, route_to_slots, shard_map,
                    split_wide_rows)
@@ -80,8 +80,8 @@ class PositionShardedConsensus(ShardedCountsBase):
     WINDOW_CAP = SP_WINDOW_CAP
 
     def __init__(self, mesh, total_len: int, halo: int = 1 << 16,
-                 pileup: str = "scatter"):
-        super().__init__(mesh, total_len)
+                 pileup: str = "scatter", wire: str = "packed5"):
+        super().__init__(mesh, total_len, wire=wire)
         self.halo = halo
         if self.block < halo:
             raise ValueError(
@@ -269,14 +269,10 @@ class PositionShardedConsensus(ShardedCountsBase):
                 jax.device_put(a, self._row_spec if a.ndim == 1
                                else self._mat_spec) for a in extra)
             self.bytes_h2d += sum(a.nbytes for a in extra)
-            p_slab = pack_nibbles(
+            st_dev, pk_dev = self.put_rows(
+                sl.reshape(-1),
                 np.ascontiguousarray(c_grid[:, lo:hi]).reshape(-1, w))
-            s_slab = sl.reshape(-1)
-            self.bytes_h2d += s_slab.nbytes + p_slab.nbytes
-            self._counts = fn(
-                self.counts,
-                jax.device_put(s_slab, self._row_spec),
-                jax.device_put(p_slab, self._mat_spec), *extra_dev)
+            self._counts = fn(self.counts, st_dev, pk_dev, *extra_dev)
             self.rows_shipped += self.n * (hi - lo)
         key = f"routed_{self.pileup}_w{w}"
         self.strategy_used[key] = self.strategy_used.get(key, 0) + 1
@@ -291,6 +287,10 @@ class PositionShardedConsensus(ShardedCountsBase):
             t0 = time.perf_counter()
             starts = np.asarray(starts)
             codes = np.asarray(codes)
+            if self.wire == "delta8":
+                from ..wire.codec import canonicalize_rows
+
+                starts, codes = canonicalize_rows(starts, codes)
             if w > self.halo:
                 starts, codes, w = split_wide_rows(
                     starts, codes, w, self.halo, self.padded_len)
@@ -330,14 +330,11 @@ class PositionShardedConsensus(ShardedCountsBase):
                         [codes, np.full((n_rows - len(codes), w), PAD_CODE,
                                         dtype=np.uint8)])
                 fn = self._window_accumulate(wp)
-                packed = pack_nibbles(codes)
-                self.bytes_h2d += starts.nbytes + packed.nbytes
                 for lo, hi in iter_row_slices(n_rows, w, multiple_of=self.n):
-                    self._counts = fn(
-                        self.counts,
-                        jax.device_put(starts[lo:hi], self._row_spec),
-                        jax.device_put(packed[lo:hi], self._mat_spec),
-                        np.int32(wlo))
+                    st_dev, pk_dev = self.put_rows(starts[lo:hi],
+                                                   codes[lo:hi])
+                    self._counts = fn(self.counts, st_dev, pk_dev,
+                                      np.int32(wlo))
                     self.rows_shipped += hi - lo
                 key = f"window_w{w}"
                 self.strategy_used[key] = self.strategy_used.get(key, 0) + 1
@@ -365,13 +362,12 @@ class PositionShardedConsensus(ShardedCountsBase):
             # cap expanded cells per device call (same budget discipline
             # as the unsharded and dp paths, ops.pileup.iter_row_slices)
             for lo, hi_r in iter_row_slices(r, w):
-                s_slab = s_routed[:, lo:hi_r].reshape(-1).copy()
-                p_slab = pack_nibbles(c_routed[:, lo:hi_r].reshape(-1, w))
-                self.bytes_h2d += s_slab.nbytes + p_slab.nbytes
-                self._counts = self._accumulate(
-                    self.counts,
-                    jax.device_put(s_slab, self._row_spec),
-                    jax.device_put(p_slab, self._mat_spec))
+                st_dev, pk_dev = self.put_rows(
+                    s_routed[:, lo:hi_r].reshape(-1).copy(),
+                    np.ascontiguousarray(
+                        c_routed[:, lo:hi_r]).reshape(-1, w))
+                self._counts = self._accumulate(self.counts, st_dev,
+                                                pk_dev)
                 self.rows_shipped += self.n * (hi_r - lo)
             key = f"routed_w{w}"
             self.strategy_used[key] = self.strategy_used.get(key, 0) + 1

@@ -13,6 +13,10 @@ site                  boundary
 ``vote``              the fused tail dispatch (vote + stats)
 ``insertion_build``   the insertion table build / vote dispatch
 ``link_probe``        the startup link probe (utils/linkprobe.py)
+``wire_encode``       the delta8 wire-codec slab encode (wire/codec.py;
+                      fires on the staging thread AND the consumer's
+                      unstaged fallback, so a persistent fault walks
+                      the ladder to the wire-free host rung)
 ====================  =====================================================
 
 Spec grammar (CLI ``--fault-inject`` or env ``S2C_FAULT_INJECT``;
@@ -48,7 +52,7 @@ import zlib
 from typing import Dict, List, Optional
 
 SITES = ("device_put", "pileup_dispatch", "accumulate", "vote",
-         "insertion_build", "link_probe")
+         "insertion_build", "link_probe", "wire_encode")
 
 KINDS = ("rpc", "timeout", "oom", "fatal", "trace")
 

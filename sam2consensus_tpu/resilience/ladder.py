@@ -100,16 +100,24 @@ def demote_pileup(acc, total_len: int) -> Tuple[Optional[object], str]:
         return None, ""
     # rung 1: pin the device kernel off — the autotuner and any explicit
     # pallas/mxu choice demote to the plain XLA scatter (a trace/compile
-    # failure in a kernel must not kill the run when scatter would work)
+    # failure in a kernel must not kill the run when scatter would work).
+    # The wire codec pins off with it: a failure at the wire_encode /
+    # decode boundary must cost ONE rung, not walk the whole ladder, so
+    # the demoted scatter rung ships the plain packed5 lanes.
     if isinstance(acc, PileupAccumulator):
-        if acc.strategy != "scatter" or acc._tuner is not None:
+        if acc.strategy != "scatter" or acc._tuner is not None \
+                or getattr(acc, "wire", "packed5") != "packed5":
             acc.strategy = "scatter"
             acc._tuner = None
+            acc.wire = "packed5"
             return acc, "device_scatter"
     elif getattr(acc, "pileup", "scatter") != "scatter" \
-            or getattr(acc, "_tuner", None) is not None:
+            or getattr(acc, "_tuner", None) is not None \
+            or getattr(acc, "wire", "packed5") != "packed5":
         acc.pileup = "scatter"
         acc._tuner = None
+        if hasattr(acc, "wire"):
+            acc.wire = "packed5"
         return acc, "device_scatter"
     # rung 2: off the device entirely — fetch the accumulated counts
     # (sum-decomposable state, exact at any boundary) into the host

@@ -1,0 +1,39 @@
+"""Compressed event wire format + streaming H2D pipeline (round 6).
+
+The device pileup is LINK-bound on the bench rig (PERF.md: 71 MB h2d at
+78% utilization of the modeled 40 MB/s tunnel for the north-star
+config), so this package attacks both sides of the wire bill:
+
+* :mod:`.codec` — the host-side ``delta8`` slab codec: start positions
+  travel as uint8 deltas (rows arrive position-sorted from the encoder,
+  so consecutive deltas are small; an escape lane carries the unsorted
+  tails and >254 jumps exactly), base codes travel 2-bit-packed ACGT
+  planes with a per-row trailing-pad count eliding the bucket pad tail,
+  and rare non-ACGT cells (gaps, N, interior pad) ride a sparse escape
+  list.  Every slab carries a self-describing header (codec id, row
+  count, escape counts) so ``--wire auto`` is priced by the same link
+  model that routes tail placement, and a mixed-codec stream stays
+  decodable.
+* :mod:`.device` — the device-side unpack stage: one jitted prefix-sum
+  + gather + 2-bit unpack reconstituting EXACTLY the operands every
+  existing pileup kernel consumes (absolute int32 starts + the 4-bit
+  packed code lanes), so scatter / Pallas tile-CSR / MXU and all three
+  shard layouts run unchanged downstream.  Counts are byte-identical
+  to the uncompressed path by construction (the decode is exact, and
+  the kernels see identical operands).
+* :mod:`.pipeline` — double-buffered async staging: two pinned staging
+  slots let the decode-prefetch thread encode + ``device_put`` slab
+  N+1 while slab N accumulates on device, with backpressure when both
+  slots are in flight, and interval accounting that surfaces the
+  measured stage/accumulate overlap (``pipeline/overlap_sec``).
+"""
+
+from .codec import (CODECS, WireSlab, decode_slab_host, encode_slab,
+                    packed5_slab_bytes, resolve_codec, row_bytes_estimate,
+                    wire_auto_cutoff_bps, worthwhile)
+
+__all__ = [
+    "CODECS", "WireSlab", "encode_slab", "decode_slab_host",
+    "packed5_slab_bytes", "resolve_codec", "row_bytes_estimate",
+    "wire_auto_cutoff_bps", "worthwhile",
+]

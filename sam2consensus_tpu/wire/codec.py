@@ -1,0 +1,375 @@
+"""Host-side ``delta8`` slab codec: what actually crosses the link.
+
+A segment-row slab is ``(starts int32 [S], codes uint8 [S, W])``.  The
+legacy wire (codec ``"packed5"`` — the packed-lane format every round
+shipped so far) moves ``4 + W/2`` bytes per row: int32 starts plus the
+4-bit nibble code lanes (``ops.pileup.pack_nibbles``).  ``delta8``
+exploits three measured regularities of real slabs:
+
+* **starts are near-sorted** — the encoder emits reads in input order
+  and real inputs are coordinate-sorted or close, so consecutive start
+  deltas are small.  Deltas ride one uint8 each; value 255 marks an
+  escape whose exact int32 delta (negative for unsorted tails, large
+  for sparse jumps, and always the first row of a chunk, whose delta is
+  from 0) rides the escape lane;
+* **rows are mostly ACGT** — codes A/C/G/T (1/2/3/5 in the count-lane
+  alphabet) remap to 2 bits; gap, N and interior-pad cells are listed
+  sparsely as (flat cell index, code) escape pairs;
+* **bucket pad tails are long** — a span-``s`` row sits in a
+  power-of-two bucket of width up to ``2s``, so up to half of every
+  code row is trailing PAD.  One per-row trailing-pad count (uint8 when
+  it fits — real rows trail < W/2 by the bucket invariant — widening
+  per-slab when it doesn't) elides the tail instead of shipping it.
+
+``chunks`` splits the slab into equal contiguous chunks whose delta
+chains restart from zero: the sharded accumulators ship ``n`` device
+chunks per slab (parallel/{dp,sp,dpsp}), and a per-chunk chain makes the
+device-side prefix sum local to each device — no cross-device decode
+dependency.
+
+Encoding is refused (``None`` / :func:`worthwhile` False) rather than
+forced when a slab would not shrink — escape-dense adversarial slabs
+fall back to the packed5 lanes, recorded per slab, and the
+self-describing header keeps a mixed stream decodable.
+
+Byte identity: :func:`decode_slab_host` is the exact inverse (pinned by
+tests/test_wire.py round-trip properties), and the device decode
+(:mod:`.device`) reproduces the same operands bit-for-bit, so counts —
+and therefore FASTA output — cannot differ from the uncompressed path.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..constants import NUM_SYMBOLS, PAD_CODE
+
+#: wire codecs, by self-describing header id
+CODECS = ("packed5", "delta8")
+
+#: escape marker in the uint8 delta lane
+DELTA_ESCAPE = 255
+
+#: 2-bit wire value -> count-lane code (A=1, C=2, G=3, T=5)
+WIRE2_TO_CODE = np.array([1, 2, 3, 5], dtype=np.uint8)
+
+#: count-lane code -> 2-bit wire value (non-ACGT cells escape; their
+#: primary-lane bits are zero and ignored on decode)
+CODE_TO_WIRE2 = np.zeros(256, dtype=np.uint8)
+CODE_TO_WIRE2[[1, 2, 3, 5]] = np.arange(4, dtype=np.uint8)
+
+#: True for codes the 2-bit primary lane can carry
+IS_ACGT = np.zeros(256, dtype=bool)
+IS_ACGT[[1, 2, 3, 5]] = True
+
+#: trailing-pad lane dtypes, narrowest first; the max value of each is
+#: the "whole row is PAD" sentinel (real rows always trail strictly
+#: less, enforced by the encoder's dtype widening)
+_TRAIL_DTYPES = (np.uint8, np.uint16, np.int32)
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+@dataclass
+class WireSlab:
+    """One encoded slab + its self-describing header.
+
+    Array shapes (``C`` chunks of ``R`` rows, ``S = C * R``):
+
+    ========= ===================== =====================================
+    field     shape / dtype         meaning
+    ========= ===================== =====================================
+    d8        ``[C, R] uint8``      start deltas; 255 = escape
+    esc_delta ``[C, Ep] int32``     exact deltas of escaped rows, in row
+                                    order per chunk (pad entries 0)
+    trail     ``[C, R] uintX``      trailing-PAD cells per row; the
+                                    dtype max is the all-PAD-row sentinel
+    base2     ``[C, R, ⌈W/4⌉] u8``  2-bit ACGT planes, 4 cells per byte
+    esc_idx   ``[C, Ec] int32``     chunk-local flat cell index
+                                    (``r*W + c``) of non-ACGT cells; pad
+                                    entries ``R*W`` (dropped on decode)
+    esc_code  ``[C, Ec] uint8``     the escaped cells' exact codes
+    ========= ===================== =====================================
+    """
+
+    codec: str
+    n_rows: int
+    width: int
+    chunks: int
+    sentinel: int                  # trail lane's all-PAD sentinel
+    d8: np.ndarray
+    esc_delta: np.ndarray
+    trail: np.ndarray
+    base2: np.ndarray
+    esc_idx: np.ndarray
+    esc_code: np.ndarray
+    n_esc_rows: int
+    n_esc_cells: int
+
+    def header(self) -> np.ndarray:
+        """Self-describing slab header: shipped ahead of the lanes so a
+        consumer (or a future on-disk spool) can size and route the
+        decode without out-of-band state, and so ``--wire auto`` bills
+        exact per-slab bytes."""
+        return np.array(
+            [CODECS.index(self.codec), self.n_rows, self.width,
+             self.chunks, self.esc_delta.shape[1], self.esc_idx.shape[1],
+             self.sentinel, self.n_esc_rows, self.n_esc_cells],
+            dtype=np.int32)
+
+    def arrays(self) -> Tuple[np.ndarray, ...]:
+        """The device-bound lanes, in decode-argument order."""
+        return (self.d8, self.esc_delta, self.trail, self.base2,
+                self.esc_idx, self.esc_code)
+
+    @property
+    def wire_bytes(self) -> int:
+        """Exact bytes this slab puts on the link (lanes + header)."""
+        return (sum(a.nbytes for a in self.arrays())
+                + self.header().nbytes)
+
+
+def packed5_slab_bytes(n_rows: int, width: int) -> int:
+    """Wire bytes of the legacy packed-lane format for the same slab."""
+    return n_rows * (4 + (width + 1) // 2)
+
+
+def row_bytes_estimate(width: int, codec: str) -> float:
+    """Modeled wire bytes per row, for link pricing that runs BEFORE a
+    slab is encoded (``parallel.auto.slab_stats`` post-codec row bytes,
+    the shard-mode model's grid-inflation term).  ``delta8`` prices the
+    clean-slab shape — 1 delta + 1 trail + 2-bit lanes — because
+    escape-dense slabs fall back to packed5 and are billed as such."""
+    if codec == "delta8":
+        return 2 + -(-width // 4)
+    return 4 + (width + 1) // 2
+
+
+def encode_slab(starts: np.ndarray, codes: np.ndarray,
+                chunks: int = 1) -> Optional["WireSlab"]:
+    """Encode one slab; ``None`` when the shape cannot chunk evenly.
+
+    Exactness contract: ``decode_slab_host(encode_slab(s, c)) == (s, c)``
+    for every uint8 code matrix and non-negative int32 starts — unsorted
+    tails, >254 deltas, all-PAD rows, interior PAD/gap/N cells and
+    single-row slabs all round-trip through the escape lanes.
+    """
+    S, W = codes.shape
+    if S == 0 or chunks < 1 or S % chunks:
+        return None
+    R = S // chunks
+
+    # -- start deltas ----------------------------------------------------
+    s64 = np.ascontiguousarray(starts, dtype=np.int64).reshape(chunks, R)
+    prev = np.roll(s64, 1, axis=1)
+    prev[:, 0] = 0                       # chain restarts at each chunk
+    delta = s64 - prev
+    esc_row = (delta < 0) | (delta >= DELTA_ESCAPE)
+    n_esc_rows = int(esc_row.sum())
+    ep = _pow2(max(1, int(esc_row.sum(axis=1).max(initial=1))))
+    # escape-lane fallback width: uint16 rows when every escaped delta
+    # fits (the sparse-but-sorted common case — deltas of a few thousand
+    # on a shallow slab), int32 only for negative/huge jumps.  This is
+    # what keeps sparse sorted slabs at ~3 B/row instead of 5.
+    esc_vals = delta[esc_row]
+    esc_dt = np.uint16 if (len(esc_vals) == 0
+                           or (esc_vals.min(initial=0) >= 0
+                               and esc_vals.max(initial=0) < (1 << 16))
+                           ) else np.int32
+    esc_delta = np.zeros((chunks, ep), dtype=esc_dt)
+    ci, ri = np.nonzero(esc_row)
+    if len(ci):
+        k = (np.cumsum(esc_row, axis=1) - 1)[ci, ri]
+        esc_delta[ci, k] = delta[ci, ri].astype(esc_dt)
+    d8 = np.where(esc_row, DELTA_ESCAPE, delta).astype(np.uint8)
+
+    # -- trailing-pad lane ----------------------------------------------
+    nonpad = codes != PAD_CODE
+    anyrow = nonpad.any(axis=1)
+    nlen = np.where(anyrow, W - nonpad[:, ::-1].argmax(axis=1), 0)
+    trail_real = W - nlen
+    max_trail = int(trail_real[anyrow].max(initial=0))
+    for dt in _TRAIL_DTYPES:
+        sentinel = int(np.iinfo(dt).max)
+        if max_trail < sentinel:
+            break
+    trail = np.where(anyrow, trail_real, sentinel).astype(dt) \
+        .reshape(chunks, R)
+
+    # -- 2-bit ACGT planes ----------------------------------------------
+    # the lane is only as wide as the slab's LONGEST row payload: a
+    # span-s row sits in a power-of-two bucket up to width 2s, so the
+    # shared trailing-PAD region past max(nlen) — up to half the bucket
+    # — ships zero bytes (the per-row trail lane restores it exactly).
+    # The width quantizes to a sixteenth-pow2 grid (finer sibling of
+    # ops.pileup.round_rows_grid): decode shapes are jit trace keys, so
+    # a raw per-slab max would compile per slab; the grid caps the
+    # cache at O(log) entries for <=6.25% lane waste.
+    wire2 = CODE_TO_WIRE2[codes]
+    lane_bytes = max(1, -(-int(nlen.max(initial=0)) // 4))
+    shift = max(0, (lane_bytes - 1).bit_length() - 4)
+    lane_bytes = -(-lane_bytes >> shift) << shift
+    wq = min(-(-W // 4), lane_bytes) * 4
+    if wq < W:
+        wire2 = wire2[:, :wq]
+    elif wq != W:
+        wire2 = np.concatenate(
+            [wire2, np.zeros((S, wq - W), dtype=np.uint8)], axis=1)
+    q = wire2.reshape(S, wq // 4, 4)
+    base2 = (q[:, :, 0] | (q[:, :, 1] << 2) | (q[:, :, 2] << 4)
+             | (q[:, :, 3] << 6)).astype(np.uint8).reshape(chunks, R,
+                                                           wq // 4)
+
+    # -- cell escapes (non-ACGT within the row payload) ------------------
+    cols = np.arange(W)
+    escm = (cols[None, :] < nlen[:, None]) & ~IS_ACGT[codes]
+    n_esc_cells = int(escm.sum())
+    rg, cg = np.nonzero(escm)
+    ci2 = rg // R
+    per_chunk = np.bincount(ci2, minlength=chunks)
+    ec = _pow2(max(1, int(per_chunk.max(initial=1))))
+    # cell-index lane narrows too: chunk-local flat indices (and the
+    # R*W drop sentinel) fit uint16 for every bucket up to 64k cells
+    idx_dt = np.uint16 if R * W <= np.iinfo(np.uint16).max else np.int32
+    esc_idx = np.full((chunks, ec), R * W, dtype=idx_dt)
+    esc_code = np.zeros((chunks, ec), dtype=np.uint8)
+    if len(rg):
+        offs = np.concatenate([[0], np.cumsum(per_chunk)])[ci2]
+        kk = np.arange(len(rg)) - offs
+        esc_idx[ci2, kk] = ((rg % R) * W + cg).astype(idx_dt)
+        esc_code[ci2, kk] = codes[rg, cg]
+
+    return WireSlab(codec="delta8", n_rows=S, width=W, chunks=chunks,
+                    sentinel=sentinel, d8=d8, esc_delta=esc_delta,
+                    trail=trail, base2=base2, esc_idx=esc_idx,
+                    esc_code=esc_code, n_esc_rows=n_esc_rows,
+                    n_esc_cells=n_esc_cells)
+
+
+def canonicalize_rows(starts: np.ndarray,
+                      codes: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Stable-sort a slab's real rows by start position, in encode order.
+
+    Pileup accumulation is order-invariant (addition commutes; every
+    consumer scatter-adds), so sorting is free correctness-wise — and
+    it is what makes delta8 effective on UNSORTED inputs: random read
+    order turns every delta into an escape, while sorted rows over an
+    ``L``-position genome delta at ``~L/S`` per row (uint8 territory
+    for any slab with ≥ L/254 rows).  The encoder's all-PAD pow2 pad
+    tail stays in place (kernel planners detect it as a suffix), and
+    the sort is deterministic (stable), so the staging thread and the
+    consumer derive the SAME canonical slab from the same arrays —
+    device kernel plans built host-side always match the decoded
+    operands.  Already-sorted slabs return the inputs untouched.
+    """
+    s = np.asarray(starts)
+    c = np.asarray(codes)
+    # trailing all-PAD pad block (encoder pow2 padding) stays a suffix
+    nonpad = (c != PAD_CODE).any(axis=1)
+    nz = np.nonzero(nonpad)[0]
+    n_real = int(nz[-1]) + 1 if len(nz) else 0
+    pre = s[:n_real]
+    if len(pre) > 1 and np.any(pre[1:] < pre[:-1]):
+        order = np.argsort(pre, kind="stable")
+        s = s.copy()
+        c = c.copy()
+        s[:n_real] = pre[order]
+        c[:n_real] = c[:n_real][order]
+    return s, c
+
+
+def worthwhile(slab: "WireSlab") -> bool:
+    """True when the encoded slab actually beats the packed5 lanes —
+    escape-dense slabs (adversarial inputs, deep unsorted tails) ship
+    legacy instead, per slab, recorded by the caller."""
+    return slab.wire_bytes < packed5_slab_bytes(slab.n_rows, slab.width)
+
+
+def decode_slab_host(slab: "WireSlab") -> Tuple[np.ndarray, np.ndarray]:
+    """Exact numpy inverse of :func:`encode_slab` — the codec's oracle
+    (the device decode in :mod:`.device` is pinned against it)."""
+    C, R = slab.d8.shape
+    W = slab.width
+    esc = slab.d8 == DELTA_ESCAPE
+    rank = np.cumsum(esc, axis=1) - 1
+    ci = np.arange(C)[:, None]
+    delta = np.where(
+        esc, slab.esc_delta[ci, np.clip(rank, 0, slab.esc_delta.shape[1]
+                                        - 1)],
+        slab.d8.astype(np.int64))
+    starts = np.cumsum(delta, axis=1).reshape(-1).astype(np.int32)
+
+    shifts = np.array([0, 2, 4, 6], dtype=np.uint8)
+    two = (slab.base2.reshape(C * R, -1)[:, :, None] >> shifts) & 3
+    lane = WIRE2_TO_CODE[two.reshape(C * R, -1)[:, :W]]
+    codes = np.full((C * R, W), PAD_CODE, dtype=np.uint8)
+    codes[:, :lane.shape[1]] = lane
+    nlen = np.where(slab.trail == slab.sentinel, 0,
+                    W - slab.trail.astype(np.int64)).reshape(-1)
+    codes[np.arange(W)[None, :] >= nlen[:, None]] = PAD_CODE
+    flat = codes.reshape(C, R * W)
+    idx = slab.esc_idx.astype(np.int64)
+    ok = idx < R * W
+    cc, kk = np.nonzero(ok)
+    flat[cc, idx[cc, kk]] = slab.esc_code[cc, kk]
+    return starts, flat.reshape(C * R, W)
+
+
+# -- run-level codec choice ---------------------------------------------
+
+#: modeled wire bytes SAVED per pileup cell by delta8 at representative
+#: slab shapes (W=128, ~100 bp reads: 68 B -> ~34 B per row); the auto
+#: gate compares the link seconds this saves against the host encode +
+#: device decode it costs
+SAVED_BYTES_PER_CELL = float(os.environ.get("S2C_WIRE_SAVED_BPC", "0.25"))
+
+
+def wire_auto_cutoff_bps() -> float:
+    """Link rate below which ``--wire auto`` picks delta8.
+
+    The codec pays ~S2C_WIRE_DEV_NS of device unpack (prefix sum +
+    2-bit expand, VPU-bound) and ~S2C_WIRE_HOST_NS of host encode per
+    cell (vectorized numpy; overlapped by the staging pipeline, priced
+    at full cost to stay conservative), and saves
+    ``SAVED_BYTES_PER_CELL`` of link.  With the defaults the crossover
+    sits at ~71 MB/s: the 40 MB/s tunnel compresses, a PCIe-class link
+    (~GB/s) ships packed5 — the decode passes would cost more than the
+    saved wire, the same shape as the packed5 OUTPUT encoding gate
+    (backends.jax_backend._fetch_costs).
+    """
+    dev_ns = float(os.environ.get("S2C_WIRE_DEV_NS", "1.5"))
+    host_ns = float(os.environ.get("S2C_WIRE_HOST_NS", "2.0"))
+    return SAVED_BYTES_PER_CELL / ((dev_ns + host_ns) * 1e-9)
+
+
+def resolve_codec(mode: str, link_bps: Optional[float],
+                  link_free: bool = False) -> Tuple[str, str]:
+    """``(codec, reason)`` for one run — THE ``--wire`` decision.
+
+    Explicit modes win unconditionally (the cpu-mesh byte-identity
+    tests force delta8 with no link at all).  ``auto`` ships packed5
+    when the tail is link-free (the "saved" wire would be a memcpy
+    while the encode/decode costs stay real) and otherwise prices the
+    measured link rate against :func:`wire_auto_cutoff_bps`.  Env
+    ``S2C_WIRE`` overrides the requested mode (campaign A/B legs).
+    Pinned by tests/test_wire.py decision tests.
+    """
+    env = os.environ.get("S2C_WIRE")
+    if env:
+        mode = env
+    if mode not in ("auto",) + CODECS:
+        raise ValueError(
+            f"--wire {mode!r}: use auto|{'|'.join(CODECS)}")
+    if mode != "auto":
+        return mode, "forced"
+    if link_free:
+        return "packed5", "link_free"
+    if link_bps is not None and link_bps < wire_auto_cutoff_bps():
+        return "delta8", "slow_link"
+    return "packed5", "fast_link"

@@ -1,0 +1,150 @@
+"""Double-buffered async H2D staging + stage/accumulate overlap metering.
+
+The decode prefetch thread (backends.jax_backend._Prefetcher) already
+overlaps HOST DECODE with device work; this module makes the TRANSFER
+overlap explicit and bounded: two pinned staging slots let slab N+1 be
+wire-encoded and ``device_put`` in flight while slab N accumulates on
+device, with BACKPRESSURE (the producer blocks) when both slots hold
+staged-but-unconsumed slabs — so staging can never run unboundedly
+ahead of the device queue, and a failed in-flight slab is at most one
+slot of work to invalidate and replay.
+
+Overlap is MEASURED, not assumed: the stager logs every staging
+interval, the consumer logs every dispatch interval, and
+:meth:`StageSlots.overlap_sec` reports their exact intersection — the
+``pipeline/overlap_sec`` metric the bench rows carry (a serialized
+pipeline reports ~0 even when both phases are busy; a healthy one
+reports stage_sec ≈ overlap_sec).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+#: pinned staging slots: slab N consuming + slab N+1 in flight
+DEFAULT_SLOTS = 2
+
+
+def _intersect_sec(a: List[Tuple[float, float]],
+                   b: List[Tuple[float, float]]) -> float:
+    """Total overlap between two interval lists (merge sweep)."""
+    a = sorted(a)
+    b = sorted(b)
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+class StageSlots:
+    """Two pinned staging slots around an accumulator's ``stage``.
+
+    Producer side (the decode prefetch thread) calls :meth:`stage`,
+    which blocks while both slots are in flight (backpressure) and
+    re-raises any staging failure AFTER releasing the batch's slot —
+    the caller invalidates the batch's staged operands and delivers it
+    unstaged, so the failure replays through the consumer's retry
+    policy / degradation ladder (resilience/).  Consumer side calls
+    :meth:`consumed` after dispatching each batch (releasing its slot)
+    and :meth:`note_consume` with the dispatch interval.  ``stage_fn``
+    is rebindable: a ladder demotion re-routes (or drops) staging
+    without tearing the pipeline down.
+    """
+
+    def __init__(self, stage_fn: Optional[Callable],
+                 slots: int = DEFAULT_SLOTS):
+        self.stage_fn = stage_fn
+        self.slots = slots
+        self._sem = threading.Semaphore(slots)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._held: set = set()
+        self._stage_iv: List[Tuple[float, float]] = []
+        self._consume_iv: List[Tuple[float, float]] = []
+        self.backpressure_sec = 0.0
+        self.staged_batches = 0
+
+    # -- producer side (prefetch thread) --------------------------------
+    def acquire(self, batch) -> bool:
+        """Claim a staging slot for ``batch``, blocking under
+        backpressure.  SPLIT from :meth:`run` so the caller's
+        ``phase/stage_sec`` clock can exclude the wait — backpressure
+        is the consumer's dispatch time, already billed there, and
+        folding it into the stage phase would both double-bill it and
+        deflate the overlap fraction computed against stage seconds.
+        False = staging unavailable (closed, or no stage_fn bound)."""
+        if self.stage_fn is None:
+            return False
+        t_wait = time.perf_counter()
+        while not self._stop.is_set():
+            if self._sem.acquire(timeout=0.05):
+                self.backpressure_sec += time.perf_counter() - t_wait
+                with self._lock:
+                    self._held.add(id(batch))
+                return True
+        return False                    # consumer gone; drop staging
+
+    def run(self, batch) -> None:
+        """Stage an acquired batch (encode + device_put).  A failure
+        invalidates the batch's slot here (released) and re-raises —
+        the caller clears ``batch.staged`` and delivers it unstaged, so
+        the slab replays through the consumer's retry policy/ladder."""
+        fn = self.stage_fn
+        if fn is None:                  # rebound to None after acquire
+            self._release(batch)
+            return
+        t0 = time.perf_counter()
+        try:
+            fn(batch)
+            self.staged_batches += 1
+        except BaseException:
+            self._release(batch)
+            raise
+        finally:
+            with self._lock:
+                self._stage_iv.append((t0, time.perf_counter()))
+
+    def stage(self, batch) -> None:
+        """acquire + run in one call (unit tests / simple callers)."""
+        if self.acquire(batch):
+            self.run(batch)
+
+    # -- consumer side ---------------------------------------------------
+    def consumed(self, batch) -> None:
+        self._release(batch)
+
+    def note_consume(self, t0: float, t1: float) -> None:
+        with self._lock:
+            self._consume_iv.append((t0, t1))
+
+    def _release(self, batch) -> None:
+        with self._lock:
+            if id(batch) in self._held:
+                self._held.discard(id(batch))
+                self._sem.release()
+
+    def close(self) -> None:
+        """Unblock any backpressured producer (consumer exited)."""
+        self._stop.set()
+
+    # -- accounting ------------------------------------------------------
+    def stage_sec(self) -> float:
+        with self._lock:
+            return sum(t1 - t0 for t0, t1 in self._stage_iv)
+
+    def overlap_sec(self) -> float:
+        """Exact seconds the staging thread's transfer work co-ran with
+        the consumer's accumulate dispatches."""
+        with self._lock:
+            return _intersect_sec(list(self._stage_iv),
+                                  list(self._consume_iv))

@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Lint: every throughput/speedup claim in PERF.md / README.md needs evidence.
+
+Round-5 verdict items #2/#3 were both "the number is quoted with no
+committed artifact" (the 735 Mcells/s Pallas rate, the fast-link gate
+flip).  This lint makes that class of drift structural: it fails when a
+paragraph in PERF.md or README.md states a measured rate (``N Mcells/s``
+etc.) or a speedup multiplier (``N×`` / ``Nx``) without either
+
+* citing a committed measurement artifact IN THE SAME PARAGRAPH —
+  a ``campaign/<file>`` / ``perf/<file>`` path, or one of the root
+  artifacts (``BENCH_rNN.json``, ``MULTICHIP_rNN.json``,
+  ``BASELINE.json``) — where the cited file must actually exist; or
+* carrying an explicit ``model-only`` / ``no-artifact:`` marker, the
+  loud way to say a number is modeled/projected rather than measured
+  (the fastlink flip until its campaign leg lands).
+
+Paragraph = blank-line-separated block; fenced code blocks are skipped
+(command transcripts quote numbers legitimately).  Wired into tier-1 as
+tests/test_perf_claims.py, so a PR cannot land an uncited claim.
+
+Usage: python tools/check_perf_claims.py [--repo DIR]; exit 0 clean,
+1 with one violation per line otherwise.
+"""
+
+import argparse
+import os
+import re
+import sys
+
+DOCS = ("PERF.md", "README.md")
+
+#: a measured-rate or speedup claim
+CLAIM_RE = re.compile(
+    r"\d+(?:\.\d+)?\s*(?:Mcells/s|Mbases/s|Mpos/s|Mrows/s|Mreads/s)"
+    r"|\d+(?:\.\d+)?\s*×"
+    r"|\b\d+(?:\.\d+)?x\b")
+
+#: a committed-artifact citation
+ARTIFACT_RE = re.compile(
+    r"(?:campaign|perf)/[A-Za-z0-9_.\-]+"
+    r"|BENCH_r\d+\.json|MULTICHIP_r\d+\.json|BASELINE\.json")
+
+#: explicit "this number is modeled, not measured" markers
+EXEMPT_RE = re.compile(r"model-only|no-artifact:", re.IGNORECASE)
+
+
+def paragraphs(text):
+    """(start_line, block) for blank-line-separated paragraphs, with
+    fenced code blocks dropped."""
+    out = []
+    buf = []
+    start = 1
+    fence = False
+    for i, line in enumerate(text.splitlines(), 1):
+        if line.lstrip().startswith("```"):
+            fence = not fence
+            continue
+        if fence:
+            continue
+        if line.strip():
+            if not buf:
+                start = i
+            buf.append(line)
+        elif buf:
+            out.append((start, "\n".join(buf)))
+            buf = []
+    if buf:
+        out.append((start, "\n".join(buf)))
+    return out
+
+
+def check_file(repo, name):
+    path = os.path.join(repo, name)
+    violations = []
+    if not os.path.exists(path):
+        return violations
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    for lineno, para in paragraphs(text):
+        claims = CLAIM_RE.findall(para)
+        if not claims:
+            continue
+        if EXEMPT_RE.search(para):
+            continue
+        cited = ARTIFACT_RE.findall(para)
+        if not cited:
+            violations.append(
+                f"{name}:{lineno}: claim(s) {claims[:3]} cite no "
+                f"campaign/ artifact (add a citation or a 'model-only' "
+                f"marker)")
+            continue
+        for art in cited:
+            art = art.rstrip(".")      # sentence-final period
+            if not os.path.exists(os.path.join(repo, art)):
+                violations.append(
+                    f"{name}:{lineno}: cites missing artifact {art!r}")
+    return violations
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repo", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    args = ap.parse_args(argv)
+    violations = []
+    for name in DOCS:
+        violations.extend(check_file(args.repo, name))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} uncited perf claim(s); cite the "
+              f"measurement artifact or mark the paragraph model-only",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

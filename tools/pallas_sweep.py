@@ -1,0 +1,141 @@
+#!/usr/bin/env python3
+"""Data-resident Pallas tile-CSR pileup sweep: the 735 Mcells/s artifact.
+
+PERF.md R5.2 quotes the Pallas tile-CSR kernel at 735 Mcells/s
+data-resident (8.8x the resident scatter) but the round-5 campaign never
+committed the sweep itself (VERDICT r5 #2) — the microbench artifact
+only carries the END-TO-END rows (host plan + transfer + kernel), which
+the tunnel dominates.  This tool measures the DATA-RESIDENT rates: every
+operand (starts, packed codes, CSR plan) is device_put once, then each
+implementation is re-dispatched over the resident operands and timed
+with a one-element fetch per repeat.  Each (rows, width, genome) point
+reports the MEDIAN OF N INDEPENDENT RUNS (default 3, MB_CAL_RUNS) so a
+single noisy tunnel window cannot set a constant (VERDICT r5 #4 applied
+to this sweep too).
+
+One JSON object per line; the campaign step commits
+``campaign/pallas_sweep_<round>.jsonl``.
+
+Run on real hardware:  python tools/pallas_sweep.py
+CI / no accelerator:   JAX_PLATFORMS=cpu PS_POINTS=tiny python tools/pallas_sweep.py
+Knobs: PS_POINTS (full|tiny), PS_REPEATS (per-run repeats, default 5),
+MB_CAL_RUNS (outer runs per point, default 3).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sam2consensus_tpu.utils.platform import pin_platform_from_env  # noqa: E402
+pin_platform_from_env()
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def fetch_one(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf.ravel()[0])
+
+
+def timed_resident(fn, repeats):
+    """Median seconds per dispatch over resident operands."""
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fetch_one(fn())
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def sweep_point(rows, width, genome_len, repeats, runs, interpret):
+    from sam2consensus_tpu.constants import NUM_SYMBOLS
+    from sam2consensus_tpu.ops import pallas_pileup as pp
+    from sam2consensus_tpu.ops.pileup import (_scatter_segments_packed,
+                                              pack_nibbles)
+
+    rng = np.random.default_rng(7)
+    tile = pp.TILE_POSITIONS
+    padded_len = -(-(genome_len + 1) // tile) * tile
+    starts = np.sort(rng.integers(0, genome_len - width, rows)) \
+        .astype(np.int32)
+    codes = rng.integers(0, 6, (rows, width)).astype(np.uint8)
+    codes[rng.random(codes.shape) < 0.05] = 255
+    cells = rows * width
+
+    packed = pack_nibbles(codes)
+    s_dev = jax.device_put(starts)
+    p_dev = jax.device_put(packed)
+    plan = pp.plan_rows(starts.astype(np.int64), width, padded_len, tile)
+    rank_dev = jax.device_put(plan.rank)
+    lo_dev = jax.device_put(plan.blk_lo)
+    n_dev = jax.device_put(plan.blk_n)
+
+    def run_scatter():
+        return _scatter_segments_packed(
+            jnp.zeros((padded_len, NUM_SYMBOLS), jnp.int32),
+            s_dev, p_dev, genome_len)
+
+    def run_pallas():
+        return pp.pileup_pallas_packed(
+            jnp.zeros((padded_len, NUM_SYMBOLS), jnp.int32),
+            s_dev, p_dev, rank_dev, tile=tile, n_tiles=plan.n_tiles,
+            width=width, row_block=plan.row_block,
+            max_blocks=plan.max_blocks,
+            n_rows_padded=plan.n_rows_padded,
+            blk_lo=lo_dev, blk_n=n_dev, interpret=interpret)
+
+    fetch_one(run_scatter())              # warm compiles outside timing
+    fetch_one(run_pallas())
+
+    point = {"rows": rows, "width": width, "genome_len": genome_len,
+             "cells": cells, "interpret": interpret}
+    results = {}
+    for impl, fn in (("scatter", run_scatter), ("pallas_csr", run_pallas)):
+        per_run = [timed_resident(fn, repeats) for _ in range(runs)]
+        sec = float(np.median(per_run))
+        results[impl] = sec
+        emit(op="pallas_sweep", impl=impl, **point, sec=round(sec, 5),
+             runs=[round(t, 5) for t in per_run],
+             mcells_per_s=round(cells / sec / 1e6, 1))
+    emit(op="pallas_sweep_point", **point,
+         pallas_speedup_vs_scatter=round(
+             results["scatter"] / results["pallas_csr"], 2))
+
+
+def main():
+    platform = jax.default_backend()
+    interpret = platform != "tpu"
+    repeats = int(os.environ.get("PS_REPEATS", "5"))
+    runs = int(os.environ.get("MB_CAL_RUNS", "3"))
+    tiny = os.environ.get("PS_POINTS", "full") == "tiny" or interpret
+    emit(op="env", platform=platform,
+         device_kind=getattr(jax.devices()[0], "device_kind", platform),
+         interpret=interpret, repeats=repeats, runs=runs,
+         note=("interpret-mode rates are NOT chip evidence; rerun on "
+               "the TPU rig for the data-resident claim"
+               if interpret else "data-resident (operands device_put "
+               "once, kernel re-dispatched)"))
+    if tiny:
+        points = [(4096, 128, 1 << 18)]
+    else:
+        # the R5.2 claim's shape first (65536x128 over the ecoli-scale
+        # genome), then the density/width axes around it
+        points = [(65536, 128, 4_600_000),
+                  (16384, 128, 4_600_000),
+                  (65536, 256, 4_600_000),
+                  (65536, 128, 40_000_000)]
+    for rows, width, genome_len in points:
+        sweep_point(rows, width, genome_len, repeats, runs, interpret)
+
+
+if __name__ == "__main__":
+    main()

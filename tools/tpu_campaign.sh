@@ -12,7 +12,7 @@
 set -u
 cd /root/repo
 mkdir -p campaign
-R=${CAMPAIGN_ROUND:-r05}
+R=${CAMPAIGN_ROUND:-r06}
 LOG=campaign/campaign.log
 echo "$(date +%H:%M:%S) campaign start (round $R)" >> "$LOG"
 
@@ -81,6 +81,31 @@ BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 \
 # 4. device-op microbench (pallas-vs-scatter evidence, mxu rates)
 run_step microbench "campaign/microbench_tpu_$R.jsonl" \
   "campaign/microbench_stderr_$R.log" 1800 python tools/microbench.py
+
+# 4b. data-resident pallas pileup sweep (VERDICT r5 #2: the
+# 735 Mcells/s / 8.8x R5.2 claim gets its own committed artifact —
+# operands resident, kernel re-dispatched, median-of-3 runs per point)
+run_step pallas_sweep "campaign/pallas_sweep_$R.jsonl" \
+  "campaign/pallas_sweep_stderr_$R.log" 1800 python tools/pallas_sweep.py
+
+# 4c. fused insertion-vote window calibration, median-of-3 (VERDICT r5
+# #4: the 1e7 point flipped 0.77x/2.23x between single runs; the auto
+# window re-pins from these medians, per-run samples in the artifact)
+run_step ins_window "campaign/ins_window_$R.jsonl" \
+  "campaign/ins_window_stderr_$R.log" 2400 python tools/ins_window_calibrate.py
+
+# 4d. wire-codec A/B leg (R6 tentpole evidence): the same north-star
+# device bench under each row codec; the delta8 row's util.h2d_mb vs
+# the packed5 row's is the measured compression, and its
+# pipeline/overlap_sec is the staging overlap claim
+S2C_WIRE=packed5 S2C_SYNC_ACCUMULATE=1 BENCH_CONFIGS=north_star \
+  BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 \
+  run_step wire_ab_packed5 "campaign/wire_ab_packed5_$R.json" \
+  "campaign/wire_ab_packed5_stderr_$R.log" 3600 python bench.py
+S2C_WIRE=delta8 S2C_SYNC_ACCUMULATE=1 BENCH_CONFIGS=north_star \
+  BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 \
+  run_step wire_ab_delta8 "campaign/wire_ab_delta8_$R.json" \
+  "campaign/wire_ab_delta8_stderr_$R.log" 3600 python bench.py
 
 # 5. packed5 output-encoding measurement (sets S2C_P5_DEV_NS evidence)
 run_step measure_p5 "campaign/measure_p5_$R.jsonl" \
