@@ -405,6 +405,23 @@ def _jax_row(name, path, cfg_kwargs, overrides, cpu_time, cpu_out):
         row["identical"] = jax_out == cpu_out
     if "insertion_kernel" in jax_stats.extra:
         row["insertion_kernel"] = jax_stats.extra["insertion_kernel"]
+    # provenance: the run manifest's compact summary (git state, env
+    # overrides, link-constant provenance, every model decision with
+    # its prediction/measured/residual/drift) rides in the committed
+    # artifact, so the number is traceable to the constants that
+    # produced it.  The manifest is from the LAST rep — decisions and
+    # constants are rep-invariant (same config, same process).
+    from sam2consensus_tpu import observability
+    from sam2consensus_tpu.observability import manifest as _manifest
+
+    man = observability.last_manifest()
+    if man is not None:
+        row["manifest"] = _manifest.summarize(man)
+        if man.get("drift_events"):
+            row["drift_events"] = man["drift_events"]
+            log(f"[{name}] DRIFT: {man['drift_events']} model "
+                f"prediction(s) fell outside the residual band — see "
+                f"row manifest")
     log(f"[{name}] jax: {jax_time:.2f}s "
         f"({row['bases_per_sec']:,.0f} bases/s, "
         f"{row['vs_baseline']}x cpu, "

@@ -73,20 +73,78 @@ TAIL_LINK_BPS_DEFAULT = 40e6
 def _link_constants() -> tuple:
     """(rt_sec, link_bps) for the placement model: env override, else
     the cached startup probe (real accelerators only), else the bench
-    rig's defaults."""
+    rig's defaults.  Every call re-registers the constants (and their
+    provenance + age) in the run's decision ledger, joined at run end
+    against the measured effective wire rate — the drift alarm that
+    would have caught the round-5 baked-default rot."""
     rt_env = os.environ.get("S2C_TAIL_RT_MS")
     bps_env = os.environ.get("S2C_TAIL_LINK_MBPS")
     rt = float(rt_env) / 1e3 if rt_env else None
     bps = float(bps_env) * 1e6 if bps_env else None
+    env_partial = (rt is None) != (bps is None)
+    source = "env" if (rt is not None and bps is not None) else None
     if rt is None or bps is None:
         probed = _probed_link()
         if probed is not None:
+            from ..utils import linkprobe
+
+            source = linkprobe.link_info().get("source") or "probed"
+            if env_partial:
+                # one field env-overridden, the other probed: say so —
+                # the manifest's provenance must not attribute an env
+                # value to the probe (or vice versa)
+                source = f"env+{source}"
             if rt is None:
                 rt = probed[0]
             if bps is None:
                 bps = probed[1]
-    return (TAIL_RT_SEC_DEFAULT if rt is None else rt,
-            TAIL_LINK_BPS_DEFAULT if bps is None else bps)
+        elif env_partial:
+            source = "env+default"
+    if source is None:
+        source = "default"
+    rt = TAIL_RT_SEC_DEFAULT if rt is None else rt
+    bps = TAIL_LINK_BPS_DEFAULT if bps is None else bps
+    from ..utils import linkprobe as _lp
+
+    inputs = {"rt_ms": round(rt * 1e3, 3),
+              "link_mbps": round(bps / 1e6, 2), "source": source}
+    age = _lp.link_info().get("age_sec")
+    if age is not None:
+        inputs["age_sec"] = age
+    # measured join: effective h2d rate over the staging + dispatch
+    # windows (the only windows the wire bill occupies); runs shipping
+    # under the min_num wire floor join nothing and can never drift —
+    # below it the windows are encode/compute-dominated and the
+    # achieved rate says nothing about the link.  A link-free default
+    # backend gets NO join at all — its "wire" is a memcpy inside
+    # compute-dominated windows, and the resulting rate says nothing
+    # about these constants (which nothing prices there)
+    try:
+        import jax
+
+        link_free = jax.default_backend() == "cpu"
+    except Exception:
+        link_free = True
+    obs.record_decision(
+        "link_constants", source, inputs=inputs,
+        predicted={"bps": bps},
+        measured=None if link_free else
+        {"bps": {"num": ["wire/bytes"],
+                 "den": ["phase/stage_sec",
+                         "phase/pileup_dispatch_sec"],
+                 "min_num": _drift_min_wire_bytes()}})
+    return (rt, bps)
+
+
+def _drift_min_wire_bytes() -> float:
+    """Wire-bytes floor under which bps residuals never join
+    (S2C_DRIFT_MIN_WIRE_MB, default 8 MB — at the modeled 40 MB/s
+    that is 0.2 s of transfer, the scale where the link constants
+    start to matter at all)."""
+    try:
+        return float(os.environ.get("S2C_DRIFT_MIN_WIRE_MB", "8")) * 1e6
+    except ValueError:
+        return 8e6
 
 
 def _probed_link():
@@ -193,6 +251,10 @@ def _tail_cpu_wins(total_len: int, n_thresholds: int,
         obs.metrics().gauge("dispatch/tail").set_info(
             {"chosen": "cpu" if forced == "cpu" else "device",
              "forced": forced})
+        obs.record_decision(
+            "tail_placement", "cpu" if forced == "cpu" else "device",
+            inputs={"forced": forced},
+            measured={"sec": {"counters": ["phase/vote_sec"]}})
         return forced == "cpu"
     if native_tail:
         cpu_sec = total_len * (
@@ -225,6 +287,16 @@ def _tail_cpu_wins(total_len: int, n_thresholds: int,
                 "native_tail": bool(native_tail)}
     obs.metrics().gauge("dispatch/tail").set_info(decision)
     obs.tracer().event("dispatch/tail", **decision)
+    # ledger: prediction for the CHOSEN side, both alternatives, and
+    # the measured join against the vote window (the tail's wall-clock
+    # — upload/fetch/dispatch all complete under its host fetches).
+    # Last-wins dedupe makes the model's optimistic-then-exact double
+    # call (_cpu_tail_wins) leave exactly the decisive record.
+    obs.record_decision(
+        "tail_placement", decision["chosen"], inputs=decision,
+        predicted={"sec": cpu_sec if cpu_wins else chip_sec},
+        alternatives={"cpu": cpu_sec, "device": chip_sec},
+        measured={"sec": {"counters": ["phase/vote_sec"]}})
     return cpu_wins
 
 
@@ -438,10 +510,15 @@ class JaxBackend:
 
         robs = obs.start_run(
             trace_out=getattr(cfg, "trace_out", None),
-            metrics_out=getattr(cfg, "metrics_out", None))
+            metrics_out=getattr(cfg, "metrics_out", None),
+            config=cfg)
         faultinject.configure(getattr(cfg, "fault_inject", "") or None)
         try:
             result = self._run(contigs, records, cfg)
+            # join the run's decision ledger against its measured
+            # counters BEFORE deriving the compat view, so residual/*
+            # and drift/* reach stats.extra (and the bench rows)
+            obs.finalize_decisions()
             obs.publish_stats_extra(result.stats.extra)
             return result
         finally:
@@ -488,6 +565,22 @@ class JaxBackend:
             winfo["link_bps"] = int(_wire_bps)
         reg.gauge("wire/codec").set_info(winfo)
         tr.event("wire/codec", **winfo)
+        # ledger: the codec's modeled compression ratio vs the measured
+        # wire/raw_bytes / wire/bytes ratio — a delta8 run whose slabs
+        # keep falling back (escape-dense input) shows residual << 1
+        from ..wire.codec import modeled_wire_ratio
+
+        obs.record_decision(
+            "wire_codec", wire_sel, inputs=winfo,
+            predicted={"ratio": modeled_wire_ratio(wire_sel),
+                       **({"bps": _wire_bps}
+                          if _wire_bps is not None else {})},
+            measured={"ratio": {"num": ["wire/raw_bytes"],
+                                "den": ["wire/bytes"]},
+                      "bps": {"num": ["wire/bytes"],
+                              "den": ["phase/stage_sec",
+                                      "phase/pileup_dispatch_sec"],
+                              "min_num": _drift_min_wire_bytes()}})
 
         n_dev = len(jax.devices())
         shards = cfg.shards if cfg.shards > 0 else n_dev
@@ -1017,6 +1110,17 @@ class JaxBackend:
                 f"S2C_TAIL_ENCODING={enc_mode!r}: use "
                 f"auto|dense|sparse|packed5")
         link_free = tail_dev is not None or jax.default_backend() == "cpu"
+        if link_free and obs.ledger().get("tail_placement") is None:
+            # a link-free tail that never consulted the cost model (the
+            # default backend IS the local cpu, or the upload committed
+            # before pricing was needed): record the placement anyway —
+            # no prediction, so no residual, but the manifest still
+            # shows where the tail ran and what it measured
+            obs.record_decision(
+                "tail_placement", "cpu",
+                inputs={"link_free": True,
+                        "total_len": int(total_len)},
+                measured={"sec": {"counters": ["phase/vote_sec"]}})
         if enc_mode == "auto":
             _rt, link_bps = _link_constants()
             costs = _fetch_costs(total_len, n_thresholds, sparse_cap,
@@ -1271,12 +1375,29 @@ class JaxBackend:
             else:
                 rows, rb, imb, sfrac = 0, 0, 1.0, 0.0
             _rt, link_bps = _link_constants()
-            mode = shard_auto.choose_shard_mode(
+            mode, mode_costs = shard_auto.shard_mode_costs(
                 layout.total_len, shards, dict(mesh.shape), rows, rb,
                 imb, sfrac, halo, link_bps)
             stats.extra["shard_auto"] = {
                 "rows": int(rows), "peak_frac": round(float(imb), 2),
                 "sorted_frac": round(float(sfrac), 2), "halo": int(halo)}
+            # ledger: the model prices per-slab OVERHEAD deltas between
+            # layouts, not absolute slab time — so the measured
+            # per-slab dispatch seconds join is informational (band=0:
+            # residual recorded, drift never fired on it)
+            obs.record_decision(
+                "shard_mode", mode,
+                inputs={"total_len": int(layout.total_len),
+                        "shards": int(shards), "rows": int(rows),
+                        "row_bytes": int(rb),
+                        "peak_frac": round(float(imb), 3),
+                        "sorted_frac": round(float(sfrac), 3),
+                        "halo": int(halo), "link_bps": int(link_bps)},
+                predicted={"sec": mode_costs.get(mode)},
+                alternatives=mode_costs,
+                measured={"sec": {"num": ["phase/pileup_dispatch_sec"],
+                                  "den": ["pileup/slabs"]}},
+                band=0)
         # the sp/dpsp routers compose with every device kernel (verdict
         # r4 #4): rows route by position block, then each device runs
         # the scatter, the Pallas tile-CSR histogram, or the MXU tile

@@ -342,6 +342,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                   cfg.thresholds, echo=echo)
     echo("Done.\n")
 
+    if cfg.metrics_out and cfg.backend == "jax":
+        # the run manifest (observability/manifest.py) rides alongside
+        # the metrics sink: config + env overrides + link provenance +
+        # every model decision with its residual/drift verdict
+        from .observability.manifest import manifest_path_for
+
+        echo("Run manifest written to "
+             + manifest_path_for(cfg.metrics_out) + "\n")
+
     elapsed = time.perf_counter() - t0
     if cfg.json_metrics:
         metrics = {

@@ -33,18 +33,23 @@ from __future__ import annotations
 import logging
 import os
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import List, Optional
 
+from . import ledger as _ledger
+from . import manifest as _manifest
 from . import metrics as _metrics
 from .export import (read_metrics_jsonl, write_chrome_trace,
                      write_metrics_jsonl)
+from .ledger import DecisionLedger, DecisionRecord
 from .metrics import MetricsRegistry
 from .trace import Tracer
 
 __all__ = [
     "Tracer", "MetricsRegistry", "RunObservability", "PHASES",
-    "start_run", "finish_run", "tracer", "metrics",
+    "DecisionLedger", "DecisionRecord",
+    "start_run", "finish_run", "tracer", "metrics", "ledger",
+    "record_decision", "finalize_decisions", "last_manifest",
     "publish_stats_extra", "configure_logging",
     "write_chrome_trace", "write_metrics_jsonl", "read_metrics_jsonl",
 ]
@@ -72,6 +77,38 @@ def metrics() -> MetricsRegistry:
     return _metrics.current()
 
 
+def ledger() -> DecisionLedger:
+    """The current run's decision ledger (see ledger.current)."""
+    return _ledger.current()
+
+
+def record_decision(decision: str, chosen: str, **kwargs) -> DecisionRecord:
+    """Register a model-driven decision into the current run's ledger
+    (see :mod:`.ledger` for the record/measured-spec shapes)."""
+    return _ledger.record(decision, chosen, **kwargs)
+
+
+def finalize_decisions() -> List[DecisionRecord]:
+    """Join the current run's ledger against its measured counters,
+    emitting ``residual/*`` gauges and ``drift`` events (idempotent).
+    The backend calls this at the end of a run BEFORE deriving the
+    ``stats.extra`` compat view, so residuals ride into bench rows;
+    ``finish_run`` re-checks for runs that died before reaching it."""
+    return _ledger.finalize(_ledger.current(), _metrics.current(),
+                            tracer())
+
+
+#: the most recent finish_run's manifest — bench.py embeds a summary in
+#: its per-config rows without threading a handle through run_once
+_last_manifest: List[Optional[dict]] = [None]
+
+
+def last_manifest() -> Optional[dict]:
+    """The manifest built by the most recent ``finish_run`` (None before
+    any run completes)."""
+    return _last_manifest[0]
+
+
 @dataclass
 class RunObservability:
     """Handle for one run's instruments + export destinations."""
@@ -80,17 +117,23 @@ class RunObservability:
     registry: MetricsRegistry
     trace_out: Optional[str] = None
     metrics_out: Optional[str] = None
+    ledger: DecisionLedger = field(default_factory=DecisionLedger)
+    config: Optional[dict] = None
 
 
 def start_run(trace_out: Optional[str] = None,
               metrics_out: Optional[str] = None,
-              enabled: Optional[bool] = None) -> RunObservability:
-    """Install a fresh tracer + registry as the process-current pair.
+              enabled: Optional[bool] = None,
+              config=None) -> RunObservability:
+    """Install a fresh tracer + registry + decision ledger as the
+    process-current set.
 
     The tracer is enabled iff a trace destination exists (``trace_out``
     or S2C_TRACE_OUT) or ``enabled`` forces it; the registry always
     collects — its cost is a few locked adds per *slab*, not per row,
     and the compat ``stats.extra`` view needs it on every run.
+    ``config`` (a RunConfig or dict) is snapshotted into the run's
+    manifest so every artifact records the flags that produced it.
     """
     trace_out = trace_out or os.environ.get("S2C_TRACE_OUT") or None
     metrics_out = metrics_out or os.environ.get("S2C_METRICS_OUT") or None
@@ -98,24 +141,51 @@ def start_run(trace_out: Optional[str] = None,
         enabled = trace_out is not None
     t = Tracer(enabled=bool(enabled))
     reg = _metrics.push_run()
+    led = _ledger.push_run()
+    if config is not None and not isinstance(config, dict):
+        import dataclasses
+
+        config = dataclasses.asdict(config) \
+            if dataclasses.is_dataclass(config) else None
     with _stack_lock:
         _tracer_stack.append(t)
     return RunObservability(tracer=t, registry=reg, trace_out=trace_out,
-                            metrics_out=metrics_out)
+                            metrics_out=metrics_out, ledger=led,
+                            config=config)
 
 
 def finish_run(obs: RunObservability, meta: Optional[dict] = None) -> None:
-    """Uninstall the run's instruments and write any requested exports."""
+    """Uninstall the run's instruments, write any requested exports, and
+    build the run's manifest (written alongside ``--metrics-out``)."""
+    # join decisions first (idempotent — the backend normally already
+    # did, so residual gauges reached the stats.extra compat view) so
+    # the exports and manifest below carry the residual/drift story
+    _ledger.finalize(obs.ledger, obs.registry, obs.tracer)
     with _stack_lock:
         if len(_tracer_stack) > 1 and _tracer_stack[-1] is obs.tracer:
             _tracer_stack.pop()
         elif obs.tracer in _tracer_stack[1:]:
             _tracer_stack.remove(obs.tracer)
     _metrics.pop_run(obs.registry)
+    _ledger.pop_run(obs.ledger)
+    artifacts = {}
     if obs.trace_out:
         write_chrome_trace(obs.tracer, obs.trace_out)
+        artifacts["trace"] = {"path": obs.trace_out,
+                              "digest": _manifest.file_digest(
+                                  obs.trace_out)}
     if obs.metrics_out:
         write_metrics_jsonl(obs.registry, obs.metrics_out, meta=meta)
+        artifacts["metrics"] = {"path": obs.metrics_out,
+                                "digest": _manifest.file_digest(
+                                    obs.metrics_out)}
+    man = _manifest.build_manifest(
+        obs.registry, obs.ledger.records(), meta=meta,
+        config=obs.config, artifacts=artifacts)
+    _last_manifest[0] = man
+    if obs.metrics_out:
+        _manifest.write_manifest(
+            _manifest.manifest_path_for(obs.metrics_out), man)
 
 
 def publish_stats_extra(extra: dict) -> None:
@@ -137,8 +207,10 @@ def publish_stats_extra(extra: dict) -> None:
             extra[name] = int(value)
         # the wire codec's compression story and the staging pipeline's
         # measured overlap (wire/bytes vs wire/raw_bytes is the ratio;
-        # pipeline/overlap_sec is the R6 acceptance metric)
-        elif name.startswith(("wire/", "pipeline/")):
+        # pipeline/overlap_sec is the R6 acceptance metric); drift
+        # events (ledger residual outside band) ride along so a run
+        # whose model mis-priced is visible from any artifact
+        elif name.startswith(("wire/", "pipeline/", "drift/")):
             extra[name] = int(value) if float(value).is_integer() \
                 else round(value, 4)
     for gauge_name, extra_key in (("dispatch/tail", "tail_dispatch"),
@@ -148,6 +220,12 @@ def publish_stats_extra(extra: dict) -> None:
         g = snap["gauges"].get(gauge_name)
         if g is not None and g.get("info"):
             extra[extra_key] = g["info"]
+    # per-decision residual ratios (ledger.finalize): the scalar
+    # residual/<decision>/<key> gauges, so bench rows show how far each
+    # model's prediction sat from the measured outcome
+    for name, g in snap["gauges"].items():
+        if name.startswith("residual/") and name.count("/") == 2:
+            extra[name] = g["value"]
 
 
 def configure_logging(level: Optional[str]) -> None:

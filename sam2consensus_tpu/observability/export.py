@@ -53,39 +53,60 @@ def chrome_trace_events(tracer: Tracer, pid: Optional[int] = None) -> list:
     return events
 
 
+def _json_default(o):
+    """Keep exports schema-valid whatever rides in span/gauge args:
+    numpy scalars/arrays become their python values, anything else its
+    repr-ish string — an exotic arg must never turn a whole trace
+    artifact into a crash."""
+    try:
+        import numpy as np
+
+        if isinstance(o, np.generic):
+            return o.item()
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+    except ImportError:
+        pass
+    return str(o)
+
+
 def write_chrome_trace(tracer: Tracer, path: str) -> None:
     blob = {"traceEvents": chrome_trace_events(tracer),
             "displayTimeUnit": "ms"}
-    with open(path, "w") as fh:
-        json.dump(blob, fh)
+    # explicit utf-8: ensure_ascii=False emits raw unicode, and a
+    # C/POSIX-locale CI host must not turn a unicode span label into a
+    # lost artifact
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(blob, fh, ensure_ascii=False, default=_json_default)
         fh.write("\n")
 
 
 def write_metrics_jsonl(registry: MetricsRegistry, path: str,
                         meta: Optional[dict] = None) -> None:
     snap = registry.snapshot()
-    with open(path, "w") as fh:
+    with open(path, "w", encoding="utf-8") as fh:
         header = {"kind": "meta", "pid": os.getpid()}
         if meta:
             header.update(meta)
-        fh.write(json.dumps(header) + "\n")
+        fh.write(json.dumps(header, default=_json_default) + "\n")
         for name, value in snap["counters"].items():
             fh.write(json.dumps({"kind": "counter", "name": name,
-                                 "value": value}) + "\n")
+                                 "value": value},
+                                default=_json_default) + "\n")
         for name, entry in snap["gauges"].items():
             row = {"kind": "gauge", "name": name, "value": entry["value"]}
             if "info" in entry:
                 row["info"] = entry["info"]
-            fh.write(json.dumps(row) + "\n")
+            fh.write(json.dumps(row, default=_json_default) + "\n")
         for name, entry in snap["histograms"].items():
             fh.write(json.dumps({"kind": "histogram", "name": name,
-                                 **entry}) + "\n")
+                                 **entry}, default=_json_default) + "\n")
 
 
 def read_metrics_jsonl(path: str) -> list:
     """Parse a metrics JSONL sink back into a list of row dicts."""
     rows = []
-    with open(path) as fh:
+    with open(path, encoding="utf-8") as fh:
         for line in fh:
             line = line.strip()
             if line:

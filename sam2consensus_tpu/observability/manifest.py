@@ -1,0 +1,151 @@
+"""Self-describing per-run manifest: every number traceable to its inputs.
+
+VERDICT r5's recurring finding was headline claims with no committed
+artifact tying them to the constants that produced them — a bench row
+says 19.4x, but WHICH link constants priced its placement decisions,
+which env overrides were live, which git state ran?  The manifest
+answers that in one JSON blob written alongside ``--metrics-out``
+(``<metrics_out>.manifest.json``) and embedded (summarized) in bench
+rows:
+
+* the run config (the full RunConfig dataclass, JSON-shaped);
+* every live ``S2C_*`` / ``JAX_PLATFORMS`` / ``XLA_FLAGS`` env
+  override — the invisible inputs that flip gate decisions;
+* the link constants the placement models priced with, their source
+  (probed / env / stale-cache / default) and measured-at age;
+* every ledger decision with its prediction, measured outcome,
+  residual and drift verdict (observability/ledger.py);
+* the phase/wire counter summary and any drift events;
+* ``git describe`` of the running tree and sha256 hashes of the trace
+  / metrics artifacts the same run wrote.
+
+Schema id ``s2c-manifest/1``; consumers must tolerate added keys.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import time
+from typing import List, Optional
+
+SCHEMA = "s2c-manifest/1"
+
+#: env prefixes that are model/gate inputs — recorded verbatim so a
+#: committed artifact shows every constant override that was live
+_ENV_PREFIXES = ("S2C_",)
+_ENV_EXACT = ("JAX_PLATFORMS", "XLA_FLAGS")
+
+_git_cache: List[Optional[str]] = []
+
+
+def git_describe() -> Optional[str]:
+    """``git describe --always --dirty`` of the repo this package runs
+    from (cached per process; None outside a work tree)."""
+    if _git_cache:
+        return _git_cache[0]
+    out: Optional[str] = None
+    try:
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        r = subprocess.run(
+            ["git", "describe", "--always", "--dirty", "--tags"],
+            capture_output=True, text=True, timeout=5, cwd=root)
+        if r.returncode == 0:
+            out = r.stdout.strip() or None
+    except Exception:
+        out = None
+    _git_cache.append(out)
+    return out
+
+
+def env_overrides() -> dict:
+    return {k: os.environ[k] for k in sorted(os.environ)
+            if k.startswith(_ENV_PREFIXES) or k in _ENV_EXACT}
+
+
+def file_digest(path: str) -> Optional[str]:
+    try:
+        h = hashlib.sha256()
+        with open(path, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+        return "sha256:" + h.hexdigest()
+    except OSError:
+        return None
+
+
+def _link_section(snap: dict) -> dict:
+    """Link-constant provenance: probe state (utils/linkprobe) plus the
+    run's recorded link gauges."""
+    from ..utils import linkprobe
+
+    link = dict(linkprobe.link_info())
+    for g in ("link/rt_sec", "link/bps", "link/stale", "link/stale_age",
+              "link/probe_failed"):
+        entry = snap["gauges"].get(g)
+        if entry is not None:
+            link[g.split("/", 1)[1]] = entry["value"]
+    return link
+
+
+def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
+                   config: Optional[dict] = None,
+                   artifacts: Optional[dict] = None) -> dict:
+    snap = registry.snapshot()
+    counters = snap["counters"]
+    phases = {k: round(v, 6) for k, v in counters.items()
+              if k.startswith("phase/")}
+    wire = {k: v for k, v in counters.items()
+            if k.startswith(("wire/", "pipeline/"))}
+    decisions = []
+    for rec in ledger_records:
+        d = rec.to_dict() if hasattr(rec, "to_dict") else dict(rec)
+        decisions.append(d)
+    return {
+        "schema": SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "git": git_describe(),
+        "meta": dict(meta or {}),
+        "config": config,
+        "env_overrides": env_overrides(),
+        "link": _link_section(snap),
+        "decisions": decisions,
+        "phases": phases,
+        "wire": wire,
+        "drift_events": int(counters.get("drift/events", 0)),
+        "artifacts": dict(artifacts or {}),
+    }
+
+
+def manifest_path_for(metrics_out: str) -> str:
+    """The manifest path derived from a ``--metrics-out`` destination."""
+    return metrics_out + ".manifest.json"
+
+
+def write_manifest(path: str, manifest: dict) -> None:
+    from .export import _json_default
+
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=False,
+                  default=_json_default)
+        fh.write("\n")
+
+
+def summarize(manifest: dict) -> dict:
+    """The compact form bench rows embed: decisions + provenance, no
+    full config/phase dump (those live in the row already)."""
+    return {
+        "schema": manifest["schema"],
+        "git": manifest.get("git"),
+        "env_overrides": manifest.get("env_overrides", {}),
+        "link": manifest.get("link", {}),
+        "decisions": [
+            {k: d[k] for k in ("decision", "chosen", "predicted",
+                               "measured", "residual", "drift")
+             if k in d}
+            for d in manifest.get("decisions", [])],
+        "drift_events": manifest.get("drift_events", 0),
+    }

@@ -1,0 +1,194 @@
+"""Noise-aware perf-regression detection over bench/campaign series.
+
+The committed ``BENCH_r0*.json`` trajectory is the repo's performance
+memory, but its numbers ride a one-core shared host and a tunnel whose
+state swings real measurements 1.5-2x run to run (bench.py's own
+best-of-N notes; the round-5 insertion sweep saw 0.77-2.23x on
+identical configs).  A naive "slower than last round" gate would cry
+wolf every round; no gate at all is how a 40 MB/s constant survived a
+10-15 MB/s link for two rounds.  This module is the middle path:
+
+* **median/MAD bands** — the history's center is the median, its noise
+  scale the MAD (scaled by 1.4826 to estimate sigma under normality);
+  both are robust to the single wild round that IS the trajectory's
+  reality.  The allowed deviation is
+  ``max(k * 1.4826 * MAD, rel_floor * |median|)`` — the relative floor
+  keeps a 3-point history whose MAD happens to be ~0 from flagging
+  ordinary rig noise;
+* **min-repeat awareness** — fewer than ``min_repeats`` prior points is
+  not a distribution, it is an anecdote: the verdict is
+  ``insufficient_history`` (gate passes, loudly) instead of a
+  confident band from two numbers;
+* **direction awareness** — ``vs_baseline``/``bases_per_sec`` regress
+  downward, ``*_sec`` regress upward; improvements are reported but
+  never fail the gate.
+
+Artifact tolerance: the committed BENCH files are driver wrappers whose
+``tail`` capture is HEAD-TRUNCATED (last N bytes of stdout), so the
+top-level JSON line is often unrecoverable while every per-config row
+object inside it is intact.  :func:`extract_bench_rows` scans for
+balanced ``{"config": ...}`` objects with ``raw_decode`` instead of
+trusting the line structure; a round with no recoverable rows (r01's
+rc=1 crash) simply contributes no history.
+
+Consumers: ``tools/regress_check.py`` (the CI gate,
+tests/test_regression_gate.py) and ``tools/bench_report.py --diff``
+(two-artifact delta table sharing :func:`noise_floor`).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_K = 4.0
+#: relative noise floor: deviations under this fraction of the median
+#: never flag, regardless of how quiet the history was.  0.35 covers
+#: the measured rig noise (bench.py: sub-second ratios swing ~1.5x;
+#: best-of-N keeps committed rows tighter, but not 10%-tight).
+DEFAULT_REL_FLOOR = 0.35
+DEFAULT_MIN_REPEATS = 3
+
+#: metric direction: True -> lower is better (seconds), False ->
+#: higher is better (throughput / speedup).  Unknown metrics default
+#: to higher-is-better (the repo's headline metrics all are).
+LOWER_IS_BETTER = {
+    "jax_sec": True, "cpu_sec": True, "sec": True, "elapsed_sec": True,
+    "vs_baseline": False, "bases_per_sec": False, "value": False,
+    "pileup_mcells_per_s": False, "decode_mbases_per_s": False,
+}
+
+
+def median(xs: Sequence[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if n == 0:
+        raise ValueError("median of empty series")
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def mad(xs: Sequence[float], center: Optional[float] = None) -> float:
+    """Median absolute deviation (unscaled)."""
+    c = median(xs) if center is None else center
+    return median([abs(x - c) for x in xs])
+
+
+def noise_floor(center: float, mad_value: float,
+                k: float = DEFAULT_K,
+                rel_floor: float = DEFAULT_REL_FLOOR) -> float:
+    """The allowed absolute deviation from ``center``."""
+    return max(k * 1.4826 * mad_value, rel_floor * abs(center))
+
+
+def check_series(history: Sequence[float], candidate: float, *,
+                 lower_is_better: bool = False,
+                 k: float = DEFAULT_K,
+                 rel_floor: float = DEFAULT_REL_FLOOR,
+                 min_repeats: int = DEFAULT_MIN_REPEATS) -> dict:
+    """Verdict for one candidate value against its history.
+
+    Returns ``{"status": "pass"|"regressed"|"improved"|
+    "insufficient_history", "median", "mad", "allowed", "delta",
+    "n_history"}``.  ``delta`` is candidate - median (sign as stored,
+    not direction-normalized).
+    """
+    n = len(history)
+    out = {"n_history": n, "candidate": candidate}
+    if n < min_repeats:
+        out.update(status="insufficient_history", median=None, mad=None,
+                   allowed=None, delta=None)
+        return out
+    c = median(history)
+    m = mad(history, c)
+    allowed = noise_floor(c, m, k=k, rel_floor=rel_floor)
+    delta = candidate - c
+    worse = delta > allowed if lower_is_better else delta < -allowed
+    better = delta < -allowed if lower_is_better else delta > allowed
+    out.update(status="regressed" if worse
+               else "improved" if better else "pass",
+               median=c, mad=m, allowed=allowed, delta=delta)
+    return out
+
+
+# -- artifact loading ------------------------------------------------------
+def extract_bench_rows(text: str) -> List[dict]:
+    """Every balanced ``{"config": ...}`` object recoverable from a
+    (possibly truncated) bench capture, in order."""
+    dec = json.JSONDecoder()
+    rows: List[dict] = []
+    i = 0
+    while True:
+        j = text.find('{"config":', i)
+        if j < 0:
+            break
+        try:
+            obj, end = dec.raw_decode(text[j:])
+            rows.append(obj)
+            i = j + end
+        except ValueError:
+            i = j + 1
+    return rows
+
+
+def load_bench_artifact(path: str) -> List[dict]:
+    """Per-config rows from one bench artifact: a driver wrapper
+    (``{"rc", "tail", "parsed"}``), a bare bench JSON line, or any text
+    containing config rows.  A crashed/empty round returns []."""
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        obj = None
+    if isinstance(obj, dict):
+        if isinstance(obj.get("configs"), list):
+            return [r for r in obj["configs"] if isinstance(r, dict)]
+        parsed = obj.get("parsed")
+        if isinstance(parsed, dict) and isinstance(
+                parsed.get("configs"), list):
+            return [r for r in parsed["configs"] if isinstance(r, dict)]
+        text = obj.get("tail", "") or ""
+    return extract_bench_rows(text)
+
+
+def bench_series(paths: Sequence[str],
+                 metrics: Sequence[str] = ("vs_baseline", "jax_sec"),
+                 ) -> Dict[Tuple[str, str], List[Tuple[str, float]]]:
+    """``{(config, metric): [(path, value), ...]}`` across a trajectory
+    (paths in trajectory order).  Rows with errors contribute nothing."""
+    series: Dict[Tuple[str, str], List[Tuple[str, float]]] = {}
+    for path in paths:
+        for row in load_bench_artifact(path):
+            if "error" in row or "config" not in row:
+                continue
+            for metric in metrics:
+                v = row.get(metric)
+                if isinstance(v, (int, float)):
+                    series.setdefault((row["config"], metric),
+                                      []).append((path, float(v)))
+    return series
+
+
+def series_from_jsonl(path: str, group_by: str, value_field: str,
+                      ) -> Dict[str, List[float]]:
+    """``{group: [values...]}`` from a campaign JSONL (one JSON object
+    per line; malformed lines skipped — campaign logs interleave)."""
+    series: Dict[str, List[float]] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(row, dict):
+                continue
+            v = row.get(value_field)
+            if not isinstance(v, (int, float)):
+                continue
+            key = str(row.get(group_by, "?"))
+            series.setdefault(key, []).append(float(v))
+    return series

@@ -310,22 +310,37 @@ def host_pileup_max_len(native_tail: bool = False,
     """
     import os
 
+    def _record(bound: int, reason: str) -> int:
+        # decision ledger (observability/ledger.py): the gate's bound
+        # and WHY — joined into the run manifest so a mis-sized bound
+        # (the round-4 wide-genome mis-route's shape) is visible from
+        # the artifact; no prediction (the bound is a threshold, not a
+        # priced cost), so no residual/drift
+        inputs = {"reason": reason, "native_tail": bool(native_tail),
+                  "link_free": bool(link_free)}
+        if link_bps is not None:
+            inputs["link_bps"] = int(link_bps)
+        obs.record_decision("host_pileup_bound", str(bound),
+                            inputs=inputs)
+        return bound
+
     env = os.environ.get("S2C_HOST_PILEUP_MAX_LEN")
     if env:
         try:
-            return int(env)
+            return _record(int(env), "env")
         except ValueError:
             raise RuntimeError(
                 f"S2C_HOST_PILEUP_MAX_LEN={env!r}: expected a plain "
                 f"integer position count (e.g. 8388608)") from None
     if native_tail and link_free:
-        return 1 << 62
+        return _record(1 << 62, "link_free")
     if native_tail and link_bps is not None:
         slow = float(os.environ.get(
             "S2C_HOST_ALWAYS_LINK_MBPS", "80")) * 1e6
         if link_bps < slow:
-            return 1 << 62
-    return (1 << 23) if native_tail else HOST_PILEUP_MAX_LEN
+            return _record(1 << 62, "slow_link")
+    return _record((1 << 23) if native_tail else HOST_PILEUP_MAX_LEN,
+                   "native_tail" if native_tail else "default")
 
 
 class HostPileupAccumulator:

@@ -134,7 +134,22 @@ def choose_shard_mode(total_len: int, n_devices: int, mesh_shape: dict,
                       rows_per_slab: int, row_bytes_per_slab: int,
                       peak_frac: float, sorted_frac: float,
                       halo: int, link_bps: float) -> str:
-    """Pick dp / sp / dpsp by modeled per-slab overhead (module doc).
+    """Pick dp / sp / dpsp by modeled per-slab overhead (module doc);
+    see :func:`shard_mode_costs` for the full priced table (the
+    decision ledger records it alongside the pick)."""
+    mode, _costs = shard_mode_costs(
+        total_len, n_devices, mesh_shape, rows_per_slab,
+        row_bytes_per_slab, peak_frac, sorted_frac, halo, link_bps)
+    return mode
+
+
+def shard_mode_costs(total_len: int, n_devices: int, mesh_shape: dict,
+                     rows_per_slab: int, row_bytes_per_slab: int,
+                     peak_frac: float, sorted_frac: float,
+                     halo: int, link_bps: float) -> tuple:
+    """(chosen_mode, {mode: modeled_per_slab_overhead_sec}) — the pick
+    plus every feasible candidate's priced cost, so the decision ledger
+    (observability/ledger.py) can record prediction AND alternatives.
 
     The routers' dense slot grids ship ``targets * max_rows_per_target``
     row slots, so a clustered-but-not-window-eligible slab inflates the
@@ -187,5 +202,5 @@ def choose_shard_mode(total_len: int, n_devices: int, mesh_shape: dict,
     if feasible_dpsp:
         costs["dpsp"] = cost_dpsp
     if not costs:
-        return "dp"                    # nothing feasible: dp, best effort
-    return min(costs, key=costs.get)
+        return "dp", {}                # nothing feasible: dp, best effort
+    return min(costs, key=costs.get), costs

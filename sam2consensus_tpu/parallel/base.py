@@ -75,8 +75,12 @@ def record_slab(key: str, t0: float, n_rows: int, width: int) -> None:
 
     obs.tracer().complete("slab", t0, strategy=key, n_rows=n_rows,
                           width=width)
-    obs.metrics().observe(f"pileup/slab_sec/{key}",
-                          time.perf_counter() - t0)
+    reg = obs.metrics()
+    reg.observe(f"pileup/slab_sec/{key}", time.perf_counter() - t0)
+    # same run-level slab counter the dp/single-device path keeps
+    # (ops.pileup.run_tuned_slab): the shard-mode decision's measured
+    # per-slab join divides phase/pileup_dispatch_sec by this
+    reg.add("pileup/slabs", 1)
 
 
 def block_for(total_len: int, n_devices: int) -> int:

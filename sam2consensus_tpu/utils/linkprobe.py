@@ -32,12 +32,15 @@ the ``link_probe`` fault-injection site (resilience/faultinject.py).
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
 from typing import Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger("sam2consensus_tpu.utils.linkprobe")
 
 _cached: Optional[Tuple[float, float]] = None
 _failed = False
@@ -46,10 +49,31 @@ _failed = False
 #: that worked ten minutes ago describes this link far better than
 #: constants measured on a different machine)
 _last_good: Optional[Tuple[float, float]] = None
+#: when the in-process measurement was taken (unix seconds)
+_last_good_at: Optional[float] = None
+#: provenance of the constants last served to a consumer, for the
+#: run manifest (observability/manifest.py): source is one of
+#: "probed" | "stale-memory" | "stale-cache" | None (never measured)
+_served: dict = {"source": None, "measured_at": None}
 
 #: probe transfer size: big enough that bandwidth dominates the RT term
 #: after correction, small enough to cost <1 s even on a ~10 MB/s link
 PROBE_BYTES = 1 << 20
+
+#: default S2C_LINK_CACHE_MAX_AGE: constants older than this (seconds)
+#: are still served on probe failure — there is nothing better — but
+#: loudly: ``link/stale_age`` gauge + warning, instead of silently
+#: pricing every placement decision from drifted numbers (the round-5
+#: failure mode: 40 MB/s baked vs 10-15 MB/s measured).  7 days.
+CACHE_MAX_AGE_SEC = 7 * 86400.0
+
+
+def cache_max_age() -> float:
+    try:
+        return float(os.environ.get("S2C_LINK_CACHE_MAX_AGE",
+                                    CACHE_MAX_AGE_SEC))
+    except ValueError:
+        return CACHE_MAX_AGE_SEC
 
 
 def _cache_file() -> Optional[str]:
@@ -59,7 +83,10 @@ def _cache_file() -> Optional[str]:
     return os.environ.get("S2C_LINK_CACHE") or None
 
 
-def _read_cache() -> Optional[Tuple[float, float]]:
+def _read_cache() -> Optional[Tuple[float, float, Optional[float]]]:
+    """(rt_sec, bps, measured_at) from the cache file; measured_at is
+    None for pre-timestamp cache entries (treated as unknown age =
+    stale)."""
     path = _cache_file()
     if not path or not os.path.exists(path):
         return None
@@ -68,7 +95,9 @@ def _read_cache() -> Optional[Tuple[float, float]]:
 
         with open(path) as fh:
             blob = json.load(fh)
-        return (float(blob["rt_sec"]), float(blob["bps"]))
+        at = blob.get("measured_at")
+        return (float(blob["rt_sec"]), float(blob["bps"]),
+                float(at) if at is not None else None)
     except Exception:
         return None
 
@@ -81,15 +110,34 @@ def _write_cache(probed: Tuple[float, float]) -> None:
         import json
 
         with open(path, "w") as fh:
-            json.dump({"rt_sec": probed[0], "bps": probed[1]}, fh)
+            json.dump({"rt_sec": probed[0], "bps": probed[1],
+                       "measured_at": time.time()}, fh)
     except OSError:
         pass
 
 
-def _stale_constants() -> Optional[Tuple[float, float]]:
-    """Last known-good constants (in-process first, then the optional
-    cache file), or None when the link was never measured."""
-    return _last_good if _last_good is not None else _read_cache()
+def _stale_constants() -> Optional[Tuple[float, float, Optional[float],
+                                         str]]:
+    """(rt_sec, bps, measured_at, source) of the last known-good
+    constants (in-process first, then the optional cache file), or None
+    when the link was never measured."""
+    if _last_good is not None:
+        return (*_last_good, _last_good_at, "stale-memory")
+    cached = _read_cache()
+    if cached is not None:
+        return (*cached, "stale-cache")
+    return None
+
+
+def link_info() -> dict:
+    """Provenance of the constants this process last served: source
+    (probed/stale-memory/stale-cache/None), measured-at and age — the
+    manifest's link section (observability/manifest.py)."""
+    info = dict(_served)
+    at = info.get("measured_at")
+    if at is not None:
+        info["age_sec"] = round(max(0.0, time.time() - at), 1)
+    return info
 
 
 def probe_link(force: bool = False) -> Optional[Tuple[float, float]]:
@@ -104,9 +152,10 @@ def probe_link(force: bool = False) -> Optional[Tuple[float, float]]:
     the default constants, which route host-side and complete link-free
     on every workload the gates would have kept local anyway.
     """
-    global _cached, _failed, _last_good
+    global _cached, _failed, _last_good, _last_good_at
     if _cached is not None and not force:
         _record_link(_cached)          # fresh per-run registry, cached probe
+        _served.update(source="probed", measured_at=_last_good_at)
         return _cached
     if _failed and not force:
         return _stale_fallback()
@@ -127,9 +176,11 @@ def probe_link(force: bool = False) -> Optional[Tuple[float, float]]:
             return _stale_fallback()
         _cached = box[0]
         _last_good = _cached
+        _last_good_at = time.time()
         _write_cache(_cached)
         sp.set_args(rt_sec=_cached[0], bps=_cached[1])
     _record_link(_cached)
+    _served.update(source="probed", measured_at=_last_good_at)
     return _cached
 
 
@@ -137,17 +188,38 @@ def _stale_fallback() -> Optional[Tuple[float, float]]:
     """On probe failure: serve the last known-good constants when any
     exist (marked stale in the run's registry so the artifact shows the
     placement model ran on memory, not measurement); None otherwise —
-    the consumers then fall to the baked rig defaults."""
+    the consumers then fall to the baked rig defaults.  Constants older
+    than S2C_LINK_CACHE_MAX_AGE (or of unknown age — a pre-timestamp
+    cache entry) additionally emit a ``link/stale_age`` gauge and a
+    warning: they still describe this link better than another rig's
+    baked defaults, but nobody should trust a week-old tunnel number
+    silently."""
     stale = _stale_constants()
     if stale is None:
         return None
+    rt, bps, measured_at, source = stale
     from .. import observability as obs
 
-    obs.metrics().gauge("link/stale").set(1.0)
-    obs.tracer().event("link/stale_constants", rt_sec=stale[0],
-                       bps=stale[1])
-    _record_link(stale)
-    return stale
+    reg = obs.metrics()
+    reg.gauge("link/stale").set(1.0)
+    age = time.time() - measured_at if measured_at is not None else None
+    if age is None or age > cache_max_age():
+        # -1.0 = unknown age (legacy cache entry without measured_at)
+        reg.gauge("link/stale_age").set(round(age, 1)
+                                        if age is not None else -1.0)
+        logger.warning(
+            "link constants from %s are %s old (max age %.0f s): the "
+            "placement model is pricing from a link that may no longer "
+            "exist — re-probe (unset S2C_LINK_PROBE=0) or override "
+            "S2C_TAIL_RT_MS / S2C_TAIL_LINK_MBPS",
+            source,
+            f"{age:.0f} s" if age is not None else "an unknown age",
+            cache_max_age())
+    obs.tracer().event("link/stale_constants", rt_sec=rt, bps=bps,
+                       age_sec=age)
+    _record_link((rt, bps))
+    _served.update(source=source, measured_at=measured_at)
+    return (rt, bps)
 
 
 def _record_link(probed: Tuple[float, float]) -> None:
@@ -211,8 +283,10 @@ def _timed(fn) -> float:
 
 
 def _reset_for_tests(drop_last_good: bool = True) -> None:
-    global _cached, _failed, _last_good
+    global _cached, _failed, _last_good, _last_good_at
     _cached = None
     _failed = False
+    _served.update(source=None, measured_at=None)
     if drop_last_good:
         _last_good = None
+        _last_good_at = None

@@ -29,11 +29,11 @@ config), so this package attacks both sides of the wire bill:
 """
 
 from .codec import (CODECS, WireSlab, decode_slab_host, encode_slab,
-                    packed5_slab_bytes, resolve_codec, row_bytes_estimate,
-                    wire_auto_cutoff_bps, worthwhile)
+                    modeled_wire_ratio, packed5_slab_bytes, resolve_codec,
+                    row_bytes_estimate, wire_auto_cutoff_bps, worthwhile)
 
 __all__ = [
     "CODECS", "WireSlab", "encode_slab", "decode_slab_host",
-    "packed5_slab_bytes", "resolve_codec", "row_bytes_estimate",
-    "wire_auto_cutoff_bps", "worthwhile",
+    "modeled_wire_ratio", "packed5_slab_bytes", "resolve_codec",
+    "row_bytes_estimate", "wire_auto_cutoff_bps", "worthwhile",
 ]

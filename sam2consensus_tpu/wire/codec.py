@@ -330,6 +330,23 @@ def decode_slab_host(slab: "WireSlab") -> Tuple[np.ndarray, np.ndarray]:
 SAVED_BYTES_PER_CELL = float(os.environ.get("S2C_WIRE_SAVED_BPC", "0.25"))
 
 
+#: packed5 wire bytes per cell at the representative slab shape the
+#: auto gate prices (W=128, ~100 bp reads: 68 B/row)
+_PACKED5_BPC = 68.0 / 128.0
+
+
+def modeled_wire_ratio(codec: str) -> float:
+    """The compression ratio (packed5-equivalent bytes / shipped bytes)
+    the auto gate's pricing ASSUMES for ``codec`` — the decision
+    ledger's prediction, joined at run end against the measured
+    ``wire/raw_bytes / wire/bytes`` (observability/ledger.py).  packed5
+    is the reference encoding, ratio 1; delta8's modeled saving is
+    ``SAVED_BYTES_PER_CELL`` off the packed5 bill."""
+    if codec != "delta8":
+        return 1.0
+    return _PACKED5_BPC / max(_PACKED5_BPC - SAVED_BYTES_PER_CELL, 1e-9)
+
+
 def wire_auto_cutoff_bps() -> float:
     """Link rate below which ``--wire auto`` picks delta8.
 

@@ -99,3 +99,112 @@ def test_real_probe_on_cpu_device_measures_sane_numbers():
     assert 1e-6 <= rt <= 10.0
     assert 1e5 <= bw <= 1e12
     assert linkprobe.probe_link() == out    # cached
+
+
+def test_cache_entries_stamped_with_measured_at(tmp_path, monkeypatch):
+    """A successful probe writes a timestamped cache entry; a later
+    process reads it back with its age."""
+    import json
+    import time
+
+    cache = tmp_path / "link.json"
+    monkeypatch.setenv("S2C_LINK_CACHE", str(cache))
+    monkeypatch.setattr(linkprobe, "_probe_into",
+                        lambda box: box.append((0.01, 5e7)))
+    assert linkprobe.probe_link(force=True) == (0.01, 5e7)
+    blob = json.loads(cache.read_text())
+    assert abs(blob["measured_at"] - time.time()) < 60
+    info = linkprobe.link_info()
+    assert info["source"] == "probed"
+    assert info["age_sec"] < 60
+
+
+def test_stale_cache_older_than_max_age_warns(tmp_path, monkeypatch,
+                                              caplog):
+    """Constants older than S2C_LINK_CACHE_MAX_AGE still serve (better
+    than another rig's baked defaults) but emit link/stale_age + a
+    warning instead of silently pricing from drifted numbers."""
+    import json
+    import logging
+    import time
+
+    from sam2consensus_tpu import observability as obs
+
+    cache = tmp_path / "link.json"
+    cache.write_text(json.dumps(
+        {"rt_sec": 0.07, "bps": 12e6,
+         "measured_at": time.time() - 10 * 86400}))    # 10 days old
+    monkeypatch.setenv("S2C_LINK_CACHE", str(cache))
+    monkeypatch.setattr(linkprobe, "_probe_into",
+                        lambda box: box.append(None))  # probe fails
+    robs = obs.start_run()
+    try:
+        with caplog.at_level(logging.WARNING,
+                             "sam2consensus_tpu.utils.linkprobe"):
+            assert linkprobe.probe_link(force=True) == (0.07, 12e6)
+        snap = robs.registry.snapshot()
+        assert snap["gauges"]["link/stale"]["value"] == 1.0
+        age = snap["gauges"]["link/stale_age"]["value"]
+        assert 9 * 86400 < age < 11 * 86400
+        assert any("placement model is pricing" in r.message
+                   for r in caplog.records)
+        assert linkprobe.link_info()["source"] == "stale-cache"
+    finally:
+        obs.finish_run(robs)
+
+
+def test_fresh_stale_cache_serves_quietly(tmp_path, monkeypatch, caplog):
+    """A recent cache entry (within max age) serves without the age
+    alarm — link/stale still marks it as memory, not measurement."""
+    import json
+    import logging
+    import time
+
+    from sam2consensus_tpu import observability as obs
+
+    cache = tmp_path / "link.json"
+    cache.write_text(json.dumps(
+        {"rt_sec": 0.07, "bps": 12e6, "measured_at": time.time() - 60}))
+    monkeypatch.setenv("S2C_LINK_CACHE", str(cache))
+    monkeypatch.setattr(linkprobe, "_probe_into",
+                        lambda box: box.append(None))
+    robs = obs.start_run()
+    try:
+        with caplog.at_level(logging.WARNING,
+                             "sam2consensus_tpu.utils.linkprobe"):
+            assert linkprobe.probe_link(force=True) == (0.07, 12e6)
+        snap = robs.registry.snapshot()
+        assert snap["gauges"]["link/stale"]["value"] == 1.0
+        assert "link/stale_age" not in snap["gauges"]
+        assert not caplog.records
+    finally:
+        obs.finish_run(robs)
+
+
+def test_legacy_cache_without_timestamp_treated_stale(tmp_path,
+                                                      monkeypatch):
+    """Pre-timestamp cache entries have unknown age: flagged (-1) rather
+    than trusted silently."""
+    import json
+
+    from sam2consensus_tpu import observability as obs
+
+    cache = tmp_path / "link.json"
+    cache.write_text(json.dumps({"rt_sec": 0.05, "bps": 30e6}))
+    monkeypatch.setenv("S2C_LINK_CACHE", str(cache))
+    monkeypatch.setattr(linkprobe, "_probe_into",
+                        lambda box: box.append(None))
+    robs = obs.start_run()
+    try:
+        assert linkprobe.probe_link(force=True) == (0.05, 30e6)
+        snap = robs.registry.snapshot()
+        assert snap["gauges"]["link/stale_age"]["value"] == -1.0
+    finally:
+        obs.finish_run(robs)
+
+
+def test_link_cache_max_age_env_override(monkeypatch):
+    monkeypatch.setenv("S2C_LINK_CACHE_MAX_AGE", "3600")
+    assert linkprobe.cache_max_age() == 3600.0
+    monkeypatch.setenv("S2C_LINK_CACHE_MAX_AGE", "junk")
+    assert linkprobe.cache_max_age() == linkprobe.CACHE_MAX_AGE_SEC

@@ -264,6 +264,338 @@ def test_backend_trace_and_metrics(tmp_path, pileup):
         gauges["dispatch/pileup"]["info"]
 
 
+# -- export edge cases -----------------------------------------------------
+def test_export_empty_registry_and_tracer(tmp_path):
+    """An empty run still produces schema-valid artifacts."""
+    reg = MetricsRegistry()
+    mpath = tmp_path / "empty.jsonl"
+    obs.write_metrics_jsonl(reg, str(mpath))
+    rows = read_metrics_jsonl(str(mpath))
+    assert len(rows) == 1 and rows[0]["kind"] == "meta"
+
+    tr = Tracer(enabled=True)          # enabled, but nothing recorded
+    tpath = tmp_path / "empty.json"
+    obs.write_chrome_trace(tr, str(tpath))
+    blob = json.loads(tpath.read_text())
+    assert blob["traceEvents"] == []
+
+
+def test_export_unicode_span_labels(tmp_path):
+    tr = Tracer(enabled=True)
+    tr.name_thread("décode-λ")
+    with tr.span("φάση/vote", note="naïve—çedilla"):
+        pass
+    tr.event("drift/σ", chosen="gén")
+    path = tmp_path / "uni.json"
+    obs.write_chrome_trace(tr, str(path))
+    blob = json.loads(path.read_text(encoding="utf-8"))
+    names = {e["name"] for e in blob["traceEvents"]}
+    assert "φάση/vote" in names and "drift/σ" in names
+    complete = [e for e in blob["traceEvents"] if e["ph"] == "X"]
+    assert complete[0]["args"]["note"] == "naïve—çedilla"
+
+
+def test_export_concurrent_with_recording(tmp_path):
+    """Exports taken WHILE other threads record stay schema-valid
+    (drain/snapshot are locked snapshots, not live views)."""
+    tr = Tracer(enabled=True)
+    reg = MetricsRegistry()
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            with tr.span("hot", i=i):
+                reg.add("phase/hot_sec", 1e-6)
+                reg.observe("h", float(i % 7))
+            i += 1
+
+    workers = [threading.Thread(target=hammer) for _ in range(3)]
+    for w in workers:
+        w.start()
+    try:
+        for k in range(5):
+            tpath = tmp_path / f"t{k}.json"
+            mpath = tmp_path / f"m{k}.jsonl"
+            obs.write_chrome_trace(tr, str(tpath))
+            obs.write_metrics_jsonl(reg, str(mpath))
+            blob = json.loads(tpath.read_text())
+            for e in blob["traceEvents"]:
+                assert e["ph"] in ("X", "i", "M")
+                if e["ph"] == "X":
+                    assert e["dur"] >= 0
+            for row in read_metrics_jsonl(str(mpath)):
+                assert "kind" in row
+    finally:
+        stop.set()
+        for w in workers:
+            w.join()
+
+
+def test_export_numpy_args_serializable(tmp_path):
+    """numpy scalars riding in span args / gauge info must not turn an
+    artifact write into a crash."""
+    import numpy as np
+
+    tr = Tracer(enabled=True)
+    with tr.span("s", n=np.int64(7), f=np.float32(0.5)):
+        pass
+    reg = MetricsRegistry()
+    reg.gauge("g").set_info({"rows": np.int32(3),
+                             "arr": np.arange(2)})
+    obs.write_chrome_trace(tr, str(tmp_path / "t.json"))
+    obs.write_metrics_jsonl(reg, str(tmp_path / "m.jsonl"))
+    blob = json.loads((tmp_path / "t.json").read_text())
+    (span,) = [e for e in blob["traceEvents"] if e["ph"] == "X"]
+    assert span["args"]["n"] == 7
+    g = next(r for r in read_metrics_jsonl(str(tmp_path / "m.jsonl"))
+             if r["kind"] == "gauge")
+    assert g["info"]["rows"] == 3 and g["info"]["arr"] == [0, 1]
+
+
+# -- decision ledger -------------------------------------------------------
+def test_ledger_residual_join_and_gauges():
+    robs = obs.start_run()
+    try:
+        obs.record_decision(
+            "tail_placement", "cpu",
+            inputs={"total_len": 1000},
+            predicted={"sec": 0.10},
+            alternatives={"cpu": 0.10, "device": 0.30},
+            measured={"sec": {"counters": ["phase/vote_sec"]}})
+        obs.metrics().add("phase/vote_sec", 0.12)   # within band
+        recs = obs.finalize_decisions()
+        (rec,) = [r for r in recs if r.decision == "tail_placement"]
+        assert rec.measured["sec"] == pytest.approx(0.12)
+        assert rec.residual["sec"] == pytest.approx(1.2)
+        assert not rec.drift
+        snap = robs.registry.snapshot()
+        assert snap["gauges"]["residual/tail_placement/sec"]["value"] \
+            == pytest.approx(1.2)
+        info = snap["gauges"]["residual/tail_placement"]["info"]
+        assert info["chosen"] == "cpu" and info["drift"] is False
+        assert "drift/events" not in snap["counters"]
+    finally:
+        obs.finish_run(robs)
+
+
+def test_ledger_drift_fires_outside_band():
+    robs = obs.start_run()
+    try:
+        obs.record_decision(
+            "link_constants", "default",
+            predicted={"bps": 40e6},
+            measured={"bps": {"num": ["wire/bytes"],
+                              "den": ["phase/stage_sec"]}})
+        # measured effective rate 10x under the modeled one (the
+        # round-5 drifted-default shape): 4 MB over 1 s vs 40 MB/s
+        obs.metrics().add("wire/bytes", 4e6)
+        obs.metrics().add("phase/stage_sec", 1.0)
+        recs = obs.finalize_decisions()
+        (rec,) = [r for r in recs if r.decision == "link_constants"]
+        assert rec.residual["bps"] == pytest.approx(0.1)
+        assert rec.drift
+        snap = robs.registry.snapshot()
+        assert snap["counters"]["drift/events"] == 1
+        assert "drift/link_constants" in snap["gauges"]
+        extra = {}
+        obs.publish_stats_extra(extra)
+        assert extra["drift/events"] == 1
+        assert extra["residual/link_constants/bps"] == pytest.approx(0.1)
+    finally:
+        obs.finish_run(robs)
+
+
+def test_ledger_drift_respects_sec_floor_and_band_zero():
+    robs = obs.start_run()
+    try:
+        # microsecond predictions never drift (noise, not mis-routes)
+        obs.record_decision(
+            "tiny", "x", predicted={"sec": 1e-5},
+            measured={"sec": {"counters": ["phase/a_sec"]}})
+        obs.metrics().add("phase/a_sec", 1e-3)      # 100x, but tiny
+        # band=0 decisions record residual but never drift
+        obs.record_decision(
+            "informational", "y", predicted={"sec": 0.1},
+            measured={"sec": {"counters": ["phase/b_sec"]}}, band=0)
+        obs.metrics().add("phase/b_sec", 100.0)     # 1000x
+        recs = {r.decision: r for r in obs.finalize_decisions()}
+        assert not recs["tiny"].drift
+        assert recs["informational"].residual["sec"] == pytest.approx(
+            1000.0)
+        assert not recs["informational"].drift
+        assert "drift/events" not in robs.registry.snapshot()["counters"]
+    finally:
+        obs.finish_run(robs)
+
+
+def test_ledger_last_wins_and_missing_measurements():
+    robs = obs.start_run()
+    try:
+        obs.record_decision("d", "first", predicted={"sec": 1.0})
+        obs.record_decision(
+            "d", "second", predicted={"sec": 2.0},
+            measured={"sec": {"counters": ["phase/never_sec"]},
+                      "bps": {"num": ["wire/bytes"],
+                              "den": ["phase/zero_sec"]}})
+        recs = obs.finalize_decisions()
+        (rec,) = [r for r in recs if r.decision == "d"]
+        assert rec.chosen == "second"
+        # absent counters / zero denominators join nothing — and
+        # therefore can never fabricate a drift
+        assert rec.measured == {} and rec.residual == {}
+        assert not rec.drift
+    finally:
+        obs.finish_run(robs)
+
+
+def test_ledger_zero_traffic_and_min_num_never_drift():
+    """A zero rate is the ABSENCE of a measurement: num == 0 (no wire
+    traffic despite elapsed windows) and sub-floor traffic (min_num)
+    both join nothing — a healthy host-routed run must never alarm."""
+    robs = obs.start_run()
+    try:
+        obs.record_decision(
+            "link_constants", "default", predicted={"bps": 40e6},
+            measured={"bps": {"num": ["wire/bytes"],
+                              "den": ["phase/pileup_dispatch_sec"]}})
+        obs.metrics().add("phase/pileup_dispatch_sec", 3.0)  # no bytes
+        obs.record_decision(
+            "wire_codec", "delta8", predicted={"bps": 40e6},
+            measured={"bps": {"num": ["wire/bytes2"],
+                              "den": ["phase/stage_sec"],
+                              "min_num": 8e6}})
+        obs.metrics().add("wire/bytes2", 2e6)       # under the floor
+        obs.metrics().add("phase/stage_sec", 5.0)   # compute-dominated
+        recs = {r.decision: r for r in obs.finalize_decisions()}
+        assert recs["link_constants"].measured == {}
+        assert recs["wire_codec"].measured == {}
+        assert not recs["link_constants"].drift
+        assert not recs["wire_codec"].drift
+        assert "drift/events" not in robs.registry.snapshot()["counters"]
+    finally:
+        obs.finish_run(robs)
+
+
+def test_link_constants_mixed_env_probe_provenance(monkeypatch):
+    """One env override + a probed other half must be labeled env+…,
+    not attributed wholesale to the probe."""
+    import jax
+
+    from sam2consensus_tpu.backends import jax_backend as jb
+    from sam2consensus_tpu.utils import linkprobe
+
+    monkeypatch.setenv("S2C_TAIL_RT_MS", "100")
+    monkeypatch.delenv("S2C_TAIL_LINK_MBPS", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(linkprobe, "probe_link",
+                        lambda force=False: (5e-4, 10e9))
+    robs = obs.start_run()
+    try:
+        assert jb._link_constants() == (0.1, 10e9)
+        rec = obs.ledger().get("link_constants")
+        assert rec.chosen.startswith("env+")
+    finally:
+        obs.finish_run(robs)
+
+
+def test_env_forced_drifted_link_constant_triggers_drift(monkeypatch):
+    """The acceptance pin: a drifted env-forced constant produces a
+    drift event through the REAL decision site (_tail_cpu_wins with
+    the model predicting a ~ms device tail that 'measures' seconds)."""
+    import jax
+
+    from sam2consensus_tpu.backends import jax_backend as jb
+
+    # absurdly fast modeled link -> the model predicts a ~0.4 ms device
+    # tail and routes there
+    monkeypatch.setenv("S2C_TAIL_RT_MS", "0.1")
+    monkeypatch.setenv("S2C_TAIL_LINK_MBPS", "40000")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    robs = obs.start_run()
+    try:
+        cpu_won = jb._tail_cpu_wins(total_len=1_000_000, n_thresholds=1,
+                                    upload_bytes=6_000_000,
+                                    native_tail=True)
+        assert not cpu_won                       # model chose the chip
+        # ...but the measured tail took 2 s (the link was NOT 40 GB/s)
+        obs.metrics().add("phase/vote_sec", 2.0)
+        recs = {r.decision: r for r in obs.finalize_decisions()}
+        rec = recs["tail_placement"]
+        assert rec.chosen == "device" and rec.drift
+        assert rec.residual["sec"] > 100
+        snap = robs.registry.snapshot()
+        assert snap["counters"]["drift/events"] >= 1
+        assert "drift/tail_placement" in snap["gauges"]
+    finally:
+        obs.finish_run(robs)
+
+
+# -- manifest --------------------------------------------------------------
+def test_manifest_written_alongside_metrics_out(tmp_path):
+    """End-to-end: a sharded device-pileup run under --metrics-out
+    yields a manifest where the auto decisions carry prediction,
+    measured outcome and residual, plus provenance + artifact hashes."""
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.io.sam import ReadStream, read_header
+    from sam2consensus_tpu.observability import manifest as man_mod
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+    text = simulate(SimSpec(n_contigs=2, contig_len=300, n_reads=400,
+                            read_len=40, ins_read_rate=0.1, seed=9))
+    mpath = tmp_path / "run.jsonl"
+    cfg = RunConfig(prefix="t", backend="jax", pileup="scatter",
+                    shards=2, metrics_out=str(mpath))
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    JaxBackend().run(contigs, ReadStream(handle, first), cfg)
+
+    man_path = man_mod.manifest_path_for(str(mpath))
+    man = json.loads(open(man_path).read())
+    assert man["schema"] == "s2c-manifest/1"
+    assert man["config"]["pileup"] == "scatter"
+    assert man["env_overrides"].get("JAX_PLATFORMS") == "cpu"
+    decisions = {d["decision"]: d for d in man["decisions"]}
+    # the run's auto decisions are all present...
+    assert {"wire_codec", "shard_mode", "tail_placement",
+            "link_constants"} <= set(decisions)
+    # ...and the priced ones carry prediction + measured + residual
+    wire = decisions["wire_codec"]
+    assert wire["predicted"]["ratio"] == 1.0       # packed5 (link-free)
+    assert wire["measured"]["ratio"] == pytest.approx(1.0)
+    assert wire["residual"]["ratio"] == pytest.approx(1.0)
+    shard = decisions["shard_mode"]
+    assert shard["chosen"] in ("dp", "sp", "dpsp")
+    assert shard["predicted"]["sec"] > 0
+    assert shard["measured"]["sec"] > 0
+    assert shard["residual"]["sec"] > 0
+    assert shard["alternatives"]                  # the full cost table
+    assert not shard["drift"]                     # band=0: informational
+    # artifact hash matches the metrics file the same run wrote
+    digest = man["artifacts"]["metrics"]["digest"]
+    assert digest == man_mod.file_digest(str(mpath))
+    assert man["phases"].get("phase/vote_sec", 0) > 0
+    # the same manifest is reachable in-process (bench.py embeds it)
+    last = obs.last_manifest()
+    assert last is not None and last["schema"] == "s2c-manifest/1"
+
+
+def test_manifest_summarize_compact():
+    from sam2consensus_tpu.observability import manifest as man_mod
+
+    robs = obs.start_run()
+    try:
+        obs.record_decision("wire_codec", "delta8",
+                            predicted={"ratio": 2.0})
+    finally:
+        obs.finish_run(robs)
+    summary = man_mod.summarize(obs.last_manifest())
+    assert summary["schema"] == "s2c-manifest/1"
+    assert summary["decisions"][0]["decision"] == "wire_codec"
+    assert "config" not in summary                 # compact form
+
+
 def test_tail_dispatch_decision_recorded():
     """The placement model's verdict carries its modeled inputs."""
     from sam2consensus_tpu.backends import jax_backend as jb

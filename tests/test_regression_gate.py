@@ -1,0 +1,173 @@
+"""The noise-aware perf regression gate (observability/regress.py +
+tools/regress_check.py) — tier-1 wiring.
+
+Pins the ISSUE's acceptance list: the gate exits 0 on the committed
+``BENCH_r01..r05`` trajectory (including the head-truncated tail
+captures and the crashed r01 round), exits nonzero on a synthetic
+regressed row, honors min-repeat awareness, and judges deltas with
+median/MAD bands instead of naive round-over-round comparison.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from sam2consensus_tpu.observability import regress
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "regress_check", os.path.join(REPO, "tools", "regress_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+regress_check = _load_tool()
+
+
+# -- band/verdict units ----------------------------------------------------
+def test_check_series_directions():
+    hist = [100.0, 102.0, 98.0, 101.0]
+    # throughput-like (higher better): a crash regresses, a jump improves
+    assert regress.check_series(hist, 40.0)["status"] == "regressed"
+    assert regress.check_series(hist, 180.0)["status"] == "improved"
+    assert regress.check_series(hist, 95.0)["status"] == "pass"
+    # seconds-like (lower better): the directions flip
+    assert regress.check_series(hist, 250.0,
+                                lower_is_better=True)["status"] \
+        == "regressed"
+    assert regress.check_series(hist, 40.0,
+                                lower_is_better=True)["status"] \
+        == "improved"
+
+
+def test_check_series_min_repeats():
+    v = regress.check_series([100.0, 101.0], 10.0)
+    assert v["status"] == "insufficient_history"
+    assert v["n_history"] == 2
+    # with the repeats present the same candidate regresses
+    assert regress.check_series([100.0, 101.0, 99.0],
+                                10.0)["status"] == "regressed"
+
+
+def test_noise_floor_rel_floor_guards_quiet_history():
+    # three identical points: MAD = 0, but ordinary rig noise must not
+    # flag — the relative floor carries the band
+    hist = [10.0, 10.0, 10.0]
+    assert regress.check_series(hist, 12.0)["status"] == "pass"
+    assert regress.check_series(hist, 2.0)["status"] == "regressed"
+
+
+def test_mad_band_tolerates_one_wild_round():
+    # one 2x outlier round in the history must not explode the center
+    hist = [10.0, 10.5, 9.8, 21.0, 10.2]
+    v = regress.check_series(hist, 10.0)
+    assert v["status"] == "pass"
+    assert v["median"] == pytest.approx(10.2)
+
+
+# -- artifact tolerance ----------------------------------------------------
+def test_extract_rows_from_truncated_capture():
+    # a head-truncated capture: the first row is cut mid-object, the
+    # rest are intact — exactly the committed BENCH_r0* shape
+    text = ('es_per_s": 42.0}, "identical": true}, '
+            '{"config": "a", "jax_sec": 1.5, "vs_baseline": 10.0}, '
+            '{"config": "b", "jax_sec": 0.5, "vs_baseline": 20.0}]}')
+    rows = regress.extract_bench_rows(text)
+    assert [r["config"] for r in rows] == ["a", "b"]
+    assert rows[0]["vs_baseline"] == 10.0
+
+
+def test_committed_trajectory_loads():
+    paths = sorted(os.path.join(REPO, f"BENCH_r0{i}.json")
+                   for i in range(1, 6))
+    per_round = [regress.load_bench_artifact(p) for p in paths]
+    # r01 crashed (rc=1): no recoverable rows; later rounds have rows
+    assert per_round[0] == []
+    assert all(len(rows) > 0 for rows in per_round[1:])
+    series = regress.bench_series(paths)
+    assert ("north_star", "vs_baseline") in series
+
+
+# -- the CI gate -----------------------------------------------------------
+def test_gate_passes_on_committed_history(capsys):
+    """THE acceptance pin: the gate must exit 0 on the repo's own
+    committed bench trajectory."""
+    rc = regress_check.main([])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 regression(s)" in out
+
+
+def _write_round(tmp_path, i, vs_baseline, jax_sec):
+    # the driver-wrapper shape the real trajectory uses
+    inner = json.dumps({"configs": [
+        {"config": "north_star", "vs_baseline": vs_baseline,
+         "jax_sec": jax_sec, "identical": True}]})
+    path = tmp_path / f"BENCH_t{i:02d}.json"
+    path.write_text(json.dumps({"rc": 0, "tail": inner + "\n",
+                                "parsed": None}))
+    return str(path)
+
+
+def test_gate_fails_on_synthetic_regression(tmp_path, capsys):
+    paths = [_write_round(tmp_path, i, vs, sec)
+             for i, (vs, sec) in enumerate(
+                 [(100.0, 1.0), (104.0, 0.97), (98.0, 1.03),
+                  (101.0, 1.0)])]
+    paths.append(_write_round(tmp_path, 9, 30.0, 3.4))   # the crash
+    rc = regress_check.main(paths)
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "REGRESSED: north_star/vs_baseline" in out
+    assert "REGRESSED: north_star/jax_sec" in out
+
+
+def test_gate_min_repeats_passes_short_history(tmp_path, capsys):
+    paths = [_write_round(tmp_path, 0, 100.0, 1.0),
+             _write_round(tmp_path, 1, 101.0, 1.0),
+             _write_round(tmp_path, 9, 30.0, 3.4)]
+    rc = regress_check.main(paths)
+    out = capsys.readouterr().out
+    assert rc == 0, out                # 2 priors < min_repeats: loud pass
+    assert "pass (2 repeats)" in out
+
+
+def test_gate_improvement_is_not_a_failure(tmp_path):
+    paths = [_write_round(tmp_path, i, 100.0 + i, 1.0)
+             for i in range(4)]
+    paths.append(_write_round(tmp_path, 9, 400.0, 0.25))
+    assert regress_check.main(paths) == 0
+
+
+def test_gate_json_output(tmp_path, capsys):
+    paths = [_write_round(tmp_path, i, v, 1.0)
+             for i, v in enumerate([100.0, 99.0, 101.0, 100.0])]
+    rc = regress_check.main(paths + ["--json"])
+    blob = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert blob["regressed"] == 0
+    assert any(v["config"] == "north_star" for v in blob["verdicts"])
+
+
+# -- campaign JSONL mode ---------------------------------------------------
+def test_gate_jsonl_series(tmp_path, capsys):
+    path = tmp_path / "sweep.jsonl"
+    rows = [{"point": "w128", "median_sec": s}
+            for s in (1.0, 1.02, 0.98, 1.01, 4.0)]   # regressed tail
+    rows += [{"point": "w256", "median_sec": s}
+             for s in (2.0, 2.05, 1.95, 2.0, 2.02)]  # stable
+    rows.append({"malformed": True})
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\nnot json\n")
+    rc = regress_check.main(["--jsonl", str(path), "--group-by", "point",
+                             "--value", "median_sec",
+                             "--lower-is-better"])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "REGRESSED: w128/median_sec" in out

@@ -11,11 +11,24 @@ Two input shapes, auto-detected:
   plus counters/gauges/histograms, sourced from the registry itself
   instead of hand-parsing ``stats.extra`` keys.
 
-Usage: python tools/bench_report.py <file.json|metrics.jsonl>
+Usage:
+  python tools/bench_report.py <file.json|metrics.jsonl>
+  python tools/bench_report.py --diff OLD NEW [--rel-floor F]
+
+``--diff`` renders a per-phase/per-config delta table between two
+artifacts (either shape, including head-truncated BENCH captures),
+with each delta judged against the same noise floor the regression
+gate uses (sam2consensus_tpu/observability/regress.py): deltas inside
+the band print ``≈`` (rig noise, not a finding), outside it
+``slower``/``faster``.
 """
 
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def load(path):
@@ -132,13 +145,94 @@ def report_bench(obj):
               f"| {r.get('identical', 'n/a')} | {ph} | {ut} |")
 
 
-def main():
-    kind, payload = load(sys.argv[1])
+def _series_for_diff(path):
+    """``{series_label: seconds}`` from either artifact shape, for the
+    --diff table.  Bench artifacts (incl. truncated driver captures)
+    contribute ``<config>.jax_sec`` plus ``<config>.<phase>``; metrics
+    JSONL sinks contribute the phase counters."""
+    from sam2consensus_tpu.observability import regress
+
+    text = open(path).read().strip()
+    first = text.splitlines()[0] if text else ""
+    try:
+        head = json.loads(first)
+    except json.JSONDecodeError:
+        head = None
+    if isinstance(head, dict) and head.get("kind") == "meta":
+        rows = [json.loads(ln) for ln in text.splitlines() if ln.strip()]
+        return {r["name"]: r["value"] for r in rows
+                if r.get("kind") == "counter"
+                and r["name"].startswith("phase/")}
+    out = {}
+    for row in regress.load_bench_artifact(path):
+        if "error" in row or "config" not in row:
+            continue
+        cfg = row["config"]
+        if isinstance(row.get("jax_sec"), (int, float)):
+            out[f"{cfg}.jax_sec"] = float(row["jax_sec"])
+        for ph, v in (row.get("phases") or {}).items():
+            if isinstance(v, (int, float)):
+                out[f"{cfg}.{ph}"] = float(v)
+    return out
+
+
+def report_diff(old_path, new_path, rel_floor=None):
+    """Per-phase delta table OLD -> NEW, noise-judged by the regression
+    gate's band logic (two points have no MAD, so the band is the
+    relative noise floor alone)."""
+    from sam2consensus_tpu.observability import regress
+
+    if rel_floor is None:
+        rel_floor = regress.DEFAULT_REL_FLOOR
+    old = _series_for_diff(old_path)
+    new = _series_for_diff(new_path)
+    keys = sorted(set(old) & set(new))
+    if not keys:
+        print("no comparable series between the two artifacts",
+              file=sys.stderr)
+        return 2
+    print(f"diff: {old_path} -> {new_path} "
+          f"(noise floor ±{rel_floor * 100:.0f}%)\n")
+    print("| series | old s | new s | Δ | verdict |")
+    print("|---|---|---|---|---|")
+    slower = 0
+    for k in keys:
+        o, n = old[k], new[k]
+        allowed = regress.noise_floor(o, 0.0, rel_floor=rel_floor)
+        delta = n - o
+        pct = f"{100.0 * delta / o:+.1f}%" if o else "—"
+        if delta > allowed:
+            verdict = "slower"
+            slower += 1
+        elif delta < -allowed:
+            verdict = "faster"
+        else:
+            verdict = "≈"
+        print(f"| {k} | {o:.4f} | {n:.4f} | {pct} | {verdict} |")
+    print(f"\n{len(keys)} series, {slower} slower beyond the noise floor")
+    return 0
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "--diff":
+        rest = argv[1:]
+        rel_floor = None
+        if "--rel-floor" in rest:
+            i = rest.index("--rel-floor")
+            rel_floor = float(rest[i + 1])
+            del rest[i:i + 2]
+        if len(rest) != 2:
+            sys.exit("usage: bench_report.py --diff OLD NEW "
+                     "[--rel-floor F]")
+        return report_diff(rest[0], rest[1], rel_floor)
+    kind, payload = load(argv[0])
     if kind == "metrics":
         report_metrics(payload)
     else:
         report_bench(payload)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""Noise-aware perf regression gate over the committed bench trajectory.
+
+Usage:
+  python tools/regress_check.py                      # BENCH_r*.json in repo root
+  python tools/regress_check.py A.json B.json C.json # explicit trajectory
+  python tools/regress_check.py --new fresh.json     # gate a candidate
+  python tools/regress_check.py --jsonl campaign/x.jsonl \
+         --group-by config --value median_sec        # campaign series mode
+
+The trajectory files are driver-wrapper BENCH artifacts (possibly with
+head-truncated ``tail`` captures — per-config rows are recovered with a
+balanced-object scan) or bare bench JSON lines.  The LAST file (or
+``--new``) is the candidate; every earlier file is history.  Per
+(config, metric) series the candidate is checked against the history's
+median/MAD band (observability/regress.py): fewer than ``--min-repeats``
+prior points is ``insufficient_history`` (passes, loudly), a candidate
+outside the band in the bad direction is a regression (exit 1), the
+good direction an improvement (reported, exit 0).
+
+This is the CI gate (tests/test_regression_gate.py runs it against the
+committed BENCH_r01..r05 history) and the engine behind
+``tools/bench_report.py --diff``.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from sam2consensus_tpu.observability import regress  # noqa: E402
+
+
+def discover_default(root):
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+
+
+def gate_bench(paths, candidate_path, metrics, k, rel_floor, min_repeats):
+    """Verdict rows for every (config, metric) series; the candidate is
+    ``candidate_path``'s value, history is every other file's."""
+    series = regress.bench_series(paths, metrics=metrics)
+    verdicts = []
+    for (config, metric), points in sorted(series.items()):
+        cand = [v for p, v in points if p == candidate_path]
+        hist = [v for p, v in points if p != candidate_path]
+        if not cand:
+            continue            # config absent from the candidate round
+        res = regress.check_series(
+            hist, cand[-1],
+            lower_is_better=regress.LOWER_IS_BETTER.get(metric, False),
+            k=k, rel_floor=rel_floor, min_repeats=min_repeats)
+        res.update(config=config, metric=metric)
+        verdicts.append(res)
+    return verdicts
+
+
+def gate_jsonl(path, group_by, value_field, k, rel_floor, min_repeats,
+               lower_is_better):
+    """Per-group verdicts over a campaign JSONL: within each group the
+    LAST row is the candidate, earlier rows are history."""
+    series = regress.series_from_jsonl(path, group_by, value_field)
+    verdicts = []
+    for group, values in sorted(series.items()):
+        if len(values) < 2:
+            continue
+        res = regress.check_series(
+            values[:-1], values[-1], lower_is_better=lower_is_better,
+            k=k, rel_floor=rel_floor, min_repeats=min_repeats)
+        res.update(config=group, metric=value_field)
+        verdicts.append(res)
+    return verdicts
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("files", nargs="*",
+                   help="bench artifacts in trajectory order "
+                        "(default: BENCH_r*.json in the repo root)")
+    p.add_argument("--new", dest="new", default=None,
+                   help="candidate artifact (default: last trajectory "
+                        "file)")
+    p.add_argument("--metric", action="append", default=None,
+                   help="per-config metric(s) to gate "
+                        "(default: vs_baseline, jax_sec)")
+    p.add_argument("--k", type=float, default=regress.DEFAULT_K,
+                   help="MAD band width (sigmas; default %(default)s)")
+    p.add_argument("--rel-floor", type=float,
+                   default=regress.DEFAULT_REL_FLOOR,
+                   help="relative noise floor (fraction of the median "
+                        "always tolerated; default %(default)s)")
+    p.add_argument("--min-repeats", type=int,
+                   default=regress.DEFAULT_MIN_REPEATS,
+                   help="history points required before the band is "
+                        "trusted (default %(default)s)")
+    p.add_argument("--jsonl", default=None,
+                   help="campaign JSONL series mode (instead of BENCH "
+                        "trajectory)")
+    p.add_argument("--group-by", default="config",
+                   help="JSONL mode: series key field")
+    p.add_argument("--value", default="median_sec",
+                   help="JSONL mode: numeric field to gate")
+    p.add_argument("--lower-is-better", action="store_true",
+                   help="JSONL mode: the value regresses upward "
+                        "(seconds-like)")
+    p.add_argument("--json", action="store_true",
+                   help="emit verdicts as JSON instead of a table")
+    args = p.parse_args(argv)
+
+    if args.jsonl:
+        verdicts = gate_jsonl(args.jsonl, args.group_by, args.value,
+                              args.k, args.rel_floor, args.min_repeats,
+                              args.lower_is_better)
+    else:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = args.files or discover_default(root)
+        if args.new:
+            paths = [f for f in paths if f != args.new] + [args.new]
+        if not paths:
+            print("no bench artifacts found", file=sys.stderr)
+            return 2
+        candidate = args.new or paths[-1]
+        metrics = tuple(args.metric or ("vs_baseline", "jax_sec"))
+        verdicts = gate_bench(paths, candidate, metrics, args.k,
+                              args.rel_floor, args.min_repeats)
+
+    regressed = [v for v in verdicts if v["status"] == "regressed"]
+    if args.json:
+        print(json.dumps({"verdicts": verdicts,
+                          "regressed": len(regressed)}, indent=1))
+    else:
+        print(f"{'series':<40} {'status':<22} {'candidate':>12} "
+              f"{'median':>12} {'allowed':>10}")
+        for v in verdicts:
+            med = "—" if v["median"] is None else f"{v['median']:.4g}"
+            allowed = "—" if v["allowed"] is None \
+                else f"±{v['allowed']:.3g}"
+            label = f"{v['config']}/{v['metric']}"
+            status = v["status"]
+            if status == "insufficient_history":
+                status = f"pass ({v['n_history']} repeats)"
+            print(f"{label:<40} {status:<22} {v['candidate']:>12.4g} "
+                  f"{med:>12} {allowed:>10}")
+        print(f"\n{len(verdicts)} series checked, "
+              f"{len(regressed)} regression(s)")
+        for v in regressed:
+            print(f"REGRESSED: {v['config']}/{v['metric']} = "
+                  f"{v['candidate']:.4g} vs median {v['median']:.4g} "
+                  f"(allowed ±{v['allowed']:.3g}, "
+                  f"n={v['n_history']})")
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

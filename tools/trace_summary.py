@@ -1,18 +1,24 @@
 #!/usr/bin/env python3
-"""One-shot trace triage: print the top-N widest spans from a Chrome/
-Perfetto trace-event JSON (the CLI's ``--trace-out`` artifact).
+"""One-shot trace triage: where did the time actually go?
 
-Usage: python tools/trace_summary.py <trace.json> [-n TOP]
+Usage: python tools/trace_summary.py <trace.json> [-n TOP] [--inclusive]
 
-Reads ``ph: "X"`` complete events, ranks by ``dur``, and prints one
-line per span with its share of the trace's wall clock — the first
-question every perf investigation asks ("where did the time go?")
-answered without opening a UI.
+Reads ``ph: "X"`` complete events from a Chrome/Perfetto trace-event
+JSON (the CLI's ``--trace-out`` artifact) and prints the top-N span
+NAMES by aggregate EXCLUSIVE self-time — each span's duration minus its
+direct children's (nesting is timestamp containment within a thread,
+exactly how Perfetto renders ``ph: X``).  Without the self-time
+subtraction a nested tree double-bills every parent phase: the
+``accumulate`` window CONTAINS every ``pileup_dispatch`` and ``slab``
+span, so the old inclusive top-N said "accumulate is 100%, dispatch is
+90%, slabs are 85%" of the same second.  ``--inclusive`` restores the
+raw widest-single-span ranking for when that's the question.
 """
 
 import argparse
 import json
 import sys
+from collections import defaultdict
 
 
 def load_events(path):
@@ -22,11 +28,46 @@ def load_events(path):
     return [e for e in events if e.get("ph") == "X"]
 
 
+def self_times(spans):
+    """Per-span exclusive duration: ``dur`` minus the summed ``dur`` of
+    DIRECT children (same tid, timestamp-contained).  Returns a list of
+    (event, self_us) in input order.
+
+    One stack pass per thread over (ts, -dur)-sorted spans: when the
+    next span starts after the stack top ends, the top is closed; a
+    span starting inside the top is its direct child and bills its
+    whole duration to exactly that parent (grandparents already billed
+    the child's parent, so nothing double-subtracts).
+    """
+    by_tid = defaultdict(list)
+    for e in spans:
+        by_tid[e.get("tid", 0)].append(e)
+    out = []
+    for tid_spans in by_tid.values():
+        # ties: the longer span first, so a child sharing its parent's
+        # start timestamp nests under it instead of beside it
+        tid_spans.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []      # [(end_ts, child_dur_accum_list)]
+        for e in tid_spans:
+            end = e["ts"] + e["dur"]
+            while stack and e["ts"] >= stack[-1][0] - 1e-9:
+                stack.pop()
+            if stack:
+                stack[-1][1][0] += e["dur"]
+            acc = [0.0]
+            stack.append((end, acc))
+            out.append((e, acc))
+    return [(e, max(0.0, e["dur"] - acc[0])) for e, acc in out]
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("trace", help="trace-event JSON (--trace-out output)")
     p.add_argument("-n", "--top", type=int, default=5,
-                   help="spans to print (default 5)")
+                   help="rows to print (default 5)")
+    p.add_argument("--inclusive", action="store_true",
+                   help="rank individual spans by raw (inclusive) "
+                        "duration instead of aggregating self-time")
     args = p.parse_args(argv)
 
     spans = load_events(args.trace)
@@ -35,17 +76,39 @@ def main(argv=None):
         return 1
     wall_us = max(e["ts"] + e["dur"] for e in spans) \
         - min(e["ts"] for e in spans)
-    spans.sort(key=lambda e: e["dur"], reverse=True)
-    print(f"{len(spans)} spans, wall {wall_us / 1e6:.4f}s — "
-          f"top {min(args.top, len(spans))} by duration:")
-    print(f"{'span':<24} {'dur_s':>10} {'% wall':>7}  args")
-    for e in spans[:args.top]:
-        arg_txt = ""
-        if e.get("args"):
-            arg_txt = " ".join(f"{k}={v}" for k, v in e["args"].items())
-        pct = 100.0 * e["dur"] / wall_us if wall_us > 0 else 0.0
-        print(f"{e['name']:<24} {e['dur'] / 1e6:>10.4f} {pct:>6.1f}%  "
-              f"{arg_txt}")
+
+    if args.inclusive:
+        spans.sort(key=lambda e: e["dur"], reverse=True)
+        print(f"{len(spans)} spans, wall {wall_us / 1e6:.4f}s — "
+              f"top {min(args.top, len(spans))} by inclusive duration:")
+        print(f"{'span':<24} {'dur_s':>10} {'% wall':>7}  args")
+        for e in spans[:args.top]:
+            arg_txt = ""
+            if e.get("args"):
+                arg_txt = " ".join(f"{k}={v}"
+                                   for k, v in e["args"].items())
+            pct = 100.0 * e["dur"] / wall_us if wall_us > 0 else 0.0
+            print(f"{e['name']:<24} {e['dur'] / 1e6:>10.4f} "
+                  f"{pct:>6.1f}%  {arg_txt}")
+        return 0
+
+    agg = defaultdict(lambda: [0, 0.0, 0.0])   # name -> [n, self, incl]
+    for e, self_us in self_times(spans):
+        a = agg[e["name"]]
+        a[0] += 1
+        a[1] += self_us
+        a[2] += e["dur"]
+    rows = sorted(agg.items(), key=lambda kv: kv[1][1], reverse=True)
+    total_self = sum(a[1] for _n, a in rows)
+    print(f"{len(spans)} spans / {len(rows)} names, "
+          f"wall {wall_us / 1e6:.4f}s — "
+          f"top {min(args.top, len(rows))} by exclusive self-time:")
+    print(f"{'span':<24} {'count':>6} {'self_s':>10} {'% self':>7} "
+          f"{'incl_s':>10}")
+    for name, (n, self_us, incl_us) in rows[:args.top]:
+        pct = 100.0 * self_us / total_self if total_self > 0 else 0.0
+        print(f"{name:<24} {n:>6} {self_us / 1e6:>10.4f} {pct:>6.1f}% "
+              f"{incl_us / 1e6:>10.4f}")
     return 0
 
 
