@@ -31,7 +31,11 @@ Env knobs: BENCH_SCALE (read-count multiplier, default 1.0), BENCH_CONFIGS
 (comma-separated subset of config names), BENCH_READS / BENCH_CONTIGS /
 BENCH_READ_LEN / BENCH_CONTIG_LEN (headline workload, defaults 200000 /
 100 / 100 / 2000), BENCH_INIT_TIMEOUT (probe seconds, default 300),
-BENCH_INIT_RETRIES (default 2).
+BENCH_INIT_RETRIES (default 2), BENCH_SERVE_JOBS (serve-leg batch size,
+default 8; 0 disables the leg), BENCH_FULL_OUT / BENCH_TAG (write the
+complete result object — every row, untruncated — to this path / to
+BENCH_<tag>.full.json, so downstream consumers stop recovering rows
+from head-truncated stdout captures).
 """
 
 import json
@@ -490,6 +494,56 @@ def bench_config(name, spec, cfg_kwargs, jax_variants, tmp, extras=None):
     return rows
 
 
+def serve_leg(n_jobs):
+    """The warm-serving row (PR-5 tentpole): a batch of small jobs
+    through one persistent ServeRunner vs one cold CLI process per job
+    (sam2consensus_tpu/serve/benchmark.py).  ``jax_sec`` is the warm
+    per-job mean and ``vs_baseline`` the cold-process/warm ratio —
+    directionally identical to every other row's metrics, so the
+    regression gate judges the serve series with the same bands."""
+    from sam2consensus_tpu.serve.benchmark import run_serve_bench
+
+    res = run_serve_bench(n_jobs=n_jobs, log=log)
+    s = res["summary"]
+    row = {
+        "config": "serve_warm",
+        "jobs": s["n_jobs"],
+        "reads_per_job": s["n_reads"],
+        "jax_sec": s["warm_per_job_sec"],
+        "warm_tail_sec": s["warm_tail_per_job_sec"],
+        "cold_process_sec": s["cold_per_job_sec"],
+        "vs_baseline": s["speedup_vs_cold"],
+        "vs_baseline_kind": "cold_process",
+        "identical": s["identical"],
+        "serve": {
+            "overlap_sec": s["overlap_sec_total"],
+            "jit_hits": sum(r.get("jit_hit", 0) for r in res["rows"]
+                            if r.get("mode") == "warm"),
+            "jit_misses": sum(r.get("jit_miss", 0) for r in res["rows"]
+                              if r.get("mode") == "warm"),
+            "jit_cache_dir": s["jit_cache_dir"],
+        },
+    }
+    log(f"[serve_warm] cold {s['cold_per_job_sec']}s/job vs warm "
+        f"{s['warm_per_job_sec']}s/job = {s['speedup_vs_cold']}x, "
+        f"identical={s['identical']}")
+    return row
+
+
+def full_artifact_path():
+    """Destination for the complete (untruncated) result object:
+    BENCH_FULL_OUT wins, else BENCH_TAG -> BENCH_<tag>.full.json next
+    to this script, else None (no artifact — the stdout line is all)."""
+    out = os.environ.get("BENCH_FULL_OUT")
+    if out:
+        return out
+    tag = os.environ.get("BENCH_TAG")
+    if tag:
+        return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            f"BENCH_{tag}.full.json")
+    return None
+
+
 def main():
     # the headline value/vs_baseline fields are inserted LAST so a
     # tail-truncated capture of the JSON line always retains them
@@ -531,6 +585,15 @@ def main():
                 except Exception as exc:  # keep earlier rows on any failure
                     log(f"[{name}] FAILED: {type(exc).__name__}: {exc}")
                     rows.append({"config": name, "error": repr(exc)})
+        # warm-serving leg: rides the same rows list so the regression
+        # gate sees a serve series once >=1 round of history exists
+        n_serve = int(os.environ.get("BENCH_SERVE_JOBS", "8"))
+        if n_serve > 0 and (not only or "serve_warm" in only):
+            try:
+                rows.append(serve_leg(n_serve))
+            except Exception as exc:
+                log(f"[serve_warm] FAILED: {type(exc).__name__}: {exc}")
+                rows.append({"config": "serve_warm", "error": repr(exc)})
         result["configs"] = rows
 
         # the driver-recorded metric IS the north_star row: BASELINE.md
@@ -574,6 +637,20 @@ def main():
         log(f"[bench] FATAL: {exc!r}")
     result["value"] = value
     result["vs_baseline"] = vs_baseline
+    full_out = full_artifact_path()
+    if full_out:
+        # the COMPLETE result object as a committed sibling artifact:
+        # driver captures keep only the tail of stdout, so the row set
+        # used to be recovered by scanning truncated text
+        # (observability/regress.py) — consumers now read
+        # BENCH_<tag>.full.json directly when it exists
+        try:
+            with open(full_out, "w") as fh:
+                json.dump(result, fh, indent=1)
+                fh.write("\n")
+            log(f"[bench] full row set written to {full_out}")
+        except OSError as exc:
+            log(f"[bench] could not write {full_out}: {exc}")
     print(json.dumps(result))
     return 0
 

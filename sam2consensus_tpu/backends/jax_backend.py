@@ -505,13 +505,22 @@ class JaxBackend:
         each other), then derive the legacy ``stats.extra`` keys from
         the registry and write any requested exports.  The fault
         injector (resilience/faultinject.py) configures here too, so
-        its per-site call counters are per-run-deterministic."""
+        its per-site call counters are per-run-deterministic.
+
+        Serve mode (sam2consensus_tpu/serve) pre-creates a job's
+        instruments (``observability.prepare_run``) so the decode-ahead
+        thread can record into them before the run starts; it hands the
+        handle over via the ``serve_prepared_obs`` attribute, consumed
+        (and cleared) here."""
         from ..resilience import faultinject
 
+        prepared = getattr(self, "serve_prepared_obs", None)
+        if prepared is not None:
+            self.serve_prepared_obs = None
         robs = obs.start_run(
             trace_out=getattr(cfg, "trace_out", None),
             metrics_out=getattr(cfg, "metrics_out", None),
-            config=cfg)
+            config=cfg, prepared=prepared)
         faultinject.configure(getattr(cfg, "fault_inject", "") or None)
         try:
             result = self._run(contigs, records, cfg)
@@ -798,6 +807,10 @@ class JaxBackend:
             policy, layout.total_len,
             checkpoint_cb=_emergency_ckpt if cfg.checkpoint_dir else None,
             on_demote=_rebind_stage)
+        # serve mode: the runner plants a list here so it can intersect
+        # THIS job's device-dispatch intervals with the NEXT job's
+        # decode-ahead intervals (the cross-job serve/overlap_sec)
+        dispatch_log = getattr(self, "serve_dispatch_log", None)
         try:
             for batch in batch_iter:
                 if cfg.paranoid:
@@ -808,8 +821,10 @@ class JaxBackend:
                 ta = time.perf_counter()
                 with tr.span("pileup_dispatch", n_events=batch.n_events):
                     acc = dispatcher.add(acc, batch)
-                reg.add("phase/pileup_dispatch_sec",
-                        time.perf_counter() - ta)
+                tb = time.perf_counter()
+                reg.add("phase/pileup_dispatch_sec", tb - ta)
+                if dispatch_log is not None:
+                    dispatch_log.append((ta, tb))
                 if stager is not None:
                     # release this batch's staging slot (backpressure
                     # window moves to the next slab) and log the
@@ -1621,6 +1636,15 @@ class JaxBackend:
         from ..encoder.events import GenomeLayout, ReadEncoder  # noqa: F811
         from ..io.sam import ReadStream
         from ..ops.pileup import HostPileupAccumulator
+
+        if getattr(records, "is_predecoded", False):
+            # serve mode (sam2consensus_tpu/serve): the job's decode ran
+            # ahead on a side thread — overlapping the PREVIOUS job's
+            # device work — and arrives as a ready encoder + its batch
+            # stream (already-decoded batches first, then any live
+            # remainder).  Decode seconds were billed to this job's
+            # registry by the decode-ahead thread.
+            return records.encoder, records.batches()
 
         if isinstance(records, ReadStream) and cfg.decoder != "py":
             from ..encoder import native_encoder

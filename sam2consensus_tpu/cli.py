@@ -266,8 +266,153 @@ def get_backend(name: str):
     raise ValueError(f"unknown backend {name!r}")
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    """The ``serve`` subcommand's surface: many ``-i`` inputs sharing one
+    flag set, run through a persistent warm backend
+    (sam2consensus_tpu/serve).  Job-shared flags mirror the one-shot
+    CLI; checkpoint/incremental flags are absent by design (their
+    serial-decode contract does not compose with decode-ahead)."""
+    p = argparse.ArgumentParser(
+        prog="sam2consensus-tpu serve",
+        description="persistent multi-job serving: one warm jax "
+                    "backend across every input (jit reuse + cross-job "
+                    "pipelining); outputs per job like N one-shot runs")
+    p.add_argument("-i", "--input", dest="inputs", action="append",
+                   required=True,
+                   help="SAM input (repeatable; one job per input, run "
+                        "in order)")
+    p.add_argument("-c", "--consensus-thresholds", dest="thresholds",
+                   type=str, default="0.25")
+    p.add_argument("-n", dest="n", type=int, default=0)
+    p.add_argument("-o", "--outfolder", dest="outfolder", default="./")
+    p.add_argument("-m", "--min-depth", dest="min_depth", type=int,
+                   default=1)
+    p.add_argument("-f", "--fill", dest="fill", default="-")
+    p.add_argument("-d", "--maxdel", dest="maxdel", type=int, default=None)
+    p.add_argument("--py2-compat", action="store_true")
+    p.add_argument("--permissive", action="store_true")
+    p.add_argument("--quiet", action="store_true")
+    p.add_argument("--pileup",
+                   choices=["auto", "pallas", "mxu", "scatter", "host"],
+                   default="auto")
+    p.add_argument("--wire", choices=["auto", "packed5", "delta8"],
+                   default="auto")
+    p.add_argument("--insertion-kernel", dest="ins_kernel",
+                   choices=["auto", "scatter", "pallas"], default="auto")
+    p.add_argument("--decode-threads", dest="decode_threads", type=int,
+                   default=1)
+    p.add_argument("--decoder", choices=["auto", "native", "py"],
+                   default="auto")
+    p.add_argument("--shard-mode", dest="shard_mode",
+                   choices=["auto", "dp", "sp", "dpsp"], default="auto")
+    p.add_argument("--shards", type=int, default=0)
+    p.add_argument("--chunk-reads", dest="chunk_reads", type=int,
+                   default=262144)
+    p.add_argument("--retries", type=int, default=3)
+    p.add_argument("--retry-backoff", dest="retry_backoff", type=float,
+                   default=0.25)
+    p.add_argument("--on-device-error", dest="on_device_error",
+                   choices=["fail", "retry", "fallback"], default="retry")
+    p.add_argument("--fault-inject", dest="fault_inject", default="")
+    p.add_argument("--log-level", dest="log_level", default=None,
+                   choices=["debug", "info", "warning", "error"])
+    p.add_argument("--metrics-out", dest="metrics_out", default=None,
+                   help="per-job metrics JSONL base path: job k writes "
+                        "<base>.job<k>.jsonl (+ its .manifest.json)")
+    p.add_argument("--trace-out", dest="trace_out", default=None,
+                   help="per-job trace base path: job k writes "
+                        "<base>.job<k>.json")
+    p.add_argument("--prewarm", choices=["auto", "off"], default="auto",
+                   help="compile the layout's canonical slab shapes "
+                        "behind the first job's decode (auto; engages "
+                        "for explicitly device-pinned pileups — "
+                        "--pileup scatter/pallas/mxu — since --pileup "
+                        "auto may route host-side where there is "
+                        "nothing to warm)")
+    p.add_argument("--no-decode-ahead", dest="decode_ahead",
+                   action="store_false",
+                   help="disable cross-job pipelining (job N+1's host "
+                        "decode normally overlaps job N's device work)")
+    # shared-flag defaults config_from_args expects but serve never
+    # exposes (one-shot-only features)
+    p.set_defaults(backend="jax", prefix="", profile_dir=None,
+                   json_metrics=None, checkpoint_dir=None,
+                   checkpoint_every=2_000_000, paranoid=False,
+                   incremental=False, filename="")
+    return p
+
+
+def serve_main(argv: List[str]) -> int:
+    """``s2c serve -i a.sam -i b.sam [...]``: run every input through
+    one warm server; exit 0 iff every job succeeded."""
+    import copy
+
+    args = build_serve_parser().parse_args(argv)
+    echo = (lambda *a, **k: None) if args.quiet else print
+
+    from . import observability
+    from .serve import JobSpec, ServeRunner
+    from .utils.platform import pin_platform_from_env
+
+    observability.configure_logging(args.log_level)
+    pin_platform_from_env()
+    # same non-composable combos the one-shot main rejects up front —
+    # a deep per-job failure would be a worse error surface
+    if args.pileup == "host" and args.shards > 1:
+        raise SystemExit("--pileup host accumulates on the single host; "
+                         "it does not compose with --shards")
+    if args.fault_inject:
+        from .resilience.faultinject import parse_spec
+
+        try:
+            parse_spec(args.fault_inject)
+        except ValueError as exc:
+            raise SystemExit(f"error: {exc}") from None
+
+    specs = []
+    for k, path in enumerate(args.inputs):
+        job_args = copy.copy(args)
+        job_args.filename = path
+        job_args.prefix = ""            # per-job default: input basename
+        if args.metrics_out:
+            job_args.metrics_out = f"{args.metrics_out}.job{k}.jsonl"
+        if args.trace_out:
+            job_args.trace_out = f"{args.trace_out}.job{k}.json"
+        cfg = config_from_args(job_args)
+        specs.append(JobSpec(filename=path, config=cfg,
+                             job_id=f"job{k}:{os.path.basename(path)}"))
+
+    runner = ServeRunner(prewarm=args.prewarm,
+                         decode_ahead=args.decode_ahead, echo=echo)
+    echo(f"\nServing {len(specs)} job(s) on one warm backend"
+         + (f" (jit cache: {runner.cache_dir})" if runner.cache_dir
+            else "") + "\n")
+    results = runner.submit_jobs(specs)
+    failed = 0
+    for spec, res in zip(specs, results):
+        if not res.ok:
+            failed += 1
+            print(f"job {res.job_id} FAILED: {res.error}",
+                  file=sys.stderr)
+            continue
+        write_outputs(res.fastas, spec.config.outfolder,
+                      spec.config.prefix, spec.config.nchar,
+                      spec.config.thresholds, echo=echo)
+        if spec.config.metrics_out:
+            from .observability.manifest import manifest_path_for
+
+            echo("Run manifest written to "
+                 + manifest_path_for(spec.config.metrics_out) + "\n")
+    ov = runner.registry.value("serve/overlap_sec")
+    echo(f"Done: {len(results) - failed}/{len(results)} job(s) ok, "
+         f"cross-job overlap {ov:.3f}s.\n")
+    return 1 if failed else 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "serve":
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     echo = (lambda *a, **k: None) if args.quiet else print
@@ -325,6 +470,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     stream = ReadStream(handle, first, on_lines=on_lines)
     backend = get_backend(cfg.backend)
+    if cfg.backend == "jax":
+        # persistent compilation cache: a COLD process start skips XLA
+        # re-compiles of programs any earlier run (one-shot or serve)
+        # already built; S2C_JIT_CACHE overrides the default dir, empty
+        # disables (observability/jitcache.py; consults are counted
+        # compile/persist_{hit,miss})
+        from .observability.jitcache import setup_persistent_cache
+
+        setup_persistent_cache()
     if cfg.profile_dir:
         import jax
 

@@ -48,7 +48,8 @@ from .trace import Tracer
 __all__ = [
     "Tracer", "MetricsRegistry", "RunObservability", "PHASES",
     "DecisionLedger", "DecisionRecord",
-    "start_run", "finish_run", "tracer", "metrics", "ledger",
+    "start_run", "finish_run", "prepare_run", "bind_run_to_thread",
+    "tracer", "metrics", "ledger",
     "record_decision", "finalize_decisions", "last_manifest",
     "publish_stats_extra", "configure_logging",
     "write_chrome_trace", "write_metrics_jsonl", "read_metrics_jsonl",
@@ -65,11 +66,15 @@ PHASES = ("decode", "stage", "pileup_dispatch", "accumulate",
 _disabled_tracer = Tracer(enabled=False)
 _tracer_stack: List[Tracer] = [_disabled_tracer]
 _stack_lock = threading.Lock()
+_tracer_tls = threading.local()
 
 
 def tracer() -> Tracer:
-    """The current run's tracer (a disabled one between runs)."""
-    return _tracer_stack[-1]
+    """The current run's tracer (a disabled one between runs).  A
+    thread-bound tracer (:func:`bind_run_to_thread`) wins over the
+    process-current stack."""
+    t = getattr(_tracer_tls, "tracer", None)
+    return t if t is not None else _tracer_stack[-1]
 
 
 def metrics() -> MetricsRegistry:
@@ -121,10 +126,40 @@ class RunObservability:
     config: Optional[dict] = None
 
 
+def prepare_run(trace_out: Optional[str] = None,
+                metrics_out: Optional[str] = None,
+                enabled: Optional[bool] = None,
+                config=None) -> RunObservability:
+    """Build a run's instruments WITHOUT installing them as current.
+
+    Serve mode (sam2consensus_tpu/serve) creates job N+1's instruments
+    while job N is still process-current: the decode-ahead thread binds
+    them thread-locally (:func:`bind_run_to_thread`) so its phase
+    seconds land in the right job, and the backend later installs the
+    same handle via ``start_run(prepared=...)`` — nothing recorded
+    ahead of the run is lost.
+    """
+    trace_out = trace_out or os.environ.get("S2C_TRACE_OUT") or None
+    metrics_out = metrics_out or os.environ.get("S2C_METRICS_OUT") or None
+    if enabled is None:
+        enabled = trace_out is not None
+    if config is not None and not isinstance(config, dict):
+        import dataclasses
+
+        config = dataclasses.asdict(config) \
+            if dataclasses.is_dataclass(config) else None
+    return RunObservability(tracer=Tracer(enabled=bool(enabled)),
+                            registry=MetricsRegistry(),
+                            trace_out=trace_out, metrics_out=metrics_out,
+                            ledger=DecisionLedger(), config=config)
+
+
 def start_run(trace_out: Optional[str] = None,
               metrics_out: Optional[str] = None,
               enabled: Optional[bool] = None,
-              config=None) -> RunObservability:
+              config=None,
+              prepared: Optional[RunObservability] = None
+              ) -> RunObservability:
     """Install a fresh tracer + registry + decision ledger as the
     process-current set.
 
@@ -134,24 +169,40 @@ def start_run(trace_out: Optional[str] = None,
     and the compat ``stats.extra`` view needs it on every run.
     ``config`` (a RunConfig or dict) is snapshotted into the run's
     manifest so every artifact records the flags that produced it.
+    ``prepared`` installs an existing :func:`prepare_run` handle
+    instead (serve mode: the handle already holds the job's
+    decode-ahead phase seconds).
     """
-    trace_out = trace_out or os.environ.get("S2C_TRACE_OUT") or None
-    metrics_out = metrics_out or os.environ.get("S2C_METRICS_OUT") or None
-    if enabled is None:
-        enabled = trace_out is not None
-    t = Tracer(enabled=bool(enabled))
-    reg = _metrics.push_run()
-    led = _ledger.push_run()
-    if config is not None and not isinstance(config, dict):
-        import dataclasses
-
-        config = dataclasses.asdict(config) \
-            if dataclasses.is_dataclass(config) else None
+    robs = prepared if prepared is not None else prepare_run(
+        trace_out=trace_out, metrics_out=metrics_out, enabled=enabled,
+        config=config)
+    _metrics.push_run(robs.registry)
+    _ledger.push_run(robs.ledger)
     with _stack_lock:
-        _tracer_stack.append(t)
-    return RunObservability(tracer=t, registry=reg, trace_out=trace_out,
-                            metrics_out=metrics_out, ledger=led,
-                            config=config)
+        _tracer_stack.append(robs.tracer)
+    return robs
+
+
+class bind_run_to_thread:
+    """Context manager routing THIS thread's ``tracer()`` /
+    ``metrics()`` / ``ledger()`` to one run's instruments, regardless
+    of what is process-current.  Serve mode's decode-ahead thread binds
+    job N+1's prepared handle while job N runs in the main thread."""
+
+    def __init__(self, robs: RunObservability):
+        self._robs = robs
+
+    def __enter__(self):
+        _metrics.bind_thread(self._robs.registry)
+        _ledger.bind_thread(self._robs.ledger)
+        _tracer_tls.tracer = self._robs.tracer
+        return self._robs
+
+    def __exit__(self, *exc):
+        _metrics.bind_thread(None)
+        _ledger.bind_thread(None)
+        _tracer_tls.tracer = None
+        return False
 
 
 def finish_run(obs: RunObservability, meta: Optional[dict] = None) -> None:
@@ -210,7 +261,12 @@ def publish_stats_extra(extra: dict) -> None:
         # pipeline/overlap_sec is the R6 acceptance metric); drift
         # events (ledger residual outside band) ride along so a run
         # whose model mis-priced is visible from any artifact
-        elif name.startswith(("wire/", "pipeline/", "drift/")):
+        # serve/* (cross-job overlap, decode-ahead seconds) and
+        # compile/* (jit cache hits/misses, persistent-cache hits) ride
+        # the same view: serve-mode amortization claims are checkable
+        # from any per-job artifact
+        elif name.startswith(("wire/", "pipeline/", "drift/", "serve/",
+                              "compile/")):
             extra[name] = int(value) if float(value).is_integer() \
                 else round(value, 4)
     for gauge_name, extra_key in (("dispatch/tail", "tail_dispatch"),

@@ -1,0 +1,144 @@
+"""Compile-cache instrumentation: prove amortization instead of assuming it.
+
+Serve mode's (sam2consensus_tpu/serve) whole premise is that keeping
+one process alive across jobs makes jit compilation a one-time cost.
+This module makes that claim measurable at two layers:
+
+* **in-process jit cache** — :func:`note_trace` is called INSIDE the
+  hot-path jitted function bodies (ops/pileup scatter, the fused tail),
+  so it executes exactly once per trace/compile, on whichever thread
+  traced, into whichever registry is current — per-job in serve mode.
+  :func:`counted_call` wraps a jitted dispatch and classifies it as
+  ``compile/jit_cache_hit`` (no trace happened during the call) or
+  ``compile/jit_cache_miss`` (the call compiled).  A warm serve job
+  therefore shows ``hit > 0, miss == 0`` in ITS OWN registry — the
+  acceptance number, not an inference from wall clock;
+* **persistent (cross-process) cache** — :func:`setup_persistent_cache`
+  wires JAX's compilation cache to disk (default under the native
+  build-cache dir, ``S2C_JIT_CACHE`` overrides, empty disables) so even
+  cold process starts skip re-compiles, and registers a
+  ``jax.monitoring`` listener translating the runtime's cache events
+  into ``compile/persist_hit`` / ``compile/persist_miss`` counters —
+  surfaced in the run manifest like every other compile/* counter.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable, Optional
+
+# NOTE: ``from . import metrics`` would resolve to the package's
+# ``metrics()`` FUNCTION once __init__ has run (attribute shadowing);
+# import the submodule's accessor directly
+from .metrics import current as _current_registry
+
+logger = logging.getLogger("sam2consensus_tpu.observability.jitcache")
+
+#: default on-disk cache location: next to the native decoder's build
+#: cache (the .so compiled-artifact convention this repo already uses);
+#: gitignored, wiped safely at any time
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "_jit_cache")
+
+_listener_lock = threading.Lock()
+_listener_registered = False
+_cache_dir: Optional[str] = None
+
+#: jax monitoring event names -> our counter names (jax emits one event
+#: per compilation that consulted the persistent cache)
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "compile/persist_hit",
+    "/jax/compilation_cache/cache_misses": "compile/persist_miss",
+}
+
+
+def note_trace(label: str, rows: Optional[int] = None,
+               width: Optional[int] = None) -> None:
+    """Record one jit trace/compile of the function ``label``.
+
+    Call this FROM INSIDE a jitted function body: tracing executes the
+    Python body once per new cache entry, so the counter bumps exactly
+    when a compile happens and never on a cache hit.  ``rows``/``width``
+    (concrete at trace time — shapes are static under jit) additionally
+    label a per-shape counter, which is what lets a test pin "the
+    prewarmed shape was never re-traced"."""
+    reg = _current_registry()
+    reg.add("compile/jit_traces", 1)
+    reg.add(f"compile/trace/{label}", 1)
+    if rows is not None and width is not None:
+        reg.add(f"compile/trace/{label}/{int(rows)}x{int(width)}", 1)
+
+
+def counted_call(fn: Callable, *args, **kwargs):
+    """Dispatch a jitted ``fn`` and classify the call as a jit-cache
+    hit or miss by whether :func:`note_trace` fired during it (the
+    trace callback runs synchronously inside a compiling call).  The
+    counters are per-run — a serve job's registry carries its own
+    hit/miss story."""
+    reg = _current_registry()
+    before = reg.value("compile/jit_traces")
+    out = fn(*args, **kwargs)
+    if reg.value("compile/jit_traces") > before:
+        reg.add("compile/jit_cache_miss", 1)
+    else:
+        reg.add("compile/jit_cache_hit", 1)
+    return out
+
+
+def _on_monitoring_event(name: str, **kwargs) -> None:
+    counter = _EVENT_COUNTERS.get(name)
+    if counter is not None:
+        _current_registry().add(counter, 1)
+
+
+def cache_dir() -> Optional[str]:
+    """The persistent cache directory in effect (None = disabled)."""
+    env = os.environ.get("S2C_JIT_CACHE")
+    if env is not None:
+        return env or None           # "" explicitly disables
+    return DEFAULT_CACHE_DIR
+
+
+def setup_persistent_cache() -> Optional[str]:
+    """Wire JAX's persistent compilation cache to disk; returns the
+    directory in effect or None when disabled/unsupported.
+
+    Idempotent: the monitoring listener registers once per process and
+    re-calls just return the configured directory.  Every failure mode
+    (old jax without the config, read-only filesystem) degrades to
+    "no persistent cache" with a log line, never an error — the cache
+    is an amortization, not a correctness dependency."""
+    global _listener_registered, _cache_dir
+    path = cache_dir()
+    if path is None:
+        return None
+    if _cache_dir is not None:
+        return _cache_dir
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # compile-time floor 0: serve-scale wins come from many small
+        # scatter/tail programs a default 1 s floor would never cache
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except Exception:
+            pass                      # older jax: size floor not tunable
+        with _listener_lock:
+            if not _listener_registered:
+                jax.monitoring.register_event_listener(
+                    _on_monitoring_event)
+                _listener_registered = True
+    except Exception as exc:
+        logger.info("persistent compilation cache unavailable: %s: %s",
+                    type(exc).__name__, exc)
+        return None
+    _cache_dir = path
+    return path

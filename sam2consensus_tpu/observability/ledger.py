@@ -154,10 +154,18 @@ class DecisionLedger:
 _process_ledger = DecisionLedger()
 _current: List[DecisionLedger] = [_process_ledger]
 _current_lock = threading.Lock()
+_tls = threading.local()
 
 
 def current() -> DecisionLedger:
-    return _current[-1]
+    led = getattr(_tls, "ledger", None)
+    return led if led is not None else _current[-1]
+
+
+def bind_thread(ledger: Optional[DecisionLedger]) -> None:
+    """Thread-local override of :func:`current` (mirrors
+    ``metrics.bind_thread``; serve-mode decode-ahead threads)."""
+    _tls.ledger = ledger
 
 
 def push_run(ledger: Optional[DecisionLedger] = None) -> DecisionLedger:

@@ -100,6 +100,11 @@ def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
               if k.startswith("phase/")}
     wire = {k: v for k, v in counters.items()
             if k.startswith(("wire/", "pipeline/"))}
+    # serve-mode amortization story: cross-job overlap seconds plus the
+    # jit/persistent compile-cache hit counters that prove the warm
+    # path actually skipped work (empty dict for cold one-shot runs)
+    serve = {k: v for k, v in counters.items()
+             if k.startswith(("serve/", "compile/"))}
     decisions = []
     for rec in ledger_records:
         d = rec.to_dict() if hasattr(rec, "to_dict") else dict(rec)
@@ -115,6 +120,7 @@ def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
         "decisions": decisions,
         "phases": phases,
         "wire": wire,
+        "serve": serve,
         "drift_events": int(counters.get("drift/events", 0)),
         "artifacts": dict(artifacts or {}),
     }

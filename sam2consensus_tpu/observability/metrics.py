@@ -182,11 +182,27 @@ class MetricsRegistry:
 _process_registry = MetricsRegistry()
 _current: List[MetricsRegistry] = [_process_registry]
 _current_lock = threading.Lock()
+#: thread-local OVERRIDE of the process-current registry: serve mode
+#: (sam2consensus_tpu/serve) decodes job N+1 on a side thread while job
+#: N's registry is process-current, and that thread's phase seconds
+#: must land in job N+1's registry, not bleed into job N's
+_tls = threading.local()
 
 
 def current() -> MetricsRegistry:
-    """The registry deep call sites record into (never None)."""
-    return _current[-1]
+    """The registry deep call sites record into (never None).  A
+    thread-bound registry (:func:`bind_thread`) wins over the
+    process-current stack."""
+    reg = getattr(_tls, "registry", None)
+    return reg if reg is not None else _current[-1]
+
+
+def bind_thread(registry: Optional[MetricsRegistry]) -> None:
+    """Route THIS thread's :func:`current` to ``registry`` (None
+    unbinds).  Per-thread, so a serve decode-ahead thread records into
+    its own job's registry while the main thread keeps the
+    process-current one."""
+    _tls.registry = registry
 
 
 def push_run(registry: Optional[MetricsRegistry] = None) -> MetricsRegistry:

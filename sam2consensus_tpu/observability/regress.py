@@ -26,10 +26,14 @@ wolf every round; no gate at all is how a 40 MB/s constant survived a
 Artifact tolerance: the committed BENCH files are driver wrappers whose
 ``tail`` capture is HEAD-TRUNCATED (last N bytes of stdout), so the
 top-level JSON line is often unrecoverable while every per-config row
-object inside it is intact.  :func:`extract_bench_rows` scans for
-balanced ``{"config": ...}`` objects with ``raw_decode`` instead of
-trusting the line structure; a round with no recoverable rows (r01's
-rc=1 crash) simply contributes no history.
+object inside it is intact.  Since round 6, ``bench.py`` writes the
+COMPLETE result object to a sibling ``BENCH_<tag>.full.json``
+(``BENCH_FULL_OUT``/``BENCH_TAG``) and :func:`load_bench_artifact`
+prefers that sibling — no recovery needed.  For the pre-r06 files the
+old path remains: :func:`extract_bench_rows` scans for balanced
+``{"config": ...}`` objects with ``raw_decode`` instead of trusting
+the line structure; a round with no recoverable rows (r01's rc=1
+crash) simply contributes no history.
 
 Consumers: ``tools/regress_check.py`` (the CI gate,
 tests/test_regression_gate.py) and ``tools/bench_report.py --diff``
@@ -131,10 +135,37 @@ def extract_bench_rows(text: str) -> List[dict]:
     return rows
 
 
+def full_sibling_path(path: str) -> str:
+    """``BENCH_r06.json`` -> ``BENCH_r06.full.json`` (the complete
+    result object bench.py writes since round 6); already-full paths
+    map to themselves."""
+    if path.endswith(".full.json"):
+        return path
+    if path.endswith(".json"):
+        return path[:-len(".json")] + ".full.json"
+    return path + ".full.json"
+
+
 def load_bench_artifact(path: str) -> List[dict]:
-    """Per-config rows from one bench artifact: a driver wrapper
-    (``{"rc", "tail", "parsed"}``), a bare bench JSON line, or any text
-    containing config rows.  A crashed/empty round returns []."""
+    """Per-config rows from one bench artifact.  A sibling
+    ``<name>.full.json`` (complete, untruncated) is authoritative when
+    present; otherwise the artifact itself is read as a driver wrapper
+    (``{"rc", "tail", "parsed"}``), a bare bench JSON line, or — for
+    the pre-r06 truncated captures — any text containing config rows.
+    A crashed/empty round returns []."""
+    import os
+
+    sibling = full_sibling_path(path)
+    if sibling != path and os.path.exists(sibling):
+        try:
+            with open(sibling) as fh:
+                obj = json.load(fh)
+            if isinstance(obj, dict) and isinstance(
+                    obj.get("configs"), list):
+                return [r for r in obj["configs"]
+                        if isinstance(r, dict)]
+        except (OSError, json.JSONDecodeError):
+            pass                      # fall back to the capture itself
     with open(path) as fh:
         text = fh.read()
     try:

@@ -36,6 +36,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from ..observability import jitcache
 from .insertions import build_insertion_table, vote_insertions
 from .vote import emit_gate, vote_block
 
@@ -187,6 +188,7 @@ def vote_packed_simple(counts: jax.Array, thr_enc: jax.Array,
                        out_enc=None) -> jax.Array:
     """No-insertion tail: position vote + contig sums, one packed buffer.
     ``out_enc`` as in :func:`_syms_head`."""
+    jitcache.note_trace("vote_packed_simple")
     syms, cov = vote_block(counts, thr_enc, min_depth,
                            _sym_space(out_enc))             # [T, L]
     contig_sums, _ = _tail_stats(cov, offsets,
@@ -208,6 +210,7 @@ def vote_packed(counts: jax.Array, thr_enc: jax.Array, offsets: jax.Array,
     into the sacrificial row Kp-1.  ``out_enc`` selects the
     position-symbol wire encoding (:func:`_syms_head`).
     """
+    jitcache.note_trace("vote_packed")
     syms, cov = vote_block(counts, thr_enc, min_depth,
                            _sym_space(out_enc))             # [T, L]
     contig_sums, site_cov = _tail_stats(cov, offsets, site_keys)

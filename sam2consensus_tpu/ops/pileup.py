@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +32,8 @@ import numpy as np
 
 from .. import observability as obs
 from ..constants import NUM_SYMBOLS, PAD_CODE
-from ..encoder.events import SegmentBatch, StagedSlab
+from ..encoder.events import MIN_BUCKET_W, SegmentBatch, StagedSlab
+from ..observability import jitcache
 from ..resilience.faultinject import fault_check
 from ..wire import codec as wire_codec
 
@@ -145,6 +147,11 @@ def expand_segment_positions(starts: jax.Array, codes: jax.Array,
 @partial(jax.jit, donate_argnums=0, static_argnums=3)
 def _scatter_segments(counts: jax.Array, starts: jax.Array,
                       codes: jax.Array, sacrificial: int) -> jax.Array:
+    # trace-time side effect: bumps compile/* in the CURRENT registry
+    # exactly once per compiled shape (observability/jitcache.py) —
+    # the serve-mode warm-path evidence
+    jitcache.note_trace("scatter", rows=starts.shape[0],
+                        width=codes.shape[1])
     pos, code = expand_segment_positions(starts, codes, sacrificial)
     return counts.at[pos, code].add(1)
 
@@ -154,6 +161,8 @@ def _scatter_segments_packed(counts: jax.Array, starts: jax.Array,
                              packed: jax.Array, sacrificial: int
                              ) -> jax.Array:
     """Scatter path fed by the 4-bit wire format (pack_nibbles)."""
+    jitcache.note_trace("scatter_packed", rows=starts.shape[0],
+                        width=packed.shape[1] * 2)
     pos, code = expand_segment_positions(starts, unpack_nibbles(packed),
                                          sacrificial)
     return counts.at[pos, code].add(1)
@@ -171,6 +180,90 @@ def iter_row_slices(n_rows: int, width: int, multiple_of: int = 1):
                // multiple_of * multiple_of)
     for lo in range(0, n_rows, step):
         yield lo, min(n_rows, lo + step)
+
+
+def padded_total_len(total_len: int) -> int:
+    """Position-axis padding shared by :class:`PileupAccumulator` and
+    the serve-mode prewarm (the scatter's counts operand is
+    ``[padded, 6]``, so a prewarm against a different padding would
+    compile a shape no job ever dispatches)."""
+    from . import mxu_pileup
+
+    tile = mxu_pileup.TILE_POSITIONS
+    return -(-(total_len + 1) // tile) * tile
+
+
+def canonical_slab_shapes(total_len: int, read_len: int = 150,
+                          chunk_reads: int = 262144,
+                          n_reads: Optional[int] = None) -> list:
+    """The (rows, width) scatter shapes a job over this genome layout is
+    expected to dispatch — the serve-mode prewarm enumeration.
+
+    Widths: the power-of-two bucket of ``read_len`` plus its double
+    (deletion runs widen a read's reference span past its length;
+    encoder/events._bucket_width).  Rows: the power-of-two row paddings
+    a chunk of ``min(n_reads, chunk_reads)`` reads produces (the
+    accumulator rounds the real row count to a power of two and
+    ``iter_row_slices`` caps a slice at SCATTER_CELL_BUDGET cells), plus
+    one level down for partially-filled tail chunks.  Deliberately a
+    SMALL set — a handful of compiles hidden behind the first job's
+    decode — not an exhaustive sweep; shapes outside it simply compile
+    on first dispatch like today.
+    """
+    w0 = max(MIN_BUCKET_W, 1 << max(0, (max(1, read_len) - 1).bit_length()))
+    widths = [w0, w0 * 2]
+    shapes = []
+    for w in widths:
+        step = max(1, SCATTER_CELL_BUDGET // w)
+        if n_reads is not None:
+            # per-job hint: the row paddings this job's chunks produce,
+            # plus one level down for skipped-read shrink / tail chunks
+            r_top = min(1 << max(3, (min(n_reads, chunk_reads) - 1)
+                                 .bit_length()), step)
+            levels = {r_top, max(8, r_top // 2)}
+        else:
+            # server startup: every power-of-two level a >=~1k-read job
+            # can dispatch (the encoder's row floor is 1024; buckets
+            # with fewer real rows compile cheaply on first touch)
+            r_top = min(1 << max(3, (min(chunk_reads, 1 << 62) - 1)
+                                 .bit_length()), step)
+            levels = {1 << b for b in range(10, r_top.bit_length())}
+            levels.add(r_top)
+        for r in sorted(levels):
+            shapes.append((int(r), int(w)))
+    return sorted(set(shapes))
+
+
+def prewarm_scatter(total_len: int, shapes, device=None) -> int:
+    """Compile the packed segment scatter for each ``(rows, width)`` in
+    ``shapes`` without accumulating anything: all-PAD operands redirect
+    every cell to the sacrificial row, so the count tensor the jobs
+    later allocate is untouched and the jit cache entries are REAL (the
+    same counts/starts/packed shapes and the same static sacrificial a
+    job over this layout dispatches).  Returns the number of shapes
+    compiled; trace-time counters land in the CURRENT registry (the
+    serve runner binds its server registry, so per-job registries show
+    the prewarmed shapes as pure cache hits)."""
+    padded = padded_total_len(total_len)
+    counts = jnp.zeros((padded, NUM_SYMBOLS), dtype=jnp.int32)
+    if device is not None:
+        counts = jax.device_put(counts, device)
+    n = 0
+    for rows, width in sorted(set((int(r), int(w)) for r, w in shapes)):
+        if width % 2 or rows <= 0:
+            continue
+        starts = jnp.zeros(rows, dtype=jnp.int32)
+        packed = jnp.full((rows, width // 2), 255, dtype=jnp.uint8)
+        if device is not None:
+            starts = jax.device_put(starts, device)
+            packed = jax.device_put(packed, device)
+        # donated counts chain through every shape (same array shape)
+        counts = _scatter_segments_packed(counts, starts, packed,
+                                          total_len)
+        n += 1
+    if n:
+        np.asarray(counts[0, 0])       # force compile + run completion
+    return n
 
 
 class PileupAutoTuner:
@@ -568,8 +661,11 @@ class PileupAccumulator:
         self.wire = wire
         self._tile = mxu_pileup.TILE_POSITIONS
         # position axis padded to whole tiles; the scatter path's
-        # sacrificial row (index total_len) lives inside the pad
-        self.padded_len = -(-(total_len + 1) // self._tile) * self._tile
+        # sacrificial row (index total_len) lives inside the pad.  THE
+        # shared definition (padded_total_len) — the serve-mode prewarm
+        # compiles against the same counts shape, so a drift here would
+        # silently turn prewarm into dead weight
+        self.padded_len = padded_total_len(total_len)
         counts = jnp.zeros((self.padded_len, NUM_SYMBOLS), dtype=jnp.int32)
         if device is not None:
             counts = jax.device_put(counts, device)
@@ -784,9 +880,12 @@ class PileupAccumulator:
             def exec_scatter():
                 st, pk = put_operands()
                 for lo, hi in iter_row_slices(n_rows, w):
-                    self._counts = _scatter_segments_packed(
-                        self._counts, st[lo:hi],
-                        pk[lo:hi], self.total_len)
+                    # counted dispatch: classifies each scatter call as
+                    # a jit-cache hit or miss in the run's registry —
+                    # the serve-mode amortization proof rides on it
+                    self._counts = jitcache.counted_call(
+                        _scatter_segments_packed, self._counts,
+                        st[lo:hi], pk[lo:hi], self.total_len)
 
             if n_rows == 0:
                 continue               # all-pad bucket: counts nothing

@@ -76,6 +76,22 @@ def _record_demotion(stage: str, frm: str, to: str, reason: str,
                        emergency_checkpoint=bool(checkpointed))
 
 
+def job_rungs(snapshot: dict) -> dict:
+    """The degradation rungs a finished run ENDED on, read back from its
+    registry snapshot's ``resilience/ladder/<stage>`` gauges — the
+    serve-mode per-job isolation surface (sam2consensus_tpu/serve): a
+    warm server asserts the job AFTER a faulting one returns ``{}``
+    here, i.e. the previous job's demotions never leaked.  Keys are the
+    stages that demoted (``pileup``, ``tail``), values the rung landed
+    on; an empty dict means the run never left the fast path."""
+    rungs = {}
+    for stage in ("pileup", "tail"):
+        g = snapshot.get("gauges", {}).get(f"resilience/ladder/{stage}")
+        if g is not None and g.get("info"):
+            rungs[stage] = g["info"].get("to", "")
+    return rungs
+
+
 def pileup_level(acc) -> str:
     """Name the accumulation rung ``acc`` currently sits on."""
     from ..ops.pileup import HostPileupAccumulator, PileupAccumulator

@@ -1,0 +1,39 @@
+"""Warm-path serving: one persistent backend across many consensus jobs.
+
+The reference is a one-shot CLI — one process, one SAM file — and every
+prior round inherited that shape, so each job re-paid the whole warmup
+bill: jit trace/compile per slab shape, the link probe, the native
+extension's staleness check, interpreter + jax import.  On the small
+BENCH configs that fixed cost exceeds the actual work (the rows are
+"oracle-noise-bound"); at the ROADMAP's serving scale it is pure waste
+multiplied by every request.  This package makes the WARM path the
+common path:
+
+* :class:`.runner.ServeRunner` / :func:`submit_jobs` — a persistent
+  multi-job runner (``s2c serve`` CLI entry, ``sam2consensus_tpu.cli``)
+  that keeps one :class:`~..backends.jax_backend.JaxBackend` alive
+  across jobs.  Job N+1's host decode/encode runs on a decode-ahead
+  thread while job N's device work is in flight, with the measured
+  intersection published as ``serve/overlap_sec`` — cross-job overlap
+  is a number in each job's registry/manifest, not an assumption;
+* shape-bucket-aware jit reuse — the canonical slab shapes
+  (``ops.pileup.canonical_slab_shapes``) are prewarmed once per server
+  lifetime (optionally behind the first job's decode), and every pileup
+  dispatch is classified ``compile/jit_cache_{hit,miss}``
+  (``observability/jitcache.py``), so amortization is proven per job;
+* per-job scoping — each job gets its OWN metrics registry, tracer,
+  decision ledger and manifest (``observability.prepare_run`` +
+  thread-local binding for the decode-ahead thread), and its own
+  resilience ladder/fault-injection scope: a fault in one job demotes
+  only that job's rungs and the next job starts back on the fast path,
+  warm.
+
+Failure semantics: a job that raises is returned as a failed
+:class:`JobResult` (``error`` set, ``fastas`` None) and the server
+stays warm for the remaining queue; nothing a failing job demoted or
+configured (ladder rung, fault spec, registry) outlives its run.
+"""
+
+from .runner import JobResult, JobSpec, ServeRunner, submit_jobs
+
+__all__ = ["JobSpec", "JobResult", "ServeRunner", "submit_jobs"]

@@ -1,0 +1,188 @@
+"""Cold-vs-warm serving benchmark: the serve tentpole's measured claim.
+
+COLD is the shape every round before this one shipped: one process per
+SAM file (the one-shot CLI in a subprocess — interpreter + jax import +
+jit compile + link probe per job).  WARM is the same jobs through one
+:class:`~.runner.ServeRunner`.  Both sides produce FASTA bytes that are
+compared against each other per job — a serving speedup that changed
+the output would be meaningless — and the summary carries the warm
+side's ``compile/jit_cache_{hit,miss}`` and ``serve/overlap_sec``
+counters so the "why" of the speedup is in the artifact, not asserted.
+
+Consumed by ``tools/serve_bench.py`` (standalone, JSONL artifact for
+the campaign) and ``bench.py`` (the ``serve_warm`` row riding the
+regression gate).
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _simulate_jobs(tmp: str, n_jobs: int, n_reads: int, contig_len: int,
+                   read_len: int, gzip_last: bool) -> list:
+    """N single-contig inputs over the SAME reference layout (the
+    serving scenario: one reference, many samples — and the layout
+    match is what makes jit shapes reusable across jobs)."""
+    from ..utils.simulate import SimSpec, simulate
+
+    paths = []
+    for k in range(n_jobs):
+        spec = SimSpec(n_contigs=1, contig_len=contig_len,
+                       n_reads=n_reads, read_len=read_len,
+                       contig_len_jitter=0.0, seed=1000 + k,
+                       contig_prefix="serveref")
+        name = f"serve_job{k}.sam"
+        if gzip_last and k == n_jobs - 1:
+            name += ".gz"
+        path = os.path.join(tmp, name)
+        text = simulate(spec)
+        if name.endswith(".gz"):
+            import gzip as _gzip
+
+            with _gzip.open(path, "wb") as fh:
+                fh.write(text.encode("ascii"))
+        else:
+            with open(path, "w") as fh:
+                fh.write(text)
+        paths.append(path)
+    return paths
+
+
+def _cold_cmd(path: str, outdir: str, pileup: str) -> list:
+    return [sys.executable, "-m", "sam2consensus_tpu.cli",
+            "-i", path, "-o", outdir, "--backend", "jax",
+            "--pileup", pileup, "--quiet"]
+
+
+def run_serve_bench(n_jobs: int = 8, n_reads: int = 5000,
+                    contig_len: int = 5386, read_len: int = 100,
+                    pileup: str = "scatter", gzip_last: bool = True,
+                    cold_timeout: int = 600,
+                    log: Optional[Callable] = None) -> dict:
+    """Run the cold-process baseline then the warm server over the same
+    ``n_jobs`` inputs; returns ``{"rows": [...], "summary": {...}}``.
+
+    ``pileup`` defaults to the explicit device scatter so the jit-reuse
+    story is exercised even where auto would route host-side (the warm
+    path must win on the DEVICE path to matter at serving scale).
+    """
+    from ..config import RunConfig, default_prefix
+    from ..io.fasta import render_file
+    from .runner import JobSpec, ServeRunner
+
+    log = log or (lambda *a: None)
+    rows = []
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = _simulate_jobs(tmp, n_jobs, n_reads, contig_len,
+                               read_len, gzip_last)
+        # -- cold: one process per job (the pre-serve reality) --------
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        # BOTH sides run with the persistent on-disk compile cache
+        # disabled (cold via env below, warm via persistent_cache=
+        # False): the cold baseline must model the pre-serve reality —
+        # the one-shot CLI now wires the cache too — and the warm
+        # numbers must not depend on what an earlier round left on
+        # disk, or the gated serve series compares non-equivalent
+        # conditions round to round.  The persistent cache's own win
+        # is pinned separately (tests/test_serve.py cross-process).
+        env["S2C_JIT_CACHE"] = ""
+        cold_out = {}
+        cold_secs = []
+        for k, path in enumerate(paths):
+            outdir = os.path.join(tmp, f"cold{k}")
+            os.makedirs(outdir)
+            t0 = time.perf_counter()
+            r = subprocess.run(_cold_cmd(path, outdir, pileup),
+                               capture_output=True, text=True,
+                               timeout=cold_timeout, env=env, cwd=REPO)
+            dt = time.perf_counter() - t0
+            ok = r.returncode == 0
+            rows.append({"mode": "cold", "job": k, "sec": round(dt, 3),
+                         "rc": r.returncode})
+            if ok:
+                cold_secs.append(dt)
+                outs = {}
+                for f in sorted(os.listdir(outdir)):
+                    with open(os.path.join(outdir, f)) as fh:
+                        outs[f] = fh.read()
+                cold_out[k] = outs
+            else:
+                rows[-1]["stderr_tail"] = \
+                    (r.stderr.strip().splitlines() or [""])[-1]
+            log(f"[serve_bench] cold job{k}: {dt:.2f}s rc={r.returncode}")
+        # -- warm: one server, same jobs ------------------------------
+        specs = [JobSpec(filename=p,
+                         config=RunConfig(backend="jax", pileup=pileup,
+                                          prefix=default_prefix(p)),
+                         job_id=f"warm{k}")
+                 for k, p in enumerate(paths)]
+        runner = ServeRunner(persistent_cache=False,
+                             echo=lambda m: log(f"[serve_bench] {m}"))
+        try:
+            t0 = time.perf_counter()
+            results = runner.submit_jobs(specs)
+            warm_total = time.perf_counter() - t0
+        finally:
+            runner.close()              # join prewarm, drop atexit ref
+        warm_secs = []
+        identical = []
+        for k, res in enumerate(results):
+            row = {"mode": "warm", "job": k,
+                   "sec": round(res.elapsed_sec, 3),
+                   "ok": res.ok,
+                   "jit_hit": int(res.metrics.get(
+                       "compile/jit_cache_hit", 0)),
+                   "jit_miss": int(res.metrics.get(
+                       "compile/jit_cache_miss", 0)),
+                   "overlap_sec": round(res.metrics.get(
+                       "serve/overlap_sec", 0.0), 4)}
+            if res.ok:
+                warm_secs.append(res.elapsed_sec)
+                if k in cold_out:
+                    warm_files = {
+                        ref + "__" + specs[k].config.prefix + ".fasta":
+                        render_file(recs, 0)
+                        for ref, recs in res.fastas.items()}
+                    same = warm_files == cold_out[k]
+                    row["identical"] = same
+                    identical.append(same)
+            else:
+                row["error"] = res.error
+            rows.append(row)
+        cold_per_job = statistics.mean(cold_secs) if cold_secs else 0.0
+        warm_per_job = statistics.mean(warm_secs) if warm_secs else 0.0
+        warm_tail = statistics.mean(warm_secs[1:]) \
+            if len(warm_secs) > 1 else warm_per_job
+        summary = {
+            "summary": True,
+            "n_jobs": n_jobs,
+            "n_reads": n_reads,
+            "contig_len": contig_len,
+            "pileup": pileup,
+            "cold_per_job_sec": round(cold_per_job, 3),
+            "warm_per_job_sec": round(warm_per_job, 3),
+            "warm_tail_per_job_sec": round(warm_tail, 3),
+            "warm_total_sec": round(warm_total, 3),
+            "speedup_vs_cold": round(cold_per_job / warm_per_job, 2)
+            if warm_per_job > 0 else 0.0,
+            "identical": bool(identical) and all(identical),
+            "overlap_sec_total": round(
+                runner.registry.value("serve/overlap_sec"), 4),
+            "jit_cache_dir": runner.cache_dir,
+        }
+        log(f"[serve_bench] cold {cold_per_job:.2f}s/job vs warm "
+            f"{warm_per_job:.2f}s/job "
+            f"({summary['speedup_vs_cold']}x), identical="
+            f"{summary['identical']}")
+    return {"rows": rows, "summary": summary}

@@ -1,0 +1,483 @@
+"""The persistent multi-job runner behind ``s2c serve`` / ``submit_jobs``.
+
+One :class:`~..backends.jax_backend.JaxBackend` lives for the server's
+lifetime; jobs flow through it sequentially on the device while each
+NEXT job's host decode runs ahead on a side thread.  See the package
+docstring for the design; the load-bearing pieces here are:
+
+* :class:`_DecodeAhead` — decodes job N+1 (header + segment batches,
+  the same ``_make_encoder`` path a cold run uses) on a daemon thread
+  with job N+1's OWN instruments thread-bound
+  (``observability.bind_run_to_thread``), logging per-batch decode
+  intervals;
+* the cross-job overlap join — after job N completes, its device
+  dispatch intervals (planted via the backend's ``serve_dispatch_log``
+  attribute) are intersected with job N+1's decode intervals
+  (``wire.pipeline.intersect_sec``) and the result lands in job N+1's
+  registry as ``serve/overlap_sec`` before that job runs;
+* prewarm — ``ops.pileup.prewarm_scatter`` over the layout's canonical
+  slab shapes, bound to the SERVER registry so per-job registries show
+  prewarmed shapes as pure ``compile/jit_cache_hit``s.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+from .. import observability as obs
+from ..config import RunConfig
+from ..observability import jitcache
+from ..observability.metrics import MetricsRegistry
+
+logger = logging.getLogger("sam2consensus_tpu.serve")
+
+#: decode-ahead batch cap: bounds the memory a pre-decoded job can pin
+#: (each batch is ~chunk_reads rows).  Past the cap the remainder
+#: decodes lazily inside the job's own run, exactly like a cold run.
+DEFAULT_AHEAD_BATCHES = 64
+
+
+def _ahead_batch_cap() -> int:
+    try:
+        return max(1, int(os.environ.get("S2C_SERVE_AHEAD_BATCHES",
+                                         DEFAULT_AHEAD_BATCHES)))
+    except ValueError:
+        return DEFAULT_AHEAD_BATCHES
+
+
+@dataclass
+class JobSpec:
+    """One consensus job: an input path plus its full RunConfig.
+
+    ``config.backend`` is ignored (the server IS the jax backend);
+    checkpoint/incremental modes are rejected — their contract is
+    serial decode with stream-consistent snapshots, which serve-mode
+    decode-ahead would break."""
+
+    filename: str
+    config: RunConfig = field(default_factory=lambda: RunConfig(
+        backend="jax"))
+    job_id: str = ""
+
+
+@dataclass
+class JobResult:
+    """One job's outcome; the server returns one per submitted spec,
+    in order, failed jobs included (``error`` set, ``fastas`` None)."""
+
+    job_id: str
+    filename: str
+    fastas: Optional[dict] = None        # {reference: [FastaRecord]}
+    stats: Optional[object] = None       # BackendStats
+    error: Optional[str] = None
+    elapsed_sec: float = 0.0
+    #: 0-based submit order; job 0 pays whatever compile the prewarm
+    #: did not hide, jobs 1+ are the warm path
+    index: int = 0
+    #: per-job counter subset: serve/*, compile/*, resilience/*,
+    #: fault/* and phase/*_sec — the amortization/isolation story
+    metrics: dict = field(default_factory=dict)
+    #: degradation rungs this job ended on ({} = never demoted)
+    rungs: dict = field(default_factory=dict)
+    manifest: Optional[dict] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+class _PredecodedJob:
+    """Records-carrier the backend consumes in place of a ReadStream
+    (``JaxBackend._make_encoder`` dispatches on ``is_predecoded``)."""
+
+    is_predecoded = True
+
+    def __init__(self, ahead: "_DecodeAhead"):
+        self._ahead = ahead
+
+    @property
+    def encoder(self):
+        return self._ahead.encoder
+
+    @property
+    def n_lines(self) -> int:
+        stream = self._ahead.stream
+        return stream.n_lines if stream is not None else 0
+
+    def batches(self):
+        """Already-decoded batches first, then any live remainder; a
+        decode error captured on the ahead thread re-raises HERE, at
+        the point the cold streaming path would have hit it (same
+        exception object, so type/message parity holds)."""
+        a = self._ahead
+        for batch in a.done_batches:
+            yield batch
+        if a.error is not None:
+            raise a.error
+        if a.rest is not None:
+            yield from a.rest
+
+
+class _DecodeAhead:
+    """Decode one job's input on a daemon thread, instruments bound."""
+
+    def __init__(self, backend, spec: JobSpec,
+                 robs: "obs.RunObservability", cap: int):
+        self.spec = spec
+        self.robs = robs
+        self.contigs = None
+        self.stream = None
+        self.encoder = None
+        self.done_batches: list = []
+        self.rest = None
+        self.error: Optional[BaseException] = None
+        self._backend = backend
+        self._cap = cap
+        self._lock = threading.Lock()
+        self._intervals: List[Tuple[float, float]] = []
+        self._handle = None
+        self.thread = threading.Thread(target=self._work, daemon=True,
+                                       name="serve-decode-ahead")
+        self.thread.start()
+
+    def intervals(self) -> List[Tuple[float, float]]:
+        with self._lock:
+            return list(self._intervals)
+
+    def decode_sec(self) -> float:
+        with self._lock:
+            return sum(t1 - t0 for t0, t1 in self._intervals)
+
+    def _work(self) -> None:
+        from ..encoder.events import GenomeLayout
+        from ..io.sam import ReadStream, opener, read_header
+
+        with obs.bind_run_to_thread(self.robs):
+            reg = obs.metrics()
+            tr = obs.tracer()
+            tr.name_thread("serve-decode-ahead")
+            try:
+                handle = opener(self.spec.filename, binary=True)
+                self._handle = handle
+                contigs, _n, first = read_header(handle)
+                stream = ReadStream(handle, first)
+                layout = GenomeLayout(contigs)
+                # acc=None: never the fused host-counting encoder — the
+                # job's accumulator does not exist yet.  Same native/py
+                # decode selection as a cold run otherwise.
+                encoder, gen = self._backend._make_encoder(
+                    layout, stream, self.spec.config, None)
+                self.encoder = encoder
+                self.stream = stream
+                self.contigs = contigs
+                while len(self.done_batches) < self._cap:
+                    with tr.span("decode"):
+                        t0 = time.perf_counter()
+                        try:
+                            batch = next(gen)
+                        except StopIteration:
+                            gen = None
+                            break
+                        t1 = time.perf_counter()
+                        reg.add("phase/decode_sec", t1 - t0)
+                    with self._lock:
+                        self._intervals.append((t0, t1))
+                    self.done_batches.append(batch)
+                self.rest = gen
+            except BaseException as exc:
+                # surfaced to the job when it consumes past the decoded
+                # prefix (_PredecodedJob.batches) — or immediately, when
+                # even the header never parsed (contigs is None)
+                self.error = exc
+
+    def close(self) -> None:
+        if self._handle is not None:
+            try:
+                self._handle.close()
+            except OSError:
+                pass
+
+
+class ServeRunner:
+    """A warm server: one backend, many jobs (see the package docs).
+
+    ``prewarm``: ``"auto"`` compiles the first job's canonical slab
+    shapes on a background thread while that job decodes (device-pileup
+    jobs only — a host-routed job dispatches no scatter), ``"off"``
+    disables, and :meth:`prewarm` takes explicit shapes at any time.
+    ``decode_ahead=False`` serializes jobs exactly like cold runs
+    (keeping only the compile-cache wins).  ``persistent_cache``
+    controls the on-disk jax compilation cache
+    (``observability/jitcache.py``; S2C_JIT_CACHE overrides).
+    """
+
+    def __init__(self, prewarm: str = "auto", decode_ahead: bool = True,
+                 persistent_cache: bool = True,
+                 echo: Optional[Callable] = None):
+        from ..backends.jax_backend import JaxBackend
+
+        if prewarm not in ("auto", "off"):
+            raise ValueError(f"prewarm={prewarm!r}: use 'auto' or 'off'")
+        self.prewarm_mode = prewarm
+        self.decode_ahead = decode_ahead
+        self.echo = echo or (lambda *a, **k: None)
+        self.backend = JaxBackend()
+        #: server-lifetime instruments: prewarm traces land here (so
+        #: per-job registries show prewarmed shapes as pure hits) plus
+        #: the aggregate serve/* counters across the whole queue
+        self.registry = MetricsRegistry()
+        self.jobs_run = 0
+        self._prewarmed: set = set()
+        self._prewarm_threads: list = []
+        self._prewarm_stop = threading.Event()
+        self.cache_dir = jitcache.setup_persistent_cache() \
+            if persistent_cache else None
+        # a daemon thread killed MID-XLA-COMPILE at interpreter exit
+        # aborts the whole process from C++ ("terminate called without
+        # an active exception"); close() stops the prewarm loop at the
+        # next shape boundary and joins, so exit costs at most one
+        # in-flight compile
+        import atexit
+
+        atexit.register(self.close)
+
+    def close(self) -> None:
+        """Stop background prewarm at the next shape boundary and wait
+        for it; idempotent (also registered atexit — and unregistered
+        here, so a closed runner is GC-able instead of pinned in the
+        atexit table for the process lifetime)."""
+        self._prewarm_stop.set()
+        for t in self._prewarm_threads:
+            if t.is_alive():
+                t.join()
+        self._prewarm_threads.clear()
+        import atexit
+
+        try:
+            atexit.unregister(self.close)
+        except Exception:
+            pass
+
+    # -- prewarm ---------------------------------------------------------
+    def prewarm(self, total_len: int, shapes) -> int:
+        """Compile the packed scatter for ``shapes`` (``(rows, width)``
+        pairs) against a genome of ``total_len`` positions, into the
+        server's registry.  Idempotent per (total_len, shape)."""
+        from ..ops.pileup import prewarm_scatter
+
+        todo = [s for s in shapes
+                if (total_len, tuple(s)) not in self._prewarmed]
+        if not todo:
+            return 0
+        server_obs = obs.RunObservability(
+            tracer=obs.tracer(), registry=self.registry,
+            ledger=obs.DecisionLedger())
+        with obs.bind_run_to_thread(server_obs):
+            n = prewarm_scatter(total_len, todo)
+        for s in todo:
+            self._prewarmed.add((total_len, tuple(s)))
+        self.registry.add("compile/prewarm_shapes", n)
+        logger.info("prewarmed %d scatter shape(s) for L=%d", n,
+                    total_len)
+        return n
+
+    def _auto_prewarm(self, spec: JobSpec, total_len: int) -> None:
+        """First-job prewarm, hidden behind its decode: compile the
+        canonical shapes on a thread.  Device-pileup jobs only — a
+        host-routed pileup dispatches no scatter to warm."""
+        from ..ops.pileup import canonical_slab_shapes
+
+        if self.prewarm_mode != "auto":
+            return
+        if spec.config.pileup not in ("scatter", "pallas", "mxu"):
+            # --pileup auto resolves per job inside the backend (host
+            # vs device by the placement gate) — a host-routed job
+            # dispatches no scatter to warm, so auto-prewarm only
+            # engages for explicitly device-pinned pileups.  Say so:
+            # a silent no-op here reads as "prewarm is broken".
+            logger.info(
+                "prewarm skipped: --pileup %s (auto-prewarm engages "
+                "for explicit device pileups scatter/pallas/mxu; use "
+                "ServeRunner.prewarm() for manual shape control)",
+                spec.config.pileup)
+            return
+        shapes = canonical_slab_shapes(
+            total_len, chunk_reads=spec.config.chunk_reads)
+
+        def _worker():
+            # one shape per prewarm() call so close() can stop the loop
+            # at a compile boundary instead of abandoning a C++ compile
+            for shape in shapes:
+                if self._prewarm_stop.is_set():
+                    return
+                self.prewarm(total_len, [shape])
+
+        t = threading.Thread(target=_worker, name="serve-prewarm",
+                             daemon=True)
+        t.start()
+        self._prewarm_threads.append(t)
+
+    # -- per-job export destinations -------------------------------------
+    def _job_out(self, cfg_value: Optional[str], env_name: str,
+                 index: int) -> Optional[str]:
+        """A job's metrics/trace destination.  An explicit per-job
+        config value wins untouched; an ENV-derived base (S2C_*_OUT)
+        is suffixed per job — without this, every serve job would
+        resolve to the same env path inside prepare_run and overwrite
+        the previous job's artifacts (mode 'w' exports).  ``index`` is
+        the offset from ``jobs_run`` AT CALL TIME (0 = the job about
+        to run, 1 = the decode-ahead next job)."""
+        if cfg_value:
+            return cfg_value
+        env = os.environ.get(env_name)
+        if env:
+            return f"{env}.job{self.jobs_run + index}"
+        return None
+
+    # -- job validation --------------------------------------------------
+    @staticmethod
+    def _validate(spec: JobSpec) -> None:
+        if spec.config.pileup == "host" and spec.config.shards > 1:
+            raise ValueError(
+                "--pileup host accumulates on the single host; it does "
+                "not compose with --shards (same contract as the "
+                "one-shot CLI)")
+        if spec.config.checkpoint_dir:
+            raise ValueError(
+                "serve mode does not compose with --checkpoint-dir: "
+                "checkpoints need serial decode with stream-consistent "
+                "snapshots, which decode-ahead breaks; run checkpointed "
+                "jobs through the one-shot CLI")
+        if spec.config.incremental:
+            raise ValueError("serve mode does not compose with "
+                             "--incremental (see --checkpoint-dir)")
+
+    # -- the queue -------------------------------------------------------
+    def submit_jobs(self, specs: List[JobSpec]) -> List[JobResult]:
+        """Run the queue; returns one :class:`JobResult` per spec, in
+        order.  The server survives failed jobs (their error rides the
+        result) and stays warm afterwards for the next submit."""
+        from ..io.sam import ReadStream, opener, read_header
+        from ..resilience import ladder as rladder
+        from ..wire.pipeline import intersect_sec
+
+        for spec in specs:
+            self._validate(spec)
+        results: List[JobResult] = []
+        ahead: Optional[_DecodeAhead] = None
+        cap = _ahead_batch_cap()
+        for i, spec in enumerate(specs):
+            job_id = spec.job_id or \
+                f"job{self.jobs_run}:{os.path.basename(spec.filename)}"
+            cfg = spec.config
+            # -- job context: from the decode-ahead thread, or inline --
+            close_handle = None
+            if ahead is not None:
+                ahead.thread.join()
+                robs = ahead.robs
+                contigs = ahead.contigs
+                records = _PredecodedJob(ahead)
+                header_err = ahead.error if contigs is None else None
+                close_handle = ahead.close
+            else:
+                robs = obs.prepare_run(
+                    trace_out=self._job_out(cfg.trace_out,
+                                            "S2C_TRACE_OUT", 0),
+                    metrics_out=self._job_out(cfg.metrics_out,
+                                              "S2C_METRICS_OUT", 0),
+                    config=cfg)
+                contigs = records = None
+                header_err = None
+                try:
+                    handle = opener(spec.filename, binary=True)
+                    close_handle = handle.close
+                    contigs, _n, first = read_header(handle)
+                    records = ReadStream(handle, first)
+                except Exception as exc:
+                    header_err = exc
+            ahead = None
+            if i == 0 and contigs is not None:
+                from ..encoder.events import GenomeLayout
+
+                self._auto_prewarm(spec, GenomeLayout(contigs).total_len)
+            # -- launch the NEXT job's decode-ahead before running ----
+            if self.decode_ahead and i + 1 < len(specs):
+                nxt = specs[i + 1]
+                ahead = _DecodeAhead(
+                    self.backend, nxt,
+                    obs.prepare_run(
+                        trace_out=self._job_out(nxt.config.trace_out,
+                                                "S2C_TRACE_OUT", 1),
+                        metrics_out=self._job_out(
+                            nxt.config.metrics_out, "S2C_METRICS_OUT",
+                            1),
+                        config=nxt.config), cap)
+            # -- run this job -----------------------------------------
+            res = JobResult(job_id=job_id, filename=spec.filename,
+                            index=i)
+            dlog: List[Tuple[float, float]] = []
+            t0 = time.perf_counter()
+            if header_err is not None:
+                res.error = f"{type(header_err).__name__}: {header_err}"
+                if close_handle is not None:
+                    close_handle()
+            else:
+                self.backend.serve_prepared_obs = robs
+                self.backend.serve_dispatch_log = dlog
+                try:
+                    out = self.backend.run(contigs, records, cfg)
+                    res.fastas, res.stats = out.fastas, out.stats
+                except Exception as exc:
+                    res.error = f"{type(exc).__name__}: {exc}"
+                    logger.warning("job %s failed: %s", job_id,
+                                   res.error)
+                finally:
+                    self.backend.serve_prepared_obs = None
+                    self.backend.serve_dispatch_log = None
+                    if close_handle is not None:
+                        close_handle()
+            res.elapsed_sec = time.perf_counter() - t0
+            snap = robs.registry.snapshot()
+            res.metrics = {
+                k: v for k, v in snap["counters"].items()
+                if k.startswith(("serve/", "compile/", "resilience/",
+                                 "fault/", "phase/"))}
+            res.rungs = rladder.job_rungs(snap)
+            res.manifest = obs.last_manifest() if res.ok else None
+            results.append(res)
+            self.jobs_run += 1
+            self.registry.add("serve/jobs", 1)
+            if not res.ok:
+                self.registry.add("serve/jobs_failed", 1)
+            # -- cross-job overlap: bill it to the job whose decode
+            #    was hidden (N+1), before that job runs ---------------
+            if ahead is not None:
+                ov = intersect_sec(ahead.intervals(), dlog)
+                ahead.robs.registry.add("serve/overlap_sec", ov)
+                ahead.robs.registry.add("serve/decode_ahead_sec",
+                                        ahead.decode_sec())
+                ahead.robs.registry.gauge("serve/overlap").set_info({
+                    "overlap_sec": round(ov, 4),
+                    "decode_ahead_sec": round(ahead.decode_sec(), 4),
+                    "overlapped_job": job_id})
+                self.registry.add("serve/overlap_sec", ov)
+            self.echo(f"[serve] {job_id}: "
+                      + (f"ok in {res.elapsed_sec:.2f}s"
+                         if res.ok else f"FAILED ({res.error})"))
+        return results
+
+
+def submit_jobs(specs: List[JobSpec], **runner_kwargs) -> List[JobResult]:
+    """One-call API: build a :class:`ServeRunner`, run the queue, return
+    the results (the runner — and its warm caches — is discarded; hold a
+    ServeRunner yourself to amortize across submits)."""
+    runner = ServeRunner(**runner_kwargs)
+    try:
+        return runner.submit_jobs(specs)
+    finally:
+        runner.close()                  # join prewarm + drop atexit ref

@@ -27,9 +27,12 @@ from typing import Callable, List, Optional, Tuple
 DEFAULT_SLOTS = 2
 
 
-def _intersect_sec(a: List[Tuple[float, float]],
-                   b: List[Tuple[float, float]]) -> float:
-    """Total overlap between two interval lists (merge sweep)."""
+def intersect_sec(a: List[Tuple[float, float]],
+                  b: List[Tuple[float, float]]) -> float:
+    """Total overlap between two interval lists (merge sweep).  Shared
+    by :meth:`StageSlots.overlap_sec` (stage∩dispatch within a run) and
+    the serve runner (job N+1 decode ∩ job N dispatch across runs —
+    ``serve/overlap_sec``)."""
     a = sorted(a)
     b = sorted(b)
     i = j = 0
@@ -44,6 +47,10 @@ def _intersect_sec(a: List[Tuple[float, float]],
         else:
             j += 1
     return total
+
+
+#: pre-rename alias (tests/test_wire.py pins the merge-sweep math)
+_intersect_sec = intersect_sec
 
 
 class StageSlots:
@@ -146,5 +153,5 @@ class StageSlots:
         """Exact seconds the staging thread's transfer work co-ran with
         the consumer's accumulate dispatches."""
         with self._lock:
-            return _intersect_sec(list(self._stage_iv),
-                                  list(self._consume_iv))
+            return intersect_sec(list(self._stage_iv),
+                                 list(self._consume_iv))

@@ -156,6 +156,66 @@ def test_gate_json_output(tmp_path, capsys):
     assert any(v["config"] == "north_star" for v in blob["verdicts"])
 
 
+# -- full-row sibling artifacts (round 6+) ---------------------------------
+def test_full_sibling_preferred_over_truncated_capture(tmp_path):
+    """bench.py now writes BENCH_<tag>.full.json; the loader must read
+    it INSTEAD of scanning the truncated capture."""
+    cap = tmp_path / "BENCH_t10.json"
+    # the capture itself is hopelessly truncated mid-object
+    cap.write_text('es_per_s": 42.0}, {"config": "stale", "jax_')
+    full = tmp_path / "BENCH_t10.full.json"
+    full.write_text(json.dumps({"configs": [
+        {"config": "north_star", "vs_baseline": 19.0, "jax_sec": 1.1},
+        {"config": "serve_warm", "vs_baseline": 6.1, "jax_sec": 0.55},
+    ]}))
+    rows = regress.load_bench_artifact(str(cap))
+    assert [r["config"] for r in rows] == ["north_star", "serve_warm"]
+    # a corrupt sibling falls back to the capture scan
+    full.write_text("not json at all")
+    assert regress.load_bench_artifact(str(cap)) == []
+
+
+def test_full_sibling_path_mapping():
+    assert regress.full_sibling_path("BENCH_r06.json") \
+        == "BENCH_r06.full.json"
+    assert regress.full_sibling_path("BENCH_r06.full.json") \
+        == "BENCH_r06.full.json"
+
+
+def test_discover_default_excludes_full_siblings(tmp_path):
+    (tmp_path / "BENCH_r06.json").write_text("{}")
+    (tmp_path / "BENCH_r06.full.json").write_text("{}")
+    paths = regress_check.discover_default(str(tmp_path))
+    assert [os.path.basename(p) for p in paths] == ["BENCH_r06.json"]
+
+
+# -- the serve_warm series (round 6+) --------------------------------------
+def _write_serve_round(tmp_path, i, vs_cold, warm_sec):
+    inner = json.dumps({"configs": [
+        {"config": "serve_warm", "vs_baseline": vs_cold,
+         "vs_baseline_kind": "cold_process", "jax_sec": warm_sec,
+         "identical": True}]})
+    path = tmp_path / f"BENCH_s{i:02d}.json"
+    path.write_text(json.dumps({"rc": 0, "tail": inner + "\n"}))
+    return str(path)
+
+
+def test_gate_judges_serve_series(tmp_path, capsys):
+    """Once >=1 round of serve history exists, the warm-path numbers
+    regress like any other series: a warm-per-job blowup (or a cold/warm
+    ratio collapse) fails the gate."""
+    paths = [_write_serve_round(tmp_path, i, vs, sec)
+             for i, (vs, sec) in enumerate(
+                 [(6.0, 0.55), (5.7, 0.58), (6.3, 0.52), (6.0, 0.56)])]
+    assert regress_check.main(list(paths)) == 0
+    paths.append(_write_serve_round(tmp_path, 9, 1.1, 3.2))
+    rc = regress_check.main(paths)
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "REGRESSED: serve_warm/vs_baseline" in out
+    assert "REGRESSED: serve_warm/jax_sec" in out
+
+
 # -- campaign JSONL mode ---------------------------------------------------
 def test_gate_jsonl_series(tmp_path, capsys):
     path = tmp_path / "sweep.jsonl"

@@ -1,0 +1,439 @@
+"""Serve-mode correctness: warm jobs ARE cold jobs, plus the warm wins.
+
+The PR-5 acceptance pins live here:
+
+* N jobs submitted warm are byte-identical to N independent cold runs —
+  including a gzip-compressed input and a ``--py2-compat`` job;
+* ``compile/jit_cache_hit`` > 0 on job 2+ with zero re-trace (and zero
+  re-trace on job 1 for prewarmed shapes);
+* a mid-queue injected device fault demotes ONLY the faulting job's
+  ladder (counter-pinned): the next job runs on the fast path, warm;
+* ``serve/overlap_sec`` is published per job and the thread-scoped
+  observability binding keeps concurrent registries isolated.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.io.sam import ReadStream, opener, read_header
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_cache(monkeypatch):
+    # keep the process-global jax compilation-cache config untouched
+    # across the suite; the persistent cache gets its own subprocess
+    # test below
+    monkeypatch.setenv("S2C_JIT_CACHE", "")
+
+
+def _sim(tmp, name, seed, contig_len=3000, n_reads=1200, gz=False,
+         **kw):
+    spec = SimSpec(n_contigs=1, contig_len=contig_len, n_reads=n_reads,
+                   read_len=100, contig_len_jitter=0.0, seed=seed,
+                   contig_prefix="srv", **kw)
+    path = os.path.join(str(tmp), name)
+    text = simulate(spec)
+    if gz:
+        import gzip
+
+        with gzip.open(path, "wb") as fh:
+            fh.write(text.encode("ascii"))
+    else:
+        with open(path, "w") as fh:
+            fh.write(text)
+    return path
+
+
+def _cold_jax(path, cfg):
+    """One independent cold run (fresh backend), rendered."""
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+
+    h = opener(path, binary=True)
+    contigs, _n, first = read_header(h)
+    res = JaxBackend().run(contigs, ReadStream(h, first), cfg)
+    h.close()
+    return {n: render_file(r, 0) for n, r in res.fastas.items()}, res
+
+
+def _rendered(result):
+    return {n: render_file(r, 0) for n, r in result.fastas.items()}
+
+
+def _runner(**kw):
+    from sam2consensus_tpu.serve import ServeRunner
+
+    kw.setdefault("prewarm", "off")
+    kw.setdefault("persistent_cache", False)
+    return ServeRunner(**kw)
+
+
+# -- thread-scoped observability ------------------------------------------
+def test_bind_thread_registry_isolation():
+    from sam2consensus_tpu import observability as obs
+    from sam2consensus_tpu.observability.metrics import current
+
+    robs = obs.prepare_run()
+    seen = {}
+
+    def side():
+        with obs.bind_run_to_thread(robs):
+            current().add("x/side", 1)
+            seen["side"] = current() is robs.registry
+        seen["after"] = current() is robs.registry
+
+    t = threading.Thread(target=side)
+    t.start()
+    t.join()
+    assert seen == {"side": True, "after": False}
+    assert robs.registry.value("x/side") == 1
+    # the main thread never saw the bound registry
+    assert current() is not robs.registry
+
+
+def test_intersect_sec_cross_lists():
+    from sam2consensus_tpu.wire.pipeline import intersect_sec
+
+    a = [(0.0, 1.0), (2.0, 3.0)]
+    b = [(0.5, 2.5)]
+    assert intersect_sec(a, b) == pytest.approx(1.0)
+    assert intersect_sec([], b) == 0.0
+
+
+# -- prewarm enumeration ---------------------------------------------------
+def test_canonical_slab_shapes_cover_hint():
+    from sam2consensus_tpu.ops.pileup import canonical_slab_shapes
+
+    shapes = canonical_slab_shapes(5386, read_len=100, n_reads=3000)
+    assert (4096, 256) in shapes        # the measured sim shape
+    assert all(w in (128, 256) for _r, w in shapes)
+    # server-startup enumeration covers every pow2 level >= 1024
+    full = canonical_slab_shapes(5386, read_len=100)
+    assert (4096, 256) in full and (1024, 128) in full
+    assert len(full) < 20               # a handful, not a sweep
+
+
+def test_prewarm_scatter_compiles_without_counting():
+    import numpy as np
+
+    from sam2consensus_tpu.observability.metrics import (pop_run,
+                                                         push_run)
+    from sam2consensus_tpu.ops.pileup import (PileupAccumulator,
+                                              prewarm_scatter)
+
+    reg = push_run()
+    try:
+        assert prewarm_scatter(911, [(64, 32)]) == 1
+        assert reg.value("compile/trace/scatter_packed/64x32") == 1
+        # a matching dispatch afterwards is a pure hit, and counts only
+        # what its rows say
+        acc = PileupAccumulator(911, strategy="scatter")
+        from sam2consensus_tpu.encoder.events import SegmentBatch
+
+        starts = np.zeros(64, np.int32)
+        codes = np.full((64, 32), 255, np.uint8)
+        codes[:, 0] = 1                 # all rows real: no pad-trim,
+        acc.add(SegmentBatch(buckets={32: (starts, codes)}))  # 64x32
+        assert reg.value("compile/jit_cache_hit") == 1
+        assert reg.value("compile/jit_cache_miss") == 0
+        assert int(np.asarray(acc.counts_host()).sum()) == 64
+    finally:
+        pop_run(reg)
+
+
+# -- warm-vs-cold byte identity -------------------------------------------
+def test_warm_jobs_byte_identical_to_cold(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    jobs = [
+        (_sim(tmp_path, "a.sam", 11),
+         RunConfig(backend="jax", pileup="scatter", shards=1, prefix="a")),
+        (_sim(tmp_path, "b.sam.gz", 12, gz=True),
+         RunConfig(backend="jax", pileup="scatter", shards=1, prefix="b")),
+        (_sim(tmp_path, "c.sam", 13),
+         RunConfig(backend="jax", pileup="scatter", shards=1, prefix="c",
+                   py2_compat=True, maxdel=None)),
+        (_sim(tmp_path, "d.sam", 14),
+         RunConfig(backend="jax", pileup="scatter", shards=1, prefix="d",
+                   thresholds=[0.25, 0.75])),
+    ]
+    runner = _runner()
+    results = runner.submit_jobs(
+        [JobSpec(filename=p, config=c) for p, c in jobs])
+    assert [r.ok for r in results] == [True] * len(jobs)
+    for (path, cfg), res in zip(jobs, results):
+        cold, cold_res = _cold_jax(path, cfg)
+        assert _rendered(res) == cold, f"warm != cold for {path}"
+        assert res.stats.reads_mapped == cold_res.stats.reads_mapped
+    # cross-check one job against the CPU golden oracle too
+    from sam2consensus_tpu.backends.cpu import CpuBackend
+
+    path, cfg = jobs[0]
+    h = opener(path, binary=False)
+    contigs, _n, first = read_header(h)
+    oracle = CpuBackend().run(contigs, ReadStream(h, first), cfg)
+    h.close()
+    assert _rendered(results[0]) == _rendered(oracle)
+
+
+# -- jit-cache amortization ------------------------------------------------
+def test_jit_cache_hit_on_warm_jobs(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    paths = [_sim(tmp_path, f"w{k}.sam", 20 + k, contig_len=4444)
+             for k in range(3)]
+    runner = _runner()
+    results = runner.submit_jobs(
+        [JobSpec(filename=p,
+                 config=RunConfig(backend="jax", pileup="scatter", shards=1))
+         for p in paths])
+    assert all(r.ok for r in results)
+    first = results[0].metrics
+    assert first.get("compile/jit_cache_miss", 0) >= 1
+    for res in results[1:]:
+        m = res.metrics
+        # THE acceptance pin: hits on job 2+, zero re-trace anywhere
+        assert m.get("compile/jit_cache_hit", 0) > 0
+        assert m.get("compile/jit_cache_miss", 0) == 0
+        assert not any(k.startswith("compile/trace/") for k in m), m
+
+
+def test_prewarmed_shapes_never_retrace(tmp_path):
+    from sam2consensus_tpu.encoder.events import GenomeLayout
+    from sam2consensus_tpu.ops.pileup import canonical_slab_shapes
+    from sam2consensus_tpu.serve import JobSpec
+
+    path = _sim(tmp_path, "p.sam", 31, contig_len=7777)
+    h = opener(path, binary=True)
+    contigs, _n, _first = read_header(h)
+    h.close()
+    total_len = GenomeLayout(contigs).total_len
+    runner = _runner()
+    shapes = canonical_slab_shapes(total_len, read_len=100,
+                                   n_reads=1200)
+    assert runner.prewarm(total_len, shapes) == len(shapes)
+    assert runner.prewarm(total_len, shapes) == 0   # idempotent
+    server = runner.registry.snapshot()["counters"]
+    assert server["compile/prewarm_shapes"] == len(shapes)
+    [res] = runner.submit_jobs([JobSpec(
+        filename=path, config=RunConfig(backend="jax", shards=1,
+                                        pileup="scatter"))])
+    assert res.ok
+    # job 1 (!) already runs fully warm: its registry saw no trace at
+    # all, every dispatch was a cache hit
+    assert res.metrics.get("compile/jit_cache_hit", 0) > 0
+    assert res.metrics.get("compile/jit_cache_miss", 0) == 0
+    assert not any(k.startswith("compile/trace/") for k in res.metrics)
+
+
+# -- cross-job pipelining --------------------------------------------------
+def test_overlap_metric_published(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    paths = [_sim(tmp_path, f"o{k}.sam", 40 + k) for k in range(3)]
+    runner = _runner()
+    results = runner.submit_jobs(
+        [JobSpec(filename=p,
+                 config=RunConfig(backend="jax", pileup="scatter", shards=1))
+         for p in paths])
+    assert all(r.ok for r in results)
+    # job 1 was never decode-ahead (nothing to overlap); jobs 2+ carry
+    # the measured cross-job intersection (>= 0 — tiny jobs can decode
+    # entirely before the previous job dispatches)
+    assert "serve/overlap_sec" not in results[0].metrics
+    for res in results[1:]:
+        assert res.metrics.get("serve/overlap_sec", None) is not None
+        assert res.metrics["serve/overlap_sec"] >= 0.0
+        assert res.metrics.get("serve/decode_ahead_sec", 0) > 0.0
+
+
+def test_decode_ahead_off_still_identical(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    path = _sim(tmp_path, "n.sam", 50)
+    cfg = RunConfig(backend="jax", pileup="scatter", shards=1)
+    runner = _runner(decode_ahead=False)
+    [r1] = runner.submit_jobs([JobSpec(filename=path, config=cfg)])
+    cold, _ = _cold_jax(path, cfg)
+    assert _rendered(r1) == cold
+
+
+# -- per-job fault isolation ----------------------------------------------
+def test_midqueue_fault_demotes_only_that_job(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    paths = [_sim(tmp_path, f"f{k}.sam", 60 + k) for k in range(3)]
+    base = dict(backend="jax", pileup="scatter", shards=1)
+    faulty = RunConfig(**base, fault_inject="pileup_dispatch:rpc:0:inf",
+                       on_device_error="fallback", retries=1,
+                       retry_backoff=0.01)
+    cfgs = [RunConfig(**base), faulty, RunConfig(**base)]
+    runner = _runner()
+    results = runner.submit_jobs(
+        [JobSpec(filename=p, config=c) for p, c in zip(paths, cfgs)])
+    assert [r.ok for r in results] == [True, True, True]
+    # the faulting job walked the ladder (counter-pinned) yet produced
+    # byte-identical output
+    m1 = results[1].metrics
+    assert m1.get("resilience/demotions", 0) >= 1
+    assert results[1].rungs.get("pileup") == "host"
+    clean_cfg = RunConfig(**base)
+    for k in (0, 1, 2):
+        cold, _ = _cold_jax(paths[k], clean_cfg)
+        assert _rendered(results[k]) == cold
+    # ...and the NEXT job never saw the demotion: fast path, warm
+    m2 = results[2].metrics
+    assert m2.get("resilience/demotions", 0) == 0
+    assert results[2].rungs == {}
+    assert m2.get("compile/jit_cache_hit", 0) > 0
+    assert "pileup_ladder" not in results[2].stats.extra
+
+
+def test_failed_job_does_not_kill_the_server(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    good = _sim(tmp_path, "g.sam", 70)
+    cfg = RunConfig(backend="jax", pileup="scatter", shards=1)
+    runner = _runner()
+    results = runner.submit_jobs([
+        JobSpec(filename=good, config=cfg),
+        JobSpec(filename=os.path.join(str(tmp_path), "missing.sam"),
+                config=cfg),
+        JobSpec(filename=good, config=cfg),
+    ])
+    assert [r.ok for r in results] == [True, False, True]
+    assert "FileNotFoundError" in results[1].error
+    cold, _ = _cold_jax(good, cfg)
+    assert _rendered(results[2]) == cold
+    assert runner.registry.value("serve/jobs_failed") == 1
+
+
+def test_serve_rejects_checkpoint_jobs(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    path = _sim(tmp_path, "r.sam", 80)
+    runner = _runner()
+    with pytest.raises(ValueError, match="checkpoint"):
+        runner.submit_jobs([JobSpec(
+            filename=path,
+            config=RunConfig(backend="jax",
+                             checkpoint_dir=str(tmp_path)))])
+    # non-composable combos the one-shot CLI rejects are rejected here
+    # too (API ValueError; the serve CLI turns the same combo into a
+    # clean SystemExit up front)
+    with pytest.raises(ValueError, match="does not compose"):
+        runner.submit_jobs([JobSpec(
+            filename=path,
+            config=RunConfig(backend="jax", pileup="host", shards=2))])
+    from sam2consensus_tpu import cli
+
+    with pytest.raises(SystemExit, match="does not compose"):
+        cli.main(["serve", "-i", path, "--pileup", "host",
+                  "--shards", "2", "--quiet"])
+
+
+def test_env_metrics_out_suffixed_per_job(tmp_path, monkeypatch):
+    """S2C_METRICS_OUT names ONE path; serve must not let N jobs
+    overwrite each other's metrics/manifest there."""
+    from sam2consensus_tpu.serve import JobSpec
+
+    paths = [_sim(tmp_path, f"e{k}.sam", 85 + k) for k in range(2)]
+    base = str(tmp_path / "envm.jsonl")
+    monkeypatch.setenv("S2C_METRICS_OUT", base)
+    runner = _runner()
+    results = runner.submit_jobs(
+        [JobSpec(filename=p,
+                 config=RunConfig(backend="jax", pileup="scatter",
+                                  shards=1))
+         for p in paths])
+    assert all(r.ok for r in results)
+    assert os.path.exists(base + ".job0")
+    assert os.path.exists(base + ".job1")
+    assert not os.path.exists(base)
+
+
+# -- the CLI entry ---------------------------------------------------------
+def test_serve_cli_end_to_end(tmp_path):
+    from sam2consensus_tpu import cli
+
+    a = _sim(tmp_path, "cli_a.sam", 90)
+    b = _sim(tmp_path, "cli_b.sam.gz", 91, gz=True)
+    out = tmp_path / "out"
+    mbase = str(tmp_path / "metrics")
+    rc = cli.main(["serve", "-i", a, "-i", b, "-o", str(out),
+                   "--pileup", "scatter", "--quiet",
+                   "--metrics-out", mbase])
+    assert rc == 0
+    cold_out = tmp_path / "cold"
+    for path in (a, b):
+        assert cli.main(["-i", path, "-o", str(cold_out),
+                         "--backend", "jax", "--pileup", "scatter",
+                         "--quiet"]) == 0
+    warm_files = sorted(os.listdir(out))
+    assert warm_files == sorted(os.listdir(cold_out))
+    for f in warm_files:
+        assert (out / f).read_text() == (cold_out / f).read_text(), f
+    # per-job metrics + manifests were written
+    for k in (0, 1):
+        assert os.path.exists(f"{mbase}.job{k}.jsonl")
+        man = json.load(open(f"{mbase}.job{k}.jsonl.manifest.json"))
+        assert man["schema"] == "s2c-manifest/1"
+        if k > 0:
+            assert "serve/overlap_sec" in man["serve"]
+
+
+def test_serve_cli_rejects_bad_fault_spec():
+    from sam2consensus_tpu import cli
+
+    with pytest.raises(SystemExit):
+        cli.main(["serve", "-i", "x.sam", "--fault-inject",
+                  "nonsense//"])
+
+
+# -- persistent compilation cache (satellite) ------------------------------
+def test_persistent_cache_cross_process(tmp_path):
+    """Cold process 2 hits the on-disk cache process 1 populated, and
+    both record compile/persist_{hit,miss} via the monitoring hook."""
+    cache = str(tmp_path / "jitcache")
+    code = (
+        "import sys; sys.path.insert(0, {repo!r})\n"
+        "from sam2consensus_tpu.observability.jitcache import "
+        "setup_persistent_cache\n"
+        "from sam2consensus_tpu.observability.metrics import current\n"
+        "assert setup_persistent_cache() == {cache!r}\n"
+        "from sam2consensus_tpu.ops.pileup import prewarm_scatter\n"
+        "prewarm_scatter(901, [(64, 32)])\n"
+        "c = current().snapshot()['counters']\n"
+        "import json; print(json.dumps({{k: v for k, v in c.items()"
+        " if k.startswith('compile/persist')}}))\n"
+    ).format(repo=REPO, cache=cache)
+    env = dict(os.environ, S2C_JIT_CACHE=cache, JAX_PLATFORMS="cpu")
+    outs = []
+    for _ in range(2):
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        outs.append(json.loads(r.stdout.strip().splitlines()[-1]))
+    assert outs[0].get("compile/persist_miss", 0) > 0
+    assert outs[1].get("compile/persist_hit", 0) > 0
+    assert outs[1].get("compile/persist_miss", 0) == 0
+    assert os.listdir(cache)            # entries actually on disk
+
+
+def test_jit_cache_env_empty_disables(monkeypatch):
+    from sam2consensus_tpu.observability import jitcache
+
+    monkeypatch.setenv("S2C_JIT_CACHE", "")
+    assert jitcache.cache_dir() is None
+    assert jitcache.setup_persistent_cache() is None
+    monkeypatch.delenv("S2C_JIT_CACHE")
+    assert jitcache.cache_dir() == jitcache.DEFAULT_CACHE_DIR

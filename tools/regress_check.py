@@ -36,7 +36,12 @@ from sam2consensus_tpu.observability import regress  # noqa: E402
 
 
 def discover_default(root):
-    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    # .full.json siblings are the same round's complete row set, not a
+    # separate trajectory point (load_bench_artifact reads them through
+    # their BENCH_rNN.json parent)
+    return sorted(p for p in glob.glob(os.path.join(root,
+                                                    "BENCH_r*.json"))
+                  if not p.endswith(".full.json"))
 
 
 def gate_bench(paths, candidate_path, metrics, k, rel_floor, min_repeats):

@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Cold-vs-warm serving benchmark (the PR-5 serve tentpole's evidence).
+
+Runs a batch of small consensus jobs two ways — one CLI process per job
+(cold, the pre-serve reality) and one persistent ServeRunner (warm) —
+over byte-compared outputs, and writes one JSON row per job plus a
+summary row as JSONL (``--out``; stdout otherwise).  The summary's
+``speedup_vs_cold``/``identical`` fields are the acceptance numbers;
+``jit_hit``/``jit_miss``/``overlap_sec`` per warm row are the why.
+
+Campaign usage (tools/tpu_campaign.sh step ``serve_bench``) tags the
+artifact per round; the CPU-fallback harness proof lives at
+campaign/serve_bench_r06_cpufallback.jsonl.
+
+Usage: python tools/serve_bench.py [--jobs 8] [--reads 5000]
+       [--contig-len 5386] [--pileup scatter] [--out FILE.jsonl]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--jobs", type=int, default=8)
+    ap.add_argument("--reads", type=int, default=5000)
+    ap.add_argument("--contig-len", type=int, default=5386)
+    ap.add_argument("--read-len", type=int, default=100)
+    ap.add_argument("--pileup", default="scatter",
+                    choices=["auto", "scatter", "pallas", "mxu", "host"])
+    ap.add_argument("--cold-timeout", type=int, default=600,
+                    help="per-cold-job subprocess timeout (seconds)")
+    ap.add_argument("--out", default=None,
+                    help="JSONL destination (default: stdout)")
+    args = ap.parse_args(argv)
+
+    from sam2consensus_tpu.utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
+
+    from sam2consensus_tpu.serve.benchmark import run_serve_bench
+
+    res = run_serve_bench(n_jobs=args.jobs, n_reads=args.reads,
+                          contig_len=args.contig_len,
+                          read_len=args.read_len, pileup=args.pileup,
+                          cold_timeout=args.cold_timeout, log=log)
+    lines = [json.dumps(r) for r in res["rows"]]
+    lines.append(json.dumps(res["summary"]))
+    blob = "\n".join(lines) + "\n"
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(blob)
+        log(f"[serve_bench] wrote {args.out}")
+    else:
+        sys.stdout.write(blob)
+    s = res["summary"]
+    return 0 if (s["identical"] and s["warm_per_job_sec"] > 0) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,8 +73,14 @@ except Exception as e:
     print('NO CPU BACKEND:', type(e).__name__, e)
 "
 
-# 3. full bench (all configs incl. north_star + wide_genome)
-BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 \
+# 3. full bench (all configs incl. north_star + wide_genome;
+# BENCH_FULL_OUT writes the untruncated row set the regression gate
+# reads directly.  BENCH_SERVE_JOBS=0: the cold-vs-warm serving
+# numbers come from step 4e's dedicated serve_bench artifact — running
+# the 8 cold subprocesses twice per round would double several minutes
+# of wall clock for no extra signal)
+BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 BENCH_SERVE_JOBS=0 \
+  BENCH_FULL_OUT="campaign/bench_preview_$R.full.json" \
   run_step bench "campaign/bench_preview_$R.json" \
   "campaign/bench_stderr_$R.log" 5400 python bench.py
 
@@ -106,6 +112,15 @@ S2C_WIRE=delta8 S2C_SYNC_ACCUMULATE=1 BENCH_CONFIGS=north_star \
   BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 \
   run_step wire_ab_delta8 "campaign/wire_ab_delta8_$R.json" \
   "campaign/wire_ab_delta8_stderr_$R.log" 3600 python bench.py
+
+# 4e. cold-vs-warm serving benchmark (PR-5 serve tentpole evidence):
+# >=8 small jobs per process-per-job baseline vs one warm ServeRunner,
+# byte-compared; the summary row's speedup_vs_cold / jit hit counters
+# are the warm-path claim.  CPU-fallback harness proof:
+# campaign/serve_bench_r06_cpufallback.jsonl
+run_step serve_bench "campaign/serve_bench_$R.jsonl" \
+  "campaign/serve_bench_stderr_$R.log" 2400 \
+  python tools/serve_bench.py --jobs 8
 
 # 5. packed5 output-encoding measurement (sets S2C_P5_DEV_NS evidence)
 run_step measure_p5 "campaign/measure_p5_$R.jsonl" \
