@@ -179,12 +179,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(tests/chaos): comma-separated "
                         "site:kind:after_n[:times] specs — sites "
                         "device_put|pileup_dispatch|accumulate|vote|"
-                        "insertion_build|link_probe|wire_encode, kinds "
+                        "insertion_build|link_probe|wire_encode|"
+                        "serve_decode_ahead|journal_write|job_hang, kinds "
                         "rpc|timeout|oom|"
                         "fatal|trace, after_n an integer call count or "
                         "pP probability (seeded by S2C_FAULT_SEED), times "
-                        "an integer or inf. Env S2C_FAULT_INJECT also "
-                        "activates it")
+                        "an integer or inf. job_hang SLEEPS "
+                        "S2C_FAULT_HANG_S before raising (a wedged "
+                        "dispatch); serve_decode_ahead/journal_write are "
+                        "serve-runner-scope sites. Env S2C_FAULT_INJECT "
+                        "also activates it")
     return p
 
 
@@ -333,12 +337,69 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    action="store_false",
                    help="disable cross-job pipelining (job N+1's host "
                         "decode normally overlaps job N's device work)")
+    # --- survivability (sam2consensus_tpu/serve/{journal,health,admission}) ---
+    p.add_argument("--journal", dest="journal", default=None,
+                   help="crash-safe job journal directory: every job's "
+                        "lifecycle is durably recorded (atomic "
+                        "tmp+rename segments) and each job gets a "
+                        "per-job checkpoint home there, so a killed "
+                        "server restarted with the SAME command resumes "
+                        "the queue — committed jobs are skipped by "
+                        "output fingerprint, the in-flight job resumes "
+                        "from its checkpoint; zero lost, zero "
+                        "duplicated jobs.  Implies --no-decode-ahead "
+                        "(checkpoints need serial decode).  Outputs are "
+                        "written per job at commit time, not at queue "
+                        "end")
+    p.add_argument("--job-timeout", dest="job_timeout", type=float,
+                   default=None,
+                   help="per-job wall-clock deadline in seconds "
+                        "(env S2C_JOB_TIMEOUT): a job that overruns is "
+                        "abandoned and failed (under --on-device-error "
+                        "fallback it retries once on the ladder's host "
+                        "rung) while the server keeps draining the "
+                        "queue")
+    p.add_argument("--stall-timeout", dest="stall_timeout", type=float,
+                   default=None,
+                   help="hung-dispatch watchdog in seconds (env "
+                        "S2C_STALL_TIMEOUT): fail the in-flight job "
+                        "when no device dispatch completes for this "
+                        "long — catches a wedged XLA dispatch or a "
+                        "stuck decode thread long before a generous "
+                        "--job-timeout would.  Set it ABOVE the "
+                        "worst-case cold jit compile of one slab shape "
+                        "(compilation is silence to this watchdog; the "
+                        "persistent compile cache and --prewarm keep "
+                        "that small on warm servers)")
+    p.add_argument("--checkpoint-every", dest="checkpoint_every",
+                   type=int, default=2_000_000,
+                   help="journal mode: reads between a job's periodic "
+                        "checkpoint writes (bounds how much of the "
+                        "in-flight job a kill -9 re-runs); "
+                        "default=2000000")
+    p.add_argument("--max-queue", dest="max_queue", type=int, default=0,
+                   help="admission control: max jobs admitted per "
+                        "submission (0 = unbounded); overflow is "
+                        "rejected with reason queue_full "
+                        "(serve/admission_* counters)")
+    p.add_argument("--tenant", dest="tenant", default="",
+                   help="tenant label for every job of this invocation "
+                        "(admission quotas + degraded-tenant isolation; "
+                        "the API sets it per JobSpec)")
+    p.add_argument("--tenant-quota", dest="tenant_quota", type=int,
+                   default=0,
+                   help="admission control: max admitted jobs per "
+                        "tenant per submission (0 = unbounded)")
+    p.add_argument("--health-out", dest="health_out", default=None,
+                   help="write an atomic health/readiness snapshot "
+                        "(queue depth, in-flight job, heartbeat age, "
+                        "tenant rungs, journal position) to this path "
+                        "at every job boundary")
     # shared-flag defaults config_from_args expects but serve never
     # exposes (one-shot-only features)
     p.set_defaults(backend="jax", prefix="", profile_dir=None,
                    json_metrics=None, checkpoint_dir=None,
-                   checkpoint_every=2_000_000, paranoid=False,
-                   incremental=False, filename="")
+                   paranoid=False, incremental=False, filename="")
     return p
 
 
@@ -380,12 +441,22 @@ def serve_main(argv: List[str]) -> int:
             job_args.trace_out = f"{args.trace_out}.job{k}.json"
         cfg = config_from_args(job_args)
         specs.append(JobSpec(filename=path, config=cfg,
-                             job_id=f"job{k}:{os.path.basename(path)}"))
+                             job_id=f"job{k}:{os.path.basename(path)}",
+                             tenant=args.tenant))
 
     runner = ServeRunner(prewarm=args.prewarm,
-                         decode_ahead=args.decode_ahead, echo=echo)
+                         decode_ahead=args.decode_ahead, echo=echo,
+                         journal_dir=args.journal,
+                         job_timeout=args.job_timeout,
+                         stall_timeout=args.stall_timeout,
+                         max_queue=args.max_queue,
+                         tenant_quota=args.tenant_quota,
+                         health_out=args.health_out,
+                         fault_inject=args.fault_inject)
     echo(f"\nServing {len(specs)} job(s) on one warm backend"
          + (f" (jit cache: {runner.cache_dir})" if runner.cache_dir
+            else "")
+         + (f" (journal: {runner.journal.root})" if runner.journal
             else "") + "\n")
     results = runner.submit_jobs(specs)
     failed = 0
@@ -394,6 +465,10 @@ def serve_main(argv: List[str]) -> int:
             failed += 1
             print(f"job {res.job_id} FAILED: {res.error}",
                   file=sys.stderr)
+            continue
+        if res.resumed or res.output_paths:
+            # journal mode: the runner wrote (or a previous process
+            # already committed) this job's outputs at commit time
             continue
         write_outputs(res.fastas, spec.config.outfolder,
                       spec.config.prefix, spec.config.nchar,
@@ -404,6 +479,8 @@ def serve_main(argv: List[str]) -> int:
             echo("Run manifest written to "
                  + manifest_path_for(spec.config.metrics_out) + "\n")
     ov = runner.registry.value("serve/overlap_sec")
+    if args.health_out:
+        echo(f"Health snapshot at {args.health_out}")
     echo(f"Done: {len(results) - failed}/{len(results)} job(s) ok, "
          f"cross-job overlap {ov:.3f}s.\n")
     return 1 if failed else 0

@@ -252,9 +252,10 @@ def publish_stats_extra(extra: dict) -> None:
         if name.startswith("phase/") and name.endswith("_sec"):
             extra[name[len("phase/"):]] = round(value, 4)
         # the recovery story (retries, demotions, emergency checkpoints,
-        # injected faults) rides into --json-metrics/bench rows too, so
-        # a degraded run is visible from any artifact
-        elif name.startswith(("resilience/", "fault/")):
+        # injected faults, corrupt-checkpoint absorptions) rides into
+        # --json-metrics/bench rows too, so a degraded run is visible
+        # from any artifact
+        elif name.startswith(("resilience/", "fault/", "checkpoint/")):
             extra[name] = int(value)
         # the wire codec's compression story and the staging pipeline's
         # measured overlap (wire/bytes vs wire/raw_bytes is the ratio;
@@ -272,7 +273,9 @@ def publish_stats_extra(extra: dict) -> None:
     for gauge_name, extra_key in (("dispatch/tail", "tail_dispatch"),
                                   ("dispatch/pileup", "pileup_path"),
                                   ("wire/codec", "wire"),
-                                  ("pipeline/overlap", "pipeline")):
+                                  ("pipeline/overlap", "pipeline"),
+                                  ("serve/recovery", "serve_recovery"),
+                                  ("serve/watchdog", "serve_watchdog")):
         g = snap["gauges"].get(gauge_name)
         if g is not None and g.get("info"):
             extra[extra_key] = g["info"]

@@ -102,9 +102,16 @@ def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
             if k.startswith(("wire/", "pipeline/"))}
     # serve-mode amortization story: cross-job overlap seconds plus the
     # jit/persistent compile-cache hit counters that prove the warm
-    # path actually skipped work (empty dict for cold one-shot runs)
+    # path actually skipped work (empty dict for cold one-shot runs).
+    # Structured serve gauges ride along — serve/health (the runner's
+    # readiness snapshot at job start), serve/recovery (journal-resume
+    # provenance: what a restarted queue skipped and resumed),
+    # serve/watchdog (the deadline/stall verdict that abandoned a job)
     serve = {k: v for k, v in counters.items()
              if k.startswith(("serve/", "compile/"))}
+    for name, g in snap["gauges"].items():
+        if name.startswith("serve/") and g.get("info"):
+            serve[name] = g["info"]
     decisions = []
     for rec in ledger_records:
         d = rec.to_dict() if hasattr(rec, "to_dict") else dict(rec)

@@ -154,6 +154,15 @@ class MetricsRegistry:
                 return g.value
             return default
 
+    def info(self, name: str) -> Optional[dict]:
+        """A gauge's structured payload (``set_info``), or None — the
+        read side of decision/health records (serve health snapshots,
+        the manifest's serve section) without snapshotting the whole
+        registry."""
+        with self._lock:
+            g = self._gauges.get(name)
+            return dict(g.info) if g is not None and g.info else None
+
     def snapshot(self) -> dict:
         """One JSON-shaped dict of every instrument's current state."""
         with self._lock:

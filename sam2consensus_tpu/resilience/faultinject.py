@@ -17,6 +17,21 @@ site                  boundary
                       fires on the staging thread AND the consumer's
                       unstaged fallback, so a persistent fault walks
                       the ladder to the wire-free host rung)
+``serve_decode_ahead``the serve runner's decode-ahead thread, per
+                      decoded batch (serve/runner.py; checked against
+                      the RUNNER's queue-lifetime injector rather than
+                      the per-job one, so a spec's call counts stay
+                      deterministic across the queue)
+``journal_write``     a serve job-journal segment append
+                      (serve/journal.py; runner-scope injector too)
+``job_hang``          the per-unit device dispatch (next to
+                      ``accumulate``) — but instead of raising
+                      immediately, a firing rule SLEEPS
+                      ``S2C_FAULT_HANG_S`` seconds (default 3600)
+                      first, modeling a wedged XLA dispatch that never
+                      returns; the serve watchdog (serve/runner.py) is
+                      what is supposed to notice.  The rule's kind is
+                      what the sleep eventually raises, if it wakes.
 ====================  =====================================================
 
 Spec grammar (CLI ``--fault-inject`` or env ``S2C_FAULT_INJECT``;
@@ -52,7 +67,20 @@ import zlib
 from typing import Dict, List, Optional
 
 SITES = ("device_put", "pileup_dispatch", "accumulate", "vote",
-         "insertion_build", "link_probe", "wire_encode")
+         "insertion_build", "link_probe", "wire_encode",
+         "serve_decode_ahead", "journal_write", "job_hang")
+
+#: how long a firing ``job_hang`` rule sleeps before raising (seconds);
+#: far past any sane --job-timeout, so the watchdog always wins the race
+DEFAULT_HANG_S = 3600.0
+
+
+def _hang_seconds() -> float:
+    try:
+        return max(0.0, float(os.environ.get("S2C_FAULT_HANG_S",
+                                             DEFAULT_HANG_S)))
+    except ValueError:
+        return DEFAULT_HANG_S
 
 KINDS = ("rpc", "timeout", "oom", "fatal", "trace")
 
@@ -231,8 +259,20 @@ class FaultInjector:
             reg = obs.metrics()
             reg.add("fault/injected", 1)
             reg.add(f"fault/injected/{site}", 1)
+            hang = _hang_seconds() if site == "job_hang" else 0.0
             obs.tracer().event("fault/injected", site=site,
-                               kind=rule.kind, call=n)
+                               kind=rule.kind, call=n,
+                               **({"hang_s": hang} if hang else {}))
+            if hang:
+                # the wedged-dispatch model: counters/trace record the
+                # injection FIRST (the thread is about to stop making
+                # progress), then the dispatch just... doesn't return.
+                # The serve watchdog abandons the thread long before
+                # the sleep expires; if it ever wakes, the kind's
+                # exception surfaces like any other injected fault.
+                import time
+
+                time.sleep(hang)
             raise exc
 
 

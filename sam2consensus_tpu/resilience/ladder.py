@@ -92,6 +92,33 @@ def job_rungs(snapshot: dict) -> dict:
     return rungs
 
 
+def job_host_rung_config(cfg):
+    """The JOB-level demotion: a whole-job re-run pinned to the
+    ladder's bottom rung (host pileup, plain packed5 wire, single
+    shard).  Used by the serve watchdog after a hang — a wedged
+    dispatch says nothing about WHICH device stage wedged, so the only
+    rung known to avoid it is the one that never touches the device
+    path at all — and by admission control to keep a degraded tenant's
+    jobs off the fleet's device path (serve/admission.py)."""
+    import dataclasses
+
+    return dataclasses.replace(cfg, pileup="host", wire="packed5",
+                               shards=1, shard_mode="auto")
+
+
+def record_job_demotion(registry, reason: str) -> None:
+    """Mark a registry (a serve job's) as having run on the job-level
+    host rung, in the same ``resilience/ladder/pileup`` gauge shape
+    :func:`job_rungs` reads — so a watchdog-retried or tenant-pinned
+    job shows ``rungs == {"pileup": "host"}`` exactly like an in-run
+    ladder demotion would."""
+    registry.add("resilience/demotions", 1)
+    registry.add("resilience/demotions/job", 1)
+    registry.gauge("resilience/ladder/pileup").set_info(
+        {"from": "device", "to": "host", "reason": reason,
+         "emergency_checkpoint": False, "job_level": True})
+
+
 def pileup_level(acc) -> str:
     """Name the accumulation rung ``acc`` currently sits on."""
     from ..ops.pileup import HostPileupAccumulator, PileupAccumulator
@@ -251,7 +278,10 @@ class ResilientDispatcher:
 
         if not isinstance(self._acc, HostPileupAccumulator):
             # the host rung carries no injection sites: it IS the
-            # bottom of the ladder
+            # bottom of the ladder.  job_hang sits on the same device
+            # boundary but SLEEPS instead of raising (a wedged XLA
+            # dispatch, faultinject.py) — the serve watchdog's prey.
+            faultinject.fault_check("job_hang")
             faultinject.fault_check("accumulate")
         self._acc.add(unit)
 

@@ -74,6 +74,20 @@ class AttemptDeadlineExceeded(TimeoutError):
     transient: a hung tunnel round trip looks exactly like this)."""
 
 
+class JobDeadlineExceeded(TimeoutError):
+    """A serve-mode JOB overran its ``--job-timeout`` wall-clock budget
+    (serve/runner.py watchdog).  TimeoutError => classified TRANSIENT:
+    the job-level ladder may re-run the job on the host rung, but the
+    fleet (the warm server and its queue) is never torn down for it."""
+
+
+class HungDispatchError(TimeoutError):
+    """The serve watchdog saw no dispatch-interval heartbeat for longer
+    than the stall budget: a device dispatch (or the decode feeding it)
+    is wedged, not slow.  TimeoutError => TRANSIENT, same job-level
+    handling as :class:`JobDeadlineExceeded`."""
+
+
 def classify(exc: BaseException) -> str:
     """Map an exception to TRANSIENT / CAPACITY / FATAL / PASSTHROUGH."""
     if isinstance(exc, (InjectedRpcError, InjectedTimeoutError)):

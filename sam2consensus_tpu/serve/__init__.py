@@ -32,8 +32,36 @@ Failure semantics: a job that raises is returned as a failed
 :class:`JobResult` (``error`` set, ``fastas`` None) and the server
 stays warm for the remaining queue; nothing a failing job demoted or
 configured (ladder rung, fault spec, registry) outlives its run.
+
+Survivability layer (the serve-level analogue of PR 2's device-path
+resilience; all opt-in):
+
+* :mod:`.journal` — a crash-safe job journal (append-only JSONL over
+  atomic tmp+rename segments): ``s2c serve --journal DIR`` survives
+  ``kill -9`` mid-queue, skipping committed jobs by output fingerprint
+  and resuming the in-flight job from its per-job checkpoint — zero
+  lost, zero duplicated jobs;
+* per-job deadlines + a hung-dispatch watchdog (``--job-timeout`` /
+  S2C_JOB_TIMEOUT, ``--stall-timeout`` / S2C_STALL_TIMEOUT): a wedged
+  XLA dispatch or stuck decode-ahead thread fails ONLY its job
+  (classified via resilience/policy.py; under ``--on-device-error
+  fallback`` the job retries once on the ladder's host rung) while the
+  server keeps draining;
+* :mod:`.admission` — bounded-queue admission control with
+  reject-with-reason (``serve/admission_*`` counters), per-tenant
+  quotas, and degraded-tenant pinning (``JobSpec.tenant``) so one
+  tenant's cursed inputs never demote the fleet;
+* :mod:`.health` — an atomic health/readiness snapshot
+  (``--health-out``; also embedded in each job's manifest ``serve``
+  section): queue depth, in-flight job, heartbeat age, per-tenant
+  rungs, journal position.
 """
 
+from .admission import AdmissionController
+from .health import snapshot as health_snapshot
+from .journal import JobJournal, job_key
 from .runner import JobResult, JobSpec, ServeRunner, submit_jobs
 
-__all__ = ["JobSpec", "JobResult", "ServeRunner", "submit_jobs"]
+__all__ = ["JobSpec", "JobResult", "ServeRunner", "submit_jobs",
+           "JobJournal", "job_key", "AdmissionController",
+           "health_snapshot"]

@@ -1,0 +1,110 @@
+"""Admission control: a bounded queue that sheds load instead of dying.
+
+ROADMAP item 2(b): at fleet scale the failure mode of an unbounded
+queue is not slowness, it is an OOM'd server taking every queued job
+with it — and the failure mode of shared tenancy is one tenant's
+degraded jobs dragging the warm device path through retry/demotion
+cycles for everyone.  This module makes both decisions explicit and
+auditable:
+
+* **bounded queue** — at most ``max_queue`` jobs are admitted per
+  submission window (0 = unbounded); overflow is rejected with reason
+  ``queue_full`` rather than silently buffered.  Rejection IS the
+  backpressure signal: the submitter sees it immediately and can
+  re-offer the job later, instead of discovering an hour later that
+  the queue never drained;
+* **per-tenant quotas** — at most ``tenant_quota`` admitted jobs per
+  tenant per window (0 = unbounded), reason ``tenant_quota``: one
+  tenant cannot occupy the whole queue;
+* **degraded-tenant pinning** — a tenant whose previous job ended on a
+  demoted ladder rung (``resilience.ladder.job_rungs``) gets its NEXT
+  jobs admitted but PINNED to the host rung
+  (``ladder.job_host_rung_config``): the jobs still run — byte
+  identity is rung-independent — but they never touch the fleet's
+  device path, so a tenant with a poisoned input or a cursed shape
+  cannot demote the fleet.  A pinned job that completes cleanly clears
+  the tenant back to the fast path (one good job is the probation).
+
+Every decision is a counter: ``serve/admission_admitted``,
+``serve/admission_rejected`` (+ ``/<reason>``), ``serve/admission_pinned``
+— surfaced through ``publish_stats_extra`` and the manifest ``serve``
+section like every other serve counter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+REASON_QUEUE_FULL = "queue_full"
+REASON_TENANT_QUOTA = "tenant_quota"
+
+
+@dataclass
+class Decision:
+    """One spec's admission verdict.  Pinning is deliberately NOT part
+    of this record: it is decided at JOB-START time via
+    :meth:`AdmissionController.pin_rung`, so a tenant degraded by an
+    earlier job of the same batch still pins the later ones."""
+
+    admitted: bool
+    reason: Optional[str] = None        # set iff rejected
+
+
+@dataclass
+class AdmissionController:
+    """Window-scoped bounds + queue-lifetime tenant state.
+
+    ``admit`` is called per spec in submission order; ``open_window``
+    resets the per-window counts (the serve runner opens one window per
+    ``submit_jobs`` batch).  Tenant degradation state intentionally
+    SURVIVES windows — that is the isolation story."""
+
+    max_queue: int = 0
+    tenant_quota: int = 0
+    _window_admitted: int = 0
+    _window_by_tenant: Dict[str, int] = field(default_factory=dict)
+    #: tenant -> rung its last degraded job landed on ("host"/"device_scatter")
+    tenant_rungs: Dict[str, str] = field(default_factory=dict)
+
+    def open_window(self) -> None:
+        self._window_admitted = 0
+        self._window_by_tenant = {}
+
+    def admit(self, tenant: str = "") -> Decision:
+        if self.max_queue and self._window_admitted >= self.max_queue:
+            return Decision(False, reason=REASON_QUEUE_FULL)
+        if (self.tenant_quota and tenant
+                and self._window_by_tenant.get(tenant, 0)
+                >= self.tenant_quota):
+            return Decision(False, reason=REASON_TENANT_QUOTA)
+        self._window_admitted += 1
+        if tenant:
+            self._window_by_tenant[tenant] = \
+                self._window_by_tenant.get(tenant, 0) + 1
+        return Decision(True)
+
+    def pin_rung(self, tenant: str) -> Optional[str]:
+        """The rung a tenant's next job must run on (None = fast path).
+        Consulted at JOB-START time, not admission time — a tenant
+        degraded by job k must see job k+1 pinned even when both were
+        admitted in the same batch."""
+        return self.tenant_rungs.get(tenant) if tenant else None
+
+    def note_result(self, tenant: str, rungs: dict, ok: bool,
+                    was_pinned: bool) -> None:
+        """Feed a finished job's outcome back into tenant state.
+
+        A job that ended demoted marks its tenant degraded (its next
+        jobs run pinned).  A PINNED job that completed cleanly is the
+        probation pass: the tenant returns to the fast path.  Failed
+        pinned jobs stay pinned — the bottom rung failing is not
+        evidence the device path would fare better."""
+        if not tenant:
+            return
+        if rungs and not was_pinned:
+            # deepest rung wins the record: host < device_scatter
+            rung = rungs.get("pileup") or rungs.get("tail") or "host"
+            self.tenant_rungs[tenant] = rung
+        elif was_pinned and ok:
+            self.tenant_rungs.pop(tenant, None)

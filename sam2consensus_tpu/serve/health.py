@@ -1,0 +1,105 @@
+"""Health/readiness snapshot of a serve runner.
+
+One JSON-shaped answer to "is this server alive and where is it?" —
+the thing an external prober, a fleet scheduler, or a human with a
+wedged queue actually needs, assembled from state the runner already
+keeps:
+
+* queue depth and the in-flight job (id + how long it has been
+  running);
+* last-heartbeat age — the newest of job-start / dispatch-interval /
+  job-end timestamps; a growing age with an in-flight job is the
+  wedged-dispatch signature the watchdog acts on;
+* per-tenant ladder rungs (admission control's isolation state);
+* journal position (last seq, committed/inflight counts) when a
+  journal is attached;
+* lifetime job counts and the admission counters.
+
+Exposure: ``s2c serve --health-out PATH`` rewrites the snapshot
+atomically (tmp + ``os.replace``, so a reader never sees a torn file)
+at queue start, after every job, and at queue end; the same snapshot
+is embedded in each job's manifest ``serve`` section via the
+``serve/health`` gauge.  Schema ``s2c-health/1``; consumers must
+tolerate added keys.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+SCHEMA = "s2c-health/1"
+
+
+@dataclass
+class HealthState:
+    """The runner-side mutable state snapshots are cut from.
+
+    ``beat()`` timestamps use ``time.monotonic`` (ages must survive
+    wall-clock jumps); ``started_unix`` is wall-clock for humans."""
+
+    started_unix: float = field(default_factory=time.time)
+    _started_mono: float = field(default_factory=time.monotonic)
+    queue_depth: int = 0
+    in_flight: Optional[str] = None
+    in_flight_since: Optional[float] = None     # monotonic
+    last_beat: float = field(default_factory=time.monotonic)
+
+    def beat(self) -> None:
+        self.last_beat = time.monotonic()
+
+    def job_started(self, job_id: str) -> None:
+        self.in_flight = job_id
+        self.in_flight_since = time.monotonic()
+        self.beat()
+
+    def job_finished(self) -> None:
+        self.in_flight = None
+        self.in_flight_since = None
+        self.beat()
+
+
+def snapshot(runner) -> dict:
+    """Cut a health snapshot from a :class:`~.runner.ServeRunner`."""
+    h = runner.health
+    now = time.monotonic()
+    reg = runner.registry
+    snap = {
+        "schema": SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "uptime_sec": round(now - h._started_mono, 3),
+        "queue_depth": h.queue_depth,
+        "in_flight": h.in_flight,
+        "in_flight_sec": round(now - h.in_flight_since, 3)
+        if h.in_flight_since is not None else None,
+        "last_heartbeat_age_sec": round(now - h.last_beat, 3),
+        "jobs": {
+            "run": int(reg.value("serve/jobs")),
+            "failed": int(reg.value("serve/jobs_failed")),
+            "resumed_skipped": int(reg.value("serve/resume_skipped")),
+            "watchdog_timeouts": int(reg.value("serve/watchdog_timeouts")),
+            "retries": int(reg.value("serve/job_retries")),
+        },
+        "admission": {
+            "admitted": int(reg.value("serve/admission_admitted")),
+            "rejected": int(reg.value("serve/admission_rejected")),
+            "pinned": int(reg.value("serve/admission_pinned")),
+        },
+        "tenant_rungs": dict(runner.admission.tenant_rungs),
+        "journal": runner.journal.position()
+        if runner.journal is not None else None,
+    }
+    return snap
+
+
+def write_health(path: str, snap: dict) -> None:
+    """Atomic rewrite: a prober polling the file never reads half a
+    snapshot (same tmp+replace discipline as the journal segments)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(snap, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    os.replace(tmp, path)

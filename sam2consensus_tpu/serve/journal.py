@@ -1,0 +1,283 @@
+"""Crash-safe job journal: a serve queue that survives ``kill -9``.
+
+The PR-5 runner kept the queue in process memory — a crash mid-queue
+lost every pending job and forgot which jobs already ran, so a naive
+re-launch either dropped work or ran it twice.  The journal makes the
+queue durable with the cheapest discipline that is actually
+crash-safe on POSIX: an append-only sequence of single-event JSON
+SEGMENTS, each written to a temp file and ``os.replace``d into place
+(the same atomicity utils/checkpoint.py relies on).  A ``kill -9`` at
+any instant leaves only whole events behind — there is no shared
+append file whose torn last line needs heuristic repair, and replay
+order is the segment sequence number, not mtime.
+
+Event vocabulary (one JSON object per segment)::
+
+    submitted  {job, key, filename, seq}
+    started    {job, key, ckpt}          # ckpt = per-job checkpoint dir
+    committed  {job, key, outputs: {path: "sha256:..."}, elapsed_sec}
+    failed     {job, key, error}
+    rejected   {job, key, reason}        # admission control audit
+    resumed    {job, key, mode}          # restart bookkeeping (audit)
+
+A job's IDENTITY (``key``) hashes its input path plus every config
+field that changes the output bytes — so a restarted server given the
+same queue recognizes its jobs even though Python object identity is
+gone, while a changed threshold/outfolder reads as a different job.
+
+Replay semantics (:meth:`JobJournal.replay`):
+
+* a key whose last lifecycle event is ``committed`` AND whose recorded
+  output files still match their fingerprints is SKIPPED on restart
+  (zero duplicated jobs — the fingerprint is the audit, not trust);
+* a key with ``started`` but no terminal event was IN FLIGHT when the
+  process died: it re-runs, resuming from its per-job checkpoint dir
+  (the PR-2 emergency/periodic checkpoints) when one survived;
+* everything else re-runs from scratch (zero lost jobs).
+
+The ``journal_write`` fault-injection site fires on every segment
+append (resilience/faultinject.py; the serve runner checks it against
+its queue-lifetime injector).  An append failure is surfaced to the
+caller — the runner decides the policy (a failed COMMIT append leaves
+the job to be re-verified-by-fingerprint on the next restart, which is
+the safe direction: re-checking work is cheap, losing it is not).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger("sam2consensus_tpu.serve.journal")
+
+SCHEMA = "s2c-journal/1"
+
+#: fields of RunConfig that change the OUTPUT BYTES of a job — the job
+#: key hashes exactly these, so a re-queued job with a different
+#: threshold/outfolder is a different job, while backend-side knobs
+#: (pileup strategy, wire codec, retries) keep the same identity: they
+#: must produce byte-identical outputs anyway
+KEY_FIELDS = ("thresholds", "min_depth", "fill", "maxdel", "prefix",
+              "nchar", "outfolder", "py2_compat", "strict")
+
+#: lifecycle events; ``rejected``/``resumed`` are audit-only
+EVENTS = ("submitted", "started", "committed", "failed", "rejected",
+          "resumed")
+
+
+def job_key(filename: str, config) -> str:
+    """Stable identity of (input, output-relevant config)."""
+    cfg = {f: getattr(config, f, None) for f in KEY_FIELDS}
+    blob = json.dumps({"filename": os.path.abspath(filename), **cfg},
+                      sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+def file_sha256(path: str) -> Optional[str]:
+    try:
+        h = hashlib.sha256()
+        with open(path, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+        return "sha256:" + h.hexdigest()
+    except OSError:
+        return None
+
+
+@dataclass
+class ReplayState:
+    """What a restarted server knows about its queue."""
+
+    #: key -> the committed event dict (outputs fingerprints inside)
+    committed: Dict[str, dict] = field(default_factory=dict)
+    #: key -> last failure reason (terminal in its process; re-run-able)
+    failed: Dict[str, str] = field(default_factory=dict)
+    #: keys started but never committed/failed — in flight at the crash
+    inflight: Dict[str, dict] = field(default_factory=dict)
+    #: per-key count of committed events across the whole journal — the
+    #: duplication audit (anything > 1 means a job ran twice)
+    commit_counts: Dict[str, int] = field(default_factory=dict)
+    #: every key ever journaled as submitted (restart re-submits are
+    #: deduped against this)
+    submitted: set = field(default_factory=set)
+    last_seq: int = 0
+    events: int = 0
+    corrupt_segments: int = 0
+
+
+class JobJournal:
+    """Append-only journal over atomic single-event segments.
+
+    ``fault_cb`` (the serve runner's queue-lifetime injector hook) is
+    called with site ``journal_write`` before every append.
+    """
+
+    def __init__(self, root: str,
+                 fault_cb: Optional[Callable[[str], None]] = None):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.fault_cb = fault_cb
+        self._seq = self._max_seq() + 1
+        #: in-memory mirror of ReplayState, maintained incrementally by
+        #: append() so position() (called at every health publish) does
+        #: not re-read the whole segment directory per job
+        self._mirror: Optional[ReplayState] = None
+
+    # -- segment mechanics -------------------------------------------------
+    def _seg_path(self, seq: int) -> str:
+        return os.path.join(self.root, f"ev-{seq:08d}.json")
+
+    def _segments(self) -> List[str]:
+        try:
+            names = sorted(n for n in os.listdir(self.root)
+                           if n.startswith("ev-") and n.endswith(".json"))
+        except OSError:
+            return []
+        return [os.path.join(self.root, n) for n in names]
+
+    def _max_seq(self) -> int:
+        top = 0
+        for p in self._segments():
+            try:
+                top = max(top, int(os.path.basename(p)[3:-5]))
+            except ValueError:
+                continue
+        return top
+
+    def append(self, ev: str, **fields) -> int:
+        """Durably record one event; returns its sequence number.
+
+        tmp + fsync + ``os.replace``: after this returns, the event
+        survives ``kill -9``; if the process dies inside, the journal
+        simply does not contain the event — never half of it."""
+        assert ev in EVENTS, ev
+        if self.fault_cb is not None:
+            self.fault_cb("journal_write")
+        seq = self._seq
+        rec = {"schema": SCHEMA, "seq": seq, "ev": ev,
+               "t": round(time.time(), 3), **fields}
+        path = self._seg_path(seq)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(rec, fh, sort_keys=True)
+            fh.write("\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        self._seq = seq + 1
+        if self._mirror is not None:    # keep the cheap mirror current
+            self._apply(self._mirror, rec)
+        return seq
+
+    def events(self) -> List[dict]:
+        """Every readable event in sequence order; corrupt/truncated
+        segments (possible only from external damage — appends are
+        atomic) are skipped with a warning, not raised."""
+        out: List[dict] = []
+        for p in self._segments():
+            try:
+                with open(p, encoding="utf-8") as fh:
+                    out.append(json.load(fh))
+            except Exception as exc:
+                logger.warning("journal segment %s unreadable (%s: %s): "
+                               "skipped", p, type(exc).__name__, exc)
+                out.append({"ev": "_corrupt", "path": p})
+        return out
+
+    # -- replay ------------------------------------------------------------
+    @staticmethod
+    def _apply(st: ReplayState, rec: dict) -> None:
+        """One event's state transition — shared by the full-disk replay
+        and the incremental in-memory mirror, so they cannot drift."""
+        ev = rec.get("ev")
+        if ev == "_corrupt":
+            st.corrupt_segments += 1
+            return
+        st.events += 1
+        st.last_seq = max(st.last_seq, int(rec.get("seq", 0)))
+        key = rec.get("key")
+        if not key:
+            return
+        if ev == "submitted":
+            st.submitted.add(key)
+        elif ev == "started":
+            st.inflight[key] = rec
+            st.failed.pop(key, None)
+        elif ev == "committed":
+            st.committed[key] = rec
+            st.inflight.pop(key, None)
+            st.failed.pop(key, None)
+            st.commit_counts[key] = st.commit_counts.get(key, 0) + 1
+        elif ev == "failed":
+            st.failed[key] = str(rec.get("error", ""))
+            st.inflight.pop(key, None)
+
+    def replay(self) -> ReplayState:
+        import copy
+
+        st = ReplayState()
+        for rec in self.events():
+            self._apply(st, rec)
+        # the mirror must be a SEPARATE copy: later appends update it
+        # incrementally, and mutating the state just handed to the
+        # caller would corrupt its view (the runner reads replay()
+        # AFTER journaling the new queue as submitted)
+        self._mirror = copy.deepcopy(st)
+        return st
+
+    def verify_outputs(self, committed_rec: dict) -> bool:
+        """True iff every output file the commit recorded still exists
+        with its recorded fingerprint — the skip-on-restart gate.  A
+        missing or drifted file re-runs the job (the journal is an
+        audit trail, not a trust store)."""
+        outputs = committed_rec.get("outputs") or {}
+        if not outputs:
+            return False
+        # a null recorded fingerprint (commit-time hash failure) must
+        # NOT match a null re-hash of a missing file — unknown never
+        # verifies, the job re-runs
+        return all(want is not None and file_sha256(p) == want
+                   for p, want in outputs.items())
+
+    # -- per-job checkpoint homes ------------------------------------------
+    def ckpt_dir(self, key: str) -> str:
+        """The PR-2 checkpoint home the runner assigns a journaled job
+        (created lazily by the checkpoint writer)."""
+        return os.path.join(self.root, "ckpt", key)
+
+    def drop_ckpt(self, key: str) -> None:
+        """A committed job's checkpoint is dead weight: remove it."""
+        d = self.ckpt_dir(key)
+        if os.path.isdir(d):
+            shutil.rmtree(d, ignore_errors=True)
+
+    # -- health / audit ----------------------------------------------------
+    def position(self) -> dict:
+        """The journal's place in the world, for health snapshots.
+        Served from the in-memory mirror (one full replay at first use,
+        incremental per append after) — health publishes happen at
+        every job boundary, and re-reading the whole segment directory
+        each time would grow per-job cost linearly with history."""
+        st = self._mirror if self._mirror is not None else self.replay()
+        return {"root": self.root, "last_seq": st.last_seq,
+                "events": st.events, "committed": len(st.committed),
+                "inflight": len(st.inflight), "failed": len(st.failed),
+                "corrupt_segments": st.corrupt_segments}
+
+    def audit(self) -> dict:
+        """Duplication/loss audit over the whole journal: per-key commit
+        counts plus the set of keys ever submitted — the chaos-soak
+        harness asserts ``max(commit_counts.values()) <= 1`` per cycle
+        and ``submitted ⊆ committed`` at cycle end."""
+        st = self.replay()
+        return {"submitted": sorted(st.submitted),
+                "commit_counts": dict(st.commit_counts),
+                "duplicated": sorted(k for k, n in st.commit_counts.items()
+                                     if n > 1),
+                "lost": sorted(st.submitted - set(st.committed))}

@@ -18,10 +18,39 @@ docstring for the design; the load-bearing pieces here are:
 * prewarm — ``ops.pileup.prewarm_scatter`` over the layout's canonical
   slab shapes, bound to the SERVER registry so per-job registries show
   prewarmed shapes as pure ``compile/jit_cache_hit``s.
+
+Survivability layer (this PR), all opt-in and orthogonal to the warm
+path:
+
+* **journal** (``journal_dir=``/``--journal``) — every job's lifecycle
+  is durably recorded (serve/journal.py) and each journaled job gets a
+  per-job PR-2 checkpoint home, so a ``kill -9`` mid-queue costs at
+  most the uncheckpointed part of ONE job: a restarted server skips
+  committed jobs by output fingerprint and resumes the in-flight one
+  from its checkpoint.  Journal mode writes each job's outputs itself
+  (commit = outputs durably on disk) and disables decode-ahead —
+  checkpoint consistency requires serial decode (the same reason the
+  one-shot CLI forces it);
+* **watchdog** (``job_timeout=``/``--job-timeout``/``S2C_JOB_TIMEOUT``,
+  plus ``stall_timeout``/``S2C_STALL_TIMEOUT``) — jobs run on a worker
+  thread monitored against a wall-clock deadline AND a dispatch
+  heartbeat (the dispatch-interval log the runner already keeps for
+  the overlap join).  A wedged dispatch fails ONLY its job
+  (classified via resilience/policy.py; under ``--on-device-error
+  fallback`` the job retries once on the ladder's host rung), and the
+  server keeps draining the queue;
+* **admission control** (``max_queue=``/``tenant_quota=``,
+  serve/admission.py) — bounded submission with reject-with-reason,
+  per-tenant quotas, and degraded-tenant pinning riding
+  ``ladder.job_rungs``;
+* **health** (serve/health.py) — a readiness snapshot cut at every job
+  boundary, written atomically to ``--health-out`` and embedded in
+  each job's manifest ``serve`` section.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 import threading
@@ -33,6 +62,9 @@ from .. import observability as obs
 from ..config import RunConfig
 from ..observability import jitcache
 from ..observability.metrics import MetricsRegistry
+from . import health as shealth
+from . import journal as sjournal
+from .admission import AdmissionController
 
 logger = logging.getLogger("sam2consensus_tpu.serve")
 
@@ -40,6 +72,10 @@ logger = logging.getLogger("sam2consensus_tpu.serve")
 #: (each batch is ~chunk_reads rows).  Past the cap the remainder
 #: decodes lazily inside the job's own run, exactly like a cold run.
 DEFAULT_AHEAD_BATCHES = 64
+
+#: watchdog poll period — cheap (a thread join with timeout), frequent
+#: enough that a 1 s --job-timeout overshoots by at most ~10%
+WATCHDOG_POLL_S = 0.1
 
 
 def _ahead_batch_cap() -> int:
@@ -50,6 +86,17 @@ def _ahead_batch_cap() -> int:
         return DEFAULT_AHEAD_BATCHES
 
 
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("%s=%r is not a number: ignored", name, raw)
+        return None
+
+
 @dataclass
 class JobSpec:
     """One consensus job: an input path plus its full RunConfig.
@@ -57,12 +104,15 @@ class JobSpec:
     ``config.backend`` is ignored (the server IS the jax backend);
     checkpoint/incremental modes are rejected — their contract is
     serial decode with stream-consistent snapshots, which serve-mode
-    decode-ahead would break."""
+    decode-ahead would break (journal mode manages per-job checkpoints
+    itself, with decode-ahead off).  ``tenant`` scopes admission
+    quotas and degraded-tenant pinning ("" = untenanted)."""
 
     filename: str
     config: RunConfig = field(default_factory=lambda: RunConfig(
         backend="jax"))
     job_id: str = ""
+    tenant: str = ""
 
 
 @dataclass
@@ -85,6 +135,15 @@ class JobResult:
     #: degradation rungs this job ended on ({} = never demoted)
     rungs: dict = field(default_factory=dict)
     manifest: Optional[dict] = None
+    #: journal resume: True = skipped because a previous process
+    #: committed this job and its outputs still fingerprint-match
+    resumed: bool = False
+    #: output files this job's commit wrote (journal mode only — the
+    #: runner writes outputs there so commit == durably on disk)
+    output_paths: List[str] = field(default_factory=list)
+    #: admission verdict: None = admitted clean, "pinned:<rung>" =
+    #: admitted on the tenant's demoted rung, else the reject reason
+    admission: Optional[str] = None
 
     @property
     def ok(self) -> bool:
@@ -124,10 +183,15 @@ class _PredecodedJob:
 
 
 class _DecodeAhead:
-    """Decode one job's input on a daemon thread, instruments bound."""
+    """Decode one job's input on a daemon thread, instruments bound.
+
+    ``fault_cb`` is the runner's queue-lifetime injector hook — the
+    ``serve_decode_ahead`` site fires per decoded batch (and before
+    the header parse, so call 0 models a poisoned open)."""
 
     def __init__(self, backend, spec: JobSpec,
-                 robs: "obs.RunObservability", cap: int):
+                 robs: "obs.RunObservability", cap: int,
+                 fault_cb: Optional[Callable[[str], None]] = None):
         self.spec = spec
         self.robs = robs
         self.contigs = None
@@ -138,6 +202,7 @@ class _DecodeAhead:
         self.error: Optional[BaseException] = None
         self._backend = backend
         self._cap = cap
+        self._fault_cb = fault_cb
         self._lock = threading.Lock()
         self._intervals: List[Tuple[float, float]] = []
         self._handle = None
@@ -162,6 +227,8 @@ class _DecodeAhead:
             tr = obs.tracer()
             tr.name_thread("serve-decode-ahead")
             try:
+                if self._fault_cb is not None:
+                    self._fault_cb("serve_decode_ahead")
                 handle = opener(self.spec.filename, binary=True)
                 self._handle = handle
                 contigs, _n, first = read_header(handle)
@@ -176,6 +243,8 @@ class _DecodeAhead:
                 self.stream = stream
                 self.contigs = contigs
                 while len(self.done_batches) < self._cap:
+                    if self._fault_cb is not None:
+                        self._fault_cb("serve_decode_ahead")
                     with tr.span("decode"):
                         t0 = time.perf_counter()
                         try:
@@ -214,11 +283,25 @@ class ServeRunner:
     (keeping only the compile-cache wins).  ``persistent_cache``
     controls the on-disk jax compilation cache
     (``observability/jitcache.py``; S2C_JIT_CACHE overrides).
+
+    Survivability knobs (all default-off; see the module docstring):
+    ``journal_dir``, ``job_timeout``/``stall_timeout`` (env
+    S2C_JOB_TIMEOUT / S2C_STALL_TIMEOUT when None), ``max_queue``,
+    ``tenant_quota``, ``health_out``, and ``fault_inject`` — the
+    runner-scope injector spec for the serve-level sites
+    (serve_decode_ahead / journal_write; env S2C_FAULT_INJECT when
+    empty).
     """
 
     def __init__(self, prewarm: str = "auto", decode_ahead: bool = True,
                  persistent_cache: bool = True,
-                 echo: Optional[Callable] = None):
+                 echo: Optional[Callable] = None,
+                 journal_dir: Optional[str] = None,
+                 job_timeout: Optional[float] = None,
+                 stall_timeout: Optional[float] = None,
+                 max_queue: int = 0, tenant_quota: int = 0,
+                 health_out: Optional[str] = None,
+                 fault_inject: str = ""):
         from ..backends.jax_backend import JaxBackend
 
         if prewarm not in ("auto", "off"):
@@ -237,6 +320,29 @@ class ServeRunner:
         self._prewarm_stop = threading.Event()
         self.cache_dir = jitcache.setup_persistent_cache() \
             if persistent_cache else None
+        # -- survivability state --------------------------------------
+        self.job_timeout = job_timeout if job_timeout is not None \
+            else _env_float("S2C_JOB_TIMEOUT")
+        self.stall_timeout = stall_timeout if stall_timeout is not None \
+            else _env_float("S2C_STALL_TIMEOUT")
+        self.admission = AdmissionController(max_queue=max_queue,
+                                             tenant_quota=tenant_quota)
+        self.health = shealth.HealthState()
+        self.health_out = health_out
+        self._fault = self._build_fault_injector(fault_inject)
+        self.journal: Optional[sjournal.JobJournal] = None
+        if journal_dir:
+            self.journal = sjournal.JobJournal(journal_dir,
+                                               fault_cb=self._fault_check)
+            if self.decode_ahead:
+                # checkpoint consistency requires serial decode (the
+                # stream offset snapshotted must match the batches
+                # already committed to counts) — same contract that
+                # makes the one-shot CLI serialize under
+                # --checkpoint-dir.  Survivability buys it here.
+                logger.info("journal mode: decode-ahead disabled "
+                            "(per-job checkpoints need serial decode)")
+                self.decode_ahead = False
         # a daemon thread killed MID-XLA-COMPILE at interpreter exit
         # aborts the whole process from C++ ("terminate called without
         # an active exception"); close() stops the prewarm loop at the
@@ -245,6 +351,30 @@ class ServeRunner:
         import atexit
 
         atexit.register(self.close)
+
+    @staticmethod
+    def _build_fault_injector(spec: str):
+        from ..resilience.faultinject import FaultInjector, parse_spec
+
+        spec = spec or os.environ.get("S2C_FAULT_INJECT", "")
+        if not spec:
+            return None
+        try:
+            rules = parse_spec(spec)
+        except ValueError:
+            # a malformed env spec is the backend's problem to report
+            # (it validates per job); the runner-scope sites just stay
+            # silent rather than double-raising
+            return None
+        seed = int(os.environ.get("S2C_FAULT_SEED", "0"))
+        return FaultInjector(rules, seed=seed)
+
+    def _fault_check(self, site: str) -> None:
+        """Queue-lifetime injection for the serve-scope sites — call
+        counts survive across jobs (the per-run injector resets per
+        job, which would make ``journal_write:rpc:2`` meaningless)."""
+        if self._fault is not None:
+            self._fault.check(site)
 
     def close(self) -> None:
         """Stop background prewarm at the next shape boundary and wait
@@ -324,19 +454,18 @@ class ServeRunner:
 
     # -- per-job export destinations -------------------------------------
     def _job_out(self, cfg_value: Optional[str], env_name: str,
-                 index: int) -> Optional[str]:
+                 jobnum: int) -> Optional[str]:
         """A job's metrics/trace destination.  An explicit per-job
         config value wins untouched; an ENV-derived base (S2C_*_OUT)
         is suffixed per job — without this, every serve job would
         resolve to the same env path inside prepare_run and overwrite
-        the previous job's artifacts (mode 'w' exports).  ``index`` is
-        the offset from ``jobs_run`` AT CALL TIME (0 = the job about
-        to run, 1 = the decode-ahead next job)."""
+        the previous job's artifacts (mode 'w' exports).  ``jobnum``
+        is the job's absolute number across the server's lifetime."""
         if cfg_value:
             return cfg_value
         env = os.environ.get(env_name)
         if env:
-            return f"{env}.job{self.jobs_run + index}"
+            return f"{env}.job{jobnum}"
         return None
 
     # -- job validation --------------------------------------------------
@@ -351,48 +480,332 @@ class ServeRunner:
             raise ValueError(
                 "serve mode does not compose with --checkpoint-dir: "
                 "checkpoints need serial decode with stream-consistent "
-                "snapshots, which decode-ahead breaks; run checkpointed "
-                "jobs through the one-shot CLI")
+                "snapshots, which decode-ahead breaks; use --journal "
+                "for crash-safe serving (the runner manages per-job "
+                "checkpoints itself) or run checkpointed jobs through "
+                "the one-shot CLI")
         if spec.config.incremental:
             raise ValueError("serve mode does not compose with "
                              "--incremental (see --checkpoint-dir)")
+
+    # -- health -----------------------------------------------------------
+    def health_snapshot(self) -> dict:
+        return shealth.snapshot(self)
+
+    def _publish_health(self) -> None:
+        if self.health_out:
+            try:
+                shealth.write_health(self.health_out,
+                                     self.health_snapshot())
+            except OSError as exc:
+                logger.warning("health snapshot write failed: %s", exc)
+
+    # -- journal helpers ---------------------------------------------------
+    def _journal_append(self, ev: str, **fields) -> None:
+        """Append, absorbing write failures: a journal that cannot be
+        written must not kill the job whose work it records.  The safe
+        direction is re-RUNNING work on restart (a missing commit means
+        the job re-runs and re-fingerprints, byte-identical), never
+        skipping it — so append failures degrade durability, not
+        correctness, and they are loudly counted."""
+        if self.journal is None:
+            return
+        try:
+            self.journal.append(ev, **fields)
+        except Exception as exc:
+            self.registry.add("serve/journal_write_failed", 1)
+            logger.warning("journal append %s failed (%s: %s): the job "
+                           "will re-run on restart instead of resuming",
+                           ev, type(exc).__name__, exc)
+
+    # -- guarded execution (watchdog) --------------------------------------
+    def _execute(self, contigs, records, cfg, robs,
+                 dlog: List[Tuple[float, float]], job_id: str):
+        """Run one job through the backend — directly when no watchdog
+        is configured (zero extra threads, the PR-5 path), else on a
+        monitored worker thread.
+
+        The monitor enforces two independent bounds: total wall clock
+        (``job_timeout`` -> JobDeadlineExceeded) and dispatch-heartbeat
+        age (``stall_timeout`` -> HungDispatchError), the heartbeat
+        being the newest dispatch-interval end in ``dlog`` — the log
+        the runner already keeps for the overlap join.  On timeout the
+        worker is ABANDONED (daemon): a wedged XLA dispatch cannot be
+        interrupted from Python, only disowned.  The abandoned thread
+        keeps ITS job's instruments thread-bound
+        (``bind_run_to_thread``), so if it ever wakes it records into
+        its own registry, not the next job's."""
+        from ..resilience.policy import (HungDispatchError,
+                                         JobDeadlineExceeded)
+
+        self.backend.serve_prepared_obs = robs
+        self.backend.serve_dispatch_log = dlog
+        try:
+            if self.job_timeout is None and self.stall_timeout is None:
+                return self.backend.run(contigs, records, cfg)
+
+            box: list = []
+
+            def work():
+                with obs.bind_run_to_thread(robs):
+                    try:
+                        box.append(("ok", self.backend.run(
+                            contigs, records, cfg)))
+                    except BaseException as exc:
+                        box.append(("exc", exc))
+
+            t = threading.Thread(target=work, daemon=True,
+                                 name=f"serve-job-{job_id}")
+            start = time.perf_counter()
+            beats_seen = 0
+            t.start()
+            while t.is_alive() and not box:
+                t.join(WATCHDOG_POLL_S)
+                if box:
+                    break               # finished during the poll: a
+                    # result beats a deadline that expired in the race
+                now = time.perf_counter()
+                last = dlog[-1][1] if dlog else start
+                if len(dlog) > beats_seen:
+                    # beat only on NEW dispatch completions — a wedged
+                    # job's published heartbeat age must GROW (the
+                    # signature health.py documents for probers)
+                    beats_seen = len(dlog)
+                    self.health.beat()
+                if (self.job_timeout is not None
+                        and now - start > self.job_timeout):
+                    raise JobDeadlineExceeded(
+                        f"job {job_id} exceeded its "
+                        f"{self.job_timeout:.3g}s deadline "
+                        f"({len(dlog)} dispatches completed)")
+                if (self.stall_timeout is not None
+                        and now - max(last, start) > self.stall_timeout):
+                    raise HungDispatchError(
+                        f"job {job_id}: no dispatch heartbeat for "
+                        f"{now - max(last, start):.1f}s "
+                        f"(stall budget {self.stall_timeout:.3g}s, "
+                        f"{len(dlog)} dispatches completed)")
+            if not box:
+                t.join()
+            tag, val = box[0]
+            if tag == "exc":
+                raise val
+            return val
+        finally:
+            self.backend.serve_prepared_obs = None
+            self.backend.serve_dispatch_log = None
+
+    @staticmethod
+    def _join_ahead(ahead: "_DecodeAhead",
+                    stall_t: Optional[float]) -> None:
+        """Wait for a decode-ahead thread, declaring it wedged only
+        when it stops MAKING PROGRESS (no new decoded batch) for
+        ``stall_t`` — a large input decoding steadily is not a hang,
+        however long it takes.  ``stall_t`` None = wait forever (no
+        watchdog configured; the PR-5 behavior)."""
+        if stall_t is None:
+            ahead.thread.join()
+            return
+        last_n = -1
+        last_progress = time.perf_counter()
+        while ahead.thread.is_alive():
+            ahead.thread.join(min(0.5, stall_t / 4))
+            n = len(ahead.intervals())
+            now = time.perf_counter()
+            if n != last_n:
+                last_n = n
+                last_progress = now
+            elif now - last_progress > stall_t:
+                return                   # caller sees is_alive() == True
+
+    def _note_timeout(self, robs, exc, server: bool = True) -> None:
+        robs.registry.add("serve/watchdog_timeouts", 1)
+        robs.registry.gauge("serve/watchdog").set_info(
+            {"error": f"{type(exc).__name__}: {exc}",
+             "job_timeout_s": self.job_timeout,
+             "stall_timeout_s": self.stall_timeout})
+        if server:               # once per timeout, not once per registry
+            self.registry.add("serve/watchdog_timeouts", 1)
 
     # -- the queue -------------------------------------------------------
     def submit_jobs(self, specs: List[JobSpec]) -> List[JobResult]:
         """Run the queue; returns one :class:`JobResult` per spec, in
         order.  The server survives failed jobs (their error rides the
         result) and stays warm afterwards for the next submit."""
+        from ..io.fasta import write_outputs
         from ..io.sam import ReadStream, opener, read_header
         from ..resilience import ladder as rladder
         from ..wire.pipeline import intersect_sec
 
         for spec in specs:
             self._validate(spec)
+
+        # -- plan: admission + journal replay, before anything runs ---
+        replay = self.journal.replay() if self.journal is not None \
+            else None
+        self.admission.open_window()
+        jobs_base = self.jobs_run
+        plan: List[dict] = []           # one entry per spec, in order
+        n_skipped = 0
+        inflight_resumed: List[str] = []
+        for j, spec in enumerate(specs):
+            jobnum = jobs_base + j
+            job_id = spec.job_id or \
+                f"job{jobnum}:{os.path.basename(spec.filename)}"
+            key = sjournal.job_key(spec.filename, spec.config) \
+                if self.journal is not None else None
+            entry = {"spec": spec, "job_id": job_id, "key": key,
+                     "jobnum": jobnum, "action": "run", "cfg": spec.config,
+                     "admission": None, "resume_ckpt": False}
+            if replay is not None and key in replay.committed \
+                    and self.journal.verify_outputs(replay.committed[key]):
+                entry["action"] = "skip"
+                entry["outputs"] = \
+                    list(replay.committed[key].get("outputs", {}))
+                n_skipped += 1
+                plan.append(entry)
+                continue
+            dec = self.admission.admit(spec.tenant)
+            if not dec.admitted:
+                entry["action"] = "reject"
+                entry["admission"] = dec.reason
+                plan.append(entry)
+                continue
+            cfg = spec.config
+            if self.journal is not None:
+                cfg = dataclasses.replace(
+                    cfg, checkpoint_dir=self.journal.ckpt_dir(key))
+                if replay is not None and key in replay.inflight:
+                    entry["resume_ckpt"] = True
+                    inflight_resumed.append(job_id)
+            entry["cfg"] = cfg
+            plan.append(entry)
+
+        # durable queue: every to-run job is journaled as submitted
+        # BEFORE anything executes, so a crash during job 0 still
+        # remembers the whole queue
+        if self.journal is not None:
+            already = replay.submitted if replay is not None else set()
+            for entry in plan:
+                if entry["action"] == "run" \
+                        and entry["key"] not in already:
+                    self._journal_append(
+                        "submitted", job=entry["job_id"],
+                        key=entry["key"],
+                        filename=os.path.abspath(
+                            entry["spec"].filename),
+                        outfolder=entry["spec"].config.outfolder)
+            for entry in plan:
+                if entry["action"] == "skip":
+                    self._journal_append("resumed", job=entry["job_id"],
+                                         key=entry["key"],
+                                         mode="skipped")
+                elif entry["resume_ckpt"]:
+                    self._journal_append("resumed", job=entry["job_id"],
+                                         key=entry["key"],
+                                         mode="inflight")
+                elif entry["action"] == "reject":
+                    self._journal_append("rejected", job=entry["job_id"],
+                                         key=entry["key"],
+                                         reason=entry["admission"])
+        recovery_info = None
+        if replay is not None and replay.events:
+            recovery_info = {
+                "resumed": True,
+                "journal_last_seq": replay.last_seq,
+                "committed_skipped": n_skipped,
+                "inflight_resumed": inflight_resumed,
+            }
+            self.registry.gauge("serve/recovery").set_info(recovery_info)
+            self.registry.add("serve/resume_skipped", n_skipped)
+            self.registry.add("serve/resume_inflight",
+                              len(inflight_resumed))
+
+        self.health.queue_depth = sum(1 for e in plan
+                                      if e["action"] == "run")
+        self._publish_health()
+
         results: List[JobResult] = []
         ahead: Optional[_DecodeAhead] = None
+        ahead_for: Optional[int] = None
         cap = _ahead_batch_cap()
-        for i, spec in enumerate(specs):
-            job_id = spec.job_id or \
-                f"job{self.jobs_run}:{os.path.basename(spec.filename)}"
-            cfg = spec.config
+        first_run_seen = False
+        for i, entry in enumerate(plan):
+            spec = entry["spec"]
+            job_id = entry["job_id"]
+            cfg = entry["cfg"]
+            jobnum = entry["jobnum"]
+            # -- non-running entries -----------------------------------
+            if entry["action"] in ("skip", "reject"):
+                res = JobResult(job_id=job_id, filename=spec.filename,
+                                index=i)
+                if entry["action"] == "skip":
+                    res.resumed = True
+                    res.output_paths = entry.get("outputs", [])
+                    res.metrics = {"serve/resume_skipped": 1}
+                    self.echo(f"[serve] {job_id}: resumed (committed in "
+                              f"journal, outputs verified)")
+                else:
+                    reason = entry["admission"]
+                    res.admission = reason
+                    res.error = f"admission rejected: {reason}"
+                    self.registry.add("serve/admission_rejected", 1)
+                    self.registry.add(
+                        f"serve/admission_rejected/{reason}", 1)
+                    self.echo(f"[serve] {job_id}: REJECTED ({reason})")
+                results.append(res)
+                self.jobs_run += 1
+                continue
+            self.registry.add("serve/admission_admitted", 1)
+            # degraded-tenant isolation, decided at JOB-START time (a
+            # tenant degraded by the previous job of this very batch
+            # must already be pinned): the job runs, but on the rung
+            # its tenant already proved it needs — never on the
+            # fleet's device path
+            rung = self.admission.pin_rung(spec.tenant)
+            if rung is not None and cfg.pileup != "host":
+                cfg = rladder.job_host_rung_config(cfg)
+                entry["cfg"] = cfg
+                entry["admission"] = f"pinned:{rung}"
+            if entry["admission"]:       # pinned:<rung>
+                self.registry.add("serve/admission_pinned", 1)
             # -- job context: from the decode-ahead thread, or inline --
             close_handle = None
-            if ahead is not None:
-                ahead.thread.join()
-                robs = ahead.robs
-                contigs = ahead.contigs
-                records = _PredecodedJob(ahead)
-                header_err = ahead.error if contigs is None else None
-                close_handle = ahead.close
+            contigs = records = None
+            header_err = None
+            robs = None
+            if ahead is not None and ahead_for == i:
+                join_t = self.stall_timeout \
+                    if self.stall_timeout is not None else self.job_timeout
+                self._join_ahead(ahead, join_t)
+                if ahead.thread.is_alive():
+                    # the decode-ahead thread itself is wedged: this is
+                    # exactly the "stuck decode-ahead thread wedges the
+                    # whole server forever" bug — disown it and fail
+                    # only its job
+                    from ..resilience.policy import HungDispatchError
+
+                    header_err = HungDispatchError(
+                        f"job {job_id}: decode-ahead thread made no "
+                        f"progress within {join_t:.3g}s")
+                    robs = ahead.robs
+                    self._note_timeout(ahead.robs, header_err)
+                    close_handle = ahead.close
+                else:
+                    robs = ahead.robs
+                    contigs = ahead.contigs
+                    records = _PredecodedJob(ahead)
+                    header_err = ahead.error if contigs is None else None
+                    close_handle = ahead.close
             else:
+                if ahead is not None:
+                    ahead.close()        # stale (intervening skip/reject)
                 robs = obs.prepare_run(
                     trace_out=self._job_out(cfg.trace_out,
-                                            "S2C_TRACE_OUT", 0),
+                                            "S2C_TRACE_OUT", jobnum),
                     metrics_out=self._job_out(cfg.metrics_out,
-                                              "S2C_METRICS_OUT", 0),
+                                              "S2C_METRICS_OUT", jobnum),
                     config=cfg)
-                contigs = records = None
-                header_err = None
                 try:
                     handle = opener(spec.filename, binary=True)
                     close_handle = handle.close
@@ -401,46 +814,79 @@ class ServeRunner:
                 except Exception as exc:
                     header_err = exc
             ahead = None
-            if i == 0 and contigs is not None:
+            ahead_for = None
+            if not first_run_seen and contigs is not None:
                 from ..encoder.events import GenomeLayout
 
                 self._auto_prewarm(spec, GenomeLayout(contigs).total_len)
-            # -- launch the NEXT job's decode-ahead before running ----
-            if self.decode_ahead and i + 1 < len(specs):
-                nxt = specs[i + 1]
-                ahead = _DecodeAhead(
-                    self.backend, nxt,
-                    obs.prepare_run(
-                        trace_out=self._job_out(nxt.config.trace_out,
-                                                "S2C_TRACE_OUT", 1),
-                        metrics_out=self._job_out(
-                            nxt.config.metrics_out, "S2C_METRICS_OUT",
-                            1),
-                        config=nxt.config), cap)
+            first_run_seen = True
+            # -- launch the NEXT runnable job's decode-ahead -----------
+            if self.decode_ahead:
+                for k in range(i + 1, len(plan)):
+                    if plan[k]["action"] == "run":
+                        nxt = plan[k]
+                        ahead = _DecodeAhead(
+                            self.backend, JobSpec(
+                                filename=nxt["spec"].filename,
+                                config=nxt["cfg"],
+                                job_id=nxt["job_id"],
+                                tenant=nxt["spec"].tenant),
+                            obs.prepare_run(
+                                trace_out=self._job_out(
+                                    nxt["cfg"].trace_out,
+                                    "S2C_TRACE_OUT", nxt["jobnum"]),
+                                metrics_out=self._job_out(
+                                    nxt["cfg"].metrics_out,
+                                    "S2C_METRICS_OUT", nxt["jobnum"]),
+                                config=nxt["cfg"]), cap,
+                            fault_cb=self._fault_check
+                            if self._fault is not None else None)
+                        ahead_for = k
+                        break
             # -- run this job -----------------------------------------
+            if recovery_info is not None:
+                robs.registry.gauge("serve/recovery").set_info(
+                    recovery_info)
+            robs.registry.gauge("serve/health").set_info({
+                "queue_depth": self.health.queue_depth,
+                "in_flight": job_id,
+                "tenant_rungs": dict(self.admission.tenant_rungs),
+                **({"journal_last_seq": replay.last_seq}
+                   if replay is not None else {})})
             res = JobResult(job_id=job_id, filename=spec.filename,
-                            index=i)
+                            index=i, admission=entry["admission"])
             dlog: List[Tuple[float, float]] = []
+            self.health.job_started(job_id)
+            self._journal_append("started", job=job_id,
+                                 key=entry["key"],
+                                 ckpt=cfg.checkpoint_dir or "")
             t0 = time.perf_counter()
             if header_err is not None:
                 res.error = f"{type(header_err).__name__}: {header_err}"
                 if close_handle is not None:
                     close_handle()
             else:
-                self.backend.serve_prepared_obs = robs
-                self.backend.serve_dispatch_log = dlog
+                out = None
                 try:
-                    out = self.backend.run(contigs, records, cfg)
-                    res.fastas, res.stats = out.fastas, out.stats
+                    out = self._execute(contigs, records, cfg, robs,
+                                        dlog, job_id)
                 except Exception as exc:
-                    res.error = f"{type(exc).__name__}: {exc}"
-                    logger.warning("job %s failed: %s", job_id,
-                                   res.error)
+                    self._note_timeout_if_deadline(robs, exc)
+                    retry_cfg = self._retry_config(cfg, exc)
+                    if retry_cfg is not None:
+                        out, robs, res.error = self._retry_on_host_rung(
+                            spec, retry_cfg, exc, jobnum, job_id)
+                    else:
+                        res.error = f"{type(exc).__name__}: {exc}"
+                    if res.error is not None:
+                        logger.warning("job %s failed: %s", job_id,
+                                       res.error)
                 finally:
-                    self.backend.serve_prepared_obs = None
-                    self.backend.serve_dispatch_log = None
                     if close_handle is not None:
                         close_handle()
+                if out is not None:
+                    res.fastas, res.stats = out.fastas, out.stats
+                    res.error = None
             res.elapsed_sec = time.perf_counter() - t0
             snap = robs.registry.snapshot()
             res.metrics = {
@@ -449,11 +895,48 @@ class ServeRunner:
                                  "fault/", "phase/"))}
             res.rungs = rladder.job_rungs(snap)
             res.manifest = obs.last_manifest() if res.ok else None
+            # -- commit: outputs durably on disk, then the journal -----
+            if res.ok and res.fastas is not None \
+                    and self.journal is not None:
+                try:
+                    res.output_paths = write_outputs(
+                        res.fastas, cfg.outfolder, cfg.prefix,
+                        cfg.nchar, cfg.thresholds, echo=self.echo)
+                    fps = {p: sjournal.file_sha256(p)
+                           for p in res.output_paths}
+                except Exception as exc:
+                    # a commit-time write failure (disk full, bad
+                    # outfolder) fails THIS job, never the queue — the
+                    # server's survive-failed-jobs contract holds at
+                    # the commit boundary too
+                    res.error = (f"output commit failed: "
+                                 f"{type(exc).__name__}: {exc}")
+                    res.fastas = None
+                    res.output_paths = []
+                    logger.warning("job %s: %s", job_id, res.error)
+                else:
+                    self._journal_append(
+                        "committed", job=job_id, key=entry["key"],
+                        outputs=fps,
+                        elapsed_sec=round(res.elapsed_sec, 3))
+                    self.journal.drop_ckpt(entry["key"])
+            if not res.ok:
+                self._journal_append("failed", job=job_id,
+                                     key=entry["key"], error=res.error)
             results.append(res)
             self.jobs_run += 1
             self.registry.add("serve/jobs", 1)
             if not res.ok:
                 self.registry.add("serve/jobs_failed", 1)
+            self.admission.note_result(
+                spec.tenant, res.rungs, res.ok,
+                was_pinned=bool(entry["admission"]
+                                and str(entry["admission"]).startswith(
+                                    "pinned")))
+            self.health.job_finished()
+            self.health.queue_depth = max(
+                0, self.health.queue_depth - 1)
+            self._publish_health()
             # -- cross-job overlap: bill it to the job whose decode
             #    was hidden (N+1), before that job runs ---------------
             if ahead is not None:
@@ -469,7 +952,92 @@ class ServeRunner:
             self.echo(f"[serve] {job_id}: "
                       + (f"ok in {res.elapsed_sec:.2f}s"
                          if res.ok else f"FAILED ({res.error})"))
+        self._publish_health()
         return results
+
+    # -- job-level ladder --------------------------------------------------
+    def _retry_config(self, cfg: RunConfig,
+                      exc: BaseException) -> Optional[RunConfig]:
+        """The job-level demotion decision: a timed-out/hung/faulted
+        job may re-run ONCE, pinned to the ladder's host rung — only
+        under fallback mode (the same opt-in the in-run ladder uses),
+        only for device-shaped failures, and only when the job was not
+        already on the host rung."""
+        from ..resilience import ladder as rladder
+        from ..resilience.policy import PASSTHROUGH, classify
+
+        kind = classify(exc)
+        on_error = os.environ.get("S2C_ON_DEVICE_ERROR",
+                                  getattr(cfg, "on_device_error",
+                                          "retry"))
+        if on_error != "fallback" or kind == PASSTHROUGH:
+            return None
+        if cfg.pileup == "host":
+            return None                 # already on the bottom rung
+        return rladder.job_host_rung_config(cfg)
+
+    def _retry_on_host_rung(self, spec: JobSpec, cfg: RunConfig,
+                            exc: BaseException, jobnum: int,
+                            job_id: str):
+        """Re-run a failed job pinned to the host rung, with fresh
+        instruments (the abandoned attempt may still hold its own).
+        Returns ``(result_or_None, robs, error_or_None)``."""
+        from ..resilience import ladder as rladder
+        from ..io.sam import ReadStream, opener, read_header
+
+        self.registry.add("serve/job_retries", 1)
+        self.echo(f"[serve] {job_id}: retrying on the host rung "
+                  f"after {type(exc).__name__}")
+
+        def _suffix(p):
+            # the abandoned first attempt may still write its exports
+            # when/if it wakes — the retry must not race it on the
+            # same paths
+            return f"{p}.retry" if p else p
+
+        robs = obs.prepare_run(
+            trace_out=_suffix(self._job_out(cfg.trace_out,
+                                            "S2C_TRACE_OUT", jobnum)),
+            metrics_out=_suffix(self._job_out(cfg.metrics_out,
+                                              "S2C_METRICS_OUT",
+                                              jobnum)),
+            config=cfg)
+        robs.registry.add("serve/job_retries", 1)
+        rladder.record_job_demotion(
+            robs.registry, f"{type(exc).__name__}: {exc}")
+        self._note_timeout_if_deadline(robs, exc, server=False)
+        self._journal_append("started", job=job_id,
+                             key=sjournal.job_key(spec.filename,
+                                                  spec.config),
+                             ckpt=cfg.checkpoint_dir or "",
+                             retry=True)
+        dlog: List[Tuple[float, float]] = []
+        handle = None
+        try:
+            handle = opener(spec.filename, binary=True)
+            contigs, _n, first = read_header(handle)
+            records = ReadStream(handle, first)
+            out = self._execute(contigs, records, cfg, robs, dlog,
+                                f"{job_id}#retry")
+            return out, robs, None
+        except Exception as exc2:
+            return None, robs, (f"{type(exc).__name__}: {exc}; retry on "
+                                f"host rung also failed: "
+                                f"{type(exc2).__name__}: {exc2}")
+        finally:
+            if handle is not None:
+                try:
+                    handle.close()
+                except OSError:
+                    pass
+
+    def _note_timeout_if_deadline(self, robs, exc,
+                                  server: bool = True) -> None:
+        from ..resilience.policy import (HungDispatchError,
+                                         JobDeadlineExceeded)
+
+        if isinstance(exc, (JobDeadlineExceeded, HungDispatchError)):
+            self._note_timeout(robs, exc, server=server)
 
 
 def submit_jobs(specs: List[JobSpec], **runner_kwargs) -> List[JobResult]:

@@ -12,18 +12,31 @@ every decoded line's contribution is either in the count tensor or the
 insertion log (nothing in flight).  Files are plain ``.npz`` written via a
 temp file + atomic rename, so a crash mid-write leaves the previous
 checkpoint intact.
+
+Integrity: the payload arrays carry a ``zlib.crc32`` digest (``digest``
+entry) computed over their raw bytes at save time.  ``load`` verifies
+it — and treats ANY unreadable checkpoint (truncated/corrupt npz,
+digest mismatch) as absent-with-warning (``checkpoint/corrupt``
+counter) instead of raising: a corrupt checkpoint mid-resume must cost
+a from-scratch re-run, never wedge the job that was trying to recover.
+A checkpoint whose shape doesn't match the input still raises — that is
+a *wrong input* contract error, not corruption.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import tempfile
+import zlib
 from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
 
 from ..encoder.events import InsertionEvents
+
+logger = logging.getLogger("sam2consensus_tpu.utils.checkpoint")
 
 _FILE = "sam2consensus_ckpt.npz"
 
@@ -58,46 +71,109 @@ def path_for(checkpoint_dir: str) -> str:
     return os.path.join(checkpoint_dir, _FILE)
 
 
+def _payload_digest(arrays) -> int:
+    """crc32 over the payload arrays' raw bytes, in a fixed order —
+    cheap (~100 MB/s-class) next to the npz compression that follows,
+    and enough to catch the failure this guards: a torn/bit-rotted file
+    served as a resume base."""
+    crc = 0
+    for a in arrays:
+        crc = zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
 def save(checkpoint_dir: str, state: CheckpointState) -> None:
     os.makedirs(checkpoint_dir, exist_ok=True)
     ic, il, im, ich = state.insertions.to_arrays()
+    counts = state.counts.astype(np.int32)
+    meta = np.array([state.lines_consumed, state.reads_mapped,
+                     state.reads_skipped, state.aligned_bases,
+                     state.byte_offset, state.max_row_width],
+                    dtype=np.int64)
+    ins_contig = ic.astype(np.int32)
+    ins_local = il.astype(np.int32)
+    ins_mlen = im.astype(np.int32)
+    ins_chars = ich.astype(np.uint8)
+    source = np.frombuffer(state.source.encode("utf-8"), dtype=np.uint8)
+    sources = np.frombuffer(
+        "\n".join(state.sources or []).encode("utf-8"), dtype=np.uint8)
+    digest = _payload_digest((counts, meta, ins_contig, ins_local,
+                              ins_mlen, ins_chars, source, sources))
     fd, tmp = tempfile.mkstemp(suffix=".npz.tmp", dir=checkpoint_dir)
     try:
         with os.fdopen(fd, "wb") as fh:
             np.savez_compressed(
                 fh,
-                counts=state.counts.astype(np.int32),
-                meta=np.array([state.lines_consumed, state.reads_mapped,
-                               state.reads_skipped, state.aligned_bases,
-                               state.byte_offset, state.max_row_width],
-                              dtype=np.int64),
-                ins_contig=ic.astype(np.int32),
-                ins_local=il.astype(np.int32),
-                ins_mlen=im.astype(np.int32),
-                ins_chars=ich.astype(np.uint8),
-                source=np.frombuffer(state.source.encode("utf-8"),
-                                     dtype=np.uint8),
-                sources=np.frombuffer(
-                    "\n".join(state.sources or []).encode("utf-8"),
-                    dtype=np.uint8))
+                counts=counts,
+                meta=meta,
+                ins_contig=ins_contig,
+                ins_local=ins_local,
+                ins_mlen=ins_mlen,
+                ins_chars=ins_chars,
+                source=source,
+                sources=sources,
+                digest=np.array([digest], dtype=np.uint32))
         os.replace(tmp, path_for(checkpoint_dir))
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
 
 
+def _corrupt(path: str, why: str) -> None:
+    """Record + warn: the checkpoint is unusable and will be ignored."""
+    from .. import observability as obs
+
+    obs.metrics().add("checkpoint/corrupt", 1)
+    obs.tracer().event("checkpoint/corrupt", path=path, reason=why)
+    logger.warning(
+        "checkpoint at %s is unusable (%s): resuming from scratch — the "
+        "corrupt file is left in place for forensics and will be "
+        "overwritten by the next checkpoint write", path, why)
+
+
 def load(checkpoint_dir: str, total_len: int) -> Optional[CheckpointState]:
-    """Load the checkpoint if present and shape-compatible, else None."""
+    """Load the checkpoint if present, intact, and shape-compatible.
+
+    Returns None when absent — or when the file is corrupt/truncated or
+    its crc32 digest mismatches (counted ``checkpoint/corrupt``, warned;
+    the run resumes from scratch).  A shape mismatch still raises: that
+    is a wrong-input error the user must see, not damage to absorb."""
     p = path_for(checkpoint_dir)
     if not os.path.exists(p):
         return None
-    with np.load(p, allow_pickle=False) as z:
-        counts = z["counts"]
+    try:
+        z = np.load(p, allow_pickle=False)
+    except Exception as exc:            # zipfile/npz corruption shapes vary
+        _corrupt(p, f"unreadable npz: {type(exc).__name__}: {exc}")
+        return None
+    with z:
+        try:
+            counts = z["counts"]
+            meta = z["meta"]
+            payload = (counts.astype(np.int32), meta,
+                       z["ins_contig"].astype(np.int32),
+                       z["ins_local"].astype(np.int32),
+                       z["ins_mlen"].astype(np.int32),
+                       z["ins_chars"].astype(np.uint8),
+                       z["source"] if "source" in z.files
+                       else np.zeros(0, np.uint8),
+                       z["sources"] if "sources" in z.files
+                       else np.zeros(0, np.uint8))
+        except Exception as exc:        # truncated member / missing key
+            _corrupt(p, f"truncated payload: {type(exc).__name__}: {exc}")
+            return None
+        if "digest" in z.files:
+            want = int(z["digest"][0])
+            got = _payload_digest(payload)
+            if got != want:
+                _corrupt(p, f"digest mismatch (crc32 {got:#010x} != "
+                            f"recorded {want:#010x})")
+                return None
+        # pre-digest checkpoints (older writers) load undigested
         if counts.shape != (total_len, 6):
             raise ValueError(
                 f"checkpoint at {p} is for a genome of length "
                 f"{counts.shape[0]}, not {total_len} — wrong input file?")
-        meta = z["meta"]
         ins = InsertionEvents()
         if len(z["ins_contig"]):
             ins.array_chunks.append(

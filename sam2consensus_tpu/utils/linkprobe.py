@@ -86,7 +86,11 @@ def _cache_file() -> Optional[str]:
 def _read_cache() -> Optional[Tuple[float, float, Optional[float]]]:
     """(rt_sec, bps, measured_at) from the cache file; measured_at is
     None for pre-timestamp cache entries (treated as unknown age =
-    stale)."""
+    stale).  A corrupt/truncated cache — a torn write from a pre-atomic
+    writer, or plain disk damage — is NOT an error: it reads as absent
+    (the caller falls back to probing / the baked defaults) with a
+    ``link/cache_corrupt`` gauge + warning so the artifact shows the
+    cache was there but unusable."""
     path = _cache_file()
     if not path or not os.path.exists(path):
         return None
@@ -98,20 +102,40 @@ def _read_cache() -> Optional[Tuple[float, float, Optional[float]]]:
         at = blob.get("measured_at")
         return (float(blob["rt_sec"]), float(blob["bps"]),
                 float(at) if at is not None else None)
-    except Exception:
+    except Exception as exc:
+        from .. import observability as obs
+
+        obs.metrics().gauge("link/cache_corrupt").set(1.0)
+        obs.tracer().event("link/cache_corrupt", path=path,
+                           error=f"{type(exc).__name__}: {exc}")
+        logger.warning(
+            "link cache %s is corrupt/truncated (%s: %s): ignoring it "
+            "and probing the link instead", path,
+            type(exc).__name__, exc)
         return None
 
 
 def _write_cache(probed: Tuple[float, float]) -> None:
+    """Persist via tmp + ``os.replace`` (same discipline as
+    utils/checkpoint.py): a crash mid-write must leave the previous
+    cache intact, never a truncated JSON a later process chokes on."""
     path = _cache_file()
     if not path:
         return
     try:
         import json
+        import tempfile
 
-        with open(path, "w") as fh:
-            json.dump({"rt_sec": probed[0], "bps": probed[1],
-                       "measured_at": time.time()}, fh)
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(suffix=".tmp", dir=d)
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump({"rt_sec": probed[0], "bps": probed[1],
+                           "measured_at": time.time()}, fh)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     except OSError:
         pass
 

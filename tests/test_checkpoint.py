@@ -100,6 +100,73 @@ def test_load_wrong_genome_raises(tmp_path):
         ckpt.load(str(tmp_path), 11)
 
 
+# -- integrity digest (r6 satellite): corrupt == absent, never a crash ----
+def _save_small(tmp_path, lines=9):
+    ckpt.save(str(tmp_path), ckpt.CheckpointState(
+        counts=np.arange(60, dtype=np.int32).reshape(10, 6),
+        lines_consumed=lines, reads_mapped=4, reads_skipped=0,
+        aligned_bases=55, insertions=InsertionEvents()))
+    return ckpt.path_for(str(tmp_path))
+
+
+def test_checkpoint_carries_crc32_digest(tmp_path):
+    p = _save_small(tmp_path)
+    with np.load(p) as z:
+        assert "digest" in z.files
+        assert z["digest"].dtype == np.uint32
+    assert ckpt.load(str(tmp_path), 10) is not None
+
+
+def test_truncated_checkpoint_loads_as_absent_with_counter(tmp_path):
+    from sam2consensus_tpu.observability.metrics import pop_run, push_run
+
+    p = _save_small(tmp_path)
+    blob = open(p, "rb").read()
+    with open(p, "wb") as fh:               # torn write / partial copy
+        fh.write(blob[:len(blob) // 2])
+    reg = push_run()
+    try:
+        assert ckpt.load(str(tmp_path), 10) is None
+        assert reg.value("checkpoint/corrupt") == 1
+    finally:
+        pop_run(reg)
+
+
+def test_digest_mismatch_loads_as_absent(tmp_path):
+    from sam2consensus_tpu.observability.metrics import pop_run, push_run
+    import zipfile
+
+    p = _save_small(tmp_path)
+    # bit-rot INSIDE the zip: rewrite the counts member with altered
+    # bytes while keeping the npz structurally valid
+    with np.load(p) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["counts"] = arrays["counts"].copy()
+    arrays["counts"][0, 0] += 1             # digest no longer matches
+    with open(p, "wb") as fh:
+        np.savez(fh, **arrays)
+    with zipfile.ZipFile(p) as zf:          # still a readable npz
+        assert "counts.npy" in zf.namelist()
+    reg = push_run()
+    try:
+        assert ckpt.load(str(tmp_path), 10) is None
+        assert reg.value("checkpoint/corrupt") == 1
+    finally:
+        pop_run(reg)
+
+
+def test_pre_digest_checkpoint_still_loads(tmp_path):
+    # a checkpoint written by an older writer (no digest entry) loads
+    # undigested — upgrades must not invalidate in-flight resumes
+    p = _save_small(tmp_path)
+    with np.load(p) as z:
+        arrays = {k: z[k] for k in z.files if k != "digest"}
+    with open(p, "wb") as fh:
+        np.savez(fh, **arrays)
+    state = ckpt.load(str(tmp_path), 10)
+    assert state is not None and state.lines_consumed == 9
+
+
 def test_crash_resume_byte_identical(tmp_path):
     cfg = RunConfig(prefix="ck", thresholds=[0.25, 0.75], backend="jax",
                     decoder="py", chunk_reads=64,

@@ -208,3 +208,69 @@ def test_link_cache_max_age_env_override(monkeypatch):
     assert linkprobe.cache_max_age() == 3600.0
     monkeypatch.setenv("S2C_LINK_CACHE_MAX_AGE", "junk")
     assert linkprobe.cache_max_age() == linkprobe.CACHE_MAX_AGE_SEC
+
+
+# -- atomic cache write + corrupt tolerance (r6 satellite) ---------------
+def test_cache_write_is_atomic(tmp_path, monkeypatch):
+    """The cache lands via tmp + os.replace — no window where the file
+    exists truncated (pinned by patching os.replace to observe the
+    temp file's complete content before the swap)."""
+    import json
+    import os as _os
+
+    cache = tmp_path / "link.json"
+    monkeypatch.setenv("S2C_LINK_CACHE", str(cache))
+    seen = {}
+    real_replace = _os.replace
+
+    def spy(src, dst):
+        seen["tmp_content"] = open(src).read()
+        seen["dst_existed"] = _os.path.exists(dst)
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(linkprobe.os, "replace", spy)
+    linkprobe._write_cache((0.02, 3e7))
+    assert json.loads(seen["tmp_content"])["bps"] == 3e7   # complete
+    assert json.loads(cache.read_text())["rt_sec"] == 0.02
+    assert not list(tmp_path.glob("*.tmp"))                # no droppings
+
+
+def test_corrupt_cache_tolerated_with_warning(tmp_path, monkeypatch,
+                                              caplog):
+    """A truncated/corrupt cache file reads as absent — the probe runs
+    instead of the process crashing — and flags link/cache_corrupt."""
+    import logging
+
+    from sam2consensus_tpu import observability as obs
+
+    cache = tmp_path / "link.json"
+    cache.write_text('{"rt_sec": 0.01, "bps": 4e7, "measu')   # torn
+    monkeypatch.setenv("S2C_LINK_CACHE", str(cache))
+    monkeypatch.setattr(linkprobe, "_probe_into",
+                        lambda box: box.append(None))   # probe fails too
+    robs = obs.start_run()
+    try:
+        with caplog.at_level(logging.WARNING,
+                             logger="sam2consensus_tpu.utils.linkprobe"):
+            # probe fails, stale fallback consults the (corrupt) cache:
+            # both degrade cleanly to None -> baked defaults
+            assert linkprobe.probe_link(force=True) is None
+        snap = robs.registry.snapshot()
+        assert snap["gauges"]["link/cache_corrupt"]["value"] == 1.0
+        assert any("corrupt" in r.message for r in caplog.records)
+    finally:
+        obs.finish_run(robs)
+
+
+def test_corrupt_cache_does_not_block_fresh_probe(tmp_path, monkeypatch):
+    """With a corrupt cache on disk, a SUCCESSFUL probe still serves
+    measured constants and atomically repairs the cache file."""
+    import json
+
+    cache = tmp_path / "link.json"
+    cache.write_text("not json at all")
+    monkeypatch.setenv("S2C_LINK_CACHE", str(cache))
+    monkeypatch.setattr(linkprobe, "_probe_into",
+                        lambda box: box.append((0.015, 6e7)))
+    assert linkprobe.probe_link(force=True) == (0.015, 6e7)
+    assert json.loads(cache.read_text())["bps"] == 6e7     # repaired

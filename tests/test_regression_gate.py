@@ -216,6 +216,48 @@ def test_gate_judges_serve_series(tmp_path, capsys):
     assert "REGRESSED: serve_warm/jax_sec" in out
 
 
+# -- chaos soak: recovery_sec rides the gate (r6) --------------------------
+def test_committed_chaos_soak_artifact_parses_and_gates(capsys):
+    """The committed chaos-soak artifact is well-formed (every cycle
+    byte-identical, zero lost/duplicated) and its recovery_sec series
+    runs through the JSONL gate mode without erroring — the per-mode
+    groups are the series future rounds regress against."""
+    path = os.path.join(REPO, "campaign",
+                        "chaos_soak_r06_cpufallback.jsonl")
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    summary = [r for r in rows if r.get("mode") == "summary"][0]
+    cycles = [r for r in rows if "cycle" in r]
+    assert summary["cycles"] >= 8 and len(cycles) >= 8
+    assert summary["identical_all"] is True
+    assert summary["lost_total"] == 0
+    assert summary["duplicated_total"] == 0
+    assert summary["killed_cycles"] >= 2     # SIGKILLs actually landed
+    assert {"kill", "hang", "fault", "kill_fault"} <= {
+        r["mode"] for r in cycles}
+    assert all(r["recovery_sec"] <= summary["max_recovery_bound_sec"]
+               for r in cycles)
+    # the gate ingests it (one committed round = insufficient history
+    # per mode -> loud pass, never a crash)
+    rc = regress_check.main(["--jsonl", path, "--group-by", "mode",
+                             "--value", "recovery_sec",
+                             "--lower-is-better"])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_gate_fails_on_synthetic_recovery_regression(tmp_path, capsys):
+    path = tmp_path / "soak.jsonl"
+    rows = [{"mode": "kill", "recovery_sec": s}
+            for s in (9.0, 9.5, 8.8, 9.2, 60.0)]   # regressed tail
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    rc = regress_check.main(["--jsonl", str(path), "--group-by", "mode",
+                             "--value", "recovery_sec",
+                             "--lower-is-better"])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "REGRESSED: kill/recovery_sec" in out
+
+
 # -- campaign JSONL mode ---------------------------------------------------
 def test_gate_jsonl_series(tmp_path, capsys):
     path = tmp_path / "sweep.jsonl"

@@ -1,0 +1,496 @@
+"""Serve survivability: crash-safe journal, watchdog, admission, health.
+
+The r6 acceptance pins live here:
+
+* a ``kill -9`` mid-queue (real SIGKILL, subprocess) costs nothing: the
+  restarted server produces the full byte-identical output set with no
+  job run twice (journal fingerprint audit);
+* a hung dispatch (``job_hang`` fault site) costs exactly ONE job — the
+  watchdog fails it (or, under fallback, retries it on the ladder's
+  host rung) while the next job runs warm on the device rung;
+* admission control bounds the queue and pins a degraded tenant's jobs
+  to the host rung without demoting the fleet;
+* the health snapshot and the manifest's ``serve`` section carry the
+  recovery story.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.serve import journal as sjournal
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_cache(monkeypatch):
+    monkeypatch.setenv("S2C_JIT_CACHE", "")
+
+
+def _sim(tmp, name, seed, contig_len=3000, n_reads=1200, prefix="srv"):
+    spec = SimSpec(n_contigs=1, contig_len=contig_len, n_reads=n_reads,
+                   read_len=100, contig_len_jitter=0.0, seed=seed,
+                   contig_prefix=prefix)
+    path = os.path.join(str(tmp), name)
+    with open(path, "w") as fh:
+        fh.write(simulate(spec))
+    return path
+
+
+def _runner(**kw):
+    from sam2consensus_tpu.serve import ServeRunner
+
+    kw.setdefault("prewarm", "off")
+    kw.setdefault("persistent_cache", False)
+    return ServeRunner(**kw)
+
+
+def _rendered(result):
+    return {n: render_file(r, 0) for n, r in result.fastas.items()}
+
+
+def _cold_jax(path, cfg):
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+    from sam2consensus_tpu.io.sam import (ReadStream, opener,
+                                          read_header)
+
+    h = opener(path, binary=True)
+    contigs, _n, first = read_header(h)
+    res = JaxBackend().run(contigs, ReadStream(h, first), cfg)
+    h.close()
+    return {n: render_file(r, 0) for n, r in res.fastas.items()}
+
+
+BASE = dict(backend="jax", pileup="scatter", shards=1)
+
+
+# -- journal unit behavior -------------------------------------------------
+def test_journal_append_replay_roundtrip(tmp_path):
+    j = sjournal.JobJournal(str(tmp_path / "j"))
+    j.append("submitted", job="a", key="k1", filename="/x/a.sam")
+    j.append("started", job="a", key="k1", ckpt="")
+    j.append("committed", job="a", key="k1",
+             outputs={}, elapsed_sec=0.5)
+    j.append("started", job="b", key="k2", ckpt="")
+    st = j.replay()
+    assert set(st.committed) == {"k1"}
+    assert set(st.inflight) == {"k2"}
+    assert st.commit_counts == {"k1": 1}
+    assert st.last_seq == 4
+    # a new handle over the same dir continues the sequence
+    j2 = sjournal.JobJournal(str(tmp_path / "j"))
+    assert j2.append("failed", job="b", key="k2", error="boom") == 5
+    st2 = j2.replay()
+    assert st2.inflight == {} and set(st2.failed) == {"k2"}
+
+
+def test_journal_segments_are_atomic_and_corrupt_tolerant(tmp_path):
+    j = sjournal.JobJournal(str(tmp_path / "j"))
+    j.append("submitted", job="a", key="k1")
+    j.append("committed", job="a", key="k1", outputs={})
+    # no tmp droppings (atomic rename), and external damage to one
+    # segment skips it without losing the rest
+    names = os.listdir(j.root)
+    assert not [n for n in names if n.endswith(".tmp")]
+    seg = os.path.join(j.root, "ev-00000001.json")
+    with open(seg, "w") as fh:
+        fh.write('{"ev": "subm')            # torn by external damage
+    st = j.replay()
+    assert st.corrupt_segments == 1
+    assert set(st.committed) == {"k1"}      # the intact event survives
+
+
+def test_job_key_tracks_output_relevant_config_only(tmp_path):
+    a = RunConfig(**BASE, thresholds=[0.25])
+    same = RunConfig(**BASE, thresholds=[0.25], retries=9, wire="delta8")
+    different = RunConfig(**BASE, thresholds=[0.5])
+    assert sjournal.job_key("x.sam", a) == sjournal.job_key("x.sam", same)
+    assert sjournal.job_key("x.sam", a) != sjournal.job_key("x.sam",
+                                                            different)
+    assert sjournal.job_key("x.sam", a) != sjournal.job_key("y.sam", a)
+
+
+def test_journal_verify_outputs_detects_drift(tmp_path):
+    p = tmp_path / "out.fasta"
+    p.write_text(">r\nACGT\n")
+    fp = {str(p): sjournal.file_sha256(str(p))}
+    rec = {"outputs": fp}
+    j = sjournal.JobJournal(str(tmp_path / "j"))
+    assert j.verify_outputs(rec)
+    p.write_text(">r\nTTTT\n")              # drifted: must re-run
+    assert not j.verify_outputs(rec)
+    os.unlink(p)                            # missing: must re-run
+    assert not j.verify_outputs(rec)
+    assert not j.verify_outputs({"outputs": {}})
+    # a null recorded fingerprint (commit-time hash failure) must not
+    # match a missing file's null re-hash: unknown never verifies
+    assert not j.verify_outputs({"outputs": {str(p): None}})
+
+
+# -- the SIGKILL acceptance test -------------------------------------------
+def _serve_cmd(inputs, outdir, jdir):
+    cmd = [sys.executable, "-m", "sam2consensus_tpu.cli", "serve"]
+    for p in inputs:
+        cmd += ["-i", p]
+    cmd += ["-o", outdir, "--journal", jdir, "--pileup", "scatter",
+            "--quiet"]
+    return cmd
+
+
+def _committed(jdir):
+    n = 0
+    for name in os.listdir(jdir) if os.path.isdir(jdir) else []:
+        if name.startswith("ev-") and name.endswith(".json"):
+            try:
+                with open(os.path.join(jdir, name)) as fh:
+                    if json.load(fh).get("ev") == "committed":
+                        n += 1
+            except Exception:
+                pass
+    return n
+
+
+def test_sigkill_midqueue_resume_byte_identical(tmp_path):
+    """THE crash-resume pin: SIGKILL a journaled serve mid-queue; the
+    restarted server completes the queue byte-identically with no job
+    run twice (fingerprint audit) and no job lost."""
+    inputs = [_sim(tmp_path, f"k{i}.sam", 300 + i, contig_len=6000,
+                   n_reads=20000, prefix=f"kk{i}_") for i in range(3)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", S2C_JIT_CACHE="",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    clean = str(tmp_path / "clean")
+    r = subprocess.run(_serve_cmd(inputs, clean,
+                                  str(tmp_path / "jc")), env=env,
+                       capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    want = {f: open(os.path.join(clean, f), "rb").read()
+            for f in sorted(os.listdir(clean))}
+    assert len(want) == 3
+
+    outdir, jdir = str(tmp_path / "out"), str(tmp_path / "j")
+    proc = subprocess.Popen(_serve_cmd(inputs, outdir, jdir), env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 300
+    killed = False
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            break
+        if 1 <= _committed(jdir) < 3:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+            killed = True
+            break
+        time.sleep(0.05)
+    assert killed, "server finished before the kill window (jobs too fast)"
+    assert _committed(jdir) < 3             # genuinely mid-queue
+
+    r2 = subprocess.run(_serve_cmd(inputs, outdir, jdir), env=env,
+                        capture_output=True, text=True, timeout=420)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    got = {f: open(os.path.join(outdir, f), "rb").read()
+           for f in sorted(os.listdir(outdir))}
+    assert got == want                      # byte-identical output set
+    audit = sjournal.JobJournal(jdir).audit()
+    assert audit["duplicated"] == []        # no job ran (committed) twice
+    assert audit["lost"] == []              # no job lost
+    assert len(audit["commit_counts"]) == 3
+    # the journal records the restart's resume bookkeeping
+    evs = [e["ev"] for e in sjournal.JobJournal(jdir).events()]
+    assert "resumed" in evs
+
+
+def test_restart_over_completed_journal_skips_everything(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    path = _sim(tmp_path, "s.sam", 77)
+    jdir = str(tmp_path / "j")
+    cfg = RunConfig(**BASE, outfolder=str(tmp_path / "o") + "/")
+    os.makedirs(str(tmp_path / "o"), exist_ok=True)
+    r1 = _runner(journal_dir=jdir)
+    [a] = r1.submit_jobs([JobSpec(filename=path, config=cfg)])
+    assert a.ok and a.output_paths and not a.resumed
+    r2 = _runner(journal_dir=jdir)
+    [b] = r2.submit_jobs([JobSpec(filename=path, config=cfg)])
+    assert b.ok and b.resumed and b.fastas is None
+    assert r2.registry.value("serve/resume_skipped") == 1
+    # drifted output re-runs instead of trusting the journal
+    with open(a.output_paths[0], "a") as fh:
+        fh.write("tampered\n")
+    r3 = _runner(journal_dir=jdir)
+    [c] = r3.submit_jobs([JobSpec(filename=path, config=cfg)])
+    assert c.ok and not c.resumed           # re-ran and re-committed
+    audit = sjournal.JobJournal(jdir).audit()
+    assert audit["lost"] == []
+
+
+# -- watchdog: deadlines + hung dispatch -----------------------------------
+def test_hung_dispatch_costs_exactly_one_job(tmp_path, monkeypatch):
+    """A wedged dispatch (job_hang site sleeping far past the deadline)
+    fails ONLY its job; the next job runs warm on the device rung."""
+    from sam2consensus_tpu.serve import JobSpec
+
+    monkeypatch.setenv("S2C_FAULT_HANG_S", "600")
+    paths = [_sim(tmp_path, f"h{i}.sam", 400 + i) for i in range(3)]
+    hang = RunConfig(**BASE, fault_inject="job_hang:timeout:0:1")
+    cfgs = [RunConfig(**BASE), hang, RunConfig(**BASE)]
+    runner = _runner(job_timeout=3.0)
+    res = runner.submit_jobs(
+        [JobSpec(filename=p, config=c) for p, c in zip(paths, cfgs)])
+    assert [r.ok for r in res] == [True, False, True]
+    assert "JobDeadlineExceeded" in res[1].error
+    assert res[1].metrics.get("serve/watchdog_timeouts") == 1
+    assert runner.registry.value("serve/watchdog_timeouts") == 1
+    # the NEXT job: device rung, warm, untouched by the hang
+    assert res[2].rungs == {}
+    assert res[2].metrics.get("compile/jit_cache_hit", 0) > 0
+    assert res[2].metrics.get("resilience/demotions", 0) == 0
+    for k in (0, 2):
+        assert _rendered(res[k]) == _cold_jax(paths[k], RunConfig(**BASE))
+
+
+def test_hung_job_retries_on_host_rung_under_fallback(tmp_path,
+                                                      monkeypatch):
+    """Fallback mode: the hung job is retried once on the ladder's host
+    rung (job-level demotion), byte-identical; counters pin the story."""
+    from sam2consensus_tpu.serve import JobSpec
+
+    monkeypatch.setenv("S2C_FAULT_HANG_S", "600")
+    path = _sim(tmp_path, "hf.sam", 410)
+    hang = RunConfig(**BASE, fault_inject="job_hang:timeout:0:1",
+                     on_device_error="fallback")
+    runner = _runner(job_timeout=3.0)
+    [r] = runner.submit_jobs([JobSpec(filename=path, config=hang)])
+    assert r.ok, r.error
+    assert r.rungs.get("pileup") == "host"  # job-level ladder rung
+    assert r.metrics.get("serve/job_retries") == 1
+    assert r.metrics.get("serve/watchdog_timeouts") == 1
+    assert _rendered(r) == _cold_jax(path, RunConfig(**BASE))
+
+
+def test_stall_timeout_catches_wedge_before_job_deadline(tmp_path,
+                                                         monkeypatch):
+    from sam2consensus_tpu.serve import JobSpec
+
+    monkeypatch.setenv("S2C_FAULT_HANG_S", "600")
+    path = _sim(tmp_path, "st.sam", 420)
+    hang = RunConfig(**BASE, fault_inject="job_hang:timeout:0:1")
+    t0 = time.monotonic()
+    runner = _runner(job_timeout=60.0, stall_timeout=2.0)
+    [r] = runner.submit_jobs([JobSpec(filename=path, config=hang)])
+    elapsed = time.monotonic() - t0
+    assert not r.ok and "HungDispatchError" in r.error
+    assert elapsed < 30                     # the 60s deadline never ran
+
+
+def test_job_timeout_env_fallback(monkeypatch):
+    monkeypatch.setenv("S2C_JOB_TIMEOUT", "7.5")
+    monkeypatch.setenv("S2C_STALL_TIMEOUT", "2.5")
+    runner = _runner()
+    assert runner.job_timeout == 7.5
+    assert runner.stall_timeout == 2.5
+    runner2 = _runner(job_timeout=1.0)      # explicit beats env
+    assert runner2.job_timeout == 1.0
+
+
+# -- admission control ------------------------------------------------------
+def test_admission_queue_bound_and_tenant_quota(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    paths = [_sim(tmp_path, f"a{i}.sam", 500 + i) for i in range(4)]
+    runner = _runner(max_queue=3, tenant_quota=1)
+    res = runner.submit_jobs([
+        JobSpec(filename=paths[0], config=RunConfig(**BASE), tenant="a"),
+        JobSpec(filename=paths[1], config=RunConfig(**BASE), tenant="a"),
+        JobSpec(filename=paths[2], config=RunConfig(**BASE), tenant="b"),
+        JobSpec(filename=paths[3], config=RunConfig(**BASE), tenant="c"),
+    ])
+    assert [r.ok for r in res] == [True, False, True, True]
+    assert res[1].admission == "tenant_quota"
+    assert "admission rejected" in res[1].error
+    reg = runner.registry
+    assert reg.value("serve/admission_rejected") == 1
+    assert reg.value("serve/admission_rejected/tenant_quota") == 1
+    assert reg.value("serve/admission_admitted") == 3
+    # order preserved, admitted jobs correct
+    assert _rendered(res[0]) == _cold_jax(paths[0], RunConfig(**BASE))
+    # the bound is per submission window: a new submit admits again
+    res2 = runner.submit_jobs([
+        JobSpec(filename=paths[1], config=RunConfig(**BASE), tenant="a")])
+    assert res2[0].ok
+
+
+def test_admission_queue_full_sheds_overflow(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    path = _sim(tmp_path, "qf.sam", 510)
+    runner = _runner(max_queue=2)
+    res = runner.submit_jobs(
+        [JobSpec(filename=path, config=RunConfig(**BASE))
+         for _ in range(4)])
+    assert [r.ok for r in res] == [True, True, False, False]
+    assert {r.admission for r in res[2:]} == {"queue_full"}
+    assert runner.registry.value(
+        "serve/admission_rejected/queue_full") == 2
+
+
+def test_degraded_tenant_pinned_to_host_rung_fleet_unharmed(tmp_path):
+    """A tenant whose job demoted runs its NEXT job pinned to the host
+    rung (byte-identical), other tenants stay on the device path, and
+    one clean pinned job clears the tenant (probation)."""
+    from sam2consensus_tpu.serve import JobSpec
+
+    paths = [_sim(tmp_path, f"t{i}.sam", 520 + i) for i in range(4)]
+    faulty = RunConfig(**BASE, fault_inject="pileup_dispatch:rpc:0:inf",
+                       on_device_error="fallback", retries=1,
+                       retry_backoff=0.01)
+    runner = _runner()
+    res = runner.submit_jobs([
+        JobSpec(filename=paths[0], config=faulty, tenant="t"),
+        JobSpec(filename=paths[1], config=RunConfig(**BASE), tenant="t"),
+        JobSpec(filename=paths[2], config=RunConfig(**BASE), tenant="u"),
+        JobSpec(filename=paths[3], config=RunConfig(**BASE), tenant="t"),
+    ])
+    assert all(r.ok for r in res)
+    assert res[0].rungs.get("pileup") == "host"   # in-run demotion
+    assert res[1].admission == "pinned:host"      # tenant isolation
+    assert res[2].admission is None               # fleet unharmed
+    # probation: job 1 (pinned) completed clean -> job 3 back on device
+    assert res[3].admission is None
+    assert runner.registry.value("serve/admission_pinned") == 1
+    for k, p in enumerate(paths):
+        assert _rendered(res[k]) == _cold_jax(p, RunConfig(**BASE)), k
+
+
+# -- health + manifest ------------------------------------------------------
+def test_health_snapshot_written_atomically(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    path = _sim(tmp_path, "he.sam", 530)
+    hout = str(tmp_path / "health.json")
+    runner = _runner(health_out=hout)
+    res = runner.submit_jobs(
+        [JobSpec(filename=path, config=RunConfig(**BASE))])
+    assert res[0].ok
+    h = json.load(open(hout))
+    assert h["schema"] == "s2c-health/1"
+    assert h["queue_depth"] == 0 and h["in_flight"] is None
+    assert h["jobs"]["run"] == 1 and h["jobs"]["failed"] == 0
+    assert h["last_heartbeat_age_sec"] >= 0
+    assert not [n for n in os.listdir(tmp_path)
+                if n.startswith("health.json.tmp")]
+    # API snapshot agrees
+    snap = runner.health_snapshot()
+    assert snap["jobs"]["run"] == 1
+
+
+def test_manifest_serve_section_carries_health_and_recovery(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    path = _sim(tmp_path, "mr.sam", 540)
+    jdir = str(tmp_path / "j")
+    cfg = RunConfig(**BASE, outfolder=str(tmp_path / "o") + "/",
+                    metrics_out=str(tmp_path / "m.jsonl"))
+    os.makedirs(str(tmp_path / "o"), exist_ok=True)
+    r1 = _runner(journal_dir=jdir)
+    [a] = r1.submit_jobs([JobSpec(filename=path, config=cfg)])
+    assert a.ok
+    man = json.load(open(str(tmp_path / "m.jsonl.manifest.json")))
+    assert man["serve"]["serve/health"]["in_flight"].endswith("mr.sam")
+    assert "serve/recovery" not in man["serve"]   # first run: no resume
+    # crash simulation: drop the committed event so the job reads as
+    # in-flight, then restart — the manifest records the recovery
+    j = sjournal.JobJournal(jdir)
+    for name in os.listdir(j.root):
+        p = os.path.join(j.root, name)
+        if name.endswith(".json"):
+            with open(p) as fh:
+                if json.load(fh).get("ev") == "committed":
+                    os.unlink(p)
+    cfg2 = RunConfig(**BASE, outfolder=str(tmp_path / "o") + "/",
+                     metrics_out=str(tmp_path / "m2.jsonl"))
+    r2 = _runner(journal_dir=jdir)
+    [b] = r2.submit_jobs([JobSpec(filename=path, config=cfg2)])
+    assert b.ok and not b.resumed
+    man2 = json.load(open(str(tmp_path / "m2.jsonl.manifest.json")))
+    rec = man2["serve"]["serve/recovery"]
+    assert rec["resumed"] is True
+    assert rec["inflight_resumed"]
+    assert man2["serve"]["serve/health"]["journal_last_seq"] >= 1
+
+
+# -- runner-scope fault sites ----------------------------------------------
+def test_journal_write_fault_degrades_durability_not_correctness(
+        tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    path = _sim(tmp_path, "jw.sam", 550)
+    cfg = RunConfig(**BASE, outfolder=str(tmp_path / "o") + "/")
+    os.makedirs(str(tmp_path / "o"), exist_ok=True)
+    runner = _runner(journal_dir=str(tmp_path / "j"),
+                     fault_inject="journal_write:rpc:0:1")
+    [r] = runner.submit_jobs([JobSpec(filename=path, config=cfg)])
+    assert r.ok                              # the JOB survived
+    assert runner.registry.value("serve/journal_write_failed") == 1
+    assert r.output_paths                    # outputs still committed
+
+
+def test_decode_ahead_fault_fails_only_its_job(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    paths = [_sim(tmp_path, f"d{i}.sam", 560 + i) for i in range(3)]
+    runner = _runner(fault_inject="serve_decode_ahead:rpc:0:1")
+    res = runner.submit_jobs(
+        [JobSpec(filename=p, config=RunConfig(**BASE)) for p in paths])
+    # job 1 is the first decode-ahead target; its poisoned decode fails
+    # it alone, jobs 0 and 2 complete
+    assert [r.ok for r in res] == [True, False, True]
+    assert "InjectedRpcError" in res[1].error
+    assert _rendered(res[2]) == _cold_jax(paths[2], RunConfig(**BASE))
+
+
+def test_new_fault_sites_accepted_by_spec_grammar():
+    from sam2consensus_tpu.resilience.faultinject import parse_spec
+
+    rules = parse_spec("serve_decode_ahead:rpc:0:1,journal_write:fatal:2,"
+                       "job_hang:timeout:0:1")
+    assert [r.site for r in rules] == ["serve_decode_ahead",
+                                      "journal_write", "job_hang"]
+    with pytest.raises(ValueError):
+        parse_spec("job_hangg:timeout:0")
+
+
+def test_serve_cli_survivability_flags(tmp_path):
+    """The serve CLI accepts the new flags end-to-end (journal +
+    health + timeouts), writes per-job outputs at commit time, and a
+    rerun resumes."""
+    from sam2consensus_tpu import cli
+
+    a = _sim(tmp_path, "cli_a.sam", 570)
+    out = tmp_path / "out"
+    jdir = str(tmp_path / "j")
+    hout = str(tmp_path / "health.json")
+    argv = ["serve", "-i", a, "-o", str(out), "--pileup", "scatter",
+            "--quiet", "--journal", jdir, "--health-out", hout,
+            "--job-timeout", "300"]
+    assert cli.main(argv) == 0
+    files = sorted(os.listdir(out))
+    assert files
+    before = {f: open(out / f, "rb").read() for f in files}
+    assert json.load(open(hout))["journal"]["committed"] == 1
+    assert cli.main(argv) == 0               # resume: all skipped
+    after = {f: open(out / f, "rb").read() for f in sorted(
+        os.listdir(out))}
+    assert after == before
+    audit = sjournal.JobJournal(jdir).audit()
+    assert audit["duplicated"] == [] and audit["lost"] == []

@@ -1,0 +1,291 @@
+#!/usr/bin/env python3
+"""Chaos soak: kill/hang/fault cycles against a journaled serve queue.
+
+The serve survivability acceptance harness (ISSUE r6): N cycles, each
+running a multi-job ``s2c serve --journal`` queue under one chaos mode —
+
+* ``kill``        — SIGKILL the server after its first commit, restart
+                    the same command, let the journal resume the queue;
+* ``hang``        — every job's first device dispatch wedges
+                    (``job_hang`` fault site + S2C_FAULT_HANG_S); the
+                    watchdog (--job-timeout) abandons it and the job
+                    retries on the ladder's host rung (fallback mode);
+* ``fault``       — persistent injected RPC faults on every pileup
+                    dispatch; the in-run ladder demotes each job to the
+                    host rung mid-flight;
+* ``kill_fault``  — the fault mode PLUS a ``journal_write`` fault on
+                    the first journal append (durability degraded, not
+                    correctness) PLUS a mid-queue SIGKILL + restart.
+                    (``serve_decode_ahead`` cannot fire here — journal
+                    mode runs serial decode — it is exercised by
+                    tests/test_survivability.py instead.)
+
+Every cycle asserts the three survivability invariants:
+
+1. **byte identity** — the cycle's output set is sha256-identical to a
+   chaos-free baseline run of the same queue;
+2. **zero lost / zero duplicated jobs** — the journal's fingerprint
+   audit (serve/journal.py ``audit()``): every submitted key committed
+   exactly once across the cycle's whole journal;
+3. **bounded recovery** — the recovery phase (the restarted process for
+   kill modes, the whole chaos-laden process otherwise) completes
+   within ``--max-recovery-sec``.
+
+One JSON row per cycle + a summary row, as JSONL on stdout (or
+``--out``); ``recovery_sec`` rides the noise-aware regression gate
+(``tools/regress_check.py --jsonl campaign/chaos_soak_<r>.jsonl
+--group-by mode --value recovery_sec``).  Campaign step ``chaos_soak``
+(tools/tpu_campaign.sh); the CPU-fallback harness proof is committed at
+campaign/chaos_soak_r06_cpufallback.jsonl.
+
+Usage: python tools/chaos_soak.py [--cycles 8] [--jobs 3]
+       [--reads 20000] [--contig-len 6000] [--max-recovery-sec 180]
+       [--out FILE.jsonl]
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODES = ("kill", "hang", "fault", "kill_fault")
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def sha_dir(d):
+    out = {}
+    for name in sorted(os.listdir(d)):
+        p = os.path.join(d, name)
+        h = hashlib.sha256()
+        with open(p, "rb") as fh:
+            h.update(fh.read())
+        out[name] = h.hexdigest()
+    return out
+
+
+def serve_cmd(inputs, outdir, jdir, extra=()):
+    cmd = [sys.executable, "-m", "sam2consensus_tpu.cli", "serve"]
+    for p in inputs:
+        cmd += ["-i", p]
+    cmd += ["-o", outdir, "--journal", jdir, "--pileup", "scatter",
+            "--quiet", *extra]
+    return cmd
+
+
+def committed_count(jdir):
+    n = 0
+    try:
+        names = os.listdir(jdir)
+    except OSError:
+        return 0
+    for name in names:
+        if not (name.startswith("ev-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(jdir, name)) as fh:
+                if json.load(fh).get("ev") == "committed":
+                    n += 1
+        except Exception:
+            continue
+    return n
+
+
+def run_to_completion(cmd, env, timeout):
+    t0 = time.monotonic()
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=timeout)
+    return r.returncode, time.monotonic() - t0, r
+
+
+def kill_after_first_commit(cmd, env, jdir, n_jobs, timeout):
+    """Launch the server and SIGKILL it once >=1 job committed (but
+    before the whole queue did).  Returns ('killed'|'finished', rc)."""
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return "finished", proc.returncode
+        n = committed_count(jdir)
+        if 1 <= n < n_jobs:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+            return "killed", -9
+        time.sleep(0.05)
+    proc.kill()
+    proc.wait(timeout=30)
+    return "timeout", -9
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cycles", type=int, default=8)
+    ap.add_argument("--jobs", type=int, default=3)
+    ap.add_argument("--reads", type=int, default=20000)
+    ap.add_argument("--contig-len", type=int, default=6000)
+    ap.add_argument("--read-len", type=int, default=100)
+    ap.add_argument("--job-timeout", type=float, default=4.0,
+                    help="watchdog deadline for the hang cycles")
+    ap.add_argument("--max-recovery-sec", type=float, default=180.0)
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh tempdir)")
+    ap.add_argument("--per-process-timeout", type=float, default=600.0)
+    ap.add_argument("--out", default=None,
+                    help="JSONL destination (default: stdout)")
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    from sam2consensus_tpu.serve.journal import JobJournal
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+    work = args.workdir or tempfile.mkdtemp(prefix="s2c_chaos_")
+    os.makedirs(work, exist_ok=True)
+    log(f"[chaos_soak] workdir {work}")
+
+    inputs = []
+    for k in range(args.jobs):
+        spec = SimSpec(n_contigs=1, contig_len=args.contig_len,
+                       n_reads=args.reads, read_len=args.read_len,
+                       contig_len_jitter=0.0, seed=4200 + k,
+                       contig_prefix=f"cs{k:02d}_")
+        p = os.path.join(work, f"job{k}.sam")
+        with open(p, "w") as fh:
+            fh.write(simulate(spec))
+        inputs.append(p)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # one persistent compile cache for the whole soak: restarts are
+    # measuring RECOVERY, not XLA re-compilation
+    env["S2C_JIT_CACHE"] = os.path.join(work, "_jit_cache")
+
+    # chaos-free baseline: the byte-identity oracle for every cycle
+    base_out = os.path.join(work, "out_base")
+    rc, base_sec, r = run_to_completion(
+        serve_cmd(inputs, base_out, os.path.join(work, "j_base")), env,
+        args.per_process_timeout)
+    if rc != 0:
+        log(f"[chaos_soak] baseline failed rc={rc}:\n{r.stderr[-2000:]}")
+        return 2
+    want = sha_dir(base_out)
+    log(f"[chaos_soak] baseline {base_sec:.1f}s, "
+        f"{len(want)} output file(s)")
+
+    rows = []
+    failures = 0
+    for c in range(args.cycles):
+        mode = MODES[c % len(MODES)]
+        outdir = os.path.join(work, f"out_c{c}")
+        jdir = os.path.join(work, f"j_c{c}")
+        for d in (outdir, jdir):
+            shutil.rmtree(d, ignore_errors=True)
+        cyc_env = dict(env)
+        extra = []
+        if mode in ("hang",):
+            # every job's first dispatch wedges; the watchdog abandons
+            # it and fallback mode re-runs the job on the host rung
+            extra += ["--fault-inject", "job_hang:timeout:0:1",
+                      "--on-device-error", "fallback",
+                      "--job-timeout", str(args.job_timeout)]
+            cyc_env["S2C_FAULT_HANG_S"] = "900"
+        elif mode in ("fault", "kill_fault"):
+            spec = "pileup_dispatch:rpc:0:inf"
+            if mode == "kill_fault":
+                # the runner-scope journal_write site too: the first
+                # journal append of each process fails (absorbed —
+                # durability degraded, correctness intact; the restart
+                # + fingerprint audit below prove it)
+                spec += ",journal_write:rpc:0:1"
+            extra += ["--fault-inject", spec,
+                      "--on-device-error", "fallback",
+                      "--retries", "1", "--retry-backoff", "0.01"]
+        cmd = serve_cmd(inputs, outdir, jdir, extra)
+        t_cycle = time.monotonic()
+        killed = False
+        recovery_sec = None
+        rc = 0
+        if mode in ("kill", "kill_fault"):
+            verdict, _rc = kill_after_first_commit(
+                cmd, cyc_env, jdir, args.jobs,
+                args.per_process_timeout)
+            killed = verdict == "killed"
+            if verdict == "timeout":
+                rc = -1
+            # the recovery phase: the restarted server drains the
+            # journaled queue (skips committed, resumes in-flight)
+            rc2, recovery_sec, r2 = run_to_completion(
+                cmd, cyc_env, args.per_process_timeout)
+            rc = rc or rc2
+            if rc2 != 0:
+                log(f"[chaos_soak] c{c} restart rc={rc2}: "
+                    f"{r2.stderr[-1500:]}")
+        else:
+            rc, recovery_sec, r1 = run_to_completion(
+                cmd, cyc_env, args.per_process_timeout)
+            if rc != 0:
+                log(f"[chaos_soak] c{c} rc={rc}: {r1.stderr[-1500:]}")
+        total_sec = time.monotonic() - t_cycle
+
+        got = sha_dir(outdir) if os.path.isdir(outdir) else {}
+        identical = got == want
+        audit = JobJournal(jdir).audit()
+        lost, dup = audit["lost"], audit["duplicated"]
+        ok = (rc == 0 and identical and not lost and not dup
+              and recovery_sec <= args.max_recovery_sec)
+        failures += 0 if ok else 1
+        row = {"cycle": c, "mode": mode, "ok": ok, "rc": rc,
+               "killed": killed,
+               "recovery_sec": round(recovery_sec, 3),
+               "total_sec": round(total_sec, 3),
+               "jobs": args.jobs, "identical": identical,
+               "lost": len(lost), "duplicated": len(dup),
+               "committed": len(audit["commit_counts"])}
+        rows.append(row)
+        log(f"[chaos_soak] c{c} {mode}: "
+            + ("OK" if ok else "FAIL")
+            + f" recovery {recovery_sec:.1f}s"
+            + (" (killed mid-queue)" if killed else ""))
+
+    rec = [r["recovery_sec"] for r in rows]
+    summary = {
+        "mode": "summary",
+        "cycles": args.cycles, "jobs": args.jobs,
+        "reads": args.reads, "contig_len": args.contig_len,
+        "identical_all": all(r["identical"] for r in rows),
+        "lost_total": sum(r["lost"] for r in rows),
+        "duplicated_total": sum(r["duplicated"] for r in rows),
+        "killed_cycles": sum(1 for r in rows if r["killed"]),
+        "max_recovery_sec": round(max(rec), 3),
+        "median_recovery_sec": round(sorted(rec)[len(rec) // 2], 3),
+        "baseline_sec": round(base_sec, 3),
+        "max_recovery_bound_sec": args.max_recovery_sec,
+        "failures": failures,
+        "platform": os.environ.get("JAX_PLATFORMS", ""),
+    }
+    lines = [json.dumps(r) for r in rows] + [json.dumps(summary)]
+    blob = "\n".join(lines) + "\n"
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(blob)
+        log(f"[chaos_soak] wrote {args.out}")
+    else:
+        sys.stdout.write(blob)
+    if not args.workdir:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

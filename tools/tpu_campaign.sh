@@ -153,4 +153,16 @@ S2C_FAULT_INJECT="pileup_dispatch:rpc:p0.03,vote:rpc:p0.15,device_put:rpc:p0.02"
   run_step chaos_bench "campaign/chaos_bench_$R.json" \
   "campaign/chaos_bench_stderr_$R.log" 3600 python bench.py
 
+# 8. chaos soak (serve survivability evidence): >=8 cycles of
+# randomized SIGKILL / injected-hang / device-fault chaos against a
+# journaled multi-job serve queue — per cycle: byte-identity vs a
+# chaos-free baseline, journal fingerprint audit (zero lost / zero
+# duplicated jobs), and bounded recovery time.  recovery_sec rides the
+# regression gate: tools/regress_check.py --jsonl <artifact>
+# --group-by mode --value recovery_sec.  CPU-fallback harness proof:
+# campaign/chaos_soak_r06_cpufallback.jsonl
+run_step chaos_soak "campaign/chaos_soak_$R.jsonl" \
+  "campaign/chaos_soak_stderr_$R.log" 3600 \
+  python tools/chaos_soak.py --cycles 8
+
 echo "$(date +%H:%M:%S) campaign complete" >> "$LOG"
