@@ -213,19 +213,47 @@ def build_configs(n_devices: int, platform: str = ""):
         ("wide_genome", wide_spec, {"thresholds": [0.25]}, {},
          {"oracle_shrink":
           int(os.environ.get("BENCH_WIDE_ORACLE_SHRINK", "1"))}),
+        # --- input-format legs (sam2consensus_tpu/formats) ---
+        # ecoli_bam: the SAME corpus as ecoli_scale, container-converted.
+        # The default row ingests BAM (block-parallel BGZF + binary
+        # record decode); +gzip_sam ingests the BGZF-compressed SAM twin
+        # (block-parallel inflate + native text parse) — the
+        # "equivalent gzip-SAM leg" the BAM decode_sec is judged
+        # against.  ONE cpu-oracle run (on the SAM text) prices both,
+        # and byte-identity is asserted per row.
+        ("ecoli_bam",
+         SimSpec(n_contigs=1, contig_len=4_600_000, n_reads=n(150000),
+                 read_len=100, contig_len_jitter=0.0, seed=404,
+                 contig_prefix="ecoli"),
+         {"thresholds": [0.25]},
+         {"gzip_sam": {}},
+         {"convert": {"": "bam", "gzip_sam": "bgzf_sam"}}),
+        # longread_ont: ONT/PacBio-like dense-indel long reads (10 kb,
+        # ~50 indel events/read) — the segmented slab layout + the
+        # insertion table under long-CIGAR stress, ingested as BAM with
+        # a +sam text-path control row
+        ("longread_ont",
+         SimSpec(n_contigs=2, contig_len=120_000, n_reads=n(4000),
+                 read_len=10_000, n_indels=50, max_indel=8,
+                 contig_len_jitter=0.0, seed=505, contig_prefix="ont"),
+         {"thresholds": [0.25]},
+         {"sam": {}},
+         {"convert": {"": "bam", "sam": None}}),
     ]
 
 
 def run_once(backend, path, cfg, binary):
+    from sam2consensus_tpu.config import resolve_decode_threads
+    from sam2consensus_tpu.formats import open_alignment_input
     from sam2consensus_tpu.io.fasta import render_file
-    from sam2consensus_tpu.io.sam import ReadStream, opener, read_header
 
-    handle = opener(path, binary=binary)
-    contigs, _n, first = read_header(handle)
+    ai = open_alignment_input(path, getattr(cfg, "input_format", "auto"),
+                              binary=binary,
+                              threads=resolve_decode_threads(cfg))
     t0 = time.perf_counter()
-    res = backend.run(contigs, ReadStream(handle, first), cfg)
+    res = backend.run(ai.contigs, ai.stream, cfg)
     elapsed = time.perf_counter() - t0
-    handle.close()
+    ai.close()
     rendered = {n: render_file(r, 0) for n, r in res.fastas.items()}
     return res.stats, elapsed, rendered
 
@@ -353,6 +381,34 @@ def _write_sim(spec, name, tmp):
     return path
 
 
+def _convert_input(sam_path, kind, tmp, name):
+    """Container-convert a simulated SAM for a format bench leg:
+    ``bam`` (binary records in BGZF) or ``bgzf_sam`` (the same text,
+    BGZF-framed — what htslib writes as .sam.gz).  None/"" = the SAM
+    itself."""
+    if not kind:
+        return sam_path
+    t0 = time.perf_counter()
+    with open(sam_path, "r") as fh:
+        text = fh.read()
+    if kind == "bam":
+        from sam2consensus_tpu.formats.bam import sam_text_to_bam
+
+        out = os.path.join(tmp, f"{name}.bam")
+        sam_text_to_bam(text, out)
+    elif kind == "bgzf_sam":
+        from sam2consensus_tpu.formats.bgzf import write_bgzf
+
+        out = os.path.join(tmp, f"{name}.sam.gz")
+        write_bgzf(text.encode("ascii"), out)
+    else:
+        raise ValueError(f"unknown conversion {kind!r}")
+    log(f"[{name}] converted to {kind} "
+        f"({os.path.getsize(out) / 1e6:.1f} MB) in "
+        f"{time.perf_counter() - t0:.1f}s")
+    return out
+
+
 def _jax_row(name, path, cfg_kwargs, overrides, cpu_time, cpu_out):
     """Warm + timed jax run; returns the result row (identical vs cpu_out
     unless cpu_out is None).  ``overrides`` may carry a ``"_env"`` dict
@@ -471,6 +527,7 @@ def bench_config(name, spec, cfg_kwargs, jax_variants, tmp, extras=None):
         return [anchor_row, row]
 
     path = _write_sim(spec, name, tmp)
+    convert = extras.get("convert")
     cpu_stats, cpu_time, cpu_out = run_once(CpuBackend(), path, cfg,
                                             binary=False)
     if cpu_time < 60.0:
@@ -489,7 +546,14 @@ def bench_config(name, spec, cfg_kwargs, jax_variants, tmp, extras=None):
     variants.update(jax_variants)
     for vname, overrides in variants.items():
         row_name = name if not vname else f"{name}+{vname}"
-        rows.append(_jax_row(row_name, path, cfg_kwargs, overrides,
+        # format legs: each variant may ingest a container-converted
+        # twin of the oracle's SAM (the oracle always reads the text —
+        # the golden-path discipline for every new format)
+        vpath = path
+        if convert is not None:
+            vpath = _convert_input(path, convert.get(vname), tmp,
+                                   row_name.replace("+", "_"))
+        rows.append(_jax_row(row_name, vpath, cfg_kwargs, overrides,
                              cpu_time, cpu_out))
     return rows
 

@@ -66,9 +66,10 @@ class CpuBackend:
 
     def _run(self, contigs: List[Contig], records: Iterable[SamRecord],
              cfg: RunConfig) -> BackendResult:
-        from ..io.sam import ReadStream
-
-        if isinstance(records, ReadStream):
+        # any stream-shaped source (io.sam.ReadStream, formats.bam
+        # BamReadStream) yields parsed records; bare record iterables
+        # pass through
+        if hasattr(records, "records"):
             records = records.records()
         stats = BackendStats()
         tr = obs.tracer()

@@ -324,12 +324,12 @@ def _fetch_costs(total_len: int, n_thresholds: int,
 
 
 def _resolve_decode_threads(cfg) -> int:
-    """--decode-threads with 0 = auto (up to 4 cores); one policy shared
-    by the fused decode and the native vote tail."""
-    threads = getattr(cfg, "decode_threads", 1)
-    if threads == 0:
-        threads = min(4, os.cpu_count() or 1)
-    return max(1, threads)
+    """--decode-threads policy; canonical home is config (shared with
+    the BGZF inflate pool so format decode and fused decode size their
+    worker pools identically)."""
+    from ..config import resolve_decode_threads
+
+    return resolve_decode_threads(cfg)
 
 
 def _native_tail_possible(cfg, has_insertions: bool = True) -> bool:
@@ -661,7 +661,9 @@ class JaxBackend:
 
             if not isinstance(records, ReadStream):
                 raise RuntimeError(
-                    "--checkpoint-dir requires a file-backed input stream")
+                    "--checkpoint-dir requires a file-backed SAM input "
+                    "stream (BAM inputs do not support checkpoint resume "
+                    "yet — convert to SAM/SAM.gz or drop the checkpoint)")
             ck = ckpt.load(cfg.checkpoint_dir, layout.total_len)
             if ck is not None:
                 # three incremental cases (SURVEY.md §5 "incremental
@@ -1633,7 +1635,8 @@ class JaxBackend:
 
     def _make_encoder(self, layout, records, cfg: RunConfig, acc=None):
         """Pick the host decode path; returns (encoder, batch iterator)."""
-        from ..encoder.events import GenomeLayout, ReadEncoder  # noqa: F811
+        from ..encoder.events import (GenomeLayout, ReadEncoder,  # noqa: F811
+                                      resolve_segment_width)
         from ..io.sam import ReadStream
         from ..ops.pileup import HostPileupAccumulator
 
@@ -1645,6 +1648,15 @@ class JaxBackend:
             # remainder).  Decode seconds were billed to this job's
             # registry by the decode-ahead thread.
             return records.encoder, records.batches()
+
+        seg_w = resolve_segment_width(getattr(cfg, "segment_width", 0))
+        self._record_layout_decision(cfg, seg_w)
+
+        if hasattr(records, "make_encoder"):
+            # binary formats (formats/bam.py BamReadStream): the stream
+            # owns its vectorized record decode and hands back the same
+            # (encoder, batches) surface as the text paths
+            return records.make_encoder(layout, cfg, acc)
 
         if isinstance(records, ReadStream) and cfg.decoder != "py":
             from ..encoder import native_encoder
@@ -1668,12 +1680,14 @@ class JaxBackend:
                         layout, acc.counts_host(), threads,
                         maxdel=cfg.maxdel, strict=cfg.strict,
                         on_lines=records.add_lines,
-                        on_bytes=records.add_bytes)
+                        on_bytes=records.add_bytes,
+                        segment_width=seg_w)
                     return enc, enc.encode_blocks(records.blocks())
                 enc = native_encoder.NativeReadEncoder(
                     layout, maxdel=cfg.maxdel, strict=cfg.strict,
                     on_lines=records.add_lines, on_bytes=records.add_bytes,
-                    accumulate_into=acc.counts_host() if fuse else None)
+                    accumulate_into=acc.counts_host() if fuse else None,
+                    segment_width=seg_w)
                 return enc, enc.encode_blocks(records.blocks())
             if cfg.decoder == "native":
                 from .. import native
@@ -1681,10 +1695,32 @@ class JaxBackend:
                 raise RuntimeError("--decoder native requested but the C++ "
                                    f"decoder is unavailable: "
                                    f"{native.load_error()}")
-        enc = ReadEncoder(layout, maxdel=cfg.maxdel, strict=cfg.strict)
+        enc = ReadEncoder(layout, maxdel=cfg.maxdel, strict=cfg.strict,
+                          segment_width=seg_w)
         source = records.records() if isinstance(records, ReadStream) \
             else records
         return enc, enc.encode_segments(source, cfg.chunk_reads)
+
+    @staticmethod
+    def _record_layout_decision(cfg, seg_w: int) -> None:
+        """Ledger the long-read slab layout choice (segmented vs fixed):
+        the priced trade is worst-case bucket width — bounded by W under
+        segmentation vs the widest read span (native slab ceiling 2^16)
+        under fixed buckets — which is exactly the padded-cell and wire
+        bill a dense-indel long read would otherwise run up.  Joined
+        against the run's realized row count so a pathological split
+        blowup (rows/read >> predicted) is visible as drift."""
+        from ..encoder.events import DEFAULT_SEGMENT_W
+
+        chosen = "segmented" if seg_w else "fixed"
+        obs.record_decision(
+            "longread_layout", chosen,
+            inputs={"segment_width": int(seg_w),
+                    "configured": int(getattr(cfg, "segment_width", 0))},
+            predicted={"max_bucket_w": float(seg_w if seg_w else 1 << 16)},
+            alternatives={"fixed" if seg_w else "segmented": float(
+                (1 << 16) if seg_w else DEFAULT_SEGMENT_W)},
+            band=0.0)
 
     # -- host-side rendering ---------------------------------------------
     def _assemble(self, layout, syms: np.ndarray, contig_sums: np.ndarray,

@@ -23,7 +23,6 @@ from typing import List, Optional
 
 from .config import RunConfig, default_prefix, normalize_outfolder
 from .io.fasta import write_outputs
-from .io.sam import ReadStream, opener, read_header
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -32,7 +31,9 @@ def build_parser() -> argparse.ArgumentParser:
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("-i", "--input", dest="filename", required=True,
-                   help="SAM file (optionally gzip-compressed, need not be sorted)")
+                   help="alignment file: SAM (optionally gzip/BGZF-"
+                        "compressed) or BAM; need not be sorted "
+                        "(format sniffed by magic bytes, see --format)")
     p.add_argument("-c", "--consensus-thresholds", dest="thresholds",
                    type=str, default="0.25",
                    help="comma-separated consensus threshold(s), e.g. 0.25,0.75; default=0.25")
@@ -54,6 +55,25 @@ def build_parser() -> argparse.ArgumentParser:
     # --- new-framework flags ---
     p.add_argument("--backend", choices=["cpu", "jax"], default="cpu",
                    help="consensus backend: cpu (golden oracle) or jax (TPU)")
+    # NOTE: long-form only — the reference already owns -f for --fill
+    p.add_argument("--format", dest="input_format",
+                   choices=["auto", "sam", "sam.gz", "bam"],
+                   default="auto",
+                   help="input format (sam2consensus_tpu/formats): auto "
+                        "(default) sniffs magic bytes — plain SAM, "
+                        "gzip SAM, BGZF SAM (htslib .sam.gz; inflated "
+                        "block-parallel on --decode-threads workers) or "
+                        "BAM (block-parallel BGZF + binary record "
+                        "decode, no SAM text materialized)")
+    p.add_argument("--segment-width", dest="segment_width", type=int,
+                   default=0,
+                   help="long-read segmented slab layout: reads whose "
+                        "reference span exceeds this split into "
+                        "W-wide segment rows (byte-exact; pileup "
+                        "addition commutes) instead of widening the "
+                        "slab bucket toward the span. 0 = auto "
+                        "(4096), negative = off, positive = explicit "
+                        "width (rounded up to a power of two)")
     p.add_argument("--py2-compat", action="store_true",
                    help="reproduce the reference's Python-2 maxdel quirk: any "
                         "explicit -d value disables deletion filtering")
@@ -230,6 +250,8 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         backend=args.backend,
         strict=not args.permissive,
         py2_compat=args.py2_compat,
+        input_format=getattr(args, "input_format", "auto"),
+        segment_width=getattr(args, "segment_width", 0),
         decoder=args.decoder,
         pileup=args.pileup,
         wire=args.wire,
@@ -296,6 +318,11 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--py2-compat", action="store_true")
     p.add_argument("--permissive", action="store_true")
     p.add_argument("--quiet", action="store_true")
+    p.add_argument("--format", dest="input_format",
+                   choices=["auto", "sam", "sam.gz", "bam"],
+                   default="auto")
+    p.add_argument("--segment-width", dest="segment_width", type=int,
+                   default=0)
     p.add_argument("--pileup",
                    choices=["auto", "pallas", "mxu", "scatter", "host"],
                    default="auto")
@@ -528,12 +555,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     t0 = time.perf_counter()
     echo("\nProcessing file " + args.filename + ":\n")
 
-    # jax backend: binary handle so the native decoder parses raw bytes
-    # (no whole-file str decode/encode round trip on the hot path)
-    handle = opener(args.filename, binary=cfg.backend == "jax")
-    contigs, _n_header, first = read_header(handle)
-    echo("SAM header processed, " + str(len(contigs)) + " references found.\n")
-
     # Mirrors the reference's progress accounting: every non-leading-header
     # line counts toward reads_total (sam2consensus.py:182,194,224-225).
     # The native decoder reports lines per block, so emit one message per
@@ -545,7 +566,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             echo(str(k * 500000) + " reads processed.")
         progress[0] = total
 
-    stream = ReadStream(handle, first, on_lines=on_lines)
+    # one open call for every container (sam2consensus_tpu/formats):
+    # format sniffed/forced, BGZF blocks inflated on the decode-threads
+    # pool, BAM records decoded binary; jax backend gets binary handles
+    # so the native decoder parses raw bytes (no whole-file str decode/
+    # encode round trip on the hot path)
+    from .config import resolve_decode_threads
+    from .formats import open_alignment_input
+
+    ai = open_alignment_input(args.filename, cfg.input_format,
+                              binary=cfg.backend == "jax",
+                              on_lines=on_lines,
+                              threads=resolve_decode_threads(cfg))
+    contigs, stream = ai.contigs, ai.stream
+    echo("SAM header processed, " + str(len(contigs)) + " references found.\n")
     backend = get_backend(cfg.backend)
     if cfg.backend == "jax":
         # persistent compilation cache: a COLD process start skips XLA
@@ -563,7 +597,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             result = backend.run(contigs, stream, cfg)
     else:
         result = backend.run(contigs, stream, cfg)
-    handle.close()
+    ai.close()
     reads_total = stream.n_lines
 
     echo("A total of " + str(reads_total) + " reads were processed, out of "

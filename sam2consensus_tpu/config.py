@@ -34,6 +34,11 @@ class RunConfig:
     # --- non-reference extensions ---
     strict: bool = True          # strict: error on invalid bases / out-of-range
     py2_compat: bool = False
+    input_format: str = "auto"   # auto | sam | sam.gz | bam (formats/;
+    #                              auto sniffs magic bytes, not suffixes)
+    segment_width: int = 0       # long-read segmented slab layout: 0 = auto
+    #                              (encoder/events.DEFAULT_SEGMENT_W), <0 =
+    #                              off, >0 = explicit width (pow2-rounded)
     decoder: str = "auto"        # auto | native | py (jax backend host decode)
     pileup: str = "auto"         # auto | mxu | scatter | host (pileup strategy)
     wire: str = "auto"           # auto | packed5 | delta8 (h2d row wire codec,
@@ -65,6 +70,17 @@ class RunConfig:
     def threshold_labels(thresholds: List[float]) -> List[str]:
         """Percent labels, matching ``int(t*100)`` (sam2consensus.py:394)."""
         return [str(int(t * 100)) for t in thresholds]
+
+
+def resolve_decode_threads(cfg) -> int:
+    """``--decode-threads`` with 0 = auto (up to 4 cores); ONE policy
+    shared by the fused decode, the native vote tail and the BGZF
+    inflate pool (formats/bgzf.py) — "shared with the native decoder"
+    by construction."""
+    threads = getattr(cfg, "decode_threads", 1)
+    if threads == 0:
+        threads = min(4, os.cpu_count() or 1)
+    return max(1, threads)
 
 
 def default_prefix(filename: str) -> str:

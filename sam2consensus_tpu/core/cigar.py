@@ -31,6 +31,19 @@ _CIGAR_RE = re.compile(r"(\d+)([MIDNSHPX=]{1})")
 CONSUMES_REF_AS_GAP = frozenset("DNP")
 CONSUMES_BOTH = frozenset("M=X")
 
+#: BAM binary op order (SAM spec §4.2: ``op = cigar_u32 & 0xF`` indexes
+#: this string) — the one definition shared by the BAM decoder, the BAM
+#: writer and the C++ record parser's mirror table (decoder.cpp kOpChr).
+BAM_OPS = "MIDNSHP=X"
+
+
+def render_ops(ops) -> str:
+    """((length, op), ...) → CIGAR text (``"*"`` for the empty tuple) —
+    the inverse of :func:`split_ops` for in-contract op lists."""
+    if not ops:
+        return "*"
+    return "".join(f"{n}{op}" for n, op in ops)
+
 
 def split_ops(cigarstring: str) -> List[Tuple[int, str]]:
     """Parse a CIGAR string into (length, op) pairs via the spec regex."""

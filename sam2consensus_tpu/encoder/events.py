@@ -47,6 +47,26 @@ from ..io.sam import Contig, SamRecord
 #: smallest segment-row bucket width
 MIN_BUCKET_W = 32
 
+#: auto-resolved long-read segment width: reads whose reference span
+#: exceeds this split into W-wide rows at exact W boundaries (pileup
+#: addition commutes, so the split is semantically free) instead of
+#: inflating the slab bucket width toward the span.  4096 keeps every
+#: short-read workload untouched (typical spans are 10-100x smaller)
+#: while a 100 kb ONT read becomes ~25 dense rows rather than one row
+#: in a 131072-wide bucket that is ~97% padding (and wire bytes).
+DEFAULT_SEGMENT_W = 4096
+
+
+def resolve_segment_width(value: int) -> int:
+    """``RunConfig.segment_width`` policy: 0 = auto (DEFAULT_SEGMENT_W),
+    negative = segmentation off, positive = that width rounded up to a
+    power of two (>= MIN_BUCKET_W) so bucket invariants hold."""
+    if value == 0:
+        return DEFAULT_SEGMENT_W
+    if value < 0:
+        return 0
+    return max(MIN_BUCKET_W, 1 << (int(value) - 1).bit_length())
+
 
 class GenomeLayout:
     """Flat concatenated coordinate system over the declared contigs.
@@ -204,10 +224,14 @@ class ReadEncoder:
     """Streaming encoder: SamRecords in, SegmentBatches + InsertionEvents out."""
 
     def __init__(self, layout: GenomeLayout, maxdel: Optional[int] = 150,
-                 strict: bool = True):
+                 strict: bool = True, segment_width: int = 0):
         self.layout = layout
         self.maxdel = maxdel
         self.strict = strict
+        #: >0 = split rows wider than this at exact W boundaries (the
+        #: long-read segmented layout); 0 = off (legacy fixed buckets).
+        #: Callers resolve config policy via :func:`resolve_segment_width`.
+        self.segment_width = segment_width
         self.n_reads = 0
         self.n_skipped = 0
         self.insertions = InsertionEvents()
@@ -280,7 +304,12 @@ class ReadEncoder:
         rc = 0
         out = 0
         claim = rec.pos
-        for length, op in split_ops(rec.cigar):
+        # pre-split ops ride with binary records (formats/bam.py), so the
+        # BAM path never rebuilds or re-regexes CIGAR text
+        ops = getattr(rec, "ops", None)
+        if ops is None:
+            ops = split_ops(rec.cigar)
+        for length, op in ops:
             if op in "M=X":
                 codes = seq_codes[rc:rc + length]
                 my_base.append((out, codes))
@@ -352,12 +381,24 @@ class ReadEncoder:
 
         # flat coordinates, wrapping negatives Python-style (quirk 7 contract)
         if rec.pos >= 0:
-            return [(offset + rec.pos, row)]
+            return self._segmented(offset + rec.pos, row)
         neg = min(span, -rec.pos)          # bases in the wrapped tail
-        out = [(offset + reflen + rec.pos, row[:neg])]
+        out = self._segmented(offset + reflen + rec.pos, row[:neg])
         if span > neg:
-            out.append((offset, row[neg:]))
+            out.extend(self._segmented(offset, row[neg:]))
         return out
+
+    def _segmented(self, start: int, row: np.ndarray
+                   ) -> List[Tuple[int, np.ndarray]]:
+        """Long-read segmented layout: rows wider than ``segment_width``
+        split at exact W boundaries into independent scatter rows —
+        pileup addition commutes, so the split is byte-exact while the
+        slab bucket width stays bounded by W instead of the read span."""
+        w = self.segment_width
+        if w <= 0 or len(row) <= w:
+            return [(start, row)] if len(row) else []
+        return [(start + off, row[off:off + w])
+                for off in range(0, len(row), w)]
 
 
 def _expand_segments(starts: List[int], lengths: List[int]) -> np.ndarray:

@@ -65,7 +65,8 @@ class NativeReadEncoder:
     def __init__(self, layout: GenomeLayout, maxdel: Optional[int] = 150,
                  strict: bool = True, width: int = 256,
                  on_lines=None, on_bytes=None,
-                 accumulate_into: Optional[np.ndarray] = None):
+                 accumulate_into: Optional[np.ndarray] = None,
+                 segment_width: int = 0):
         lib = native.load()
         if lib is None:  # pragma: no cover - callers check available()
             raise RuntimeError(f"native decoder unavailable: "
@@ -74,7 +75,13 @@ class NativeReadEncoder:
         self.layout = layout
         self.maxdel = maxdel
         self.strict = strict
-        self.width = width
+        #: slab-width ceiling: with the segmented layout active, a long
+        #: read is an overflow line that the python twin splits into
+        #: <=segment_width rows — so the native slab never widens past W
+        #: (one 100 kb read would otherwise push every subsequent slab
+        #: to a 65536-wide, ~97%-padding shape)
+        self._width_cap = segment_width if segment_width else 1 << 16
+        self.width = min(width, self._width_cap)
         self.on_lines = on_lines
         self.on_bytes = on_bytes
         # fused host pileup: the C decoder counts each committed row into
@@ -122,7 +129,8 @@ class NativeReadEncoder:
         self._banked = 0
         # python twin for overflow/error-replay fallback; shares counters
         # and the insertion store so fallback reads land in the same place
-        self._py = ReadEncoder(layout, maxdel=maxdel, strict=strict)
+        self._py = ReadEncoder(layout, maxdel=maxdel, strict=strict,
+                               segment_width=segment_width)
         self.insertions = self._py.insertions
 
         names_blob = "".join(layout.names).encode("ascii")
@@ -227,15 +235,19 @@ class NativeReadEncoder:
                 for k in range(int(n_overflow)):
                     self._fallback_line(chunk, int(ovf[k]))
                 if n_overflow > max(64, n_reads // 64):
-                    # widen future slabs; the current slab keeps its width
-                    self.width = min(1 << 16, self.width * 2)
+                    # widen future slabs; the current slab keeps its
+                    # width.  Capped at the segmented layout's W when
+                    # active — overflow reads come back segmented via
+                    # the python twin instead of widening every slab.
+                    self.width = min(self._width_cap, self.width * 2)
                 elif (not self._probed and n_reads > 256 and _max_span > 0
                       and not n_overflow):
                     # one-shot shrink to the observed span profile: padding
                     # bytes are wire bytes on the host->device link
                     self._probed = True
-                    self.width = max(MIN_BUCKET_W,
-                                     _bucket_width(int(_max_span)))
+                    self.width = min(self._width_cap,
+                                     max(MIN_BUCKET_W,
+                                         _bucket_width(int(_max_span))))
 
                 offset += int(consumed)
                 self._count_bytes(int(consumed))

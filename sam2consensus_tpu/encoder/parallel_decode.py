@@ -54,7 +54,9 @@ class ParallelFusedDecoder:
 
     def __init__(self, layout: GenomeLayout, counts: np.ndarray,
                  n_threads: int, maxdel: Optional[int] = 150,
-                 strict: bool = True, on_lines=None, on_bytes=None):
+                 strict: bool = True, on_lines=None, on_bytes=None,
+                 segment_width: int = 0):
+        self._segment_width = segment_width
         self.layout = layout
         self._counts = counts                 # worker 0 writes here
         # per-extra-worker memory: its int32 count tensor, plus — in
@@ -96,7 +98,8 @@ class ParallelFusedDecoder:
             enc = NativeReadEncoder(layout, maxdel=maxdel, strict=strict,
                                     accumulate_into=target,
                                     on_lines=_count("lines"),
-                                    on_bytes=_count("bytes"))
+                                    on_bytes=_count("bytes"),
+                                    segment_width=segment_width)
             state["enc"] = enc
             self._workers.append(state)
 

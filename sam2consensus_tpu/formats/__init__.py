@@ -1,0 +1,267 @@
+"""Pluggable input formats: one open call, every alignment container.
+
+``open_alignment_input(path, fmt="auto")`` is the single entry every
+consumer routes through — the CLI, the serve runner's cold and
+decode-ahead paths, the bench harness and the tests — returning an
+:class:`AlignmentInput` whose ``contigs``/``stream`` pair drops into the
+existing ``backend.run(contigs, stream, cfg)`` seam unchanged.
+
+Formats and their decode routes:
+
+==========  ==============================================================
+``sam``     plain SAM text (``io/sam.py`` — mmap'd zero-copy blocks into
+            the native C++ decoder)
+``sam.gz``  gzip-compressed SAM.  Sniffed per FILE, not per suffix:
+            htslib-written ``.sam.gz`` are really BGZF, whose ≤64 KiB
+            independently-deflated blocks inflate on a ``--decode-threads``
+            worker pool (``formats/bgzf.py``) with ordered reassembly;
+            plain single-member gzip keeps the serial streaming path.
+``bam``     BGZF container + binary records (``formats/bam.py``): the
+            block-parallel inflate feeds a vectorized record decoder that
+            emits the encoder's segment rows without ever materializing
+            SAM text lines.
+==========  ==============================================================
+
+Failure semantics (the counters ride the run's metrics registry):
+
+* BGZF truncation / structural damage is detected at OPEN time by the
+  one-pass block scan (missing EOF marker, mid-block EOF, bad headers):
+  counted ``format/bgzf_corrupt``; when a same-stem sibling SAM exists
+  (``x.bam`` → ``x.sam``/``x.sam.gz``, ``x.sam.gz`` → ``x.sam``) the
+  open FALLS BACK to it — the text rung of the decode ladder — counted
+  ``format/fallback`` (and the chosen path recorded in the
+  ``format/input`` gauge); with no sibling the error propagates with the
+  precise block offset.
+* A mid-stream corrupt block (CRC/ISIZE/inflate failure) is TRANSIENT:
+  the reader re-reads and re-inflates it once (bitrot on the wire or a
+  racing writer), counted ``format/bgzf_corrupt``; a second failure
+  raises :class:`~.bgzf.BgzfCorruptBlock` carrying the block offset.
+* The ``bam_inflate`` fault-injection site (``resilience/faultinject``)
+  fires per inflated block, so chaos runs rehearse all of the above.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io as _io
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..io.sam import Contig, ReadStream, read_header
+from . import bgzf as _bgzf
+
+FORMATS = ("auto", "sam", "sam.gz", "bam")
+
+#: gzip magic (any flavor)
+_GZ_MAGIC = b"\x1f\x8b"
+
+
+class FormatError(ValueError):
+    """Input does not match the requested/detected format."""
+
+
+@dataclass
+class AlignmentInput:
+    """An opened alignment source, backend-ready.
+
+    ``stream`` is a :class:`~..io.sam.ReadStream` (SAM flavors) or
+    :class:`~.bam.BamReadStream` (BAM); both expose the counting surface
+    the CLI and backends consume.  ``format`` is the RESOLVED format
+    (``sam`` / ``sam.gz`` / ``sam.bgzf`` / ``bam``); ``fallback_from``
+    records a corrupt-container fallback's original path."""
+
+    path: str
+    format: str
+    contigs: List[Contig]
+    stream: object
+    handle: object = None
+    fallback_from: Optional[str] = None
+
+    def close(self) -> None:
+        h = self.handle
+        if h is not None:
+            try:
+                h.close()
+            except OSError:
+                pass
+
+
+def detect_format(path: str) -> str:
+    """Resolve a file's on-disk format by magic bytes, not suffix:
+    ``sam`` | ``sam.gz`` (plain gzip) | ``sam.bgzf`` | ``bam``."""
+    with open(path, "rb") as fh:
+        head = fh.read(64)
+    if head[:2] != _GZ_MAGIC:
+        return "sam"
+    if not _bgzf.sniff_bgzf(head):
+        return "sam.gz"
+    # BGZF: BAM iff the first inflated bytes open with the BAM magic
+    with open(path, "rb") as fh:
+        try:
+            bsize = _bgzf._block_bsize(head, 0)
+            first = _bgzf.inflate_block(fh.read(bsize), 0)
+        except _bgzf.BgzfError:
+            # damaged first block: defer to the opener, which runs the
+            # full scan and owns the fallback path; suffix is the best
+            # remaining hint
+            return "bam" if path.endswith(".bam") else "sam.bgzf"
+    return "bam" if first[:4] == b"BAM\x01" else "sam.bgzf"
+
+
+def sibling_sam(path: str) -> Optional[str]:
+    """A same-stem plain/gzip SAM next to ``path``, if one exists —
+    the text fallback target for a damaged binary container."""
+    stem = path
+    for ext in (".bam", ".gz"):
+        if stem.endswith(ext):
+            stem = stem[: -len(ext)]
+    if stem.endswith(".sam.bgzf"):
+        stem = stem[: -len(".bgzf")]
+    candidates = []
+    if not stem.endswith(".sam"):
+        candidates.append(stem + ".sam")
+    else:
+        candidates.append(stem)
+    candidates.append(stem + ".gz" if stem.endswith(".sam")
+                      else stem + ".sam.gz")
+    for cand in candidates:
+        if cand != path and os.path.exists(cand):
+            return cand
+    return None
+
+
+def _metrics():
+    try:
+        from .. import observability as obs
+
+        return obs.metrics()
+    except Exception:  # pragma: no cover - observability always imports
+        return None
+
+
+def _fault_check(site: str) -> None:
+    from ..resilience.faultinject import fault_check
+
+    fault_check(site)
+
+
+def open_alignment_input(path: str, fmt: str = "auto",
+                         binary: bool = False, on_lines=None,
+                         threads: int = 1,
+                         fallback: bool = True) -> AlignmentInput:
+    """Open ``path`` as ``fmt`` (``auto`` sniffs magic bytes) and return
+    the backend-ready (contigs, stream) pair.
+
+    ``threads`` sizes the BGZF inflate pool (callers pass the resolved
+    ``--decode-threads``); ``binary`` keeps text-SAM handles in bytes
+    mode (the native decoder's contract) and is ignored for formats that
+    are inherently binary.  ``fallback=False`` disables the
+    corrupt-container sibling-SAM fallback (tests pin exact errors)."""
+    if fmt not in FORMATS:
+        raise FormatError(
+            f"unknown input format {fmt!r} (use one of {FORMATS})")
+    resolved = detect_format(path) if fmt == "auto" else fmt
+    reg = _metrics()
+
+    if resolved == "bam":
+        try:
+            reader = _bgzf.BgzfReader(path, threads=threads,
+                                      fault_check=_fault_check,
+                                      metrics=reg)
+        except _bgzf.BgzfError as exc:
+            return _bgzf_open_failed(path, fmt, binary, on_lines,
+                                     threads, fallback, exc, reg)
+        from .bam import BamReadStream, read_bam_header
+
+        try:
+            contigs, _text = read_bam_header(reader)
+        except Exception:
+            # the reader owns an fd and (threads > 1) a live pool: a
+            # corrupt first block / damaged BAM header must not leak
+            # them until GC — serve queues survive such jobs and would
+            # accumulate idle inflate threads otherwise
+            reader.close()
+            raise
+        stream = BamReadStream(reader, [c.name for c in contigs],
+                               on_lines=on_lines)
+        if reg is not None:
+            reg.gauge("format/input").set_info(
+                {"path": path, "format": "bam",
+                 "blocks": len(reader.blocks), "threads": threads})
+        return AlignmentInput(path=path, format="bam", contigs=contigs,
+                              stream=stream, handle=reader)
+
+    if resolved in ("sam.gz", "sam.bgzf"):
+        bgzf_file = resolved == "sam.bgzf" or (
+            fmt == "sam.gz" and _bgzf.is_bgzf(path))
+        if bgzf_file:
+            try:
+                handle = _bgzf.BgzfReader(path, threads=threads,
+                                          fault_check=_fault_check,
+                                          metrics=reg)
+            except _bgzf.BgzfError as exc:
+                return _bgzf_open_failed(path, fmt, binary, on_lines,
+                                         threads, fallback, exc, reg)
+            resolved = "sam.bgzf"
+        else:
+            handle = gzip.open(path, "rb")
+            resolved = "sam.gz"
+        if not binary:
+            base = handle if isinstance(handle, gzip.GzipFile) \
+                else _io.BufferedReader(handle)
+            handle = _io.TextIOWrapper(base, encoding="ascii",
+                                       errors="strict")
+        try:
+            contigs, _n, first = read_header(handle)
+        except Exception:
+            handle.close()      # see the bam branch: no fd/pool leak
+            raise
+        if reg is not None:
+            reg.gauge("format/input").set_info(
+                {"path": path, "format": resolved, "threads": threads})
+        return AlignmentInput(
+            path=path, format=resolved, contigs=contigs,
+            stream=ReadStream(handle, first, on_lines=on_lines),
+            handle=handle)
+
+    # plain SAM text
+    if resolved != "sam":  # pragma: no cover - FORMATS exhausts above
+        raise FormatError(f"unhandled format {resolved!r}")
+    handle = open(path, "rb") if binary else open(
+        path, "r", encoding="ascii", errors="strict")
+    contigs, _n, first = read_header(handle)
+    if reg is not None:
+        reg.gauge("format/input").set_info({"path": path, "format": "sam"})
+    return AlignmentInput(
+        path=path, format="sam", contigs=contigs,
+        stream=ReadStream(handle, first, on_lines=on_lines),
+        handle=handle)
+
+
+def _bgzf_open_failed(path, fmt, binary, on_lines, threads, fallback,
+                      exc, reg) -> AlignmentInput:
+    """A BGZF container failed its open-time scan (truncation / bad
+    blocks).  Count it, then take the text rung — a sibling SAM — when
+    one exists; else re-raise with the block offset."""
+    if reg is not None:
+        reg.add("format/bgzf_corrupt")
+    sib = sibling_sam(path) if fallback else None
+    if sib is None:
+        raise exc
+    if reg is not None:
+        reg.add("format/fallback")
+        reg.gauge("format/input").set_info(
+            {"path": sib, "format": "fallback",
+             "fallback_from": path,
+             "error": f"{type(exc).__name__}: {exc}"})
+    import logging
+
+    logging.getLogger("sam2consensus_tpu.formats").warning(
+        "damaged BGZF container %s (%s); falling back to sibling %s",
+        path, exc, sib)
+    out = open_alignment_input(sib, "auto", binary=binary,
+                               on_lines=on_lines, threads=threads,
+                               fallback=False)
+    out.fallback_from = path
+    return out

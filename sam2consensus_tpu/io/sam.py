@@ -26,8 +26,15 @@ from dataclasses import dataclass
 from typing import Iterable, Iterator, List, TextIO, Tuple
 
 
-def opener(filename: str, binary: bool = False):
+def opener(filename: str, binary: bool = False, threads: int = 1):
     """Open plain or gzip text by suffix (sam2consensus.py:110-114).
+
+    ``.gz`` files are SNIFFED, not trusted: htslib-written ``.sam.gz``
+    are actually BGZF (gzip members with the FEXTRA ``BC`` subfield),
+    whose independently-deflated blocks decode through the
+    block-parallel reader (``formats/bgzf.py`` — ordered reassembly, so
+    downstream semantics are identical) on ``threads`` workers; plain
+    single-member gzip keeps the serial streaming path it always had.
 
     ``binary=True`` returns a bytes handle: the native decoder parses raw
     bytes, so decoding 100s of MB of SAM text to ``str`` on the way in would
@@ -36,6 +43,20 @@ def opener(filename: str, binary: bool = False):
     from the C++/Python encoder rather than a ``UnicodeDecodeError``.)
     """
     if filename.endswith(".gz"):
+        from ..formats import _fault_check, _metrics
+        from ..formats import bgzf as _bgzf
+
+        if _bgzf.is_bgzf(filename):
+            # same fault-site/counter wiring as open_alignment_input:
+            # the bam_inflate injection site and the format/bgzf_corrupt
+            # retry counter apply to THIS entry point too
+            raw = _bgzf.BgzfReader(filename, threads=max(1, threads),
+                                   fault_check=_fault_check,
+                                   metrics=_metrics())
+            if binary:
+                return raw
+            return io.TextIOWrapper(io.BufferedReader(raw),
+                                    encoding="ascii", errors="strict")
         raw = gzip.open(filename, "rb")
         if binary:
             return raw

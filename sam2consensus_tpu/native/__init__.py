@@ -101,6 +101,20 @@ def load() -> Optional[ctypes.CDLL]:
                                                #   +256 overflow bank, len
         ctypes.c_long,                         # direct int32 mode flag
     ]
+    lib.s2c_decode_bam.restype = ctypes.c_long
+    lib.s2c_decode_bam.argtypes = [
+        u8p, ctypes.c_long,                    # inflated record bytes
+        i32p, i64p, i64p, ctypes.c_long,       # ref ci/offset/len, n_refs
+        ctypes.c_long, ctypes.c_long,          # maxdel, strict
+        ctypes.c_long,                         # width
+        i32p, u8p, ctypes.c_long,              # starts, codes, rows_cap
+        i32p, i32p, i32p, ctypes.c_long,       # ins contig/local/mlen, cap
+        u8p, ctypes.c_long,                    # ins_chars, cap
+        i64p, ctypes.c_long,                   # overflow_off, cap
+        i64p,                                  # out stats
+        u8p, i32p, ctypes.c_int64,             # fused pileup (as s2c_decode)
+        ctypes.c_long,                         # direct int32 mode flag
+    ]
     lib.s2c_accumulate_rows.restype = None
     lib.s2c_accumulate_rows.argtypes = [
         i32p, u8p,                             # starts, codes

@@ -316,6 +316,9 @@ enum OutIdx : int {
   oMaxSpan = 11,
   oBanked = 12,  // u8-shadow saturation wraps banked into acc_ovf: when 0
                  // the bank is untouched and merge_shadow skips its fold
+  oSegmented = 13,  // BAM path: reads emitted as multiple width-bounded
+                    // segment rows (the long-read segmented layout,
+                    // handled in C instead of the python replay lane)
 };
 
 }  // namespace
@@ -896,6 +899,334 @@ extern "C" long s2c_decode(
   out[oOverflow] = n_overflow;
   out[oMaxSpan] = max_span;
   out[oBanked] = n_banked;
+  return status;
+}
+
+// ---------------------------------------------------------------------------
+// Binary BAM record decoder — the text path's twin over the BGZF-inflated
+// record stream (formats/bam.py feeds whole-record buffers; BGZF blocks
+// inflate block-parallel upstream).  No field split, no int parse, no
+// CIGAR regex: ops are (u32 >> 4, u32 & 0xF) and SEQ is 4-bit nibbles,
+// which is exactly why BAM ingest skips the text-tokenization bill.
+//
+// Protocol mirrors s2c_decode: same out[] indices, same status codes.
+//  * kErrorLine: err_off = the RECORD's byte offset (at its block_size
+//    field); the python wrapper replays that one record through the
+//    golden encoder so exception type/message match the oracle exactly;
+//  * kCapacity: slab/insertion buffers full, consumed stops before the
+//    record;
+//  * overflow_off records reads the wrapper must replay in python:
+//    span > width (the segmented-layout fallback) and negative-POS
+//    wraps (rare; python owns the wrap split).
+// refID indexing replaces the name hash: the wrapper passes per-refid
+// (layout contig index, flat offset, length) arrays resolved through the
+// GenomeLayout, so duplicate-name semantics match the text path.
+
+namespace {
+
+// BAM nibble -> consensus code ("=ACMGRSVTWYHKDBN"; only ACGTN valid)
+constexpr unsigned char kNibCode[16] = {255, 1, 2, 255, 3, 255, 255, 255,
+                                        5, 255, 255, 255, 255, 255, 255, 4};
+constexpr char kNibChr[17] = "=ACMGRSVTWYHKDBN";
+// BAM op code -> text op (index > 8 is corrupt; wrapper replay reports)
+constexpr char kOpChr[9] = {'M', 'I', 'D', 'N', 'S', 'H', 'P', '=', 'X'};
+
+inline int32_t le32(const unsigned char* p) {
+  int32_t v;
+  memcpy(&v, p, 4);
+  return v;  // build targets are little-endian (x86/arm64)
+}
+
+inline uint32_t leu32(const unsigned char* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+inline unsigned char nib_at(const unsigned char* seq, long j) {
+  const unsigned char b = seq[j >> 1];
+  return (j & 1) ? (b & 0xF) : (b >> 4);
+}
+
+}  // namespace
+
+extern "C" long s2c_decode_bam(
+    const unsigned char* data, long data_len,
+    const int32_t* ref_ci, const int64_t* ref_offset,
+    const int64_t* ref_len, long n_refs,
+    long maxdel, long strict, long width,
+    int32_t* starts, unsigned char* codes, long rows_cap,
+    int32_t* ins_contig, int32_t* ins_local, int32_t* ins_mlen, long ins_cap,
+    unsigned char* ins_chars, long ins_chars_cap,
+    int64_t* overflow_off, long overflow_cap,
+    int64_t* out,
+    unsigned char* acc_u8, int32_t* acc_ovf, int64_t acc_total_len,
+    long acc_direct) {
+  long n_rows = 0, n_reads = 0, n_skipped = 0, n_ins = 0, n_ins_chars = 0;
+  long n_events = 0, n_lines = 0, n_overflow = 0, max_span = 0;
+  long n_segmented = 0;
+  long status = kOk;
+  long err_off = -1;
+  int64_t n_banked = 0;
+  std::vector<unsigned char> scratch;  // wide-read translate buffer
+
+  long i = 0;
+  while (i + 4 <= data_len) {
+    const int64_t block_size = le32(data + i);
+    if (block_size < 32 || block_size > (int64_t(1) << 31)) {
+      status = kErrorLine;  // corrupt framing: python replay reports it
+      err_off = i;
+      ++n_lines;            // rolled back below like the text path
+      break;
+    }
+    if (i + 4 + block_size > data_len) break;  // partial record: stop here
+    const long next = i + 4 + static_cast<long>(block_size);
+    const unsigned char* r = data + i + 4;
+    ++n_lines;
+
+    const int64_t refid = le32(r + 0);
+    const int64_t pos = le32(r + 4);
+    const long l_rn = r[8];
+    const long n_cig = r[12] | (static_cast<long>(r[13]) << 8);
+    const int64_t l_seq = le32(r + 16);
+    const unsigned char* cig = r + 32 + l_rn;
+    const unsigned char* seq = cig + 4 * n_cig;
+    if (l_seq < 0 ||
+        32 + l_rn + 4 * n_cig + (l_seq + 1) / 2 + l_seq > block_size) {
+      status = kErrorLine;  // fields overrun the record: replay reports
+      err_off = i;
+      break;
+    }
+    if (n_cig == 0) {  // the binary form of CIGAR "*": skip, still counts
+      i = next;
+      continue;
+    }
+
+    // --- refid resolution (contract violation, not a parse error) ---
+    const bool known_ref = refid >= 0 && refid < n_refs;
+    if (refid < -1 || refid >= n_refs) {
+      status = kErrorLine;  // corrupt table index: replay reports
+      err_off = i;
+      break;
+    }
+    const int64_t reflen = known_ref ? ref_len[refid] : 0;
+
+    // --- op pre-scan: span / read-cursor / insertion sizing ---
+    long span = 0, pre_rc = 0, pre_ins = 0, pre_chars = 0;
+    bool huge_span = false, bad_op = false;
+    for (long k = 0; k < n_cig; ++k) {
+      const uint32_t v = leu32(cig + 4 * k);
+      const int64_t num = v >> 4;
+      const unsigned op = v & 0xF;
+      if (op > 8) {
+        bad_op = true;  // outside MIDNSHP=X: python replay IndexErrors
+        break;
+      }
+      const char oc = kOpChr[op];
+      switch (oc) {
+        case 'M': case '=': case 'X':
+          if (huge_span || span + num > 2 * reflen + 64) huge_span = true;
+          else span += num;
+          pre_rc += num;
+          break;
+        case 'D': case 'N': case 'P':
+          if (huge_span || span + num > 2 * reflen + 64) huge_span = true;
+          else span += num;
+          break;
+        case 'I': {
+          long take = l_seq - pre_rc;
+          if (take < 0) take = 0;
+          if (take > num) take = num;
+          ++pre_ins;
+          pre_chars += take;
+          pre_rc += num;
+          break;
+        }
+        case 'S':
+          pre_rc += num;
+          break;
+        default:  // 'H'
+          break;
+      }
+    }
+    if (bad_op || pre_rc > l_seq) {
+      // corrupt op nibble, or SEQ shorter than the CIGAR claims (the
+      // reference's concatenation-shift semantics): replay in python
+      status = kErrorLine;
+      err_off = i;
+      break;
+    }
+    if (span > max_span) max_span = span;
+
+    if (!known_ref || huge_span ||
+        (span > 0 && (pos < -reflen || pos + span > reflen))) {
+      if (strict) {
+        status = kErrorLine;  // replay raises the oracle's exact error
+        err_off = i;
+        break;
+      }
+      ++n_skipped;
+      i = next;
+      continue;
+    }
+
+    if (pos < 0) {
+      // python fallback: negative-POS wrap split (python owns the wrap)
+      if (n_overflow + 1 > overflow_cap) {
+        status = kCapacity;
+        break;
+      }
+      overflow_off[n_overflow++] = i;
+      i = next;
+      continue;
+    }
+
+    // ---- fast path: capacity, then translate nibbles into the slab.
+    //      Wide reads (span > width — the long-read case) translate
+    //      into a scratch row and commit as ceil(span/width) segment
+    //      rows at exact width boundaries: the segmented slab layout,
+    //      done here so a 10-100 kb CIGAR never pays the per-read
+    //      python replay lane ----
+    const bool wide = span > width;
+    const long rows_needed =
+        span > 0 ? (wide ? (span + width - 1) / width : 1) : 0;
+    if (n_rows + rows_needed > rows_cap || n_ins + pre_ins > ins_cap ||
+        n_ins_chars + pre_chars > ins_chars_cap) {
+      status = kCapacity;
+      break;
+    }
+    const int64_t ci = known_ref ? ref_ci[refid] : -1;
+    const int64_t goff = known_ref ? ref_offset[refid] : 0;
+    unsigned char* dst;
+    if (wide) {
+      if (static_cast<long>(scratch.size()) < span) scratch.resize(span);
+      dst = scratch.data();
+    } else {
+      dst = codes + static_cast<int64_t>(n_rows) * width;
+    }
+    long o = 0, rc = 0, gaps = 0, pads = 0;
+    bool bad_base = false;
+    const long ins_base = n_ins, chars_base = n_ins_chars;
+    for (long k = 0; k < n_cig; ++k) {
+      const uint32_t v = leu32(cig + 4 * k);
+      const int64_t num = v >> 4;
+      const char oc = kOpChr[v & 0xF];
+      switch (oc) {
+        case 'M': case '=': case 'X': {
+          // pre_rc <= l_seq guaranteed above: the full claim is present
+          for (long k2 = 0; k2 < num; ++k2) {
+            const unsigned char code = kNibCode[nib_at(seq, rc + k2)];
+            bad_base |= (code == 255);
+            dst[o + k2] = code;
+          }
+          // '-' has no BAM nibble: M runs contribute no gap cells
+          o += num;
+          rc += num;
+          break;
+        }
+        case 'D': case 'N': case 'P':
+          memset(dst + o, kGap, num);
+          gaps += num;
+          o += num;
+          break;
+        case 'I': {
+          long take = l_seq - rc;
+          if (take < 0) take = 0;
+          if (take > num) take = num;
+          for (long k2 = 0; k2 < take; ++k2) {
+            const unsigned char nb = nib_at(seq, rc + k2);
+            bad_base |= (kNibCode[nb] == 255);
+            ins_chars[n_ins_chars + k2] =
+                static_cast<unsigned char>(kNibChr[nb]);
+          }
+          ins_contig[n_ins] = static_cast<int32_t>(ci);
+          ins_local[n_ins] = static_cast<int32_t>(pos + o);
+          ins_mlen[n_ins] = static_cast<int32_t>(take);
+          n_ins_chars += take;
+          ++n_ins;
+          rc += num;
+          break;
+        }
+        case 'S':
+          rc += num;
+          break;
+        default:  // 'H'
+          break;
+      }
+    }
+    if (bad_base) {
+      n_ins = ins_base;  // roll back; nothing was counted yet
+      n_ins_chars = chars_base;
+      if (strict) {
+        status = kErrorLine;  // replay raises the oracle's KeyError
+        err_off = i;
+        break;
+      }
+      ++n_skipped;
+      i = next;
+      continue;
+    }
+    if (maxdel >= 0 && gaps > maxdel) {
+      for (long k = 0; k < span; ++k)
+        if (dst[k] == kGap) dst[k] = kPad;
+      pads += gaps;
+    }
+    if (span > 0) {
+      // fused counting runs over the CONTIGUOUS row once, segmented or
+      // not (the counts don't care where the slab rows split)
+      if (acc_total_len > 0) {
+        if (acc_direct) {
+          int32_t* ap = acc_ovf + (goff + pos) * 6;
+          for (long k = 0; k < span; ++k) {
+            const unsigned char cd = dst[k];
+            if (cd < 6) ++ap[k * 6 + cd];
+          }
+        } else {
+          count_row_u8(dst, span, goff + pos, acc_u8, acc_ovf, n_banked);
+        }
+      }
+      if (!wide) {
+        if (acc_total_len == 0) memset(dst + span, kPad, width - span);
+        starts[n_rows] = static_cast<int32_t>(goff + pos);
+        ++n_rows;
+      } else {
+        ++n_segmented;
+        for (long lo = 0; lo < span; lo += width) {
+          long len = span - lo;
+          if (len > width) len = width;
+          unsigned char* seg =
+              codes + static_cast<int64_t>(n_rows) * width;
+          memcpy(seg, dst + lo, len);
+          if (len < width) memset(seg + len, kPad, width - len);
+          starts[n_rows] = static_cast<int32_t>(goff + pos + lo);
+          ++n_rows;
+        }
+      }
+      n_events += span - pads;
+    }
+    ++n_reads;
+    i = next;
+  }
+
+  if (status != kOk) --n_lines;  // the flagged record is not consumed
+
+  out[oRows] = n_rows;
+  out[oReads] = n_reads;
+  out[oSkipped] = n_skipped;
+  // always the last whole-record boundary: on kOk a trailing partial
+  // record stays unconsumed and the wrapper carries it into the next
+  // chunk (binary records straddle inflate chunks, unlike text lines)
+  out[oConsumed] = i;
+  out[oIns] = n_ins;
+  out[oInsChars] = n_ins_chars;
+  out[oStatus] = status;
+  out[oErrorOff] = err_off;
+  out[oEvents] = n_events;
+  out[oLines] = n_lines;
+  out[oOverflow] = n_overflow;
+  out[oMaxSpan] = max_span;
+  out[oBanked] = n_banked;
+  out[oSegmented] = n_segmented;
   return status;
 }
 

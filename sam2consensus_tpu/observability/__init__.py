@@ -266,14 +266,18 @@ def publish_stats_extra(extra: dict) -> None:
         # compile/* (jit cache hits/misses, persistent-cache hits) ride
         # the same view: serve-mode amortization claims are checkable
         # from any per-job artifact
+        # format/* (BGZF corrupt-block absorptions, text fallbacks —
+        # sam2consensus_tpu/formats) rides along so a run that survived
+        # a damaged container says so from any artifact
         elif name.startswith(("wire/", "pipeline/", "drift/", "serve/",
-                              "compile/")):
+                              "compile/", "format/")):
             extra[name] = int(value) if float(value).is_integer() \
                 else round(value, 4)
     for gauge_name, extra_key in (("dispatch/tail", "tail_dispatch"),
                                   ("dispatch/pileup", "pileup_path"),
                                   ("wire/codec", "wire"),
                                   ("pipeline/overlap", "pipeline"),
+                                  ("format/input", "input_format"),
                                   ("serve/recovery", "serve_recovery"),
                                   ("serve/watchdog", "serve_watchdog")):
         g = snap["gauges"].get(gauge_name)

@@ -195,23 +195,29 @@ def padded_total_len(total_len: int) -> int:
 
 def canonical_slab_shapes(total_len: int, read_len: int = 150,
                           chunk_reads: int = 262144,
-                          n_reads: Optional[int] = None) -> list:
+                          n_reads: Optional[int] = None,
+                          segment_width: int = 0) -> list:
     """The (rows, width) scatter shapes a job over this genome layout is
     expected to dispatch — the serve-mode prewarm enumeration.
 
     Widths: the power-of-two bucket of ``read_len`` plus its double
     (deletion runs widen a read's reference span past its length;
-    encoder/events._bucket_width).  Rows: the power-of-two row paddings
-    a chunk of ``min(n_reads, chunk_reads)`` reads produces (the
-    accumulator rounds the real row count to a power of two and
-    ``iter_row_slices`` caps a slice at SCATTER_CELL_BUDGET cells), plus
-    one level down for partially-filled tail chunks.  Deliberately a
-    SMALL set — a handful of compiles hidden behind the first job's
-    decode — not an exhaustive sweep; shapes outside it simply compile
-    on first dispatch like today.
+    encoder/events._bucket_width), both clamped to ``segment_width``
+    when the long-read segmented layout is active — segmentation bounds
+    every row at W, so wider shapes can never be dispatched.  Rows: the
+    power-of-two row paddings a chunk of ``min(n_reads, chunk_reads)``
+    reads produces (the accumulator rounds the real row count to a
+    power of two and ``iter_row_slices`` caps a slice at
+    SCATTER_CELL_BUDGET cells), plus one level down for
+    partially-filled tail chunks.  Deliberately a SMALL set — a handful
+    of compiles hidden behind the first job's decode — not an
+    exhaustive sweep; shapes outside it simply compile on first
+    dispatch like today.
     """
     w0 = max(MIN_BUCKET_W, 1 << max(0, (max(1, read_len) - 1).bit_length()))
     widths = [w0, w0 * 2]
+    if segment_width:
+        widths = sorted({min(w, int(segment_width)) for w in widths})
     shapes = []
     for w in widths:
         step = max(1, SCATTER_CELL_BUDGET // w)

@@ -68,7 +68,8 @@ from typing import Dict, List, Optional
 
 SITES = ("device_put", "pileup_dispatch", "accumulate", "vote",
          "insertion_build", "link_probe", "wire_encode",
-         "serve_decode_ahead", "journal_write", "job_hang")
+         "serve_decode_ahead", "journal_write", "job_hang",
+         "bam_inflate")
 
 #: how long a firing ``job_hang`` rule sleeps before raising (seconds);
 #: far past any sane --job-timeout, so the watchdog always wins the race

@@ -96,6 +96,13 @@ def classify(exc: BaseException) -> str:
         return CAPACITY
     if isinstance(exc, (InjectedFatalError, InjectedTraceError)):
         return FATAL
+    if getattr(exc, "transient", False):
+        # self-describing transients (e.g. formats.bgzf.BgzfCorruptBlock:
+        # storage-level bitrot is transport-shaped) — a marker attribute
+        # instead of an import so low layers never cycle into this one.
+        # Checked BEFORE the passthrough types: BgzfCorruptBlock IS a
+        # ValueError, but it is infrastructure damage, not user input.
+        return TRANSIENT
     if isinstance(exc, _PASSTHROUGH_TYPES):
         return PASSTHROUGH
     msg = str(exc)

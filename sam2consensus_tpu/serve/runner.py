@@ -219,8 +219,9 @@ class _DecodeAhead:
             return sum(t1 - t0 for t0, t1 in self._intervals)
 
     def _work(self) -> None:
+        from ..config import resolve_decode_threads
         from ..encoder.events import GenomeLayout
-        from ..io.sam import ReadStream, opener, read_header
+        from ..formats import open_alignment_input
 
         with obs.bind_run_to_thread(self.robs):
             reg = obs.metrics()
@@ -229,10 +230,13 @@ class _DecodeAhead:
             try:
                 if self._fault_cb is not None:
                     self._fault_cb("serve_decode_ahead")
-                handle = opener(self.spec.filename, binary=True)
-                self._handle = handle
-                contigs, _n, first = read_header(handle)
-                stream = ReadStream(handle, first)
+                ai = open_alignment_input(
+                    self.spec.filename,
+                    getattr(self.spec.config, "input_format", "auto"),
+                    binary=True,
+                    threads=resolve_decode_threads(self.spec.config))
+                self._handle = ai
+                contigs, stream = ai.contigs, ai.stream
                 layout = GenomeLayout(contigs)
                 # acc=None: never the fused host-counting encoder — the
                 # job's accumulator does not exist yet.  Same native/py
@@ -436,8 +440,12 @@ class ServeRunner:
                 "ServeRunner.prewarm() for manual shape control)",
                 spec.config.pileup)
             return
+        from ..encoder.events import resolve_segment_width
+
         shapes = canonical_slab_shapes(
-            total_len, chunk_reads=spec.config.chunk_reads)
+            total_len, chunk_reads=spec.config.chunk_reads,
+            segment_width=resolve_segment_width(
+                getattr(spec.config, "segment_width", 0)))
 
         def _worker():
             # one shape per prewarm() call so close() can stop the loop
@@ -469,13 +477,31 @@ class ServeRunner:
         return None
 
     # -- job validation --------------------------------------------------
-    @staticmethod
-    def _validate(spec: JobSpec) -> None:
+    def _validate(self, spec: JobSpec) -> None:
         if spec.config.pileup == "host" and spec.config.shards > 1:
             raise ValueError(
                 "--pileup host accumulates on the single host; it does "
                 "not compose with --shards (same contract as the "
                 "one-shot CLI)")
+        if self.journal is not None:
+            # journal mode injects a per-job checkpoint_dir, and BAM
+            # inputs do not support checkpoint resume yet — failing the
+            # QUEUE up front beats journaling every such job failed
+            # twice (first attempt + host-rung retry)
+            fmt = getattr(spec.config, "input_format", "auto")
+            if fmt == "auto" and os.path.exists(spec.filename):
+                from ..formats import detect_format
+
+                try:
+                    fmt = detect_format(spec.filename)
+                except OSError:
+                    pass
+            if fmt == "bam":
+                raise ValueError(
+                    f"--journal checkpoints every job, and BAM input "
+                    f"{spec.filename!r} does not support checkpoint "
+                    f"resume yet — convert it to SAM/SAM.gz or run the "
+                    f"queue without --journal")
         if spec.config.checkpoint_dir:
             raise ValueError(
                 "serve mode does not compose with --checkpoint-dir: "
@@ -632,8 +658,9 @@ class ServeRunner:
         """Run the queue; returns one :class:`JobResult` per spec, in
         order.  The server survives failed jobs (their error rides the
         result) and stays warm afterwards for the next submit."""
+        from ..config import resolve_decode_threads
+        from ..formats import open_alignment_input
         from ..io.fasta import write_outputs
-        from ..io.sam import ReadStream, opener, read_header
         from ..resilience import ladder as rladder
         from ..wire.pipeline import intersect_sec
 
@@ -807,10 +834,13 @@ class ServeRunner:
                                               "S2C_METRICS_OUT", jobnum),
                     config=cfg)
                 try:
-                    handle = opener(spec.filename, binary=True)
-                    close_handle = handle.close
-                    contigs, _n, first = read_header(handle)
-                    records = ReadStream(handle, first)
+                    ai = open_alignment_input(
+                        spec.filename,
+                        getattr(cfg, "input_format", "auto"),
+                        binary=True,
+                        threads=resolve_decode_threads(cfg))
+                    close_handle = ai.close
+                    contigs, records = ai.contigs, ai.stream
                 except Exception as exc:
                     header_err = exc
             ahead = None
@@ -982,8 +1012,9 @@ class ServeRunner:
         """Re-run a failed job pinned to the host rung, with fresh
         instruments (the abandoned attempt may still hold its own).
         Returns ``(result_or_None, robs, error_or_None)``."""
+        from ..config import resolve_decode_threads
+        from ..formats import open_alignment_input
         from ..resilience import ladder as rladder
-        from ..io.sam import ReadStream, opener, read_header
 
         self.registry.add("serve/job_retries", 1)
         self.echo(f"[serve] {job_id}: retrying on the host rung "
@@ -1014,9 +1045,10 @@ class ServeRunner:
         dlog: List[Tuple[float, float]] = []
         handle = None
         try:
-            handle = opener(spec.filename, binary=True)
-            contigs, _n, first = read_header(handle)
-            records = ReadStream(handle, first)
+            handle = open_alignment_input(
+                spec.filename, getattr(cfg, "input_format", "auto"),
+                binary=True, threads=resolve_decode_threads(cfg))
+            contigs, records = handle.contigs, handle.stream
             out = self._execute(contigs, records, cfg, robs, dlog,
                                 f"{job_id}#retry")
             return out, robs, None

@@ -62,16 +62,26 @@ class SimSpec:
     contig_len_jitter: float = 0.3
     seed: int = 0
     contig_prefix: str = "contig"
+    #: long-read mode (ONT/PacBio-like): every read carries this many
+    #: indel events spread across its length (alternating I/D), instead
+    #: of the at-most-one event the short-read rates draw.  0 keeps the
+    #: legacy single-event path (and its exact rng stream — existing
+    #: seeds stay byte-stable).
+    n_indels: int = 0
 
 
 def simulate(spec: SimSpec) -> str:
     """Generate a deterministic SAM corpus; returns the SAM text."""
     rng = np.random.RandomState(spec.seed)
+    # worst-case reference span a read can consume past its start
+    # (n_indels > 0 may stack several D events; == max_indel for the
+    # legacy path so existing seeds keep their exact streams)
+    margin = spec.max_indel * (spec.n_indels if spec.n_indels > 0 else 1)
     contigs: List[Tuple[str, int]] = []
     genomes: List[np.ndarray] = []
     for i in range(spec.n_contigs):
         jitter = 1.0 + spec.contig_len_jitter * (rng.rand() - 0.5) * 2
-        length = max(spec.read_len + spec.max_indel + 2,
+        length = max(spec.read_len + margin + 2,
                      int(spec.contig_len * jitter))
         contigs.append((f"{spec.contig_prefix}{i:04d}", length))
         genomes.append(rng.randint(0, 4, size=length))
@@ -82,7 +92,7 @@ def simulate(spec: SimSpec) -> str:
         name, length = contigs[ci]
         genome = genomes[ci]
         rl = spec.read_len
-        start = int(rng.randint(0, max(1, length - rl - spec.max_indel)))
+        start = int(rng.randint(0, max(1, length - rl - margin)))
 
         cigar_parts: List[str] = []
         seq_parts: List[str] = []
@@ -104,6 +114,31 @@ def simulate(spec: SimSpec) -> str:
             clip = int(rng.randint(1, 8))
             seq_parts.append("".join(_BASES[c] for c in rng.randint(0, 4, clip)))
             cigar_parts.append(f"{clip}S")
+
+        if spec.n_indels > 0:
+            # dense-indel long read: split the read into n_indels+1 match
+            # chunks with an alternating I/D event between consecutive
+            # chunks — the CIGAR shape that stresses the insertion table
+            # and the segmented slab layout
+            cuts = np.sort(rng.choice(np.arange(1, rl),
+                                      size=min(spec.n_indels, rl - 1),
+                                      replace=False))
+            prev = 0
+            for j, cut in enumerate(cuts):
+                take_match(int(cut) - prev)
+                k = int(rng.randint(1, spec.max_indel + 1))
+                if j % 2 == 0:
+                    seq_parts.append("".join(
+                        _BASES[c] for c in rng.randint(0, 4, k)))
+                    cigar_parts.append(f"{k}I")
+                else:
+                    cigar_parts.append(f"{k}D")
+                    gpos += k
+                prev = int(cut)
+            take_match(rl - prev)
+            reads.append((name, start + 1, "".join(cigar_parts),
+                          "".join(seq_parts)))
+            continue
 
         event = rng.rand()
         if event < spec.ins_read_rate:

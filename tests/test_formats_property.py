@@ -1,0 +1,67 @@
+"""Hypothesis property: arbitrary record sets round-trip SAM↔BAM to
+identical pileup counts and identical FASTA vs the cpu oracle.
+
+Separate module so environments without hypothesis (the ``[dev]``
+extra) skip ONLY the property layer — tests/test_formats.py carries a
+seeded pseudo-property twin that always runs.
+"""
+
+import os
+import sys
+
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sam2consensus_tpu.formats.bam import sam_text_to_bam  # noqa: E402
+from sam2consensus_tpu.utils.simulate import sam_text  # noqa: E402
+
+from test_formats import _jax, run_backend  # noqa: E402
+
+_BASE = st.sampled_from("ACGTN")
+_OP = st.sampled_from("MIDNS")
+
+
+@st.composite
+def _read(draw):
+    n_ops = draw(st.integers(1, 5))
+    cigar = []
+    seq = []
+    span = 0
+    for _ in range(n_ops):
+        o = draw(_OP)
+        n = draw(st.integers(1, 12))
+        cigar.append(f"{n}{o}")
+        if o == "M":
+            seq.append("".join(draw(_BASE) for _ in range(n)))
+            span += n
+        elif o in "DN":
+            span += n
+        else:                       # I / S consume read only
+            seq.append("".join(draw(_BASE) for _ in range(n)))
+    pos = draw(st.integers(1, max(1, 150 - span)))
+    return ("c0", pos, "".join(cigar), "".join(seq) or "*")
+
+
+@settings(max_examples=25, deadline=None,
+          suppress_health_check=[HealthCheck.function_scoped_fixture])
+@given(st.lists(_read(), min_size=1, max_size=10))
+def test_sam_bam_round_trip_identical(tmp_path, reads):
+    text = sam_text([("c0", 200)], reads)
+    sam = str(tmp_path / "x.sam")
+    bam = str(tmp_path / "x.bam")
+    with open(sam, "w") as fh:
+        fh.write(text)
+    sam_text_to_bam(text, bam)
+    out_s, stats_s, lines_s = run_backend(sam)
+    out_b, stats_b, lines_b = run_backend(bam)
+    assert out_s == out_b
+    assert stats_s.aligned_bases == stats_b.aligned_bases
+    assert stats_s.reads_mapped == stats_b.reads_mapped
+    assert lines_s == lines_b
+    out_jb, _st, _l = run_backend(bam, backend=_jax())
+    assert out_jb == out_s

@@ -1,0 +1,121 @@
+#!/usr/bin/env python3
+"""BGZF block-parallel inflate: thread-scaling measurement.
+
+The formats tentpole's decode-side claim is that BGZF's independently
+deflated ≤64 KiB blocks are free parallel-decode shards.  This tool
+measures that on the CURRENT host — raw ordered-reassembly inflate
+throughput (``formats/bgzf.py BgzfReader.read``) and end-to-end BAM
+ingest decode seconds at each thread count, with the host's core count
+recorded so the artifact is honest about whether scaling was possible
+at all (the convention tools/thread_scaling.py set).  One JSON line per
+measurement; serial gzip and the BGZF-SAM/native-text path ride along
+as controls.
+
+Usage: python tools/bgzf_scaling.py [> perf/bgzf_scaling_<r>.jsonl]
+Env: S2C_SCALING_THREADS=1,2,4  BGZF_SCALING_READS=150000
+"""
+
+import gzip
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def emit(row):
+    row["host_cores"] = os.cpu_count()
+    print(json.dumps(row), flush=True)
+
+
+def main():
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.formats import open_alignment_input
+    from sam2consensus_tpu.formats.bam import sam_text_to_bam
+    from sam2consensus_tpu.formats.bgzf import BgzfReader, write_bgzf
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+    threads_list = [int(t) for t in os.environ.get(
+        "S2C_SCALING_THREADS", "1,2,4").split(",")]
+    n_reads = int(os.environ.get("BGZF_SCALING_READS", "150000"))
+
+    spec = SimSpec(n_contigs=1, contig_len=4_600_000, n_reads=n_reads,
+                   read_len=100, ins_read_rate=0.05, del_read_rate=0.05,
+                   contig_len_jitter=0.0, seed=404,
+                   contig_prefix="ecoli")
+    log(f"[sim] {n_reads} reads ...")
+    text = simulate(spec)
+    data = text.encode("ascii")
+    tmp = tempfile.mkdtemp(prefix="bgzf_scaling_")
+    bgz = os.path.join(tmp, "e.sam.gz")
+    write_bgzf(data, bgz)
+    bam = os.path.join(tmp, "e.bam")
+    sam_text_to_bam(text, bam)
+    total_mb = len(data) / 1e6
+
+    # --- raw inflate: serial gzip control ---
+    pgz = os.path.join(tmp, "e.plain.sam.gz")
+    with gzip.open(pgz, "wb") as fh:
+        fh.write(data)
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        with gzip.open(pgz, "rb") as fh:
+            out = fh.read()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    assert out == data
+    emit({"metric": "inflate", "container": "gzip", "threads": 1,
+          "sec": round(best, 4), "mb_per_s": round(total_mb / best, 1),
+          "mb": round(total_mb, 1)})
+    log(f"[inflate] gzip serial: {best:.3f}s "
+        f"({total_mb / best:.0f} MB/s)")
+
+    # --- raw inflate: BGZF at each thread count ---
+    for nt in threads_list:
+        best = None
+        for _ in range(3):
+            r = BgzfReader(bgz, threads=nt)
+            t0 = time.perf_counter()
+            out = r.read()
+            dt = time.perf_counter() - t0
+            r.close()
+            best = dt if best is None else min(best, dt)
+        assert out == data
+        emit({"metric": "inflate", "container": "bgzf", "threads": nt,
+              "sec": round(best, 4),
+              "mb_per_s": round(total_mb / best, 1),
+              "mb": round(total_mb, 1)})
+        log(f"[inflate] bgzf threads={nt}: {best:.3f}s "
+            f"({total_mb / best:.0f} MB/s)")
+
+    # --- end-to-end ingest decode seconds (jax backend, host pileup) ---
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+
+    be = JaxBackend()
+    for label, path in (("bam", bam), ("bgzf_sam", bgz)):
+        for nt in threads_list:
+            best = None
+            for _ in range(3):
+                ai = open_alignment_input(path, binary=True, threads=nt)
+                cfg = RunConfig(prefix="s", backend="jax",
+                                decode_threads=nt)
+                res = be.run(ai.contigs, ai.stream, cfg)
+                ai.close()
+                d = res.stats.extra.get("decode_sec", 0.0)
+                best = d if best is None else min(best, d)
+            emit({"metric": "ingest_decode", "format": label,
+                  "threads": nt, "decode_sec": round(best, 4),
+                  "reads": n_reads})
+            log(f"[ingest] {label} threads={nt}: decode {best:.3f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,173 @@
+#!/usr/bin/env python3
+"""Deterministic input-format fixtures: paired SAM / BAM / BGZF-SAM
+corpora with pinned oracle outputs, committed under tests/data/.
+
+Every fixture family is generated from a SEEDED simulator (no clocks,
+no environment, no htslib) and written by the pure-stdlib writers in
+``sam2consensus_tpu/formats`` — so a regenerate is byte-identical and
+the tool is an idempotent campaign step (existing, digest-matching
+fixtures are left untouched; ``--force`` rewrites; a digest MISMATCH
+exits 1, because it means the generators drifted from the committed
+corpus and tests downstream are pinning stale bytes).
+
+Families:
+
+* ``formats_short``   — short reads, mixed indels/clips, 3 contigs; the
+  SAM↔BAM↔BGZF equivalence corpus.
+* ``formats_longread``— ONT/PacBio-like dense-indel long reads (3 kb,
+  ~20 indel events each): exercises the segmented slab layout and the
+  insertion table under long-CIGAR stress.
+* ``formats_adversarial`` — hand-built records: a read wider than any
+  slab bucket, an insertion run > 255 bases, an all-indel read (zero
+  M ops), a POS-0 leading-deletion read, and an end-anchored read.
+
+Each family writes ``<stem>.sam``, ``<stem>.bam``, ``<stem>.sam.gz``
+(BGZF), ``<stem>.plain.sam.gz`` (single-member gzip, the serial-decode
+control) and ``<stem>.expected.fasta`` — the CPU golden oracle's
+rendered output (t=0.25, no wrap), the byte-identity target every
+format path must hit.
+"""
+
+import argparse
+import gzip
+import hashlib
+import io
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sam2consensus_tpu.backends.cpu import CpuBackend  # noqa: E402
+from sam2consensus_tpu.config import RunConfig  # noqa: E402
+from sam2consensus_tpu.formats.bam import sam_text_to_bam  # noqa: E402
+from sam2consensus_tpu.formats.bgzf import write_bgzf  # noqa: E402
+from sam2consensus_tpu.io.fasta import render_file  # noqa: E402
+from sam2consensus_tpu.io.sam import ReadStream, read_header  # noqa: E402
+from sam2consensus_tpu.utils.simulate import (SimSpec, sam_text,  # noqa: E402
+                                              simulate)
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "data")
+
+
+def adversarial_text() -> str:
+    """Hand-specified records targeting the long-read escape lanes."""
+    contigs = [("adv0", 9000), ("adv1", 600)]
+    reads = [
+        # 1. wider than any default slab bucket (span 8000 > 4096):
+        #    splits into segment rows under the segmented layout
+        ("adv0", 101, "8000M", "A" * 8000),
+        # 2. insertion run > 255 (motif length 300) — n_cols / delta8
+        #    escape-lane stress at one site
+        ("adv0", 501, "100M300I100M", "C" * 100 + "G" * 300 + "T" * 100),
+        # 3. all-indel read: zero M ops — span comes entirely from D,
+        #    SEQ is consumed by I/S only
+        ("adv0", 1001, "40I200D10S", "A" * 50),
+        # 4. leading deletion at POS 1 (0-based 0) — gap-start row
+        ("adv1", 1, "30D50M", "N" * 50),
+        # 5. end-anchored read, exact tail fit
+        ("adv1", 551, "50M", "G" * 50),
+        # 6. deep stack over the >255-insertion site so coverage
+        #    completion (quirk 4) goes through the escape lane too
+        *[("adv0", 501, "200M", "A" * 200) for _ in range(7)],
+        # 7. an unmapped record (CIGAR "*"), skipped but counted
+        ("adv0", 1, "*", "*"),
+    ]
+    return sam_text(contigs, reads)
+
+
+FAMILIES = {
+    "formats_short": lambda: simulate(SimSpec(
+        n_contigs=3, contig_len=700, n_reads=420, read_len=80,
+        ins_read_rate=0.12, del_read_rate=0.12, softclip_rate=0.08,
+        seed=1401, contig_prefix="fshort")),
+    "formats_longread": lambda: simulate(SimSpec(
+        n_contigs=2, contig_len=22000, n_reads=64, read_len=3000,
+        n_indels=20, max_indel=6, contig_len_jitter=0.0,
+        seed=1402, contig_prefix="ont")),
+    "formats_adversarial": adversarial_text,
+}
+
+
+def oracle_fasta(text: str) -> str:
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    cfg = RunConfig(prefix="fixture", outfolder="./")
+    res = CpuBackend().run(contigs, ReadStream(handle, first), cfg)
+    return "".join(render_file(res.fastas[name], 0)
+                   for name in (c.name for c in contigs)
+                   if name in res.fastas)
+
+
+def build_family(stem: str, text: str) -> dict:
+    """All artifact payloads for one family, as {filename: bytes}."""
+    out = {f"{stem}.sam": text.encode("ascii")}
+    from sam2consensus_tpu.formats.bam import (bam_payload,
+                                               sam_text_to_records)
+    from sam2consensus_tpu.formats.bgzf import BGZF_EOF, compress_block
+
+    # the SAME parse the bench converter uses (formats/bam.py), so the
+    # committed fixtures can never drift from in-bench conversions
+    payload = bam_payload(*sam_text_to_records(text))
+    frames = [compress_block(payload[o:o + 60000])
+              for o in range(0, len(payload), 60000)]
+    out[f"{stem}.bam"] = b"".join(frames) + BGZF_EOF
+    # BGZF-compressed SAM (small blocks so even the tiny fixtures span
+    # multiple blocks — the parallel-inflate path gets real work)
+    data = text.encode("ascii")
+    bgzf_frames = [compress_block(data[o:o + 16384])
+                   for o in range(0, len(data), 16384)]
+    out[f"{stem}.sam.gz"] = b"".join(bgzf_frames) + BGZF_EOF
+    # plain single-member gzip control (mtime pinned: deterministic)
+    buf = io.BytesIO()
+    with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as gz:
+        gz.write(data)
+    out[f"{stem}.plain.sam.gz"] = buf.getvalue()
+    out[f"{stem}.expected.fasta"] = oracle_fasta(text).encode("ascii")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--force", action="store_true",
+                    help="rewrite fixtures even when they exist and match")
+    ap.add_argument("--out", default=DATA_DIR,
+                    help=f"output directory (default {DATA_DIR})")
+    args = ap.parse_args(argv)
+
+    os.makedirs(args.out, exist_ok=True)
+    wrote = kept = 0
+    drifted = []
+    for stem, gen in sorted(FAMILIES.items()):
+        payloads = build_family(stem, gen())
+        for name, blob in sorted(payloads.items()):
+            path = os.path.join(args.out, name)
+            if os.path.exists(path) and not args.force:
+                with open(path, "rb") as fh:
+                    have = fh.read()
+                if have == blob:
+                    kept += 1
+                    continue
+                drifted.append(name)
+                print(f"DRIFT {name}: committed "
+                      f"{hashlib.sha256(have).hexdigest()[:12]} vs "
+                      f"regenerated "
+                      f"{hashlib.sha256(blob).hexdigest()[:12]}",
+                      file=sys.stderr)
+                continue
+            with open(path, "wb") as fh:
+                fh.write(blob)
+            wrote += 1
+            print(f"wrote {name} ({len(blob)} B, sha256 "
+                  f"{hashlib.sha256(blob).hexdigest()[:12]})")
+    print(f"fixtures: {wrote} written, {kept} verified-unchanged, "
+          f"{len(drifted)} drifted")
+    if drifted:
+        print("generator/fixture drift — regenerate with --force and "
+              "review the diff", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,12 @@ if [ "$up" != "1" ]; then
   exit 1
 fi
 
+# 1b. input-format fixtures (idempotent by construction: the tool
+# verifies committed fixtures against a seeded regenerate and exits 1
+# on drift — so this step doubles as the corpus-integrity check)
+run_step format_fixtures "campaign/format_fixtures_$R.txt" - 600 \
+  python tools/make_format_fixtures.py
+
 # 2. cpu backend coexistence (the host-tail gate depends on it)
 run_step cpu_coexist "campaign/cpu_coexist_$R.txt" - 300 python -c "
 import jax, numpy as np
@@ -121,6 +127,25 @@ S2C_WIRE=delta8 S2C_SYNC_ACCUMULATE=1 BENCH_CONFIGS=north_star \
 run_step serve_bench "campaign/serve_bench_$R.jsonl" \
   "campaign/serve_bench_stderr_$R.log" 2400 \
   python tools/serve_bench.py --jobs 8
+
+# 4f. input-format bench legs (formats tentpole evidence): the BAM
+# ingest row vs its BGZF-SAM "equivalent gzip-SAM" twin (same corpus,
+# one oracle) and the dense-indel long-read row — decode_sec per row is
+# the block-parallel + binary-record claim, byte-identity per row the
+# correctness gate.  CPU-fallback harness proof:
+# perf/bench_formats_r06_cpufallback.json
+BENCH_CONFIGS=ecoli_bam,longread_ont BENCH_SERVE_JOBS=0 \
+  BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 \
+  BENCH_FULL_OUT="campaign/formats_bench_$R.full.json" \
+  run_step formats_bench "campaign/formats_bench_$R.json" \
+  "campaign/formats_bench_stderr_$R.log" 3600 python bench.py
+
+# 4g. BGZF inflate thread scaling (decode-shard claim): raw ordered
+# inflate MB/s + end-to-end ingest decode_sec at 1/2/4 threads, serial
+# gzip control, host core count recorded.  CPU-fallback harness proof:
+# perf/bgzf_scaling_r06_cpufallback.jsonl
+run_step bgzf_scaling "campaign/bgzf_scaling_$R.jsonl" \
+  "campaign/bgzf_scaling_stderr_$R.log" 1800 python tools/bgzf_scaling.py
 
 # 5. packed5 output-encoding measurement (sets S2C_P5_DEV_NS evidence)
 run_step measure_p5 "campaign/measure_p5_$R.jsonl" \
