@@ -1669,20 +1669,30 @@ class JaxBackend:
                 fuse = (isinstance(acc, HostPileupAccumulator)
                         and not cfg.paranoid)
                 threads = _resolve_decode_threads(cfg)
-                if fuse and threads > 1 and not cfg.checkpoint_dir:
-                    # multi-core hosts: parallel fused decode (per-worker
-                    # count tensors summed at the end; checkpointing
-                    # needs ordered offsets, so it keeps the serial path)
+                parallel = (threads > 1 and not cfg.checkpoint_dir
+                            and not cfg.paranoid)
+                self._record_decode_decision(cfg, records, threads,
+                                             parallel, fuse)
+                if parallel:
+                    # multi-core hosts: shard-owned ingest
+                    # (encoder/parallel_decode.py) — byte-range workers
+                    # decode GIL-free into per-worker partitions merged
+                    # via s2c_merge_u8 (fused), or emit slabs straight
+                    # into the wire-encode/staging pipeline (device
+                    # path).  Checkpointing needs ordered consumption
+                    # offsets and paranoid wants ordered re-validated
+                    # batches, so both keep the serial path.
                     from ..encoder.parallel_decode import \
                         ParallelFusedDecoder
 
                     enc = ParallelFusedDecoder(
-                        layout, acc.counts_host(), threads,
+                        layout, acc.counts_host() if fuse else None,
+                        threads,
                         maxdel=cfg.maxdel, strict=cfg.strict,
                         on_lines=records.add_lines,
                         on_bytes=records.add_bytes,
                         segment_width=seg_w)
-                    return enc, enc.encode_blocks(records.blocks())
+                    return enc, enc.encode_input(records)
                 enc = native_encoder.NativeReadEncoder(
                     layout, maxdel=cfg.maxdel, strict=cfg.strict,
                     on_lines=records.add_lines, on_bytes=records.add_bytes,
@@ -1700,6 +1710,70 @@ class JaxBackend:
         source = records.records() if isinstance(records, ReadStream) \
             else records
         return enc, enc.encode_segments(source, cfg.chunk_reads)
+
+    @staticmethod
+    def _record_decode_decision(cfg, records, threads: int,
+                                parallel: bool, fuse: bool = True) -> None:
+        """Ledger the ``--decode-threads`` policy like every other
+        priced gate: predicted decode seconds (body bytes over the
+        measured per-core shard-decode rate, scaled by the thread
+        count with a parallel-efficiency factor) joined at run end
+        against the run's real ``phase/decode_sec`` — so a host where
+        the shard scheduler stops scaling (memory-bandwidth-bound, or
+        an input stuck on the streaming rung) shows up as residual
+        drift in the manifest instead of silently recording the
+        single-core floor (the round-5 verdict's gap)."""
+        def _envf(name, default):
+            # telemetry-only knobs: a malformed value falls back to the
+            # default instead of failing the run before decode starts
+            try:
+                return float(os.environ.get(name, default))
+            except ValueError:
+                return float(default)
+
+        rate = _envf("S2C_DECODE_MBPS_PER_CORE", "330") * 1e6
+        eff = _envf("S2C_DECODE_PAR_EFF", "0.85")
+        cores = os.cpu_count() or 1
+        inputs = {"threads": int(threads),
+                  "requested": int(getattr(cfg, "decode_threads", 1)),
+                  "cores": int(cores), "parallel": bool(parallel),
+                  "rung": "fused" if fuse else "slab"}
+        # priced only for plain uncompressed files (ReadStream owns the
+        # ONE plain-file rule: a gzip handle's fstat size is COMPRESSED
+        # bytes while decode_sec walks uncompressed text) and only on
+        # fresh runs — checkpoint resume decodes the un-committed
+        # remainder while fstat sees the whole body; either would
+        # manufacture drift
+        body_bytes = None
+        probe = getattr(records, "body_bytes_total", None)
+        if probe is not None and not getattr(cfg, "checkpoint_dir", None):
+            body_bytes = probe()
+        predicted = {}
+        alternatives = {}
+        if body_bytes is not None:
+            inputs["body_bytes"] = int(body_bytes)
+            serial_sec = body_bytes / rate
+
+            def _sec(n):
+                speedup = 1.0 + (n - 1) * eff if n > 1 else 1.0
+                return serial_sec / speedup
+
+            n_eff = min(threads, cores) if parallel else 1
+            predicted["sec"] = _sec(n_eff)
+            alternatives = {"1": serial_sec,
+                            str(cores): _sec(cores)}
+        # the model prices decode WORK; phase/decode_sec measures decode
+        # WALL.  On the fused host rung they coincide, so the drift band
+        # is enforced.  On the slab (device) rung the whole point of the
+        # pipeline is wall << work — decode hides under wire encode and
+        # dispatch — so the decision is informational there (band=0:
+        # residual still joined into the manifest, no false alarm)
+        obs.record_decision(
+            "decode_threads", str(threads if parallel else 1),
+            inputs=inputs, predicted=predicted,
+            alternatives=alternatives,
+            measured={"sec": {"counters": ["phase/decode_sec"]}},
+            band=None if fuse or not parallel else 0.0)
 
     @staticmethod
     def _record_layout_decision(cfg, seg_w: int) -> None:

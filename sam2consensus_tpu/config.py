@@ -44,7 +44,8 @@ class RunConfig:
     wire: str = "auto"           # auto | packed5 | delta8 (h2d row wire codec,
     #                              sam2consensus_tpu/wire; auto prices the
     #                              measured link rate)
-    decode_threads: int = 1      # fused-decode workers; 0 = auto (<=4)
+    decode_threads: int = 1      # ingest decode workers; 0 = auto (all
+    #                              cores, S2C_DECODE_THREADS_CAP pins)
     ins_kernel: str = "auto"  # auto | scatter | pallas (insertion table)
     shard_mode: str = "auto"     # auto | dp | sp | dpsp (accumulator layout)
     incremental: bool = False    # keep/extend checkpoints across input files
@@ -73,13 +74,31 @@ class RunConfig:
 
 
 def resolve_decode_threads(cfg) -> int:
-    """``--decode-threads`` with 0 = auto (up to 4 cores); ONE policy
-    shared by the fused decode, the native vote tail and the BGZF
-    inflate pool (formats/bgzf.py) — "shared with the native decoder"
-    by construction."""
+    """``--decode-threads`` with 0 = auto; ONE policy shared by the
+    shard scheduler (encoder/parallel_decode.py), the native vote tail
+    and the BGZF inflate pool (formats/bgzf.py + ingest.shared_pool) —
+    "shared with the native decoder" by construction.
+
+    Auto means ALL cores.  The old hard cap of 4 was an unmeasured
+    guess from a one-core bench host; the committed scaling artifact
+    (``perf/thread_scaling_r08.jsonl``) shows the shard-owned decode
+    tracking core count on the hosts we can measure (1.9x at 2 threads
+    on the 2-core rig, where the retired feed-thread design managed
+    1.1x), with no knee below the host's core count — so the policy cap
+    is the core count itself.  The real guards are elsewhere: the
+    sharded decoder's ``EXTRA_COUNTS_BUDGET`` clamps workers on huge
+    genomes (memory, the one measured failure mode), and
+    ``S2C_DECODE_THREADS_CAP`` lets shared hosts pin a smaller budget
+    without touching per-run flags."""
     threads = getattr(cfg, "decode_threads", 1)
     if threads == 0:
-        threads = min(4, os.cpu_count() or 1)
+        threads = os.cpu_count() or 1
+        try:
+            cap = int(os.environ.get("S2C_DECODE_THREADS_CAP", "0"))
+        except ValueError:
+            cap = 0
+        if cap > 0:
+            threads = min(threads, cap)
     return max(1, threads)
 
 

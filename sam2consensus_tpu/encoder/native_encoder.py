@@ -66,7 +66,7 @@ class NativeReadEncoder:
                  strict: bool = True, width: int = 256,
                  on_lines=None, on_bytes=None,
                  accumulate_into: Optional[np.ndarray] = None,
-                 segment_width: int = 0):
+                 segment_width: int = 0, private_counts: bool = False):
         lib = native.load()
         if lib is None:  # pragma: no cover - callers check available()
             raise RuntimeError(f"native decoder unavailable: "
@@ -93,6 +93,14 @@ class NativeReadEncoder:
         # batches carry only counters.  Python-fallback reads accumulate
         # into ``accumulate_into`` directly via numpy.
         self._acc = accumulate_into
+        #: shard-worker mode (encoder/parallel_decode.py): the decode
+        #: pass must never touch ``accumulate_into`` directly — counts
+        #: stay in this encoder's PRIVATE uint8 shadow / int32 bank
+        #: partitions until the coordinator calls :meth:`merge_shadow`
+        #: after every shard succeeded, so a failed shard can be
+        #: retried (or the whole ingest demoted) without ever having
+        #: corrupted the shared tensor
+        self._private = bool(private_counts)
         if accumulate_into is not None:
             if accumulate_into.shape != (layout.total_len, 6) \
                     or accumulate_into.dtype != np.int32 \
@@ -109,7 +117,11 @@ class NativeReadEncoder:
             self._acc_direct = fused_direct_mode(layout.total_len)
             if self._acc_direct:
                 self._acc_u8 = np.zeros(6, dtype=np.uint8)   # unused
-                self._acc_ovf = self._acc_flat
+                # private direct mode: a full private int32 partition
+                # stands in for the shared tensor until merge time
+                self._acc_ovf = np.zeros(layout.total_len * 6,
+                                         dtype=np.int32) \
+                    if self._private else self._acc_flat
             else:
                 # np.zeros -> calloc: the overflow bank's pages only
                 # materialize where depth actually passes 255
@@ -117,12 +129,18 @@ class NativeReadEncoder:
                                         dtype=np.uint8)
                 self._acc_ovf = np.zeros(layout.total_len * 6,
                                          dtype=np.int32)
+            # where python-replayed fallback lines count: the shared
+            # tensor normally; the private int32 bank/partition in
+            # shard-worker mode (the bank is exact — merge adds it)
+            self._fb_acc = self._acc if not self._private \
+                else self._acc_ovf.reshape(layout.total_len, 6)
         else:
             self._acc_direct = False
             self._acc_flat = np.zeros(6, dtype=np.int32)   # dummy, len 0
             self._acc_u8 = np.zeros(6, dtype=np.uint8)
             self._acc_ovf = np.zeros(6, dtype=np.int32)
             self._acc_len = 0
+            self._fb_acc = None
         #: saturation wraps the C side banked into ``_acc_ovf`` since the
         #: last merge — 0 means the bank is all zeros and its fold is a
         #: no-op merge_shadow can skip
@@ -176,11 +194,22 @@ class NativeReadEncoder:
         self._batch_reads = 0
         self._batch_events = 0
 
-        # persistent insertion/overflow buffers (copied out per call)
+        # persistent insertion/overflow buffers, allocated ONCE and
+        # reused across calls (contents are copied out per call below).
+        # They used to be allocated per chunk iteration; at ~1.3 MB a
+        # set that is an mmap+munmap pair per chunk through glibc,
+        # whose mmap_sem write locks serialize the OTHER decode
+        # workers' page faults — measured as most of the gap between
+        # raw-C and full-path shard scaling on the 2-core rig
         ins_cap = 1 << 16
         chars_cap = 1 << 20
         ovf_cap = 4096
         out = np.zeros(16, dtype=np.int64)
+        ic = np.empty(ins_cap, dtype=np.int32)
+        il = np.empty(ins_cap, dtype=np.int32)
+        im = np.empty(ins_cap, dtype=np.int32)
+        ich = np.empty(chars_cap, dtype=np.uint8)
+        ovf = np.empty(ovf_cap, dtype=np.int64)
 
         for text in blocks:
             if isinstance(text, str):
@@ -189,12 +218,20 @@ class NativeReadEncoder:
             offset = 0
             while offset < len(data):
                 chunk = data[offset:]
-                ic = np.empty(ins_cap, dtype=np.int32)
-                il = np.empty(ins_cap, dtype=np.int32)
-                im = np.empty(ins_cap, dtype=np.int32)
-                ich = np.empty(chars_cap, dtype=np.uint8)
-                ovf = np.empty(ovf_cap, dtype=np.int64)
-
+                # NOT dead code: the status==1/consumed==0 branch below
+                # doubles the caps when a single line overruns the
+                # insertion buffers — these guards are where the arrays
+                # actually grow before the retry call (the C decoder is
+                # told the cap, so cap > len(array) would write past
+                # the end)
+                if len(ic) < ins_cap:
+                    ic = np.empty(ins_cap, dtype=np.int32)
+                    il = np.empty(ins_cap, dtype=np.int32)
+                    im = np.empty(ins_cap, dtype=np.int32)
+                if len(ich) < chars_cap:
+                    ich = np.empty(chars_cap, dtype=np.uint8)
+                if len(ovf) < ovf_cap:
+                    ovf = np.empty(ovf_cap, dtype=np.int64)
                 fill = self._fill
                 self._lib.s2c_decode(
                     chunk, len(chunk),
@@ -282,7 +319,10 @@ class NativeReadEncoder:
                 if batch is not None:
                     yield batch
 
-        self.merge_shadow()
+        if not self._private:
+            # shard workers defer the merge to the coordinator (after
+            # every shard succeeded); everyone else folds at stream end
+            self.merge_shadow()
         batch = self._flush()
         if batch is not None:
             yield batch
@@ -302,7 +342,15 @@ class NativeReadEncoder:
         (``out[oBanked]``) — at typical coverage the bank is untouched
         and its two full-tensor passes were the dominant merge cost
         (measured ~100 ms of the ~200 ms merge at 4.6 Mbp)."""
-        if self._acc is None or self._acc_direct:
+        if self._acc is None:
+            return
+        if self._acc_direct:
+            if not self._private:
+                return          # counts went straight into the pileup
+            # private direct partition: one widen-add into the shared
+            # tensor (the coordinator serializes these across workers)
+            np.add(self._acc_flat, self._acc_ovf, out=self._acc_flat)
+            self._acc_ovf[:] = 0
             return
         # the .so is source-hash-keyed (native/_build_so), so the symbol
         # always matches this file's expectations — no fallback branch
@@ -363,10 +411,16 @@ class NativeReadEncoder:
             for start_flat, row in rows:
                 if self._acc is not None:
                     # fused pileup: count the replayed row immediately
+                    # (into the private bank in shard-worker mode, so
+                    # the shared tensor stays untouched until merge —
+                    # the bank is exact, so marking it dirty via
+                    # ``_banked`` folds it like a saturation wrap)
                     cols = np.nonzero(row < 6)[0]
                     pos = start_flat + cols
                     ok = (pos >= 0) & (pos < self._acc_len)
-                    np.add.at(self._acc, (pos[ok], row[cols[ok]]), 1)
+                    np.add.at(self._fb_acc, (pos[ok], row[cols[ok]]), 1)
+                    if self._private and not self._acc_direct and len(cols):
+                        self._banked += 1
                     self._batch_events += len(cols)
                 else:
                     self._fallback_rows.append((start_flat, row))

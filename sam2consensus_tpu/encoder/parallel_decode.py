@@ -1,30 +1,59 @@
-"""Multi-threaded fused decode+accumulate for multi-core hosts.
+"""Sharded multi-core ingest: byte-range workers own the decode.
 
-The fused host-counts path (``native_encoder.NativeReadEncoder`` with
-``accumulate_into``) is a single pass over the SAM text at ~500 MB/s per
-core.  The measurement host fronting the tunneled chip has ONE core, but
-production TPU-VM hosts have many — and the count tensor is
-sum-decomposable, so the pass parallelizes exactly:
+The first multi-threaded decoder fed workers from a Python coordinator
+thread — the stream's blocks round-robined into bounded per-worker
+queues.  Measured on a 2-core host that design scaled 1.1x where the
+embarrassingly-parallel native vote scaled 2.6x: the feed thread's
+block slicing, queue puts and drain polling all run under the GIL,
+serializing against the workers' Python-side slab bookkeeping.  This
+rewrite removes the coordinator from the hot path entirely:
 
-* the input stream's line-aligned blocks round-robin into bounded
-  per-worker queues;
-* each worker owns a full fused decoder — its own slab scratch, its own
-  insertion store, its own ``[L, 6]`` count tensor — and the C decode
-  releases the GIL, so workers run truly parallel;
-* counts sum at the end (addition commutes: same guarantee the dp
-  reduce-scatter relies on, SURVEY.md §5); insertion stores concatenate
-  (grouping sorts by site key, so inter-store order is irrelevant);
-* strict-mode error parity: the serial path raises at the FIRST bad
-  input line.  Blocks are fed in stream order and processed in order
-  within each worker, so when workers fail the smallest failing block
-  index is exactly the first bad line of the stream; its exception is
-  re-raised after the join.  Feeding stops at the first observed
-  failure (the serial path would not have read further either).
+* the input is split ONCE into record-aligned byte ranges
+  (``ingest.plan_byte_shards``: mmap + line-boundary snapping — every
+  SAM line starts in exactly one shard);
+* each worker OWNS a shard: it slices zero-copy ``memoryview`` windows
+  off the map and runs the native decoder GIL-free over them — no
+  queue, no feed thread, no shared mutable state during decode;
+* counts land in per-worker partitions — the fused decoder's private
+  uint8 shadow + int32 overflow bank (``NativeReadEncoder
+  private_counts=True``), 1.25 count-tensor-equivalents per extra
+  worker instead of the old 2.25 — and merge into the run's single
+  int32 tensor through the existing ``s2c_merge_u8`` SIMD fold, only
+  after EVERY shard has succeeded (a failing shard can therefore retry
+  or demote without ever corrupting the merge);
+* error parity with the serial path is structural: shards are disjoint
+  and ordered, so the earliest-SHARD error is the earliest-offset
+  error; within a shard the worker's sequential decode surfaces its
+  first error first.  Workers past a failed shard stop at the next
+  sub-block boundary (the serial path would not have read further);
+  workers before it run to completion so an even-earlier error still
+  wins.  Decode-semantics errors (the replayed Python exception types)
+  re-raise exactly; anything else — an injected ``ingest_decode_shard``
+  fault, MemoryError — retries the shard once on a fresh encoder and
+  then demotes the WHOLE ingest to the serial rung (fresh pass over the
+  full input against zeroed counts), counted as ``ingest/demoted``.
+
+Two output modes share the machinery:
+
+* **fused** (``counts`` given — the host-pileup path): batches are
+  counters-only; each worker holds its batches until its shard commits
+  so a retry/demotion never double-counts, then the coordinator yields
+  them all after the merge;
+* **slab** (``counts=None`` — the device path): workers emit real
+  row slabs into a bounded hand-off queue as they fill, and the
+  consumer (the backend's prefetch thread) wire-encodes and stages
+  them while later shards are still decoding — decode → encode →
+  ``device_put`` as one overlapped pipeline.  Addition commutes, so
+  inter-shard batch order is irrelevant to the counts.
+
+Inputs that cannot be byte-sharded — gzip streams (non-splittable),
+BGZF text (parallel at the inflate layer already), in-memory handles —
+degrade to the STREAMING rung: the original queue-feed coordinator,
+kept as ``encode_blocks``, counted as ``ingest/fallback``.
 
 Not composable with checkpointing (checkpoints need ordered consumption
-offsets) or paranoid mode (which wants row batches); the backend gates
-accordingly.  With one worker the class degrades to the serial fused
-path plus one queue hop.
+offsets) or paranoid mode (which re-validates ordered row batches); the
+backend gates accordingly.
 """
 
 from __future__ import annotations
@@ -32,117 +61,454 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from .. import observability as obs
-from .events import GenomeLayout, InsertionEvents, SegmentBatch
+from ..ingest import DEFAULT_MIN_SHARD_BYTES, ShardPlan, snap_line_start
+from ..resilience.faultinject import fault_check
+from .events import (EncodeError, GenomeLayout, InsertionEvents,
+                     SegmentBatch)
 from .native_encoder import NativeReadEncoder
+
+#: decode-semantics exceptions (the replayed python parser/encoder
+#: errors whose type+message parity with the serial path is contract);
+#: everything else is infrastructure and goes to the retry/demote path
+PARITY_ERRORS = (EncodeError, KeyError, IndexError, ValueError,
+                 OverflowError, UnicodeDecodeError)
+
+#: sub-block feed granularity inside a shard: line-snapped windows this
+#: size bound the abort-check latency and keep the fused stats cadence
+SHARD_BLOCK_BYTES = 1 << 23
 
 
 class ParallelFusedDecoder:
-    """Same surface as NativeReadEncoder for the backend's accumulate loop
-    (``insertions`` / ``n_reads`` / ``n_skipped`` / ``encode_blocks``)."""
+    """Same surface as NativeReadEncoder for the backend's accumulate
+    loop (``insertions`` / ``n_reads`` / ``n_skipped`` / ``counts_fused``
+    / ``encode_blocks``), plus the shard scheduler (``encode_input`` /
+    ``encode_shards``).  ``counts=None`` selects slab mode."""
 
     _DONE = object()
 
-    #: per-worker count tensors are capped to this much extra memory in
-    #: total; workers clamp down on huge genomes rather than OOM the
+    #: per-worker count partitions are capped to this much extra memory
+    #: in total; workers clamp down on huge genomes rather than OOM the
     #: large-genome runs the feature exists to speed up
     EXTRA_COUNTS_BUDGET = 512 << 20
 
-    def __init__(self, layout: GenomeLayout, counts: np.ndarray,
-                 n_threads: int, maxdel: Optional[int] = 150,
+    def __init__(self, layout: GenomeLayout,
+                 counts: Optional[np.ndarray], n_threads: int,
+                 maxdel: Optional[int] = 150,
                  strict: bool = True, on_lines=None, on_bytes=None,
                  segment_width: int = 0):
         self._segment_width = segment_width
         self.layout = layout
-        self._counts = counts                 # worker 0 writes here
-        # per-extra-worker memory: its int32 count tensor, plus — in
-        # shadow mode only — the fused decoder's uint8 shadow and (worst
-        # case, deep coverage) int32 overflow bank, 2.25x the tensor
-        # alone.  Direct mode (huge genomes) allocates neither, and is
-        # exactly where under-capping would hurt most.
-        from .native_encoder import fused_direct_mode
-
-        if fused_direct_mode(layout.total_len):
-            extra_each = max(1, counts.nbytes)
+        self._counts = counts
+        self.maxdel = maxdel
+        self.strict = strict
+        self._direct = False
+        self._merge_lock = threading.Lock()
+        if counts is None:
+            self.n_threads = max(1, n_threads)
         else:
-            extra_each = max(1, counts.nbytes + (counts.nbytes * 5) // 4)
-        cap = 1 + self.EXTRA_COUNTS_BUDGET // extra_each
-        self.n_threads = max(1, min(n_threads, cap))
-        #: counting is fused into the worker decode passes (batches are
-        #: counters-only), and the workers already overlap — the
+            # per-extra-worker memory: shadow mode holds a uint8 shadow
+            # + int32 bank (1.25x the count tensor — the old design's
+            # private int32 tensor on top of those is gone: workers
+            # merge straight into the shared tensor via s2c_merge_u8);
+            # direct mode (huge genomes) holds one private int32
+            # partition.  Worker 0 always writes the shared tensor.
+            from .native_encoder import fused_direct_mode
+
+            self._direct = fused_direct_mode(layout.total_len)
+            if self._direct:
+                extra_each = max(1, counts.nbytes)
+            else:
+                extra_each = max(1, (counts.nbytes * 5) // 4)
+            cap = 1 + self.EXTRA_COUNTS_BUDGET // extra_each
+            self.n_threads = max(1, min(n_threads, cap))
+        #: fused mode: counting rides the worker decode passes (batches
+        #: are counters-only) and the workers already overlap — the
         #: backend's extra prefetch thread would be pure overhead
-        self.counts_fused = True
+        self.counts_fused = counts is not None
         self.insertions = InsertionEvents()
         self.n_reads = 0
         self.n_skipped = 0
         self._on_lines = on_lines
         self._on_bytes = on_bytes
-        self._workers: List[dict] = []
-        for w in range(self.n_threads):
-            target = counts if w == 0 else np.zeros_like(counts)
-            state = {
-                "counts": target, "q": queue.Queue(maxsize=2),
-                "batches": [], "error": None, "lines": 0, "bytes": 0,
-                "idx": w,
-            }
 
-            def _count(key, st=state):
-                def cb(k):
-                    st[key] += k
-                return cb
+    # ------------------------------------------------------------------
+    def _private_for(self, idx: int) -> bool:
+        """Shard-worker count-partition policy.  Shadow mode: EVERY
+        worker is private (the shadow+bank cost the same either way)
+        and merges its partition at its own stream end under the shared
+        merge lock — merges overlap slower workers' decode, and the
+        shared tensor is only ever touched lock-serialized.  Direct
+        mode (huge genomes): a private partition is a full int32
+        tensor, so worker 0 writes the shared tensor in place (its
+        retry scrubs it) and the private partitions fold post-join."""
+        if self._counts is None:
+            return False
+        return not self._direct or idx > 0
 
-            enc = NativeReadEncoder(layout, maxdel=maxdel, strict=strict,
-                                    accumulate_into=target,
-                                    on_lines=_count("lines"),
-                                    on_bytes=_count("bytes"),
-                                    segment_width=segment_width)
-            state["enc"] = enc
-            self._workers.append(state)
+    # ------------------------------------------------------------------
+    def _mk_encoder(self, st: dict, private: bool) -> NativeReadEncoder:
+        """A fresh worker encoder counting lines/bytes into ``st``."""
 
-    def _any_error(self) -> bool:
-        return any(st["error"] is not None for st in self._workers)
+        def _count(key):
+            def cb(k):
+                st[key] += k
+            return cb
 
-    # -- worker ------------------------------------------------------------
-    def _work(self, state: dict) -> None:
-        enc: NativeReadEncoder = state["enc"]
-        current_idx = [None]
-        # capture the RUN's tracer and registry at thread start: a
-        # worker that outlives the run (consumer aborted mid-stream)
-        # must not record into whatever registry is current at its exit
-        tr = obs.tracer()
+        return NativeReadEncoder(
+            self.layout, maxdel=self.maxdel, strict=self.strict,
+            accumulate_into=self._counts,
+            on_lines=_count("lines"), on_bytes=_count("bytes"),
+            segment_width=self._segment_width,
+            private_counts=private and self._counts is not None)
+
+    def _finish(self, encoders: List[NativeReadEncoder],
+                n_lines: int, n_bytes: int) -> None:
+        """Commit worker results: counts merge (coordinator-serialized,
+        so the shared tensor only ever has one writer), insertion stores
+        concatenate (grouping sorts by site key, so inter-store order is
+        irrelevant), counters total."""
+        for enc in encoders:
+            enc.merge_shadow()          # no-op for non-private/direct
+            self.insertions.extend(enc.insertions)
+            self.n_reads += enc.n_reads
+            self.n_skipped += enc.n_skipped
+        if self._on_lines is not None and n_lines:
+            self._on_lines(n_lines)
+        if self._on_bytes is not None and n_bytes:
+            self._on_bytes(n_bytes)
+
+    # -- rung selection ----------------------------------------------------
+    def encode_input(self, stream,
+                     min_shard_bytes: int = DEFAULT_MIN_SHARD_BYTES
+                     ) -> Iterator[SegmentBatch]:
+        """Decode ``stream`` (io.sam.ReadStream) on the best rung: byte
+        shards when the input mmaps (plain files), else the streaming
+        rung with a counted ``ingest/fallback``."""
+        plan = None
+        if self.n_threads > 1:
+            plan = stream.shard_plan(self.n_threads,
+                                     min_bytes=min_shard_bytes)
+        if plan is not None and plan.ranges:
+            return self.encode_shards(plan)
         reg = obs.metrics()
-        tr.name_thread(f"decode-worker-{state['idx']}")
-        t0 = time.perf_counter()
+        if self.n_threads > 1:
+            reg.add("ingest/fallback", 1)
+        reg.gauge("ingest/mode").set_info(
+            {"rung": "stream", "threads": self.n_threads,
+             "input": type(stream.handle).__name__,
+             "fused": self.counts_fused})
+        return self.encode_blocks(stream.blocks())
 
-        def feed():
-            while True:
-                item = state["q"].get()
-                if item is self._DONE:
-                    return
-                current_idx[0] = item[0]
-                yield item[1]
+    # -- shard rung --------------------------------------------------------
+    def encode_shards(self, plan: ShardPlan) -> Iterator[SegmentBatch]:
+        """Decode a byte-sharded input; see the module docstring for the
+        ownership/merge/error protocol."""
+        reg = obs.metrics()
+        ranges = list(plan.ranges)
+        nw = min(self.n_threads, len(ranges))
+        reg.gauge("ingest/mode").set_info(
+            {"rung": "shards", "threads": nw, "shards": len(ranges),
+             "bytes": plan.nbytes, "fused": self.counts_fused})
+        reg.add("ingest/shards", len(ranges))
+        if self.counts_fused:
+            return self._run_shards_fused(plan, ranges, nw)
+        return self._run_shards_slab(plan, ranges, nw)
+
+    def _shard_blocks(self, data, lo: int, hi: int, shard_idx: int,
+                      horizon: List[int]):
+        """Zero-copy line-snapped windows of one shard.  Between windows
+        the worker checks the error horizon: a shard EARLIER than this
+        one failed, so nothing from here on can matter (serial parity:
+        the stream would have stopped there) — stop feeding."""
+        import mmap as _mmap
 
         try:
-            for batch in enc.encode_blocks(feed()):
-                state["batches"].append(batch)
-        except BaseException as exc:
-            state["error"] = (current_idx[0], exc)
-        # one span per worker lifetime (block-level spans would be
-        # noise: the fused C decode runs ~500 MB/s/core); the bytes/lines
-        # args make per-worker balance visible in the trace
-        tr.complete("decode_worker", t0, worker=state["idx"],
-                    lines=state["lines"], bytes=state["bytes"])
-        reg.add("decode/worker_sec", time.perf_counter() - t0)
+            # one readahead hint per shard: the map's pages fault on
+            # this worker's thread otherwise, at whatever per-fault
+            # cost the host's kernel/sandbox charges
+            lo_pg = lo & ~(_mmap.PAGESIZE - 1)
+            data.madvise(_mmap.MADV_WILLNEED, lo_pg, hi - lo_pg)
+        except (AttributeError, ValueError, OSError):
+            pass
+        pos = lo
+        view = memoryview(data)
+        while pos < hi:
+            if horizon[0] < shard_idx:
+                return
+            end = snap_line_start(data, min(pos + SHARD_BLOCK_BYTES, hi),
+                                  lo, hi)
+            if end <= pos:      # one line longer than the window
+                end = hi
+            yield view[pos:end]
+            pos = end
 
-    # -- coordinator -------------------------------------------------------
+    def _shard_work(self, st: dict, data, horizon: List[int],
+                    hlock: threading.Lock, emit, tr, reg) -> None:
+        """One worker: decode the owned shard, GIL-free in the C core.
+
+        ``emit(batch)`` is rung-specific (collect vs queue-put).
+        Attempt protocol: a decode-semantics error records
+        ``(shard_idx, exc)`` and advances the horizon; any other
+        failure retries ONCE on a fresh encoder (the failed attempt's
+        private partitions and held batches are discarded whole, so
+        nothing can double-count), then flags the shard for demotion.
+
+        ``tr``/``reg`` are the RUN's instruments, captured on the
+        spawning thread: worker threads are never thread-bound, so
+        resolving them here would read whatever run is process-current
+        — the wrong job under serve's decode-ahead overlap.
+        """
+        shard_idx, (lo, hi) = st["idx"], st["range"]
+        tr.name_thread(f"decode-shard-{shard_idx}")
+        t0 = time.perf_counter()
+        attempts = 0
+        while True:
+            attempts += 1
+            held: List[SegmentBatch] = []
+            st["lines"] = st["bytes"] = 0
+            # attempt 1 uses the coordinator-prebuilt encoder (its
+            # tensor allocations would otherwise contend the GIL with
+            # the other workers' chunk bookkeeping); retries build
+            # fresh — the failed attempt's private partitions are
+            # discarded whole, so nothing can double-count
+            enc = st.pop("enc0", None)
+            try:
+                if enc is None:
+                    # INSIDE the try: a retry-attempt allocation failure
+                    # (the fresh shadow+bank is ~1.25 count tensors) is
+                    # itself an infrastructure fault — it must take the
+                    # retry/demote protocol, not kill the worker thread
+                    # with st['fault'] unset
+                    enc = self._mk_encoder(st, self._private_for(shard_idx))
+                if self.counts_fused:
+                    fault_check("ingest_decode_shard")
+                for batch in enc.encode_blocks(
+                        self._shard_blocks(data, lo, hi, shard_idx,
+                                           horizon)):
+                    if self.counts_fused:
+                        # counters-only: held until the shard commits,
+                        # so a retry/demotion never double-counts
+                        held.append(batch)
+                    elif not emit(batch):
+                        break           # consumer gone
+                if self.counts_fused and not self._direct:
+                    # shadow mode: fold this worker's private partition
+                    # now, lock-serialized — merges overlap the slower
+                    # workers' decode instead of queueing post-join.  A
+                    # later shard's demotion zeroes the shared tensor,
+                    # so an early merge is never a corruption hazard.
+                    with self._merge_lock:
+                        enc.merge_shadow()
+                st["enc"] = enc
+                st["held"] = held
+                break
+            except PARITY_ERRORS as exc:
+                st["error"] = (shard_idx, exc)
+                with hlock:
+                    horizon[0] = min(horizon[0], shard_idx)
+                break
+            except BaseException as exc:
+                # infrastructure fault (injected ingest_decode_shard,
+                # MemoryError, ...): retry the shard once on a fresh
+                # encoder, then hand the decision to the coordinator
+                if (shard_idx == 0 and self._direct
+                        and self._counts is not None):
+                    # direct-mode worker 0 writes the SHARED tensor in
+                    # place: scrub its partial contribution before any
+                    # retry/demotion — no other writer exists outside
+                    # the merge lock, and nothing has merged yet
+                    with self._merge_lock:
+                        self._counts[:] = 0
+                if attempts >= 2 or not self.counts_fused:
+                    st["fault"] = exc
+                    with hlock:
+                        horizon[0] = min(horizon[0], shard_idx)
+                    break
+                reg.add("ingest/shard_retries", 1)
+                tr.event("ingest/shard_retry", shard=shard_idx,
+                         error=f"{type(exc).__name__}: {exc}")
+        dt = time.perf_counter() - t0
+        tr.complete("decode_shard", t0, shard=shard_idx,
+                    lines=st["lines"], bytes=st["bytes"])
+        reg.add("decode/worker_sec", dt)
+        reg.add("ingest/worker_sec", dt)
+
+    def _spawn_shards(self, ranges, nw: int, data, emit):
+        """Start one worker per shard (round-robined when shards exceed
+        the thread budget) and return (states, threads, horizon)."""
+        horizon = [len(ranges)]
+        hlock = threading.Lock()
+        # instruments resolved HERE (the spawning thread, which serve's
+        # decode-ahead binds to its job) and passed into the workers
+        tr = obs.tracer()
+        reg = obs.metrics()
+        states = [{"idx": i, "range": r, "lines": 0, "bytes": 0,
+                   "enc": None, "held": [], "error": None, "fault": None}
+                  for i, r in enumerate(ranges)]
+        for st in states:
+            # attempt-1 encoders built HERE, before any worker runs:
+            # their shadow/bank allocations and name-table builds would
+            # otherwise serialize against the other workers under the
+            # GIL right at the start of the parallel phase
+            st["enc0"] = self._mk_encoder(st, self._private_for(st["idx"]))
+        # one thread per shard up to nw at a time: a simple claim queue
+        # (shards are sized ~equal, so static round-robin is fine too;
+        # the claim queue additionally absorbs snap-size imbalance)
+        claims: "queue.Queue" = queue.Queue()
+        for st in states:
+            claims.put(st)
+
+        def runner():
+            while True:
+                try:
+                    st = claims.get_nowait()
+                except queue.Empty:
+                    return
+                self._shard_work(st, data, horizon, hlock, emit, tr, reg)
+
+        threads = [threading.Thread(target=runner, daemon=True,
+                                    name=f"decode-worker-{w}")
+                   for w in range(nw)]
+        for t in threads:
+            t.start()
+        return states, threads, horizon
+
+    @staticmethod
+    def _first_failure(states):
+        """The stream-order-first failure: ``(idx, kind, exc)`` or None.
+        Shards are disjoint and ordered, so the smallest shard index is
+        the earliest stream offset regardless of which worker hit it."""
+        failures = []
+        for st in states:
+            if st["error"] is not None:
+                failures.append((st["error"][0], "error", st["error"][1]))
+            if st["fault"] is not None:
+                failures.append((st["idx"], "fault", st["fault"]))
+        if not failures:
+            return None
+        failures.sort(key=lambda f: f[0])
+        return failures[0]
+
+    def _run_shards_fused(self, plan: ShardPlan, ranges, nw: int
+                          ) -> Iterator[SegmentBatch]:
+        reg = obs.metrics()
+        states, threads, _horizon = self._spawn_shards(
+            ranges, nw, plan.data, emit=None)
+        for t in threads:
+            t.join()
+        first = self._first_failure(states)
+        if first is not None and first[1] == "error":
+            # a decode-semantics error EARLIER than any fault: serial
+            # would have raised it before reaching the faulted region
+            raise first[2]
+        if first is not None:
+            # demotion: the serial rung, whole input, zeroed counts —
+            # by construction nothing has merged yet and nothing was
+            # yielded, so the fresh pass is exactly the serial path
+            reg.add("ingest/demoted", 1)
+            obs.tracer().event(
+                "ingest/demoted",
+                error=f"{type(first[2]).__name__}: {first[2]}")
+            self._counts[:] = 0
+            st = {"lines": 0, "bytes": 0}
+            enc = self._mk_encoder(st, private=False)
+            view = memoryview(plan.data)
+            for batch in enc.encode_blocks(
+                    iter([view[plan.start:plan.end]])):
+                yield batch
+            self._finish([enc], st["lines"], st["bytes"])
+            return
+        self._finish([st["enc"] for st in states],
+                     sum(st["lines"] for st in states),
+                     sum(st["bytes"] for st in states))
+        for st in states:
+            for batch in st["held"]:
+                yield batch
+
+    def _run_shards_slab(self, plan: ShardPlan, ranges, nw: int
+                         ) -> Iterator[SegmentBatch]:
+        out_q: "queue.Queue" = queue.Queue(maxsize=2 * nw)
+        stop = threading.Event()
+
+        def emit(batch) -> bool:
+            while not stop.is_set():
+                try:
+                    out_q.put(batch, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        states, threads, _horizon = self._spawn_shards(
+            ranges, nw, plan.data, emit)
+
+        def alive() -> bool:
+            return any(t.is_alive() for t in threads)
+
+        try:
+            while True:
+                try:
+                    batch = out_q.get(timeout=0.1)
+                except queue.Empty:
+                    if not alive():
+                        break
+                    continue
+                yield batch
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        # drain anything emitted between the last get and the joins
+        while True:
+            try:
+                yield out_q.get_nowait()
+            except queue.Empty:
+                break
+        first = self._first_failure(states)
+        if first is not None:
+            # slab mode has no retry rung: emitted slabs may already be
+            # accumulated device-side, so a clean replay is impossible —
+            # surface the stream-order-first failure (parity error, or
+            # the fault for the run's retry policy/ladder to own)
+            raise first[2]
+        self._finish([st["enc"] for st in states],
+                     sum(st["lines"] for st in states),
+                     sum(st["bytes"] for st in states))
+
+    # -- streaming rung ----------------------------------------------------
     def encode_blocks(self, blocks) -> Iterator[SegmentBatch]:
-        threads = [threading.Thread(target=self._work, args=(st,),
-                                    daemon=True)
-                   for st in self._workers]
+        """The queue-feed rung for non-shardable inputs: the stream's
+        line-aligned blocks round-robin into bounded per-worker queues;
+        workers process blocks in order within each worker, so when
+        workers fail the smallest failing block index is exactly the
+        first bad line of the stream.  Feeding stops at the first
+        observed failure (the serial path would not have read further
+        either).  With one worker this degrades to the serial fused
+        path plus one queue hop."""
+        workers: List[dict] = []
+        for w in range(self.n_threads):
+            st = {"idx": w, "q": queue.Queue(maxsize=2), "batches": [],
+                  "error": None, "fault": None, "lines": 0, "bytes": 0,
+                  "enc": None}
+            st["enc"] = self._mk_encoder(st, private=w > 0)
+            workers.append(st)
+
+        def any_error() -> bool:
+            return any(st["error"] is not None or st["fault"] is not None
+                       for st in workers)
+
+        # instruments resolved on the consuming thread (thread-bound in
+        # serve's decode-ahead) and passed into the workers
+        tr = obs.tracer()
+        reg = obs.metrics()
+        threads = [threading.Thread(target=self._stream_work,
+                                    args=(st, tr, reg), daemon=True)
+                   for st in workers]
         for t in threads:
             t.start()
 
@@ -158,43 +524,66 @@ class ParallelFusedDecoder:
 
         try:
             for idx, block in enumerate(blocks):
-                if self._any_error():
+                if any_error():
                     break                 # serial parity: stop reading
                 w = idx % self.n_threads
-                tolerant_put(self._workers[w], threads[w], (idx, block))
+                tolerant_put(workers[w], threads[w], (idx, block))
                 # drain finished batches opportunistically so the
                 # backend's stats cadence ticks while decoding continues
-                for st in self._workers:
+                for st in workers:
                     while st["batches"]:
                         yield st["batches"].pop(0)
         finally:
-            for st, t in zip(self._workers, threads):
+            for st, t in zip(workers, threads):
                 tolerant_put(st, t, self._DONE)
             for t in threads:
                 t.join()
 
         # error parity: smallest failing block index == first bad line
-        errors = [st["error"] for st in self._workers
-                  if st["error"] is not None]
+        errors = [st["error"] for st in workers if st["error"] is not None]
         if errors:
             errors.sort(key=lambda e: (e[0] is None, e[0]))
             raise errors[0][1]
+        faults = [st["fault"] for st in workers if st["fault"] is not None]
+        if faults:
+            raise faults[0]
 
-        # merge: counts sum into worker 0's tensor (the accumulator's
-        # buffer), insertion stores concatenate, counters total
-        n_lines = n_bytes = 0
-        for w, st in enumerate(self._workers):
-            enc: NativeReadEncoder = st["enc"]
-            if w > 0:
-                self._counts += st["counts"]
-            self.insertions.extend(enc.insertions)
-            self.n_reads += enc.n_reads
-            self.n_skipped += enc.n_skipped
-            n_lines += st["lines"]
-            n_bytes += st["bytes"]
+        self._finish([st["enc"] for st in workers],
+                     sum(st["lines"] for st in workers),
+                     sum(st["bytes"] for st in workers))
+        for st in workers:
             for batch in st["batches"]:
                 yield batch
-        if self._on_lines is not None and n_lines:
-            self._on_lines(n_lines)
-        if self._on_bytes is not None and n_bytes:
-            self._on_bytes(n_bytes)
+
+    def _stream_work(self, st: dict, tr, reg) -> None:
+        enc: NativeReadEncoder = st["enc"]
+        current_idx = [None]
+        # tr/reg are the RUN's instruments captured on the consuming
+        # thread: a worker that outlives the run (consumer aborted
+        # mid-stream) must not record into whatever registry is current
+        # at its exit, and an unbound worker thread must not read a
+        # different job's process-current registry under serve overlap
+        tr.name_thread(f"decode-worker-{st['idx']}")
+        t0 = time.perf_counter()
+
+        def feed():
+            while True:
+                item = st["q"].get()
+                if item is self._DONE:
+                    return
+                current_idx[0] = item[0]
+                yield item[1]
+
+        try:
+            for batch in enc.encode_blocks(feed()):
+                st["batches"].append(batch)
+        except PARITY_ERRORS as exc:
+            st["error"] = (current_idx[0], exc)
+        except BaseException as exc:
+            st["fault"] = exc
+        # one span per worker lifetime (block-level spans would be
+        # noise: the fused C decode runs ~500 MB/s/core); the bytes/lines
+        # args make per-worker balance visible in the trace
+        tr.complete("decode_worker", t0, worker=st["idx"],
+                    lines=st["lines"], bytes=st["bytes"])
+        reg.add("decode/worker_sec", time.perf_counter() - t0)

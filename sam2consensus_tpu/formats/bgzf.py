@@ -259,11 +259,18 @@ class BgzfReader(io.RawIOBase):
         self._buf_pos = 0
         self._upos = 0                 # uncompressed offset of _buf start
         if self._threads > 1:
-            from concurrent.futures import ThreadPoolExecutor
+            # stripes run on the PROCESS-WIDE ingest pool (the same
+            # scheduler budget as the byte-shard decode workers,
+            # ingest.shared_pool): a serve queue opening many
+            # containers no longer accumulates one idle pool per
+            # reader, and the --decode-threads policy is the one
+            # thread budget everywhere.  The pool is shared, so
+            # close() must never shut it down — and submits go through
+            # ingest.pool_submit (never a cached executor), because a
+            # later open with a larger budget replaces the pool.
+            from .. import ingest
 
-            self._pool = ThreadPoolExecutor(
-                max_workers=self._threads,
-                thread_name_prefix="bgzf-inflate")
+            self._pool = ingest.shared_pool(self._threads)
 
     # -- block plumbing ----------------------------------------------------
     def _read_raw(self, index: int) -> bytes:
@@ -309,14 +316,19 @@ class BgzfReader(io.RawIOBase):
             out = self._inflate(self._next_block)
             self._next_block += 1
             return out
+        from .. import ingest
+
         window = self._threads * 4
         stripe = self.STRIPE_BLOCKS
         while self._next_submit < n and len(self._inflight) < window:
             count = min(stripe, n - self._next_submit)
+            # via pool_submit, NOT a cached executor: a concurrent open
+            # with a larger thread budget grows (replaces) the shared
+            # pool, and a submit on the retired executor would raise
             self._inflight.append(
                 (self._next_submit,
-                 self._pool.submit(self._inflate_stripe,
-                                   self._next_submit, count)))
+                 ingest.pool_submit(self._threads, self._inflate_stripe,
+                                    self._next_submit, count)))
             self._next_submit += count
         index, fut = self._inflight.pop(0)
         assert index == self._next_block
@@ -434,9 +446,9 @@ class BgzfReader(io.RawIOBase):
         if self.closed:
             return
         self._drain_pool()
-        if self._pool is not None:
-            self._pool.shutdown(wait=False)
-            self._pool = None
+        # the inflate pool is the shared ingest executor — other
+        # readers (and future opens) keep using it; just drop the ref
+        self._pool = None
         if self._owns:
             self._fh.close()
         super().close()

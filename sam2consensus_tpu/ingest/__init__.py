@@ -1,0 +1,217 @@
+"""Sharded ingest: byte-range planning and the shared decode pool.
+
+The multi-core ingest path (``encoder/parallel_decode.py``) is built on
+two primitives that live here so every input family — plain SAM text,
+BGZF containers, BAM — shares ONE definition of each:
+
+* :func:`plan_byte_shards` — split a record-oriented byte buffer into
+  line-snapped ranges.  Each range starts exactly at a line start and
+  ends exactly after a line terminator (or at EOF), so N decode workers
+  can own N disjoint ranges with zero coordination: no feed thread, no
+  queue hops, no line straddling two workers.  The snapping rule is the
+  classic "a line belongs to the shard containing its first byte":
+  an interior cut point is advanced to one past the next newline at or
+  after ``cut - 1`` (a cut already sitting on a line start stays put).
+
+* :func:`shared_pool` — the process-wide inflate executor.  BGZF
+  readers (``formats/bgzf.py``) used to spin a private pool per open
+  container; a serve queue with many containers accumulated idle
+  inflate threads, and a BGZF-SAM run stacked an inflate pool on top of
+  the decode workers.  Now every BGZF stripe from every reader runs on
+  one pool sized by the run's ``--decode-threads`` policy
+  (``config.resolve_decode_threads``) — the ONE thread budget shared by
+  the shard scheduler, the BGZF stripes and the native vote tail.
+
+Observability vocabulary (counters/gauges the scheduler records, all
+surfaced into ``stats.extra`` / bench rows by
+``observability.publish_stats_extra``):
+
+========================  ==============================================
+``ingest/shards``         byte-range shards decoded this run
+``ingest/worker_sec``     summed wall seconds across shard workers (the
+                          parallelism story: worker_sec / decode_sec)
+``ingest/fallback``       input could not be byte-sharded (gzip stream,
+                          BGZF text, in-memory handle) — the streaming
+                          rung served instead
+``ingest/shard_retries``  shard decode attempts retried after an
+                          infrastructure fault (``ingest_decode_shard``
+                          site)
+``ingest/demoted``        the whole ingest fell back to the serial rung
+                          after a shard failed its retry
+``ingest/mode``           gauge: rung + input class + shard count
+========================  ==============================================
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+#: default floor on shard size: below this, per-shard fixed costs
+#: (encoder construction, thread spawn, final-slab padding) dominate
+#: and the serial path is faster anyway
+DEFAULT_MIN_SHARD_BYTES = 1 << 20
+
+
+def snap_line_start(data, pos: int, start: int, end: int) -> int:
+    """Advance ``pos`` to the nearest line start at or after it.
+
+    ``data`` is any buffer with ``find`` (mmap, bytes).  A position is a
+    line start when the preceding byte is a newline (or it is ``start``
+    itself), so the probe looks at ``pos - 1``: if that byte is ``\\n``
+    the cut already sits on a line start and stays; otherwise the cut
+    moves one past the newline that terminates the line containing
+    ``pos``.  Returns ``end`` when no newline remains (the tail is one
+    unterminated line belonging to the previous shard).
+    """
+    if pos <= start:
+        return start
+    if pos >= end:
+        return end
+    nl = data.find(b"\n", pos - 1, end)
+    return end if nl < 0 else nl + 1
+
+
+def plan_byte_shards(data, start: int, end: int, n_shards: int,
+                     min_bytes: int = DEFAULT_MIN_SHARD_BYTES
+                     ) -> List[Tuple[int, int]]:
+    """Line-snapped byte ranges ``[(lo, hi), ...]`` tiling
+    ``data[start:end]`` exactly.
+
+    At most ``n_shards`` ranges, each (before snapping) at least
+    ``min_bytes`` long — tiny inputs collapse to fewer shards rather
+    than paying per-shard overhead for nothing.  Ranges are disjoint,
+    ordered, non-empty, and every line of the input starts in exactly
+    one range (CRLF and a truncated final line included: the ``\\r``
+    travels with its line, and an unterminated tail belongs to the last
+    range).  Snapping can empty a range (a shard narrower than one
+    line); empty ranges are dropped, so fewer ranges than requested can
+    come back — including zero for an empty body.
+    """
+    size = end - start
+    if size <= 0:
+        return []
+    n = max(1, min(int(n_shards), size // max(1, int(min_bytes)) or 1))
+    bounds = _snap_bounds(data, start, end, n)
+    ranges: List[Tuple[int, int]] = []
+    prev = start
+    for b in bounds[1:]:
+        if b > prev:
+            ranges.append((prev, b))
+            prev = b
+    return ranges
+
+
+def _snap_bounds(data, start: int, end: int, n: int) -> List[int]:
+    """All n+1 snapped boundaries, via the native one-pass snapper
+    (``s2c_snap_shards``) when the decoder library is loaded — the
+    python loop below is its semantics twin and the fallback."""
+    from .. import native
+
+    lib = native.load()
+    if lib is not None and hasattr(lib, "s2c_snap_shards"):
+        import numpy as np
+
+        buf = np.frombuffer(data, dtype=np.uint8) \
+            if not isinstance(data, np.ndarray) else data
+        out = np.empty(n + 1, dtype=np.int64)
+        lib.s2c_snap_shards(buf, start, end, n, out)
+        return [int(b) for b in out]
+    bounds = [start]
+    for k in range(1, n):
+        bounds.append(snap_line_start(data, start + (end - start) * k // n,
+                                      start, end))
+    bounds.append(end)
+    return bounds
+
+
+@dataclass
+class ShardPlan:
+    """A planned byte-sharded input: the backing buffer plus the
+    line-snapped ranges decode workers will own.  ``data`` is typically
+    an ``mmap`` of the input file — workers slice ``memoryview`` windows
+    off it, so the whole plan is zero-copy down to the C decoder."""
+
+    data: object
+    ranges: List[Tuple[int, int]] = field(default_factory=list)
+    start: int = 0
+    end: int = 0
+    source: str = "mmap"
+
+    @property
+    def nbytes(self) -> int:
+        return max(0, self.end - self.start)
+
+
+# -- shared inflate pool ----------------------------------------------------
+_pool = None
+_pool_workers = 0
+_pool_lock = threading.Lock()
+
+
+def shared_pool(threads: int):
+    """The process-wide ingest executor, grown to at least ``threads``
+    workers (never shrunk — the high-water budget is what the operator
+    asked for at some point this process).  Returns None for
+    ``threads <= 1``: serial callers should stay poolless.
+
+    Only SHORT tasks (BGZF stripe inflates) belong here.  Shard decode
+    workers are dedicated threads — a long-running decode task parked on
+    this pool would starve the inflate stripes it is itself waiting on.
+    """
+    global _pool, _pool_workers
+    if threads <= 1:
+        return None
+    with _pool_lock:
+        if _pool is None or _pool_workers < threads:
+            from concurrent.futures import ThreadPoolExecutor
+
+            old = _pool
+            _pool = ThreadPoolExecutor(max_workers=int(threads),
+                                       thread_name_prefix="s2c-ingest")
+            _pool_workers = int(threads)
+            if old is not None:
+                # in-flight stripes finish on the old pool's threads;
+                # new submissions land on the grown pool
+                old.shutdown(wait=False)
+        return _pool
+
+
+def pool_submit(threads: int, fn, *args):
+    """Submit a short task to the shared pool, safe against concurrent
+    growth.  ``shared_pool`` retires the old executor when a larger
+    budget arrives; a caller that fetched the pool just before that
+    loses the race and its submit raises RuntimeError — retry against
+    the current pool (already-submitted work is unaffected: retirement
+    uses ``shutdown(wait=False)``, which drains the queue).  Callers
+    must NOT cache the executor across submits; always come through
+    here."""
+    while True:
+        pool = shared_pool(threads)
+        if pool is None:
+            raise ValueError("pool_submit needs threads > 1")
+        try:
+            return pool.submit(fn, *args)
+        except RuntimeError:
+            # only a RETIRED executor justifies a retry; if the refusing
+            # pool is still the current one the error is real (e.g.
+            # interpreter shutdown) and must propagate, not busy-spin
+            with _pool_lock:
+                if _pool is pool:
+                    raise
+
+
+def pool_info() -> dict:
+    """Introspection for gauges/tests: current shared-pool size."""
+    with _pool_lock:
+        return {"workers": _pool_workers, "active": _pool is not None}
+
+
+def _reset_pool_for_tests() -> None:
+    global _pool, _pool_workers
+    with _pool_lock:
+        if _pool is not None:
+            _pool.shutdown(wait=False)
+        _pool = None
+        _pool_workers = 0

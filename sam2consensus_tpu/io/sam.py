@@ -23,7 +23,7 @@ from __future__ import annotations
 import gzip
 import io
 from dataclasses import dataclass
-from typing import Iterable, Iterator, List, TextIO, Tuple
+from typing import Iterable, Iterator, List, Optional, TextIO, Tuple
 
 
 def opener(filename: str, binary: bool = False, threads: int = 1):
@@ -220,6 +220,45 @@ class ReadStream:
         if k:
             self.n_bytes += k
 
+    def shard_plan(self, n_shards: int, min_bytes: Optional[int] = None):
+        """Byte-range shard plan over the remaining body, or None.
+
+        Plain uncompressed binary files mmap and split into line-snapped
+        ranges (``ingest.plan_byte_shards``) that the sharded decoder's
+        workers own outright — the zero-feed-thread ingest path.  Gzip
+        streams (compressed bytes are not splittable), BGZF readers
+        (parallel at the inflate layer already) and text/in-memory
+        handles return None: the caller degrades to the streaming rung.
+
+        A successful plan CONSUMES the stream (the handle seeks to EOF
+        and any buffered first line is dropped — its bytes are re-read
+        from the map), so plan exactly once and only when committing to
+        the shard rung.  Line/byte accounting still arrives through
+        ``add_lines`` / ``add_bytes`` from the decoder, as on every
+        other path.
+        """
+        if n_shards <= 1:
+            return None
+        mm = self._mmap_body()
+        if mm is None:
+            return None
+        from .. import ingest
+
+        if self.first:
+            if self._body_start is None:
+                return None       # cannot locate the buffered line
+            start = self._body_start
+            self.first = ""
+        else:
+            start = self.handle.tell()
+        kwargs = {} if min_bytes is None else {"min_bytes": min_bytes}
+        ranges = ingest.plan_byte_shards(mm, start, len(mm), n_shards,
+                                         **kwargs)
+        # leave the handle where the content ended, as read() would
+        self.handle.seek(len(mm))
+        return ingest.ShardPlan(data=mm, ranges=ranges, start=start,
+                                end=len(mm))
+
     def records(self) -> Iterator[SamRecord]:
         """Parsed mapped records, counting every body line."""
         def counted() -> Iterator[str]:
@@ -290,18 +329,41 @@ class ReadStream:
             block, pending = pending + chunk, chunk[:0]
             yield block
 
+    def _is_plain_file(self) -> bool:
+        """ONE definition of "plain uncompressed binary file handle" —
+        shared by the mmap shard planner and the decode-pricing ledger
+        so they can never disagree on what is byte-addressable (a gzip
+        handle's fileno()/fstat see COMPRESSED bytes)."""
+        import io as _io
+
+        h = self.handle
+        return (isinstance(h, _io.BufferedReader)
+                and isinstance(getattr(h, "raw", None), _io.FileIO))
+
+    def body_bytes_total(self) -> Optional[int]:
+        """Body size in bytes (header excluded) for plain uncompressed
+        file handles; None for compressed/in-memory handles or when the
+        body start could not be located."""
+        import os as _os
+
+        if not self._is_plain_file() or self._body_start is None:
+            return None
+        try:
+            st = _os.fstat(self.handle.fileno())
+        except (OSError, ValueError):
+            return None
+        return max(0, st.st_size - self._body_start)
+
     def _mmap_body(self):
         """An ACCESS_READ mmap of the whole file when the handle is a
         plain uncompressed binary file; None otherwise (gzip handles
         would map COMPRESSED bytes — their fileno() is the raw file)."""
-        import io as _io
         import mmap as _mmap
 
-        h = self.handle
-        if not (isinstance(h, _io.BufferedReader)
-                and isinstance(getattr(h, "raw", None), _io.FileIO)):
+        if not self._is_plain_file():
             return None
         try:
-            return _mmap.mmap(h.fileno(), 0, access=_mmap.ACCESS_READ)
+            return _mmap.mmap(self.handle.fileno(), 0,
+                              access=_mmap.ACCESS_READ)
         except (ValueError, OSError):
             return None                    # empty file, pipe, ...

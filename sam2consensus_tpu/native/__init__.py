@@ -138,6 +138,11 @@ def load() -> Optional[ctypes.CDLL]:
     lib.s2c_merge_u8.argtypes = [
         i32p, u8p, ctypes.c_int64,             # acc [n], u8 shadow [n], n
     ]
+    lib.s2c_snap_shards.restype = None
+    lib.s2c_snap_shards.argtypes = [
+        u8p, ctypes.c_int64, ctypes.c_int64,   # text, start, end
+        ctypes.c_long, i64p,                   # n_shards, bounds [n+1]
+    ]
     lib.s2c_cov_sums.restype = None
     lib.s2c_cov_sums.argtypes = [
         i32p, i64p,                            # cov [L], offsets [C+1]

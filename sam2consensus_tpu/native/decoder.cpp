@@ -1231,6 +1231,37 @@ extern "C" long s2c_decode_bam(
 }
 
 // ---------------------------------------------------------------------------
+// Line-snapped shard boundaries for the byte-range ingest planner
+// (sam2consensus_tpu/ingest plan_byte_shards): bounds[0..n] with
+// bounds[0] = start, bounds[n] = end, and interior cut k snapped forward
+// to one past the newline at or after (raw cut - 1) — a cut whose
+// preceding byte is already '\n' sits on a line start and stays put, so
+// every line of [start, end) begins in exactly one shard.  This is the
+// contract the shard-owned decode workers rely on: s2c_decode is
+// range-bounded (pointer + length) and each worker's range starts at a
+// line start, so N workers decode N disjoint ranges with no feed thread
+// and no straddled records.  One memchr per cut (~a line of text
+// scanned), so planning a multi-GB input costs microseconds.
+extern "C" void s2c_snap_shards(const char* text, int64_t start,
+                                int64_t end, long n, int64_t* bounds) {
+  bounds[0] = start;
+  bounds[n] = end;
+  const int64_t size = end - start;
+  for (long k = 1; k < n; ++k) {
+    int64_t cut = start + (size * k) / n;
+    if (cut <= start) {
+      bounds[k] = start;
+    } else if (cut >= end) {
+      bounds[k] = end;
+    } else {
+      const char* nl = static_cast<const char*>(
+          memchr(text + cut - 1, '\n', end - (cut - 1)));
+      bounds[k] = nl ? (nl - text) + 1 : end;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
 // Fold the uint8 shadow into the int32 pileup and clear it, in one pass.
 //
 // numpy's mixed-dtype `np.add(acc, u8, out=acc)` routes through a buffered

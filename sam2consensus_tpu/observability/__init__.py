@@ -269,8 +269,12 @@ def publish_stats_extra(extra: dict) -> None:
         # format/* (BGZF corrupt-block absorptions, text fallbacks —
         # sam2consensus_tpu/formats) rides along so a run that survived
         # a damaged container says so from any artifact
+        # ingest/* (shard counts, worker seconds, stream-rung fallbacks,
+        # shard retries/demotions — encoder/parallel_decode.py) rides
+        # along so the multi-core ingest story is checkable from any
+        # artifact: worker_sec / decode_sec is the realized parallelism
         elif name.startswith(("wire/", "pipeline/", "drift/", "serve/",
-                              "compile/", "format/")):
+                              "compile/", "format/", "ingest/")):
             extra[name] = int(value) if float(value).is_integer() \
                 else round(value, 4)
     for gauge_name, extra_key in (("dispatch/tail", "tail_dispatch"),
@@ -278,6 +282,7 @@ def publish_stats_extra(extra: dict) -> None:
                                   ("wire/codec", "wire"),
                                   ("pipeline/overlap", "pipeline"),
                                   ("format/input", "input_format"),
+                                  ("ingest/mode", "ingest_mode"),
                                   ("serve/recovery", "serve_recovery"),
                                   ("serve/watchdog", "serve_watchdog")):
         g = snap["gauges"].get(gauge_name)

@@ -69,7 +69,7 @@ from typing import Dict, List, Optional
 SITES = ("device_put", "pileup_dispatch", "accumulate", "vote",
          "insertion_build", "link_probe", "wire_encode",
          "serve_decode_ahead", "journal_write", "job_hang",
-         "bam_inflate")
+         "bam_inflate", "ingest_decode_shard")
 
 #: how long a firing ``job_hang`` rule sleeps before raising (seconds);
 #: far past any sane --job-timeout, so the watchdog always wins the race

@@ -138,6 +138,23 @@ def test_width_overflow_fallback():
     assert nat.n_reads == 301
 
 
+def test_giant_insertion_grows_scratch_buffers():
+    """A single line whose insertion payload overruns the per-call
+    scratch buffers (chars_cap = 1 MiB) must take the grow-and-retry
+    path (status==1, consumed==0 -> caps double, arrays REALLOCATE at
+    the loop top) and decode exactly — regression for the hoisted
+    buffers being grown by cap integer only, which let the C decoder
+    write past the allocation."""
+    big = ("ACGT" * 330_000)[:1_300_000]          # > 1 MiB insertion
+    reads = [("g", 1, "30M", "C" * 30),
+             ("g", 5, f"1M{len(big)}I1M", "A" + big + "T"),
+             ("g", 11, "20M", "G" * 20)]
+    text = sam_text([("g", 400)], reads)
+    py, nat = _assert_equivalent(text)
+    assert nat.n_reads == 3
+    assert len(nat.insertions) == len(py.insertions)
+
+
 def test_strict_error_parity():
     cases = [
         sam_text([("e", 10)], [("e", 1, "4M", "ACXT")]),   # bad base

@@ -1,24 +1,27 @@
-"""Multi-threaded fused decode: exact parity with the serial path.
+"""Sharded multi-core ingest: exact parity with the serial path.
 
-The count tensor is sum-decomposable, so per-worker tensors summed at the
-end must equal the serial fused pass bit-for-bit; insertion grouping
+The count tensor is sum-decomposable, so per-worker partitions merged at
+the end must equal the serial fused pass bit-for-bit; insertion grouping
 sorts by site key, so store concatenation order is irrelevant; strict
 errors must surface as the FIRST bad line of the stream exactly like the
-serial path (encoder/parallel_decode.py).
-"""
+serial path — on the byte-shard rung (disjoint ordered ranges: earliest
+shard wins) AND the streaming rung (block order within workers)
+(encoder/parallel_decode.py)."""
 
 import io
+import os
 
 import numpy as np
 import pytest
 
-from sam2consensus_tpu import native
+from sam2consensus_tpu import ingest, native, observability
 from sam2consensus_tpu.backends.cpu import CpuBackend
 from sam2consensus_tpu.backends.jax_backend import JaxBackend
 from sam2consensus_tpu.config import RunConfig
 from sam2consensus_tpu.encoder.events import GenomeLayout
+from sam2consensus_tpu.encoder.native_encoder import NativeReadEncoder
 from sam2consensus_tpu.io.fasta import render_file
-from sam2consensus_tpu.io.sam import ReadStream, read_header
+from sam2consensus_tpu.io.sam import ReadStream, opener, read_header
 from sam2consensus_tpu.ops.pileup import HostPileupAccumulator
 from sam2consensus_tpu.utils.simulate import SimSpec, simulate
 
@@ -99,3 +102,347 @@ def test_backend_decode_threads_byte_identical():
     got = _run_cli_style(text, RunConfig(prefix="t", thresholds=[0.25],
                                          shards=1, decode_threads=3))
     assert got == want
+
+
+# -- byte-shard rung --------------------------------------------------------
+def _write(tmp_path, text, name="t.sam", mode="w"):
+    path = tmp_path / name
+    with open(path, mode) as fh:
+        fh.write(text)
+    return str(path)
+
+
+def _decode_file(path, n_threads, min_bytes=1):
+    """Decode a FILE via the decoder's rung selection (shard rung for
+    plain files); returns (acc, dec, events, stream)."""
+    from sam2consensus_tpu.encoder.parallel_decode import \
+        ParallelFusedDecoder
+
+    handle = opener(path, binary=True)
+    contigs, _n, first = read_header(handle)
+    layout = GenomeLayout(contigs)
+    acc = HostPileupAccumulator(layout.total_len)
+    dec = ParallelFusedDecoder(layout, acc.counts_host(), n_threads)
+    stream = ReadStream(handle, first)
+    events = 0
+    try:
+        for b in dec.encode_input(stream, min_shard_bytes=min_bytes):
+            acc.add(b)
+            events += b.n_events
+    finally:
+        handle.close()
+    return acc, dec, events, stream
+
+
+def _serial_reference(path):
+    handle = opener(path, binary=True)
+    contigs, _n, first = read_header(handle)
+    layout = GenomeLayout(contigs)
+    counts = np.zeros((layout.total_len, 6), dtype=np.int32)
+    enc = NativeReadEncoder(layout, accumulate_into=counts)
+    stream = ReadStream(handle, first)
+    try:
+        for _ in enc.encode_blocks(stream.blocks()):
+            pass
+    finally:
+        handle.close()
+    return counts, enc, stream
+
+
+def _assert_shard_equals_serial(path, n_threads, min_bytes=1):
+    counts, senc, sstream = _serial_reference(path)
+    acc, dec, _ev, pstream = _decode_file(path, n_threads,
+                                          min_bytes=min_bytes)
+    np.testing.assert_array_equal(counts, acc.counts_host())
+    assert (senc.n_reads, senc.n_skipped) == (dec.n_reads, dec.n_skipped)
+    assert len(senc.insertions) == len(dec.insertions)
+    assert (sstream.n_lines, sstream.n_bytes) \
+        == (pstream.n_lines, pstream.n_bytes)
+    from sam2consensus_tpu.encoder.events import group_insertions
+    g1 = group_insertions(senc.insertions, senc.layout)
+    g2 = group_insertions(dec.insertions, dec.layout)
+    assert (g1 is None) == (g2 is None)
+    if g1 is not None:
+        for k in g1:
+            np.testing.assert_array_equal(g1[k], g2[k])
+
+
+@pytest.mark.parametrize("n_threads", [2, 3, 8])
+def test_shard_rung_equals_serial(tmp_path, n_threads):
+    """min_bytes=1 forces one shard per thread, so every boundary falls
+    mid-line and the snapping owns reads straddling the raw cuts."""
+    text = simulate(SimSpec(n_contigs=4, contig_len=300, n_reads=1500,
+                            read_len=60, ins_read_rate=0.2,
+                            del_read_rate=0.2, seed=61))
+    path = _write(tmp_path, text)
+    _assert_shard_equals_serial(path, n_threads)
+
+
+def test_shard_rung_direct_mode_equals_serial(tmp_path, monkeypatch):
+    """Huge-genome counting mode (int32 direct, no shadow): workers use
+    private int32 partitions merged at the end — forced onto a small
+    genome via the fused-direct threshold knob."""
+    monkeypatch.setenv("S2C_FUSED_DIRECT_MIN_LEN", "1")
+    text = simulate(SimSpec(n_contigs=3, contig_len=300, n_reads=1000,
+                            read_len=60, ins_read_rate=0.15,
+                            del_read_rate=0.15, seed=71))
+    path = _write(tmp_path, text)
+    _assert_shard_equals_serial(path, 3)
+
+
+def test_shard_rung_crlf_and_truncated_final_line(tmp_path):
+    """CRLF terminators travel with their line through snapping, and an
+    unterminated final line belongs to the last shard."""
+    text = simulate(SimSpec(n_contigs=2, contig_len=200, n_reads=300,
+                            read_len=40, seed=62))
+    crlf = text.replace("\n", "\r\n")
+    assert crlf.endswith("\r\n")
+    truncated = crlf[:-2]          # drop the final terminator entirely
+    path = _write(tmp_path, truncated)
+    _assert_shard_equals_serial(path, 4)
+
+
+def test_shard_rung_more_shards_than_records(tmp_path):
+    """8 requested shards over 3 records: snapping collapses empty
+    ranges and parity holds."""
+    text = simulate(SimSpec(n_contigs=1, contig_len=120, n_reads=3,
+                            read_len=30, seed=63))
+    path = _write(tmp_path, text)
+    _assert_shard_equals_serial(path, 8)
+
+
+def test_shard_rung_single_record(tmp_path):
+    text = simulate(SimSpec(n_contigs=1, contig_len=100, n_reads=1,
+                            read_len=30, seed=64))
+    path = _write(tmp_path, text)
+    _assert_shard_equals_serial(path, 4)
+
+
+def test_shard_rung_header_only(tmp_path):
+    text = "@SQ\tSN:c1\tLN:100\n"
+    path = _write(tmp_path, text)
+    acc, dec, ev, _s = _decode_file(path, 3)
+    assert dec.n_reads == 0 and ev == 0
+    assert not acc.counts_host().any()
+
+
+def test_shard_rung_error_is_first_bad_line(tmp_path):
+    """Two bad lines in different shards: the earlier one's exception
+    surfaces, with the serial path's exact type and message."""
+    text = simulate(SimSpec(n_contigs=2, contig_len=200, n_reads=600,
+                            read_len=40, seed=65))
+    lines = text.splitlines(keepends=True)
+    third = len(lines) // 3
+    lines.insert(third, "broken\tline\n")
+    lines.insert(2 * third, "also\tbroken\tbut\tlater\n")
+    path = _write(tmp_path, "".join(lines))
+
+    with pytest.raises(Exception) as serial_err:
+        _serial_reference(path)
+    errs = []
+    for n_threads in (1, 4):
+        with pytest.raises(Exception) as ei:
+            _decode_file(path, n_threads)
+        errs.append((type(ei.value), str(ei.value)))
+    want = (type(serial_err.value), str(serial_err.value))
+    assert errs == [want, want]
+
+
+def test_plan_byte_shards_invariants():
+    """Every line starts in exactly one range; ranges tile the span."""
+    body = b"".join(b"line%d\tx\n" % i for i in range(200))
+    data = b"@hdr\n" + body
+    start = 5
+    for n in (1, 2, 3, 7, 50, 500):
+        ranges = ingest.plan_byte_shards(data, start, len(data), n,
+                                         min_bytes=1)
+        assert ranges[0][0] == start and ranges[-1][1] == len(data)
+        for (a, b), (c, d) in zip(ranges, ranges[1:]):
+            assert b == c
+        for lo, hi in ranges:
+            assert lo < hi
+            # every range starts at a line start
+            assert lo == start or data[lo - 1:lo] == b"\n"
+        # the native one-pass snapper (s2c_snap_shards) and the python
+        # fallback are semantics twins
+        py = [start] + [
+            ingest.snap_line_start(data, start + (len(data) - start) * k
+                                   // n, start, len(data))
+            for k in range(1, n)] + [len(data)]
+        assert ingest._snap_bounds(data, start, len(data), n) == py
+
+
+def test_gzip_falls_back_to_stream_rung(tmp_path):
+    """Non-splittable gzip input: the streaming rung serves, counted as
+    ingest/fallback, byte-identical output."""
+    import gzip
+
+    text = simulate(SimSpec(n_contigs=2, contig_len=200, n_reads=500,
+                            read_len=40, ins_read_rate=0.1, seed=66))
+    sam = _write(tmp_path, text)
+    gz = str(tmp_path / "t.sam.gz")
+    with gzip.open(gz, "wb") as fh:
+        fh.write(text.encode())
+
+    counts, _enc, _s = _serial_reference(sam)
+    robs = observability.start_run()
+    try:
+        handle = opener(gz, binary=True)
+        contigs, _n, first = read_header(handle)
+        layout = GenomeLayout(contigs)
+        acc = HostPileupAccumulator(layout.total_len)
+        from sam2consensus_tpu.encoder.parallel_decode import \
+            ParallelFusedDecoder
+
+        dec = ParallelFusedDecoder(layout, acc.counts_host(), 2)
+        for b in dec.encode_input(ReadStream(handle, first)):
+            acc.add(b)
+        handle.close()
+        snap = observability.metrics().snapshot()
+        assert snap["counters"].get("ingest/fallback") == 1
+        mode = snap["gauges"]["ingest/mode"]["info"]
+        assert mode["rung"] == "stream"
+    finally:
+        observability.finish_run(robs)
+    np.testing.assert_array_equal(counts, acc.counts_host())
+
+
+def test_shard_fault_retries_once_then_succeeds(tmp_path):
+    """An injected ingest_decode_shard fault costs one retry; counts
+    stay exact and the retry is counted."""
+    from sam2consensus_tpu.resilience import faultinject
+
+    text = simulate(SimSpec(n_contigs=2, contig_len=200, n_reads=800,
+                            read_len=40, ins_read_rate=0.1, seed=67))
+    path = _write(tmp_path, text)
+    counts, senc, _s = _serial_reference(path)
+
+    robs = observability.start_run()
+    faultinject.configure("ingest_decode_shard:rpc:0")
+    try:
+        acc, dec, _ev, _st = _decode_file(path, 2)
+        snap = observability.metrics().snapshot()
+        assert snap["counters"].get("ingest/shard_retries") == 1
+        assert "ingest/demoted" not in snap["counters"]
+    finally:
+        faultinject.configure("")
+        observability.finish_run(robs)
+    np.testing.assert_array_equal(counts, acc.counts_host())
+    assert dec.n_reads == senc.n_reads
+
+
+def test_shard_fault_persistent_demotes_to_serial(tmp_path):
+    """A persistent fault demotes the WHOLE ingest to the serial rung:
+    counts exact (never corrupted by partial shard work), demotion
+    counted."""
+    from sam2consensus_tpu.resilience import faultinject
+
+    text = simulate(SimSpec(n_contigs=2, contig_len=200, n_reads=800,
+                            read_len=40, ins_read_rate=0.1, seed=68))
+    path = _write(tmp_path, text)
+    counts, senc, _s = _serial_reference(path)
+
+    robs = observability.start_run()
+    faultinject.configure("ingest_decode_shard:rpc:0:inf")
+    try:
+        acc, dec, _ev, _st = _decode_file(path, 2)
+        snap = observability.metrics().snapshot()
+        assert snap["counters"].get("ingest/demoted") == 1
+        assert snap["counters"].get("ingest/shard_retries", 0) >= 1
+    finally:
+        faultinject.configure("")
+        observability.finish_run(robs)
+    np.testing.assert_array_equal(counts, acc.counts_host())
+    assert dec.n_reads == senc.n_reads
+    assert len(dec.insertions) == len(senc.insertions)
+
+
+def test_backend_file_shard_rung_byte_identical(tmp_path):
+    """End-to-end through the jax backend over a real file (the shard
+    rung engages, unlike the in-memory StringIO test above), fused host
+    path AND the slab/device path, vs the CPU oracle."""
+    text = simulate(SimSpec(n_contigs=3, contig_len=250, n_reads=1200,
+                            read_len=50, ins_read_rate=0.25,
+                            del_read_rate=0.15, seed=69))
+    path = _write(tmp_path, text)
+
+    from sam2consensus_tpu.io.sam import read_sam
+    contigs, records = read_sam(path)
+    res_cpu = CpuBackend().run(contigs, records,
+                               RunConfig(prefix="t", thresholds=[0.25]))
+    want = {n: render_file(r, 0) for n, r in res_cpu.fastas.items()}
+
+    for extra in ({}, {"pileup": "scatter"}):
+        with open(path, "rb") as fh:
+            contigs, _n, first = read_header(fh)
+            res = JaxBackend().run(
+                contigs, ReadStream(fh, first),
+                RunConfig(prefix="t", thresholds=[0.25], shards=1,
+                          decode_threads=2, **extra))
+        got = {n: render_file(r, 0) for n, r in res.fastas.items()}
+        assert got == want, f"mismatch for {extra}"
+        assert res.stats.extra.get("ingest/shards", 0) >= 1
+
+
+def test_decode_threads_decision_in_manifest(tmp_path):
+    """--decode-threads is a priced, recorded decision: it lands in the
+    run manifest with its inputs and a residual joined against the
+    realized phase/decode_sec.  The fused host rung keeps the enforced
+    drift band (decode wall == decode work there); the slab/device rung
+    is informational (band=0) because the pipeline's whole point is
+    hiding decode wall under dispatch."""
+    text = simulate(SimSpec(n_contigs=2, contig_len=250, n_reads=900,
+                            read_len=50, seed=72))
+    path = _write(tmp_path, text)
+
+    def _run(**extra):
+        with open(path, "rb") as fh:
+            contigs, _n, first = read_header(fh)
+            JaxBackend().run(contigs, ReadStream(fh, first),
+                             RunConfig(prefix="t", thresholds=[0.25],
+                                       shards=1, decode_threads=2,
+                                       **extra))
+        man = observability.last_manifest()
+        assert man is not None
+        return {d["decision"]: d for d in man["decisions"]}
+
+    dec = _run()["decode_threads"]                     # fused host rung
+    assert dec["chosen"] == "2"
+    assert dec["inputs"]["rung"] == "fused"
+    assert dec["inputs"]["parallel"] is True
+    assert dec["predicted"].get("sec", 0) > 0
+    assert "sec" in dec["residual"]
+
+    dec = _run(pileup="scatter")["decode_threads"]     # slab rung
+    assert dec["inputs"]["rung"] == "slab"
+    assert "sec" in dec["residual"]
+    assert not dec["drift"]      # informational on the pipelined rung
+
+
+def test_shared_ingest_pool_grows_and_survives_close(tmp_path):
+    """BGZF readers ride the process-wide ingest pool: closing one
+    reader must not tear the pool down for others."""
+    from sam2consensus_tpu.formats.bgzf import BgzfReader, write_bgzf
+
+    text = simulate(SimSpec(n_contigs=1, contig_len=200, n_reads=2000,
+                            read_len=40, seed=70))
+    path = str(tmp_path / "t.sam.gz")
+    write_bgzf(text.encode(), path)
+
+    r1 = BgzfReader(path, threads=2)
+    r2 = BgzfReader(path, threads=2)
+    assert r1._pool is r2._pool
+    first = r1.read(100)
+    r1.close()
+    # growing the pool mid-read (a later open with a larger budget
+    # retires the old executor) must not break readers already open:
+    # submits go through ingest.pool_submit, never a cached executor
+    r3 = BgzfReader(path, threads=4)
+    out = r2.read()
+    r2.close()
+    out3 = r3.read()
+    r3.close()
+    assert first == text.encode()[:100]
+    assert out == text.encode()
+    assert out3 == text.encode()
+    assert ingest.pool_info()["workers"] >= 4

@@ -1,0 +1,119 @@
+"""Property test: sharded ingest == serial ingest, for ANY input.
+
+Random small SAM bodies — valid reads, indel CIGARs, unmapped lines,
+out-of-bounds spans, malformed junk, optional trailing-newline-less
+tails — decoded serially and through the byte-shard rung at a random
+thread count with 1-byte shard floors (so raw cuts land mid-line
+everywhere).  Either both paths raise the same exception (type and
+message — the strict first-error parity contract) or both succeed with
+bit-identical counts and identical read/skip/insertion totals.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import HealthCheck, given, settings, strategies as st  # noqa: E402
+
+from sam2consensus_tpu import native  # noqa: E402
+from sam2consensus_tpu.encoder.events import GenomeLayout  # noqa: E402
+from sam2consensus_tpu.encoder.native_encoder import \
+    NativeReadEncoder  # noqa: E402
+from sam2consensus_tpu.encoder.parallel_decode import \
+    ParallelFusedDecoder  # noqa: E402
+from sam2consensus_tpu.io.sam import ReadStream, opener, \
+    read_header  # noqa: E402
+from sam2consensus_tpu.ops.pileup import \
+    HostPileupAccumulator  # noqa: E402
+
+pytestmark = pytest.mark.skipif(native.load() is None,
+                                reason="native decoder unavailable")
+
+HEADER = "@SQ\tSN:c1\tLN:60\n@SQ\tSN:c2\tLN:40\n"
+
+
+@st.composite
+def sam_line(draw):
+    kind = draw(st.sampled_from(
+        ["read", "read", "read", "ins", "dele", "clip", "unmapped",
+         "oob", "badref", "junk"]))
+    ref = draw(st.sampled_from(["c1", "c2"]))
+    reflen = 60 if ref == "c1" else 40
+    pos = draw(st.integers(1, reflen))
+    seq = "".join(draw(st.lists(st.sampled_from("ACGTN"), min_size=12,
+                                max_size=12)))
+    base = f"r\t0\t{ref}\t{pos}\t60\t{{cig}}\t*\t0\t0\t{{seq}}\t*"
+    if kind == "unmapped":
+        return base.format(cig="*", seq=seq)
+    if kind == "junk":
+        return draw(st.sampled_from(
+            ["broken line", "a\tb\tc", "r\t0\tc1\tNOTANINT\t60\t5M\t*"
+             "\t0\t0\tACGTA\t*"]))
+    if kind == "badref":
+        return base.format(cig="5M", seq=seq[:5]).replace(ref, "nope")
+    if kind == "oob":
+        return f"r\t0\t{ref}\t{reflen}\t60\t12M\t*\t0\t0\t{seq}\t*"
+    if kind == "ins":
+        return base.format(cig="4M3I5M", seq=seq)
+    if kind == "dele":
+        return base.format(cig="4M3D4M", seq=seq[:8])
+    if kind == "clip":
+        return base.format(cig="2S6M2H", seq=seq[:8])
+    span = min(12, reflen - pos + 1)
+    return base.format(cig=f"{span}M", seq=seq[:span])
+
+
+def _run(path, n_threads):
+    handle = opener(path, binary=True)
+    try:
+        contigs, _n, first = read_header(handle)
+        layout = GenomeLayout(contigs)
+        if n_threads == 0:
+            counts = np.zeros((layout.total_len, 6), dtype=np.int32)
+            enc = NativeReadEncoder(layout, accumulate_into=counts)
+            for _ in enc.encode_blocks(ReadStream(handle, first).blocks()):
+                pass
+            return counts, enc.n_reads, enc.n_skipped, len(enc.insertions)
+        acc = HostPileupAccumulator(layout.total_len)
+        dec = ParallelFusedDecoder(layout, acc.counts_host(), n_threads)
+        for _ in dec.encode_input(ReadStream(handle, first),
+                                  min_shard_bytes=1):
+            pass
+        return (acc.counts_host(), dec.n_reads, dec.n_skipped,
+                len(dec.insertions))
+    finally:
+        handle.close()
+
+
+@settings(max_examples=25, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(lines=st.lists(sam_line(), max_size=30),
+       trailing_newline=st.booleans(),
+       n_threads=st.integers(2, 5))
+def test_shard_rung_matches_serial(lines, trailing_newline, n_threads):
+    text = HEADER + "\n".join(lines)
+    if lines and trailing_newline:
+        text += "\n"
+    fd, path = tempfile.mkstemp(suffix=".sam")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            fh.write(text)
+        try:
+            want = _run(path, 0)
+            serial_exc = None
+        except Exception as exc:
+            serial_exc = (type(exc), str(exc))
+        try:
+            got = _run(path, n_threads)
+            par_exc = None
+        except Exception as exc:
+            par_exc = (type(exc), str(exc))
+        assert serial_exc == par_exc
+        if serial_exc is None:
+            np.testing.assert_array_equal(want[0], got[0])
+            assert want[1:] == got[1:]
+    finally:
+        os.unlink(path)

@@ -20,6 +20,13 @@ running a multi-job ``s2c serve --journal`` queue under one chaos mode —
                     mode runs serial decode — it is exercised by
                     tests/test_survivability.py instead.)
 
+After the cycles, one **ingest_demote** leg runs (always): journaled
+jobs checkpoint and therefore keep the serial decode rung, so the
+byte-shard scheduler's ``ingest_decode_shard`` site gets a one-shot-CLI
+cycle of its own — a PERSISTENT shard fault under ``--decode-threads
+2`` must demote the whole ingest to the serial rung
+(``ingest/demoted``) with output byte-identical to a clean run.
+
 Every cycle asserts the three survivability invariants:
 
 1. **byte identity** — the cycle's output set is sha256-identical to a
@@ -257,6 +264,57 @@ def main(argv=None):
             + ("OK" if ok else "FAIL")
             + f" recovery {recovery_sec:.1f}s"
             + (" (killed mid-queue)" if killed else ""))
+
+    # Dedicated ingest-demotion leg: journaled jobs checkpoint, and
+    # checkpointed runs keep the SERIAL decode rung — so the byte-shard
+    # scheduler's fault site (ingest_decode_shard) gets its own
+    # one-shot-CLI soak cycle: with a PERSISTENT shard fault every
+    # shard fails its retry, the whole ingest must demote to the serial
+    # rung, and the output must still be byte-identical to a clean run
+    # (the merge-never-corrupted contract of
+    # encoder/parallel_decode.py).
+    def oneshot(outdir, extra):
+        os.makedirs(outdir, exist_ok=True)
+        return [sys.executable, "-m", "sam2consensus_tpu.cli",
+                "-i", inputs[0], "-o", outdir, *extra]
+
+    ing_clean = os.path.join(work, "ing_clean")
+    ing_out = os.path.join(work, "ing_out")
+    ing_metrics = os.path.join(work, "ing_metrics.json")
+    t_cycle = time.monotonic()
+    rc1, _t, r1 = run_to_completion(
+        oneshot(ing_clean, ["--decode-threads", "2"]), env,
+        args.per_process_timeout)
+    rc2, ing_sec, r2 = run_to_completion(
+        oneshot(ing_out, ["--decode-threads", "2",
+                          "--fault-inject",
+                          "ingest_decode_shard:rpc:0:inf",
+                          "--json-metrics", ing_metrics]), env,
+        args.per_process_timeout)
+    ing_identical = (rc1 == 0 and rc2 == 0
+                     and sha_dir(ing_clean) == sha_dir(ing_out))
+    try:
+        with open(ing_metrics) as fh:
+            m = json.load(fh)
+        demoted = int(m.get("ingest/demoted", 0))
+        retries = int(m.get("ingest/shard_retries", 0))
+    except Exception:
+        demoted = retries = 0
+    ing_ok = ing_identical and demoted >= 1 and retries >= 1
+    failures += 0 if ing_ok else 1
+    if not ing_ok:
+        log(f"[chaos_soak] ingest_demote rc1={rc1} rc2={rc2}: "
+            f"{(r2.stderr or r1.stderr)[-1500:]}")
+    rows.append({"cycle": "ingest", "mode": "ingest_demote",
+                 "ok": ing_ok, "rc": rc1 or rc2,
+                 "killed": False, "identical": ing_identical,
+                 "demoted": demoted, "shard_retries": retries,
+                 "recovery_sec": round(ing_sec, 3),
+                 "total_sec": round(time.monotonic() - t_cycle, 3),
+                 "jobs": 1, "lost": 0, "duplicated": 0, "committed": 0})
+    log(f"[chaos_soak] ingest_demote: "
+        + ("OK" if ing_ok else "FAIL")
+        + f" demoted={demoted} retries={retries}")
 
     rec = [r["recovery_sec"] for r in rows]
     summary = {

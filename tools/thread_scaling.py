@@ -1,13 +1,26 @@
 #!/usr/bin/env python3
-"""Measure decode and native-vote throughput at 1/2/4 threads.
+"""Measure ingest and native-vote throughput at 1/2/4 threads.
 
-The multi-threaded paths (--decode-threads: ``encoder/parallel_decode.py``
-fused decode workers; the threaded ``s2c_vote`` position ranges) carry the
-framework's multi-core story, but the round-3 verdict noted every claim
-about them was unmeasured (the bench host has one core).  This tool
-records what the current host CAN measure — per-thread-count rates plus
-the host's core count, so the artifact is honest about whether the run
-could exhibit scaling at all — as one JSON line per measurement.
+The multi-threaded paths (--decode-threads: the byte-shard scheduler in
+``encoder/parallel_decode.py``; the threaded ``s2c_vote`` position
+ranges; the BGZF/BAM block-parallel ingest) carry the framework's
+multi-core story.  This tool records what the current host CAN measure
+— per-thread-count rates plus the host's core count, so the artifact is
+honest about whether the run could exhibit scaling at all — as one JSON
+line per measurement.
+
+Legs (all best-of-``S2C_SCALING_REPS``, default 5 — the scaling hosts
+are noisy VMs and the bench convention is best-of-N):
+
+* ``serial_decode`` — the plain fused NativeReadEncoder over a file
+  (the 1-thread floor every speedup row is judged against);
+* ``fused_decode`` — the shard rung (``encode_input`` over a real
+  file: mmap + line-snapped byte ranges, one worker per shard);
+* ``fused_decode_stream`` — the queue-feed streaming rung (what gzip
+  inputs get), so the fallback's cost is a number, not a guess;
+* ``bam_ingest`` — the binary BAM leg: BGZF stripes on the shared
+  ingest pool + the native record decoder;
+* ``native_vote`` — the threaded C++ position vote.
 
 Usage: python tools/thread_scaling.py [> artifact.jsonl]
 """
@@ -26,54 +39,185 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def _read_int(path):
+    try:
+        with open(path) as fh:
+            return int(fh.read().split()[0])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _cpu_limits():
+    """cgroup CPU constraints, schemes kept distinct: a host with 2
+    cores but a 1.5-CPU budget can only show full 2-thread scaling in
+    burst windows — the artifact says so instead of letting the reader
+    assume 2 unthrottled cores.
+
+    ``cpu_shares`` (v1) and ``cpu_weight`` (v2) are RELATIVE weights on
+    different bases (1024 vs 100) — never merged into one field.
+    ``cpu_quota`` is the actual hard cap in CPUs (v1
+    cfs_quota_us/cfs_period_us, v2 cpu.max), emitted only when set."""
+    out = {}
+    shares = _read_int("/sys/fs/cgroup/cpu/cpu.shares")
+    if shares is not None:                       # cgroup v1
+        out["cpu_shares"] = shares
+        quota = _read_int("/sys/fs/cgroup/cpu/cpu.cfs_quota_us")
+        period = _read_int("/sys/fs/cgroup/cpu/cpu.cfs_period_us")
+        if quota and period and quota > 0:
+            out["cpu_quota"] = round(quota / period, 3)
+        return out
+    weight = _read_int("/sys/fs/cgroup/cpu.weight")
+    if weight is not None:                       # cgroup v2
+        out["cpu_weight"] = weight
+        try:
+            with open("/sys/fs/cgroup/cpu.max") as fh:
+                q, p = fh.read().split()
+                if q != "max":
+                    out["cpu_quota"] = round(int(q) / int(p), 3)
+        except (OSError, ValueError):
+            pass
+    return out
+
+
 def emit(row):
     row["host_cores"] = os.cpu_count()
+    row.update(_cpu_limits())
     print(json.dumps(row), flush=True)
 
 
-def measure_decode(threads_list, n_reads=500_000):
-    from sam2consensus_tpu.encoder.events import GenomeLayout
-    from sam2consensus_tpu.encoder.parallel_decode import ParallelFusedDecoder
-    from sam2consensus_tpu.io.sam import ReadStream, opener, read_header
+def _reps():
+    return max(1, int(os.environ.get("S2C_SCALING_REPS", "5")))
+
+
+def _best(fn):
+    best = None
+    for _ in range(_reps()):
+        dt = fn()
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def _sim_sam(n_reads, tmpdir):
     from sam2consensus_tpu.utils.simulate import SimSpec, simulate
-    import io
-    import tempfile
 
     spec = SimSpec(n_contigs=200, contig_len=2000, n_reads=n_reads,
                    read_len=100, ins_read_rate=0.05, del_read_rate=0.05,
                    seed=99)
-    log(f"[decode] simulating {n_reads} reads ...")
+    log(f"[sim] {n_reads} reads ...")
     text = simulate(spec)
-    with tempfile.NamedTemporaryFile("w", suffix=".sam",
-                                     delete=False) as fh:
+    path = os.path.join(tmpdir, "scaling.sam")
+    with open(path, "w") as fh:
         fh.write(text)
-        path = fh.name
-    try:
-        handle = opener(path, binary=True)
-        contigs, _n, first = read_header(handle)
-        layout = GenomeLayout(contigs)
-        blocks = list(ReadStream(handle, first).blocks())
-        handle.close()
-        total_mb = sum(len(b) for b in blocks) / 1e6
+    return path, os.path.getsize(path)
+
+
+def measure_decode(threads_list, n_reads=500_000):
+    import tempfile
+
+    from sam2consensus_tpu.encoder.events import GenomeLayout
+    from sam2consensus_tpu.encoder.native_encoder import NativeReadEncoder
+    from sam2consensus_tpu.encoder.parallel_decode import \
+        ParallelFusedDecoder
+    from sam2consensus_tpu.io.sam import ReadStream, opener, read_header
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path, total_b = _sim_sam(n_reads, tmp)
+        total_mb = total_b / 1e6
+
+        def open_stream():
+            handle = opener(path, binary=True)
+            contigs, _n, first = read_header(handle)
+            return handle, GenomeLayout(contigs), \
+                ReadStream(handle, first)
+
+        def serial_once():
+            handle, layout, stream = open_stream()
+            counts = np.zeros((layout.total_len, 6), dtype=np.int32)
+            enc = NativeReadEncoder(layout, accumulate_into=counts)
+            t0 = time.perf_counter()
+            for _ in enc.encode_blocks(stream.blocks()):
+                pass
+            dt = time.perf_counter() - t0
+            handle.close()
+            return dt
+
+        best = _best(serial_once)
+        emit({"metric": "serial_decode", "threads": 1,
+              "sec": round(best, 4),
+              "mb_per_s": round(total_mb / best, 1)})
+        log(f"[decode] serial: {best:.3f}s ({total_mb / best:.0f} MB/s)")
+
+        def rung_once(nt, rung):
+            handle, layout, stream = open_stream()
+            counts = np.zeros((layout.total_len, 6), dtype=np.int32)
+            dec = ParallelFusedDecoder(layout, counts, n_threads=nt)
+            t0 = time.perf_counter()
+            src = dec.encode_input(stream) if rung == "shards" \
+                else dec.encode_blocks(stream.blocks())
+            for _ in src:
+                pass
+            dt = time.perf_counter() - t0
+            handle.close()
+            return dt, dec
+
+        for rung, metric in (("shards", "fused_decode"),
+                             ("stream", "fused_decode_stream")):
+            for nt in threads_list:
+                best, dec = None, None
+                for _ in range(_reps()):
+                    dt, d = rung_once(nt, rung)
+                    if best is None or dt < best:
+                        best, dec = dt, d
+                emit({"metric": metric, "rung": rung, "threads": nt,
+                      "effective_threads": dec.n_threads,
+                      "sec": round(best, 4),
+                      "mb_per_s": round(total_mb / best, 1),
+                      "reads": dec.n_reads})
+                log(f"[decode] {rung} threads={nt}: {best:.3f}s "
+                    f"({total_mb / best:.0f} MB/s)")
+
+
+def measure_bam(threads_list, n_reads=300_000):
+    import tempfile
+
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.encoder.events import GenomeLayout
+    from sam2consensus_tpu.formats import open_alignment_input
+    from sam2consensus_tpu.formats.bam import sam_text_to_bam
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path, _b = _sim_sam(n_reads, tmp)
+        with open(path, "r") as fh:
+            text = fh.read()
+        bam = os.path.join(tmp, "scaling.bam")
+        sam_text_to_bam(text, bam)
+        total_mb = os.path.getsize(bam) / 1e6
+        log(f"[bam] converted ({total_mb:.1f} MB compressed)")
+
+        def once(nt):
+            ai = open_alignment_input(bam, "bam", threads=nt)
+            layout = GenomeLayout(ai.contigs)
+            cfg = RunConfig(decode_threads=nt)
+            enc, batches = ai.stream.make_encoder(layout, cfg, None)
+            t0 = time.perf_counter()
+            for _ in batches:
+                pass
+            dt = time.perf_counter() - t0
+            ai.close()
+            return dt, enc
+
         for nt in threads_list:
-            best = None
-            for _rep in range(3):
-                counts = np.zeros((layout.total_len, 6), dtype=np.int32)
-                dec = ParallelFusedDecoder(layout, counts, n_threads=nt)
-                t0 = time.perf_counter()
-                for _ in dec.encode_blocks(iter(blocks)):
-                    pass
-                dt = time.perf_counter() - t0
-                best = dt if best is None else min(best, dt)
-            emit({"metric": "fused_decode", "threads": nt,
-                  "effective_threads": dec.n_threads,
+            best, enc = None, None
+            for _ in range(_reps()):
+                dt, e = once(nt)
+                if best is None or dt < best:
+                    best, enc = dt, e
+            emit({"metric": "bam_ingest", "threads": nt,
                   "sec": round(best, 4),
-                  "mb_per_s": round(total_mb / best, 1),
-                  "reads": dec.n_reads})
-            log(f"[decode] threads={nt}: {best:.3f}s "
-                f"({total_mb / best:.0f} MB/s)")
-    finally:
-        os.unlink(path)
+                  "bam_mb_per_s": round(total_mb / best, 1),
+                  "reads": enc.n_reads})
+            log(f"[bam] threads={nt}: {best:.3f}s "
+                f"({total_mb / best:.0f} compressed MB/s)")
 
 
 def measure_vote(threads_list, L=4 << 20):
@@ -87,14 +231,14 @@ def measure_vote(threads_list, L=4 << 20):
     counts = rng.integers(0, 60, (L, 6)).astype(np.int32)
     for T, thresholds in ((1, [0.25]), (3, [0.25, 0.5, 0.75])):
         for nt in threads_list:
-            best = None
-            for _rep in range(3):
+            def once():
                 t0 = time.perf_counter()
                 out = vote_positions_native(counts, thresholds, 1,
                                             threads=nt)
-                dt = time.perf_counter() - t0
                 assert out is not None
-                best = dt if best is None else min(best, dt)
+                return time.perf_counter() - t0
+
+            best = _best(once)
             emit({"metric": "native_vote", "threads": nt,
                   "n_thresholds": T, "positions": L,
                   "sec": round(best, 4),
@@ -107,6 +251,7 @@ def main():
     threads_list = [int(t) for t in os.environ.get(
         "S2C_SCALING_THREADS", "1,2,4").split(",")]
     measure_decode(threads_list)
+    measure_bam(threads_list)
     measure_vote(threads_list)
     return 0
 

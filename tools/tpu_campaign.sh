@@ -147,6 +147,17 @@ BENCH_CONFIGS=ecoli_bam,longread_ont BENCH_SERVE_JOBS=0 \
 run_step bgzf_scaling "campaign/bgzf_scaling_$R.jsonl" \
   "campaign/bgzf_scaling_stderr_$R.log" 1800 python tools/bgzf_scaling.py
 
+# 4h. ingest thread scaling (the sharded-ingest claim, ISSUE 8): the
+# byte-shard rung vs the streaming rung vs the serial floor at 1/2/4
+# threads, a BAM binary-ingest leg, and the threaded native vote —
+# best-of-5 per point, host core count stamped per row.  The committed
+# bench-host artifact is perf/thread_scaling_r08.jsonl; this step
+# re-measures on the rig so the r-tagged campaign copy tracks the
+# hardware the other legs ran on.
+run_step thread_scaling "campaign/thread_scaling_$R.jsonl" \
+  "campaign/thread_scaling_stderr_$R.log" 1800 \
+  python tools/thread_scaling.py
+
 # 5. packed5 output-encoding measurement (sets S2C_P5_DEV_NS evidence)
 run_step measure_p5 "campaign/measure_p5_$R.jsonl" \
   "campaign/measure_p5_stderr_$R.log" 1200 python tools/measure_p5.py
