@@ -54,6 +54,9 @@ class CpuBackend:
         tracer/registry scope as the jax backend, so ``--trace-out`` /
         ``--metrics-out`` work on ``--backend cpu`` and its phase
         seconds surface through the same compat view."""
+        from ..ingest.badrecords import (BadRecordBudgetExceeded,
+                                         abort_bookkeeping)
+
         robs = obs.start_run(
             trace_out=getattr(cfg, "trace_out", None),
             metrics_out=getattr(cfg, "metrics_out", None))
@@ -61,16 +64,42 @@ class CpuBackend:
             result = self._run(contigs, records, cfg)
             obs.publish_stats_extra(result.stats.extra)
             return result
+        except BadRecordBudgetExceeded as exc:
+            abort_bookkeeping(exc, obs.metrics())
+            raise
         finally:
             obs.finish_run(robs, meta={"backend": self.name})
 
     def _run(self, contigs: List[Contig], records: Iterable[SamRecord],
              cfg: RunConfig) -> BackendResult:
+        from ..encoder.events import render_record
+        from ..ingest.badrecords import sink_from_config
+
+        # tolerant decode (--on-bad-record skip|quarantine): the oracle
+        # is its own single rung — parse errors absorb through the
+        # iter_records hook, validation errors through the loop below;
+        # both into one stream-order partition
+        bad_sink = sink_from_config(cfg)
+        source = records
         # any stream-shaped source (io.sam.ReadStream, formats.bam
         # BamReadStream) yields parsed records; bare record iterables
         # pass through
         if hasattr(records, "records"):
-            records = records.records()
+            on_bad = None
+            if bad_sink is not None:
+                def on_bad(line, exc):
+                    # parse-level bad record: quarantine AND count the
+                    # skip, exactly like the native rungs' replay lane.
+                    # BAM parse errors know their record offset (the
+                    # text lane has no offset tracking — documented)
+                    off = getattr(exc, "s2c_offset", None)
+                    if off is None:
+                        off = getattr(exc, "offset", None)
+                        if not isinstance(off, int) or off < 0:
+                            off = None
+                    bad_sink.record(line, exc, offset=off)
+                    stats.reads_skipped += 1
+            records = source.records(on_bad=on_bad)
         stats = BackendStats()
         tr = obs.tracer()
         reg = obs.metrics()
@@ -90,43 +119,53 @@ class CpuBackend:
         # --- accumulation (sam2consensus.py:191-221) ---
         t0 = time.perf_counter()
         for rec in records:
-            try:
+            err = None
+            seqs_ref = seqout = insert = None
+            if rec.refname not in sequences:
+                err = KeyError(
+                    f"read mapped to unknown reference {rec.refname!r} "
+                    "(reference would KeyError here too)")
+            else:
                 seqs_ref = sequences[rec.refname]
-            except KeyError:
-                if cfg.strict:
-                    raise KeyError(
-                        f"read mapped to unknown reference {rec.refname!r} "
-                        "(reference would KeyError here too)") from None
-                stats.reads_skipped += 1
-                continue
-            seqout, insert = walk(rec.cigar, rec.seq, rec.pos)
-            pos_ref = rec.pos
-            # Validate the whole read *before* touching the pileup so a
-            # permissive-mode skip leaves no partial increments behind.
-            # A zero-span read (all S/H/I ops) touches no position and is
-            # accepted at any POS, like the reference's zero-iteration loop.
-            span_end = pos_ref + len(seqout)
-            in_bounds = (len(seqout) == 0
-                         or (-len(seqs_ref) <= pos_ref
-                             and span_end <= len(seqs_ref)))
-            valid_bases = (all(ch in "-ACGNT" for ch in seqout)
-                           and all(ch in "-ACGNT"
-                                   for _pos, motif in insert for ch in motif))
-            if not (in_bounds and valid_bases):
-                if cfg.strict:
-                    if not in_bounds:
-                        raise IndexError(
-                            f"read at pos {rec.pos} spans [{rec.pos},"
-                            f" {span_end}) outside reference "
-                            f"{rec.refname!r} of length {len(seqs_ref)} "
-                            "(reference would IndexError here too)")
-                    raise KeyError(
-                        f"read at pos {rec.pos} contains an out-of-alphabet "
+                seqout, insert = walk(rec.cigar, rec.seq, rec.pos)
+                # Validate the whole read *before* touching the pileup so
+                # a skip (permissive OR tolerant) leaves no partial
+                # increments behind.  A zero-span read (all S/H/I ops)
+                # touches no position and is accepted at any POS, like
+                # the reference's zero-iteration loop.
+                span_end = rec.pos + len(seqout)
+                in_bounds = (len(seqout) == 0
+                             or (-len(seqs_ref) <= rec.pos
+                                 and span_end <= len(seqs_ref)))
+                if not in_bounds:
+                    err = IndexError(
+                        f"read at pos {rec.pos} spans [{rec.pos},"
+                        f" {span_end}) outside reference "
+                        f"{rec.refname!r} of length {len(seqs_ref)} "
+                        "(reference would IndexError here too)")
+                elif not (all(ch in "-ACGNT" for ch in seqout)
+                          and all(ch in "-ACGNT"
+                                  for _pos, motif in insert
+                                  for ch in motif)):
+                    err = KeyError(
+                        f"read at pos {rec.pos} contains an "
+                        "out-of-alphabet "
                         "base (input contract is uppercase ACGTN; the "
                         "reference would KeyError here too, though for "
-                        "insertion motifs only later, in its reformat pass)")
+                        "insertion motifs only later, in its reformat "
+                        "pass)")
+            if err is not None:
+                if bad_sink is not None:
+                    # tolerant decode: quarantine/count per record (the
+                    # sink raises the budget error when it is spent)
+                    bad_sink.record(render_record(rec), err)
+                    stats.reads_skipped += 1
+                    continue
+                if cfg.strict:
+                    raise err from None
                 stats.reads_skipped += 1
                 continue
+            pos_ref = rec.pos
             if cfg.maxdel is None or seqout.count("-") <= cfg.maxdel:
                 for nuc in seqout:
                     seqs_ref[pos_ref][nuc] += 1
@@ -145,6 +184,16 @@ class CpuBackend:
         reg.add("reads/mapped", stats.reads_mapped)
         reg.add("reads/skipped", stats.reads_skipped)
         reg.add("pileup/cells", stats.aligned_bases)
+        if bad_sink is not None:
+            total = int(getattr(source, "n_lines", 0) or 0)
+            if total <= 0:
+                total = stats.reads_mapped + stats.reads_skipped
+            summary = bad_sink.finish(total)
+            bad_sink.publish(reg)
+            if summary["bad_records"]:
+                stats.extra["bad_records"] = summary["bad_records"]
+                if summary.get("sidecar"):
+                    stats.extra["quarantine_sidecar"] = summary["sidecar"]
 
         # --- reformat + insertion table (sam2consensus.py:233-311) ---
         t0 = time.perf_counter()

@@ -530,6 +530,16 @@ class JaxBackend:
             obs.finalize_decisions()
             obs.publish_stats_extra(result.stats.extra)
             return result
+        except BaseException as exc:
+            from ..ingest.badrecords import (BadRecordBudgetExceeded,
+                                             abort_bookkeeping)
+
+            if isinstance(exc, BadRecordBudgetExceeded):
+                # rotten input (--max-bad-records blown mid-decode, on
+                # whichever rung/thread): leave the evidence — sidecar
+                # + counters — before the typed failure propagates
+                abort_bookkeeping(exc, obs.metrics())
+            raise
         finally:
             faultinject.configure("")
             obs.finish_run(robs, meta={"backend": self.name})
@@ -875,6 +885,23 @@ class JaxBackend:
         reg.add("reads/mapped", encoder.n_reads)
         reg.add("reads/skipped", encoder.n_skipped)
         reg.add("pileup/cells", stats.aligned_bases - base_aligned)
+        bad_sink = getattr(encoder, "bad_sink", None)
+        if bad_sink is not None:
+            # decode is complete: enforce the percent budget against the
+            # real record total, write the quarantine sidecar, publish
+            # the ingest/bad_records + quarantine/* counters.  A blown
+            # budget raises the typed DATA-class failure HERE — before
+            # any tail work — and run()'s abort bookkeeping finalizes
+            # the evidence.
+            total = int(getattr(records, "n_lines", 0) or 0)
+            if total <= 0:
+                total = encoder.n_reads + encoder.n_skipped
+            summary = bad_sink.finish(total)
+            bad_sink.publish(reg)
+            if summary["bad_records"]:
+                stats.extra["bad_records"] = summary["bad_records"]
+                if summary.get("sidecar"):
+                    stats.extra["quarantine_sidecar"] = summary["sidecar"]
         stats.extra["shards"] = shards if use_sharded else 1
         stats.extra["decoder"] = encoder.__class__.__name__
         if getattr(acc, "strategy_used", None):
@@ -915,9 +942,11 @@ class JaxBackend:
                     site="tail")
                 break
             except BaseException as exc:
-                from ..resilience.policy import PASSTHROUGH, classify
+                from ..resilience.policy import (DATA, PASSTHROUGH,
+                                                 classify)
 
-                if (demoted_tail or classify(exc) == PASSTHROUGH
+                if (demoted_tail
+                        or classify(exc) in (PASSTHROUGH, DATA)
                         or policy.on_error != "fallback"):
                     raise
                 acc = rladder.demote_tail_and_record(
@@ -1634,9 +1663,17 @@ class JaxBackend:
         stats.extra["paranoid_result_ok"] = True
 
     def _make_encoder(self, layout, records, cfg: RunConfig, acc=None):
-        """Pick the host decode path; returns (encoder, batch iterator)."""
+        """Pick the host decode path; returns (encoder, batch iterator).
+
+        Tolerant decode: the run's ONE quarantine sink is created here
+        (``--on-bad-record skip|quarantine`` — None under the strict
+        default) and carried on the encoder as ``bad_sink``, so every
+        caller — the cold path, serve's decode-ahead thread (which
+        builds the encoder through this same method), the BAM stream's
+        ``make_encoder`` — shares run-lifecycle code in ``_run``."""
         from ..encoder.events import (GenomeLayout, ReadEncoder,  # noqa: F811
                                       resolve_segment_width)
+        from ..ingest.badrecords import sink_from_config
         from ..io.sam import ReadStream
         from ..ops.pileup import HostPileupAccumulator
 
@@ -1651,12 +1688,14 @@ class JaxBackend:
 
         seg_w = resolve_segment_width(getattr(cfg, "segment_width", 0))
         self._record_layout_decision(cfg, seg_w)
+        bad_sink = sink_from_config(cfg)
 
         if hasattr(records, "make_encoder"):
             # binary formats (formats/bam.py BamReadStream): the stream
             # owns its vectorized record decode and hands back the same
             # (encoder, batches) surface as the text paths
-            return records.make_encoder(layout, cfg, acc)
+            return records.make_encoder(layout, cfg, acc,
+                                        bad_sink=bad_sink)
 
         if isinstance(records, ReadStream) and cfg.decoder != "py":
             from ..encoder import native_encoder
@@ -1691,14 +1730,14 @@ class JaxBackend:
                         maxdel=cfg.maxdel, strict=cfg.strict,
                         on_lines=records.add_lines,
                         on_bytes=records.add_bytes,
-                        segment_width=seg_w)
+                        segment_width=seg_w, bad_sink=bad_sink)
                     return enc, enc.encode_input(records)
                 enc = native_encoder.NativeReadEncoder(
                     layout, maxdel=cfg.maxdel, strict=cfg.strict,
                     on_lines=records.add_lines, on_bytes=records.add_bytes,
                     accumulate_into=acc.counts_host() if fuse else None,
-                    segment_width=seg_w)
-                return enc, enc.encode_blocks(records.blocks())
+                    segment_width=seg_w, bad_sink=bad_sink)
+                return enc, enc.encode_blocks_from(records)
             if cfg.decoder == "native":
                 from .. import native
 
@@ -1706,9 +1745,17 @@ class JaxBackend:
                                    f"decoder is unavailable: "
                                    f"{native.load_error()}")
         enc = ReadEncoder(layout, maxdel=cfg.maxdel, strict=cfg.strict,
-                          segment_width=seg_w)
-        source = records.records() if isinstance(records, ReadStream) \
-            else records
+                          segment_width=seg_w, bad_sink=bad_sink)
+        on_bad = None
+        if bad_sink is not None:
+            def on_bad(line, exc):
+                # pure-python rung parse errors (iter_records): same
+                # sink, single stream-order partition — and the same
+                # n_skipped accounting as the native rungs' replay lane
+                bad_sink.record(line, exc)
+                enc.n_skipped += 1
+        source = records.records(on_bad=on_bad) \
+            if isinstance(records, ReadStream) else records
         return enc, enc.encode_segments(source, cfg.chunk_reads)
 
     @staticmethod

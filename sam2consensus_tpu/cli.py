@@ -80,6 +80,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--permissive", action="store_true",
                    help="skip-and-count malformed/out-of-contract records "
                         "instead of erroring like the reference")
+    p.add_argument("--on-bad-record", dest="on_bad_record",
+                   choices=["fail", "skip", "quarantine"], default="fail",
+                   help="per-record malformation policy "
+                        "(ingest/badrecords.py): fail (default; strict "
+                        "reference semantics — first bad record kills the "
+                        "job with a typed error carrying the file offset), "
+                        "skip (drop + count as ingest/bad_records with a "
+                        "per-reason taxonomy), quarantine (skip + write "
+                        "the raw record and classified reason to a "
+                        "bounded JSONL sidecar).  Identical consensus "
+                        "bytes on every decode rung (serial/sharded/"
+                        "streaming/BAM)")
+    p.add_argument("--max-bad-records", dest="max_bad_records", default="",
+                   help="error budget for tolerant modes: N (absolute — "
+                        "the Nth bad record fails the job immediately) or "
+                        "x%% (fraction of all records, checked at stream "
+                        "end).  A blown budget is a clean job-level "
+                        "failure with a precise summary (DATA resilience "
+                        "class: never retried, never demotes a rung, "
+                        "never pins a serve tenant)")
+    p.add_argument("--quarantine-out", dest="quarantine_out", default=None,
+                   help="quarantine sidecar path (s2c-quarantine/1 JSONL; "
+                        "default <outfolder>/<prefix>_quarantine.jsonl); "
+                        "bounded by S2C_QUARANTINE_MAX stored records")
     p.add_argument("--quiet", action="store_true", help="suppress progress output")
     p.add_argument("--json-metrics", dest="json_metrics", default=None,
                    help="write run metrics as JSON to this path ('-' = stdout)")
@@ -231,6 +255,17 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
             "error: consensus thresholds must be finite, > 0 and <= 100, "
             f"got {args.thresholds}")
     prefix = args.prefix if args.prefix != "" else default_prefix(args.filename)
+    # --on-bad-record / --max-bad-records / --quarantine-out cross-
+    # checks are validated up front (a typo'd budget must fail the run
+    # at parse time, not after the decode warmed up) by the ONE
+    # authority — policy_from_config — which API callers hit with the
+    # same ValueError at run start
+    from .ingest.badrecords import policy_from_config
+
+    try:
+        policy_from_config(args)
+    except ValueError as exc:
+        raise SystemExit(f"error: {exc}") from None
     if args.maxdel is None:
         maxdel: Optional[int] = 150
     elif args.py2_compat:
@@ -274,6 +309,9 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         retry_backoff=args.retry_backoff,
         on_device_error=args.on_device_error,
         fault_inject=args.fault_inject,
+        on_bad_record=getattr(args, "on_bad_record", "fail"),
+        max_bad_records=getattr(args, "max_bad_records", ""),
+        quarantine_out=getattr(args, "quarantine_out", None),
     )
 
 
@@ -317,6 +355,20 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("-d", "--maxdel", dest="maxdel", type=int, default=None)
     p.add_argument("--py2-compat", action="store_true")
     p.add_argument("--permissive", action="store_true")
+    p.add_argument("--on-bad-record", dest="on_bad_record",
+                   choices=["fail", "skip", "quarantine"], default="fail",
+                   help="per-record malformation policy shared by every "
+                        "job (see the one-shot CLI); a blown "
+                        "--max-bad-records budget fails ONLY that job "
+                        "(DATA class: no retry, no rung demotion, no "
+                        "tenant pinning) while the queue keeps draining "
+                        "warm")
+    p.add_argument("--max-bad-records", dest="max_bad_records", default="",
+                   help="per-job bad-record error budget: N or x%%")
+    p.add_argument("--quarantine-out", dest="quarantine_out", default=None,
+                   help="quarantine sidecar base path: job k writes "
+                        "<base>.job<k>.jsonl (default per-job "
+                        "<outfolder>/<prefix>_quarantine.jsonl)")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--format", dest="input_format",
                    choices=["auto", "sam", "sam.gz", "bam"],
@@ -466,7 +518,19 @@ def serve_main(argv: List[str]) -> int:
             job_args.metrics_out = f"{args.metrics_out}.job{k}.jsonl"
         if args.trace_out:
             job_args.trace_out = f"{args.trace_out}.job{k}.json"
+        if args.quarantine_out:
+            # per-job sidecars, same .jobN discipline as metrics/trace
+            # (N jobs sharing one sidecar would interleave evidence)
+            job_args.quarantine_out = f"{args.quarantine_out}.job{k}.jsonl"
         cfg = config_from_args(job_args)
+        if cfg.on_bad_record == "quarantine" and not cfg.quarantine_out:
+            # the DEFAULT sidecar derives from prefix = input basename,
+            # so two jobs over the same upload (the retrying-tenant
+            # case) would clobber each other's evidence — stamp the
+            # job index into the default too
+            cfg.quarantine_out = os.path.join(
+                cfg.outfolder,
+                f"{cfg.prefix}_quarantine.job{k}.jsonl")
         specs.append(JobSpec(filename=path, config=cfg,
                              job_id=f"job{k}:{os.path.basename(path)}",
                              tenant=args.tenant))
@@ -590,18 +654,43 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .observability.jitcache import setup_persistent_cache
 
         setup_persistent_cache()
-    if cfg.profile_dir:
-        import jax
+    from .ingest.badrecords import BadRecordBudgetExceeded
 
-        with jax.profiler.trace(cfg.profile_dir):
+    try:
+        if cfg.profile_dir:
+            import jax
+
+            with jax.profiler.trace(cfg.profile_dir):
+                result = backend.run(contigs, stream, cfg)
+        else:
             result = backend.run(contigs, stream, cfg)
-    else:
-        result = backend.run(contigs, stream, cfg)
+    except BadRecordBudgetExceeded as exc:
+        # rotten input: a clean job-level failure with the precise
+        # summary (counts per reason + sidecar path), not a traceback —
+        # the budget is the user's own contract with their data
+        ai.close()
+        s = exc.summary
+        lines = [f"error: {exc}"]
+        if s.get("reasons"):
+            lines.append("  reasons: " + ", ".join(
+                f"{why}={n}" for why, n in s["reasons"].items()))
+        if s.get("sidecar"):
+            lines.append(f"  quarantine sidecar: {s['sidecar']}")
+        raise SystemExit("\n".join(lines)) from None
     ai.close()
     reads_total = stream.n_lines
 
     echo("A total of " + str(reads_total) + " reads were processed, out of "
          "which, " + str(result.stats.reads_mapped) + " reads were mapped.\n")
+    n_bad = result.stats.extra.get("bad_records", 0)
+    if n_bad:
+        msg = (f"{n_bad} malformed record(s) "
+               + ("quarantined" if cfg.on_bad_record == "quarantine"
+                  else "skipped") + f" (--on-bad-record {cfg.on_bad_record})")
+        sidecar = result.stats.extra.get("quarantine_sidecar")
+        if sidecar:
+            msg += f"; sidecar: {sidecar}"
+        echo(msg + "\n")
 
     write_outputs(result.fastas, cfg.outfolder, cfg.prefix, cfg.nchar,
                   cfg.thresholds, echo=echo)

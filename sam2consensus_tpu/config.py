@@ -66,6 +66,13 @@ class RunConfig:
     checkpoint_every: int = 2_000_000  # reads between checkpoint writes
     paranoid: bool = False       # re-validate device inputs/outputs per batch
     shards: int = 0              # 0 = use all local devices for DP
+    # --- tolerant decode (sam2consensus_tpu/ingest/badrecords.py) ---
+    on_bad_record: str = "fail"  # fail | skip | quarantine (per-record
+    #                              malformation policy; fail = strict
+    #                              reference semantics, byte-identical)
+    max_bad_records: str = ""    # error budget: "" (none), N, or x%
+    quarantine_out: Optional[str] = None  # sidecar path (quarantine mode;
+    #                              default <outfolder>/<prefix>_quarantine.jsonl)
 
     @staticmethod
     def threshold_labels(thresholds: List[float]) -> List[str]:

@@ -28,6 +28,8 @@ from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 import numpy as np
 
 from ..constants import PAD_CODE
+from ..ingest.badrecords import (C_REASONS, RECORD_ERRORS, classify_reason,
+                                 mark_offset)
 from ..io.sam import iter_records
 from .. import native
 from .events import (EncodeError, GenomeLayout, MIN_BUCKET_W, ReadEncoder,
@@ -66,7 +68,8 @@ class NativeReadEncoder:
                  strict: bool = True, width: int = 256,
                  on_lines=None, on_bytes=None,
                  accumulate_into: Optional[np.ndarray] = None,
-                 segment_width: int = 0, private_counts: bool = False):
+                 segment_width: int = 0, private_counts: bool = False,
+                 bad_sink=None, bad_partition=(0,)):
         lib = native.load()
         if lib is None:  # pragma: no cover - callers check available()
             raise RuntimeError(f"native decoder unavailable: "
@@ -75,6 +78,21 @@ class NativeReadEncoder:
         self.layout = layout
         self.maxdel = maxdel
         self.strict = strict
+        #: tolerant decode (--on-bad-record): when a sink is attached,
+        #: the C decoder runs in line-FLAGGING mode (strict=1 on the C
+        #: side — its clean fast path is byte-identical to strict runs,
+        #: which is why tolerant-mode overhead on clean input is ~zero)
+        #: and the python replay below absorbs each flagged record into
+        #: the sink instead of raising.  ``bad_partition`` keys this
+        #: encoder's records in the sink's deterministic merge order;
+        #: the rung schedulers re-key it (shard index / block index).
+        self.bad_sink = bad_sink
+        self.bad_partition = tuple(bad_partition)
+        self._c_strict = 1 if (strict or bad_sink is not None) else 0
+        #: absolute input offset of the block currently being decoded
+        #: (set by the feeding rung; None = offsets unknown) — the base
+        #: for strict-error offset marking and quarantine entries
+        self.block_base = None
         #: slab-width ceiling: with the segmented layout active, a long
         #: read is an overflow line that the python twin splits into
         #: <=segment_width rows — so the native slab never widens past W
@@ -147,6 +165,8 @@ class NativeReadEncoder:
         self._banked = 0
         # python twin for overflow/error-replay fallback; shares counters
         # and the insertion store so fallback reads land in the same place
+        # (NOT the sink: _fallback_line/_fallback_record own the tolerant
+        # catch around encode_record, so the twin never double-records)
         self._py = ReadEncoder(layout, maxdel=maxdel, strict=strict,
                                segment_width=segment_width)
         self.insertions = self._py.insertions
@@ -215,6 +235,7 @@ class NativeReadEncoder:
             if isinstance(text, str):
                 text = text.encode("ascii")
             data = np.frombuffer(text, dtype=np.uint8)
+            base = self.block_base       # set by the feeding rung
             offset = 0
             while offset < len(data):
                 chunk = data[offset:]
@@ -238,7 +259,7 @@ class NativeReadEncoder:
                     self._names, self._name_off, len(self._ctg_len),
                     self._ctg_offset, self._ctg_len,
                     -1 if self.maxdel is None else self.maxdel,
-                    1 if self.strict else 0,
+                    self._c_strict,
                     self._slab_w,
                     self._starts[fill:], self._codes[fill:],
                     len(self._starts) - fill,
@@ -270,7 +291,10 @@ class NativeReadEncoder:
 
                 # overflow lines (span > width): python fallback, whole read
                 for k in range(int(n_overflow)):
-                    self._fallback_line(chunk, int(ovf[k]))
+                    self._fallback_line(
+                        chunk, int(ovf[k]),
+                        abs_off=None if base is None
+                        else base + offset + int(ovf[k]))
                 if n_overflow > max(64, n_reads // 64):
                     # widen future slabs; the current slab keeps its
                     # width.  Capped at the segmented layout's W when
@@ -293,7 +317,10 @@ class NativeReadEncoder:
                     # the replay succeeds instead (python being more lenient
                     # than the C parser), commit it via the fallback path
                     line_end = _line_end(data, offset)
-                    self._fallback_line(data, offset, line_end=line_end)
+                    self._fallback_line(
+                        data, offset, line_end=line_end,
+                        abs_off=None if base is None else base + offset,
+                        c_reason=int(out[14]))
                     self._count_lines(1)
                     self._count_bytes(min(line_end + 1, len(data)) - offset)
                     offset = line_end + 1
@@ -326,6 +353,18 @@ class NativeReadEncoder:
         batch = self._flush()
         if batch is not None:
             yield batch
+
+    def encode_blocks_from(self, stream) -> Iterator[SegmentBatch]:
+        """``encode_blocks`` over a ReadStream, tracking each block's
+        absolute input offset (``stream.block_offset`` →
+        ``self.block_base``) so strict errors and quarantine entries
+        carry real file offsets on the serial rung too."""
+        def feed():
+            for block in stream.blocks():
+                self.block_base = getattr(stream, "block_offset", None)
+                yield block
+
+        return self.encode_blocks(feed())
 
     def merge_shadow(self) -> None:
         """Fold the C decoder's uint8 shadow counts + overflow bank into
@@ -388,21 +427,46 @@ class NativeReadEncoder:
             self.on_bytes(k)
 
     def _fallback_line(self, data: np.ndarray, start: int,
-                       line_end: Optional[int] = None) -> None:
-        """Encode one raw line via the Python path into the pending batch."""
+                       line_end: Optional[int] = None,
+                       abs_off: Optional[int] = None,
+                       c_reason: int = 0) -> None:
+        """Encode one raw line via the Python path into the pending batch.
+
+        This is THE tolerance point of every native text rung: a line
+        the C decoder flagged (or a wide/overflow read) replays through
+        the golden encoder; with a sink attached, any strict-mode error
+        the replay raises — parse OR encode level, the exact oracle
+        types — is classified and absorbed per record.  Strict mode
+        additionally stamps the line's absolute input offset onto the
+        raised exception (``s2c_offset``), identically on the serial,
+        sharded and streaming rungs.
+        """
         if line_end is None:
             line_end = _line_end(data, start)
-        # include the trailing newline so even an empty line replays as the
-        # truthy "\n" string the pure-python path would have seen
-        line = bytes(data[start:min(line_end + 1, len(data))]).decode("ascii")
-        # the record iterator raises IndexError on malformed lines in every
-        # mode, exactly like the pure-python path
-        recs = list(iter_records(iter(()), line))
+        raw = bytes(data[start:min(line_end + 1, len(data))])
+        sink = self.bad_sink
+        try:
+            # include the trailing newline so even an empty line replays
+            # as the truthy "\n" string the pure-python path would have
+            # seen; the record iterator raises IndexError on malformed
+            # lines in every mode, exactly like the pure-python path
+            line = raw.decode("ascii")
+            recs = list(iter_records(iter(()), line))
+        except RECORD_ERRORS as exc:
+            if sink is not None:
+                self._quarantine(sink, raw, exc, abs_off, c_reason)
+                return
+            mark_offset(exc, abs_off)
+            raise
         for rec in recs:
             try:
                 rows = self._py.encode_record(rec)
-            except (EncodeError, KeyError, IndexError):
+            except (EncodeError, KeyError, IndexError) as exc:
+                if sink is not None:
+                    self._quarantine(sink, raw, exc, abs_off, c_reason)
+                    continue
                 if self.strict:
+                    mark_offset(exc, abs_off)
                     raise
                 self._py.n_skipped += 1
                 continue
@@ -426,6 +490,20 @@ class NativeReadEncoder:
                     self._fallback_rows.append((start_flat, row))
                     self._batch_events += (len(row)
                                            - int((row == PAD_CODE).sum()))
+
+    def _quarantine(self, sink, raw: bytes, exc: BaseException,
+                    abs_off: Optional[int], c_reason: int) -> None:
+        """Absorb one flagged record into the sink (counts a skip like
+        legacy permissive mode).  The C decoder's reason-code hint
+        refines classification only when the python-side classifier
+        cannot name the failure — python classification is the
+        authority, so the pure-python rung can never disagree."""
+        reason = classify_reason(exc)
+        if reason == "malformed":
+            reason = C_REASONS.get(int(c_reason), reason)
+        sink.record(raw, exc, partition=self.bad_partition,
+                    offset=abs_off, reason=reason)
+        self._py.n_skipped += 1
 
     def _build_batch(self, native_parts, fallback_rows, n_reads, n_events
                      ) -> Optional[SegmentBatch]:

@@ -67,6 +67,7 @@ import numpy as np
 
 from .. import observability as obs
 from ..ingest import DEFAULT_MIN_SHARD_BYTES, ShardPlan, snap_line_start
+from ..ingest.badrecords import is_data_error
 from ..resilience.faultinject import fault_check
 from .events import (EncodeError, GenomeLayout, InsertionEvents,
                      SegmentBatch)
@@ -100,8 +101,16 @@ class ParallelFusedDecoder:
                  counts: Optional[np.ndarray], n_threads: int,
                  maxdel: Optional[int] = 150,
                  strict: bool = True, on_lines=None, on_bytes=None,
-                 segment_width: int = 0):
+                 segment_width: int = 0, bad_sink=None):
         self._segment_width = segment_width
+        #: tolerant decode (--on-bad-record): ONE run-wide sink shared by
+        #: every worker encoder.  Rung invariance is partition keying:
+        #: shard workers record into partition ``(shard_idx,)`` (cleared
+        #: whole on a shard retry, reset whole on an ingest demotion —
+        #: the count-bank discipline), streaming workers re-key per
+        #: block index; ``entries()``'s sorted-partition merge is stream
+        #: order on both rungs.
+        self.bad_sink = bad_sink
         self.layout = layout
         self._counts = counts
         self.maxdel = maxdel
@@ -151,7 +160,8 @@ class ParallelFusedDecoder:
         return not self._direct or idx > 0
 
     # ------------------------------------------------------------------
-    def _mk_encoder(self, st: dict, private: bool) -> NativeReadEncoder:
+    def _mk_encoder(self, st: dict, private: bool,
+                    partition=(0,)) -> NativeReadEncoder:
         """A fresh worker encoder counting lines/bytes into ``st``."""
 
         def _count(key):
@@ -164,7 +174,8 @@ class ParallelFusedDecoder:
             accumulate_into=self._counts,
             on_lines=_count("lines"), on_bytes=_count("bytes"),
             segment_width=self._segment_width,
-            private_counts=private and self._counts is not None)
+            private_counts=private and self._counts is not None,
+            bad_sink=self.bad_sink, bad_partition=partition)
 
     def _finish(self, encoders: List[NativeReadEncoder],
                 n_lines: int, n_bytes: int) -> None:
@@ -202,7 +213,7 @@ class ParallelFusedDecoder:
             {"rung": "stream", "threads": self.n_threads,
              "input": type(stream.handle).__name__,
              "fused": self.counts_fused})
-        return self.encode_blocks(stream.blocks())
+        return self.encode_blocks(stream.blocks(), stream=stream)
 
     # -- shard rung --------------------------------------------------------
     def encode_shards(self, plan: ShardPlan) -> Iterator[SegmentBatch]:
@@ -220,11 +231,17 @@ class ParallelFusedDecoder:
         return self._run_shards_slab(plan, ranges, nw)
 
     def _shard_blocks(self, data, lo: int, hi: int, shard_idx: int,
-                      horizon: List[int]):
+                      horizon: List[int], enc: NativeReadEncoder):
         """Zero-copy line-snapped windows of one shard.  Between windows
         the worker checks the error horizon: a shard EARLIER than this
         one failed, so nothing from here on can matter (serial parity:
-        the stream would have stopped there) — stop feeding."""
+        the stream would have stopped there) — stop feeding.
+
+        ``enc.block_base`` is stamped with each window's absolute file
+        offset before the yield, so a strict decode error (and every
+        quarantine entry) carries the SAME offset the serial rung
+        would report — including for a record straddling a shard snap
+        boundary, whose line lives whole in exactly one shard."""
         import mmap as _mmap
 
         try:
@@ -244,6 +261,7 @@ class ParallelFusedDecoder:
                                   lo, hi)
             if end <= pos:      # one line longer than the window
                 end = hi
+            enc.block_base = pos
             yield view[pos:end]
             pos = end
 
@@ -284,12 +302,13 @@ class ParallelFusedDecoder:
                     # itself an infrastructure fault — it must take the
                     # retry/demote protocol, not kill the worker thread
                     # with st['fault'] unset
-                    enc = self._mk_encoder(st, self._private_for(shard_idx))
+                    enc = self._mk_encoder(st, self._private_for(shard_idx),
+                                           partition=(shard_idx,))
                 if self.counts_fused:
                     fault_check("ingest_decode_shard")
                 for batch in enc.encode_blocks(
                         self._shard_blocks(data, lo, hi, shard_idx,
-                                           horizon)):
+                                           horizon, enc)):
                     if self.counts_fused:
                         # counters-only: held until the shard commits,
                         # so a retry/demotion never double-counts
@@ -313,6 +332,15 @@ class ParallelFusedDecoder:
                     horizon[0] = min(horizon[0], shard_idx)
                 break
             except BaseException as exc:
+                if is_data_error(exc):
+                    # the run's bad-record budget blew on this worker's
+                    # records: a property of the INPUT, not of this
+                    # shard's attempt — never retried, never demoted
+                    # (the serial rung would fail on the same bytes)
+                    st["error"] = (shard_idx, exc)
+                    with hlock:
+                        horizon[0] = min(horizon[0], shard_idx)
+                    break
                 # infrastructure fault (injected ingest_decode_shard,
                 # MemoryError, ...): retry the shard once on a fresh
                 # encoder, then hand the decision to the coordinator
@@ -329,6 +357,11 @@ class ParallelFusedDecoder:
                     with hlock:
                         horizon[0] = min(horizon[0], shard_idx)
                     break
+                if self.bad_sink is not None:
+                    # the failed attempt's quarantine partition rolls
+                    # back whole with its count partition — the fresh
+                    # attempt re-records, so nothing double-counts
+                    self.bad_sink.clear_partition((shard_idx,))
                 reg.add("ingest/shard_retries", 1)
                 tr.event("ingest/shard_retry", shard=shard_idx,
                          error=f"{type(exc).__name__}: {exc}")
@@ -355,7 +388,8 @@ class ParallelFusedDecoder:
             # their shadow/bank allocations and name-table builds would
             # otherwise serialize against the other workers under the
             # GIL right at the start of the parallel phase
-            st["enc0"] = self._mk_encoder(st, self._private_for(st["idx"]))
+            st["enc0"] = self._mk_encoder(st, self._private_for(st["idx"]),
+                                          partition=(st["idx"],))
         # one thread per shard up to nw at a time: a simple claim queue
         # (shards are sized ~equal, so static round-robin is fine too;
         # the claim queue additionally absorbs snap-size imbalance)
@@ -415,8 +449,14 @@ class ParallelFusedDecoder:
                 "ingest/demoted",
                 error=f"{type(first[2]).__name__}: {first[2]}")
             self._counts[:] = 0
+            if self.bad_sink is not None:
+                # demotion replays the WHOLE input on the serial rung:
+                # every shard partition rolls back so the fresh pass's
+                # records (partition (0,)) are the only ones counted
+                self.bad_sink.reset()
             st = {"lines": 0, "bytes": 0}
             enc = self._mk_encoder(st, private=False)
+            enc.block_base = plan.start
             view = memoryview(plan.data)
             for batch in enc.encode_blocks(
                     iter([view[plan.start:plan.end]])):
@@ -481,7 +521,7 @@ class ParallelFusedDecoder:
                      sum(st["bytes"] for st in states))
 
     # -- streaming rung ----------------------------------------------------
-    def encode_blocks(self, blocks) -> Iterator[SegmentBatch]:
+    def encode_blocks(self, blocks, stream=None) -> Iterator[SegmentBatch]:
         """The queue-feed rung for non-shardable inputs: the stream's
         line-aligned blocks round-robin into bounded per-worker queues;
         workers process blocks in order within each worker, so when
@@ -489,7 +529,13 @@ class ParallelFusedDecoder:
         first bad line of the stream.  Feeding stops at the first
         observed failure (the serial path would not have read further
         either).  With one worker this degrades to the serial fused
-        path plus one queue hop."""
+        path plus one queue hop.
+
+        ``stream`` (when given) supplies per-block input offsets
+        (``ReadStream.block_offset`` — uncompressed offsets on gzip
+        handles) and keys each block's quarantine partition by block
+        index, so tolerant-mode entries merge in stream order exactly
+        like the shard rung's."""
         workers: List[dict] = []
         for w in range(self.n_threads):
             st = {"idx": w, "q": queue.Queue(maxsize=2), "batches": [],
@@ -526,8 +572,10 @@ class ParallelFusedDecoder:
             for idx, block in enumerate(blocks):
                 if any_error():
                     break                 # serial parity: stop reading
+                off = getattr(stream, "block_offset", None) \
+                    if stream is not None else None
                 w = idx % self.n_threads
-                tolerant_put(workers[w], threads[w], (idx, block))
+                tolerant_put(workers[w], threads[w], (idx, block, off))
                 # drain finished batches opportunistically so the
                 # backend's stats cadence ticks while decoding continues
                 for st in workers:
@@ -572,6 +620,11 @@ class ParallelFusedDecoder:
                 if item is self._DONE:
                     return
                 current_idx[0] = item[0]
+                # per-block re-key: quarantine partition = block index
+                # (sorted-partition merge == stream order) and the
+                # block's absolute input offset for error marking
+                enc.bad_partition = (item[0],)
+                enc.block_base = item[2]
                 yield item[1]
 
         try:
@@ -580,7 +633,13 @@ class ParallelFusedDecoder:
         except PARITY_ERRORS as exc:
             st["error"] = (current_idx[0], exc)
         except BaseException as exc:
-            st["fault"] = exc
+            if is_data_error(exc):
+                # budget blown mid-block: input-shaped, takes the
+                # parity path (smallest block index wins) not the
+                # infrastructure-fault path
+                st["error"] = (current_idx[0], exc)
+            else:
+                st["fault"] = exc
         # one span per worker lifetime (block-level spans would be
         # noise: the fused C decode runs ~500 MB/s/core); the bytes/lines
         # args make per-worker balance visible in the trace

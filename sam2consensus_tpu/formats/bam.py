@@ -132,20 +132,32 @@ _REC_FIXED = struct.Struct("<iiiBBHHHi")
 
 
 class _RecordIndex:
-    """Offsets + fixed fields for the complete records in one buffer."""
+    """Offsets + fixed fields for the complete records in one buffer.
+
+    With ``collect_bad``, record-bounded structural damage (fields
+    overrun a block_size whose extent IS known) becomes an index ENTRY
+    flagged in ``bad`` (exception in ``bad_exc``) instead of a raise —
+    keeping the index a faithful walk of the raw record stream, so
+    checkpoint-resume record-count skips stay exact (the native lane's
+    ``_skip_whole_records`` semantics).  Framing loss (block_size < 32)
+    raises in every mode.
+    """
 
     __slots__ = ("off", "refid", "pos", "l_rn", "n_cig", "l_seq",
-                 "consumed", "n")
+                 "consumed", "n", "base", "bad", "bad_exc")
 
-    def __init__(self, buf, base_offset: int):
+    def __init__(self, buf, base_offset: int, collect_bad: bool = False):
         off: List[int] = []
         refid: List[int] = []
         pos: List[int] = []
         l_rn: List[int] = []
         n_cig: List[int] = []
         l_seq: List[int] = []
+        bad: List[bool] = []
+        self.bad_exc: Dict[int, BamParseError] = {}
         p = 0
         size = len(buf)
+        self.base = base_offset
         unpack = _REC_FIXED.unpack_from
         while p + 4 <= size:
             if p + 24 > size:
@@ -161,19 +173,25 @@ class _RecordIndex:
             # fields must fit the record (the C lane's identical check,
             # decoder.cpp): without it a corrupt l_seq/n_cigar makes the
             # decode lanes read the NEXT record's bytes as SEQ
-            if lsq < 0 or 32 + lrn + 4 * nc + (lsq + 1) // 2 + lsq \
-                    > block_size:
-                raise BamParseError(
+            is_bad = (lsq < 0 or 32 + lrn + 4 * nc + (lsq + 1) // 2 + lsq
+                      > block_size)
+            if is_bad:
+                exc = BamParseError(
                     f"BAM record at offset {base_offset + p}: fields "
                     f"overrun the record (block_size {block_size}, "
                     f"l_read_name {lrn}, n_cigar {nc}, l_seq {lsq})",
                     base_offset + p)
+                exc.rec_len = 4 + int(block_size)
+                if not collect_bad:
+                    raise exc
+                self.bad_exc[len(off)] = exc
             off.append(p)
             refid.append(rid)
             pos.append(ps)
             l_rn.append(lrn)
             n_cig.append(nc)
             l_seq.append(lsq)
+            bad.append(is_bad)
             p += 4 + block_size
         self.consumed = p
         self.n = len(off)
@@ -183,6 +201,7 @@ class _RecordIndex:
         self.l_rn = np.asarray(l_rn, dtype=np.int64)
         self.n_cig = np.asarray(n_cig, dtype=np.int64)
         self.l_seq = np.asarray(l_seq, dtype=np.int64)
+        self.bad = np.asarray(bad, dtype=bool)
 
 
 def _gather(buf: np.ndarray, offs: np.ndarray, width: int) -> np.ndarray:
@@ -236,7 +255,10 @@ class BamRecordReader:
 
     def chunks(self) -> Iterator[Tuple[np.ndarray, "_RecordIndex"]]:
         """Yield (buffer, record-index) pairs spanning the whole stream;
-        records never straddle a yielded buffer."""
+        records never straddle a yielded buffer.  With ``on_bad`` set,
+        record-bounded structural damage becomes flagged INDEX ENTRIES
+        (``idx.bad``) — still counted, still skippable by position —
+        instead of a raise."""
         pending = b""
         base = 0
         while True:
@@ -248,7 +270,8 @@ class BamRecordReader:
                         f"({len(pending)} dangling bytes)", base)
                 return
             buf = pending + data if pending else data
-            idx = _RecordIndex(buf, base)
+            idx = _RecordIndex(buf, base,
+                               collect_bad=self.on_bad is not None)
             if idx.consumed == 0 and len(buf) > self.CHUNK * 4:
                 raise BamParseError(
                     f"BAM record at offset {base} larger than "
@@ -269,14 +292,37 @@ class BamRecordReader:
             for k in range(idx.n):
                 if self._count_cb is not None:
                     self._count_cb(1)
+                if idx.bad[k]:
+                    # flagged at index time: fields overrun the record's
+                    # block_size, so decoding would read the NEXT
+                    # record's bytes — absorb the INDEX exception, never
+                    # walk the entry (idx.bad is always all-False in
+                    # strict mode: the index raised instead)
+                    self.on_bad(int(idx.base + idx.off[k]),
+                                idx.bad_exc[k])
+                    continue
                 if idx.n_cig[k] == 0:
                     continue                      # CIGAR "*" analogue
-                yield record_at(buf, idx, k, int(cig_off[k]),
-                                int(seq_off[k]), self.refname_fn)
+                try:
+                    rec = record_at(buf, idx, k, int(cig_off[k]),
+                                    int(seq_off[k]), self.refname_fn)
+                except BamParseError as exc:
+                    # bad CIGAR op / refID outside the table: bounded
+                    # to this indexed record, so tolerant mode skips
+                    # exactly it
+                    if self.on_bad is not None:
+                        self.on_bad(int(idx.base + idx.off[k]), exc)
+                        continue
+                    raise
+                yield rec
             del buf
 
     #: patched by the owning stream: refid -> display name ("*" for -1)
     refname_fn = staticmethod(lambda refid: "*")
+
+    #: tolerant hook: ``on_bad(abs_offset, exc)`` absorbs record-bounded
+    #: damage (None = strict raise, the default)
+    on_bad = None
 
 
 def record_at(buf: np.ndarray, idx: "_RecordIndex", k: int,
@@ -353,17 +399,25 @@ class BamReadStream:
         rd.refname_fn = self.refname
         return rd
 
-    def records(self) -> Iterator[BamRecord]:
-        """Mapped records in file order (oracle / python-encoder lane)."""
+    def records(self, on_bad=None) -> Iterator[BamRecord]:
+        """Mapped records in file order (oracle / python-encoder lane).
+
+        ``on_bad(raw, exc)``: tolerant hook matching the text
+        ``ReadStream.records`` signature — record-bounded structural
+        damage reports a rendered placeholder instead of raising."""
         skip = self._skip_records
         self._skip_records = 0
-        for rec in self._reader():
+        rd = self._reader()
+        if on_bad is not None:
+            rd.on_bad = lambda abs_off, exc: on_bad(
+                f"<bam record at offset {abs_off}>", exc)
+        for rec in rd:
             if skip > 0:
                 skip -= 1
                 continue
             yield rec
 
-    def make_encoder(self, layout, cfg, acc=None):
+    def make_encoder(self, layout, cfg, acc=None, bad_sink=None):
         """The jax backend's decode hook.
 
         Preferred path: the C++ binary record decoder
@@ -387,7 +441,8 @@ class BamReadStream:
                 layout, self, maxdel=cfg.maxdel, strict=cfg.strict,
                 segment_width=resolve_segment_width(
                     getattr(cfg, "segment_width", 0)),
-                accumulate_into=acc.counts_host() if fuse else None)
+                accumulate_into=acc.counts_host() if fuse else None,
+                bad_sink=bad_sink)
             return enc, enc.encode_batches()
         if decoder == "native":
             raise RuntimeError(
@@ -396,7 +451,8 @@ class BamReadStream:
         enc = BamSegmentEncoder(
             layout, self, maxdel=cfg.maxdel, strict=cfg.strict,
             chunk_reads=getattr(cfg, "chunk_reads", 262144),
-            segment_width=getattr(cfg, "segment_width", 0))
+            segment_width=getattr(cfg, "segment_width", 0),
+            bad_sink=bad_sink)
         return enc, enc.encode_batches()
 
 
@@ -415,13 +471,18 @@ class BamSegmentEncoder:
 
     def __init__(self, layout, stream: BamReadStream,
                  maxdel: Optional[int] = 150, strict: bool = True,
-                 chunk_reads: int = 262144, segment_width: int = 0):
+                 chunk_reads: int = 262144, segment_width: int = 0,
+                 bad_sink=None):
         from ..encoder.events import ReadEncoder, resolve_segment_width
 
         self.layout = layout
         self.stream = stream
         self.strict = strict
         self.chunk_reads = max(1, chunk_reads)
+        #: tolerant decode: absorbed in _encode_slow (the replay lane
+        #: every malformed record routes through; the fast lane's
+        #: filters re-route to slow before anything could raise)
+        self.bad_sink = bad_sink
         # config policy -> concrete width (0 = segmentation off)
         seg_w = resolve_segment_width(segment_width)
         self._py = ReadEncoder(layout, maxdel=maxdel, strict=strict,
@@ -463,6 +524,8 @@ class BamSegmentEncoder:
         rows: List[Tuple[int, np.ndarray]] = []
         batch_reads = 0
         reader = self.stream._reader()
+        if self.bad_sink is not None:
+            reader.on_bad = self._absorb_record
         for buf, idx in reader.chunks():
             self.stream.add_lines(idx.n)
             lo = 0
@@ -472,6 +535,19 @@ class BamSegmentEncoder:
             sel = np.arange(lo, idx.n, dtype=np.int64)
             if len(sel) == 0:
                 continue
+            bad = idx.bad[sel]
+            if bad.any():
+                # index-flagged structural damage (fields overrun the
+                # record): absorb the INDEX exception and drop the entry
+                # before the lane split — walking it would read the next
+                # record's bytes as CIGAR/SEQ (strict mode never gets
+                # here: the index raised at build time)
+                for k in sel[bad]:
+                    self._absorb_record(int(idx.base + idx.off[k]),
+                                        idx.bad_exc[int(k)])
+                sel = sel[~bad]
+                if len(sel) == 0:
+                    continue
             n_cig = idx.n_cig[sel]
             mapped = sel[n_cig > 0]          # CIGAR "*" analogue dropped
             if len(mapped) == 0:
@@ -489,9 +565,17 @@ class BamSegmentEncoder:
                     slow = np.sort(np.concatenate([slow, extra_slow]))
             for k in slow:
                 ks = int(np.searchsorted(mapped, k))
-                rec = record_at(buf, idx, int(k), int(cig_off[ks]),
-                                int(seq_off[ks]), self.stream.refname)
-                if self._encode_slow(rec, rows):
+                abs_off = int(idx.base + idx.off[k])
+                try:
+                    rec = record_at(buf, idx, int(k), int(cig_off[ks]),
+                                    int(seq_off[ks]), self.stream.refname)
+                except BamParseError as exc:
+                    # bad CIGAR op / refID outside the table — bounded
+                    # to this already-indexed (and already-counted)
+                    # record
+                    self._absorb_record(abs_off, exc)
+                    continue
+                if self._encode_slow(rec, rows, offset=abs_off):
                     batch_reads += 1
             if batch_reads >= self.chunk_reads:
                 yield self._flush(mats, rows, batch_reads)
@@ -561,14 +645,40 @@ class BamSegmentEncoder:
         return n_rows, n_cells, np.asarray(sorted(extra_slow),
                                            dtype=np.int64)
 
+    def _absorb_record(self, abs_off: int, exc: BaseException) -> None:
+        """One record-bounded BAM failure (structural overrun, bad
+        CIGAR op, refID outside the table): quarantine / skip /
+        strict-raise — the python twin of the native lane's
+        ``_fallback_record`` tolerance protocol."""
+        from ..ingest.badrecords import mark_offset
+
+        if self.bad_sink is not None:
+            self.bad_sink.record(f"<bam record at offset {abs_off}>",
+                                 exc, offset=abs_off)
+            self._py.n_skipped += 1
+            return
+        # no sink: structural parse damage raises in BOTH modes —
+        # legacy permissive mode tolerates encode-level contract errors
+        # only, matching the native lane's _fallback_record
+        mark_offset(exc, abs_off)
+        raise exc
+
     def _encode_slow(self, rec: BamRecord,
-                     rows: List[Tuple[int, np.ndarray]]) -> bool:
-        from ..encoder.events import EncodeError
+                     rows: List[Tuple[int, np.ndarray]],
+                     offset: Optional[int] = None) -> bool:
+        from ..encoder.events import EncodeError, render_record
+        from ..ingest.badrecords import mark_offset
 
         try:
             new_rows = self._py.encode_record(rec)
-        except (EncodeError, KeyError, IndexError):
+        except (EncodeError, KeyError, IndexError) as exc:
+            if self.bad_sink is not None:
+                self.bad_sink.record(render_record(rec), exc,
+                                     offset=offset)
+                self._py.n_skipped += 1
+                return False
             if self.strict:
+                mark_offset(exc, offset)
                 raise
             self._py.n_skipped += 1
             return False
@@ -645,12 +755,14 @@ class NativeBamEncoder(NativeReadEncoder):
 
     def __init__(self, layout, stream: BamReadStream,
                  maxdel: Optional[int] = 150, strict: bool = True,
-                 segment_width: int = 0, accumulate_into=None):
+                 segment_width: int = 0, accumulate_into=None,
+                 bad_sink=None):
         super().__init__(layout, maxdel=maxdel, strict=strict,
                          on_lines=stream.add_lines,
                          on_bytes=stream.add_bytes,
                          accumulate_into=accumulate_into,
-                         segment_width=segment_width)
+                         segment_width=segment_width,
+                         bad_sink=bad_sink)
         self.stream = stream
         ci = []
         off = []
@@ -719,7 +831,7 @@ class NativeBamEncoder(NativeReadEncoder):
                     self._ref_ci, self._ref_off, self._ref_lenv,
                     len(self._ref_ci),
                     -1 if self.maxdel is None else self.maxdel,
-                    1 if self.strict else 0,
+                    self._c_strict,
                     self._slab_w,
                     self._starts[fill:], self._codes[fill:],
                     len(self._starts) - fill,
@@ -750,7 +862,9 @@ class NativeBamEncoder(NativeReadEncoder):
                 for k in range(int(n_overflow)):
                     # negative-POS wrap lane: python replay (segmented
                     # there too; wide positive reads are segmented in C)
-                    self._fallback_record(data, int(ovf[k]) + offset)
+                    self._fallback_record(
+                        data, int(ovf[k]) + offset,
+                        flagged_at=stream_off + int(ovf[k]) + offset)
                 if int(out[13]) + n_overflow > max(64, n_reads // 64):
                     # many segmented/wrapped reads: widen future slabs
                     # toward the cap so each read needs fewer rows
@@ -769,7 +883,8 @@ class NativeBamEncoder(NativeReadEncoder):
                 self._count_bytes(int(consumed))
                 if status == 2:
                     rec_len = self._fallback_record(
-                        data, offset, flagged_at=stream_off + offset)
+                        data, offset, flagged_at=stream_off + offset,
+                        c_reason=int(out[14]))
                     self._count_lines(1)
                     self._count_bytes(rec_len)
                     offset += rec_len
@@ -789,7 +904,9 @@ class NativeBamEncoder(NativeReadEncoder):
                             # one record wider than the whole slab —
                             # replay it through the python twin (its
                             # row list is unbounded)
-                            rec_len = self._fallback_record(data, offset)
+                            rec_len = self._fallback_record(
+                                data, offset,
+                                flagged_at=stream_off + offset)
                             self._count_lines(1)
                             self._count_bytes(rec_len)
                             offset += rec_len
@@ -843,31 +960,67 @@ class NativeBamEncoder(NativeReadEncoder):
             raise BamParseError(
                 f"BAM record at offset {where} claims block_size "
                 f"{block_size} past the stream", where)
+        # from here the record's extent IS known (4 + block_size): any
+        # damage below is bounded to this one record, so tolerant mode
+        # can skip exactly it — mark the errors with rec_len so
+        # _fallback_record knows how far to advance
+        rec_len = 4 + int(block_size)
         cig_off = off + 36 + l_rn
         seq_off = cig_off + 4 * n_cig
-        if 32 + l_rn + 4 * n_cig + (l_seq + 1) // 2 + l_seq > block_size:
-            raise BamParseError(
-                f"BAM record at offset {where}: fields overrun the "
-                f"record (block_size {block_size})", where)
-        rec = BamRecord(
-            refname=self.stream.refname(int(refid)),
-            pos=int(pos),
-            ops=decode_ops(data, cig_off, int(n_cig)),
-            seq=decode_seq(data, seq_off, int(l_seq)))
-        return rec, 4 + int(block_size)
+        try:
+            if l_seq < 0 or 32 + l_rn + 4 * n_cig + (l_seq + 1) // 2 \
+                    + l_seq > block_size:
+                raise BamParseError(
+                    f"BAM record at offset {where}: fields overrun the "
+                    f"record (block_size {block_size}, l_read_name "
+                    f"{l_rn}, n_cigar {n_cig}, l_seq {l_seq})", where)
+            rec = BamRecord(
+                refname=self.stream.refname(int(refid)),
+                pos=int(pos),
+                ops=decode_ops(data, cig_off, int(n_cig)),
+                seq=decode_seq(data, seq_off, int(l_seq)))
+        except BamParseError as exc:
+            exc.rec_len = rec_len
+            raise
+        return rec, rec_len
 
     def _fallback_record(self, data: np.ndarray, off: int,
-                         flagged_at: Optional[int] = None) -> int:
+                         flagged_at: Optional[int] = None,
+                         c_reason: int = 0) -> int:
         """Replay one record through the golden python encoder (error
         parity / wrap split / segmentation); returns the record's total
-        byte length."""
-        from ..encoder.events import EncodeError
+        byte length.
 
-        rec, rec_len = self._record_at_offset(data, off, flagged_at)
+        The BAM rung's tolerance point: with a sink attached
+        (``--on-bad-record skip|quarantine``), any record-bounded
+        failure — a replay-raised oracle error, or structural damage
+        whose extent is still known (``BamParseError.rec_len``) — is
+        absorbed per record; framing loss (truncation, a block_size
+        past the stream) stays job-level in every mode."""
+        from ..encoder.events import EncodeError, render_record
+        from ..ingest.badrecords import mark_offset
+
+        sink = self.bad_sink
+        where = off if flagged_at is None else flagged_at
+        try:
+            rec, rec_len = self._record_at_offset(data, off, flagged_at)
+        except BamParseError as exc:
+            bounded_len = getattr(exc, "rec_len", None)
+            if sink is not None and bounded_len is not None:
+                self._quarantine(
+                    sink, f"<bam record at offset {where}>", exc,
+                    where, c_reason)
+                return bounded_len
+            raise
         try:
             rows = self._py.encode_record(rec)
-        except (EncodeError, KeyError, IndexError):
+        except (EncodeError, KeyError, IndexError) as exc:
+            if sink is not None:
+                self._quarantine(sink, render_record(rec), exc,
+                                 where, c_reason)
+                return rec_len
             if self.strict:
+                mark_offset(exc, where)
                 raise
             self._py.n_skipped += 1
             return rec_len

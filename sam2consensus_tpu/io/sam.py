@@ -111,28 +111,50 @@ def read_header(handle) -> Tuple[List[Contig], int, str]:
     return contigs, n_header, ""
 
 
-def iter_records(handle: TextIO, first_line: str = "") -> Iterator[SamRecord]:
+def iter_records(handle: TextIO, first_line: str = "",
+                 on_bad=None) -> Iterator[SamRecord]:
     """Yield mapped records (CIGAR != "*"), skipping any stray header lines.
 
     Mirrors the reference's body loop (sam2consensus.py:191-206); chunked
     reading is an I/O detail there (``readlines(50000)``), not a semantic one,
     so plain line iteration is used here.
+
+    ``on_bad`` is the tolerant-decode hook (``--on-bad-record``): a line
+    whose positional parse raises (too few fields, unparsable POS) calls
+    ``on_bad(line, exc)`` and iteration continues instead of dying —
+    the per-record quarantine contract.  ``None`` (default) keeps the
+    strict reference semantics: the parse error propagates.
     """
-    def make(line: str) -> SamRecord:
-        fields = line.rstrip("\n").split("\t")
-        return SamRecord(
-            refname=fields[2].split()[0],
-            pos=int(fields[3]) - 1,
-            cigar=fields[5],
-            seq=fields[9],
-        )
+    def make(line: str) -> Optional[SamRecord]:
+        try:
+            # the un-rstripped CIGAR probe first, exactly like the
+            # reference's body loop: a 6-field line ending "...\t*\n"
+            # compares "*\n" != "*" and proceeds to the fields[9]
+            # IndexError, it is NOT an unmapped skip
+            if line.split("\t")[5] == "*":
+                return None
+            fields = line.rstrip("\n").split("\t")
+            return SamRecord(
+                refname=fields[2].split()[0],
+                pos=int(fields[3]) - 1,
+                cigar=fields[5],
+                seq=fields[9],
+            )
+        except (IndexError, ValueError) as exc:
+            if on_bad is None:
+                raise
+            on_bad(line, exc)
+            return None
 
     if first_line and first_line[0] != "@":
-        if first_line.split("\t")[5] != "*":
-            yield make(first_line)
+        rec = make(first_line)
+        if rec is not None:
+            yield rec
     for line in handle:
-        if line[0] != "@" and line.split("\t")[5] != "*":
-            yield make(line)
+        if line[0] != "@":
+            rec = make(line)
+            if rec is not None:
+                yield rec
 
 
 def read_sam(filename: str) -> Tuple[List[Contig], Iterator[SamRecord]]:
@@ -171,6 +193,10 @@ class ReadStream:
             self._body_start = self.handle.tell() - len(first_line)
         except (AttributeError, OSError, ValueError):
             self._body_start = None
+        #: absolute input offset of the most recent ``blocks()`` block
+        #: (None when the handle cannot locate itself) — the strict-
+        #: error / quarantine offset base
+        self.block_offset: Optional[int] = None
 
     def byte_offset(self) -> int:
         """Absolute input offset matching ``n_lines``; -1 if unknown."""
@@ -259,8 +285,10 @@ class ReadStream:
         return ingest.ShardPlan(data=mm, ranges=ranges, start=start,
                                 end=len(mm))
 
-    def records(self) -> Iterator[SamRecord]:
-        """Parsed mapped records, counting every body line."""
+    def records(self, on_bad=None) -> Iterator[SamRecord]:
+        """Parsed mapped records, counting every body line.  ``on_bad``
+        is :func:`iter_records`' tolerant-decode hook (the pure-python
+        rung's seam for ``--on-bad-record``)."""
         def counted() -> Iterator[str]:
             for line in self.handle:
                 self.add_lines(1)
@@ -274,7 +302,7 @@ class ReadStream:
         if first:
             self.add_lines(1)
             self.add_bytes(len(first))
-        yield from iter_records(counted(), first)
+        yield from iter_records(counted(), first, on_bad=on_bad)
 
     def blocks(self, max_bytes: int = 1 << 23):
         """Raw blocks of whole lines, str or bytes per the handle's mode
@@ -287,12 +315,21 @@ class ReadStream:
         (~tens of ms on the 241 MB north-star input).  Consumers already
         accept anything ``np.frombuffer`` does.  Gzip and text handles
         keep the buffered-read path.
+
+        ``block_offset`` is set before each yield to the absolute input
+        offset of the block's first byte (uncompressed offsets on gzip/
+        BGZF handles — the SAME number a plain copy of the file would
+        give, which is what makes strict-error offsets comparable
+        across containers), or ``None`` when the handle cannot locate
+        itself.  Consumers that attach offsets to strict decode errors
+        (``ingest/badrecords.mark_offset``) read it per block.
         """
         pending = self.first
         self.first = ""
         mm = self._mmap_body()
         if mm is not None:
             if pending:
+                self.block_offset = self._body_start
                 yield pending.encode("ascii") \
                     if isinstance(pending, str) else pending
             pos = self.handle.tell()
@@ -309,15 +346,19 @@ class ReadStream:
                         end = size if nl < 0 else nl + 1
                     else:
                         end = nl + 1
+                self.block_offset = pos
                 yield mv[pos:end]
                 pos = end
             # leave the handle where the content ended, as read() would
             self.handle.seek(size)
             return
+        off = None if self._body_start is None \
+            else self._body_start + self.n_bytes
         while True:
             chunk = self.handle.read(max_bytes)
             if not chunk:
                 if pending:
+                    self.block_offset = off
                     yield pending
                 return
             if not isinstance(pending, type(chunk)):  # str first body line
@@ -327,6 +368,9 @@ class ReadStream:
             if not chunk.endswith(newline):
                 chunk += self.handle.readline()
             block, pending = pending + chunk, chunk[:0]
+            self.block_offset = off
+            if off is not None:
+                off += len(block)
             yield block
 
     def _is_plain_file(self) -> bool:

@@ -96,7 +96,11 @@ struct SimdTables {
   SimdTables() {
     alignas(64) unsigned char c[64], a[64], e[64], r[64];
     memset(c, 255, 64);
-    memset(a, 0, 64);
+    // unused reconstruction slots hold j+1, never == any byte whose low
+    // 6 bits select slot j (j+1 != j mod 64) — a zero fill would let
+    // '\0' reconstruct itself through slot 0 and pass validation
+    for (int j = 0; j < 64; ++j)
+      a[j] = static_cast<unsigned char>(j + 1);
     const char* bases = "-ACGNT";
     for (int i = 0; i < 6; ++i) {
       const unsigned char ch = static_cast<unsigned char>(bases[i]);
@@ -319,6 +323,27 @@ enum OutIdx : int {
   oSegmented = 13,  // BAM path: reads emitted as multiple width-bounded
                     // segment rows (the long-read segmented layout,
                     // handled in C instead of the python replay lane)
+  oErrReason = 14,  // BadReason code for the kErrorLine record (0 when
+                    // status != kErrorLine).  A HINT for the tolerant-
+                    // decode observability counters (ingest/flagged/*):
+                    // classification authority stays with the python
+                    // replay, whose exception types/messages are the
+                    // oracle-parity contract shared with the pure-
+                    // python rung.
+};
+
+// why a line/record was flagged (out[oErrReason]; mirrored by
+// ingest/badrecords.py C_REASONS — keep the two tables in lockstep)
+enum BadReason : long {
+  rNone = 0,
+  rFieldCount = 1,        // too few tab fields / empty RNAME token
+  rBadPos = 2,            // POS is not an integer
+  rBadCigar = 3,          // invalid binary CIGAR op (BAM)
+  rSeqCigarMismatch = 4,  // SEQ shorter than the CIGAR claims
+  rUnknownRef = 5,        // RNAME/refID outside the reference table
+  rOutOfBounds = 6,       // span leaves the reference
+  rBadAlphabet = 7,       // out-of-contract base / seq nibble
+  rBadBamRecord = 8,      // record-bounded BAM structural damage
 };
 
 }  // namespace
@@ -361,6 +386,7 @@ extern "C" long s2c_decode(
   long n_events = 0, n_lines = 0, n_overflow = 0, max_span = 0;
   long status = kOk;
   long err_off = -1;
+  long err_reason = rNone;
   int64_t n_banked = 0;
 
   std::vector<unsigned char> row;           // reused per line (slow path)
@@ -380,6 +406,7 @@ extern "C" long s2c_decode(
       if (line_end == ls) {  // empty line: python IndexErrors on fields[5]
         status = kErrorLine;
         err_off = ls;
+        err_reason = rFieldCount;
         break;
       }
       i = next;
@@ -427,6 +454,7 @@ extern "C" long s2c_decode(
     if (nf < 6) {  // python: line.split("\t")[5] -> IndexError
       status = kErrorLine;
       err_off = ls;
+      err_reason = rFieldCount;
       break;
     }
     // CIGAR "*" -> unmapped, skipped before any further field access
@@ -437,6 +465,7 @@ extern "C" long s2c_decode(
     if (nf < 10) {  // python: fields[9] -> IndexError
       status = kErrorLine;
       err_off = ls;
+      err_reason = rFieldCount;
       break;
     }
 
@@ -448,6 +477,7 @@ extern "C" long s2c_decode(
     if (rtok == rs) {  // empty token: python fields[2].split()[0] IndexErrors
       status = kErrorLine;
       err_off = ls;
+      err_reason = rFieldCount;
       break;
     }
 
@@ -463,6 +493,7 @@ extern "C" long s2c_decode(
     if (ps == pe) {
       status = kErrorLine;
       err_off = ls;
+      err_reason = rBadPos;
       break;
     }
     int64_t posv = 0;
@@ -478,6 +509,7 @@ extern "C" long s2c_decode(
     if (badint) {
       status = kErrorLine;
       err_off = ls;
+      err_reason = rBadPos;
       break;
     }
     if (negpos) posv = -posv;
@@ -576,6 +608,7 @@ extern "C" long s2c_decode(
         !(seq_len == 1 && text[ss] == '*' && first_rc_op == 'M')) {
       status = kErrorLine;
       err_off = ls;
+      err_reason = rSeqCigarMismatch;
       break;
     }
 
@@ -586,6 +619,7 @@ extern "C" long s2c_decode(
       if (strict) {
         status = kErrorLine;
         err_off = ls;
+        err_reason = (ci < 0) ? rUnknownRef : rOutOfBounds;
         break;
       }
       ++n_skipped;
@@ -683,6 +717,7 @@ extern "C" long s2c_decode(
         if (strict) {
           status = kErrorLine;
           err_off = ls;
+          err_reason = rBadAlphabet;
           break;
         }
         ++n_skipped;
@@ -788,6 +823,7 @@ extern "C" long s2c_decode(
       if (strict) {
         status = kErrorLine;
         err_off = ls;
+        err_reason = rBadAlphabet;
         break;
       }
       ++n_skipped;
@@ -899,6 +935,7 @@ extern "C" long s2c_decode(
   out[oOverflow] = n_overflow;
   out[oMaxSpan] = max_span;
   out[oBanked] = n_banked;
+  out[oErrReason] = err_reason;
   return status;
 }
 
@@ -967,6 +1004,7 @@ extern "C" long s2c_decode_bam(
   long n_segmented = 0;
   long status = kOk;
   long err_off = -1;
+  long err_reason = rNone;
   int64_t n_banked = 0;
   std::vector<unsigned char> scratch;  // wide-read translate buffer
 
@@ -976,6 +1014,7 @@ extern "C" long s2c_decode_bam(
     if (block_size < 32 || block_size > (int64_t(1) << 31)) {
       status = kErrorLine;  // corrupt framing: python replay reports it
       err_off = i;
+      err_reason = rBadBamRecord;
       ++n_lines;            // rolled back below like the text path
       break;
     }
@@ -995,6 +1034,7 @@ extern "C" long s2c_decode_bam(
         32 + l_rn + 4 * n_cig + (l_seq + 1) / 2 + l_seq > block_size) {
       status = kErrorLine;  // fields overrun the record: replay reports
       err_off = i;
+      err_reason = rBadBamRecord;
       break;
     }
     if (n_cig == 0) {  // the binary form of CIGAR "*": skip, still counts
@@ -1007,6 +1047,7 @@ extern "C" long s2c_decode_bam(
     if (refid < -1 || refid >= n_refs) {
       status = kErrorLine;  // corrupt table index: replay reports
       err_off = i;
+      err_reason = rUnknownRef;
       break;
     }
     const int64_t reflen = known_ref ? ref_len[refid] : 0;
@@ -1054,6 +1095,7 @@ extern "C" long s2c_decode_bam(
       // reference's concatenation-shift semantics): replay in python
       status = kErrorLine;
       err_off = i;
+      err_reason = bad_op ? rBadCigar : rSeqCigarMismatch;
       break;
     }
     if (span > max_span) max_span = span;
@@ -1063,6 +1105,7 @@ extern "C" long s2c_decode_bam(
       if (strict) {
         status = kErrorLine;  // replay raises the oracle's exact error
         err_off = i;
+        err_reason = !known_ref ? rUnknownRef : rOutOfBounds;
         break;
       }
       ++n_skipped;
@@ -1160,6 +1203,7 @@ extern "C" long s2c_decode_bam(
       if (strict) {
         status = kErrorLine;  // replay raises the oracle's KeyError
         err_off = i;
+        err_reason = rBadAlphabet;
         break;
       }
       ++n_skipped;
@@ -1227,6 +1271,7 @@ extern "C" long s2c_decode_bam(
   out[oMaxSpan] = max_span;
   out[oBanked] = n_banked;
   out[oSegmented] = n_segmented;
+  out[oErrReason] = err_reason;
   return status;
 }
 

@@ -273,8 +273,12 @@ def publish_stats_extra(extra: dict) -> None:
         # shard retries/demotions — encoder/parallel_decode.py) rides
         # along so the multi-core ingest story is checkable from any
         # artifact: worker_sec / decode_sec is the realized parallelism
+        # quarantine/* (tolerant decode: stored sidecar entries,
+        # truncation — ingest/badrecords.py) rides along so a job that
+        # skipped records says so from any artifact
         elif name.startswith(("wire/", "pipeline/", "drift/", "serve/",
-                              "compile/", "format/", "ingest/")):
+                              "compile/", "format/", "ingest/",
+                              "quarantine/")):
             extra[name] = int(value) if float(value).is_integer() \
                 else round(value, 4)
     for gauge_name, extra_key in (("dispatch/tail", "tail_dispatch"),
@@ -284,7 +288,8 @@ def publish_stats_extra(extra: dict) -> None:
                                   ("format/input", "input_format"),
                                   ("ingest/mode", "ingest_mode"),
                                   ("serve/recovery", "serve_recovery"),
-                                  ("serve/watchdog", "serve_watchdog")):
+                                  ("serve/watchdog", "serve_watchdog"),
+                                  ("quarantine/summary", "quarantine")):
         g = snap["gauges"].get(gauge_name)
         if g is not None and g.get("info"):
             extra[extra_key] = g["info"]

@@ -112,6 +112,14 @@ def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
     for name, g in snap["gauges"].items():
         if name.startswith("serve/") and g.get("info"):
             serve[name] = g["info"]
+    # tolerant-decode evidence: bad-record counts per taxonomy reason
+    # plus the quarantine summary (mode, sidecar path, truncation) —
+    # empty dict on clean strict runs
+    ingest = {k: int(v) for k, v in counters.items()
+              if k.startswith(("ingest/bad_records", "quarantine/"))}
+    qg = snap["gauges"].get("quarantine/summary")
+    if qg is not None and qg.get("info"):
+        ingest["quarantine/summary"] = qg["info"]
     decisions = []
     for rec in ledger_records:
         d = rec.to_dict() if hasattr(rec, "to_dict") else dict(rec)
@@ -128,6 +136,7 @@ def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
         "phases": phases,
         "wire": wire,
         "serve": serve,
+        "ingest": ingest,
         "drift_events": int(counters.get("drift/events", 0)),
         "artifacts": dict(artifacts or {}),
     }

@@ -56,7 +56,7 @@ import numpy as np
 
 from .. import observability as obs
 from . import faultinject
-from .policy import PASSTHROUGH, RetryPolicy, classify
+from .policy import DATA, PASSTHROUGH, RetryPolicy, classify
 
 #: smallest bucket-row count a capacity split will produce; below this
 #: an OOM is not a batch-size problem and demotion is the answer
@@ -311,8 +311,11 @@ class ResilientDispatcher:
                 return
             except BaseException as exc:
                 kind = classify(exc)
-                if kind == PASSTHROUGH \
+                if kind in (PASSTHROUGH, DATA) \
                         or self.policy.on_error != "fallback":
+                    # DATA: malformed input fails identically on every
+                    # rung — demoting would re-decode the same poison
+                    # bytes on a slower path and still fail
                     raise
                 frm = pileup_level(self._acc)
                 new_acc, level = demote_pileup(self._acc, self.total_len)

@@ -18,6 +18,15 @@ layers:
   process-control exceptions: never retried, never demoted — they are
   bugs or contract errors, and masking them with a host fallback would
   hide them while still costing a full recompute.
+* ``DATA`` — the input bytes are malformed (a bad-record error budget
+  blown, a poison upload): like PASSTHROUGH it is never retried and
+  never demotes a rung — re-reading the same bytes on any rung fails
+  identically — but it is its own class so the serve layer can tell "a
+  tenant sent us garbage" (fail fast with the quarantine manifest, no
+  tenant demotion, count ``serve/admission_poison``) apart from "this
+  code path is broken".  Marked by a ``data_error`` attribute on the
+  exception (``ingest/badrecords.py``), same marker protocol as
+  ``transient``.
 
 The classifier is name/message-based for the jax runtime's exception
 types (``XlaRuntimeError`` carries its gRPC-style status in the
@@ -41,6 +50,7 @@ TRANSIENT = "transient"
 CAPACITY = "capacity"
 FATAL = "fatal"
 PASSTHROUGH = "passthrough"
+DATA = "data"
 
 #: status substrings the jax/gRPC runtime uses for retryable transport
 #: failures; checked case-sensitively first (they are SHOUTY status
@@ -89,7 +99,12 @@ class HungDispatchError(TimeoutError):
 
 
 def classify(exc: BaseException) -> str:
-    """Map an exception to TRANSIENT / CAPACITY / FATAL / PASSTHROUGH."""
+    """Map an exception to TRANSIENT/CAPACITY/FATAL/PASSTHROUGH/DATA."""
+    if getattr(exc, "data_error", False):
+        # checked FIRST: a data-malformation error must never match the
+        # transient/capacity message heuristics below ("exhausted" is in
+        # the budget message AND the capacity regex's vocabulary...)
+        return DATA
     if isinstance(exc, (InjectedRpcError, InjectedTimeoutError)):
         return TRANSIENT
     if isinstance(exc, InjectedOomError):
@@ -221,7 +236,7 @@ class RetryPolicy:
                 return self._call(fn)
             except BaseException as exc:
                 kind = classify(exc)
-                if kind in (PASSTHROUGH, FATAL):
+                if kind in (PASSTHROUGH, FATAL, DATA):
                     raise
                 if self.on_error == "fail":
                     raise             # fail mode: no splits, no retries

@@ -66,6 +66,10 @@ class AdmissionController:
     _window_by_tenant: Dict[str, int] = field(default_factory=dict)
     #: tenant -> rung its last degraded job landed on ("host"/"device_scatter")
     tenant_rungs: Dict[str, str] = field(default_factory=dict)
+    #: tenant -> poison submissions (DATA-class failures: blown
+    #: bad-record budgets).  Queue-lifetime, like tenant_rungs — but
+    #: unlike a degradation rung it never pins anybody (see note_poison)
+    poison_by_tenant: Dict[str, int] = field(default_factory=dict)
 
     def open_window(self) -> None:
         self._window_admitted = 0
@@ -90,6 +94,18 @@ class AdmissionController:
         degraded by job k must see job k+1 pinned even when both were
         admitted in the same batch."""
         return self.tenant_rungs.get(tenant) if tenant else None
+
+    def note_poison(self, tenant: str) -> None:
+        """Count one poison submission (a job failed DATA-class: blown
+        bad-record budget / rotten upload) for the tenant.  Counting is
+        ALL this does — a tenant whose data is garbage gets precise
+        failure summaries, not a device-rung demotion: the fast path
+        would fail the same input no slower, and pinning them to the
+        host rung would punish their next (clean) job for their last
+        (dirty) one.  The tally is the evidence base for future
+        poison-rate throttling at admission time."""
+        self.poison_by_tenant[tenant or ""] = \
+            self.poison_by_tenant.get(tenant or "", 0) + 1
 
     def note_result(self, tenant: str, rungs: dict, ok: bool,
                     was_pinned: bool) -> None:

@@ -87,7 +87,15 @@ def snapshot(runner) -> dict:
             "admitted": int(reg.value("serve/admission_admitted")),
             "rejected": int(reg.value("serve/admission_rejected")),
             "pinned": int(reg.value("serve/admission_pinned")),
+            # poison submissions (DATA class: blown bad-record budgets);
+            # counted per tenant WITHOUT device-rung demotion
+            "poison": int(reg.value("serve/admission_poison")),
         },
+        # tolerant decode across the queue + the last job's verdict
+        # (per-job history rides each JobResult / job manifest)
+        "bad_records": int(reg.value("serve/bad_records")),
+        "last_job": getattr(runner, "last_job_badrec", None),
+        "poison_by_tenant": dict(runner.admission.poison_by_tenant),
         "tenant_rungs": dict(runner.admission.tenant_rungs),
         "journal": runner.journal.position()
         if runner.journal is not None else None,

@@ -144,6 +144,15 @@ class JobResult:
     #: admission verdict: None = admitted clean, "pinned:<rung>" =
     #: admitted on the tenant's demoted rung, else the reject reason
     admission: Optional[str] = None
+    #: tolerant decode (--on-bad-record): malformed records this job
+    #: skipped/quarantined (0 under the strict default)
+    bad_records: int = 0
+    #: entries captured to the job's quarantine sidecar
+    quarantined: int = 0
+    #: True = the job failed because its --max-bad-records budget blew
+    #: (DATA class: failed fast, no retry, no rung demotion, tenant
+    #: stays on the device path)
+    budget_exhausted: bool = False
 
     @property
     def ok(self) -> bool:
@@ -332,6 +341,9 @@ class ServeRunner:
         self.admission = AdmissionController(max_queue=max_queue,
                                              tenant_quota=tenant_quota)
         self.health = shealth.HealthState()
+        #: last finished job's tolerant-decode verdict, surfaced in the
+        #: health snapshot (per-job history lives in each JobResult)
+        self.last_job_badrec: Optional[dict] = None
         self.health_out = health_out
         self._fault = self._build_fault_injector(fault_inject)
         self.journal: Optional[sjournal.JobJournal] = None
@@ -902,6 +914,7 @@ class ServeRunner:
                                         dlog, job_id)
                 except Exception as exc:
                     self._note_timeout_if_deadline(robs, exc)
+                    self._note_poison(spec, exc, res)
                     retry_cfg = self._retry_config(cfg, exc)
                     if retry_cfg is not None:
                         out, robs, res.error = self._retry_on_host_rung(
@@ -922,7 +935,16 @@ class ServeRunner:
             res.metrics = {
                 k: v for k, v in snap["counters"].items()
                 if k.startswith(("serve/", "compile/", "resilience/",
-                                 "fault/", "phase/"))}
+                                 "fault/", "phase/", "ingest/",
+                                 "quarantine/"))}
+            res.bad_records = int(
+                snap["counters"].get("ingest/bad_records", 0))
+            res.quarantined = int(
+                snap["counters"].get("quarantine/records", 0))
+            if res.bad_records:
+                # fleet-level aggregation for the health snapshot (the
+                # per-job numbers live in each job's own registry)
+                self.registry.add("serve/bad_records", res.bad_records)
             res.rungs = rladder.job_rungs(snap)
             res.manifest = obs.last_manifest() if res.ok else None
             # -- commit: outputs durably on disk, then the journal -----
@@ -963,6 +985,12 @@ class ServeRunner:
                 was_pinned=bool(entry["admission"]
                                 and str(entry["admission"]).startswith(
                                     "pinned")))
+            self.last_job_badrec = {
+                "job": job_id,
+                "bad_records": res.bad_records,
+                "quarantined": res.quarantined,
+                "budget_exhausted": res.budget_exhausted,
+            }
             self.health.job_finished()
             self.health.queue_depth = max(
                 0, self.health.queue_depth - 1)
@@ -985,6 +1013,24 @@ class ServeRunner:
         self._publish_health()
         return results
 
+    def _note_poison(self, spec: JobSpec, exc: BaseException,
+                     res: JobResult) -> None:
+        """Poison-job accounting (DATA class — the input is rotten, not
+        the fleet): count the submission per tenant
+        (``serve/admission_poison``) WITHOUT touching the tenant's
+        ladder rung — a tenant uploading garbage must not be demoted
+        off the device path, only told precisely what was wrong.  The
+        counter is admission's evidence base for future poison-rate
+        throttling."""
+        from ..ingest.badrecords import is_data_error
+
+        if not is_data_error(exc):
+            return
+        res.budget_exhausted = bool(
+            getattr(exc, "budget_exhausted", False))
+        self.registry.add("serve/admission_poison", 1)
+        self.admission.note_poison(spec.tenant)
+
     # -- job-level ladder --------------------------------------------------
     def _retry_config(self, cfg: RunConfig,
                       exc: BaseException) -> Optional[RunConfig]:
@@ -994,13 +1040,16 @@ class ServeRunner:
         only for device-shaped failures, and only when the job was not
         already on the host rung."""
         from ..resilience import ladder as rladder
-        from ..resilience.policy import PASSTHROUGH, classify
+        from ..resilience.policy import DATA, PASSTHROUGH, classify
 
         kind = classify(exc)
         on_error = os.environ.get("S2C_ON_DEVICE_ERROR",
                                   getattr(cfg, "on_device_error",
                                           "retry"))
-        if on_error != "fallback" or kind == PASSTHROUGH:
+        if on_error != "fallback" or kind in (PASSTHROUGH, DATA):
+            # DATA (poison input): the host rung would re-decode the
+            # same bytes and fail identically — fail fast with the
+            # quarantine summary, keep the tenant on the fast path
             return None
         if cfg.pileup == "host":
             return None                 # already on the bottom rung

@@ -1,0 +1,723 @@
+"""Tolerant decode (``--on-bad-record``): hostile-input hardening.
+
+Five layers of assurance:
+
+* policy units — budget grammar, reason taxonomy, sink partition
+  merge/rollback, sidecar write + truncation, config validation;
+* the tentpole guarantee, rung-invariant tolerant semantics — the
+  committed fixture families with injected malformed records decode to
+  the PINNED ``.expected.fasta`` bytes on every rung (serial native /
+  byte-shard / streaming gzip / BAM native / BAM python / pure-python /
+  cpu oracle), with identical quarantine verdicts and — among the
+  raw-line native rungs — identical sidecar record sequences;
+* error budgets — the N-1/N absolute boundary, the percent boundary,
+  and the blown budget leaving its sidecar evidence behind;
+* DATA-class resilience — a poison-input failure is never retried,
+  never demotes the pileup ladder, and is distinguishable from
+  infrastructure trouble (``resilience/policy.py``);
+* serve isolation — a poison job injected mid-queue fails fast with
+  its quarantine summary while the next job runs warm on the device
+  rung: no retry storm, no tenant demotion, ``serve/admission_poison``
+  counted, health snapshot carrying the verdict.
+"""
+
+import json
+import os
+
+import pytest
+
+from sam2consensus_tpu import native
+from sam2consensus_tpu.backends.cpu import CpuBackend
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.formats import open_alignment_input
+from sam2consensus_tpu.formats.bam import sam_text_to_bam
+from sam2consensus_tpu.ingest.badrecords import (BadRecordBudgetExceeded,
+                                                 BadRecordPolicy,
+                                                 QuarantineSink,
+                                                 classify_reason,
+                                                 is_data_error,
+                                                 parse_budget,
+                                                 policy_from_config)
+from sam2consensus_tpu.io.fasta import render_file
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+FAMILIES = ("formats_short", "formats_longread", "formats_adversarial")
+
+HAVE_NATIVE = native.load() is not None
+
+
+# ---------------------------------------------------------------------------
+# dirty-fixture construction
+# ---------------------------------------------------------------------------
+def _refs(text):
+    out = []
+    for ln in text.splitlines():
+        if ln.startswith("@SQ"):
+            name = length = None
+            for f in ln.split("\t"):
+                if f.startswith("SN:"):
+                    name = f[3:].strip()
+                elif f.startswith("LN:"):
+                    length = int(f[3:])
+            out.append((name, length or 0))
+    return out
+
+
+def _dirt_lines(refs, bam_safe=False):
+    """(line, reason) malformations covering the taxonomy.  With
+    ``bam_safe`` only dirt that survives SAM->BAM conversion (the
+    container parses on write, so text-parse garbage can't ride along —
+    semantically-bad records can)."""
+    name, ln = refs[0]
+    oob = [
+        (f"oobA\t0\t{name}\t{ln * 2 + 7}\t60\t8M\t*\t0\t0\t"
+         "ACGTACGT\t*\n", "out_of_bounds_pos"),
+        (f"oobB\t0\t{name}\t{ln + 1}\t60\t4M\t*\t0\t0\tACGT\t*\n",
+         "out_of_bounds_pos"),
+    ]
+    if bam_safe:
+        return oob
+    return oob + [
+        ("junk\tline\n", "bad_field_count"),
+        (f"badpos\t0\t{name}\txx\t60\t4M\t*\t0\t0\tACGT\t*\n",
+         "bad_pos"),
+        (f"noref\t0\tNOSUCHREF\t5\t60\t4M\t*\t0\t0\tACGT\t*\n",
+         "unknown_reference"),
+        (f"badalpha\t0\t{name}\t1\t60\t4M\t*\t0\t0\tAC!T\t*\n",
+         "bad_alphabet"),
+    ]
+
+
+def make_dirty(text, bam_safe=False):
+    """Inject the taxonomy dirt at deterministic positions spread
+    through the body; returns (dirty_text, [(line, reason), ...] in
+    stream order)."""
+    lines = text.splitlines(keepends=True)
+    body = [i for i, ln in enumerate(lines) if not ln.startswith("@")]
+    dirt = _dirt_lines(_refs(text), bam_safe=bam_safe)
+    # insertion points spread over the body, inserted back-to-front so
+    # earlier indices stay valid
+    spots = [body[(k * len(body)) // len(dirt)] for k in range(len(dirt))]
+    order = sorted(zip(spots, dirt), key=lambda t: t[0])
+    for spot, (ln, _why) in reversed(order):
+        lines.insert(spot, ln)
+    return "".join(lines), [(ln.rstrip("\n"), why)
+                            for _s, (ln, why) in order]
+
+
+def _render_all(fastas, contigs):
+    return "".join(render_file(fastas[c.name], 0)
+                   for c in contigs if c.name in fastas)
+
+
+def run_backend(path, backend=None, fmt="auto", **cfg_kw):
+    be = backend or CpuBackend()
+    ai = open_alignment_input(path, fmt, binary=(be.name == "jax"))
+    cfg = RunConfig(prefix="fixture", **cfg_kw)
+    try:
+        res = be.run(ai.contigs, ai.stream, cfg)
+    finally:
+        ai.close()
+    return _render_all(res.fastas, ai.contigs), res
+
+
+def _jax():
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+
+    return JaxBackend()
+
+
+def _sidecar_entries(path):
+    assert os.path.exists(path), f"sidecar missing: {path}"
+    head, *rows = [json.loads(ln) for ln in open(path)]
+    assert head == {"schema": "s2c-quarantine/1"}
+    summary = rows[-1]["summary"]
+    return [ (e["record"], e["reason"]) for e in rows[:-1] ], summary
+
+
+def _expected(family):
+    with open(os.path.join(DATA, f"{family}.expected.fasta")) as fh:
+        return fh.read()
+
+
+# ---------------------------------------------------------------------------
+# policy units
+# ---------------------------------------------------------------------------
+class TestPolicyUnits:
+    def test_parse_budget_grammar(self):
+        assert parse_budget("") == (None, None)
+        assert parse_budget("  ") == (None, None)
+        assert parse_budget("7") == (7, None)
+        assert parse_budget("0") == (0, None)
+        assert parse_budget("2.5%") == (None, pytest.approx(0.025))
+        assert parse_budget("100%") == (None, pytest.approx(1.0))
+        for bad in ("-1", "101%", "-3%", "x", "5%%"):
+            with pytest.raises(ValueError):
+                parse_budget(bad)
+
+    def test_policy_from_config_validation(self):
+        with pytest.raises(ValueError, match="on_bad_record"):
+            policy_from_config(RunConfig(on_bad_record="explode"))
+        with pytest.raises(ValueError, match="tolerant mode"):
+            policy_from_config(RunConfig(max_bad_records="3"))
+        pol = policy_from_config(RunConfig(on_bad_record="quarantine",
+                                           prefix="p", outfolder="/tmp/o"))
+        assert pol.sidecar_path == "/tmp/o/p_quarantine.jsonl"
+        assert policy_from_config(RunConfig()).tolerant is False
+
+    def test_classify_reason_taxonomy(self):
+        cases = [
+            (IndexError("list index out of range"), "bad_field_count"),
+            (ValueError("invalid literal for int() with base 10: 'xx'"),
+             "bad_pos"),
+            (KeyError("read mapped to unknown reference 'Z'"),
+             "unknown_reference"),
+            (ValueError("record refID 9 outside the reference table"),
+             "unknown_reference"),
+            (IndexError("read at pos 3 spans [3, 99) outside reference"),
+             "out_of_bounds_pos"),
+            (KeyError("read at pos 0 contains an out-of-alphabet base"),
+             "bad_alphabet"),
+            (ValueError("BAM record at offset 8: fields overrun the "
+                        "record"), "bad_bam_record"),
+            (ValueError("CIGAR op code 12 outside MIDNSHP=X"),
+             "bad_cigar"),
+            (RuntimeError("boom"), "malformed"),
+        ]
+        for exc, want in cases:
+            assert classify_reason(exc) == want, exc
+        try:
+            "\xff".encode("ascii")
+        except UnicodeEncodeError:
+            pass
+        assert classify_reason(UnicodeDecodeError(
+            "ascii", b"\xff", 0, 1, "ordinal not in range(128)")) \
+            == "non_ascii"
+
+    def test_sink_partition_merge_and_rollback(self):
+        sink = QuarantineSink(BadRecordPolicy(mode="quarantine"))
+        sink.record("s2-a\tx", IndexError("i"), partition=(2,))
+        sink.record("s0-a\tx", IndexError("i"), partition=(0,))
+        sink.record("s2-b\tx", IndexError("i"), partition=(2,))
+        sink.record("s1-a\tx", IndexError("i"), partition=(1,))
+        # deterministic merge: partitions in sorted (stream) order,
+        # decode order within each
+        assert [e["record"] for e in sink.entries()] == \
+            ["s0-a\tx", "s1-a\tx", "s2-a\tx", "s2-b\tx"]
+        sink.clear_partition((2,))          # shard retry rolls back whole
+        assert sink.count == 2
+        sink.reset()                        # ingest demotion starts over
+        assert sink.count == 0 and sink.entries() == []
+
+    def test_sink_absolute_budget_raises(self):
+        sink = QuarantineSink(BadRecordPolicy(mode="skip", max_bad=2))
+        sink.record("a", IndexError("i"))
+        with pytest.raises(BadRecordBudgetExceeded) as ei:
+            sink.record("b", IndexError("i"))
+        assert is_data_error(ei.value)
+        assert ei.value.budget_exhausted
+        assert ei.value.summary["bad_records"] == 2
+
+    def test_sink_percent_budget_at_finish(self):
+        sink = QuarantineSink(BadRecordPolicy(mode="skip", max_pct=0.10))
+        sink.record("a", IndexError("i"))
+        assert sink.finish(100)["bad_records"] == 1    # 1% <= 10%
+        with pytest.raises(BadRecordBudgetExceeded):
+            sink.finish(5)                             # 20% > 10%
+
+    def test_sidecar_write_and_truncation(self, tmp_path):
+        out = str(tmp_path / "q.jsonl")
+        sink = QuarantineSink(BadRecordPolicy(
+            mode="quarantine", sidecar_path=out, sidecar_max=2))
+        for k in range(5):
+            sink.record(f"bad{k}\tline", IndexError("i"), offset=10 * k)
+        summary = sink.finish(50)
+        entries, side_summary = _sidecar_entries(out)
+        assert entries == [("bad0\tline", "bad_field_count"),
+                           ("bad1\tline", "bad_field_count")]
+        assert summary["truncated"] and side_summary["truncated"]
+        assert summary["bad_records"] == 5
+        assert summary["sidecar"] == os.path.abspath(out)
+
+
+# ---------------------------------------------------------------------------
+# the tentpole: rung-invariant tolerant semantics on the fixture matrix
+# ---------------------------------------------------------------------------
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native decoder unavailable")
+class TestRungInvariance:
+    """Every cell must produce the PINNED clean-oracle bytes — skipping
+    record k is byte-equivalent to deleting record k from the input —
+    with identical quarantine verdicts across rungs."""
+
+    def _dirty_paths(self, family, tmp_path):
+        import gzip as _gzip
+
+        text = open(os.path.join(DATA, f"{family}.sam")).read()
+        dirty, entries = make_dirty(text)
+        sam = str(tmp_path / f"{family}.dirty.sam")
+        with open(sam, "w") as fh:
+            fh.write(dirty)
+        gz = str(tmp_path / f"{family}.dirty.sam.gz")
+        with _gzip.open(gz, "wb") as fh:
+            fh.write(dirty.encode("ascii"))
+        return sam, gz, entries
+
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_text_rung_matrix_quarantine(self, family, tmp_path):
+        sam, gz, entries = self._dirty_paths(family, tmp_path)
+        expected = _expected(family)
+        cells = [
+            ("serial", sam, dict(decode_threads=1)),
+            ("shard", sam, dict(decode_threads=3)),
+            ("stream", gz, dict(decode_threads=2)),
+        ]
+        sidecars = {}
+        for rung, path, extra in cells:
+            side = str(tmp_path / f"{family}.{rung}.q.jsonl")
+            out, res = run_backend(
+                path, backend=_jax(), on_bad_record="quarantine",
+                quarantine_out=side, shards=1, **extra)
+            assert out == expected, f"{family}/{rung} consensus differs"
+            assert res.stats.extra["bad_records"] == len(entries)
+            sidecars[rung], summary = _sidecar_entries(side)
+            assert summary["bad_records"] == len(entries)
+        # raw-line native rungs: identical record SEQUENCES (the
+        # deterministic partition merge), equal to the injected dirt
+        assert sidecars["serial"] == entries
+        assert sidecars["shard"] == sidecars["serial"]
+        assert sidecars["stream"] == sidecars["serial"]
+
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_bam_rung_matrix_quarantine(self, family, tmp_path):
+        text = open(os.path.join(DATA, f"{family}.sam")).read()
+        dirty, entries = make_dirty(text, bam_safe=True)
+        bam = str(tmp_path / f"{family}.dirty.bam")
+        sam_text_to_bam(dirty, bam)
+        expected = _expected(family)
+        verdicts = {}
+        for decoder in ("native", "py"):
+            side = str(tmp_path / f"{family}.bam.{decoder}.q.jsonl")
+            out, res = run_backend(
+                bam, backend=_jax(), fmt="bam",
+                on_bad_record="quarantine", quarantine_out=side,
+                decoder=decoder, shards=1)
+            assert out == expected, \
+                f"{family}/bam-{decoder} consensus differs"
+            assert res.stats.extra["bad_records"] == len(entries)
+            got, summary = _sidecar_entries(side)
+            verdicts[decoder] = sorted(why for _r, why in got)
+            assert summary["bad_records"] == len(entries)
+        assert verdicts["native"] == verdicts["py"] \
+            == sorted(why for _l, why in entries)
+
+    def test_py_rung_and_cpu_oracle(self, tmp_path):
+        sam, _gz, entries = self._dirty_paths("formats_short", tmp_path)
+        expected = _expected("formats_short")
+        for tag, be, extra in (("py", _jax(), dict(decoder="py")),
+                               ("cpu", CpuBackend(), {})):
+            side = str(tmp_path / f"{tag}.q.jsonl")
+            out, res = run_backend(sam, backend=be,
+                                   on_bad_record="quarantine",
+                                   quarantine_out=side, **extra)
+            assert out == expected, f"{tag} consensus differs"
+            assert res.stats.extra["bad_records"] == len(entries)
+            got, _summary = _sidecar_entries(side)
+            # parsed-record lanes store rendered records: reasons must
+            # still match the injected taxonomy exactly
+            assert sorted(why for _r, why in got) \
+                == sorted(why for _l, why in entries)
+
+    def test_skip_mode_counts_without_sidecar(self, tmp_path):
+        sam, gz, entries = self._dirty_paths("formats_short", tmp_path)
+        expected = _expected("formats_short")
+        for path, extra in ((sam, dict(decode_threads=1)),
+                            (sam, dict(decode_threads=3)),
+                            (gz, dict(decode_threads=2))):
+            out, res = run_backend(path, backend=_jax(),
+                                   on_bad_record="skip", shards=1,
+                                   **extra)
+            assert out == expected
+            assert res.stats.extra["bad_records"] == len(entries)
+            assert "quarantine_sidecar" not in res.stats.extra
+        assert not list(tmp_path.glob("*_quarantine.jsonl"))
+
+    def test_bam_structural_overrun_never_walked(self, tmp_path):
+        """A record whose fields overrun its block_size (corrupt
+        n_cigar_op) is flagged at INDEX time — every python lane must
+        absorb the index exception instead of walking the entry, which
+        would read the NEXT record's bytes as CIGAR/SEQ and misclassify
+        (or miscount).  Fuzzer-found: the py twin walked index-flagged
+        entries and reported ``bad_cigar`` from the neighbour's bytes
+        where the native lane said ``bad_bam_record``."""
+        import io
+        import struct
+
+        from sam2consensus_tpu.formats.bam import (bam_payload,
+                                                   read_bam_header,
+                                                   sam_text_to_records)
+        from sam2consensus_tpu.formats.bgzf import (BGZF_EOF,
+                                                    compress_block)
+
+        body = [f"r{k}\t0\tc1\t{1 + 8 * k}\t60\t8M\t*\t0\t0\t"
+                "ACGTACGT\t*\n" for k in range(4)]
+        text = "@SQ\tSN:c1\tLN:60\n" + "".join(body)
+        payload = bytearray(bam_payload(*sam_text_to_records(text)))
+        fh = io.BytesIO(bytes(payload))
+        read_bam_header(fh)
+        rec_offs, p = [], fh.tell()
+        while p < len(payload):
+            rec_offs.append(p)
+            p += 4 + struct.unpack_from("<i", payload, p)[0]
+        # record 2: n_cigar_op (u16 at record-relative offset 16) -> 999
+        struct.pack_into("<H", payload, rec_offs[2] + 16, 999)
+        bam = str(tmp_path / "overrun.bam")
+        with open(bam, "wb") as out:
+            out.write(compress_block(bytes(payload)) + BGZF_EOF)
+
+        clean = str(tmp_path / "minus_r2.sam")
+        with open(clean, "w") as out:
+            out.write("@SQ\tSN:c1\tLN:60\n"
+                      + "".join(ln for k, ln in enumerate(body)
+                                if k != 2))
+        expected, _res = run_backend(clean)
+
+        # tolerant: native lane, py twin, and the cpu records() lane all
+        # quarantine exactly the flagged record with the INDEX error
+        sides = {}
+        for tag, be, extra in (("native", _jax(), dict(decoder="native")),
+                               ("py", _jax(), dict(decoder="py")),
+                               ("cpu", CpuBackend(), {})):
+            side = str(tmp_path / f"{tag}.q.jsonl")
+            out_txt, res = run_backend(bam, backend=be, fmt="bam",
+                                       on_bad_record="quarantine",
+                                       quarantine_out=side, **extra)
+            assert out_txt == expected, f"{tag} consensus differs"
+            assert res.stats.extra["bad_records"] == 1
+            with open(side) as fh2:
+                rows = [json.loads(ln) for ln in fh2]
+            sides[tag] = [(e["reason"], e["error"], e["offset"])
+                          for e in rows if "reason" in e]
+        want_off = rec_offs[2] - rec_offs[0]
+        for tag, got in sides.items():
+            assert got[0][0] == "bad_bam_record", (tag, got)
+            assert "fields overrun" in got[0][1], (tag, got)
+            assert got[0][2] == want_off, (tag, got)
+        assert sides["native"] == sides["py"] == sides["cpu"]
+
+        # strict AND legacy permissive (no sink either way): both
+        # binary decode lanes die on the index error with the identical
+        # type + message — permissive mode tolerates encode-level
+        # contract errors only, never structural parse damage
+        for strict in (True, False):
+            errs = {}
+            for decoder in ("native", "py"):
+                with pytest.raises(ValueError) as ei:
+                    run_backend(bam, backend=_jax(), fmt="bam",
+                                decoder=decoder, strict=strict)
+                errs[decoder] = (type(ei.value).__name__, str(ei.value))
+            assert errs["native"] == errs["py"], strict
+            assert "fields overrun" in errs["native"][1]
+
+    def test_strict_default_error_parity(self, tmp_path):
+        """--on-bad-record fail (the default): the FIRST bad record
+        kills the job with the same typed error + absolute file offset
+        on every text rung."""
+        sam, gz, entries = self._dirty_paths("formats_short", tmp_path)
+        first_bad = entries[0][0]
+        want_off = open(sam).read().index(first_bad)
+        errs = {}
+        for rung, path, extra in (("serial", sam, dict(decode_threads=1)),
+                                  ("shard", sam, dict(decode_threads=3)),
+                                  ("stream", gz, dict(decode_threads=2))):
+            with pytest.raises((ValueError, KeyError, IndexError)) as ei:
+                run_backend(path, backend=_jax(), shards=1, **extra)
+            errs[rung] = (type(ei.value).__name__, str(ei.value),
+                          getattr(ei.value, "s2c_offset", None))
+        assert errs["serial"][2] == want_off
+        assert errs["shard"] == errs["serial"]
+        assert errs["stream"] == errs["serial"]
+
+
+# ---------------------------------------------------------------------------
+# error budgets
+# ---------------------------------------------------------------------------
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native decoder unavailable")
+class TestErrorBudget:
+    def _dirty(self, tmp_path):
+        text = open(os.path.join(DATA, "formats_short.sam")).read()
+        dirty, entries = make_dirty(text)
+        path = str(tmp_path / "dirty.sam")
+        with open(path, "w") as fh:
+            fh.write(dirty)
+        return path, entries
+
+    def test_absolute_boundary(self, tmp_path):
+        path, entries = self._dirty(tmp_path)
+        n = len(entries)
+        # budget n+1: all n bad records fit — the job completes
+        out, res = run_backend(path, backend=_jax(),
+                               on_bad_record="skip",
+                               max_bad_records=str(n + 1),
+                               decode_threads=1, shards=1)
+        assert out == _expected("formats_short")
+        assert res.stats.extra["bad_records"] == n
+        # budget n: the nth bad record fails the job as a unit
+        with pytest.raises(BadRecordBudgetExceeded) as ei:
+            run_backend(path, backend=_jax(), on_bad_record="skip",
+                        max_bad_records=str(n), decode_threads=1,
+                        shards=1)
+        assert ei.value.summary["bad_records"] >= n
+        assert is_data_error(ei.value)
+
+    def test_percent_boundary(self, tmp_path):
+        path, entries = self._dirty(tmp_path)
+        out, _res = run_backend(path, backend=_jax(),
+                                on_bad_record="skip",
+                                max_bad_records="50%",
+                                decode_threads=2, shards=1)
+        assert out == _expected("formats_short")
+        with pytest.raises(BadRecordBudgetExceeded) as ei:
+            run_backend(path, backend=_jax(), on_bad_record="skip",
+                        max_bad_records="0.1%", decode_threads=2,
+                        shards=1)
+        assert "%" in str(ei.value)
+
+    def test_blown_budget_leaves_sidecar_evidence(self, tmp_path):
+        path, _entries = self._dirty(tmp_path)
+        side = str(tmp_path / "evidence.jsonl")
+        with pytest.raises(BadRecordBudgetExceeded) as ei:
+            run_backend(path, backend=_jax(),
+                        on_bad_record="quarantine", quarantine_out=side,
+                        max_bad_records="2", decode_threads=1, shards=1)
+        got, summary = _sidecar_entries(side)
+        assert summary["bad_records"] >= 2 and len(got) >= 1
+        assert ei.value.summary.get("sidecar") == os.path.abspath(side)
+
+
+# ---------------------------------------------------------------------------
+# DATA resilience class: poison input never retries, never demotes
+# ---------------------------------------------------------------------------
+class TestDataClass:
+    def test_classify(self):
+        from sam2consensus_tpu.resilience.policy import (DATA, TRANSIENT,
+                                                         classify)
+
+        assert classify(BadRecordBudgetExceeded("rotten")) == DATA
+        # the marker protocol, not the type: any data_error-marked
+        # exception classifies DATA even when its message says
+        # "exhausted" (the capacity heuristics' vocabulary)
+        exc = RuntimeError("resource exhausted while decoding")
+        exc.data_error = True
+        assert classify(exc) == DATA
+        assert classify(TimeoutError("deadline")) == TRANSIENT
+
+    def test_retry_policy_never_retries_data(self):
+        from sam2consensus_tpu.resilience.policy import RetryPolicy
+
+        calls = []
+
+        def poison():
+            calls.append(1)
+            raise BadRecordBudgetExceeded("rotten input")
+
+        pol = RetryPolicy(retries=5, backoff=0.001, on_error="fallback")
+        with pytest.raises(BadRecordBudgetExceeded):
+            pol.run(poison)
+        assert len(calls) == 1          # zero retries
+
+    def test_dispatcher_never_demotes_data(self):
+        """A DATA-class error through ResilientDispatcher raises
+        unchanged: no pileup-ladder demotion, no split, no retry — even
+        under ``--on-device-error fallback`` with retries available."""
+        import numpy as np
+
+        from sam2consensus_tpu.encoder.events import SegmentBatch
+        from sam2consensus_tpu.ops.pileup import PileupAccumulator
+        from sam2consensus_tpu.resilience.ladder import ResilientDispatcher
+        from sam2consensus_tpu.resilience.policy import RetryPolicy
+
+        total_len = 1 << 12
+        rng = np.random.default_rng(9)
+        starts = rng.integers(0, total_len - 64, 32).astype(np.int32)
+        codes = rng.integers(1, 6, (32, 64)).astype(np.uint8)
+        batch = SegmentBatch(buckets={64: (starts, codes)})
+        acc = PileupAccumulator(total_len, strategy="scatter")
+        calls = []
+        orig_add = acc.add
+
+        def poison_add(unit):
+            calls.append(1)
+            raise BadRecordBudgetExceeded("rotten")
+
+        acc.add = poison_add
+        disp = ResilientDispatcher(
+            RetryPolicy(retries=3, backoff=0.001, on_error="fallback"),
+            total_len)
+        with pytest.raises(BadRecordBudgetExceeded):
+            disp.add(acc, batch)
+        acc.add = orig_add
+        assert len(calls) == 1          # zero retries, zero splits
+        assert disp.demotions == 0      # no ladder step taken
+        assert disp._acc is acc         # same accumulator, same rung
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native decoder unavailable")
+class TestCli:
+    def _dirty(self, tmp_path):
+        text = open(os.path.join(DATA, "formats_short.sam")).read()
+        dirty, entries = make_dirty(text)
+        path = str(tmp_path / "dirty.sam")
+        with open(path, "w") as fh:
+            fh.write(dirty)
+        return path, entries
+
+    def test_budget_requires_tolerant_mode(self, tmp_path):
+        from sam2consensus_tpu.cli import main
+
+        path, _ = self._dirty(tmp_path)
+        with pytest.raises(SystemExit, match="tolerant"):
+            main(["-i", path, "--max-bad-records", "5",
+                  "-o", str(tmp_path / "out")])
+        with pytest.raises(SystemExit):
+            main(["-i", path, "--on-bad-record", "skip",
+                  "--max-bad-records", "nonsense",
+                  "-o", str(tmp_path / "out")])
+
+    def test_quarantine_out_requires_quarantine_mode(self, tmp_path):
+        # an explicit sidecar path must never be silently ignored
+        from sam2consensus_tpu.cli import main
+
+        path, _ = self._dirty(tmp_path)
+        for mode_args in ([], ["--on-bad-record", "skip"]):
+            with pytest.raises(SystemExit, match="quarantine-out"):
+                main(["-i", path, "-o", str(tmp_path / "out"),
+                      "--quarantine-out", str(tmp_path / "q.jsonl"),
+                      *mode_args])
+
+    def test_quarantine_end_to_end(self, tmp_path, capsys):
+        from sam2consensus_tpu.cli import main
+
+        path, entries = self._dirty(tmp_path)
+        out = str(tmp_path / "out")
+        rc = main(["-i", path, "-o", out, "-p", "cliq",
+                   "--backend", "cpu", "--on-bad-record", "quarantine"])
+        assert rc in (0, None)
+        side = os.path.join(out, "cliq_quarantine.jsonl")
+        got, summary = _sidecar_entries(side)
+        assert summary["bad_records"] == len(entries)
+        text = capsys.readouterr().out
+        assert "malformed record(s) quarantined" in text
+
+    def test_blown_budget_is_clean_failure(self, tmp_path, capsys):
+        from sam2consensus_tpu.cli import main
+
+        path, _ = self._dirty(tmp_path)
+        with pytest.raises(SystemExit) as ei:
+            main(["-i", path, "-o", str(tmp_path / "out"), "-p", "clib",
+                  "--backend", "cpu", "--on-bad-record", "skip",
+                  "--max-bad-records", "2"])
+        msg = str(ei.value)
+        assert "bad-record budget exhausted" in msg
+        assert "reasons:" in msg
+
+
+# ---------------------------------------------------------------------------
+# serve: poison-job isolation
+# ---------------------------------------------------------------------------
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native decoder unavailable")
+class TestServePoison:
+    def _runner(self, **kw):
+        from sam2consensus_tpu.serve import ServeRunner
+
+        kw.setdefault("prewarm", "off")
+        kw.setdefault("persistent_cache", False)
+        return ServeRunner(**kw)
+
+    def _paths(self, tmp_path):
+        text = open(os.path.join(DATA, "formats_short.sam")).read()
+        dirty, entries = make_dirty(text)
+        clean = str(tmp_path / "clean.sam")
+        with open(clean, "w") as fh:
+            fh.write(text)
+        poison = str(tmp_path / "poison.sam")
+        with open(poison, "w") as fh:
+            fh.write(dirty)
+        return clean, poison, entries
+
+    def test_poison_job_mid_queue_fails_fast_next_job_warm(self,
+                                                           tmp_path):
+        from sam2consensus_tpu.serve import JobSpec
+        from sam2consensus_tpu.serve.health import snapshot
+
+        clean, poison, entries = self._paths(tmp_path)
+        base = dict(backend="jax", pileup="scatter", shards=1,
+                    on_device_error="fallback", retries=2,
+                    retry_backoff=0.01)
+        poison_cfg = RunConfig(**base, on_bad_record="skip",
+                               max_bad_records="1")
+        runner = self._runner()
+        try:
+            results = runner.submit_jobs([
+                JobSpec(filename=clean, config=RunConfig(**base),
+                        tenant="t1"),
+                JobSpec(filename=poison, config=poison_cfg,
+                        tenant="t1"),
+                JobSpec(filename=clean, config=RunConfig(**base),
+                        tenant="t1"),
+            ])
+            assert [r.ok for r in results] == [True, False, True]
+            bad = results[1]
+            assert bad.budget_exhausted
+            assert "bad-record budget exhausted" in bad.error
+            # no retry storm, no ladder demotion for the poison job
+            assert bad.metrics.get("resilience/retries", 0) == 0
+            assert bad.metrics.get("resilience/demotions", 0) == 0
+            assert bad.rungs == {}
+            # the tenant was NOT pinned off the device path: the next
+            # job admitted clean and ran warm on the fast path
+            nxt = results[2]
+            assert nxt.admission is None
+            assert nxt.rungs == {}
+            assert nxt.metrics.get("compile/jit_cache_hit", 0) > 0
+            assert nxt.metrics.get("compile/jit_cache_miss", 0) == 0
+            # poison accounting: counted per tenant, surfaced in health
+            assert runner.registry.value("serve/admission_poison") == 1
+            assert runner.admission.poison_by_tenant == {"t1": 1}
+            assert runner.admission.tenant_rungs == {}
+            snap = snapshot(runner)
+            assert snap["admission"]["poison"] == 1
+            assert snap["poison_by_tenant"] == {"t1": 1}
+            assert snap["last_job"]["job"].startswith("job")
+        finally:
+            runner.close()
+
+    def test_tolerant_job_reports_verdict(self, tmp_path):
+        from sam2consensus_tpu.serve import JobSpec
+        from sam2consensus_tpu.serve.health import snapshot
+
+        clean, poison, entries = self._paths(tmp_path)
+        side = str(tmp_path / "job.q.jsonl")
+        cfg = RunConfig(backend="jax", pileup="scatter", shards=1,
+                        on_bad_record="quarantine", quarantine_out=side)
+        runner = self._runner()
+        try:
+            [res] = runner.submit_jobs([JobSpec(filename=poison,
+                                                config=cfg)])
+            assert res.ok
+            assert res.bad_records == len(entries)
+            assert res.quarantined == len(entries)
+            assert not res.budget_exhausted
+            got, _summary = _sidecar_entries(side)
+            assert len(got) == len(entries)
+            # fleet aggregation + last-job verdict in the snapshot
+            assert runner.registry.value("serve/bad_records") \
+                == len(entries)
+            snap = snapshot(runner)
+            assert snap["bad_records"] == len(entries)
+            assert snap["last_job"]["bad_records"] == len(entries)
+            assert snap["last_job"]["budget_exhausted"] is False
+        finally:
+            runner.close()

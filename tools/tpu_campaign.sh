@@ -201,4 +201,22 @@ run_step chaos_soak "campaign/chaos_soak_$R.jsonl" \
   "campaign/chaos_soak_stderr_$R.log" 3600 \
   python tools/chaos_soak.py --cycles 8
 
+# 9. differential ingest fuzz (hostile-input hardening evidence,
+# ISSUE 9): seeded byte/field-level mutants over the fixture corpus,
+# every mutant through the strict + tolerant rung matrices (serial /
+# byte-shard / streaming gzip / pure-python + the BAM binary lanes) —
+# the artifact's summary row must show 0 crashes / 0 hangs / 0
+# strict-or-tolerant rung divergences.  The tier-1 smoke slice
+# (tests/test_fuzz_smoke.py) keeps the guarantee live between
+# campaigns; the committed proof is
+# campaign/fuzz_ingest_r06_cpufallback.jsonl.  A second leg measures
+# tolerant-mode overhead on CLEAN input (the <2% PERF.md claim):
+# perf/tolerant_overhead_r06_cpufallback.json
+run_step fuzz_ingest "campaign/fuzz_ingest_$R.jsonl" \
+  "campaign/fuzz_ingest_stderr_$R.log" 3600 \
+  python tools/fuzz_ingest.py --trials 1200 --no-progress --out -
+run_step tolerant_overhead "campaign/tolerant_overhead_$R.json" \
+  "campaign/tolerant_overhead_stderr_$R.log" 1200 \
+  python tools/fuzz_ingest.py --overhead --out -
+
 echo "$(date +%H:%M:%S) campaign complete" >> "$LOG"
