@@ -586,6 +586,9 @@ def serve_leg(n_jobs):
             "jit_misses": sum(r.get("jit_miss", 0) for r in res["rows"]
                               if r.get("mode") == "warm"),
             "jit_cache_dir": s["jit_cache_dir"],
+            # the warm side ran with the telemetry plane on; its
+            # exposition format-lint verdict rides the gated artifact
+            "telemetry": s.get("telemetry"),
         },
     }
     log(f"[serve_warm] cold {s['cold_per_job_sec']}s/job vs warm "

@@ -122,6 +122,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-level", dest="log_level", default=None,
                    choices=["debug", "info", "warning", "error"],
                    help="enable package logging to stderr at this level")
+    p.add_argument("--log-format", dest="log_format",
+                   choices=["text", "json"], default="text",
+                   help="log record shape: text (default) or json — "
+                        "one JSON object per record carrying "
+                        "job_id/tenant/rung and the innermost open "
+                        "trace span as correlation IDs "
+                        "(observability/telemetry.py; json implies "
+                        "--log-level info when none is given)")
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None,
                    help="persist count-tensor checkpoints here and resume "
                         "from them if present (jax backend)")
@@ -298,6 +306,7 @@ def config_from_args(args: argparse.Namespace) -> RunConfig:
         trace_out=args.trace_out,
         metrics_out=args.metrics_out,
         log_level=args.log_level,
+        log_format=getattr(args, "log_format", "text"),
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         paranoid=args.paranoid,
@@ -472,8 +481,56 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--health-out", dest="health_out", default=None,
                    help="write an atomic health/readiness snapshot "
                         "(queue depth, in-flight job, heartbeat age, "
-                        "tenant rungs, journal position) to this path "
-                        "at every job boundary")
+                        "tenant rungs, journal position, SLO burn) to "
+                        "this path — rewritten at every job boundary "
+                        "AND on the watchdog heartbeat cadence, so it "
+                        "stays fresh while a job hangs")
+    # --- telemetry plane (sam2consensus_tpu/observability/telemetry) ---
+    p.add_argument("--telemetry-out", dest="telemetry_out", default=None,
+                   help="write the server-lifetime OpenMetrics/"
+                        "Prometheus text exposition (folded per-job "
+                        "counters, per-tenant SLO summaries, "
+                        "heartbeat-aged liveness gauges) to this path, "
+                        "rewritten atomically on the telemetry "
+                        "cadence — scrapeable with a plain file read, "
+                        "no agent required")
+    p.add_argument("--telemetry-port", dest="telemetry_port", type=int,
+                   default=None,
+                   help="serve /metrics (OpenMetrics text) and "
+                        "/healthz (the health snapshot JSON) on "
+                        "127.0.0.1:PORT via a stdlib-only endpoint "
+                        "(0 = ephemeral port, logged at startup); "
+                        "scrapes compute fresh heartbeat ages per "
+                        "request")
+    p.add_argument("--telemetry-interval", dest="telemetry_interval",
+                   type=float, default=None,
+                   help="seconds between exposition/health rewrites "
+                        "(default 2.0; env S2C_TELEMETRY_INTERVAL); "
+                        "the same cadence drives the mid-hang health "
+                        "refresh")
+    p.add_argument("--slo", dest="slo", default=None,
+                   help="per-phase latency objectives, e.g. "
+                        "'e2e=5s,queue=1s' (phases: queue|queue_wait, "
+                        "decode, dispatch, vote, e2e; values in s or "
+                        "ms; env S2C_SLO).  Breaches burn "
+                        "slo/violations/<tenant>/<phase> counters "
+                        "surfaced in the exposition, the health "
+                        "snapshot and each job's manifest serve.slo "
+                        "verdict")
+    p.add_argument("--profile-capture-dir", dest="profile_capture_dir",
+                   default=None,
+                   help="where on-demand profiler captures land "
+                        "(default: the journal dir, else next to "
+                        "--telemetry-out).  Arm a capture with "
+                        "SIGUSR2 or by touching <dir>/capture_profile "
+                        "— a bounded jax.profiler window (pure-Python "
+                        "span/stack dump on cpu) taken WHILE the "
+                        "current job runs, no restart needed")
+    p.add_argument("--log-format", dest="log_format",
+                   choices=["text", "json"], default="text",
+                   help="log record shape (see the one-shot CLI); "
+                        "json records carry job_id/tenant/rung/span "
+                        "correlation IDs across every serve thread")
     # shared-flag defaults config_from_args expects but serve never
     # exposes (one-shot-only features)
     p.set_defaults(backend="jax", prefix="", profile_dir=None,
@@ -494,13 +551,21 @@ def serve_main(argv: List[str]) -> int:
     from .serve import JobSpec, ServeRunner
     from .utils.platform import pin_platform_from_env
 
-    observability.configure_logging(args.log_level)
+    observability.configure_logging(args.log_level, args.log_format)
     pin_platform_from_env()
     # same non-composable combos the one-shot main rejects up front —
     # a deep per-job failure would be a worse error surface
     if args.pileup == "host" and args.shards > 1:
         raise SystemExit("--pileup host accumulates on the single host; "
                          "it does not compose with --shards")
+    # a typo'd SLO objective must fail the server start, not silently
+    # never fire (same up-front discipline as --fault-inject)
+    from .observability.telemetry import parse_slo
+
+    try:
+        parse_slo(args.slo)
+    except ValueError as exc:
+        raise SystemExit(f"error: {exc}") from None
     if args.fault_inject:
         from .resilience.faultinject import parse_spec
 
@@ -543,7 +608,12 @@ def serve_main(argv: List[str]) -> int:
                          max_queue=args.max_queue,
                          tenant_quota=args.tenant_quota,
                          health_out=args.health_out,
-                         fault_inject=args.fault_inject)
+                         fault_inject=args.fault_inject,
+                         telemetry_out=args.telemetry_out,
+                         telemetry_port=args.telemetry_port,
+                         telemetry_interval=args.telemetry_interval,
+                         slo=args.slo,
+                         profile_capture_dir=args.profile_capture_dir)
     echo(f"\nServing {len(specs)} job(s) on one warm backend"
          + (f" (jit cache: {runner.cache_dir})" if runner.cache_dir
             else "")
@@ -572,6 +642,12 @@ def serve_main(argv: List[str]) -> int:
     ov = runner.registry.value("serve/overlap_sec")
     if args.health_out:
         echo(f"Health snapshot at {args.health_out}")
+    if args.telemetry_out:
+        echo(f"Telemetry exposition at {args.telemetry_out}")
+    nv = int(runner.registry.value("slo/violations"))
+    if nv:
+        echo(f"SLO: {nv} objective breach(es) — see slo/violations/* "
+             f"in the exposition / health snapshot")
     echo(f"Done: {len(results) - failed}/{len(results)} job(s) ok, "
          f"cross-job overlap {ov:.3f}s.\n")
     return 1 if failed else 0
@@ -587,7 +663,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from . import observability
 
-    observability.configure_logging(cfg.log_level)
+    observability.configure_logging(cfg.log_level, cfg.log_format)
 
     # A user's JAX_PLATFORMS must win even where a sitecustomize hook
     # pre-registered a remote accelerator and overrode jax.config (the

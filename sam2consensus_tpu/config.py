@@ -62,6 +62,9 @@ class RunConfig:
     trace_out: Optional[str] = None      # Chrome/Perfetto trace JSON path
     metrics_out: Optional[str] = None    # metrics-registry JSONL path
     log_level: Optional[str] = None      # package logger level (CLI)
+    log_format: str = "text"     # text | json (structured records with
+    #                              job/tenant/rung/span correlation IDs
+    #                              — observability/telemetry.py)
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 2_000_000  # reads between checkpoint writes
     paranoid: bool = False       # re-validate device inputs/outputs per batch

@@ -276,9 +276,13 @@ def publish_stats_extra(extra: dict) -> None:
         # quarantine/* (tolerant decode: stored sidecar entries,
         # truncation — ingest/badrecords.py) rides along so a job that
         # skipped records says so from any artifact
+        # slo/* (per-tenant objective burn) and telemetry/* (exposition
+        # writer health, profiler captures — observability/telemetry.py)
+        # ride along so the fleet-telemetry story is checkable from any
+        # per-job artifact
         elif name.startswith(("wire/", "pipeline/", "drift/", "serve/",
                               "compile/", "format/", "ingest/",
-                              "quarantine/")):
+                              "quarantine/", "slo/", "telemetry/")):
             extra[name] = int(value) if float(value).is_integer() \
                 else round(value, 4)
     for gauge_name, extra_key in (("dispatch/tail", "tail_dispatch"),
@@ -301,8 +305,20 @@ def publish_stats_extra(extra: dict) -> None:
             extra[name] = g["value"]
 
 
-def configure_logging(level: Optional[str]) -> None:
-    """Wire the package logger to stderr at ``level`` (``--log-level``)."""
+def configure_logging(level: Optional[str],
+                      log_format: str = "text") -> None:
+    """Wire the package logger to stderr (``--log-level`` /
+    ``--log-format``).  ``log_format="json"`` swaps in
+    :class:`~.telemetry.JsonLogFormatter` — one JSON object per record
+    carrying the job_id/tenant/rung/trace-span correlation context
+    (:func:`~.telemetry.set_log_context`) — and implies level=info
+    when no level was requested (asking for structured logs and
+    getting silence would be absurd)."""
+    if log_format not in ("text", "json"):
+        raise SystemExit(f"error: unknown log format {log_format!r} "
+                         "(use text|json)")
+    if log_format == "json" and not level:
+        level = "info"
     if not level:
         return
     lv = getattr(logging, level.upper(), None)
@@ -311,8 +327,14 @@ def configure_logging(level: Optional[str]) -> None:
                          "(use debug|info|warning|error)")
     logger = logging.getLogger("sam2consensus_tpu")
     if not logger.handlers:
-        h = logging.StreamHandler()
-        h.setFormatter(logging.Formatter(
-            "%(asctime)s %(name)s %(levelname)s %(message)s"))
-        logger.addHandler(h)
+        logger.addHandler(logging.StreamHandler())
+    if log_format == "json":
+        from .telemetry import JsonLogFormatter
+
+        fmt: logging.Formatter = JsonLogFormatter()
+    else:
+        fmt = logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s %(message)s")
+    for h in logger.handlers:
+        h.setFormatter(fmt)
     logger.setLevel(lv)

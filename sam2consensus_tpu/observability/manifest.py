@@ -107,10 +107,16 @@ def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
     # readiness snapshot at job start), serve/recovery (journal-resume
     # provenance: what a restarted queue skipped and resumed),
     # serve/watchdog (the deadline/stall verdict that abandoned a job)
+    # slo/* (per-tenant objective burn counters) and telemetry/*
+    # (exposition-writer health, profiler captures) ride the serve
+    # section: the fleet-telemetry verdicts live next to the serve
+    # counters they explain (observability/telemetry.py)
     serve = {k: v for k, v in counters.items()
-             if k.startswith(("serve/", "compile/"))}
+             if k.startswith(("serve/", "compile/", "slo/",
+                              "telemetry/"))}
     for name, g in snap["gauges"].items():
-        if name.startswith("serve/") and g.get("info"):
+        if name.startswith(("serve/", "slo/", "telemetry/")) \
+                and g.get("info"):
             serve[name] = g["info"]
     # tolerant-decode evidence: bad-record counts per taxonomy reason
     # plus the quarantine summary (mode, sidecar path, truncation) —

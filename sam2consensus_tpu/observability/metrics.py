@@ -91,6 +91,31 @@ class Histogram:
             # so late observations still register without randomness
             self.values[self.count % HIST_CAP] = v
 
+    def merge(self, other: "Histogram") -> None:
+        """Fold another histogram in: count/total/min/max merge
+        EXACTLY; the bounded reservoir absorbs the other's samples
+        through the same deterministic round-robin decimation
+        ``observe`` uses — so fleet-level percentiles over merged
+        per-job histograms stay meaningful (approximate past HIST_CAP,
+        exact below it).  Used by the telemetry plane's
+        server-lifetime :class:`~.telemetry.AggregateRegistry`."""
+        if other.count == 0:
+            return
+        self.total += other.total
+        if other.vmin < self.vmin:
+            self.vmin = other.vmin
+        if other.vmax > self.vmax:
+            self.vmax = other.vmax
+        for v in other.values:
+            self.count += 1
+            if len(self.values) < HIST_CAP:
+                self.values.append(v)
+            else:
+                self.values[self.count % HIST_CAP] = v
+        # observations the other reservoir itself decimated away still
+        # count toward the merged count (sum/min/max already carry them)
+        self.count += other.count - len(other.values)
+
     def percentile(self, q: float) -> float:
         if not self.values:
             return 0.0

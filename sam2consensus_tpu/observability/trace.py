@@ -71,6 +71,19 @@ class _NullSpan:
 
 _NULL_SPAN = _NullSpan()
 
+#: per-thread stack of OPEN span names — the log-correlation surface
+#: (observability/telemetry.JsonLogFormatter stamps the innermost open
+#: span onto every record).  Maintained only by live spans, so the
+#: disabled path stays allocation-free.
+_span_tls = threading.local()
+
+
+def current_span_name() -> Optional[str]:
+    """The innermost open span on THIS thread (None outside any span
+    or while tracing is disabled)."""
+    stack = getattr(_span_tls, "stack", None)
+    return stack[-1] if stack else None
+
 
 class _LiveSpan:
     """An open span on one thread's stack."""
@@ -88,10 +101,17 @@ class _LiveSpan:
         self._t0_us = 0.0
 
     def __enter__(self):
+        stack = getattr(_span_tls, "stack", None)
+        if stack is None:
+            stack = _span_tls.stack = []
+        stack.append(self._name)
         self._t0_us = (time.perf_counter() - self._tracer._epoch) * 1e6
         return self
 
     def __exit__(self, exc_type, exc, tb):
+        stack = getattr(_span_tls, "stack", None)
+        if stack:
+            stack.pop()
         if self._sync is not None and exc_type is None:
             # force device completion INSIDE the span so dur measures
             # compute; skipped when unwinding an exception (the device

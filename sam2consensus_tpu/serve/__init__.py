@@ -54,7 +54,18 @@ resilience; all opt-in):
 * :mod:`.health` — an atomic health/readiness snapshot
   (``--health-out``; also embedded in each job's manifest ``serve``
   section): queue depth, in-flight job, heartbeat age, per-tenant
-  rungs, journal position.
+  rungs, journal position — rewritten on the watchdog heartbeat
+  cadence as well as at job boundaries, so it stays fresh while a job
+  hangs.
+
+Fleet telemetry plane (``observability/telemetry.py``, wired through
+the runner): a server-lifetime AggregateRegistry per-job registries
+fold into, per-tenant per-phase SLO histograms + burn counters
+(``--slo``), an OpenMetrics exposition (``--telemetry-out`` /
+``--telemetry-port`` ``/metrics``+``/healthz``), on-demand profiler
+capture (SIGUSR2 / ``capture_profile`` touch-file), and correlated
+JSON logs (``--log-format json``).  All best-effort: telemetry never
+fails a job.
 """
 
 from .admission import AdmissionController

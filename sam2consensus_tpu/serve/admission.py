@@ -70,6 +70,15 @@ class AdmissionController:
     #: bad-record budgets).  Queue-lifetime, like tenant_rungs — but
     #: unlike a degradation rung it never pins anybody (see note_poison)
     poison_by_tenant: Dict[str, int] = field(default_factory=dict)
+    #: tenant -> SLO objective breaches (observability/telemetry.py
+    #: burn counters, fed by the serve runner per finished job).
+    #: Queue-lifetime evidence for admission decisions: surfaced in
+    #: the health snapshot and each job's manifest serve.slo verdict,
+    #: the base for future burn-rate throttling — like poison, burning
+    #: an objective never demotes a tenant's rung by itself (slow is
+    #: not broken, and the breach may be the FLEET's queue, not the
+    #: tenant's data)
+    slo_burn_by_tenant: Dict[str, int] = field(default_factory=dict)
 
     def open_window(self) -> None:
         self._window_admitted = 0
@@ -106,6 +115,14 @@ class AdmissionController:
         poison-rate throttling at admission time."""
         self.poison_by_tenant[tenant or ""] = \
             self.poison_by_tenant.get(tenant or "", 0) + 1
+
+    def note_slo(self, tenant: str, n_violations: int = 1) -> None:
+        """Count SLO objective breaches for the tenant (see
+        ``slo_burn_by_tenant``)."""
+        if n_violations > 0:
+            self.slo_burn_by_tenant[tenant or ""] = \
+                self.slo_burn_by_tenant.get(tenant or "", 0) \
+                + int(n_violations)
 
     def note_result(self, tenant: str, rungs: dict, ok: bool,
                     was_pinned: bool) -> None:

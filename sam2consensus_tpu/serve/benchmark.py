@@ -127,7 +127,14 @@ def run_serve_bench(n_jobs: int = 8, n_reads: int = 5000,
                                           prefix=default_prefix(p)),
                          job_id=f"warm{k}")
                  for k, p in enumerate(paths)]
+        # the warm side runs with the telemetry plane ON (exposition
+        # into a scratch file): its format-lint verdict rides the
+        # summary, so every committed serve_bench artifact doubles as
+        # proof the exposition stays well-formed under a real queue
+        tele_path = os.path.join(tmp, "serve_bench.prom")
         runner = ServeRunner(persistent_cache=False,
+                             telemetry_out=tele_path,
+                             telemetry_interval=0.5,
                              echo=lambda m: log(f"[serve_bench] {m}"))
         try:
             t0 = time.perf_counter()
@@ -181,6 +188,21 @@ def run_serve_bench(n_jobs: int = 8, n_reads: int = 5000,
                 runner.registry.value("serve/overlap_sec"), 4),
             "jit_cache_dir": runner.cache_dir,
         }
+        try:
+            from ..observability.telemetry import lint_openmetrics
+
+            with open(tele_path, encoding="utf-8") as fh:
+                lint = lint_openmetrics(fh.read())
+            summary["telemetry"] = {
+                "lint_errors": len(lint),
+                "lint_first": lint[:2],
+                "jobs_folded": int(runner.registry.value(
+                    "telemetry/jobs_folded")),
+                "write_failed": int(runner.registry.value(
+                    "telemetry/write_failed")),
+            }
+        except OSError as exc:
+            summary["telemetry"] = {"error": str(exc)}
         log(f"[serve_bench] cold {cold_per_job:.2f}s/job vs warm "
             f"{warm_per_job:.2f}s/job "
             f"({summary['speedup_vs_cold']}x), identical="

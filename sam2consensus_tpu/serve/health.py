@@ -26,7 +26,6 @@ tolerate added keys.
 from __future__ import annotations
 
 import json
-import os
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -67,14 +66,19 @@ def snapshot(runner) -> dict:
     h = runner.health
     now = time.monotonic()
     reg = runner.registry
+    # single read before the None test: telemetry HTTP threads cut
+    # snapshots concurrently with the main thread's job_finished()
+    # clearing the field — a check-then-read pair would 500 a scrape
+    # that races a job boundary
+    since = h.in_flight_since
     snap = {
         "schema": SCHEMA,
         "created_unix": round(time.time(), 3),
         "uptime_sec": round(now - h._started_mono, 3),
         "queue_depth": h.queue_depth,
         "in_flight": h.in_flight,
-        "in_flight_sec": round(now - h.in_flight_since, 3)
-        if h.in_flight_since is not None else None,
+        "in_flight_sec": round(now - since, 3)
+        if since is not None else None,
         "last_heartbeat_age_sec": round(now - h.last_beat, 3),
         "jobs": {
             "run": int(reg.value("serve/jobs")),
@@ -100,14 +104,34 @@ def snapshot(runner) -> dict:
         "journal": runner.journal.position()
         if runner.journal is not None else None,
     }
+    # fleet telemetry (observability/telemetry.py): the SLO burn and
+    # the telemetry plane's own health, so a prober without a
+    # Prometheus stack still sees objective breaches
+    slo_obj = getattr(runner, "slo", None)
+    if slo_obj or reg.value("slo/violations"):
+        snap["slo"] = {
+            "objectives": dict(slo_obj or {}),
+            "violations": int(reg.value("slo/violations")),
+            "burn_by_tenant": dict(getattr(
+                runner.admission, "slo_burn_by_tenant", {})),
+        }
+    prof = getattr(runner, "profiler", None)
+    if prof is not None and (prof.captures
+                             or reg.value("telemetry/write_failed")):
+        snap["telemetry"] = {
+            "profile_captures": prof.captures,
+            "last_profile": prof.last_path,
+            "write_failed": int(reg.value("telemetry/write_failed")),
+        }
     return snap
 
 
 def write_health(path: str, snap: dict) -> None:
     """Atomic rewrite: a prober polling the file never reads half a
-    snapshot (same tmp+replace discipline as the journal segments)."""
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        json.dump(snap, fh, indent=1, sort_keys=False)
-        fh.write("\n")
-    os.replace(tmp, path)
+    snapshot.  Delegates to the ONE shared writer
+    (:func:`~..observability.telemetry.atomic_write_text`) the
+    exposition file and journal segments also use."""
+    from ..observability.telemetry import atomic_write_text
+
+    atomic_write_text(path, json.dumps(snap, indent=1,
+                                       sort_keys=False) + "\n")

@@ -61,6 +61,7 @@ from typing import Callable, List, Optional, Tuple
 from .. import observability as obs
 from ..config import RunConfig
 from ..observability import jitcache
+from ..observability import telemetry as stele
 from ..observability.metrics import MetricsRegistry
 from . import health as shealth
 from . import journal as sjournal
@@ -233,6 +234,9 @@ class _DecodeAhead:
         from ..formats import open_alignment_input
 
         with obs.bind_run_to_thread(self.robs):
+            stele.set_log_context(job_id=self.spec.job_id,
+                                  tenant=self.spec.tenant,
+                                  thread="decode-ahead")
             reg = obs.metrics()
             tr = obs.tracer()
             tr.name_thread("serve-decode-ahead")
@@ -314,7 +318,12 @@ class ServeRunner:
                  stall_timeout: Optional[float] = None,
                  max_queue: int = 0, tenant_quota: int = 0,
                  health_out: Optional[str] = None,
-                 fault_inject: str = ""):
+                 fault_inject: str = "",
+                 telemetry_out: Optional[str] = None,
+                 telemetry_port: Optional[int] = None,
+                 telemetry_interval: Optional[float] = None,
+                 slo=None,
+                 profile_capture_dir: Optional[str] = None):
         from ..backends.jax_backend import JaxBackend
 
         if prewarm not in ("auto", "off"):
@@ -323,10 +332,13 @@ class ServeRunner:
         self.decode_ahead = decode_ahead
         self.echo = echo or (lambda *a, **k: None)
         self.backend = JaxBackend()
-        #: server-lifetime instruments: prewarm traces land here (so
-        #: per-job registries show prewarmed shapes as pure hits) plus
-        #: the aggregate serve/* counters across the whole queue
-        self.registry = MetricsRegistry()
+        #: server-lifetime instruments (observability/telemetry.py
+        #: AggregateRegistry): prewarm traces land here (so per-job
+        #: registries show prewarmed shapes as pure hits), the
+        #: aggregate serve/* counters across the whole queue, and —
+        #: folded in at every job end — each job's phase counters,
+        #: gauges and histograms, plus the per-tenant SLO histograms
+        self.registry = stele.AggregateRegistry()
         self.jobs_run = 0
         self._prewarmed: set = set()
         self._prewarm_threads: list = []
@@ -359,6 +371,37 @@ class ServeRunner:
                 logger.info("journal mode: decode-ahead disabled "
                             "(per-job checkpoints need serial decode)")
                 self.decode_ahead = False
+        # -- telemetry plane (observability/telemetry.py) --------------
+        # strictly best-effort: every write path below degrades to the
+        # per-job manifests (telemetry/write_failed counter + warning)
+        # and never fails a job
+        self.slo = dict(slo) if isinstance(slo, dict) \
+            else stele.parse_slo(slo)
+        self.telemetry_out = telemetry_out
+        try:
+            self.telemetry_interval = float(
+                telemetry_interval if telemetry_interval is not None
+                else os.environ.get("S2C_TELEMETRY_INTERVAL",
+                                    stele.DEFAULT_INTERVAL_S))
+        except ValueError:
+            self.telemetry_interval = stele.DEFAULT_INTERVAL_S
+        self._telemetry_last = 0.0
+        #: profiler captures land next to the journal (the durable
+        #: place an operator already looks), else next to the
+        #: exposition file, else the cwd
+        cap_dir = profile_capture_dir or \
+            (self.journal.root if self.journal is not None else None) \
+            or (os.path.dirname(telemetry_out) or "."
+                if telemetry_out else ".")
+        self.profiler = stele.ProfilerCapture(cap_dir)
+        self.profiler.install_signal()
+        self.http: Optional[stele.TelemetryServer] = None
+        if telemetry_port is not None:
+            self.http = stele.TelemetryServer(
+                self.render_telemetry, self.health_snapshot,
+                port=telemetry_port)
+            logger.info("telemetry endpoint on 127.0.0.1:%d "
+                        "(/metrics, /healthz)", self.http.port)
         # a daemon thread killed MID-XLA-COMPILE at interpreter exit
         # aborts the whole process from C++ ("terminate called without
         # an active exception"); close() stops the prewarm loop at the
@@ -402,6 +445,9 @@ class ServeRunner:
             if t.is_alive():
                 t.join()
         self._prewarm_threads.clear()
+        if self.http is not None:
+            self.http.close()
+            self.http = None
         import atexit
 
         try:
@@ -535,8 +581,134 @@ class ServeRunner:
             try:
                 shealth.write_health(self.health_out,
                                      self.health_snapshot())
-            except OSError as exc:
+            except Exception as exc:
+                self.registry.add("telemetry/write_failed", 1)
                 logger.warning("health snapshot write failed: %s", exc)
+
+    # -- telemetry plane ---------------------------------------------------
+    def _update_live_gauges(self) -> None:
+        """Refresh the heartbeat-aged liveness gauges from runner state
+        — the mid-job signal that makes a hung job visible WHILE it
+        hangs (the per-job registries only fold in at job end)."""
+        h = self.health
+        now = time.monotonic()
+        reg = self.registry
+        reg.gauge("serve/up").set(1.0)
+        reg.gauge("serve/uptime_sec").set(
+            round(now - h._started_mono, 3))
+        reg.gauge("serve/queue_depth").set(float(h.queue_depth))
+        reg.gauge("serve/heartbeat_age_sec").set(
+            round(now - h.last_beat, 3))
+        # single read before the None test: HTTP scrape threads call
+        # this concurrently with job_finished() clearing the field
+        since = h.in_flight_since
+        reg.gauge("serve/inflight_age_sec").set(
+            round(now - since, 3) if since is not None else 0.0)
+
+    def render_telemetry(self) -> str:
+        """The OpenMetrics exposition over the server-lifetime
+        aggregate, gauges refreshed first — an HTTP scrape between
+        watchdog ticks still sees current heartbeat ages."""
+        self._update_live_gauges()
+        return stele.render_openmetrics(self.registry.snapshot())
+
+    def telemetry_tick(self, force: bool = False) -> None:
+        """One heartbeat of the telemetry plane, driven from the
+        watchdog poll loop and (``force=True``) every job boundary:
+        refresh liveness gauges, honor a pending profiler-capture
+        request, and — on the configured cadence — atomically rewrite
+        the exposition file AND the health snapshot (one shared
+        writer, so ``--health-out`` is no longer frozen while a job
+        hangs under ``--job-timeout``).  Every failure degrades to the
+        per-job manifests: counted, warned, never raised."""
+        self._update_live_gauges()
+        if self.profiler.pending():
+            path = self.profiler.capture(
+                tracer=obs.tracer(), registry=self.registry,
+                context={"in_flight": self.health.in_flight,
+                         "queue_depth": self.health.queue_depth})
+            if path is not None:
+                self.registry.add("telemetry/profile_captures", 1)
+                self.registry.gauge("telemetry/last_profile").set_info(
+                    {"path": path, "in_flight": self.health.in_flight})
+        now = time.monotonic()
+        if not force and now - self._telemetry_last \
+                < self.telemetry_interval:
+            return
+        self._telemetry_last = now
+        if self.telemetry_out:
+            try:
+                stele.atomic_write_text(self.telemetry_out,
+                                        self.render_telemetry())
+            except Exception as exc:
+                self.registry.add("telemetry/write_failed", 1)
+                logger.warning(
+                    "telemetry exposition write failed (%s: %s) — "
+                    "degrading to per-job manifests",
+                    type(exc).__name__, exc)
+        self._publish_health()
+
+    def _telemetry_job_end(self, robs, res: JobResult, snap: dict,
+                           tenant: str, queue_wait: float) -> None:
+        """Job-boundary telemetry: fold the job's registry into the
+        server-lifetime aggregate, observe its per-phase latency into
+        the tenant's SLO histograms, burn violation counters, and feed
+        the verdict into the job's manifest ``serve.slo`` section (the
+        manifest file is rewritten in place when the job exported
+        one)."""
+        try:
+            self.registry.fold(robs.registry, job_id=res.job_id,
+                               tenant=tenant)
+        except Exception as exc:     # aggregation is derived state
+            self.registry.add("telemetry/fold_failed", 1)
+            logger.warning("telemetry fold failed for %s: %s",
+                           res.job_id, exc)
+        phases = stele.slo_phase_seconds(snap["counters"],
+                                         res.elapsed_sec, queue_wait)
+        tlabel = tenant or "default"
+        violated = []
+        for ph, sec in phases.items():
+            self.registry.observe(f"slo/{tlabel}/{ph}", sec)
+            obj = self.slo.get(ph)
+            if obj is not None and sec > obj:
+                violated.append(ph)
+                self.registry.add("slo/violations", 1)
+                self.registry.add(f"slo/violations/{tlabel}/{ph}", 1)
+        if violated:
+            # burn under the SAME label the exposition/manifest use
+            # ("default" for untenanted jobs) so an operator can
+            # cross-reference the two surfaces key-for-key
+            self.admission.note_slo(tlabel, len(violated))
+            logger.warning(
+                "job %s breached SLO objective(s) %s "
+                "(phases %s vs objectives %s)", res.job_id,
+                ",".join(violated),
+                {k: round(v, 3) for k, v in phases.items()}, self.slo)
+        verdict = {
+            "job": res.job_id, "tenant": tlabel,
+            "phases_sec": {k: round(v, 4) for k, v in phases.items()},
+            "objectives_sec": dict(self.slo),
+            "violated": violated,
+            "burn": {ph: int(self.registry.value(
+                f"slo/violations/{tlabel}/{ph}"))
+                for ph in stele.SLO_PHASES
+                if self.registry.value(
+                    f"slo/violations/{tlabel}/{ph}")},
+        }
+        self.registry.gauge("slo/last_job").set_info(verdict)
+        if res.manifest is not None:
+            res.manifest.setdefault("serve", {})["slo"] = verdict
+            if robs.metrics_out:
+                from ..observability import manifest as _manifest
+
+                try:
+                    _manifest.write_manifest(
+                        _manifest.manifest_path_for(robs.metrics_out),
+                        res.manifest)
+                except Exception as exc:
+                    self.registry.add("telemetry/write_failed", 1)
+                    logger.warning("manifest slo rewrite failed: %s",
+                                   exc)
 
     # -- journal helpers ---------------------------------------------------
     def _journal_append(self, ev: str, **fields) -> None:
@@ -584,7 +756,10 @@ class ServeRunner:
 
             box: list = []
 
+            log_ctx = stele.get_log_context()
+
             def work():
+                stele.set_log_context(**log_ctx)
                 with obs.bind_run_to_thread(robs):
                     try:
                         box.append(("ok", self.backend.run(
@@ -602,6 +777,11 @@ class ServeRunner:
                 if box:
                     break               # finished during the poll: a
                     # result beats a deadline that expired in the race
+                # mid-job telemetry heartbeat: liveness gauges, the
+                # exposition/health cadence writer, and profiler-
+                # capture triggers all ride the watchdog poll — a hung
+                # dispatch is visible (and profileable) WHILE it hangs
+                self.telemetry_tick()
                 now = time.perf_counter()
                 last = dlog[-1][1] if dlog else start
                 if len(dlog) > beats_seen:
@@ -633,8 +813,7 @@ class ServeRunner:
             self.backend.serve_prepared_obs = None
             self.backend.serve_dispatch_log = None
 
-    @staticmethod
-    def _join_ahead(ahead: "_DecodeAhead",
+    def _join_ahead(self, ahead: "_DecodeAhead",
                     stall_t: Optional[float]) -> None:
         """Wait for a decode-ahead thread, declaring it wedged only
         when it stops MAKING PROGRESS (no new decoded batch) for
@@ -648,6 +827,7 @@ class ServeRunner:
         last_progress = time.perf_counter()
         while ahead.thread.is_alive():
             ahead.thread.join(min(0.5, stall_t / 4))
+            self.telemetry_tick()       # a wedged decode is mid-job too
             n = len(ahead.intervals())
             now = time.perf_counter()
             if n != last_n:
@@ -762,7 +942,12 @@ class ServeRunner:
 
         self.health.queue_depth = sum(1 for e in plan
                                       if e["action"] == "run")
-        self._publish_health()
+        #: queue-wait epoch: every job's SLO queue_wait is measured
+        #: from here — the wall time a submission spent behind earlier
+        #: jobs of its own window (a hung job inflates every
+        #: successor's queue_wait, which is exactly the signal)
+        window_t0 = time.perf_counter()
+        self.telemetry_tick(force=True)
 
         results: List[JobResult] = []
         ahead: Optional[_DecodeAhead] = None
@@ -898,6 +1083,12 @@ class ServeRunner:
             res = JobResult(job_id=job_id, filename=spec.filename,
                             index=i, admission=entry["admission"])
             dlog: List[Tuple[float, float]] = []
+            # log-correlation IDs for every record this job emits —
+            # the watchdog worker and (already-bound) decode-ahead
+            # threads inherit/set the same fields (--log-format json)
+            stele.set_log_context(
+                job_id=job_id, tenant=spec.tenant,
+                rung=(entry["admission"] or cfg.pileup))
             self.health.job_started(job_id)
             self._journal_append("started", job=job_id,
                                  key=entry["key"],
@@ -975,6 +1166,10 @@ class ServeRunner:
             if not res.ok:
                 self._journal_append("failed", job=job_id,
                                      key=entry["key"], error=res.error)
+            # fold the job's registry into the server-lifetime
+            # aggregate + per-tenant SLO verdict (never fails a job)
+            self._telemetry_job_end(robs, res, snap, spec.tenant,
+                                    queue_wait=t0 - window_t0)
             results.append(res)
             self.jobs_run += 1
             self.registry.add("serve/jobs", 1)
@@ -991,10 +1186,11 @@ class ServeRunner:
                 "quarantined": res.quarantined,
                 "budget_exhausted": res.budget_exhausted,
             }
+            stele.set_log_context()     # job done: clear correlation
             self.health.job_finished()
             self.health.queue_depth = max(
                 0, self.health.queue_depth - 1)
-            self._publish_health()
+            self.telemetry_tick(force=True)
             # -- cross-job overlap: bill it to the job whose decode
             #    was hidden (N+1), before that job runs ---------------
             if ahead is not None:
@@ -1010,7 +1206,7 @@ class ServeRunner:
             self.echo(f"[serve] {job_id}: "
                       + (f"ok in {res.elapsed_sec:.2f}s"
                          if res.ok else f"FAILED ({res.error})"))
-        self._publish_health()
+        self.telemetry_tick(force=True)
         return results
 
     def _note_poison(self, spec: JobSpec, exc: BaseException,

@@ -1,0 +1,722 @@
+"""Fleet telemetry plane (observability/telemetry.py + serve wiring).
+
+The ISSUE-10 acceptance pins live here:
+
+* a journaled 8-job two-tenant serve queue with one ``job_hang``-
+  injected job shows the exposition rewritten MID-HANG (heartbeat-
+  aged, not job-boundary-stale — the stale-health-while-hung fix),
+  per-tenant e2e/queue_wait p50/p99 present for BOTH tenants,
+  ``slo/violations`` burned exactly for the hung job's tenant, and an
+  on-demand profiler capture produced during the hang;
+* byte-identical consensus output with telemetry enabled vs disabled;
+* the OpenMetrics exposition of a real 4-job serve queue passes the
+  promtool-style format lint, including counter monotonicity across
+  two scrapes and over the live HTTP endpoint.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.observability import telemetry as T
+from sam2consensus_tpu.observability.metrics import (HIST_CAP, Histogram,
+                                                     MetricsRegistry)
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_cache(monkeypatch):
+    monkeypatch.setenv("S2C_JIT_CACHE", "")
+
+
+def _sim(tmp, name, seed, contig_len=3000, n_reads=1000):
+    spec = SimSpec(n_contigs=1, contig_len=contig_len, n_reads=n_reads,
+                   read_len=100, contig_len_jitter=0.0, seed=seed,
+                   contig_prefix="tele")
+    path = os.path.join(str(tmp), name)
+    with open(path, "w") as fh:
+        fh.write(simulate(spec))
+    return path
+
+
+def _runner(**kw):
+    from sam2consensus_tpu.serve import ServeRunner
+
+    kw.setdefault("prewarm", "off")
+    kw.setdefault("persistent_cache", False)
+    return ServeRunner(**kw)
+
+
+BASE = dict(backend="jax", pileup="scatter", shards=1)
+
+
+# =========================================================================
+# units: SLO grammar
+# =========================================================================
+def test_parse_slo_grammar():
+    assert T.parse_slo("e2e=5s,queue=1s") == {"e2e": 5.0,
+                                              "queue_wait": 1.0}
+    assert T.parse_slo("queue_wait=250ms, decode=0.5") == {
+        "queue_wait": 0.25, "decode": 0.5}
+    assert T.parse_slo("DISPATCH=2s") == {"dispatch": 2.0}
+    assert T.parse_slo("") == {}
+    assert T.parse_slo(None) == {}
+    for bad in ("bogus=1s", "e2e", "e2e=zap", "e2e=0", "e2e=-1s"):
+        with pytest.raises(ValueError):
+            T.parse_slo(bad)
+
+
+def test_parse_slo_env_fallback(monkeypatch):
+    monkeypatch.setenv("S2C_SLO", "e2e=3s")
+    assert T.parse_slo(None) == {"e2e": 3.0}
+    assert T.parse_slo("vote=1s") == {"vote": 1.0}   # explicit wins
+
+
+# =========================================================================
+# units: histogram merge + aggregate fold
+# =========================================================================
+def test_histogram_merge_exact_stats():
+    a, b = Histogram(), Histogram()
+    for v in (1.0, 2.0, 3.0):
+        a.observe(v)
+    for v in (0.5, 9.0):
+        b.observe(v)
+    a.merge(b)
+    assert a.count == 5
+    assert a.total == pytest.approx(15.5)
+    assert a.vmin == 0.5 and a.vmax == 9.0
+    assert sorted(a.values) == [0.5, 1.0, 2.0, 3.0, 9.0]
+    a.merge(Histogram())                 # empty merge is a no-op
+    assert a.count == 5
+
+
+def test_histogram_merge_past_reservoir_cap():
+    a, b = Histogram(), Histogram()
+    for i in range(HIST_CAP):
+        a.observe(float(i))
+    for i in range(HIST_CAP + 100):
+        b.observe(float(i))
+    a.merge(b)
+    assert a.count == HIST_CAP + HIST_CAP + 100     # exact count
+    assert len(a.values) == HIST_CAP                # bounded reservoir
+    assert a.vmax == float(HIST_CAP + 99)
+
+
+def test_aggregate_fold_counters_gauges_histograms():
+    agg = T.AggregateRegistry()
+    agg.add("phase/decode_sec", 1.0)
+    agg.add("serve/jobs", 3)             # runner-owned at server scope
+    job = MetricsRegistry()
+    job.add("phase/decode_sec", 2.0)
+    job.add("serve/overlap_sec", 9.0)    # must NOT double-count
+    job.gauge("wire/codec").set(1.0)
+    job.gauge("wire/codec").set_info({"chosen": "delta8"})
+    job.observe("pileup/slab_sec", 0.25)
+    agg.fold(job, job_id="j1", tenant="ta")
+    assert agg.value("phase/decode_sec") == pytest.approx(3.0)
+    assert agg.value("serve/overlap_sec") == 0.0
+    assert agg.value("serve/jobs") == 3
+    info = agg.info("wire/codec")
+    assert info["folded_from"] == "j1" and info["tenant"] == "ta"
+    assert info["chosen"] == "delta8" and "updated_unix" in info
+    snap = agg.snapshot()
+    assert snap["histograms"]["pileup/slab_sec"]["count"] == 1
+    assert agg.value("telemetry/jobs_folded") == 1
+    # second fold keeps summing
+    agg.fold(job, job_id="j2")
+    assert agg.value("phase/decode_sec") == pytest.approx(5.0)
+    assert snap is not agg.snapshot()
+
+
+# =========================================================================
+# units: exposition render + lint
+# =========================================================================
+def _demo_registry():
+    r = T.AggregateRegistry()
+    r.add("phase/decode_sec", 1.5)
+    r.add("serve/jobs", 4)
+    r.add("slo/violations/ta/e2e", 1)
+    r.gauge("serve/heartbeat_age_sec").set(0.5)
+    r.observe("slo/ta/e2e", 0.25)
+    r.observe("slo/ta/e2e", 0.75)
+    return r
+
+
+def test_render_openmetrics_structure():
+    text = T.render_openmetrics(_demo_registry().snapshot())
+    assert 's2c_phase_seconds_total{phase="decode"} 1.5' in text
+    assert 's2c_slo_violations_total{tenant="ta",phase="e2e"} 1' in text
+    assert 's2c_slo_phase_seconds{tenant="ta",phase="e2e",' \
+           'quantile="0.5"}' in text
+    assert 's2c_slo_phase_seconds_count{tenant="ta",phase="e2e"} 2' \
+        in text
+    assert text.rstrip().endswith("# EOF")
+    # one TYPE per family, HELP before TYPE, deterministic output
+    assert text.count("# TYPE s2c_slo_phase_seconds summary") == 1
+    assert text == T.render_openmetrics(_demo_registry().snapshot())
+    assert T.lint_openmetrics(text) == []
+
+
+def test_render_escapes_label_values():
+    r = T.AggregateRegistry()
+    r.observe('slo/we"ird\\ten\nant/e2e', 1.0)
+    text = T.render_openmetrics(r.snapshot())
+    assert T.lint_openmetrics(text) == []
+    samples = T.parse_openmetrics(text)
+    tenants = {s["labels"].get("tenant") for s in samples
+               if "tenant" in s["labels"]}
+    assert 'we"ird\\ten\nant' in tenants   # round-trips exactly
+
+
+def test_lint_catches_synthetic_violations():
+    def errs(text):
+        return T.lint_openmetrics(text)
+
+    # name charset
+    assert errs("# TYPE s2c-bad gauge\n# EOF\n")
+    # sample without TYPE
+    assert any("no preceding TYPE" in e
+               for e in errs("s2c_x_total 1\n# EOF\n"))
+    # duplicate TYPE
+    bad = ("# TYPE s2c_x gauge\n# TYPE s2c_x gauge\ns2c_x 1\n# EOF\n")
+    assert any("duplicate TYPE" in e for e in errs(bad))
+    # TYPE after samples
+    bad = ("# TYPE s2c_x gauge\ns2c_x 1\n"
+           "# TYPE s2c_y gauge\ns2c_y 1\n"
+           "# TYPE s2c_x gauge\n# EOF\n")
+    assert any("duplicate TYPE" in e for e in errs(bad))
+    # counter without _total
+    bad = "# TYPE s2c_x counter\ns2c_x 1\n# EOF\n"
+    assert any("_total" in e for e in errs(bad))
+    # negative counter
+    bad = "# TYPE s2c_x_total counter\ns2c_x_total -1\n# EOF\n"
+    assert any("negative" in e for e in errs(bad))
+    # bad escape in label value
+    bad = ('# TYPE s2c_x gauge\ns2c_x{a="b\\q"} 1\n# EOF\n')
+    assert any("escape" in e for e in errs(bad))
+    # bad label name
+    bad = ('# TYPE s2c_x gauge\ns2c_x{0a="b"} 1\n# EOF\n')
+    assert errs(bad)
+    # duplicate sample
+    bad = ("# TYPE s2c_x gauge\ns2c_x 1\ns2c_x 2\n# EOF\n")
+    assert any("duplicate sample" in e for e in errs(bad))
+    # quantile out of range
+    bad = ('# TYPE s2c_x summary\ns2c_x{quantile="1.5"} 1\n# EOF\n')
+    assert any("quantile" in e for e in errs(bad))
+    # missing EOF
+    assert any("EOF" in e
+               for e in errs("# TYPE s2c_x gauge\ns2c_x 1\n"))
+    # unparsable value
+    assert errs("# TYPE s2c_x gauge\ns2c_x zap\n# EOF\n")
+
+
+def test_lint_counter_monotonicity_across_scrapes():
+    a = "# TYPE s2c_x_total counter\ns2c_x_total 5\n# EOF\n"
+    b = "# TYPE s2c_x_total counter\ns2c_x_total 3\n# EOF\n"
+    ok = "# TYPE s2c_x_total counter\ns2c_x_total 7\n# EOF\n"
+    assert T.lint_openmetrics(ok, prev=a) == []
+    assert any("went backwards" in e
+               for e in T.lint_openmetrics(b, prev=a))
+    # summary _count is monotone too
+    s1 = ("# TYPE s2c_h summary\ns2c_h_count 4\ns2c_h_sum 2.0\n# EOF\n")
+    s2 = ("# TYPE s2c_h summary\ns2c_h_count 2\ns2c_h_sum 2.0\n# EOF\n")
+    assert any("went backwards" in e
+               for e in T.lint_openmetrics(s2, prev=s1))
+    # gauges may move freely
+    g1 = "# TYPE s2c_g gauge\ns2c_g 5\n# EOF\n"
+    g2 = "# TYPE s2c_g gauge\ns2c_g 1\n# EOF\n"
+    assert T.lint_openmetrics(g2, prev=g1) == []
+
+
+def test_atomic_write_leaves_no_droppings(tmp_path):
+    path = str(tmp_path / "x.prom")
+    T.atomic_write_text(path, "hello\n")
+    T.atomic_write_text(path, "world\n")
+    assert open(path).read() == "world\n"
+    assert [n for n in os.listdir(tmp_path)] == ["x.prom"]
+
+
+# =========================================================================
+# units: JSON logging + correlation, profiler capture
+# =========================================================================
+def test_json_log_formatter_correlation():
+    import logging
+
+    from sam2consensus_tpu.observability.trace import Tracer
+
+    fmt = T.JsonLogFormatter()
+    rec = logging.LogRecord("sam2consensus_tpu.test", logging.WARNING,
+                            __file__, 1, "slab %d retried", (3,), None)
+    T.set_log_context(job_id="job7", tenant="ta", rung="host")
+    tr = Tracer(enabled=True)
+    try:
+        with tr.span("accumulate"):
+            obj = json.loads(fmt.format(rec))
+    finally:
+        T.set_log_context()
+    assert obj["msg"] == "slab 3 retried"
+    assert obj["level"] == "warning"
+    assert obj["job_id"] == "job7" and obj["tenant"] == "ta"
+    assert obj["rung"] == "host" and obj["span"] == "accumulate"
+    # cleared context + closed span leave no stale correlation
+    obj2 = json.loads(fmt.format(rec))
+    assert "job_id" not in obj2 and "span" not in obj2
+
+
+def test_configure_logging_json(monkeypatch):
+    import logging
+
+    from sam2consensus_tpu import observability as obs
+
+    logger = logging.getLogger("sam2consensus_tpu")
+    old_handlers, old_level = list(logger.handlers), logger.level
+    try:
+        logger.handlers = []
+        obs.configure_logging(None, "json")   # json implies info
+        assert logger.level == logging.INFO
+        assert isinstance(logger.handlers[0].formatter,
+                          T.JsonLogFormatter)
+        with pytest.raises(SystemExit):
+            obs.configure_logging("info", "yaml")
+    finally:
+        logger.handlers = old_handlers
+        logger.setLevel(old_level)
+
+
+def test_profiler_capture_touch_file_and_span_dump(tmp_path):
+    from sam2consensus_tpu.observability.trace import Tracer
+
+    cap = T.ProfilerCapture(str(tmp_path))
+    assert cap.capture() is None               # not armed
+    open(cap.touch_path, "w").close()
+    assert cap.pending()                       # consumed the touch file
+    assert not os.path.exists(cap.touch_path)
+    tr = Tracer(enabled=True)
+    with tr.span("decode"):
+        pass
+    reg = MetricsRegistry()
+    reg.add("phase/decode_sec", 1.0)
+    dest = cap.capture(tracer=tr, registry=reg,
+                       context={"in_flight": "j0"})
+    assert dest is not None and os.path.isdir(dest)
+    blob = json.load(open(os.path.join(dest, "span_dump.json")))
+    assert blob["schema"] == "s2c-profile-capture/1"
+    assert blob["context"]["in_flight"] == "j0"
+    assert blob["threads"]                     # live thread stacks
+    assert any(s["name"] == "decode" for s in blob["spans"])
+    assert blob["metrics"]["counters"]["phase/decode_sec"] == 1.0
+    assert cap.captures == 1 and cap.last_path == dest
+    assert cap.capture() is None               # disarmed after capture
+    cap.request()                              # SIGUSR2 path arms too
+    assert cap.pending()
+
+
+# =========================================================================
+# satellites: --flame, s2c_top
+# =========================================================================
+def test_trace_summary_flame_collapsed_stacks(tmp_path, capsys):
+    ts = _tool("trace_summary")
+    spans = [
+        {"ph": "X", "name": "accumulate", "ts": 0.0, "dur": 100.0,
+         "tid": 1},
+        {"ph": "X", "name": "pileup_dispatch", "ts": 10.0, "dur": 60.0,
+         "tid": 1},
+        {"ph": "X", "name": "slab", "ts": 20.0, "dur": 30.0, "tid": 1},
+        {"ph": "X", "name": "decode", "ts": 0.0, "dur": 50.0, "tid": 2},
+    ]
+    agg = ts.collapsed_stacks(spans)
+    assert agg["accumulate"] == pytest.approx(40.0)        # 100-60
+    assert agg["accumulate;pileup_dispatch"] == pytest.approx(30.0)
+    assert agg["accumulate;pileup_dispatch;slab"] == \
+        pytest.approx(30.0)
+    assert agg["decode"] == pytest.approx(50.0)
+    # the CLI path over a real trace file
+    trace = tmp_path / "t.json"
+    trace.write_text(json.dumps({"traceEvents": spans}))
+    assert ts.main([str(trace), "--flame"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert "accumulate;pileup_dispatch;slab 30" in out
+    assert "decode 50" in out
+    # self-time totals across paths == total span self time
+    total = sum(int(line.rsplit(" ", 1)[1]) for line in out)
+    assert total == 150
+
+
+def test_s2c_top_render_frame(tmp_path, capsys):
+    top = _tool("s2c_top")
+    health = {
+        "schema": "s2c-health/1", "uptime_sec": 12.5, "queue_depth": 2,
+        "in_flight": "job3", "in_flight_sec": 4.0,
+        "last_heartbeat_age_sec": 6.5,
+        "jobs": {"run": 3, "failed": 1, "watchdog_timeouts": 1},
+        "admission": {"admitted": 4, "rejected": 0, "pinned": 0,
+                      "poison": 0},
+        "tenant_rungs": {"tb": "host"},
+        "slo": {"objectives": {"e2e": 2.0}, "violations": 1,
+                "burn_by_tenant": {"tb": 1}},
+        "telemetry": {"profile_captures": 1, "last_profile": "/x/p1"},
+    }
+    text = T.render_openmetrics(_demo_registry().snapshot())
+    samples = T.parse_openmetrics(text)
+    lines = top.render(health, samples)
+    frame = "\n".join(lines)
+    assert "in-flight: job3" in frame
+    assert "possible wedge" in frame          # aging heartbeat flagged
+    assert "ta" in frame and "tb" in frame    # tenants from both files
+    assert "violations 1" in frame
+    assert "profiler captures: 1" in frame
+    assert top.render(None, None) == \
+        ["s2c_top: waiting for health snapshot..."]
+    # --once end-to-end over real files
+    hp = tmp_path / "health.json"
+    hp.write_text(json.dumps(health))
+    tp = tmp_path / "m.prom"
+    tp.write_text(text)
+    assert top.main(["--health", str(hp), "--telemetry", str(tp),
+                     "--once"]) == 0
+    assert "job3" in capsys.readouterr().out
+
+
+# =========================================================================
+# satellites: check_perf_claims accepts (and lints) telemetry artifacts
+# =========================================================================
+def test_check_perf_claims_lints_telemetry_artifacts(tmp_path):
+    cpc = _tool("check_perf_claims")
+    committed = os.path.join(
+        REPO, "campaign", "serve_telemetry_r06_cpufallback.prom")
+    assert os.path.exists(committed)
+    assert cpc.lint_telemetry_artifact(committed) == []
+    # a malformed cited exposition is flagged as a violation
+    repo = tmp_path
+    os.makedirs(repo / "campaign")
+    (repo / "campaign" / "bad.prom").write_text("s2c_x_total 1\n")
+    (repo / "PERF.md").write_text(
+        "The serve path hits 5.6x vs cold, see "
+        "campaign/bad.prom evidence.\n")
+    viol = cpc.check_file(str(repo), "PERF.md")
+    assert any("fails the OpenMetrics lint" in v for v in viol)
+    # a well-formed one passes
+    (repo / "campaign" / "bad.prom").write_text(
+        "# TYPE s2c_x_total counter\ns2c_x_total 1\n# EOF\n")
+    assert cpc.check_file(str(repo), "PERF.md") == []
+
+
+# =========================================================================
+# serve integration: 4-job queue exposition + endpoint (tier-1 pin)
+# =========================================================================
+def test_serve_queue_exposition_lint_and_endpoint(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    paths = [_sim(tmp_path, f"q{k}.sam", seed=40 + k) for k in range(4)]
+    tele = str(tmp_path / "metrics.prom")
+    health = str(tmp_path / "health.json")
+    runner = _runner(telemetry_out=tele, telemetry_port=0,
+                     health_out=health, telemetry_interval=0.05,
+                     slo="e2e=120s")
+    try:
+        specs = [JobSpec(filename=p, config=RunConfig(**BASE),
+                         tenant="ta" if k < 2 else "tb")
+                 for k, p in enumerate(paths)]
+        res = runner.submit_jobs(specs[:2])
+        first = open(tele).read()
+        assert T.lint_openmetrics(first) == []
+        res += runner.submit_jobs(specs[2:])
+        second = open(tele).read()
+        assert all(r.ok for r in res)
+        # scrape-over-scrape: well-formed AND counters monotone
+        assert T.lint_openmetrics(second, prev=first) == []
+        samples = T.parse_openmetrics(second)
+        tenants = {s["labels"].get("tenant") for s in samples
+                   if s["name"] == "s2c_slo_phase_seconds"}
+        assert tenants == {"ta", "tb"}
+        phases = {s["labels"]["phase"] for s in samples
+                  if s["name"] == "s2c_slo_phase_seconds"}
+        assert phases == set(T.SLO_PHASES)
+        folded = [s["value"] for s in samples
+                  if s["name"] == "s2c_telemetry_jobs_folded_total"]
+        assert folded == [4.0]
+        # the live endpoint serves the same snapshot, fresh
+        port = runner.http.port
+        got = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read() \
+            .decode()
+        assert T.lint_openmetrics(got, prev=second) == []
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert hz["schema"] == "s2c-health/1"
+        assert hz["jobs"]["run"] == 4
+        code = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).status
+        assert code == 200
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
+                                   timeout=10)
+    finally:
+        runner.close()
+    # no objectives breached on a generous SLO
+    assert runner.registry.value("slo/violations") == 0
+    assert runner.admission.slo_burn_by_tenant == {}
+
+
+# =========================================================================
+# serve integration: manifest serve.slo + telemetry failure semantics
+# =========================================================================
+def test_manifest_carries_slo_verdict_and_burn(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    path = _sim(tmp_path, "m.sam", seed=77)
+    mpath = str(tmp_path / "job.metrics")
+    runner = _runner(slo="e2e=1ms")       # impossible: every job burns
+    try:
+        [r] = runner.submit_jobs([JobSpec(
+            filename=path,
+            config=RunConfig(**BASE, metrics_out=mpath),
+            tenant="ta")])
+    finally:
+        runner.close()
+    assert r.ok
+    slo = r.manifest["serve"]["slo"]
+    assert slo["tenant"] == "ta" and "e2e" in slo["violated"]
+    assert slo["objectives_sec"] == {"e2e": 0.001}
+    assert set(slo["phases_sec"]) == set(T.SLO_PHASES)
+    assert slo["burn"]["e2e"] == 1
+    # the on-disk manifest was rewritten with the verdict
+    disk = json.load(open(mpath + ".manifest.json"))
+    assert disk["serve"]["slo"]["violated"] == slo["violated"]
+    assert runner.admission.slo_burn_by_tenant == {"ta": 1}
+    # stats.extra surfaces the slo counters via the compat view
+    assert r.metrics.get("slo/violations", 0) == 0  # job registry: none
+    assert runner.registry.value("slo/violations/ta/e2e") == 1
+
+
+def test_telemetry_write_failure_never_fails_job(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    path = _sim(tmp_path, "w.sam", seed=78)
+    # a directory path makes every atomic replace fail
+    bad = str(tmp_path / "isdir.prom")
+    os.makedirs(bad)
+    runner = _runner(telemetry_out=bad, telemetry_interval=0.0)
+    try:
+        [r] = runner.submit_jobs([JobSpec(filename=path,
+                                          config=RunConfig(**BASE))])
+    finally:
+        runner.close()
+    assert r.ok                                  # degraded, not dead
+    assert runner.registry.value("telemetry/write_failed") > 0
+
+
+# =========================================================================
+# byte identity: telemetry on vs off
+# =========================================================================
+def test_byte_identity_telemetry_on_vs_off(tmp_path):
+    from sam2consensus_tpu.serve import JobSpec
+
+    paths = [_sim(tmp_path, f"b{k}.sam", seed=90 + k)
+             for k in range(2)]
+
+    def run(telemetry):
+        kw = {}
+        if telemetry:
+            kw = dict(telemetry_out=str(tmp_path / "t.prom"),
+                      telemetry_interval=0.05, slo="e2e=60s",
+                      telemetry_port=0)
+        runner = _runner(**kw)
+        try:
+            res = runner.submit_jobs(
+                [JobSpec(filename=p, config=RunConfig(**BASE),
+                         tenant="ta") for p in paths])
+        finally:
+            runner.close()
+        assert all(r.ok for r in res)
+        return [{n: render_file(rec, 0) for n, rec in r.fastas.items()}
+                for r in res]
+
+    assert run(False) == run(True)
+
+
+# =========================================================================
+# THE acceptance: journaled 8-job queue, one hung job
+# =========================================================================
+def test_hang_visible_mid_flight_with_slo_burn_and_capture(
+        tmp_path, monkeypatch):
+    from sam2consensus_tpu.serve import JobSpec
+
+    monkeypatch.setenv("S2C_FAULT_HANG_S", "600")
+    paths = [_sim(tmp_path, f"h{k}.sam", seed=300 + k)
+             for k in range(8)]
+    tele = str(tmp_path / "metrics.prom")
+    health = str(tmp_path / "health.json")
+    hang_job = 3                                  # tenant tb
+    runner = _runner(journal_dir=str(tmp_path / "journal"),
+                     stall_timeout=3.5,
+                     telemetry_out=tele, health_out=health,
+                     telemetry_interval=0.1, slo="e2e=2.5s")
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    specs = []
+    for k, p in enumerate(paths):
+        # journal mode commits outputs to disk: outfolder must be the
+        # test's tmp dir, not the pytest CWD
+        cfg = RunConfig(**BASE, outfolder=str(outdir) + "/",
+                        prefix=f"h{k}",
+                        fault_inject="job_hang:timeout:0:1"
+                        if k == hang_job else "")
+        specs.append(JobSpec(filename=p, config=cfg,
+                             job_id=f"h{k}",
+                             tenant="ta" if k % 2 == 0 else "tb"))
+
+    scrapes = []
+    health_ages = []
+    stop = threading.Event()
+
+    def poller():
+        prev = None
+        armed = False
+        while not stop.is_set():
+            try:
+                h = json.load(open(health))
+            except (OSError, ValueError):
+                time.sleep(0.03)
+                continue
+            if h.get("in_flight") == f"h{hang_job}":
+                if not armed:
+                    runner.profiler.request()     # SIGUSR2-equivalent
+                    armed = True
+                try:
+                    text = open(tele).read()
+                except OSError:
+                    text = None
+                if text and text != prev:
+                    hb = None
+                    for line in text.splitlines():
+                        if line.startswith(
+                                "s2c_serve_heartbeat_age_sec "):
+                            hb = float(line.split()[-1])
+                    scrapes.append(
+                        (hb, T.lint_openmetrics(text, prev=prev)))
+                    health_ages.append(
+                        h.get("last_heartbeat_age_sec"))
+                    prev = text
+            time.sleep(0.06)
+
+    t = threading.Thread(target=poller, daemon=True)
+    t.start()
+    try:
+        res = runner.submit_jobs(specs)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        runner.close()
+
+    # -- the hang cost exactly one job, the rest ran -------------------
+    assert [r.ok for r in res] == [k != hang_job for k in range(8)]
+    assert "HungDispatchError" in res[hang_job].error
+
+    # -- exposition updated MID-HANG, heartbeat-aged, lint-clean -------
+    ages = [hb for hb, _errs in scrapes if hb is not None]
+    assert len(ages) >= 2, f"only {len(scrapes)} mid-hang scrapes"
+    assert max(ages) > min(ages), "heartbeat age did not grow mid-hang"
+    assert max(ages) > 1.0                       # visibly hung
+    for _hb, errs in scrapes:
+        assert errs == []                        # every scrape valid
+    # the health file aged mid-hang too (stale-health-while-hung fix)
+    hages = [a for a in health_ages if a is not None]
+    assert hages and max(hages) > 1.0 and max(hages) > min(hages)
+
+    # -- SLO burned exactly for the hung job's tenant ------------------
+    assert runner.registry.value("slo/violations") == 1
+    assert runner.registry.value("slo/violations/tb/e2e") == 1
+    assert runner.admission.slo_burn_by_tenant == {"tb": 1}
+
+    # -- per-tenant latency summaries present for BOTH tenants ---------
+    final = open(tele).read()
+    assert T.lint_openmetrics(final) == []
+    samples = T.parse_openmetrics(final)
+
+    def q(tenant, phase, quantile):
+        for s in samples:
+            if (s["name"] == "s2c_slo_phase_seconds"
+                    and s["labels"].get("tenant") == tenant
+                    and s["labels"].get("phase") == phase
+                    and s["labels"].get("quantile") == quantile):
+                return s["value"]
+        return None
+
+    for tenant in ("ta", "tb"):
+        for phase in ("e2e", "queue_wait"):
+            assert q(tenant, phase, "0.5") is not None
+            assert q(tenant, phase, "0.99") is not None
+    # the hung job dominates its tenant's p99 but not the other's
+    assert q("tb", "e2e", "0.99") > 2.5
+    assert q("ta", "e2e", "0.99") < 2.5
+    # jobs behind the hang waited: queue_wait p99 reflects the stall
+    assert q("ta", "queue_wait", "0.99") > 2.5
+
+    # -- on-demand profiler capture produced DURING the hang -----------
+    assert runner.profiler.captures == 1
+    dump = os.path.join(runner.profiler.last_path, "span_dump.json")
+    blob = json.load(open(dump))
+    assert blob["context"]["in_flight"] == f"h{hang_job}"
+    # the capture saw the wedged worker thread's stack
+    assert any("serve-job" in name for name in blob["threads"])
+    # it landed next to the journal
+    assert runner.profiler.last_path.startswith(
+        str(tmp_path / "journal"))
+    assert runner.registry.value("telemetry/profile_captures") == 1
+    assert runner.registry.value("telemetry/write_failed") == 0
+
+    # -- health snapshot carries the slo + telemetry sections ----------
+    h = json.load(open(health))
+    assert h["slo"]["violations"] == 1
+    assert h["slo"]["burn_by_tenant"] == {"tb": 1}
+    assert h["telemetry"]["profile_captures"] == 1
+
+
+# =========================================================================
+# CLI surface
+# =========================================================================
+def test_serve_cli_telemetry_flags(tmp_path):
+    from sam2consensus_tpu.cli import build_serve_parser, serve_main
+
+    args = build_serve_parser().parse_args(
+        ["-i", "x.sam", "--telemetry-out", "t.prom",
+         "--telemetry-port", "0", "--slo", "e2e=5s,queue=1s",
+         "--telemetry-interval", "0.5", "--log-format", "json",
+         "--profile-capture-dir", "caps"])
+    assert args.telemetry_out == "t.prom" and args.telemetry_port == 0
+    assert args.slo == "e2e=5s,queue=1s"
+    assert args.log_format == "json"
+    # a typo'd objective fails the server start, loudly
+    with pytest.raises(SystemExit):
+        serve_main(["-i", "x.sam", "--slo", "nope=1s"])
+    with pytest.raises(SystemExit):
+        serve_main(["-i", "x.sam", "--slo", "e2e=fast"])
+
+
+def test_one_shot_cli_log_format_flag():
+    from sam2consensus_tpu.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args(
+        ["-i", "x.sam", "--log-format", "json"])
+    cfg = config_from_args(args)
+    assert cfg.log_format == "json"
+    assert config_from_args(build_parser().parse_args(
+        ["-i", "x.sam"])).log_format == "text"

@@ -19,6 +19,13 @@ Paragraph = blank-line-separated block; fenced code blocks are skipped
 (command transcripts quote numbers legitimately).  Wired into tier-1 as
 tests/test_perf_claims.py, so a PR cannot land an uncited claim.
 
+Telemetry artifacts are first-class claim evidence: a cited
+``.prom``/``.openmetrics`` exposition snapshot (the serve telemetry
+plane's ``--telemetry-out`` / the campaign ``serve_telemetry`` leg)
+must additionally PASS the OpenMetrics format lint
+(``observability/telemetry.lint_openmetrics``) — a malformed
+exposition is no more evidence than a missing file.
+
 Usage: python tools/check_perf_claims.py [--repo DIR]; exit 0 clean,
 1 with one violation per line otherwise.
 """
@@ -92,10 +99,32 @@ def check_file(repo, name):
             continue
         for art in cited:
             art = art.rstrip(".")      # sentence-final period
-            if not os.path.exists(os.path.join(repo, art)):
+            path = os.path.join(repo, art)
+            if not os.path.exists(path):
                 violations.append(
                     f"{name}:{lineno}: cites missing artifact {art!r}")
+            elif art.endswith((".prom", ".openmetrics")):
+                errs = lint_telemetry_artifact(path)
+                if errs:
+                    violations.append(
+                        f"{name}:{lineno}: telemetry artifact {art!r} "
+                        f"fails the OpenMetrics lint "
+                        f"({len(errs)} error(s); first: {errs[0]})")
     return violations
+
+
+def lint_telemetry_artifact(path):
+    """Format-lint a cited exposition snapshot; returns violations."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from sam2consensus_tpu.observability.telemetry import \
+        lint_openmetrics
+
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return lint_openmetrics(fh.read())
+    except OSError as exc:
+        return [f"unreadable: {exc}"]
 
 
 def main(argv=None):

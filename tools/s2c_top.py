@@ -1,0 +1,188 @@
+#!/usr/bin/env python3
+"""s2c_top: live serve-fleet status for operators WITHOUT a Prometheus
+stack — a curses-free top(1) over the two files a telemetry-enabled
+server already writes:
+
+    python tools/s2c_top.py --health health.json --telemetry metrics.prom
+    python tools/s2c_top.py --health health.json --once       # one frame
+
+Polls the atomic health snapshot (``s2c serve --health-out``) and the
+OpenMetrics exposition (``--telemetry-out``) every ``--interval``
+seconds and renders: uptime, queue depth, the in-flight job + its age,
+heartbeat age (a GROWING age with an in-flight job is the
+wedged-dispatch signature), per-tenant ladder rung + SLO p50/p99
+end-to-end latency + violation burn, bad-record/poison tallies, drift
+events, and the last profiler capture.  Renders with plain ANSI
+clear-screen — works over ssh, in tmux, and in a CI log (``--once``).
+
+Both files are rewritten atomically by the server (one shared writer,
+``observability/telemetry.atomic_write_text``), so a read never sees a
+torn frame; a missing file renders as "waiting" rather than crashing —
+the poller may simply have started before the server.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def read_health(path):
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def read_telemetry(path):
+    """Exposition -> {(name, labelitems): value} sample map (None when
+    absent/torn — the renderer degrades to health-only)."""
+    from sam2consensus_tpu.observability.telemetry import \
+        parse_openmetrics
+
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        return parse_openmetrics(text)
+    except (OSError, ValueError):
+        return None
+
+
+def _sample(samples, name, **labels):
+    for s in samples or ():
+        if s["name"] != name:
+            continue
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s["value"]
+    return None
+
+
+def _tenants(samples):
+    out = set()
+    for s in samples or ():
+        t = s["labels"].get("tenant")
+        if t:
+            out.add(t)
+    return sorted(out)
+
+
+def _age_fmt(sec):
+    if sec is None:
+        return "-"
+    if sec < 120:
+        return f"{sec:.1f}s"
+    if sec < 7200:
+        return f"{sec / 60:.1f}m"
+    return f"{sec / 3600:.1f}h"
+
+
+def render(health, samples, now=None):
+    """One status frame as a list of lines (pure — pinned by tests)."""
+    lines = []
+    if health is None:
+        return ["s2c_top: waiting for health snapshot..."]
+    hb = health.get("last_heartbeat_age_sec")
+    inflight = health.get("in_flight")
+    lines.append(
+        f"s2c serve  up {_age_fmt(health.get('uptime_sec'))}  "
+        f"queue {health.get('queue_depth', 0)}  "
+        f"jobs {health.get('jobs', {}).get('run', 0)} "
+        f"({health.get('jobs', {}).get('failed', 0)} failed, "
+        f"{health.get('jobs', {}).get('watchdog_timeouts', 0)} timeouts)")
+    flag = ""
+    if inflight and hb is not None and hb > 5.0:
+        flag = "  << heartbeat aging: possible wedge"
+    lines.append(
+        f"in-flight: {inflight or '-'}"
+        + (f" (age {_age_fmt(health.get('in_flight_sec'))})"
+           if inflight else "")
+        + f"  heartbeat age {_age_fmt(hb)}{flag}")
+    adm = health.get("admission", {})
+    lines.append(
+        f"admission: {adm.get('admitted', 0)} admitted, "
+        f"{adm.get('rejected', 0)} rejected, "
+        f"{adm.get('pinned', 0)} pinned, "
+        f"{adm.get('poison', 0)} poison; "
+        f"bad records {health.get('bad_records', 0)}")
+    slo = health.get("slo") or {}
+    if slo:
+        lines.append(
+            f"slo: objectives {slo.get('objectives')}  "
+            f"violations {slo.get('violations', 0)}  "
+            f"burn {slo.get('burn_by_tenant')}")
+    # per-tenant table from the exposition (p50/p99 e2e + rung)
+    rungs = health.get("tenant_rungs", {})
+    tenants = _tenants(samples) or sorted(rungs) or []
+    if tenants:
+        lines.append(f"{'tenant':<14} {'rung':<10} {'e2e p50':>9} "
+                     f"{'e2e p99':>9} {'queue p99':>10} {'viol':>5}")
+        for t in tenants:
+            p50 = _sample(samples, "s2c_slo_phase_seconds", tenant=t,
+                          phase="e2e", quantile="0.5")
+            p99 = _sample(samples, "s2c_slo_phase_seconds", tenant=t,
+                          phase="e2e", quantile="0.99")
+            q99 = _sample(samples, "s2c_slo_phase_seconds", tenant=t,
+                          phase="queue_wait", quantile="0.99")
+            viol = sum(s["value"] for s in samples or ()
+                       if s["name"] == "s2c_slo_violations_total"
+                       and s["labels"].get("tenant") == t)
+            lines.append(
+                f"{t:<14} {rungs.get(t, 'device'):<10} "
+                f"{'-' if p50 is None else f'{p50:9.3f}'} "
+                f"{'-' if p99 is None else f'{p99:9.3f}'} "
+                f"{'-' if q99 is None else f'{q99:10.3f}'} "
+                f"{int(viol):>5}")
+    drift = _sample(samples, "s2c_drift_events_total")
+    if drift:
+        lines.append(f"drift events: {int(drift)} (see residual/* in "
+                     f"the job manifests)")
+    tel = health.get("telemetry") or {}
+    if tel.get("profile_captures"):
+        lines.append(f"profiler captures: {tel['profile_captures']} "
+                     f"(last: {tel.get('last_profile')})")
+    jr = health.get("journal")
+    if jr:
+        lines.append(f"journal: {jr}")
+    return lines
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--health", required=True,
+                   help="the server's --health-out path")
+    p.add_argument("--telemetry", default=None,
+                   help="the server's --telemetry-out exposition path "
+                        "(optional; adds per-tenant latency columns)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="poll period in seconds (default 2)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (CI logs, tests)")
+    args = p.parse_args(argv)
+
+    while True:
+        health = read_health(args.health)
+        samples = read_telemetry(args.telemetry) \
+            if args.telemetry else None
+        frame = render(health, samples)
+        if args.once:
+            print("\n".join(frame))
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H")     # clear + home, no curses
+        sys.stdout.write("\n".join(frame) + "\n")
+        sys.stdout.write(f"\n[{time.strftime('%H:%M:%S')}] "
+                         f"polling every {args.interval:g}s "
+                         f"(ctrl-c to quit)\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -219,4 +219,21 @@ run_step tolerant_overhead "campaign/tolerant_overhead_$R.json" \
   "campaign/tolerant_overhead_stderr_$R.log" 1200 \
   python tools/fuzz_ingest.py --overhead --out -
 
+# 10. serve telemetry plane (fleet observability evidence, ISSUE 10):
+# a journaled 8-job two-tenant queue with one job_hang-injected job,
+# run telemetry-off then telemetry-on — the artifact's scrape rows
+# show the exposition rewritten MID-HANG with growing heartbeat age
+# (format-linted per scrape, counters monotone across scrapes), the
+# summary row pins per-tenant e2e/queue_wait p50/p99 for both
+# tenants, slo/violations burned exactly for the hung tenant, an
+# on-demand profiler capture taken DURING the hang, and byte-identical
+# outputs across the two passes.  The .prom sibling is the citable
+# exposition snapshot (tools/check_perf_claims.py format-lints cited
+# .prom evidence).  CPU-fallback harness proof:
+# campaign/serve_telemetry_r06_cpufallback.jsonl
+run_step serve_telemetry "campaign/serve_telemetry_$R.jsonl" \
+  "campaign/serve_telemetry_stderr_$R.log" 1800 \
+  python tools/serve_telemetry.py --jobs 8 \
+  --prom-out "campaign/serve_telemetry_$R.prom"
+
 echo "$(date +%H:%M:%S) campaign complete" >> "$LOG"

@@ -1,7 +1,8 @@
 #!/usr/bin/env python3
 """One-shot trace triage: where did the time actually go?
 
-Usage: python tools/trace_summary.py <trace.json> [-n TOP] [--inclusive]
+Usage: python tools/trace_summary.py <trace.json> [-n TOP]
+                                     [--inclusive | --flame]
 
 Reads ``ph: "X"`` complete events from a Chrome/Perfetto trace-event
 JSON (the CLI's ``--trace-out`` artifact) and prints the top-N span
@@ -13,6 +14,14 @@ subtraction a nested tree double-bills every parent phase: the
 span, so the old inclusive top-N said "accumulate is 100%, dispatch is
 90%, slabs are 85%" of the same second.  ``--inclusive`` restores the
 raw widest-single-span ranking for when that's the question.
+
+``--flame`` emits collapsed-stack lines (``root;child;leaf N`` — N in
+integer microseconds of EXCLUSIVE self-time, from the same stack
+pass), the input format of Brendan Gregg's ``flamegraph.pl`` and of
+speedscope's "collapsed stacks" importer:
+
+    python tools/trace_summary.py trace.json --flame > out.collapsed
+    flamegraph.pl out.collapsed > flame.svg
 """
 
 import argparse
@@ -28,36 +37,62 @@ def load_events(path):
     return [e for e in events if e.get("ph") == "X"]
 
 
-def self_times(spans):
-    """Per-span exclusive duration: ``dur`` minus the summed ``dur`` of
-    DIRECT children (same tid, timestamp-contained).  Returns a list of
-    (event, self_us) in input order.
+def _stack_pass(spans):
+    """THE nesting reconstruction, shared by :func:`self_times` and
+    :func:`collapsed_stacks` so the two can never diverge: one stack
+    pass per thread over (ts, -dur)-sorted spans — when the next span
+    starts after the stack top ends, the top is closed; a span
+    starting inside the top is its direct child and bills its whole
+    duration to exactly that parent (grandparents already billed the
+    child's parent, so nothing double-subtracts).  Ties sort the
+    longer span first, so a child sharing its parent's start timestamp
+    nests under it instead of beside it.
 
-    One stack pass per thread over (ts, -dur)-sorted spans: when the
-    next span starts after the stack top ends, the top is closed; a
-    span starting inside the top is its direct child and bills its
-    whole duration to exactly that parent (grandparents already billed
-    the child's parent, so nothing double-subtracts).
+    Returns ``[(ancestor_path, event, child_dur_acc)]`` in per-thread
+    scan order; exclusive self-time is ``max(0, dur - acc[0])`` once
+    the pass completes.
     """
     by_tid = defaultdict(list)
     for e in spans:
         by_tid[e.get("tid", 0)].append(e)
-    out = []
+    records = []
     for tid_spans in by_tid.values():
-        # ties: the longer span first, so a child sharing its parent's
-        # start timestamp nests under it instead of beside it
         tid_spans.sort(key=lambda e: (e["ts"], -e["dur"]))
-        stack = []      # [(end_ts, child_dur_accum_list)]
+        stack = []      # [(end_ts, name, child_dur_accum_list)]
         for e in tid_spans:
             end = e["ts"] + e["dur"]
             while stack and e["ts"] >= stack[-1][0] - 1e-9:
                 stack.pop()
             if stack:
-                stack[-1][1][0] += e["dur"]
+                stack[-1][2][0] += e["dur"]
             acc = [0.0]
-            stack.append((end, acc))
-            out.append((e, acc))
-    return [(e, max(0.0, e["dur"] - acc[0])) for e, acc in out]
+            path = ";".join([s[1] for s in stack] + [e["name"]])
+            stack.append((end, e["name"], acc))
+            records.append((path, e, acc))
+    return records
+
+
+def self_times(spans):
+    """Per-span exclusive duration: ``dur`` minus the summed ``dur`` of
+    DIRECT children (same tid, timestamp-contained).  Returns a list of
+    (event, self_us)."""
+    return [(e, max(0.0, e["dur"] - acc[0]))
+            for _path, e, acc in _stack_pass(spans)]
+
+
+def collapsed_stacks(spans):
+    """Per-stack-path exclusive self-time: ``{"a;b;c": self_us}``.
+
+    Literally :func:`self_times`'s shared stack pass
+    (:func:`_stack_pass`) with the ancestor name chain kept — a leaf's
+    self-time bills to the full path, which is exactly what a
+    flamegraph renders.  Paths from different threads merge by name
+    chain (the per-phase story an operator wants; pass one tid's spans
+    to keep threads apart)."""
+    agg = defaultdict(float)
+    for path, e, acc in _stack_pass(spans):
+        agg[path] += max(0.0, e["dur"] - acc[0])
+    return dict(agg)
 
 
 def main(argv=None):
@@ -68,9 +103,23 @@ def main(argv=None):
     p.add_argument("--inclusive", action="store_true",
                    help="rank individual spans by raw (inclusive) "
                         "duration instead of aggregating self-time")
+    p.add_argument("--flame", action="store_true",
+                   help="emit collapsed-stack lines (path;to;span N, "
+                        "N = exclusive self-microseconds) for "
+                        "flamegraph.pl / speedscope instead of the "
+                        "top-N table")
     args = p.parse_args(argv)
 
     spans = load_events(args.trace)
+    if args.flame:
+        if not spans:
+            print("no complete spans in trace", file=sys.stderr)
+            return 1
+        for path, self_us in sorted(collapsed_stacks(spans).items()):
+            n = int(round(self_us))
+            if n > 0:
+                print(f"{path} {n}")
+        return 0
     if not spans:
         print("no complete spans in trace", file=sys.stderr)
         return 1
