@@ -32,7 +32,9 @@ Env knobs: BENCH_SCALE (read-count multiplier, default 1.0), BENCH_CONFIGS
 BENCH_READ_LEN / BENCH_CONTIG_LEN (headline workload, defaults 200000 /
 100 / 100 / 2000), BENCH_INIT_TIMEOUT (probe seconds, default 300),
 BENCH_INIT_RETRIES (default 2), BENCH_SERVE_JOBS (serve-leg batch size,
-default 8; 0 disables the leg), BENCH_FULL_OUT / BENCH_TAG (write the
+default 8; 0 disables the leg), BENCH_SERVE_BATCH_JOBS (continuous-
+batching leg: warm-serial vs warm-packed jobs/sec over one small-job
+queue, default 16; 0 disables), BENCH_FULL_OUT / BENCH_TAG (write the
 complete result object — every row, untruncated — to this path / to
 BENCH_<tag>.full.json, so downstream consumers stop recovering rows
 from head-truncated stdout captures).
@@ -597,6 +599,41 @@ def serve_leg(n_jobs):
     return row
 
 
+def serve_batch_leg(n_jobs):
+    """The continuous-batching row (PR-11 tentpole): the same small-job
+    queue through one warm runner serial vs packed
+    (sam2consensus_tpu/serve/scheduler.py).  ``jax_sec`` is the packed
+    per-job min and ``vs_baseline`` the warm-serial/warm-packed
+    jobs-per-sec ratio — directionally identical to every other row's
+    metrics, so the regression gate judges the batching series with
+    the same bands."""
+    from sam2consensus_tpu.serve.benchmark import run_serve_batch_bench
+
+    res = run_serve_batch_bench(n_jobs=n_jobs, log=log)
+    s = res["summary"]
+    row = {
+        "config": "serve_batch",
+        "jobs": s["n_jobs"],
+        "reads_per_job": s["n_reads"],
+        "jax_sec": round(s["warm_packed_min_sec"] / s["n_jobs"], 4),
+        "warm_serial_per_job_sec": round(
+            s["warm_serial_min_sec"] / s["n_jobs"], 4),
+        "vs_baseline": s["packed_vs_serial"],
+        "vs_baseline_kind": "warm_serial",
+        "identical": s["identical"],
+        "serve_batch": {
+            "packed_jobs_per_sec": s["warm_packed_jobs_per_sec"],
+            "serial_jobs_per_sec": s["warm_serial_jobs_per_sec"],
+            "batch": s.get("batch"),
+            "decision": s.get("decision"),
+        },
+    }
+    log(f"[serve_batch] serial {s['warm_serial_jobs_per_sec']} jobs/s "
+        f"vs packed {s['warm_packed_jobs_per_sec']} jobs/s = "
+        f"{s['packed_vs_serial']}x, identical={s['identical']}")
+    return row
+
+
 def full_artifact_path():
     """Destination for the complete (untruncated) result object:
     BENCH_FULL_OUT wins, else BENCH_TAG -> BENCH_<tag>.full.json next
@@ -661,6 +698,16 @@ def main():
             except Exception as exc:
                 log(f"[serve_warm] FAILED: {type(exc).__name__}: {exc}")
                 rows.append({"config": "serve_warm", "error": repr(exc)})
+        # continuous-batching leg: warm-serial vs warm-packed jobs/sec
+        # over one small-job queue, riding the same regression gate
+        n_batch = int(os.environ.get("BENCH_SERVE_BATCH_JOBS", "16"))
+        if n_batch > 0 and (not only or "serve_batch" in only):
+            try:
+                rows.append(serve_batch_leg(n_batch))
+            except Exception as exc:
+                log(f"[serve_batch] FAILED: {type(exc).__name__}: {exc}")
+                rows.append({"config": "serve_batch",
+                             "error": repr(exc)})
         result["configs"] = rows
 
         # the driver-recorded metric IS the north_star row: BASELINE.md

@@ -922,15 +922,56 @@ class JaxBackend:
         if ck is not None and "incremental_base" not in stats.extra:
             stats.extra["resumed_from_line"] = ck.lines_consumed
 
-        # Post-accumulation tail: ONE device round trip computing vote +
-        # insertion table + stats (moved to _tail_attempt; the original
-        # wire-cost rationale lives in its body).  The tail is a pure
-        # function of the accumulated counts, so the retry policy can
-        # recompute it whole on a transient device failure; a
-        # persistent failure demotes it host-side (resilience/ladder:
-        # emergency checkpoint first, then cpu-committed counts and the
-        # link-free tail), with injection suppressed on the demoted
-        # attempt -- the host rung is the ladder's bottom.
+        # Post-accumulation tail + render: shared with the serve batch
+        # scheduler's per-job extraction path (run_from_counts), so the
+        # packed and cold tails are ONE code path by construction.
+        fastas, acc = self._finish_consensus(
+            acc, cfg, layout, encoder, stats, use_sharded, policy,
+            ckpt_cb=_emergency_ckpt if cfg.checkpoint_dir else None)
+
+        if cfg.checkpoint_dir:
+            from ..utils import checkpoint as ckpt
+
+            if getattr(cfg, "incremental", False):
+                # incremental: the checkpoint IS the accumulated base for
+                # the next shard — persist the final state, and record this
+                # input as FULLY absorbed so a later rerun of it (even with
+                # other shards in between) adds nothing
+                done = list(prior_sources)
+                if source_id and source_id not in done:
+                    done.append(source_id)
+                self._write_checkpoint(cfg, records, acc, encoder, stats,
+                                       base_mapped, base_skipped, done,
+                                       max_row_width)
+            else:
+                # a completed run invalidates its checkpoint: remove it so
+                # a rerun starts from scratch, not replaying a finished job
+                p = ckpt.path_for(cfg.checkpoint_dir)
+                if os.path.exists(p):
+                    os.unlink(p)
+        return BackendResult(fastas=fastas, stats=stats)
+
+    # -- shared tail + render (cold run AND packed extraction) -------------
+    def _finish_consensus(self, acc, cfg: RunConfig, layout, encoder,
+                          stats, use_sharded: bool, policy,
+                          ckpt_cb=None):
+        """Post-accumulation tail in ONE device round trip, then render;
+        returns ``(fastas, acc)`` (``acc`` may have been tail-demoted).
+
+        The tail is a pure function of the accumulated counts, so the
+        retry policy can recompute it whole on a transient device
+        failure; a persistent failure demotes it host-side
+        (resilience/ladder: emergency checkpoint via ``ckpt_cb`` first,
+        then cpu-committed counts and the link-free tail), with
+        injection suppressed on the demoted attempt — the host rung is
+        the ladder's bottom.  Shared by ``_run`` and
+        :meth:`run_from_counts` (the serve batch scheduler's per-job
+        extraction), so a packed job's consensus is byte-identical to a
+        cold run's by construction, not by parallel maintenance."""
+        from ..resilience import ladder as rladder
+
+        tr = obs.tracer()
+        reg = obs.metrics()
         demoted_tail = False
         while True:
             try:
@@ -950,9 +991,7 @@ class JaxBackend:
                         or policy.on_error != "fallback"):
                     raise
                 acc = rladder.demote_tail_and_record(
-                    acc, layout.total_len, exc,
-                    checkpoint_cb=_emergency_ckpt
-                    if cfg.checkpoint_dir else None)
+                    acc, layout.total_len, exc, checkpoint_cb=ckpt_cb)
                 use_sharded = False
                 demoted_tail = True
         # wire accounting (bench utilization rows): bytes shipped up during
@@ -983,27 +1022,144 @@ class JaxBackend:
             fastas = self._assemble(layout, syms, contig_sums, ins,
                                     ins_syms, site_cov, cfg, stats)
         reg.add("phase/render_sec", time.perf_counter() - t0)
+        return fastas, acc
 
-        if cfg.checkpoint_dir:
-            from ..utils import checkpoint as ckpt
+    # -- packed-batch extraction (serve/scheduler.py) ----------------------
+    def run_from_counts(self, contigs: List[Contig], cfg: RunConfig,
+                        counts, insertions=None, n_reads: int = 0,
+                        n_skipped: int = 0,
+                        aligned_bases: int = 0) -> BackendResult:
+        """Consensus from an externally accumulated count partition.
 
-            if getattr(cfg, "incremental", False):
-                # incremental: the checkpoint IS the accumulated base for
-                # the next shard — persist the final state, and record this
-                # input as FULLY absorbed so a later rerun of it (even with
-                # other shards in between) adds nothing
-                done = list(prior_sources)
-                if source_id and source_id not in done:
-                    done.append(source_id)
-                self._write_checkpoint(cfg, records, acc, encoder, stats,
-                                       base_mapped, base_skipped, done,
-                                       max_row_width)
-            else:
-                # a completed run invalidates its checkpoint: remove it so
-                # a rerun starts from scratch, not replaying a finished job
-                p = ckpt.path_for(cfg.checkpoint_dir)
-                if os.path.exists(p):
-                    os.unlink(p)
+        The serve batch scheduler packs N small jobs' segment rows into
+        one shared count tensor (serve/packing.py — pileup addition
+        commutes, so each job's extracted slice is bit-for-bit the
+        tensor its own accumulation would have produced) and then calls
+        this per job: the SAME tail + render path a cold run takes
+        (:meth:`_finish_consensus`), over a
+        :class:`~..ops.pileup.HostPileupAccumulator` seeded with the
+        partition, so per-job byte identity is structural.  ``counts``
+        is the job's ``[total_len, 6]`` int32 partition; ``insertions``
+        the job's own :class:`~..encoder.events.InsertionEvents` (never
+        packed — insertion keys are (contig, local) and stay per-job).
+
+        Run lifecycle mirrors :meth:`run`: fresh (or serve-prepared)
+        instruments, fault-injector configuration, decision finalize,
+        ``stats.extra`` compat view — so a packed job's manifest and
+        metrics look exactly like any other job's.  ``checkpoint_dir``
+        is deliberately ignored: a packed member's replay unit is the
+        whole (small) job, journaled at the serve layer."""
+        from ..resilience import faultinject
+
+        prepared = getattr(self, "serve_prepared_obs", None)
+        if prepared is not None:
+            self.serve_prepared_obs = None
+        robs = obs.start_run(
+            trace_out=getattr(cfg, "trace_out", None),
+            metrics_out=getattr(cfg, "metrics_out", None),
+            config=cfg, prepared=prepared)
+        faultinject.configure(getattr(cfg, "fault_inject", "") or None)
+        try:
+            result = self._run_from_counts(contigs, cfg, counts,
+                                           insertions, n_reads,
+                                           n_skipped, aligned_bases)
+            obs.finalize_decisions()
+            obs.publish_stats_extra(result.stats.extra)
+            return result
+        finally:
+            faultinject.configure("")
+            obs.finish_run(robs, meta={"backend": self.name,
+                                       "mode": "packed"})
+
+    def assemble_partition(self, contigs: List[Contig], cfg: RunConfig,
+                           syms, contig_sums, ins, ins_syms, site_cov,
+                           n_reads: int = 0, n_skipped: int = 0,
+                           aligned_bases: int = 0) -> BackendResult:
+        """Render one packed member's slice of a SHARED tail.
+
+        The serve batch scheduler may run the post-accumulation tail
+        ONCE over the whole packed batch (the vote is per-position and
+        insertion sites are keyed (contig, local), so a member's slice
+        of the combined outputs is bit-for-bit what its own tail would
+        have produced — serve/scheduler.py documents the slicing); this
+        entry point is the member's render-only run: same instruments
+        lifecycle as any job (prepared-obs handoff, decision finalize,
+        stats compat view, manifest), with ``_assemble`` the one shared
+        render path."""
+        robs = obs.start_run(
+            trace_out=getattr(cfg, "trace_out", None),
+            metrics_out=getattr(cfg, "metrics_out", None),
+            config=cfg,
+            prepared=getattr(self, "serve_prepared_obs", None))
+        self.serve_prepared_obs = None
+        try:
+            from ..encoder.events import GenomeLayout
+
+            stats = BackendStats()
+            reg = obs.metrics()
+            tr = obs.tracer()
+            layout = GenomeLayout(contigs)
+            stats.reads_mapped = int(n_reads)
+            stats.reads_skipped = int(n_skipped)
+            stats.aligned_bases = int(aligned_bases)
+            reg.add("reads/mapped", int(n_reads))
+            reg.add("reads/skipped", int(n_skipped))
+            reg.add("pileup/cells", int(aligned_bases))
+            reg.gauge("dispatch/pileup").set_info(
+                {"path": "packed", "strategy": "shared_tail",
+                 "total_len": int(layout.total_len)})
+            stats.extra["decoder"] = "packed"
+            stats.extra["shards"] = 1
+            t0 = time.perf_counter()
+            with tr.span("render"):
+                fastas = self._assemble(layout, syms, contig_sums, ins,
+                                        ins_syms, site_cov, cfg, stats)
+            reg.add("phase/render_sec", time.perf_counter() - t0)
+            result = BackendResult(fastas=fastas, stats=stats)
+            obs.finalize_decisions()
+            obs.publish_stats_extra(result.stats.extra)
+            return result
+        finally:
+            obs.finish_run(robs, meta={"backend": self.name,
+                                       "mode": "packed"})
+
+    def _run_from_counts(self, contigs, cfg, counts, insertions,
+                         n_reads, n_skipped, aligned_bases
+                         ) -> BackendResult:
+        from ..encoder.events import GenomeLayout, InsertionEvents
+        from ..ops.pileup import HostPileupAccumulator
+        from ..resilience.policy import RetryPolicy
+
+        stats = BackendStats()
+        reg = obs.metrics()
+        layout = GenomeLayout(contigs)
+        if layout.total_len == 0:
+            return BackendResult(fastas={}, stats=stats)
+        stats.reads_mapped = int(n_reads)
+        stats.reads_skipped = int(n_skipped)
+        stats.aligned_bases = int(aligned_bases)
+        reg.add("reads/mapped", int(n_reads))
+        reg.add("reads/skipped", int(n_skipped))
+        reg.add("pileup/cells", int(aligned_bases))
+        acc = HostPileupAccumulator(layout.total_len)
+        acc.set_counts(counts)
+        reg.gauge("dispatch/pileup").set_info(
+            {"path": "packed", "strategy": "extracted",
+             "total_len": int(layout.total_len)})
+        stats.extra["decoder"] = "packed"
+        stats.extra["shards"] = 1
+
+        class _Carrier:
+            """Insertion-events holder standing in for the encoder the
+            tail reads (``_tail_attempt`` touches only ``.insertions``)."""
+
+        carrier = _Carrier()
+        carrier.insertions = insertions if insertions is not None \
+            else InsertionEvents()
+        policy = RetryPolicy.from_config(cfg)
+        fastas, acc = self._finish_consensus(
+            acc, cfg, layout, carrier, stats, use_sharded=False,
+            policy=policy)
         return BackendResult(fastas=fastas, stats=stats)
 
     # -- post-accumulation tail (resilient) --------------------------------

@@ -425,6 +425,26 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    action="store_false",
                    help="disable cross-job pipelining (job N+1's host "
                         "decode normally overlaps job N's device work)")
+    # --- continuous batching (sam2consensus_tpu/serve/scheduler.py) ---
+    p.add_argument("--batch", dest="batch", default="off",
+                   help="continuous batching: pack up to N eligible "
+                        "small jobs (--pileup auto/scatter, genome <= "
+                        "S2C_BATCH_MAX_MEMBER_LEN positions) into "
+                        "shared slabs riding ONE device dispatch "
+                        "sequence, with per-job count partitions "
+                        "extracted for byte-identical per-job outputs. "
+                        "off (default) | auto (tuned batch size, env "
+                        "S2C_BATCH_AUTO_JOBS) | N.  A tenant burning "
+                        "its --slo objective flushes the filling batch "
+                        "immediately (latency over occupancy); any "
+                        "fault inside a packed phase demotes only that "
+                        "batch back to the serial path")
+    p.add_argument("--batch-window", dest="batch_window", type=float,
+                   default=None,
+                   help="max milliseconds a filling batch waits for "
+                        "more eligible jobs before flushing (default "
+                        "50; live-arrival queues only — a pre-planned "
+                        "queue arrives at once)")
     # --- survivability (sam2consensus_tpu/serve/{journal,health,admission}) ---
     p.add_argument("--journal", dest="journal", default=None,
                    help="crash-safe job journal directory: every job's "
@@ -566,6 +586,12 @@ def serve_main(argv: List[str]) -> int:
         parse_slo(args.slo)
     except ValueError as exc:
         raise SystemExit(f"error: {exc}") from None
+    from .serve.scheduler import parse_batch_mode
+
+    try:
+        parse_batch_mode(args.batch)
+    except ValueError as exc:
+        raise SystemExit(f"error: {exc}") from None
     if args.fault_inject:
         from .resilience.faultinject import parse_spec
 
@@ -613,7 +639,9 @@ def serve_main(argv: List[str]) -> int:
                          telemetry_port=args.telemetry_port,
                          telemetry_interval=args.telemetry_interval,
                          slo=args.slo,
-                         profile_capture_dir=args.profile_capture_dir)
+                         profile_capture_dir=args.profile_capture_dir,
+                         batch=args.batch,
+                         batch_window=args.batch_window)
     echo(f"\nServing {len(specs)} job(s) on one warm backend"
          + (f" (jit cache: {runner.cache_dir})" if runner.cache_dir
             else "")

@@ -267,6 +267,22 @@ _HELP = {
     "s2c_telemetry_write_failed_total": "Exposition/health writes that "
                                         "failed (telemetry degrades, "
                                         "jobs never fail).",
+    # continuous batching (serve/scheduler.py): the s2c_batch_* family
+    "s2c_batch_batches_total": "Packed batches executed (continuous "
+                               "batching, --batch).",
+    "s2c_batch_packed_jobs_total": "Jobs that rode a packed batch's "
+                                   "shared dispatch.",
+    "s2c_batch_demotions_total": "Batches demoted whole to the serial "
+                                 "path (fault inside a packed phase).",
+    "s2c_batch_tail_demotions_total": "Shared-tail failures demoted to "
+                                      "per-member extraction tails.",
+    "s2c_batch_pack_sec_total": "Cumulative non-dispatch shared-phase "
+                                "seconds (merge/extract/fetch).",
+    "s2c_batch_size": "Members in the most recent packed batch.",
+    "s2c_batch_occupancy_pct": "Real rows / padded rows of the last "
+                               "batch's merged slabs, percent.",
+    "s2c_batch_jobs_per_sec": "Last batch's shared-phase throughput "
+                              "(members / shared wall).",
 }
 
 

@@ -66,13 +66,26 @@ fold into, per-tenant per-phase SLO histograms + burn counters
 capture (SIGUSR2 / ``capture_profile`` touch-file), and correlated
 JSON logs (``--log-format json``).  All best-effort: telemetry never
 fails a job.
+
+Continuous batching (:mod:`.scheduler` + :mod:`.packing`,
+``--batch {off,auto,N}`` / ``--batch-window``): the admission queue's
+eligible small jobs are packed into shared canonical slabs so N jobs
+ride one device dispatch sequence, with per-job count partitions
+extracted for byte-identical per-job consensus, per-job
+observability/journal/SLO scoping intact, and any fault inside a
+packed phase demoting only that batch back to the serial path.
 """
 
 from .admission import AdmissionController
 from .health import snapshot as health_snapshot
 from .journal import JobJournal, job_key
+from .packing import (PackPlan, extract_counts, extract_member,
+                      merge_batches, plan_pack)
 from .runner import JobResult, JobSpec, ServeRunner, submit_jobs
+from .scheduler import BatchScheduler, parse_batch_mode
 
 __all__ = ["JobSpec", "JobResult", "ServeRunner", "submit_jobs",
            "JobJournal", "job_key", "AdmissionController",
-           "health_snapshot"]
+           "health_snapshot", "BatchScheduler", "parse_batch_mode",
+           "PackPlan", "plan_pack", "merge_batches", "extract_counts",
+           "extract_member"]

@@ -64,6 +64,151 @@ def _cold_cmd(path: str, outdir: str, pileup: str) -> list:
             "--pileup", pileup, "--quiet"]
 
 
+def run_serve_batch_bench(n_jobs: int = 16, n_reads: int = 256,
+                          contig_len: int = 5386, read_len: int = 150,
+                          pileup: str = "scatter", passes: int = 5,
+                          cold: bool = False, cold_timeout: int = 600,
+                          log: Optional[Callable] = None) -> dict:
+    """Continuous-batching benchmark: warm-SERIAL vs warm-PACKED jobs/sec
+    over the same small-job queue (optionally plus the cold-process
+    floor), byte-compared per job.
+
+    The job class is the batching sweet spot the tentpole targets: many
+    SMALL jobs (amplicon-scale reference, shallow coverage) where the
+    per-job device-path machinery — per-job accumulator + dispatch
+    sequence + tail + prefetch threads — dominates the actual counting
+    work, so packing N jobs into shared slabs with one shared
+    dispatch+tail amortizes it.  Both warm sides run one warmup pass
+    then ``passes`` measured passes, scoring MIN wall per side
+    (alternating, the tolerant_overhead discipline — noisy-neighbor
+    spikes poison means, not mins).  Outputs are compared packed vs
+    serial (and vs cold when enabled) before anything is timed.
+    """
+    import statistics as _st
+
+    from ..config import RunConfig, default_prefix
+    from ..io.fasta import render_file
+    from .runner import JobSpec, ServeRunner
+
+    log = log or (lambda *a: None)
+    rows = []
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = _simulate_jobs(tmp, n_jobs, n_reads, contig_len,
+                               read_len, gzip_last=False)
+
+        def specs():
+            return [JobSpec(filename=p,
+                            config=RunConfig(backend="jax",
+                                             pileup=pileup,
+                                             prefix=default_prefix(p)),
+                            job_id=f"sb{k}")
+                    for k, p in enumerate(paths)]
+
+        def rendered(res):
+            return {n: render_file(r, 0) for n, r in res.fastas.items()}
+
+        cold_secs = []
+        cold_out = {}
+        if cold:
+            env = dict(os.environ)
+            env["PYTHONPATH"] = REPO + os.pathsep \
+                + env.get("PYTHONPATH", "")
+            env["S2C_JIT_CACHE"] = ""
+            for k, path in enumerate(paths):
+                outdir = os.path.join(tmp, f"cold{k}")
+                os.makedirs(outdir)
+                t0 = time.perf_counter()
+                r = subprocess.run(_cold_cmd(path, outdir, pileup),
+                                   capture_output=True, text=True,
+                                   timeout=cold_timeout, env=env,
+                                   cwd=REPO)
+                dt = time.perf_counter() - t0
+                rows.append({"mode": "cold", "job": k,
+                             "sec": round(dt, 3), "rc": r.returncode})
+                if r.returncode == 0:
+                    cold_secs.append(dt)
+                    outs = {}
+                    for f in sorted(os.listdir(outdir)):
+                        with open(os.path.join(outdir, f)) as fh:
+                            outs[f] = fh.read()
+                    cold_out[k] = outs
+        # both warm sides: persistent cache off (round-comparable, the
+        # serve_bench discipline), prewarm off (nothing to hide behind
+        # on repeated passes)
+        r_serial = ServeRunner(prewarm="off", persistent_cache=False,
+                               batch="off")
+        r_packed = ServeRunner(prewarm="off", persistent_cache=False,
+                               batch=str(n_jobs))
+        try:
+            res_s = r_serial.submit_jobs(specs())     # warmup + bytes
+            res_p = r_packed.submit_jobs(specs())
+            identical = []
+            for k, (a, b) in enumerate(zip(res_p, res_s)):
+                same = a.ok and b.ok and rendered(a) == rendered(b)
+                if same and cold and k in cold_out:
+                    warm_files = {
+                        ref + "__" + default_prefix(paths[k])
+                        + ".fasta": render_file(recs, 0)
+                        for ref, recs in a.fastas.items()}
+                    same = warm_files == cold_out[k]
+                identical.append(same)
+            t_serial, t_packed = [], []
+            for _ in range(max(1, passes)):          # alternating
+                t0 = time.perf_counter()
+                r_packed.submit_jobs(specs())
+                t_packed.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                r_serial.submit_jobs(specs())
+                t_serial.append(time.perf_counter() - t0)
+            # the measured-pass batch decision (prediction residual):
+            # from the LAST packed pass's first member manifest
+            last = r_packed.submit_jobs(specs())
+            decision = None
+            for res in last:
+                man = res.manifest or {}
+                for d in man.get("decisions", []):
+                    if d.get("decision") == "serve_batch":
+                        decision = d
+                        break
+                if decision:
+                    break
+            snap = r_packed.registry.snapshot()
+            binfo = snap["gauges"].get("serve/batch", {}).get("info", {})
+        finally:
+            r_serial.close()
+            r_packed.close()
+        for i, (tp, ts) in enumerate(zip(t_packed, t_serial)):
+            rows.append({"mode": "warm_pass", "i": i,
+                         "packed_sec": round(tp, 4),
+                         "serial_sec": round(ts, 4)})
+        serial_min = min(t_serial)
+        packed_min = min(t_packed)
+        summary = {
+            "summary": True,
+            "n_jobs": n_jobs, "n_reads": n_reads,
+            "contig_len": contig_len, "read_len": read_len,
+            "pileup": pileup, "passes": passes,
+            "warm_serial_min_sec": round(serial_min, 4),
+            "warm_packed_min_sec": round(packed_min, 4),
+            "warm_serial_jobs_per_sec": round(n_jobs / serial_min, 2),
+            "warm_packed_jobs_per_sec": round(n_jobs / packed_min, 2),
+            "packed_vs_serial": round(serial_min / packed_min, 2),
+            "warm_serial_median_sec": round(_st.median(t_serial), 4),
+            "warm_packed_median_sec": round(_st.median(t_packed), 4),
+            "identical": bool(identical) and all(identical),
+            "cold_per_job_sec": round(_st.mean(cold_secs), 3)
+            if cold_secs else None,
+            "batch": binfo,
+            "decision": decision,
+        }
+        log(f"[serve_batch] warm-serial {summary['warm_serial_jobs_per_sec']}"
+            f" jobs/s vs warm-packed "
+            f"{summary['warm_packed_jobs_per_sec']} jobs/s = "
+            f"{summary['packed_vs_serial']}x, identical="
+            f"{summary['identical']}")
+    return {"rows": rows, "summary": summary}
+
+
 def run_serve_bench(n_jobs: int = 8, n_reads: int = 5000,
                     contig_len: int = 5386, read_len: int = 100,
                     pileup: str = "scatter", gzip_last: bool = True,

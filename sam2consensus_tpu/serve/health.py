@@ -107,6 +107,25 @@ def snapshot(runner) -> dict:
     # fleet telemetry (observability/telemetry.py): the SLO burn and
     # the telemetry plane's own health, so a prober without a
     # Prometheus stack still sees objective breaches
+    # continuous batching (serve/scheduler.py): current policy + the
+    # last batch's shape, so an operator (or tools/s2c_top.py) sees the
+    # packing state without a Prometheus stack
+    sched = getattr(runner, "scheduler", None)
+    if sched is not None and sched.enabled:
+        g = reg.snapshot()["gauges"]
+        snap["batch"] = {
+            "mode": sched.mode,
+            "max_jobs": sched.max_jobs,
+            "window_ms": sched.window_ms,
+            "batches": int(reg.value("batch/batches")),
+            "packed_jobs": int(reg.value("batch/packed_jobs")),
+            "demotions": int(reg.value("batch/demotions")),
+            "last_size": int(g.get("batch/size", {}).get("value", 0)),
+            "last_occupancy_pct": g.get("batch/occupancy_pct",
+                                        {}).get("value", 0.0),
+            "last_jobs_per_sec": g.get("batch/jobs_per_sec",
+                                       {}).get("value", 0.0),
+        }
     slo_obj = getattr(runner, "slo", None)
     if slo_obj or reg.value("slo/violations"):
         snap["slo"] = {

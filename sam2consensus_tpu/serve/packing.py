@@ -1,0 +1,189 @@
+"""Cross-job slab packing: N small jobs' rows in ONE shared dispatch.
+
+The pileup's entire job state is a flat ``[L, 6]`` count tensor and
+addition commutes (SURVEY.md §5), so packing is exact by construction:
+give each job a disjoint offset window inside one combined position
+axis, remap every segment row's flat start by its job's offset, and the
+combined tensor's slice ``[off_j, off_j + L_j)`` is bit-for-bit the
+count tensor job *j*'s own accumulation would have produced — whatever
+order, batching, or device kernel accumulated it.  That one invariant
+is what lets the serve scheduler (serve/scheduler.py) ride N queued
+small jobs through a single device dispatch sequence and still hand
+each job a byte-identical consensus: the per-job tail/render runs the
+SAME code path a cold run takes, just over the extracted partition.
+
+This module is the pure layer: offset planning, slab merging, count
+extraction, occupancy accounting.  No device work, no scheduling
+policy — both live with their owners (ops/pileup.py, serve/scheduler).
+
+Merged slabs stay on the CANONICAL shape grid (encoder bucket widths ×
+pow2 row paddings, floor 1024 — the exact family
+``ops.pileup.canonical_slab_shapes`` enumerates and the serve prewarm
+compiles), so a packed batch dispatches shapes the warm server has
+already compiled: packing changes how FULL the slabs are, never which
+programs run.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+from ..constants import PAD_CODE
+from ..encoder.events import SegmentBatch
+
+
+@dataclass
+class PackedMember:
+    """One job's slot in a pack plan.  Planned from the HEADER's genome
+    length alone (the scheduler probes headers at compose time), so the
+    offset table exists before any member decodes — decode and dispatch
+    can overlap in waves."""
+
+    job_id: str
+    total_len: int
+    offset: int = 0            # flat-position base inside the combined axis
+    n_events: int = 0          # countable cells this member contributed
+
+
+@dataclass
+class PackPlan:
+    """Disjoint offset windows over one combined position axis.
+
+    ``total_len`` is the combined genome length the shared accumulator
+    allocates; member *j* owns positions ``[offset_j, offset_j + L_j)``.
+    """
+
+    members: List[PackedMember] = field(default_factory=list)
+    total_len: int = 0
+    # -- merge accounting (filled by merge_batches) -----------------------
+    real_rows: int = 0
+    padded_rows: int = 0
+    merged_slabs: int = 0
+
+    @property
+    def occupancy(self) -> float:
+        """Real rows / padded rows of the merged slabs (1.0 = no pad)."""
+        return (self.real_rows / self.padded_rows) if self.padded_rows \
+            else 0.0
+
+
+def plan_pack(members: Sequence[Tuple[str, int]]) -> PackPlan:
+    """Assign each ``(job_id, total_len)`` a disjoint offset window."""
+    plan = PackPlan()
+    off = 0
+    for job_id, total_len in members:
+        plan.members.append(PackedMember(job_id=job_id,
+                                         total_len=int(total_len),
+                                         offset=off))
+        off += int(total_len)
+    plan.total_len = off
+    return plan
+
+
+def _real_rows(starts: np.ndarray, codes: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Drop all-PAD rows (the pow2 pad tail, plus any genuinely empty
+    encoded row — both contribute zero counts).  Vectorized: one
+    first-cell prefilter catches the contiguous pad tail cheaply, the
+    full-row scan runs only over the candidates."""
+    first = codes[:, 0] == PAD_CODE
+    if not first.any():
+        return starts, codes
+    keep = ~(codes == PAD_CODE).all(axis=1)
+    return starts[keep], codes[keep]
+
+
+def _pad_rows(n: int) -> int:
+    """Merged-slab row padding: pow2 (floor 8).  The accumulator's
+    pad-tail trim re-rounds to pow2 of the REAL rows before dispatching
+    anyway (ops/pileup.py ``add``), so the dispatch shapes stay on the
+    same canonical grid the prewarm compiles — this pad only squares
+    the host array."""
+    return 1 << max(3, (max(1, n) - 1).bit_length())
+
+
+def merge_batches(plan: PackPlan,
+                  pairs: Sequence[Tuple[PackedMember,
+                                        List[SegmentBatch]]],
+                  max_cells: int = 1 << 24) -> List[SegmentBatch]:
+    """Remap + merge members' decoded batches into shared slabs.
+
+    ``pairs`` is any subset of the plan's members with their decoded
+    batches — the scheduler merges in WAVES (whichever members have
+    finished decoding) so dispatch overlaps the remaining decodes.  Per
+    bucket width, each member's rows are compacted to real rows, their
+    flat starts shifted by the member's offset, concatenated across the
+    wave, and re-padded pow2; buckets whose merged row count would
+    exceed ``max_cells / width`` split into several slabs (the same
+    cell-budget discipline as ``ops.pileup.iter_row_slices``, applied
+    at build time so a merged batch cannot pin unbounded host memory).
+
+    Pileup addition commutes, so the merge is byte-exact: the combined
+    tensor's member slices equal each member's own accumulation.
+    Occupancy (real/padded rows) accumulates into ``plan``.
+    """
+    by_w: Dict[int, Tuple[List[np.ndarray], List[np.ndarray]]] = {}
+    for member, batches in pairs:
+        member_events = 0
+        for batch in batches:
+            if batch.accumulated or not batch.buckets:
+                continue
+            for w, (starts, codes) in batch.buckets.items():
+                starts, codes = _real_rows(np.asarray(starts),
+                                           np.asarray(codes))
+                if not len(starts):
+                    continue
+                slist, clist = by_w.setdefault(w, ([], []))
+                slist.append(starts.astype(np.int32)
+                             + np.int32(member.offset))
+                clist.append(codes)
+            member_events += batch.n_events
+        member.n_events = member_events
+
+    merged: List[SegmentBatch] = []
+    for w in sorted(by_w):
+        slist, clist = by_w[w]
+        starts = np.concatenate(slist) if len(slist) > 1 else slist[0]
+        codes = np.concatenate(clist) if len(clist) > 1 else clist[0]
+        step = max(1024, (max_cells // int(w)) // 1024 * 1024)
+        for lo in range(0, len(starts), step):
+            s = starts[lo:lo + step]
+            c = codes[lo:lo + step]
+            n = len(s)
+            n_pad = _pad_rows(n)
+            st = np.zeros(n_pad, dtype=np.int32)
+            st[:n] = s
+            mat = np.full((n_pad, int(w)), PAD_CODE, dtype=np.uint8)
+            mat[:n] = c
+            nev = int(n * w - int((c == PAD_CODE).sum()))
+            merged.append(SegmentBatch(buckets={int(w): (st, mat)},
+                                       n_events=nev))
+            plan.real_rows += n
+            plan.padded_rows += n_pad
+            plan.merged_slabs += 1
+    return merged
+
+
+def extract_counts(plan: PackPlan, combined_counts: np.ndarray
+                   ) -> List[np.ndarray]:
+    """Slice each member's private count partition out of the combined
+    tensor (ONE host fetch upstream, N views here).  Copies: a member's
+    tail may narrow/re-upload its partition independently, and the
+    combined buffer must stay immutable until every member extracted —
+    the count-bank discipline (partitions merged/handed out only after
+    the whole dispatch succeeded)."""
+    return [extract_member(combined_counts, m) for m in plan.members]
+
+
+def extract_member(combined_counts: np.ndarray, member: PackedMember
+                   ) -> np.ndarray:
+    """One member's private partition (a copy — the combined buffer
+    stays immutable until every member extracted).  The ONE slicing
+    definition, shared by :func:`extract_counts` and the scheduler's
+    lazy per-member fallback path."""
+    lo = member.offset
+    return np.ascontiguousarray(
+        combined_counts[lo:lo + member.total_len])

@@ -308,6 +308,12 @@ class ServeRunner:
     runner-scope injector spec for the serve-level sites
     (serve_decode_ahead / journal_write; env S2C_FAULT_INJECT when
     empty).
+
+    Continuous batching (``batch``/``batch_window``, default off —
+    serve/scheduler.py): eligible small jobs are packed into shared
+    slabs riding one dispatch sequence, with per-job count partitions
+    extracted for byte-identical per-job consensus; SLO-burning
+    tenants flush the filling batch immediately.
     """
 
     def __init__(self, prewarm: str = "auto", decode_ahead: bool = True,
@@ -323,7 +329,8 @@ class ServeRunner:
                  telemetry_port: Optional[int] = None,
                  telemetry_interval: Optional[float] = None,
                  slo=None,
-                 profile_capture_dir: Optional[str] = None):
+                 profile_capture_dir: Optional[str] = None,
+                 batch="off", batch_window: Optional[float] = None):
         from ..backends.jax_backend import JaxBackend
 
         if prewarm not in ("auto", "off"):
@@ -352,6 +359,13 @@ class ServeRunner:
             else _env_float("S2C_STALL_TIMEOUT")
         self.admission = AdmissionController(max_queue=max_queue,
                                              tenant_quota=tenant_quota)
+        # -- continuous batching (serve/scheduler.py) -----------------
+        # a typo'd --batch must fail the server start, same discipline
+        # as --slo / --fault-inject
+        from .scheduler import BatchScheduler
+
+        self.scheduler = BatchScheduler(self, batch=batch,
+                                        window_ms=batch_window)
         self.health = shealth.HealthState()
         #: last finished job's tolerant-decode verdict, surfaced in the
         #: health snapshot (per-job history lives in each JobResult)
@@ -891,6 +905,18 @@ class ServeRunner:
                 plan.append(entry)
                 continue
             cfg = spec.config
+            if getattr(cfg, "on_bad_record", "fail") == "quarantine" \
+                    and not getattr(cfg, "quarantine_out", None):
+                # default sidecar naming keyed on the job's UNIQUE
+                # server-lifetime number, not on outfolder+prefix: two
+                # jobs over the same upload — serial OR packed into one
+                # batch (concurrent commit) — must never clobber each
+                # other's evidence files.  An explicit --quarantine-out
+                # wins untouched (the CLI already stamps its own .jobN).
+                cfg = dataclasses.replace(cfg, quarantine_out=os.path.join(
+                    cfg.outfolder or "./",
+                    f"{cfg.prefix or 'quarantine'}_quarantine"
+                    f".job{jobnum}.jsonl"))
             if self.journal is not None:
                 cfg = dataclasses.replace(
                     cfg, checkpoint_dir=self.journal.ckpt_dir(key))
@@ -949,12 +975,50 @@ class ServeRunner:
         window_t0 = time.perf_counter()
         self.telemetry_tick(force=True)
 
+        # -- continuous batching (serve/scheduler.py): compose packed
+        #    batches over the eligible small jobs up front; the loop
+        #    below executes each batch when it reaches the batch's
+        #    first member and routes demoted members back through the
+        #    untouched serial path
+        batch_results: dict = {}
+        batch_by_first: dict = {}
+        batched: set = set()
+        if self.scheduler.enabled:
+            for b in self.scheduler.compose(plan):
+                batch_by_first[b.indices[0]] = b
+                batched.update(b.indices)
+            # entries probed but not packed must not leak their probe
+            # handles (the packed ones are consumed by the decode phase)
+            for j, e in enumerate(plan):
+                if j not in batched:
+                    ai = e.pop("batch_handle", None)
+                    if ai is not None:
+                        ai.close()
+            if batched:
+                logger.info("continuous batching: %d job(s) in %d "
+                            "batch(es)", len(batched),
+                            len(batch_by_first))
+
         results: List[JobResult] = []
         ahead: Optional[_DecodeAhead] = None
         ahead_for: Optional[int] = None
         cap = _ahead_batch_cap()
         first_run_seen = False
         for i, entry in enumerate(plan):
+            if i in batch_results:
+                results.append(batch_results.pop(i))
+                continue
+            b = batch_by_first.pop(i, None)
+            if b is not None:
+                done, leftovers = self.scheduler.run_batch(
+                    b, plan, window_t0)
+                batch_results.update(done)
+                for k in leftovers:
+                    batched.discard(k)  # serial re-run when reached
+                if i in batch_results:
+                    results.append(batch_results.pop(i))
+                    continue
+                # i itself demoted: fall through to the serial path
             spec = entry["spec"]
             job_id = entry["job_id"]
             cfg = entry["cfg"]
@@ -1031,11 +1095,15 @@ class ServeRunner:
                                               "S2C_METRICS_OUT", jobnum),
                     config=cfg)
                 try:
-                    ai = open_alignment_input(
-                        spec.filename,
-                        getattr(cfg, "input_format", "auto"),
-                        binary=True,
-                        threads=resolve_decode_threads(cfg))
+                    # a batch demotion may have left this entry's probe
+                    # handle open (header already parsed): resume from it
+                    ai = entry.pop("batch_handle", None)
+                    if ai is None:
+                        ai = open_alignment_input(
+                            spec.filename,
+                            getattr(cfg, "input_format", "auto"),
+                            binary=True,
+                            threads=resolve_decode_threads(cfg))
                     close_handle = ai.close
                     contigs, records = ai.contigs, ai.stream
                 except Exception as exc:
@@ -1050,7 +1118,7 @@ class ServeRunner:
             # -- launch the NEXT runnable job's decode-ahead -----------
             if self.decode_ahead:
                 for k in range(i + 1, len(plan)):
-                    if plan[k]["action"] == "run":
+                    if plan[k]["action"] == "run" and k not in batched:
                         nxt = plan[k]
                         ahead = _DecodeAhead(
                             self.backend, JobSpec(
@@ -1122,75 +1190,9 @@ class ServeRunner:
                     res.fastas, res.stats = out.fastas, out.stats
                     res.error = None
             res.elapsed_sec = time.perf_counter() - t0
-            snap = robs.registry.snapshot()
-            res.metrics = {
-                k: v for k, v in snap["counters"].items()
-                if k.startswith(("serve/", "compile/", "resilience/",
-                                 "fault/", "phase/", "ingest/",
-                                 "quarantine/"))}
-            res.bad_records = int(
-                snap["counters"].get("ingest/bad_records", 0))
-            res.quarantined = int(
-                snap["counters"].get("quarantine/records", 0))
-            if res.bad_records:
-                # fleet-level aggregation for the health snapshot (the
-                # per-job numbers live in each job's own registry)
-                self.registry.add("serve/bad_records", res.bad_records)
-            res.rungs = rladder.job_rungs(snap)
-            res.manifest = obs.last_manifest() if res.ok else None
-            # -- commit: outputs durably on disk, then the journal -----
-            if res.ok and res.fastas is not None \
-                    and self.journal is not None:
-                try:
-                    res.output_paths = write_outputs(
-                        res.fastas, cfg.outfolder, cfg.prefix,
-                        cfg.nchar, cfg.thresholds, echo=self.echo)
-                    fps = {p: sjournal.file_sha256(p)
-                           for p in res.output_paths}
-                except Exception as exc:
-                    # a commit-time write failure (disk full, bad
-                    # outfolder) fails THIS job, never the queue — the
-                    # server's survive-failed-jobs contract holds at
-                    # the commit boundary too
-                    res.error = (f"output commit failed: "
-                                 f"{type(exc).__name__}: {exc}")
-                    res.fastas = None
-                    res.output_paths = []
-                    logger.warning("job %s: %s", job_id, res.error)
-                else:
-                    self._journal_append(
-                        "committed", job=job_id, key=entry["key"],
-                        outputs=fps,
-                        elapsed_sec=round(res.elapsed_sec, 3))
-                    self.journal.drop_ckpt(entry["key"])
-            if not res.ok:
-                self._journal_append("failed", job=job_id,
-                                     key=entry["key"], error=res.error)
-            # fold the job's registry into the server-lifetime
-            # aggregate + per-tenant SLO verdict (never fails a job)
-            self._telemetry_job_end(robs, res, snap, spec.tenant,
-                                    queue_wait=t0 - window_t0)
+            self._finalize_job(entry, res, robs, spec,
+                               queue_wait=t0 - window_t0)
             results.append(res)
-            self.jobs_run += 1
-            self.registry.add("serve/jobs", 1)
-            if not res.ok:
-                self.registry.add("serve/jobs_failed", 1)
-            self.admission.note_result(
-                spec.tenant, res.rungs, res.ok,
-                was_pinned=bool(entry["admission"]
-                                and str(entry["admission"]).startswith(
-                                    "pinned")))
-            self.last_job_badrec = {
-                "job": job_id,
-                "bad_records": res.bad_records,
-                "quarantined": res.quarantined,
-                "budget_exhausted": res.budget_exhausted,
-            }
-            stele.set_log_context()     # job done: clear correlation
-            self.health.job_finished()
-            self.health.queue_depth = max(
-                0, self.health.queue_depth - 1)
-            self.telemetry_tick(force=True)
             # -- cross-job overlap: bill it to the job whose decode
             #    was hidden (N+1), before that job runs ---------------
             if ahead is not None:
@@ -1203,11 +1205,97 @@ class ServeRunner:
                     "decode_ahead_sec": round(ahead.decode_sec(), 4),
                     "overlapped_job": job_id})
                 self.registry.add("serve/overlap_sec", ov)
-            self.echo(f"[serve] {job_id}: "
-                      + (f"ok in {res.elapsed_sec:.2f}s"
-                         if res.ok else f"FAILED ({res.error})"))
+        self.scheduler.release_handles(plan)     # no probe-handle leaks
         self.telemetry_tick(force=True)
         return results
+
+    def _finalize_job(self, entry: dict, res: JobResult, robs,
+                      spec: JobSpec, queue_wait: float,
+                      echo_suffix: str = "") -> None:
+        """Everything after a job's run attempt, shared by the serial
+        loop and the batch scheduler (serve/scheduler.py) so the two
+        execution paths cannot drift: metrics subset + rung/manifest
+        capture, journal commit/failed events (outputs durably on disk
+        BEFORE the commit event), telemetry fold + per-tenant SLO
+        verdict, admission feedback, health bookkeeping, operator
+        echo."""
+        from ..io.fasta import write_outputs
+        from ..resilience import ladder as rladder
+
+        cfg = entry["cfg"]
+        job_id = entry["job_id"]
+        snap = robs.registry.snapshot()
+        res.metrics = {
+            k: v for k, v in snap["counters"].items()
+            if k.startswith(("serve/", "compile/", "resilience/",
+                             "fault/", "phase/", "ingest/",
+                             "quarantine/"))}
+        res.bad_records = int(
+            snap["counters"].get("ingest/bad_records", 0))
+        res.quarantined = int(
+            snap["counters"].get("quarantine/records", 0))
+        if res.bad_records:
+            # fleet-level aggregation for the health snapshot (the
+            # per-job numbers live in each job's own registry)
+            self.registry.add("serve/bad_records", res.bad_records)
+        res.rungs = rladder.job_rungs(snap)
+        res.manifest = obs.last_manifest() if res.ok else None
+        # -- commit: outputs durably on disk, then the journal -----
+        if res.ok and res.fastas is not None \
+                and self.journal is not None:
+            try:
+                res.output_paths = write_outputs(
+                    res.fastas, cfg.outfolder, cfg.prefix,
+                    cfg.nchar, cfg.thresholds, echo=self.echo)
+                fps = {p: sjournal.file_sha256(p)
+                       for p in res.output_paths}
+            except Exception as exc:
+                # a commit-time write failure (disk full, bad
+                # outfolder) fails THIS job, never the queue — the
+                # server's survive-failed-jobs contract holds at
+                # the commit boundary too
+                res.error = (f"output commit failed: "
+                             f"{type(exc).__name__}: {exc}")
+                res.fastas = None
+                res.output_paths = []
+                logger.warning("job %s: %s", job_id, res.error)
+            else:
+                self._journal_append(
+                    "committed", job=job_id, key=entry["key"],
+                    outputs=fps,
+                    elapsed_sec=round(res.elapsed_sec, 3))
+                self.journal.drop_ckpt(entry["key"])
+        if not res.ok:
+            self._journal_append("failed", job=job_id,
+                                 key=entry["key"], error=res.error)
+        # fold the job's registry into the server-lifetime
+        # aggregate + per-tenant SLO verdict (never fails a job)
+        self._telemetry_job_end(robs, res, snap, spec.tenant,
+                                queue_wait=queue_wait)
+        self.jobs_run += 1
+        self.registry.add("serve/jobs", 1)
+        if not res.ok:
+            self.registry.add("serve/jobs_failed", 1)
+        self.admission.note_result(
+            spec.tenant, res.rungs, res.ok,
+            was_pinned=bool(entry["admission"]
+                            and str(entry["admission"]).startswith(
+                                "pinned")))
+        self.last_job_badrec = {
+            "job": job_id,
+            "bad_records": res.bad_records,
+            "quarantined": res.quarantined,
+            "budget_exhausted": res.budget_exhausted,
+        }
+        stele.set_log_context()     # job done: clear correlation
+        self.health.job_finished()
+        self.health.queue_depth = max(
+            0, self.health.queue_depth - 1)
+        self.telemetry_tick(force=True)
+        self.echo(f"[serve] {job_id}: "
+                  + (f"ok in {res.elapsed_sec:.2f}s"
+                     if res.ok else f"FAILED ({res.error})")
+                  + echo_suffix)
 
     def _note_poison(self, spec: JobSpec, exc: BaseException,
                      res: JobResult) -> None:

@@ -1,0 +1,496 @@
+"""Continuous batching (serve/scheduler.py + serve/packing.py): the
+cross-job slab-packing pins.
+
+* packed-vs-serial byte identity across the small-job fixture families
+  (short/deep, multi-contig target-capture, gzip container, py2-compat,
+  mixed thresholds forcing the per-member extraction tail) at batch
+  sizes 1/4/8 — the tentpole's exactness claim;
+* a fault injected inside the packed dispatch demotes ONLY that batch
+  back to the serial path (co-tenants' outputs stay byte-identical,
+  ``batch/demotions`` counted);
+* SIGKILL mid-batch under a journal: the restarted queue replays only
+  uncommitted members, zero lost / zero duplicated, byte-identical;
+* a tenant burning its SLO objective flushes the filling batch
+  immediately (no ``--batch-window`` wait);
+* default quarantine sidecars stay unique under packed (concurrent-
+  commit) execution;
+* the ``s2c_batch_*`` exposition family renders lint-clean and the
+  batch policy decision lands in every packed job's manifest.
+"""
+
+import gzip
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.serve import JobSpec, journal as sjournal
+from sam2consensus_tpu.serve.scheduler import parse_batch_mode
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_cache(monkeypatch):
+    monkeypatch.setenv("S2C_JIT_CACHE", "")
+
+
+def _sim(tmp, name, seed, contig_len=3000, n_reads=600, n_contigs=1,
+         gz=False, **kw):
+    spec = SimSpec(n_contigs=n_contigs, contig_len=contig_len,
+                   n_reads=n_reads, read_len=100, contig_len_jitter=0.0,
+                   seed=seed, contig_prefix=f"bt{seed}", **kw)
+    path = os.path.join(str(tmp), name)
+    text = simulate(spec)
+    if gz:
+        with gzip.open(path, "wb") as fh:
+            fh.write(text.encode("ascii"))
+    else:
+        with open(path, "w") as fh:
+            fh.write(text)
+    return path
+
+
+def _runner(**kw):
+    from sam2consensus_tpu.serve import ServeRunner
+
+    kw.setdefault("prewarm", "off")
+    kw.setdefault("persistent_cache", False)
+    return ServeRunner(**kw)
+
+
+def _rendered(res):
+    return {n: render_file(r, 0) for n, r in res.fastas.items()}
+
+
+def _family_specs(tmp):
+    """The small-job fixture families, one queue: short/deep phix-class,
+    multi-contig target-capture class, a gzip container, a py2-compat
+    job, and a pair with different thresholds (tail-incompatible with
+    the rest, so the per-member extraction tail is exercised inside the
+    same batch run)."""
+    base = dict(backend="jax")
+    specs = []
+    for k, (name, seed, kw, cfg_kw) in enumerate([
+            ("phix0.sam", 11, {}, {}),
+            ("phix1.sam", 12, {"n_reads": 900}, {}),
+            ("cap0.sam", 13, {"n_contigs": 6, "contig_len": 700}, {}),
+            ("cap1.sam", 14, {"n_contigs": 4, "contig_len": 900}, {}),
+            ("gz0.sam.gz", 15, {"gz": True}, {}),
+            ("py2.sam", 16, {}, {"py2_compat": True, "maxdel": None}),
+            ("thr0.sam", 17, {}, {"thresholds": [0.25, 0.5]}),
+            ("thr1.sam", 18, {}, {"thresholds": [0.25, 0.5]}),
+    ]):
+        path = _sim(tmp, name, seed, **kw)
+        specs.append(JobSpec(filename=path,
+                             config=RunConfig(**base, **cfg_kw),
+                             job_id=f"fam{k}"))
+    return specs
+
+
+# -- policy parsing --------------------------------------------------------
+def test_parse_batch_mode():
+    assert parse_batch_mode("off") == ("off", 1)
+    assert parse_batch_mode(None) == ("off", 1)
+    assert parse_batch_mode("0") == ("off", 1)
+    assert parse_batch_mode("1") == ("off", 1)
+    assert parse_batch_mode("6") == ("fixed", 6)
+    mode, n = parse_batch_mode("auto")
+    assert mode == "auto" and n >= 2
+    with pytest.raises(ValueError):
+        parse_batch_mode("many")
+    with pytest.raises(ValueError):
+        parse_batch_mode("-3")
+
+
+def test_serve_cli_rejects_bad_batch():
+    from sam2consensus_tpu.cli import serve_main
+
+    with pytest.raises(SystemExit):
+        serve_main(["-i", "x.sam", "--batch", "bogus"])
+
+
+# -- the byte-identity matrix ----------------------------------------------
+@pytest.mark.parametrize("batch", ["1", "4", "8"])
+def test_packed_vs_serial_byte_identity_matrix(tmp_path, batch):
+    """Every fixture family through batch sizes 1/4/8 equals the serial
+    path byte-for-byte; packed jobs carry the serve_batch decision in
+    their manifest and the serve/batch counters in their metrics."""
+    specs = _family_specs(tmp_path)
+    rs = _runner(batch="off")
+    serial = rs.submit_jobs(specs)
+    rs.close()
+    rp = _runner(batch=batch)
+    packed = rp.submit_jobs(specs)
+    n_packed = rp.registry.value("batch/packed_jobs")
+    rp.close()
+    assert all(r.ok for r in serial), [r.error for r in serial]
+    assert all(r.ok for r in packed), [r.error for r in packed]
+    for a, b in zip(packed, serial):
+        assert _rendered(a) == _rendered(b), a.job_id
+    if batch == "1":
+        assert n_packed == 0                  # 1 == off
+        return
+    assert n_packed >= 2
+    for res in packed:
+        if not res.metrics.get("serve/batched"):
+            continue
+        assert res.metrics.get("serve/batch_jobs", 0) >= 2
+        assert res.metrics.get("serve/batch_wall_sec", 0) > 0
+        decisions = [d for d in (res.manifest or {}).get(
+            "decisions", []) if d.get("decision") == "serve_batch"]
+        assert decisions, f"{res.job_id}: no serve_batch decision"
+        d = decisions[0]
+        assert d["measured"].get("jobs_per_sec", 0) > 0
+        assert "occupancy" in d["inputs"]
+
+
+def test_packed_matches_independent_cold_runs(tmp_path):
+    """Packed outputs equal fresh cold-backend runs (not just the warm
+    serial path) — the scheduler cannot be 'consistently wrong'."""
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+    from sam2consensus_tpu.io.sam import ReadStream, opener, read_header
+
+    specs = _family_specs(tmp_path)[:4]
+    rp = _runner(batch="4")
+    packed = rp.submit_jobs(specs)
+    rp.close()
+    for spec, res in zip(specs, packed):
+        h = opener(spec.filename, binary=True)
+        contigs, _n, first = read_header(h)
+        cold = JaxBackend().run(contigs, ReadStream(h, first),
+                                spec.config)
+        h.close()
+        assert _rendered(res) == {
+            n: render_file(r, 0) for n, r in cold.fastas.items()}
+
+
+# -- resilience ------------------------------------------------------------
+def test_fault_in_packed_dispatch_demotes_batch_only(tmp_path):
+    """An injected device fault inside the packed dispatch discards the
+    shared tensor and re-runs every member through the serial path —
+    outputs byte-identical, co-members uncorrupted, demotion counted."""
+    paths = [_sim(tmp_path, f"f{i}.sam", 40 + i) for i in range(4)]
+
+    def specs(fault_first):
+        out = []
+        for k, p in enumerate(paths):
+            cfg = RunConfig(backend="jax")
+            if fault_first and k == 0:
+                # the scheduler configures the packed dispatch's
+                # injector from the FIRST member's spec; one counted
+                # rpc fault fires inside the shared dispatch
+                cfg = RunConfig(backend="jax",
+                                fault_inject="pileup_dispatch:rpc:0:1")
+            out.append(JobSpec(filename=p, config=cfg, job_id=f"f{k}"))
+        return out
+
+    rs = _runner(batch="off")
+    want = [_rendered(r) for r in rs.submit_jobs(specs(False))]
+    rs.close()
+    rp = _runner(batch="4")
+    got = rp.submit_jobs(specs(True))
+    assert rp.registry.value("batch/demotions") == 1
+    assert rp.registry.value("batch/packed_jobs") == 0
+    rp.close()
+    assert all(r.ok for r in got), [r.error for r in got]
+    assert [_rendered(r) for r in got] == want
+
+
+def test_member_decode_failure_fails_alone(tmp_path):
+    """A poison member (strict decode error) fails alone; co-members
+    stay packed and byte-identical."""
+    paths = [_sim(tmp_path, f"p{i}.sam", 50 + i) for i in range(3)]
+    bad = os.path.join(str(tmp_path), "bad.sam")
+    with open(paths[1]) as fh:
+        text = fh.read()
+    lines = text.splitlines()
+    body = [ln for ln in lines if not ln.startswith("@")]
+    hdr = [ln for ln in lines if ln.startswith("@")]
+    f = body[0].split("\t")
+    f[3] = "999999"                       # way out of bounds: IndexError
+    with open(bad, "w") as fh:
+        fh.write("\n".join(hdr + [("\t".join(f))] + body[1:]) + "\n")
+    specs = [JobSpec(filename=paths[0], config=RunConfig(backend="jax"),
+                     job_id="ok0"),
+             JobSpec(filename=bad, config=RunConfig(backend="jax"),
+                     job_id="poison"),
+             JobSpec(filename=paths[2], config=RunConfig(backend="jax"),
+                     job_id="ok1")]
+    rs = _runner(batch="off")
+    serial = rs.submit_jobs([specs[0], specs[2]])
+    rs.close()
+    rp = _runner(batch="3")
+    packed = rp.submit_jobs(specs)
+    rp.close()
+    assert packed[0].ok and packed[2].ok
+    assert not packed[1].ok
+    assert "IndexError" in packed[1].error
+    assert _rendered(packed[0]) == _rendered(serial[0])
+    assert _rendered(packed[2]) == _rendered(serial[1])
+
+
+# -- SIGKILL mid-batch under a journal -------------------------------------
+def _serve_cmd(inputs, outdir, jdir, batch):
+    cmd = [sys.executable, "-m", "sam2consensus_tpu.cli", "serve"]
+    for p in inputs:
+        cmd += ["-i", p]
+    cmd += ["-o", outdir, "--journal", jdir, "--batch", batch,
+            "--quiet"]
+    return cmd
+
+
+def _committed(jdir):
+    n = 0
+    for name in os.listdir(jdir) if os.path.isdir(jdir) else []:
+        if name.startswith("ev-") and name.endswith(".json"):
+            try:
+                with open(os.path.join(jdir, name)) as fh:
+                    if json.load(fh).get("ev") == "committed":
+                        n += 1
+            except Exception:
+                pass
+    return n
+
+
+def test_sigkill_mid_batch_journal_resume(tmp_path):
+    """Crash-mid-batch replay: SIGKILL a journaled batched queue after
+    the first batch committed (mid-queue, second batch in flight); the
+    restarted server replays ONLY uncommitted members — zero lost, zero
+    duplicated, byte-identical output set."""
+    inputs = [_sim(tmp_path, f"k{i}.sam", 300 + i, contig_len=6000,
+                   n_reads=20000) for i in range(6)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", S2C_JIT_CACHE="",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    clean = str(tmp_path / "clean")
+    r = subprocess.run(_serve_cmd(inputs, clean, str(tmp_path / "jc"),
+                                  "3"),
+                       env=env, capture_output=True, text=True,
+                       timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    want = {f: open(os.path.join(clean, f), "rb").read()
+            for f in sorted(os.listdir(clean))}
+    assert len(want) == 6
+
+    outdir, jdir = str(tmp_path / "out"), str(tmp_path / "j")
+    proc = subprocess.Popen(_serve_cmd(inputs, outdir, jdir, "3"),
+                            env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 300
+    killed = False
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            break
+        if 1 <= _committed(jdir) < 6:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+            killed = True
+            break
+        time.sleep(0.02)
+    assert killed, "queue finished before the kill window (too fast)"
+    n_before = _committed(jdir)
+    assert n_before < 6                     # genuinely mid-queue
+
+    r2 = subprocess.run(_serve_cmd(inputs, outdir, jdir, "3"), env=env,
+                        capture_output=True, text=True, timeout=420)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    got = {f: open(os.path.join(outdir, f), "rb").read()
+           for f in sorted(os.listdir(outdir))}
+    assert got == want
+    audit = sjournal.JobJournal(jdir).audit()
+    assert audit["duplicated"] == []        # committed members NOT rerun
+    assert audit["lost"] == []
+    assert len(audit["commit_counts"]) == 6
+
+
+# -- composition policy ----------------------------------------------------
+def _plan_entry(i, tmp, tenant="", total_len=3000, nbytes=10_000):
+    spec = JobSpec(filename=f"/nonexistent/j{i}.sam",
+                   config=RunConfig(backend="jax"), job_id=f"c{i}",
+                   tenant=tenant)
+    return {"spec": spec, "job_id": spec.job_id, "key": None,
+            "jobnum": i, "action": "run", "cfg": spec.config,
+            "admission": None, "resume_ckpt": False,
+            "batch_total_len": total_len, "batch_bytes": nbytes}
+
+
+def test_burning_tenant_flushes_without_window(tmp_path):
+    """A tenant with SLO burn gets LATENCY: its job flushes the filling
+    batch immediately (flush_reason slo_burn) instead of waiting for
+    the batch to fill or the window to lapse."""
+    r = _runner(batch="8", batch_window=10_000.0)   # absurd window
+    try:
+        r.admission.slo_burn_by_tenant["hot"] = 2
+        plan = [_plan_entry(0, tmp_path), _plan_entry(1, tmp_path),
+                _plan_entry(2, tmp_path, tenant="hot"),
+                _plan_entry(3, tmp_path), _plan_entry(4, tmp_path)]
+        batches = r.scheduler.compose(plan, arrivals=[0.0] * len(plan))
+        assert batches, "no batches composed"
+        first = batches[0]
+        assert first.flush_reason == "slo_burn"
+        assert first.indices == [0, 1, 2]       # ships at the hot job,
+        # NOT held until max_jobs=8 or the 10s window
+        assert batches[1].indices == [3, 4]
+    finally:
+        r.close()
+
+
+def test_window_bounds_batch_composition(tmp_path):
+    """An arrival outside --batch-window starts the next batch."""
+    r = _runner(batch="8", batch_window=50.0)
+    try:
+        plan = [_plan_entry(i, tmp_path) for i in range(4)]
+        batches = r.scheduler.compose(
+            plan, arrivals=[0.0, 0.010, 0.200, 0.205])
+        assert [b.indices for b in batches] == [[0, 1], [2, 3]]
+        assert batches[0].flush_reason == "window"
+    finally:
+        r.close()
+
+
+def test_pinned_tenant_not_batchable(tmp_path):
+    r = _runner(batch="8")
+    try:
+        r.admission.tenant_rungs["deg"] = "host"
+        plan = [_plan_entry(0, tmp_path),
+                _plan_entry(1, tmp_path, tenant="deg"),
+                _plan_entry(2, tmp_path)]
+        batches = r.scheduler.compose(plan)
+        assert [b.indices for b in batches] == [[0, 2]]
+    finally:
+        r.close()
+
+
+def test_oversize_member_not_batchable(tmp_path):
+    r = _runner(batch="8")
+    try:
+        plan = [_plan_entry(0, tmp_path),
+                _plan_entry(1, tmp_path, total_len=1 << 30),
+                _plan_entry(2, tmp_path)]
+        batches = r.scheduler.compose(plan)
+        assert [b.indices for b in batches] == [[0, 2]]
+    finally:
+        r.close()
+
+
+# -- sidecar naming under packed execution ---------------------------------
+def test_default_quarantine_sidecars_unique_per_packed_job(tmp_path):
+    """Two packed jobs over the SAME upload in quarantine mode get
+    DISTINCT default sidecars (.job<N> keyed on the server-lifetime job
+    number) — concurrent commits can never clobber evidence files."""
+    good = _sim(tmp_path, "q.sam", 60)
+    bad = os.path.join(str(tmp_path), "qbad.sam")
+    with open(good) as fh:
+        lines = fh.read().splitlines()
+    body = [ln for ln in lines if not ln.startswith("@")]
+    hdr = [ln for ln in lines if ln.startswith("@")]
+    f = body[0].split("\t")
+    f[3] = "999999"
+    with open(bad, "w") as fh:
+        fh.write("\n".join(hdr + ["\t".join(f)] + body) + "\n")
+    out = str(tmp_path / "o")
+    os.makedirs(out)
+    cfg = RunConfig(backend="jax", on_bad_record="quarantine",
+                    outfolder=out + "/", prefix="same")
+    specs = [JobSpec(filename=bad, config=cfg, job_id="qa"),
+             JobSpec(filename=bad, config=cfg, job_id="qb")]
+    r = _runner(batch="2")
+    results = r.submit_jobs(specs)
+    r.close()
+    assert all(res.ok for res in results), [res.error for res in results]
+    assert all(res.quarantined == 1 for res in results)
+    sidecars = sorted(f for f in os.listdir(out) if "quarantine" in f)
+    assert sidecars == ["same_quarantine.job0.jsonl",
+                        "same_quarantine.job1.jsonl"]
+
+
+# -- observability surfaces ------------------------------------------------
+def test_batch_exposition_family_and_health(tmp_path):
+    """The s2c_batch_* family renders lint-clean with HELP/TYPE
+    discipline, and the health snapshot carries the batch section
+    tools/s2c_top.py renders."""
+    from sam2consensus_tpu.observability.telemetry import (
+        lint_openmetrics, parse_openmetrics, render_openmetrics)
+
+    paths = [_sim(tmp_path, f"e{i}.sam", 70 + i) for i in range(4)]
+    specs = [JobSpec(filename=p, config=RunConfig(backend="jax"),
+                     job_id=f"e{k}") for k, p in enumerate(paths)]
+    r = _runner(batch="4")
+    results = r.submit_jobs(specs)
+    assert all(res.ok for res in results)
+    text = r.render_telemetry()
+    assert lint_openmetrics(text) == []
+    samples = parse_openmetrics(text)
+    names = {s["name"] for s in samples}
+    assert {"s2c_batch_size", "s2c_batch_occupancy_pct",
+            "s2c_batch_jobs_per_sec", "s2c_batch_batches_total",
+            "s2c_batch_packed_jobs_total"} <= names
+    snap = r.health_snapshot()
+    assert snap["batch"]["batches"] == 1
+    assert snap["batch"]["packed_jobs"] == 4
+    assert snap["batch"]["last_size"] == 4
+    assert 0 < snap["batch"]["last_occupancy_pct"] <= 100
+    # the s2c_top frame renders the batching line from either surface
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import s2c_top
+
+    frame = s2c_top.render(snap, samples)
+    assert any("batching:" in ln for ln in frame)
+    r.close()
+
+
+def test_batch_decision_residual_joins(tmp_path):
+    """The serve_batch ledger decision joins its measured counters: a
+    second (warm) batch's residual uses the self-calibrated rate from
+    the first, so the prediction tracks the rig."""
+    paths = [_sim(tmp_path, f"d{i}.sam", 80 + i) for i in range(4)]
+
+    def specs():
+        return [JobSpec(filename=p, config=RunConfig(backend="jax"),
+                        job_id=f"d{k}") for k, p in enumerate(paths)]
+    r = _runner(batch="4")
+    r.submit_jobs(specs())                  # calibration batch
+    results = r.submit_jobs(specs())
+    r.close()
+    d = [x for x in (results[0].manifest or {}).get("decisions", [])
+         if x["decision"] == "serve_batch"][0]
+    assert d["measured"]["sec"] > 0
+    assert d["residual"]["sec"] > 0
+    assert d["residual"]["jobs_per_sec"] > 0
+
+
+def test_decode_ahead_skips_batched_entries(tmp_path):
+    """A mixed queue (batched smalls + an ineligible job) completes
+    with every output byte-identical to serial — the decode-ahead
+    launcher and the batch scheduler never fight over an entry."""
+    paths = [_sim(tmp_path, f"m{i}.sam", 90 + i) for i in range(3)]
+    big = _sim(tmp_path, "host.sam", 99)
+    specs = [
+        JobSpec(filename=paths[0], config=RunConfig(backend="jax"),
+                job_id="m0"),
+        JobSpec(filename=big,
+                config=RunConfig(backend="jax", pileup="host"),
+                job_id="mhost"),         # ineligible: explicit host pin
+        JobSpec(filename=paths[1], config=RunConfig(backend="jax"),
+                job_id="m1"),
+        JobSpec(filename=paths[2], config=RunConfig(backend="jax"),
+                job_id="m2"),
+    ]
+    rs = _runner(batch="off")
+    want = [_rendered(r) for r in rs.submit_jobs(specs)]
+    rs.close()
+    rp = _runner(batch="8")
+    got = rp.submit_jobs(specs)
+    n_packed = rp.registry.value("batch/packed_jobs")
+    rp.close()
+    assert all(r.ok for r in got), [r.error for r in got]
+    assert [_rendered(r) for r in got] == want
+    assert n_packed == 3                   # the host-pinned job ran serial

@@ -115,6 +115,27 @@ def render(health, samples, now=None):
             f"slo: objectives {slo.get('objectives')}  "
             f"violations {slo.get('violations', 0)}  "
             f"burn {slo.get('burn_by_tenant')}")
+    # continuous batching: prefer the live exposition gauges
+    # (s2c_batch_* family), fall back to the health snapshot's batch
+    # section when no exposition is wired
+    bsize = _sample(samples, "s2c_batch_size")
+    bocc = _sample(samples, "s2c_batch_occupancy_pct")
+    bjps = _sample(samples, "s2c_batch_jobs_per_sec")
+    bat = health.get("batch") or {}
+    if bsize is None and bat:
+        bsize = bat.get("last_size")
+        bocc = bat.get("last_occupancy_pct")
+        bjps = bat.get("last_jobs_per_sec")
+    if bsize is not None or bat:
+        npacked = _sample(samples, "s2c_batch_packed_jobs_total")
+        if npacked is None:
+            npacked = bat.get("packed_jobs", 0)
+        lines.append(
+            f"batching: size {int(bsize or 0)}  "
+            f"occupancy {0.0 if bocc is None else bocc:.1f}%  "
+            f"{0.0 if bjps is None else bjps:.1f} packed-jobs/s  "
+            f"({int(npacked or 0)} packed total"
+            + (f", mode {bat.get('mode')}" if bat else "") + ")")
     # per-tenant table from the exposition (p50/p99 e2e + rung)
     rungs = health.get("tenant_rungs", {})
     tenants = _tenants(samples) or sorted(rungs) or []

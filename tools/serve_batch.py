@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""Continuous-batching serve benchmark (the PR-11 tentpole's evidence).
+
+Runs one small-job queue through a warm ServeRunner two ways — strictly
+serial (``--batch off``, the pre-batching warm path) and packed
+(``--batch N``: shared slabs, one shared dispatch + shared tail, per-job
+count partitions) — over byte-compared outputs, min-of-N alternating
+passes, and writes per-pass rows plus a summary row as JSONL (``--out``;
+stdout otherwise).  The summary's ``packed_vs_serial``/``identical``
+fields are the acceptance numbers; ``batch`` (occupancy, merged slabs,
+shared wall) and ``decision`` (the serve_batch ledger record with its
+prediction residual) are the why.  ``--cold`` adds the one-process-per-
+job floor for scale.
+
+Campaign usage (tools/tpu_campaign.sh step ``serve_batch``) tags the
+artifact per round; the CPU-fallback harness proof lives at
+campaign/serve_batch_r06_cpufallback.jsonl.
+
+Usage: python tools/serve_batch.py [--jobs 16] [--reads 256]
+       [--contig-len 5386] [--read-len 150] [--passes 5] [--cold]
+       [--pileup scatter] [--out FILE.jsonl]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--jobs", type=int, default=16)
+    ap.add_argument("--reads", type=int, default=256)
+    ap.add_argument("--contig-len", type=int, default=5386)
+    ap.add_argument("--read-len", type=int, default=150)
+    ap.add_argument("--passes", type=int, default=5)
+    ap.add_argument("--pileup", default="scatter",
+                    choices=["auto", "scatter"])
+    ap.add_argument("--cold", action="store_true",
+                    help="also run the one-process-per-job cold floor")
+    ap.add_argument("--cold-timeout", type=int, default=600)
+    ap.add_argument("--out", default=None,
+                    help="JSONL destination (default: stdout)")
+    args = ap.parse_args(argv)
+
+    from sam2consensus_tpu.utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
+
+    from sam2consensus_tpu.serve.benchmark import run_serve_batch_bench
+
+    res = run_serve_batch_bench(
+        n_jobs=args.jobs, n_reads=args.reads,
+        contig_len=args.contig_len, read_len=args.read_len,
+        passes=args.passes, pileup=args.pileup, cold=args.cold,
+        cold_timeout=args.cold_timeout, log=log)
+    lines = [json.dumps(r) for r in res["rows"]]
+    lines.append(json.dumps(res["summary"]))
+    blob = "\n".join(lines) + "\n"
+    if args.out and args.out != "-":
+        with open(args.out, "w") as fh:
+            fh.write(blob)
+        log(f"[serve_batch] wrote {args.out}")
+    else:
+        sys.stdout.write(blob)
+    s = res["summary"]
+    return 0 if (s["identical"] and s["warm_packed_min_sec"] > 0) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

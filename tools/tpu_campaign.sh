@@ -236,4 +236,21 @@ run_step serve_telemetry "campaign/serve_telemetry_$R.jsonl" \
   python tools/serve_telemetry.py --jobs 8 \
   --prom-out "campaign/serve_telemetry_$R.prom"
 
+# 11. continuous batching (cross-job slab packing evidence, ISSUE 11):
+# one small-job queue through a warm runner serial (--batch off) vs
+# packed (--batch N: shared slabs, one shared dispatch + shared tail,
+# per-job count partitions), byte-compared, min-of-5 alternating
+# passes + the cold-process floor.  The summary row's
+# packed_vs_serial (jobs/sec ratio, target >=3x) and identical=true
+# are the acceptance numbers; the decision row carries the serve_batch
+# ledger prediction residual (must sit inside the drift band).  On a
+# TPU rig this re-measures the real device-dispatch amortization the
+# cpu-fallback proof can only approximate (its packed side routes the
+# shared accumulation host-side per the link-free placement gate).
+# CPU-fallback harness proof: campaign/serve_batch_r06_cpufallback.jsonl
+run_step serve_batch "campaign/serve_batch_$R.jsonl" \
+  "campaign/serve_batch_stderr_$R.log" 2400 \
+  python tools/serve_batch.py --jobs 16 --reads 256 --passes 5 --cold \
+  --out -
+
 echo "$(date +%H:%M:%S) campaign complete" >> "$LOG"
