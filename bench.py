@@ -34,7 +34,10 @@ BENCH_READ_LEN / BENCH_CONTIG_LEN (headline workload, defaults 200000 /
 BENCH_INIT_RETRIES (default 2), BENCH_SERVE_JOBS (serve-leg batch size,
 default 8; 0 disables the leg), BENCH_SERVE_BATCH_JOBS (continuous-
 batching leg: warm-serial vs warm-packed jobs/sec over one small-job
-queue, default 16; 0 disables), BENCH_FULL_OUT / BENCH_TAG (write the
+queue, default 16; 0 disables), BENCH_INCR_PCT (incremental-consensus
+leg: +N% reads on a warm per-reference count cache vs the cold
+combined job, default 10; 0 disables; BENCH_INCR_READS sizes the
+base), BENCH_FULL_OUT / BENCH_TAG (write the
 complete result object — every row, untruncated — to this path / to
 BENCH_<tag>.full.json, so downstream consumers stop recovering rows
 from head-truncated stdout captures).
@@ -634,6 +637,45 @@ def serve_batch_leg(n_jobs):
     return row
 
 
+def incremental_leg(extra_pct):
+    """The incremental-consensus row (ISSUE 13 tentpole): +N% reads
+    against a warm per-reference count cache vs the cold job over the
+    combined input, through one warm ServeRunner
+    (sam2consensus_tpu/serve/benchmark.py).  ``jax_sec`` is the warm
+    delta job's min wall and ``vs_baseline`` the cold/warm ratio
+    (bigger = better, like every row), so the regression gate judges
+    the incremental series with the same bands.  The acceptance line
+    is ``incr_cost_ratio <= 0.15``."""
+    from sam2consensus_tpu.serve.benchmark import run_incremental_bench
+
+    n_reads = int(os.environ.get("BENCH_INCR_READS", "1000000"))
+    res = run_incremental_bench(n_reads=n_reads, extra_pct=extra_pct,
+                                log=log)
+    s = res["summary"]
+    row = {
+        "config": "incremental",
+        "reads_base": s["n_reads"],
+        "extra_pct": s["extra_pct"],
+        "jax_sec": s["warm_incr_min_sec"],
+        "cold_sec": s["cold_min_sec"],
+        "vs_baseline": round(s["cold_min_sec"]
+                             / max(1e-9, s["warm_incr_min_sec"]), 2),
+        "vs_baseline_kind": "cold_combined_job",
+        "incr_cost_ratio": s["incr_cost_ratio"],
+        "target_ratio": s["target_ratio"],
+        "identical": s["identical"],
+        "count_cache": {
+            "cache": s.get("cache"),
+            "decision": s.get("decision"),
+        },
+    }
+    log(f"[incremental] +{extra_pct}% reads {s['warm_incr_min_sec']}s "
+        f"vs cold {s['cold_min_sec']}s = "
+        f"{s['incr_cost_ratio']:.2%} of cold (target <=15%), "
+        f"identical={s['identical']}")
+    return row
+
+
 def full_artifact_path():
     """Destination for the complete (untruncated) result object:
     BENCH_FULL_OUT wins, else BENCH_TAG -> BENCH_<tag>.full.json next
@@ -707,6 +749,16 @@ def main():
             except Exception as exc:
                 log(f"[serve_batch] FAILED: {type(exc).__name__}: {exc}")
                 rows.append({"config": "serve_batch",
+                             "error": repr(exc)})
+        # incremental-consensus leg: +N% reads on a warm reference vs
+        # the cold combined job (BENCH_INCR_PCT=0 disables)
+        incr_pct = int(os.environ.get("BENCH_INCR_PCT", "10"))
+        if incr_pct > 0 and (not only or "incremental" in only):
+            try:
+                rows.append(incremental_leg(incr_pct))
+            except Exception as exc:
+                log(f"[incremental] FAILED: {type(exc).__name__}: {exc}")
+                rows.append({"config": "incremental",
                              "error": repr(exc)})
         result["configs"] = rows
 

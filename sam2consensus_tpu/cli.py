@@ -445,6 +445,24 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "more eligible jobs before flushing (default "
                         "50; live-arrival queues only — a pre-planned "
                         "queue arrives at once)")
+    # --- incremental consensus (sam2consensus_tpu/serve/countcache.py) ---
+    p.add_argument("--count-cache", dest="count_cache", default=None,
+                   help="per-reference count cache byte budget (e.g. "
+                        "'512M', '2G'; 'off' disables; env "
+                        "S2C_COUNT_CACHE).  Keeps each reference "
+                        "set's accumulated count tensor + insertion "
+                        "log resident across jobs (LRU under the "
+                        "budget) so an --incremental job against a "
+                        "warm reference pays only delta decode + "
+                        "scatter + re-vote — byte-identical to a cold "
+                        "run over the concatenated inputs")
+    p.add_argument("--incremental", action="store_true",
+                   help="treat every input as an incremental shard "
+                        "against its reference's warm count state "
+                        "(requires --count-cache): outputs cover ALL "
+                        "reads absorbed for that reference so far, "
+                        "and re-submitting an already-absorbed input "
+                        "adds nothing (keyed by absolute path)")
     # --- survivability (sam2consensus_tpu/serve/{journal,health,admission}) ---
     p.add_argument("--journal", dest="journal", default=None,
                    help="crash-safe job journal directory: every job's "
@@ -555,7 +573,7 @@ def build_serve_parser() -> argparse.ArgumentParser:
     # exposes (one-shot-only features)
     p.set_defaults(backend="jax", prefix="", profile_dir=None,
                    json_metrics=None, checkpoint_dir=None,
-                   paranoid=False, incremental=False, filename="")
+                   paranoid=False, filename="")
     return p
 
 
@@ -592,6 +610,24 @@ def serve_main(argv: List[str]) -> int:
         parse_batch_mode(args.batch)
     except ValueError as exc:
         raise SystemExit(f"error: {exc}") from None
+    from .serve.countcache import parse_budget
+
+    try:
+        cache_on = parse_budget(
+            args.count_cache if args.count_cache is not None
+            else os.environ.get("S2C_COUNT_CACHE")) > 0
+    except ValueError as exc:
+        raise SystemExit(f"error: {exc}") from None
+    if args.incremental and not cache_on:
+        raise SystemExit(
+            "error: --incremental serve jobs need --count-cache SIZE "
+            "(or S2C_COUNT_CACHE) — the warm per-reference count state "
+            "lives there")
+    if args.incremental and args.journal:
+        raise SystemExit(
+            "error: --incremental does not compose with --journal "
+            "(the journal injects per-job checkpoint homes, a second "
+            "source of resumable state)")
     if args.fault_inject:
         from .resilience.faultinject import parse_spec
 
@@ -641,7 +677,8 @@ def serve_main(argv: List[str]) -> int:
                          slo=args.slo,
                          profile_capture_dir=args.profile_capture_dir,
                          batch=args.batch,
-                         batch_window=args.batch_window)
+                         batch_window=args.batch_window,
+                         count_cache=args.count_cache)
     echo(f"\nServing {len(specs)} job(s) on one warm backend"
          + (f" (jit cache: {runner.cache_dir})" if runner.cache_dir
             else "")

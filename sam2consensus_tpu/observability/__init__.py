@@ -280,9 +280,13 @@ def publish_stats_extra(extra: dict) -> None:
         # writer health, profiler captures — observability/telemetry.py)
         # ride along so the fleet-telemetry story is checkable from any
         # per-job artifact
+        # cache/* (incremental count cache hit/miss per job) and
+        # epilogue/* (device vs host render epilogue) ride along so the
+        # warm-path story is checkable from any per-job artifact
         elif name.startswith(("wire/", "pipeline/", "drift/", "serve/",
                               "compile/", "format/", "ingest/",
-                              "quarantine/", "slo/", "telemetry/")):
+                              "quarantine/", "slo/", "telemetry/",
+                              "cache/", "epilogue/")):
             extra[name] = int(value) if float(value).is_integer() \
                 else round(value, 4)
     for gauge_name, extra_key in (("dispatch/tail", "tail_dispatch"),

@@ -174,7 +174,12 @@ class AggregateRegistry(MetricsRegistry):
 
     #: counter families the serve runner already records at server
     #: scope — folding a job's copies would double-count
-    FOLD_SKIP_PREFIXES = ("serve/", "slo/", "telemetry/")
+    # cache/: the count cache bills the server registry DIRECTLY
+    # (serve/countcache.py gets/puts pass it) while each incremental
+    # job's registry carries its own cache/{hits,misses} copy for the
+    # per-job manifest — folding that copy would double-count the
+    # server-lifetime family
+    FOLD_SKIP_PREFIXES = ("serve/", "slo/", "telemetry/", "cache/")
 
     def fold(self, registry: MetricsRegistry, job_id: str = "",
              tenant: str = "") -> None:
@@ -283,6 +288,33 @@ _HELP = {
                                "batch's merged slabs, percent.",
     "s2c_batch_jobs_per_sec": "Last batch's shared-phase throughput "
                               "(members / shared wall).",
+    # incremental consensus (serve/countcache.py): the s2c_cache_*
+    # family — per-reference device-resident count cache
+    "s2c_cache_entries": "References with warm count state resident "
+                         "in the serve count cache.",
+    "s2c_cache_resident_bytes": "Bytes of count+insertion state the "
+                                "cache holds (LRU under "
+                                "--count-cache).",
+    "s2c_cache_hits_total": "Incremental jobs seeded from a warm "
+                            "reference (paid only delta decode + "
+                            "scatter + re-vote).",
+    "s2c_cache_misses_total": "Incremental jobs that absorbed their "
+                              "input cold (no warm entry).",
+    "s2c_cache_evictions_total": "Entries evicted by the LRU byte "
+                                 "budget.",
+    "s2c_cache_invalidated_total": "Entries dropped whole after a "
+                                   "seeded job failed (the count-bank "
+                                   "rule).",
+    "s2c_cache_inserts_total": "Entries (re-)inserted at job commit.",
+    # device-resident epilogue (ops/fused.py): where the render
+    # epilogue ran per tail
+    "s2c_epilogue_device_tails_total": "Tails whose fill substitution "
+                                       "+ dash counts ran on device "
+                                       "(fetched bytes are final "
+                                       "FASTA).",
+    "s2c_epilogue_host_tails_total": "Tails whose render epilogue ran "
+                                     "host-side (sharded/native/"
+                                     "unrepresentable fill).",
 }
 
 

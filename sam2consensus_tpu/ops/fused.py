@@ -161,6 +161,48 @@ def _sym_space(out_enc) -> str:
     return "code5" if out_enc == "packed5" else "ascii"
 
 
+def _dash_code(out_enc) -> int:
+    """The ``'-'`` symbol in the vote's wire symbol space: raw ASCII
+    for dense/sparse, the SYM32 index (1) for packed5."""
+    return 1 if out_enc == "packed5" else ord("-")
+
+
+def contig_dash_counts(syms: jax.Array, offsets: jax.Array,
+                       dash_code: int) -> jax.Array:
+    """Per-(threshold, contig) ``'-'`` totals of the POST-FILL symbols —
+    the device-resident epilogue's stripped-length math.
+
+    The reference renders each contig's sequence, substitutes the fill
+    character, then counts ``'-'`` to decide the empty-sequence drop
+    and the header's stripped length (``sam2consensus.py:400-406``) —
+    two O(L) host passes per (threshold, contig).  Here the count runs
+    on device while the vote output is still resident: vmapped over the
+    THRESHOLD grid, with the CONTIG axis as a segmented prefix-sum
+    difference at the contig offsets (the same one-cumsum trick as
+    ``_tail_stats`` — a [C]-wide gather pair, not a per-contig loop).
+    ``syms`` must already carry the device-substituted fill
+    (``ops.vote.device_fill_code``), so a ``'-'`` fill's unemitted
+    positions are counted exactly like the reference's host pass would.
+    Returns int32 ``[T, C]``."""
+
+    def per_threshold(row):
+        is_dash = (row == jnp.uint8(dash_code)).astype(jnp.int32)
+        prefix = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(is_dash)])
+        return prefix[offsets[1:]] - prefix[offsets[:-1]]
+
+    return jax.vmap(per_threshold)(syms)
+
+
+def _epilogue_sections(syms, offsets, out_enc, epilogue: bool) -> list:
+    """The packed buffer's trailing epilogue section (``[T*C]`` dash
+    counts as LE bytes) — empty when the epilogue is host-routed."""
+    if not epilogue:
+        return []
+    dash = contig_dash_counts(syms, offsets, _dash_code(out_enc))
+    return [_bytes_of_i32(dash.reshape(-1))]
+
+
 def _syms_head(syms, cov, min_depth: int, out_enc):
     """Position-symbol section of the packed buffer.
 
@@ -182,56 +224,89 @@ def _syms_head(syms, cov, min_depth: int, out_enc):
     return [bits, compact.reshape(-1)]
 
 
-@partial(jax.jit, static_argnames=("min_depth", "out_enc"))
-def vote_packed_simple(counts: jax.Array, thr_enc: jax.Array,
-                       offsets: jax.Array, min_depth: int,
-                       out_enc=None) -> jax.Array:
+def _vote_packed_simple_body(counts: jax.Array, thr_enc: jax.Array,
+                             offsets: jax.Array, min_depth: int,
+                             out_enc=None, fill_code: int = 0,
+                             epilogue: bool = False) -> jax.Array:
     """No-insertion tail: position vote + contig sums, one packed buffer.
-    ``out_enc`` as in :func:`_syms_head`."""
+    ``out_enc`` as in :func:`_syms_head`; ``fill_code``/``epilogue`` are
+    the device-resident epilogue statics (``ops.vote.device_fill_code``
+    substitution inside the vote + per-(T, C) dash counts appended)."""
     jitcache.note_trace("vote_packed_simple")
     syms, cov = vote_block(counts, thr_enc, min_depth,
-                           _sym_space(out_enc))             # [T, L]
+                           _sym_space(out_enc), fill_code)  # [T, L]
     contig_sums, _ = _tail_stats(cov, offsets,
                                  jnp.full((1,), -1, jnp.int32))
-    return jnp.concatenate(_syms_head(syms, cov, min_depth, out_enc)
-                           + [_bytes_of_i32(contig_sums)])
+    return jnp.concatenate(
+        _syms_head(syms, cov, min_depth, out_enc)
+        + [_bytes_of_i32(contig_sums)]
+        + _epilogue_sections(syms, offsets, out_enc, epilogue))
 
 
-@partial(jax.jit, static_argnames=("min_depth", "cp", "out_enc"))
-def vote_packed(counts: jax.Array, thr_enc: jax.Array, offsets: jax.Array,
-                site_keys: jax.Array, n_cols: jax.Array, ev_key: jax.Array,
-                ev_col: jax.Array, ev_code: jax.Array,
-                min_depth: int, cp: int, out_enc=None) -> jax.Array:
+_SIMPLE_STATICS = ("min_depth", "out_enc", "fill_code", "epilogue")
+vote_packed_simple = partial(
+    jax.jit, static_argnames=_SIMPLE_STATICS)(_vote_packed_simple_body)
+#: same computation with the counts operand DONATED: XLA may reuse the
+#: count buffer's allocation for the packed output, so a warm serve job
+#: never holds counts + output live at once.  The backend gates use
+#: (the operand must be a dead temp — see jax_backend._donate_counts).
+vote_packed_simple_donated = partial(
+    jax.jit, donate_argnums=0,
+    static_argnames=_SIMPLE_STATICS)(_vote_packed_simple_body)
+
+
+def _vote_packed_body(counts: jax.Array, thr_enc: jax.Array,
+                      offsets: jax.Array, site_keys: jax.Array,
+                      n_cols: jax.Array, ev_key: jax.Array,
+                      ev_col: jax.Array, ev_code: jax.Array,
+                      min_depth: int, cp: int, out_enc=None,
+                      fill_code: int = 0,
+                      epilogue: bool = False) -> jax.Array:
     """Position vote + insertion table + insertion vote + stats, packed.
 
     ``site_keys``/``n_cols`` are the padded ``[Kp]`` site arrays
     (flat genome position, -1 for end-of-contig and pad sites); ``cp`` is
     the padded insertion-table column count (static).  Pad events scatter
     into the sacrificial row Kp-1.  ``out_enc`` selects the
-    position-symbol wire encoding (:func:`_syms_head`).
+    position-symbol wire encoding (:func:`_syms_head`);
+    ``fill_code``/``epilogue`` the device-resident epilogue statics.
     """
     jitcache.note_trace("vote_packed")
     syms, cov = vote_block(counts, thr_enc, min_depth,
-                           _sym_space(out_enc))             # [T, L]
+                           _sym_space(out_enc), fill_code)  # [T, L]
     contig_sums, site_cov = _tail_stats(cov, offsets, site_keys)
     kp = site_keys.shape[0]
     table = jnp.zeros((kp, cp, 6), dtype=jnp.int32)
     table = build_insertion_table(table, ev_key, ev_col, ev_code)
     ins_syms = vote_insertions(table, site_cov, n_cols, thr_enc)  # [T,Kp,Cp]
-    return jnp.concatenate(_syms_head(syms, cov, min_depth, out_enc) + [
-        ins_syms.reshape(-1),
-        _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)])
+    return jnp.concatenate(
+        _syms_head(syms, cov, min_depth, out_enc)
+        + [ins_syms.reshape(-1),
+           _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)]
+        + _epilogue_sections(syms, offsets, out_enc, epilogue))
 
 
-@partial(jax.jit, static_argnames=("min_depth", "cp", "kp", "c6p",
-                                   "max_blocks", "interpret", "out_enc"))
-def vote_packed_pallas(counts: jax.Array, thr_enc: jax.Array,
-                       offsets: jax.Array, site_keys: jax.Array,
-                       n_cols: jax.Array, key3: jax.Array, cc3: jax.Array,
-                       blk_lo: jax.Array, blk_n: jax.Array,
-                       min_depth: int, cp: int, kp: int, c6p: int,
-                       max_blocks: int, interpret: bool = False,
-                       out_enc=None) -> jax.Array:
+_PACKED_STATICS = ("min_depth", "cp", "out_enc", "fill_code", "epilogue")
+vote_packed = partial(
+    jax.jit, static_argnames=_PACKED_STATICS)(_vote_packed_body)
+vote_packed_donated = partial(
+    jax.jit, donate_argnums=0,
+    static_argnames=_PACKED_STATICS)(_vote_packed_body)
+
+
+_PALLAS_STATICS = ("min_depth", "cp", "kp", "c6p", "max_blocks",
+                   "interpret", "out_enc", "fill_code", "epilogue")
+
+
+def _vote_packed_pallas_body(counts: jax.Array, thr_enc: jax.Array,
+                             offsets: jax.Array, site_keys: jax.Array,
+                             n_cols: jax.Array, key3: jax.Array,
+                             cc3: jax.Array,
+                             blk_lo: jax.Array, blk_n: jax.Array,
+                             min_depth: int, cp: int, kp: int, c6p: int,
+                             max_blocks: int, interpret: bool = False,
+                             out_enc=None, fill_code: int = 0,
+                             epilogue: bool = False) -> jax.Array:
     """``vote_packed`` with the insertion table built by the Pallas
     segmented-reduce kernel (ops/pallas_insertion.py) instead of the XLA
     scatter — still one dispatch, one packed uint8 result.
@@ -253,7 +328,7 @@ def vote_packed_pallas(counts: jax.Array, thr_enc: jax.Array,
                                    vote_insertions_fused)
 
     syms, cov = vote_block(counts, thr_enc, min_depth,
-                           _sym_space(out_enc))             # [T, L]
+                           _sym_space(out_enc), fill_code)  # [T, L]
     contig_sums, site_cov = _tail_stats(cov, offsets, site_keys)
     if cp <= FUSED_VOTE_MAX_CP:
         ins_syms = vote_insertions_fused(
@@ -265,6 +340,15 @@ def vote_packed_pallas(counts: jax.Array, thr_enc: jax.Array,
                           max_blocks=max_blocks, interpret=interpret)
         table = out.reshape(kp, c6p)[:, : cp * 6].reshape(kp, cp, 6)
         ins_syms = vote_insertions(table, site_cov, n_cols, thr_enc)
-    return jnp.concatenate(_syms_head(syms, cov, min_depth, out_enc) + [
-        ins_syms.reshape(-1),
-        _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)])
+    return jnp.concatenate(
+        _syms_head(syms, cov, min_depth, out_enc)
+        + [ins_syms.reshape(-1),
+           _bytes_of_i32(contig_sums), _bytes_of_i32(site_cov)]
+        + _epilogue_sections(syms, offsets, out_enc, epilogue))
+
+
+vote_packed_pallas = partial(
+    jax.jit, static_argnames=_PALLAS_STATICS)(_vote_packed_pallas_body)
+vote_packed_pallas_donated = partial(
+    jax.jit, donate_argnums=0,
+    static_argnames=_PALLAS_STATICS)(_vote_packed_pallas_body)

@@ -917,8 +917,13 @@ class PileupAccumulator:
 
     def counts_host(self):
         """Valid counts on host, ``[total_len, 6]`` (same surface as the
-        sharded accumulator, for checkpointing)."""
-        return np.asarray(self._counts)[: self.total_len]
+        sharded accumulator, for checkpointing).  The full-tensor pull
+        (checkpoint snapshots, ladder demotions, paranoid cross-checks)
+        bills the d2h choke point — these were the unaccounted
+        host-vote return paths."""
+        from ..wire import fetch_d2h
+
+        return fetch_d2h(self._counts)[: self.total_len]
 
     def set_counts(self, counts) -> None:
         """Restore from a checkpoint: counts of shape [total_len, 6]."""

@@ -56,6 +56,33 @@ IUPAC_MASK_LUT5 = np.array(
 FILL_SENTINEL = 0
 
 
+def device_fill_code(fill: str, sym_space: str = "ascii"):
+    """The device-resident epilogue's fill substitution code, or None
+    when the fill string cannot be substituted on device.
+
+    The reference substitutes the ``-f`` fill character for unemitted
+    positions on the host (``sam2consensus.py:345``); the fused epilogue
+    does it inside the vote instead — ``jnp.where(emit, syms, fill)`` is
+    the SAME select that placed the FILL sentinel, so the substitution
+    is free device work and the fetched buffer is final FASTA body
+    bytes.  Representability depends on the wire symbol space:
+
+    * ``ascii``: any single latin-1 character (the select emits raw
+      bytes);
+    * ``code5``: only fill characters inside the 32-symbol vote
+      alphabet (``constants.SYM32_ASCII``) — the packed planes carry 5
+      bits, nothing else fits.
+
+    Multi-character (or non-latin) fills return None and the host
+    render keeps the sentinel path, exactly as before."""
+    if len(fill) != 1 or ord(fill) > 255:
+        return None
+    if sym_space == "code5":
+        hits = np.nonzero(SYM32_ASCII == ord(fill))[0]
+        return int(hits[0]) if len(hits) else None
+    return ord(fill)
+
+
 def threshold_luts(thresholds: Sequence[float], max_cov: int) -> np.ndarray:
     """Integer cutoffs ``lut[t, cov] = ceil(float64(t)*cov)`` as int32.
 
@@ -96,7 +123,8 @@ def emit_gate(cov: jax.Array, min_depth: int) -> jax.Array:
 
 
 def vote_block(counts: jax.Array, thr_enc: jax.Array,
-               min_depth: int, sym_space: str = "ascii") -> tuple:
+               min_depth: int, sym_space: str = "ascii",
+               fill_code: int = FILL_SENTINEL) -> tuple:
     """Vote every position of a counts block for every threshold.
 
     Pure traceable function (no jit) so it can run inside ``jax.jit``,
@@ -113,9 +141,12 @@ def vote_block(counts: jax.Array, thr_enc: jax.Array,
         select through a different table, so the packed5 wire encoding
         costs no extra device work.  The FILL sentinel is 0 in both
         spaces (``SYM32_ASCII[0] == 0``).
+      fill_code: what unemitted positions carry — FILL_SENTINEL (the
+        host substitutes later) or a :func:`device_fill_code` value
+        (the device-resident epilogue: the fetched bytes are final).
 
     Returns:
-      syms: uint8 ``[T, L]`` symbol per position (FILL_SENTINEL where
+      syms: uint8 ``[T, L]`` symbol per position (``fill_code`` where
         the reference emits the fill character), and cov: int32 ``[L]``.
     """
     table = IUPAC_MASK_LUT if sym_space == "ascii" else IUPAC_MASK_LUT5
@@ -138,13 +169,14 @@ def vote_block(counts: jax.Array, thr_enc: jax.Array,
         included = nonzero & (strictly_greater_sum < cutoff[:, None])
         mask = jnp.sum(jnp.where(included, bit, 0), axis=-1)   # [L]
         syms = iupac_select(mask, table)
-        return jnp.where(emit, syms, jnp.uint8(FILL_SENTINEL))
+        return jnp.where(emit, syms, jnp.uint8(fill_code))
 
     return jax.vmap(per_threshold)(thr_enc), cov
 
 
 #: jitted single-device entry point over a full counts tensor
-vote_positions = partial(jax.jit, static_argnames=("min_depth",))(vote_block)
+vote_positions = partial(jax.jit, static_argnames=(
+    "min_depth", "sym_space", "fill_code"))(vote_block)
 
 
 def vote_positions_native(counts: np.ndarray, thresholds: Sequence[float],

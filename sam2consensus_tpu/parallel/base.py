@@ -58,12 +58,18 @@ def fetch_host(x: jax.Array) -> np.ndarray:
     ``tools/multihost_dryrun.py``) a position-sharded array spans
     processes, so each process assembles the global value with one
     ``process_allgather`` (tiled: shards land in their global slots).
+
+    Every fetch bills the run's d2h choke point (``wire.account_d2h``)
+    — the gather-based sharded tails (vote symbols, tail stats, count
+    snapshots) previously bypassed ``wire/d2h_bytes`` entirely.
     """
+    from ..wire import fetch_d2h
+
     if x.is_fully_addressable or x.sharding.is_fully_replicated:
-        return np.asarray(x)
+        return fetch_d2h(x)
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return fetch_d2h(multihost_utils.process_allgather(x, tiled=True))
 
 
 def record_slab(key: str, t0: float, n_rows: int, width: int) -> None:
@@ -364,5 +370,5 @@ class ShardedCountsBase:
         contig_sums, site_cov = jax.jit(stats)(
             self.counts, jnp.asarray(offsets.astype(np.int32)),
             jnp.asarray(site_keys.astype(np.int32)))
-        return (np.asarray(contig_sums, dtype=np.int64),
-                np.asarray(site_cov, dtype=np.int64))
+        return (fetch_host(contig_sums).astype(np.int64),
+                fetch_host(site_cov).astype(np.int64))

@@ -77,6 +77,7 @@ packed phase demoting only that batch back to the serial path.
 """
 
 from .admission import AdmissionController
+from .countcache import CountCache, parse_budget, reference_key
 from .health import snapshot as health_snapshot
 from .journal import JobJournal, job_key
 from .packing import (PackPlan, extract_counts, extract_member,
@@ -88,4 +89,5 @@ __all__ = ["JobSpec", "JobResult", "ServeRunner", "submit_jobs",
            "JobJournal", "job_key", "AdmissionController",
            "health_snapshot", "BatchScheduler", "parse_batch_mode",
            "PackPlan", "plan_pack", "merge_batches", "extract_counts",
-           "extract_member"]
+           "extract_member", "CountCache", "parse_budget",
+           "reference_key"]

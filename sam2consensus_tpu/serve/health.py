@@ -126,6 +126,12 @@ def snapshot(runner) -> dict:
             "last_jobs_per_sec": g.get("batch/jobs_per_sec",
                                        {}).get("value", 0.0),
         }
+    # incremental consensus (serve/countcache.py): the per-reference
+    # count cache's residency + hit/evict story, mirrored from the
+    # s2c_cache_* exposition family for probers without a scraper
+    cc = getattr(runner, "count_cache", None)
+    if cc is not None:
+        snap["count_cache"] = cc.stats()
     slo_obj = getattr(runner, "slo", None)
     if slo_obj or reg.value("slo/violations"):
         snap["slo"] = {

@@ -330,7 +330,8 @@ class ServeRunner:
                  telemetry_interval: Optional[float] = None,
                  slo=None,
                  profile_capture_dir: Optional[str] = None,
-                 batch="off", batch_window: Optional[float] = None):
+                 batch="off", batch_window: Optional[float] = None,
+                 count_cache=None):
         from ..backends.jax_backend import JaxBackend
 
         if prewarm not in ("auto", "off"):
@@ -366,6 +367,14 @@ class ServeRunner:
 
         self.scheduler = BatchScheduler(self, batch=batch,
                                         window_ms=batch_window)
+        # -- incremental consensus (serve/countcache.py) ---------------
+        # a typo'd budget fails the server start, same discipline as
+        # --batch / --slo
+        from . import countcache as ccache
+
+        self.count_cache = ccache.from_config(
+            count_cache if count_cache is not None
+            else os.environ.get("S2C_COUNT_CACHE"))
         self.health = shealth.HealthState()
         #: last finished job's tolerant-decode verdict, surfaced in the
         #: health snapshot (per-job history lives in each JobResult)
@@ -583,8 +592,20 @@ class ServeRunner:
                 "checkpoints itself) or run checkpointed jobs through "
                 "the one-shot CLI")
         if spec.config.incremental:
-            raise ValueError("serve mode does not compose with "
-                             "--incremental (see --checkpoint-dir)")
+            # incremental IS a serve feature now — but only through the
+            # count cache (the checkpoint-file flavor needs serial
+            # decode + a --checkpoint-dir, which serve rejects above)
+            if self.count_cache is None:
+                raise ValueError(
+                    "incremental serve jobs need the per-reference "
+                    "count cache: start the server with --count-cache "
+                    "SIZE (e.g. 512M) or S2C_COUNT_CACHE")
+            if self.journal is not None:
+                raise ValueError(
+                    "--journal injects a per-job checkpoint home, "
+                    "which conflicts with count-cache seeding (two "
+                    "sources of resumable state); run incremental "
+                    "jobs on an unjournaled server")
 
     # -- health -----------------------------------------------------------
     def health_snapshot(self) -> dict:
@@ -1150,6 +1171,14 @@ class ServeRunner:
                    if replay is not None else {})})
             res = JobResult(job_id=job_id, filename=spec.filename,
                             index=i, admission=entry["admission"])
+            # incremental consensus: seed the job from the warm
+            # per-reference count state (serve/countcache.py) and ask
+            # the backend to hand back the final state for re-insertion
+            cache_key = cache_seed = None
+            if header_err is None:
+                cache_key, cache_seed, cfg = self._cache_begin(
+                    spec, cfg, contigs, robs)
+                entry["cfg"] = cfg
             dlog: List[Tuple[float, float]] = []
             # log-correlation IDs for every record this job emits —
             # the watchdog worker and (already-bound) decode-ahead
@@ -1176,6 +1205,12 @@ class ServeRunner:
                     self._note_poison(spec, exc, res)
                     retry_cfg = self._retry_config(cfg, exc)
                     if retry_cfg is not None:
+                        if cache_key is not None:
+                            # the first attempt consumed (or dropped)
+                            # the seed; the host-rung retry must run
+                            # against the SAME warm base or its output
+                            # would cover only the delta reads
+                            self._plant_seed(cache_seed)
                         out, robs, res.error = self._retry_on_host_rung(
                             spec, retry_cfg, exc, jobnum, job_id)
                     else:
@@ -1189,6 +1224,8 @@ class ServeRunner:
                 if out is not None:
                     res.fastas, res.stats = out.fastas, out.stats
                     res.error = None
+                if cache_key is not None:
+                    self._cache_end(cache_key, out is not None)
             res.elapsed_sec = time.perf_counter() - t0
             self._finalize_job(entry, res, robs, spec,
                                queue_wait=t0 - window_t0)
@@ -1229,7 +1266,7 @@ class ServeRunner:
             k: v for k, v in snap["counters"].items()
             if k.startswith(("serve/", "compile/", "resilience/",
                              "fault/", "phase/", "ingest/",
-                             "quarantine/"))}
+                             "quarantine/", "cache/", "epilogue/"))}
         res.bad_records = int(
             snap["counters"].get("ingest/bad_records", 0))
         res.quarantined = int(
@@ -1296,6 +1333,81 @@ class ServeRunner:
                   + (f"ok in {res.elapsed_sec:.2f}s"
                      if res.ok else f"FAILED ({res.error})")
                   + echo_suffix)
+
+    # -- incremental consensus (serve/countcache.py) -----------------------
+    def _plant_seed(self, seed) -> None:
+        """Arm the backend for one count-cache job: consume ``seed``
+        (None = cold absorb) and capture the final state back."""
+        self.backend.serve_count_seed = seed
+        self.backend.serve_capture_counts = True
+
+    def _cache_begin(self, spec: JobSpec, cfg: RunConfig, contigs, robs):
+        """Seed an incremental job from the warm per-reference state.
+
+        Returns ``(key, seed, cfg)`` — key None for non-incremental
+        jobs (cache off / flag off / header unread); cfg gains a
+        default ``source_id`` (the input's absolute path, the one-shot
+        CLI's convention) so duplicate-shard detection works without
+        per-job plumbing.  The warm/cold verdict is a priced ledger
+        decision in the JOB's manifest: predicted decode seconds for
+        THIS input's bytes, joined against the measured decode phase
+        (band=0 — the decode-threads decision already owns enforcing
+        the rate model; this one documents what the cache saved)."""
+        if self.count_cache is None \
+                or not getattr(cfg, "incremental", False) \
+                or contigs is None:
+            return None, None, cfg
+        from . import countcache as ccache
+
+        if not cfg.source_id:
+            cfg = dataclasses.replace(
+                cfg, source_id=os.path.abspath(spec.filename))
+        key = ccache.reference_key(contigs, cfg, spec.tenant)
+        seed = self.count_cache.get(key, self.registry)
+        self._plant_seed(seed)
+        chosen = "warm" if seed is not None else "cold"
+        # same (plural) counter names as the cache's server-lifetime
+        # family, so a per-job manifest joins the s2c_cache_*
+        # exposition key-for-key
+        robs.registry.add(
+            f"cache/{'hits' if seed is not None else 'misses'}", 1)
+        try:
+            size = os.path.getsize(spec.filename)
+        except OSError:
+            size = 0
+        try:
+            rate = float(os.environ.get("S2C_DECODE_MBPS_PER_CORE",
+                                        "330")) * 1e6
+        except ValueError:
+            rate = 330e6
+        cstats = self.count_cache.stats()
+        with obs.bind_run_to_thread(robs):
+            obs.record_decision(
+                "count_cache", chosen,
+                inputs={"entries": cstats["entries"],
+                        "resident_mb": cstats["resident_mb"],
+                        "input_bytes": int(size),
+                        "base_sources": len(seed.sources or [])
+                        if seed is not None else 0,
+                        "tenant": spec.tenant or ""},
+                predicted={"sec": size / rate} if size else {},
+                measured={"sec": {"counters": ["phase/decode_sec"]}},
+                band=0)
+        return key, seed, cfg
+
+    def _cache_end(self, key: str, ok: bool) -> None:
+        """Commit or invalidate the job's entry — the count-bank rule:
+        only a job that finished whole re-inserts its state; ANY
+        failure after seeding drops the entry entirely (a half-applied
+        base must never seed the next job)."""
+        result = getattr(self.backend, "serve_count_result", None)
+        self.backend.serve_count_result = None
+        self.backend.serve_count_seed = None
+        self.backend.serve_capture_counts = False
+        if ok and result is not None:
+            self.count_cache.put(key, result, self.registry)
+        else:
+            self.count_cache.invalidate(key, self.registry)
 
     def _note_poison(self, spec: JobSpec, exc: BaseException,
                      res: JobResult) -> None:

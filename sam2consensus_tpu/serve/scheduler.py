@@ -226,6 +226,11 @@ class BatchScheduler:
             return False
         if getattr(cfg, "paranoid", False):
             return False
+        if getattr(cfg, "incremental", False):
+            return False        # incremental consensus (count cache):
+            # the job's accumulator seeds from warm per-reference
+            # state — packing it into a shared tensor would merge
+            # co-tenants' counts into its combined output
         if getattr(cfg, "checkpoint_dir", None) and self.runner.journal \
                 is None:
             return False            # explicit checkpoint job (serve
@@ -809,12 +814,17 @@ class BatchScheduler:
     @staticmethod
     def _tail_compatible(live: List[_Member]) -> bool:
         """True when every member's tail math reads the same knobs.
-        Only ``thresholds`` and ``min_depth`` enter the vote; maxdel /
-        strict / py2-compat act at encode time (already per-member) and
-        fill / prefix / nchar at render time (per-member too)."""
-        key = (tuple(live[0].cfg.thresholds), live[0].cfg.min_depth)
-        return all((tuple(m.cfg.thresholds), m.cfg.min_depth) == key
-                   for m in live)
+        ``thresholds`` and ``min_depth`` enter the vote, and ``fill``
+        now enters the TAIL too (the device-resident epilogue
+        substitutes the fill byte inside the vote's emit select —
+        backends/jax_backend.py); maxdel / strict / py2-compat act at
+        encode time (already per-member) and prefix / nchar at render
+        time (per-member too).  Members with a different fill take the
+        per-member extraction tail — same bytes, less amortization."""
+        key = (tuple(live[0].cfg.thresholds), live[0].cfg.min_depth,
+               live[0].cfg.fill)
+        return all((tuple(m.cfg.thresholds), m.cfg.min_depth,
+                    m.cfg.fill) == key for m in live)
 
     def _shared_tail(self, members: List[_Member], live: List[_Member],
                      plan_pk: packing.PackPlan, counts: np.ndarray,
@@ -884,7 +894,7 @@ class BatchScheduler:
         t0 = time.perf_counter()
         with obs.bind_run_to_thread(batch_robs):
             (syms, ins_syms, contig_sums, site_cov, ins, _out,
-             _link_free) = policy.run(
+             _link_free, dash_counts) = policy.run(
                 lambda: backend._tail(acc, cfg0, comb_layout, carrier,
                                       stats, use_sharded=False),
                 site="tail")
@@ -896,6 +906,10 @@ class BatchScheduler:
             "site_cov": None if site_cov is None else
             np.asarray(site_cov),
             "ins": ins,
+            # device-resident epilogue: per-(T, comb-contig) dash
+            # totals slice per member exactly like contig_sums
+            "dash_counts": None if dash_counts is None else
+            np.asarray(dash_counts),
             "base_ci": base_ci,
             "total_len": comb_layout.total_len,
             "tail_sec": time.perf_counter() - t0,
@@ -914,6 +928,8 @@ class BatchScheduler:
         hi_ci = shared["base_ci"][m.ordinal + 1]
         syms_k = shared["syms"][:, off:off + L]
         contig_sums_k = shared["contig_sums"][lo_ci:hi_ci]
+        dash_k = None if shared.get("dash_counts") is None \
+            else shared["dash_counts"][:, lo_ci:hi_ci]
         ins = shared["ins"]
         ins_k = ins_syms_k = site_cov_k = None
         if ins is not None:
@@ -943,7 +959,8 @@ class BatchScheduler:
                 ins_syms_k, site_cov_k,
                 n_reads=m.encoder.n_reads,
                 n_skipped=m.encoder.n_skipped,
-                aligned_bases=pm.n_events)
+                aligned_bases=pm.n_events,
+                dash_counts=dash_k)
         except Exception as exc:
             runner.backend.serve_prepared_obs = None
             logger.warning("packed job %s: shared-tail render failed "

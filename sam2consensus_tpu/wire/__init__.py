@@ -32,8 +32,53 @@ from .codec import (CODECS, WireSlab, decode_slab_host, encode_slab,
                     modeled_wire_ratio, packed5_slab_bytes, resolve_codec,
                     row_bytes_estimate, wire_auto_cutoff_bps, worthwhile)
 
+
+def link_free_default() -> bool:
+    """True when the default backend shares host memory (no wire to
+    bill).  Import-guarded so jax-free consumers (the cpu backend's
+    paranoid path) can still call the accounting helpers."""
+    try:
+        import jax
+
+        return jax.default_backend() == "cpu"
+    except Exception:
+        return True
+
+
+def account_d2h(nbytes: int, link_free=None) -> None:
+    """THE device→host accounting choke point: every fetch that crosses
+    the link bills ``wire/d2h_bytes`` here — the fused tail's packed
+    buffer, the sharded (gather-based) tail's symbol/stat fetches
+    (``parallel.base.fetch_host``), and full count-tensor pulls
+    (checkpoint snapshots, ladder demotions, paranoid cross-checks,
+    overflow fallbacks via ``counts_host``).  Before this, the
+    gather-based and counts-pull routes bypassed the accounting
+    entirely and ``wire/d2h_bytes`` was a tail-output model, not a
+    measurement.  ``link_free`` skips the bill when the fetch is a host
+    memcpy (the default backend IS the cpu, or a tail explicitly
+    committed to the local cpu device — callers that know pass it)."""
+    if link_free is None:
+        link_free = link_free_default()
+    if link_free or nbytes <= 0:
+        return
+    from .. import observability as obs
+
+    obs.metrics().add("wire/d2h_bytes", int(nbytes))
+
+
+def fetch_d2h(x, link_free=None):
+    """``np.asarray`` with the transfer billed through
+    :func:`account_d2h`; returns the host array."""
+    import numpy as np
+
+    arr = np.asarray(x)
+    account_d2h(arr.nbytes, link_free)
+    return arr
+
+
 __all__ = [
     "CODECS", "WireSlab", "encode_slab", "decode_slab_host",
     "modeled_wire_ratio", "packed5_slab_bytes", "resolve_codec",
     "row_bytes_estimate", "wire_auto_cutoff_bps", "worthwhile",
+    "account_d2h", "fetch_d2h", "link_free_default",
 ]

@@ -1,0 +1,293 @@
+"""Incremental consensus via the per-reference count cache (ISSUE 13).
+
+The serving contract under test: a tenant streaming new reads against
+a warm reference pays only delta decode + scatter + re-vote, and the
+combined output is byte-identical to a cold run over the concatenated
+inputs — the same sum-decomposition the checkpointed ``--incremental``
+CLI mode already pins, promoted to the warm serve path.  Failure obeys
+the count-bank rule (a seeded job that fails invalidates its entry
+whole) and eviction under the LRU byte budget must never corrupt a
+re-ingested reference.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+jax = pytest.importorskip("jax")
+
+from sam2consensus_tpu.serve import JobSpec, ServeRunner  # noqa: E402
+from sam2consensus_tpu.serve import countcache  # noqa: E402
+
+
+# -- units ----------------------------------------------------------------
+
+def test_parse_budget_grammar():
+    pb = countcache.parse_budget
+    assert pb(None) == 0
+    assert pb("off") == 0
+    assert pb("0") == 0
+    assert pb("1048576") == 1 << 20
+    assert pb("512M") == 512 << 20
+    assert pb("2g") == 2 << 30
+    assert pb("1.5K") == 1536
+    for bad in ("lots", "12Q", "-5", "3 M"):
+        with pytest.raises(ValueError):
+            pb(bad)
+
+
+def _state(nbytes, tag="s"):
+    from sam2consensus_tpu.encoder.events import InsertionEvents
+    from sam2consensus_tpu.utils.checkpoint import CheckpointState
+
+    counts = np.zeros((max(1, nbytes // 24), 6), np.int32)
+    return CheckpointState(counts=counts, lines_consumed=0,
+                           reads_mapped=0, reads_skipped=0,
+                           aligned_bases=0,
+                           insertions=InsertionEvents(),
+                           source="", sources=[tag])
+
+
+def test_lru_eviction_under_budget():
+    cache = countcache.CountCache(10_000)
+    cache.put("a", _state(4_000, "a"))
+    cache.put("b", _state(4_000, "b"))
+    assert cache.stats()["entries"] == 2
+    assert cache.get("a") is not None        # touch: b becomes LRU
+    cache.put("c", _state(4_000, "c"))       # evicts b
+    assert cache.get("b") is None
+    assert cache.get("a") is not None
+    assert cache.get("c") is not None
+    s = cache.stats()
+    assert s["evictions"] == 1 and s["entries"] == 2
+    # an entry larger than the whole budget is refused, nothing evicted
+    cache.put("huge", _state(50_000, "huge"))
+    assert cache.get("huge") is None
+    assert cache.stats()["entries"] == 2
+    # invalidation drops whole
+    assert cache.invalidate("a") is True
+    assert cache.invalidate("a") is False
+    assert cache.stats()["invalidated"] == 1
+
+
+def test_reference_key_sensitivity():
+    from sam2consensus_tpu.io.sam import Contig
+
+    ref = [Contig("c1", 100), Contig("c2", 200)]
+    cfg = RunConfig(backend="jax")
+    k0 = countcache.reference_key(ref, cfg, "")
+    # vote/render knobs do NOT key (counts are pre-vote state)
+    assert countcache.reference_key(
+        ref, RunConfig(backend="jax", thresholds=[0.5], fill="N",
+                       min_depth=9), "") == k0
+    # layout, tenant, and count-relevant encode knobs DO
+    assert countcache.reference_key(
+        [Contig("c1", 100), Contig("c2", 201)], cfg, "") != k0
+    assert countcache.reference_key(ref, cfg, "tenant_a") != k0
+    assert countcache.reference_key(
+        ref, RunConfig(backend="jax", maxdel=3), "") != k0
+
+
+# -- serve integration ----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def shard_files(tmp_path_factory):
+    """Two read shards over ONE reference layout + their concatenation,
+    plus a second reference's input (for eviction pressure)."""
+    tmp = tmp_path_factory.mktemp("incr")
+    kw = dict(n_contigs=2, contig_len=1500, read_len=60,
+              contig_len_jitter=0.0, ins_read_rate=0.2,
+              del_read_rate=0.2, contig_prefix="ref")
+    ta = simulate(SimSpec(n_reads=2400, seed=11, **kw))
+    tb = simulate(SimSpec(n_reads=240, seed=99, **kw))
+    tr2 = simulate(SimSpec(n_contigs=1, contig_len=900, n_reads=800,
+                           read_len=60, contig_len_jitter=0.0, seed=5,
+                           contig_prefix="other"))
+    paths = {}
+    for name, text in (("a", ta), ("b", tb), ("r2", tr2)):
+        p = tmp / f"{name}.sam"
+        p.write_text(text)
+        paths[name] = str(p)
+    la, lb = ta.splitlines(True), tb.splitlines(True)
+    hdr = [ln for ln in la if ln.startswith("@")]
+    body = [ln for ln in la if not ln.startswith("@")] \
+        + [ln for ln in lb if not ln.startswith("@")]
+    p = tmp / "combined.sam"
+    p.write_text("".join(hdr + body))
+    paths["combined"] = str(p)
+    return paths
+
+
+def _cfg(incremental, **kw):
+    return RunConfig(backend="jax", prefix="t", thresholds=[0.25, 0.5],
+                     incremental=incremental, **kw)
+
+
+def _render(res):
+    return {n: render_file(v, 0) for n, v in res.fastas.items()}
+
+
+def test_serve_incremental_warm_equals_cold(shard_files):
+    """The acceptance matrix in one queue: cold absorb (miss), warm
+    delta shard (hit, == cold-combined), duplicate re-submit (no-op,
+    == cold-combined), with counters/decision/health/exposition/top
+    all carrying the cache story."""
+    r = ServeRunner(prewarm="off", persistent_cache=False,
+                    count_cache="64M")
+    try:
+        res = r.submit_jobs([
+            JobSpec(filename=shard_files["a"], config=_cfg(True),
+                    job_id="A"),
+            JobSpec(filename=shard_files["b"], config=_cfg(True),
+                    job_id="B"),
+            JobSpec(filename=shard_files["b"], config=_cfg(True),
+                    job_id="Bdup"),
+            JobSpec(filename=shard_files["combined"],
+                    config=_cfg(False), job_id="COLD"),
+        ])
+        assert all(x.ok for x in res), [x.error for x in res]
+        cold = _render(res[3])
+        assert _render(res[1]) == cold           # warm delta == combined
+        assert _render(res[2]) == cold           # duplicate adds nothing
+        assert res[0].metrics.get("cache/misses") == 1
+        assert res[1].metrics.get("cache/hits") == 1
+        assert res[2].metrics.get("cache/hits") == 1
+        assert res[2].stats.extra.get("incremental_duplicate") \
+            == os.path.abspath(shard_files["b"])
+        # the decision rode the warm job's manifest ledger
+        recs = {d["decision"]: d for d in res[1].manifest["decisions"]}
+        assert recs["count_cache"]["chosen"] == "warm"
+        assert recs["count_cache"]["inputs"]["entries"] == 1
+        # health + exposition + operator top line
+        snap = r.health_snapshot()
+        assert snap["count_cache"]["hits"] == 2
+        assert snap["count_cache"]["entries"] == 1
+        from sam2consensus_tpu.observability.telemetry import (
+            lint_openmetrics, parse_openmetrics)
+
+        text = r.render_telemetry()
+        assert lint_openmetrics(text) == []
+        samples = parse_openmetrics(text)
+        by_name = {s["name"]: s["value"] for s in samples}
+        assert by_name["s2c_cache_hits_total"] == 2
+        assert by_name["s2c_cache_entries"] == 1
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "s2c_top", os.path.join(os.path.dirname(__file__), "..",
+                                    "tools", "s2c_top.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        frame = "\n".join(mod.render(snap, samples))
+        assert "count cache: 1 entry" in frame
+        assert "2 hits" in frame
+    finally:
+        r.close()
+
+
+def test_eviction_under_pressure_reingest_identity(shard_files):
+    """Budget fits ONE entry: alternating references evict each other,
+    and a re-ingested (evicted) reference's cold re-absorb is
+    byte-identical to its original cached run."""
+    # budget sized between one entry (~72K for the 2x1500-position
+    # reference) and two, so the second reference must evict the first
+    r = ServeRunner(prewarm="off", persistent_cache=False,
+                    count_cache="80K")
+    try:
+        res = r.submit_jobs([
+            JobSpec(filename=shard_files["a"], config=_cfg(True),
+                    job_id="r1_first"),
+            JobSpec(filename=shard_files["r2"], config=_cfg(True),
+                    job_id="r2"),
+            JobSpec(filename=shard_files["a"], config=_cfg(True),
+                    job_id="r1_again"),
+        ])
+        assert all(x.ok for x in res), [x.error for x in res]
+        s = r.count_cache.stats()
+        assert s["evictions"] >= 1, s
+        # r1 was evicted by r2 -> its re-ingest is a miss, absorbed
+        # cold, and must render the bytes the cached run produced
+        assert res[2].metrics.get("cache/misses") == 1
+        assert _render(res[2]) == _render(res[0])
+    finally:
+        r.close()
+
+
+def test_failed_incremental_invalidates_entry(shard_files, tmp_path):
+    """The count-bank rule's failure edge: a poison delta shard fails
+    its job AND drops the reference's warm entry whole — the next
+    submission re-absorbs from scratch rather than inheriting state a
+    failed job may have half-applied."""
+    bad = tmp_path / "bad.sam"
+    hdr = "".join(ln for ln in open(shard_files["a"])
+                  if ln.startswith("@"))
+    bad.write_text(hdr + "r1\t0\tref0000\t5\t60\t10M\t*\t0\t0\t"
+                   "ACGTACGTAZ\t*\n")
+    r = ServeRunner(prewarm="off", persistent_cache=False,
+                    count_cache="64M")
+    try:
+        res = r.submit_jobs([
+            JobSpec(filename=shard_files["a"], config=_cfg(True),
+                    job_id="A"),
+            JobSpec(filename=str(bad), config=_cfg(True), job_id="BAD"),
+        ])
+        assert res[0].ok and not res[1].ok
+        s = r.count_cache.stats()
+        assert s["entries"] == 0
+        assert s["invalidated"] == 1
+        # server survives; the reference re-absorbs clean
+        res2 = r.submit_jobs([JobSpec(filename=shard_files["a"],
+                                      config=_cfg(True), job_id="A2")])
+        assert res2[0].ok
+        assert res2[0].metrics.get("cache/misses") == 1
+        assert _render(res2[0]) == _render(res[0])
+    finally:
+        r.close()
+
+
+def test_serve_validate_rejections(shard_files, tmp_path):
+    # incremental without the cache: rejected with a pointer
+    r = ServeRunner(prewarm="off", persistent_cache=False)
+    try:
+        with pytest.raises(ValueError, match="count-cache"):
+            r.submit_jobs([JobSpec(filename=shard_files["a"],
+                                   config=_cfg(True))])
+    finally:
+        r.close()
+    # incremental + journal: two sources of resumable state
+    r = ServeRunner(prewarm="off", persistent_cache=False,
+                    count_cache="8M",
+                    journal_dir=str(tmp_path / "j"))
+    try:
+        with pytest.raises(ValueError, match="journal"):
+            r.submit_jobs([JobSpec(filename=shard_files["a"],
+                                   config=_cfg(True))])
+    finally:
+        r.close()
+    # a typo'd budget fails the server start
+    with pytest.raises(ValueError, match="count-cache"):
+        ServeRunner(prewarm="off", persistent_cache=False,
+                    count_cache="lots")
+
+
+def test_incremental_jobs_never_pack(shard_files):
+    """Continuous batching must not pack an incremental job — its
+    accumulator seeds from warm state no shared tensor holds."""
+    r = ServeRunner(prewarm="off", persistent_cache=False,
+                    count_cache="64M", batch="4")
+    try:
+        entry = {"action": "run", "cfg": _cfg(True),
+                 "spec": JobSpec(filename=shard_files["a"],
+                                 config=_cfg(True))}
+        assert not r.scheduler.eligible(entry)
+        entry2 = {"action": "run", "cfg": _cfg(False),
+                  "spec": JobSpec(filename=shard_files["a"],
+                                  config=_cfg(False))}
+        assert r.scheduler.eligible(entry2)
+    finally:
+        r.close()

@@ -1,0 +1,236 @@
+"""Device-resident epilogue (ISSUE 13): fused vote→IUPAC→FASTA on
+device, donated count buffers, and the d2h accounting choke point.
+
+The tentpole's correctness contract is the byte-identity matrix: with
+the epilogue device-routed (fill substituted inside the vote's emit
+select, per-(T, C) dash totals packed into the tail buffer) the FASTA
+output must equal the CPU oracle's across the threshold grid ×
+min_depth × output encodings × fills — including fills the device
+CANNOT represent (multi-char, outside the packed5 symbol space), which
+must fall back to the host epilogue and still match.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from sam2consensus_tpu.backends.cpu import CpuBackend
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.io.sam import iter_records, read_header
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+jax = pytest.importorskip("jax")
+
+from sam2consensus_tpu.backends.jax_backend import JaxBackend  # noqa: E402
+
+
+def _run(text, backend, cfg):
+    handle = io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    res = backend.run(contigs, iter_records(handle, first), cfg)
+    return {n: render_file(r, 0) for n, r in res.fastas.items()}, res.stats
+
+
+@pytest.fixture(scope="module")
+def ins_heavy_text():
+    """Multi-contig, insertion/deletion-heavy fixture: exercises the
+    splice path, lowercase IUPAC calls, and the empty-drop gates."""
+    return simulate(SimSpec(n_contigs=3, contig_len=2500, n_reads=2500,
+                            read_len=60, ins_read_rate=0.3,
+                            del_read_rate=0.3, seed=907))
+
+
+# -- units ----------------------------------------------------------------
+
+def test_device_fill_code_resolution():
+    from sam2consensus_tpu.constants import SYM32_ASCII
+    from sam2consensus_tpu.ops.vote import device_fill_code
+
+    assert device_fill_code("-", "ascii") == ord("-")
+    assert device_fill_code("N", "ascii") == ord("N")
+    assert device_fill_code("\xc8", "ascii") == 0xC8   # any latin-1
+    assert device_fill_code("xy", "ascii") is None     # multi-char
+    assert device_fill_code("ሴ", "ascii") is None  # non-latin
+    # code5: only the 32-symbol vote alphabet fits the packed planes
+    assert device_fill_code("-", "code5") == 1
+    assert SYM32_ASCII[device_fill_code("N", "code5")] == ord("N")
+    assert device_fill_code("x", "code5") is None      # not in SYM32
+    assert device_fill_code("xy", "code5") is None
+
+
+def test_contig_dash_counts_matches_numpy():
+    import jax.numpy as jnp
+
+    from sam2consensus_tpu.ops import fused
+
+    rng = np.random.default_rng(3)
+    syms = rng.choice(
+        np.frombuffer(b"-ACGTNmrwn", np.uint8), size=(3, 1000))
+    offsets = np.array([0, 120, 120, 777, 1000], dtype=np.int32)
+    got = np.asarray(fused.contig_dash_counts(
+        jnp.asarray(syms), jnp.asarray(offsets), ord("-")))
+    want = np.stack([
+        [(syms[t, offsets[c]:offsets[c + 1]] == ord("-")).sum()
+         for c in range(4)] for t in range(3)])
+    assert np.array_equal(got, want)
+
+
+def test_donated_tail_invalidates_cached_upload():
+    """Donating the HostPileupAccumulator's cached device copy must
+    drop the cache (the buffer is dead), and the re-upload on the next
+    call must produce identical bytes — the retry-soundness contract."""
+    import jax.numpy as jnp
+
+    from sam2consensus_tpu.backends.jax_backend import _fused_tail_call
+    from sam2consensus_tpu.ops import fused
+    from sam2consensus_tpu.ops.cutoff import encode_thresholds
+    from sam2consensus_tpu.ops.pileup import HostPileupAccumulator
+
+    acc = HostPileupAccumulator(64)
+    counts = np.zeros((64, 6), np.int32)
+    counts[:32, 1] = 5
+    counts[5, 0] = 9
+    acc.set_counts(counts)
+    thr = jnp.asarray(encode_thresholds([0.25]))
+    offs = jnp.asarray(np.array([0, 64], np.int32))
+    _ = acc.counts
+    assert acc._device_counts is not None
+    out1 = np.asarray(_fused_tail_call(
+        fused.vote_packed_simple, fused.vote_packed_simple_donated,
+        True, acc, acc.counts, thr, offs, 1, None, ord("-"), True))
+    assert acc._device_counts is None        # invalidated post-donation
+    out2 = np.asarray(_fused_tail_call(
+        fused.vote_packed_simple, fused.vote_packed_simple_donated,
+        True, acc, acc.counts, thr, offs, 1, None, ord("-"), True))
+    assert np.array_equal(out1, out2)
+
+
+def test_d2h_choke_point_bills_fetches(monkeypatch):
+    """Every d2h route bills wire/d2h_bytes at the one choke point —
+    including the count-tensor pull (counts_host) that previously
+    escaped the accounting — and link-free fetches bill nothing."""
+    from sam2consensus_tpu import observability as obs
+    from sam2consensus_tpu import wire
+
+    robs = obs.start_run()
+    try:
+        reg = obs.metrics()
+        arr = np.arange(1000, dtype=np.int32)
+        wire.account_d2h(123, link_free=True)
+        assert reg.value("wire/d2h_bytes") == 0
+        got = wire.fetch_d2h(arr, link_free=False)
+        assert np.array_equal(got, arr)
+        assert reg.value("wire/d2h_bytes") == arr.nbytes
+        # the device accumulator's counts_host pull (checkpoint /
+        # demotion / paranoid route) bills through the same point;
+        # pretend the default backend has a real link
+        monkeypatch.setattr(wire, "link_free_default", lambda: False)
+        from sam2consensus_tpu.ops.pileup import PileupAccumulator
+
+        acc = PileupAccumulator(100, strategy="scatter")
+        before = reg.value("wire/d2h_bytes")
+        _ = acc.counts_host()
+        assert reg.value("wire/d2h_bytes") >= before + 100 * 6 * 4
+    finally:
+        obs.finish_run(robs)
+
+
+# -- the byte-identity matrix --------------------------------------------
+
+@pytest.mark.parametrize("enc", ["dense", "sparse", "packed5"])
+@pytest.mark.parametrize("thresholds", [[0.25], [0.25, 0.5, 0.75]])
+def test_epilogue_matrix_encodings(ins_heavy_text, monkeypatch, enc,
+                                   thresholds):
+    monkeypatch.setenv("S2C_TAIL_ENCODING", enc)
+    cfg = RunConfig(prefix="t", thresholds=thresholds, min_depth=2,
+                    shards=1)
+    out_cpu, _ = _run(ins_heavy_text, CpuBackend(), cfg)
+    out_jax, st = _run(ins_heavy_text, JaxBackend(), cfg)
+    assert out_jax == out_cpu
+    # the epilogue must actually have run on the (XLA) device side
+    assert st.extra.get("epilogue/device_tails") == 1, st.extra
+
+
+@pytest.mark.parametrize("fill,enc,expect_device", [
+    ("N", "packed5", True),    # in the 32-symbol space: device
+    ("x", "packed5", False),   # outside SYM32: host fallback
+    ("x", "dense", True),      # dense ships raw bytes: device
+    ("xy", "dense", False),    # multi-char: host fallback
+])
+def test_epilogue_matrix_fills(ins_heavy_text, monkeypatch, fill, enc,
+                               expect_device):
+    monkeypatch.setenv("S2C_TAIL_ENCODING", enc)
+    cfg = RunConfig(prefix="t", thresholds=[0.25, 0.5], fill=fill,
+                    min_depth=3, shards=1)
+    out_cpu, _ = _run(ins_heavy_text, CpuBackend(), cfg)
+    out_jax, st = _run(ins_heavy_text, JaxBackend(), cfg)
+    assert out_jax == out_cpu
+    key = "epilogue/device_tails" if expect_device \
+        else "epilogue/host_tails"
+    assert st.extra.get(key) == 1, st.extra
+
+
+def test_epilogue_forced_host_identical(ins_heavy_text, monkeypatch):
+    """S2C_EPILOGUE=host pins the classic host render; bytes match."""
+    monkeypatch.setenv("S2C_TAIL_ENCODING", "dense")
+    monkeypatch.setenv("S2C_EPILOGUE", "host")
+    cfg = RunConfig(prefix="t", thresholds=[0.25], shards=1)
+    out_cpu, _ = _run(ins_heavy_text, CpuBackend(), cfg)
+    out_jax, st = _run(ins_heavy_text, JaxBackend(), cfg)
+    assert out_jax == out_cpu
+    assert st.extra.get("epilogue/host_tails") == 1
+
+
+def test_epilogue_env_typo_fails(ins_heavy_text, monkeypatch):
+    monkeypatch.setenv("S2C_EPILOGUE", "dev")
+    cfg = RunConfig(prefix="t", thresholds=[0.25], shards=1)
+    with pytest.raises(ValueError, match="S2C_EPILOGUE"):
+        _run(ins_heavy_text, JaxBackend(), cfg)
+
+
+def test_epilogue_forced_device_rejects_unrepresentable_fill(
+        ins_heavy_text, monkeypatch):
+    """S2C_EPILOGUE=device must not silently measure the host path: an
+    unrepresentable fill is a loud config conflict, not a fallback."""
+    monkeypatch.setenv("S2C_EPILOGUE", "device")
+    cfg = RunConfig(prefix="t", thresholds=[0.25], fill="xy", shards=1)
+    with pytest.raises(ValueError, match="not.*representable"):
+        _run(ins_heavy_text, JaxBackend(), cfg)
+    # representable fill: forced device works and matches the oracle
+    monkeypatch.setenv("S2C_TAIL_ENCODING", "dense")
+    cfg = RunConfig(prefix="t", thresholds=[0.25], fill="N", shards=1)
+    out_cpu, _ = _run(ins_heavy_text, CpuBackend(), cfg)
+    out_jax, st = _run(ins_heavy_text, JaxBackend(), cfg)
+    assert out_jax == out_cpu
+    assert st.extra.get("epilogue/device_tails") == 1
+
+
+def test_epilogue_donated_end_to_end(ins_heavy_text, monkeypatch):
+    """Forced-on donation (a cpu no-op, but the code path is real):
+    identical bytes, and the retry policy still sound after donation."""
+    monkeypatch.setenv("S2C_TAIL_ENCODING", "dense")
+    monkeypatch.setenv("S2C_DONATE_COUNTS", "on")
+    cfg = RunConfig(prefix="t", thresholds=[0.25, 0.5], shards=1)
+    out_cpu, _ = _run(ins_heavy_text, CpuBackend(), cfg)
+    out_jax, st = _run(ins_heavy_text, JaxBackend(), cfg)
+    assert out_jax == out_cpu
+
+
+def test_epilogue_decision_in_ledger(ins_heavy_text, monkeypatch,
+                                     tmp_path):
+    """The epilogue placement is a ledger decision in the manifest,
+    alternatives priced, measured joined against the render phase."""
+    monkeypatch.setenv("S2C_TAIL_ENCODING", "dense")
+    cfg = RunConfig(prefix="t", thresholds=[0.25], shards=1,
+                    metrics_out=str(tmp_path / "m.jsonl"))
+    _out, _st = _run(ins_heavy_text, JaxBackend(), cfg)
+    import json
+
+    man = json.load(open(tmp_path / "m.jsonl.manifest.json"))
+    recs = {d["decision"]: d for d in man["decisions"]}
+    assert recs["epilogue"]["chosen"] == "device"
+    assert set(recs["epilogue"]["alternatives"]) == {"device", "host"}
+    assert "sec" in recs["epilogue"]["predicted"]

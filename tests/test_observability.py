@@ -303,12 +303,21 @@ def test_export_concurrent_with_recording(tmp_path):
     stop = threading.Event()
 
     def hammer():
+        # paced, not free-spinning: on a 1-core host three unthrottled
+        # recording threads starve the exporting main thread (the GIL
+        # round-robins ~75% of cycles to them) and the event buffer
+        # outgrows each export pass — a livelock that timed out the
+        # whole suite.  The property under test is schema validity of
+        # exports taken WHILE other threads record, which a paced
+        # recorder exercises identically.
         i = 0
         while not stop.is_set():
             with tr.span("hot", i=i):
                 reg.add("phase/hot_sec", 1e-6)
                 reg.observe("h", float(i % 7))
             i += 1
+            if i % 64 == 0:
+                stop.wait(0.001)
 
     workers = [threading.Thread(target=hammer) for _ in range(3)]
     for w in workers:

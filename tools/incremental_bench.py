@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""Incremental-consensus benchmark (the ISSUE-13 tentpole's evidence).
+
+Measures what a tenant pays to add +N% reads against a reference whose
+count state is already warm in the serve count cache
+(``--count-cache``; serve/countcache.py) vs the cold job over the
+combined input — both through ONE warm ServeRunner, outputs
+byte-compared before anything is timed, min-of-N alternating passes
+(each warm pass restores the cache entry to its post-base state so the
+duplicate-input no-op can't flatter the number).  Writes per-pass rows
+plus a summary row as JSONL (``--out``; stdout otherwise).  The
+summary's ``incr_cost_ratio`` (target <= 0.15) and ``identical`` are
+the acceptance numbers; ``cache`` (hit/evict counters) and
+``decision`` (the count_cache ledger record with its residual) are
+the why.
+
+Campaign usage (tools/tpu_campaign.sh step ``incremental``) tags the
+artifact per round; the CPU-fallback harness proof lives at
+campaign/incremental_r06_cpufallback.jsonl.
+
+Usage: python tools/incremental_bench.py [--reads 1000000]
+       [--extra-pct 10] [--contig-len 50000] [--read-len 100]
+       [--passes 3] [--cache 256M] [--out FILE.jsonl]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--reads", type=int, default=1_000_000,
+                    help="base read count the reference absorbs first")
+    ap.add_argument("--extra-pct", type=int, default=10)
+    ap.add_argument("--contig-len", type=int, default=50_000)
+    ap.add_argument("--read-len", type=int, default=100)
+    ap.add_argument("--passes", type=int, default=3)
+    ap.add_argument("--cache", default="256M",
+                    help="count-cache byte budget")
+    ap.add_argument("--out", default=None,
+                    help="JSONL destination (default: stdout)")
+    args = ap.parse_args(argv)
+
+    from sam2consensus_tpu.utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
+
+    from sam2consensus_tpu.serve.benchmark import run_incremental_bench
+
+    res = run_incremental_bench(
+        n_reads=args.reads, extra_pct=args.extra_pct,
+        contig_len=args.contig_len, read_len=args.read_len,
+        passes=args.passes, cache_budget=args.cache, log=log)
+    lines = [json.dumps(r) for r in res["rows"]]
+    lines.append(json.dumps(res["summary"]))
+    blob = "\n".join(lines) + "\n"
+    if args.out and args.out != "-":
+        with open(args.out, "w") as fh:
+            fh.write(blob)
+        log(f"[incremental] wrote {args.out}")
+    else:
+        sys.stdout.write(blob)
+    s = res["summary"]
+    return 0 if (s["identical"]
+                 and s["incr_cost_ratio"] <= s["target_ratio"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -136,6 +136,26 @@ def render(health, samples, now=None):
             f"{0.0 if bjps is None else bjps:.1f} packed-jobs/s  "
             f"({int(npacked or 0)} packed total"
             + (f", mode {bat.get('mode')}" if bat else "") + ")")
+    # incremental count cache (s2c_cache_* family, falling back to the
+    # health snapshot's count_cache section when no exposition is wired)
+    cent = _sample(samples, "s2c_cache_entries")
+    cbytes = _sample(samples, "s2c_cache_resident_bytes")
+    chits = _sample(samples, "s2c_cache_hits_total")
+    cevict = _sample(samples, "s2c_cache_evictions_total")
+    cc = health.get("count_cache") or {}
+    if cent is None and cc:
+        cent = cc.get("entries")
+        cbytes = (cc.get("resident_mb") or 0.0) * 1e6
+        chits = cc.get("hits")
+        cevict = cc.get("evictions")
+    if cent is not None or cc:
+        lines.append(
+            f"count cache: {int(cent or 0)} entr"
+            f"{'y' if int(cent or 0) == 1 else 'ies'}  "
+            f"{(cbytes or 0.0) / 1e6:.1f} MB resident  "
+            f"{int(chits or 0)} hits  {int(cevict or 0)} evictions"
+            + (f"  (budget {cc.get('budget_mb')} MB)"
+               if cc.get("budget_mb") else ""))
     # per-tenant table from the exposition (p50/p99 e2e + rung)
     rungs = health.get("tenant_rungs", {})
     tenants = _tenants(samples) or sorted(rungs) or []
