@@ -359,6 +359,24 @@ def util_fields(stats, jax_time):
     if ds > 0:
         u["decode_mbases_per_s"] = round(
             stats.aligned_bases / ds / 1e6, 1)
+    # memory plane (observability/memplane.py): per-family peak bytes
+    # + process/device watermarks, so every bench row answers "what
+    # did this config pin" and the regression gate can band it
+    mem = {}
+    for k, v in stats.extra.items():
+        if k.startswith("mem/peak_bytes/"):
+            mem[k[len("mem/peak_bytes/"):] + "_peak_mb"] = \
+                round(v / 1e6, 2)
+    ptb = stats.extra.get("mem/peak_tracked_bytes")
+    if ptb:
+        mem["tracked_peak_mb"] = round(ptb / 1e6, 2)
+    if stats.extra.get("peak_rss_mb"):
+        mem["peak_rss_mb"] = stats.extra["peak_rss_mb"]
+    if stats.extra.get("mem/device_peak_bytes"):
+        mem["device_peak_mb"] = round(
+            stats.extra["mem/device_peak_bytes"] / 1e6, 2)
+    if mem:
+        u["mem"] = mem
     # placement-gate decisions, from the observability registry's compat
     # view (backends/jax_backend._tail_cpu_wins records the model's
     # verdict with its cpu_sec/chip_sec/link inputs; the pileup gauge
@@ -466,6 +484,12 @@ def _jax_row(name, path, cfg_kwargs, overrides, cpu_time, cpu_out):
         "util": util_fields(jax_stats, jax_time),
         "pileup": jax_stats.extra.get("pileup", {}),
     }
+    # top-level so tools/regress_check.py bands it per config like
+    # jax_sec (process peak RSS is monotone within one bench process;
+    # the per-config isolation leg is tools/mem_watermark.py, which
+    # runs each config in its own subprocess)
+    if jax_stats.extra.get("peak_rss_mb"):
+        row["peak_rss_mb"] = jax_stats.extra["peak_rss_mb"]
     if cpu_out is not None:
         row["identical"] = jax_out == cpu_out
     if "insertion_kernel" in jax_stats.extra:

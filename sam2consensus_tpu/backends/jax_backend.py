@@ -41,6 +41,7 @@ import numpy as np
 
 from .. import observability as obs
 from ..config import RunConfig
+from ..observability import memplane
 from ..constants import NUM_SYMBOLS
 from ..io.sam import Contig, SamRecord
 from .base import BackendResult, BackendStats, FastaRecord, format_header
@@ -592,6 +593,10 @@ class JaxBackend:
         faultinject.configure(getattr(cfg, "fault_inject", "") or None)
         try:
             result = self._run(contigs, records, cfg)
+            # end-of-run watermark sample (observability/memplane.py):
+            # the run's registry carries its RSS/device peaks into the
+            # manifest + bench rows alongside the per-family gauges
+            memplane.sample()
             # join the run's decision ledger against its measured
             # counters BEFORE deriving the compat view, so residual/*
             # and drift/* reach stats.extra (and the bench rows)
@@ -607,6 +612,17 @@ class JaxBackend:
                 # whichever rung/thread): leave the evidence — sidecar
                 # + counters — before the typed failure propagates
                 abort_bookkeeping(exc, obs.metrics())
+            # OOM forensics: a CAPACITY-class escape writes
+            # mem_dump.json next to the run's metrics artifact (the
+            # manifest's home — one-shot runs without --metrics-out
+            # have no durable home and skip; the serve runner dumps
+            # next to its journal for those)
+            if robs.metrics_out:
+                memplane.dump_on_capacity(
+                    exc, os.path.dirname(os.path.abspath(
+                        robs.metrics_out)),
+                    registry=robs.registry,
+                    context={"backend": self.name})
             raise
         finally:
             faultinject.configure("")
@@ -722,6 +738,15 @@ class JaxBackend:
                     {"path": "device", "strategy": strategy,
                      "wire": wire_sel,
                      "total_len": int(layout.total_len)})
+
+        # capacity: the run's predicted peak host+device bytes as a
+        # priced ledger decision (observability/memplane.py), joined
+        # against the measured mem/peak_tracked_bytes ratchet at
+        # finalize — the same model serve admission sheds against
+        memplane.record_capacity(
+            layout.total_len, n_thresholds=len(cfg.thresholds),
+            chunk_reads=cfg.chunk_reads, shards=shards,
+            segment_width=max(0, getattr(cfg, "segment_width", 0)))
 
         # checkpoint resume: counts + insertion log + consumed-line offset
         # are the entire job state (SURVEY.md §5)
@@ -1138,16 +1163,14 @@ class JaxBackend:
                     acc, layout.total_len, exc, checkpoint_cb=ckpt_cb)
                 use_sharded = False
                 demoted_tail = True
-        # wire accounting (bench utilization rows): bytes shipped up
-        # during accumulation, and every device→host fetch billed at
-        # the ONE choke point (wire.account_d2h: the fused tail's
-        # packed buffer, the sharded gather fetches, count-tensor pulls
-        # — link-free fetches bill nothing).  stats.extra mirrors the
-        # registry instead of re-modeling the tail output size, so
-        # routes that fetch outside the packed buffer can no longer
-        # escape the ≥5x d2h claim's measurement.
-        stats.extra["h2d_bytes"] = int(getattr(acc, "bytes_h2d", 0))
-        reg.add("wire/h2d_bytes", stats.extra["h2d_bytes"])
+        # wire accounting (bench utilization rows): BOTH directions now
+        # mirror the registry's choke points — h2d billed per upload at
+        # wire.account_h2d (staged slabs, kernel plans, counts uploads,
+        # prewarm compiles), d2h per fetch at wire.account_d2h — so
+        # stats.extra reads the ledger instead of re-summing
+        # per-accumulator attributes, and no route can escape either
+        # direction's measurement.
+        stats.extra["h2d_bytes"] = int(reg.value("wire/h2d_bytes"))
         stats.extra["d2h_bytes"] = int(reg.value("wire/d2h_bytes"))
         if getattr(acc, "strategy_used", None):
             # refresh: the host-counts path records its wire dtype at upload
@@ -1511,6 +1534,17 @@ class JaxBackend:
             # vote past n_cols and come back as skip sentinels
             kp = fused.next_pow2(k + 1)
             cp = fused.next_pow2(ins["max_cols"])
+            # residency: the [kp, cp, 6] int32 table plus the padded
+            # event lanes are the insertion path's real allocations
+            # (observability/memplane.py insertion_table family).
+            # Tracked against the ACCUMULATOR — the table's lifetime is
+            # the tail's, which the accumulator outlives by one release
+            # point; a dict can't carry the weakref the auto-release
+            # needs.
+            memplane.track_obj(
+                "insertion_table", acc,
+                kp * cp * 6 * 4
+                + 3 * 4 * fused.next_pow2(max(len(ins["ev_key"]), 1)))
             ik = getattr(cfg, "ins_kernel", "auto")
             if ik == "auto":
                 # chip-resident tails only (never preempts the

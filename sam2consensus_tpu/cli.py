@@ -516,6 +516,16 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    default=0,
                    help="admission control: max admitted jobs per "
                         "tenant per submission (0 = unbounded)")
+    p.add_argument("--mem-budget", dest="mem_budget", default=None,
+                   help="capacity-priced admission (observability/"
+                        "memplane.py): a job whose predicted peak "
+                        "host+device bytes (from its header-probed "
+                        "genome length, threshold grid and slab "
+                        "geometry) exceeds this budget is shed with "
+                        "reason 'capacity' instead of OOMing the warm "
+                        "server.  Size grammar like --count-cache "
+                        "('4G', '512M'); 'off'/unset disables; env "
+                        "S2C_MEM_BUDGET")
     p.add_argument("--health-out", dest="health_out", default=None,
                    help="write an atomic health/readiness snapshot "
                         "(queue depth, in-flight job, heartbeat age, "
@@ -618,6 +628,12 @@ def serve_main(argv: List[str]) -> int:
             else os.environ.get("S2C_COUNT_CACHE")) > 0
     except ValueError as exc:
         raise SystemExit(f"error: {exc}") from None
+    try:
+        parse_budget(args.mem_budget if args.mem_budget is not None
+                     else os.environ.get("S2C_MEM_BUDGET"))
+    except ValueError as exc:
+        raise SystemExit("error: " + str(exc).replace(
+            "--count-cache", "--mem-budget")) from None
     if args.incremental and not cache_on:
         raise SystemExit(
             "error: --incremental serve jobs need --count-cache SIZE "
@@ -678,7 +694,8 @@ def serve_main(argv: List[str]) -> int:
                          profile_capture_dir=args.profile_capture_dir,
                          batch=args.batch,
                          batch_window=args.batch_window,
-                         count_cache=args.count_cache)
+                         count_cache=args.count_cache,
+                         mem_budget=args.mem_budget)
     echo(f"\nServing {len(specs)} job(s) on one warm backend"
          + (f" (jit cache: {runner.cache_dir})" if runner.cache_dir
             else "")

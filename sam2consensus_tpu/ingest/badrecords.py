@@ -237,6 +237,22 @@ def policy_from_config(cfg) -> BadRecordPolicy:
                            sidecar_max=max(0, sidecar_max))
 
 
+def _entry_nbytes(entry: dict) -> int:
+    """Approximate resident bytes of one stored quarantine entry (the
+    record text dominates; 160 covers the dict/key overhead) — the
+    memory plane's sizing for the ``quarantine`` family."""
+    return len(entry.get("record") or "") \
+        + len(entry.get("error") or "") + 160
+
+
+def _release_quarantine(cell: dict) -> None:
+    """weakref finalizer: release whatever the sink still tracked when
+    it was collected (module-level so the finalizer holds no sink ref)."""
+    from ..observability import memplane
+
+    memplane.adjust("quarantine", -cell["bytes"])
+
+
 class _Partition:
     """One partition's bad-record state: counts always, stored entries
     only in quarantine mode (the skip mode still needs exact per-
@@ -273,6 +289,19 @@ class QuarantineSink:
         self._stored = 0              # entries held across all partitions
         self._hi: Optional[Tuple] = None   # cached max stored key
         self._hi_valid = True
+        # residency accounting (observability/memplane.py): the cell
+        # holds this sink's live quarantine bytes so the finalizer can
+        # release exactly what is still tracked when the sink goes away
+        self._mem_cell = {"bytes": 0}
+        import weakref
+
+        weakref.finalize(self, _release_quarantine, self._mem_cell)
+
+    def _mem_adjust(self, delta: int) -> None:
+        from ..observability import memplane
+
+        self._mem_cell["bytes"] = max(0, self._mem_cell["bytes"] + delta)
+        memplane.adjust("quarantine", delta)
 
     # -- recording ---------------------------------------------------------
     def record(self, raw, exc: BaseException,
@@ -331,11 +360,15 @@ class QuarantineSink:
             return                      # count-only: past the window
         part.entries.append(entry)
         self._stored += 1
+        # residency accounting: the bounded sidecar window is the
+        # quarantine mode's one real in-process allocation
+        self._mem_adjust(_entry_nbytes(entry))
         if self._hi is None or key > self._hi:
             self._hi = key
         while self._stored > cap:
             hi_part = self._parts[self._hi]
-            hi_part.entries.pop()       # merge-order-last stored entry
+            evicted = hi_part.entries.pop()  # merge-order-last stored
+            self._mem_adjust(-_entry_nbytes(evicted))
             self._stored -= 1
             if not hi_part.entries:
                 self._hi = max((k for k, p in self._parts.items()
@@ -350,6 +383,8 @@ class QuarantineSink:
             if part is not None:
                 self._total -= part.count
                 self._stored -= len(part.entries)
+                self._mem_adjust(-sum(_entry_nbytes(e)
+                                      for e in part.entries))
                 self._hi_valid = False
 
     def reset(self) -> None:
@@ -359,6 +394,7 @@ class QuarantineSink:
             self._parts.clear()
             self._total = 0
             self._stored = 0
+            self._mem_adjust(-self._mem_cell["bytes"])
             self._hi = None
             self._hi_valid = True
 

@@ -283,10 +283,13 @@ def publish_stats_extra(extra: dict) -> None:
         # cache/* (incremental count cache hit/miss per job) and
         # epilogue/* (device vs host render epilogue) ride along so the
         # warm-path story is checkable from any per-job artifact
+        # mem/* (the memory plane's peak-tracked ratchet and OOM-dump
+        # tallies — observability/memplane.py) rides along so the
+        # residency story is checkable from any artifact
         elif name.startswith(("wire/", "pipeline/", "drift/", "serve/",
                               "compile/", "format/", "ingest/",
                               "quarantine/", "slo/", "telemetry/",
-                              "cache/", "epilogue/")):
+                              "cache/", "epilogue/", "mem/")):
             extra[name] = int(value) if float(value).is_integer() \
                 else round(value, 4)
     for gauge_name, extra_key in (("dispatch/tail", "tail_dispatch"),
@@ -307,6 +310,17 @@ def publish_stats_extra(extra: dict) -> None:
     for name, g in snap["gauges"].items():
         if name.startswith("residual/") and name.count("/") == 2:
             extra[name] = g["value"]
+        # per-family peak bytes + process/device watermarks (the memory
+        # plane's gauges), so bench rows and --json-metrics carry the
+        # residency numbers without a second export path
+        elif name.startswith("mem/"):
+            extra[name] = int(g["value"]) \
+                if float(g["value"]).is_integer() else g["value"]
+    # the regression gate's top-level key (tools/regress_check.py gates
+    # peak_rss_mb alongside jax_sec on the bench series)
+    prss = snap["gauges"].get("mem/peak_rss_mb")
+    if prss is not None:
+        extra["peak_rss_mb"] = prss["value"]
 
 
 def configure_logging(level: Optional[str],

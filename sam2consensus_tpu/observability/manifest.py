@@ -126,6 +126,15 @@ def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
     qg = snap["gauges"].get("quarantine/summary")
     if qg is not None and qg.get("info"):
         ingest["quarantine/summary"] = qg["info"]
+    # memory plane (observability/memplane.py): per-family live/peak
+    # gauges, the peak-tracked ratchet, process/device watermarks and
+    # any OOM-dump tally — the manifest answers "what did this run
+    # actually pin" next to "how long did it take"
+    memory: dict = {k: int(v) for k, v in counters.items()
+                    if k.startswith("mem/")}
+    for name, g in snap["gauges"].items():
+        if name.startswith("mem/"):
+            memory[name] = g["value"]
     decisions = []
     for rec in ledger_records:
         d = rec.to_dict() if hasattr(rec, "to_dict") else dict(rec)
@@ -143,6 +152,7 @@ def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
         "wire": wire,
         "serve": serve,
         "ingest": ingest,
+        "memory": memory,
         "drift_events": int(counters.get("drift/events", 0)),
         "artifacts": dict(artifacts or {}),
     }

@@ -1,0 +1,593 @@
+"""Memory observability plane: host+device byte accounting + forensics.
+
+Every observability layer so far measures seconds and wire bytes but
+not a single byte of *residency* — yet the failure mode every
+remaining scale item shares is memory-shaped: a wide-genome job OOMs
+one host, the OOM-split ladder rung fires blind, and the HBM-OOM note
+in ``ops/mxu_pileup.py`` documents a failure the system can neither
+predict nor report.  This module is the residency counterpart of the
+PR-12 d2h choke point (``wire.account_d2h``): one discipline, three
+surfaces.
+
+**Byte accounting.**  Every long-lived allocation family registers
+through one choke point:
+
+====================  ====================================================
+family                what it holds
+====================  ====================================================
+``counts``            device count tensors (PileupAccumulator, sharded)
+``counts_host``       the host pileup rung's count tensor
+``wire_staging``      staged slab operands (encode + ``device_put`` slots)
+``insertion_table``   the insertion-event key/table operands
+``decode_ahead``      serve-mode predecoded batches pinned for job N+1
+``count_cache``       warm per-reference count state (serve/countcache)
+``quarantine``        the tolerant-decode sidecar window
+``packed_batch``      continuous batching's merged combined tensors
+====================  ====================================================
+
+:func:`track` / :func:`release` (or :func:`track_obj`, which
+auto-releases when the object is garbage-collected) maintain
+process-wide live/peak bytes per family AND publish into the *current*
+metrics registry — ``mem/live_bytes/<family>`` /
+``mem/peak_bytes/<family>`` gauges plus the ``mem/peak_tracked_bytes``
+ratchet counter — so each job's registry carries the peaks observed
+during that job while the plane itself survives across jobs (resident
+cache entries keep counting).  The plane is pure accounting: bytes are
+identical with it on or off (``S2C_MEMPLANE=0`` disables; pinned by
+tests/test_memplane.py).
+
+**Watermarks.**  :func:`sample` reads process RSS (current via
+``/proc/self/statm``, peak via ``resource.getrusage``), optional
+tracemalloc (only when the caller already enabled tracing), and
+``device.memory_stats()`` bytes-in-use/peak where the backend exposes
+it (gracefully absent on CPU), publishing ``mem/rss_mb`` /
+``mem/peak_rss_mb`` / ``mem/device_bytes_in_use`` /
+``mem/device_peak_bytes`` gauges and keeping a bounded history ring —
+the serve runner samples from its watchdog/telemetry tick, so a
+mid-hang scrape shows memory too, and the ring is the forensic dump's
+watermark tail.
+
+**Capacity model.**  :func:`predict_run_peak_bytes` prices a run's
+peak tracked bytes from the same geometry the allocations come from
+(``padded_total_len`` counts, ``canonical_slab_shapes`` staging slots,
+the threshold grid's tail buffers); :func:`record_capacity` registers
+it as a ``capacity`` ledger decision joined against the measured
+``mem/peak_tracked_bytes`` ratchet.  The residual is recorded
+*informationally* (band=0, the shard-mode precedent): the model is an
+admission-side UPPER bound — an under-filled final chunk makes
+measured << predicted by design, and alarming on headroom would teach
+operators to ignore drift.  The committed ``mem_watermark`` artifact
+(tools/mem_watermark.py) runs chunk-filling configs precisely so its
+residuals sit inside the default band, keeping the model honest where
+it matters.  Serve admission consumes the same prediction: a job whose
+predicted peak exceeds ``--mem-budget`` is shed with reason
+``capacity`` (``serve/admission_capacity``) instead of being allowed
+to OOM the fleet.
+
+**OOM forensics.**  :func:`dump_on_capacity` writes ``mem_dump.json``
+(schema ``s2c-mem-dump/1``) next to the journal / metrics artifact
+when a failure classifies CAPACITY (resilience/policy.py — the class
+that splits/demotes rather than blindly retrying): per-family
+live/peak table, the watermark history tail, the capacity prediction
+and its inputs, the innermost open span, and the error itself —
+exactly like the telemetry plane's profiler span-dump, but for
+residency.  The ``mem_alloc`` fault site (resilience/faultinject.py)
+injects a deterministic MemoryError at the device count-tensor
+allocation boundary so the whole path is testable without a real OOM.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger("sam2consensus_tpu.observability.memplane")
+
+#: the documented allocation families (informational — track() accepts
+#: any name; these are the ones the shipped call sites use)
+FAMILIES = ("counts", "counts_host", "wire_staging", "insertion_table",
+            "decode_ahead", "count_cache", "quarantine", "packed_batch")
+
+MEM_DUMP_SCHEMA = "s2c-mem-dump/1"
+MEM_DUMP_NAME = "mem_dump.json"
+
+#: watermark history ring bound (one entry per sampler tick — at the
+#: serve default 2 s cadence this is ~8.5 minutes of tail)
+HISTORY_CAP = 256
+
+
+def enabled() -> bool:
+    """The plane's on/off gate (``S2C_MEMPLANE``; default on).  Checked
+    live so tests can toggle it; one getenv per accounting event —
+    allocation-family events are per run/slab/entry, never per row."""
+    return os.environ.get("S2C_MEMPLANE", "1").lower() \
+        not in ("0", "off", "false")
+
+
+class _Plane:
+    """Process-wide accounting state (families outlive runs: a warm
+    count-cache entry is resident across jobs and must keep counting)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.live: Dict[str, int] = {}
+        self.peak: Dict[str, int] = {}
+        self.total_live = 0
+        self.total_peak = 0
+        self.history: deque = deque(maxlen=HISTORY_CAP)
+        self.last_capacity: Optional[dict] = None
+        self.last_sample: Optional[dict] = None
+
+
+_plane = _Plane()
+
+
+def _publish(family: str, live: int, total: int) -> None:
+    """Mirror one adjustment into the CURRENT registry: live gauges are
+    absolute (process-wide), peak gauges/counters ratchet per registry —
+    a fresh per-job registry therefore records the peak observed during
+    *that* job (including state resident when it started)."""
+    from .metrics import current as _current_registry
+
+    reg = _current_registry()
+    reg.gauge(f"mem/live_bytes/{family}").set(float(live))
+    g = reg.gauge(f"mem/peak_bytes/{family}")
+    if live > g.value:
+        g.set(float(live))
+    reg.gauge("mem/live_tracked_bytes").set(float(total))
+    have = reg.value("mem/peak_tracked_bytes")
+    if total > have:
+        reg.add("mem/peak_tracked_bytes", total - have)
+
+
+def adjust(family: str, delta: int) -> None:
+    """THE residency choke point: add ``delta`` bytes (negative =
+    release) to ``family``'s live total and publish live/peak."""
+    if delta == 0 or not enabled():
+        return
+    with _plane.lock:
+        live = max(0, _plane.live.get(family, 0) + int(delta))
+        _plane.live[family] = live
+        if live > _plane.peak.get(family, 0):
+            _plane.peak[family] = live
+        _plane.total_live = max(0, _plane.total_live + int(delta))
+        if _plane.total_live > _plane.total_peak:
+            _plane.total_peak = _plane.total_live
+        # publish under the plane lock so the per-registry peak ratchet
+        # (read-then-add) cannot interleave across threads; lock order
+        # is plane -> registry, used nowhere in the other direction
+        _publish(family, live, _plane.total_live)
+
+
+def track(family: str, nbytes: int) -> None:
+    """Register ``nbytes`` of live residency under ``family``."""
+    if nbytes > 0:
+        adjust(family, int(nbytes))
+
+
+def release(family: str, nbytes: int) -> None:
+    """The matching release (callers with explicit lifecycles)."""
+    if nbytes > 0:
+        adjust(family, -int(nbytes))
+
+
+def track_obj(family: str, obj, nbytes: int) -> None:
+    """Track ``nbytes`` against ``obj``'s lifetime: released
+    automatically when the object is garbage-collected (CPython
+    refcounting makes this prompt for the accumulator/batch objects the
+    call sites hand in).  Objects that cannot carry a weakref are
+    counted toward the family peak and released immediately — peak is
+    the surface admission and forensics consume; a non-weakrefable
+    object must not leak live bytes forever."""
+    if nbytes <= 0 or not enabled():
+        return
+    n = int(nbytes)
+    track(family, n)
+    try:
+        weakref.finalize(obj, adjust, family, -n)
+    except TypeError:
+        adjust(family, -n)
+
+
+def batch_nbytes(batch) -> int:
+    """Resident bytes of one decoded SegmentBatch (bucket operands +
+    any staged slab payloads) — the decode-ahead / packed-batch
+    families' sizing helper."""
+    n = 0
+    for starts, codes in getattr(batch, "buckets", {}).values():
+        n += int(getattr(starts, "nbytes", 0))
+        n += int(getattr(codes, "nbytes", 0))
+    for slab in getattr(batch, "staged", {}).values():
+        n += int(getattr(slab, "nbytes", 0))
+    return n
+
+
+# =========================================================================
+# Watermarks
+# =========================================================================
+def rss_bytes() -> Tuple[int, int]:
+    """(current, peak) process RSS in bytes.  Peak via
+    ``resource.getrusage`` (kilobytes on Linux); current via
+    ``/proc/self/statm`` where it exists, else 0 (the peak still
+    reports)."""
+    peak = 0
+    try:
+        import resource
+        import sys
+
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        peak = int(ru) if sys.platform == "darwin" else int(ru) * 1024
+    except Exception:
+        pass
+    cur = 0
+    try:
+        with open("/proc/self/statm") as fh:
+            cur = int(fh.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE")
+                                               if hasattr(os, "sysconf")
+                                               else 4096)
+    except Exception:
+        pass
+    return cur, peak
+
+
+def device_memory_stats() -> Optional[dict]:
+    """``{bytes_in_use, peak_bytes_in_use}`` from the default device
+    where the backend exposes ``memory_stats()`` (real accelerators);
+    None on CPU / when jax was never imported — the plane must not be
+    the thing that pays jax's import or dials a remote backend."""
+    import sys
+
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return None
+    try:
+        dev = jax_mod.devices()[0]
+        stats = dev.memory_stats()
+    except Exception:
+        return None
+    if not isinstance(stats, dict):
+        return None
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        v = stats.get(key)
+        if isinstance(v, (int, float)):
+            out[key] = int(v)
+    return out or None
+
+
+def sample(registry=None) -> dict:
+    """One watermark sample: RSS + optional tracemalloc + device bytes
+    + the plane's tracked totals; appended to the bounded history ring
+    and published as ``mem/*`` gauges into ``registry`` (default: the
+    current registry).  Rides the serve watchdog/telemetry tick and the
+    backend's end-of-run publish."""
+    cur, peak = rss_bytes()
+    with _plane.lock:
+        tracked_live = _plane.total_live
+        tracked_peak = _plane.total_peak
+    s = {
+        "unix": round(time.time(), 3),
+        "rss_mb": round(cur / 1e6, 2),
+        "peak_rss_mb": round(peak / 1e6, 2),
+        "tracked_live_bytes": tracked_live,
+        "tracked_peak_bytes": tracked_peak,
+    }
+    try:
+        import tracemalloc
+
+        if tracemalloc.is_tracing():
+            traced, tpeak = tracemalloc.get_traced_memory()
+            s["tracemalloc_mb"] = round(traced / 1e6, 2)
+            s["tracemalloc_peak_mb"] = round(tpeak / 1e6, 2)
+    except Exception:
+        pass
+    dev = device_memory_stats()
+    if dev is not None:
+        s["device_bytes_in_use"] = dev.get("bytes_in_use", 0)
+        if "peak_bytes_in_use" in dev:
+            s["device_peak_bytes"] = dev["peak_bytes_in_use"]
+    with _plane.lock:
+        _plane.history.append(s)
+        _plane.last_sample = s
+    if enabled():
+        if registry is None:
+            from .metrics import current as _current_registry
+
+            registry = _current_registry()
+        registry.gauge("mem/rss_mb").set(s["rss_mb"])
+        registry.gauge("mem/peak_rss_mb").set(s["peak_rss_mb"])
+        if "device_bytes_in_use" in s:
+            registry.gauge("mem/device_bytes_in_use").set(
+                float(s["device_bytes_in_use"]))
+        if "device_peak_bytes" in s:
+            registry.gauge("mem/device_peak_bytes").set(
+                float(s["device_peak_bytes"]))
+        # per-family live/peak into THIS registry too: the serve
+        # runner samples into its server-lifetime AggregateRegistry,
+        # which deliberately does NOT fold per-job mem/* (summed
+        # per-job peaks would be jobs_folded x reality) — the sampled
+        # publication here is how the fleet exposition carries the
+        # family gauges instead.  The whole publish runs under the
+        # plane lock, like adjust()'s _publish: the peak ratchets are
+        # read-then-write, and an adjust() racing on the same registry
+        # could otherwise inflate the monotone counter past the true
+        # peak (lock order plane -> registry, same as everywhere)
+        with _plane.lock:
+            for f in set(_plane.live) | set(_plane.peak):
+                live = _plane.live.get(f, 0)
+                registry.gauge(f"mem/live_bytes/{f}").set(float(live))
+                g = registry.gauge(f"mem/peak_bytes/{f}")
+                if live > g.value:
+                    g.set(float(live))
+            total_live = _plane.total_live
+            registry.gauge("mem/live_tracked_bytes").set(
+                float(total_live))
+            have = registry.value("mem/peak_tracked_bytes")
+            if total_live > have:
+                registry.add("mem/peak_tracked_bytes",
+                             total_live - have)
+    return s
+
+
+def history_tail(n: int = 64) -> list:
+    """The newest ``n`` watermark samples (forensic dump tail)."""
+    with _plane.lock:
+        return list(_plane.history)[-n:]
+
+
+def summary() -> dict:
+    """The health-snapshot / s2c_top shape: per-family live/peak plus
+    the latest watermarks (sampled fresh when none exist yet)."""
+    with _plane.lock:
+        fams = {f: {"live_bytes": _plane.live.get(f, 0),
+                    "peak_bytes": _plane.peak.get(f, 0)}
+                for f in sorted(set(_plane.live) | set(_plane.peak))}
+        totals = {"live_bytes": _plane.total_live,
+                  "peak_bytes": _plane.total_peak}
+        last = _plane.last_sample
+    return {
+        "families": fams,
+        "tracked": totals,
+        "watermarks": dict(last) if last is not None else sample(),
+        "enabled": enabled(),
+    }
+
+
+# =========================================================================
+# Capacity model
+# =========================================================================
+def predict_run_peak_bytes(total_len: int, n_thresholds: int = 1,
+                           chunk_reads: int = 262144,
+                           read_len: int = 150, shards: int = 1,
+                           segment_width: int = 0,
+                           n_reads: Optional[int] = None,
+                           batch_members: int = 1
+                           ) -> Tuple[int, Dict[str, int]]:
+    """Predicted peak tracked bytes for one run, from the same geometry
+    the allocations come from.
+
+    Components: the padded count tensor (per shard — the formula
+    ``padded_total_len * NUM_SYMBOLS * 4`` every accumulator
+    implicitly encodes), the double-buffered staging slots at the
+    widest canonical slab shape (host buffer + device operand), and
+    the tail's per-threshold symbol/stat buffers.  Insertion tables
+    and quarantine windows are data-dependent and deliberately
+    unpriced — the model is a geometry bound, and its residual is
+    recorded informationally (module docstring).
+    """
+    try:
+        from ..constants import NUM_SYMBOLS
+        from ..ops.pileup import canonical_slab_shapes, padded_total_len
+
+        padded = padded_total_len(total_len)
+        shapes = canonical_slab_shapes(
+            total_len, read_len=read_len, chunk_reads=chunk_reads,
+            n_reads=n_reads, segment_width=segment_width)
+        nsym = NUM_SYMBOLS
+    except Exception:
+        # geometry helpers unavailable (jax-free consumer): arithmetic
+        # approximations keep admission working
+        padded = -(-(total_len + 1) // 1024) * 1024
+        w = max(64, 1 << max(0, (max(1, read_len) - 1).bit_length()))
+        rows = min(max(8, 1 << (max(1, min(n_reads or chunk_reads,
+                                           chunk_reads)) - 1)
+                       .bit_length()), max(1, (1 << 22) // w))
+        shapes = [(rows, w)]
+        nsym = 6
+    shards = max(1, int(shards))
+    counts = padded * nsym * 4 * shards
+    # widest canonical slab in its WIRE layout (packed nibble lanes +
+    # int32 starts — what the staged device operands actually hold)
+    slab = max((int(r) * (int(w) // 2 + 4) for r, w in shapes),
+               default=0)
+    # two pinned staging slots (wire.pipeline.DEFAULT_SLOTS)
+    staging = 2 * slab
+    tail = max(1, int(n_thresholds)) * padded * 6
+    components = {
+        "counts_bytes": int(counts),
+        "staging_bytes": int(staging),
+        "tail_bytes": int(tail),
+    }
+    total = sum(components.values()) * max(1, int(batch_members)) \
+        if batch_members > 1 else sum(components.values())
+    return int(total), components
+
+
+def predict_job_peak_bytes(total_len: int, cfg) -> int:
+    """Admission-side wrapper: the prediction for one job from its
+    header-probed genome length + RunConfig (serve/runner.py)."""
+    total, _comp = predict_run_peak_bytes(
+        total_len,
+        n_thresholds=len(getattr(cfg, "thresholds", None) or [0.25]),
+        chunk_reads=getattr(cfg, "chunk_reads", 262144),
+        shards=getattr(cfg, "shards", 1) or 1,
+        segment_width=max(0, getattr(cfg, "segment_width", 0)))
+    return total
+
+
+def record_capacity(total_len: int, n_thresholds: int,
+                    chunk_reads: int = 262144, shards: int = 1,
+                    segment_width: int = 0,
+                    n_reads: Optional[int] = None,
+                    budget_bytes: int = 0) -> dict:
+    """Register the run's ``capacity`` ledger decision (predicted peak
+    bytes joined against the measured ``mem/peak_tracked_bytes``
+    ratchet at finalize, like every other gate).  Returns the
+    prediction record (also kept as the forensic dump's ``capacity``
+    section)."""
+    from .. import observability as obs
+
+    total, components = predict_run_peak_bytes(
+        total_len, n_thresholds=n_thresholds, chunk_reads=chunk_reads,
+        shards=shards, segment_width=segment_width, n_reads=n_reads)
+    chosen = "unbudgeted"
+    if budget_bytes:
+        chosen = "over_budget" if total > budget_bytes \
+            else "within_budget"
+    inputs = {
+        "total_len": int(total_len),
+        "n_thresholds": int(n_thresholds),
+        "chunk_reads": int(chunk_reads),
+        "shards": int(max(1, shards)),
+        "segment_width": int(segment_width),
+        **({"budget_bytes": int(budget_bytes)} if budget_bytes else {}),
+        **components,
+    }
+    record = {"predicted_bytes": int(total), "chosen": chosen,
+              "inputs": inputs}
+    with _plane.lock:
+        _plane.last_capacity = record
+    if enabled():
+        # band=0: informational residual (see the module docstring) —
+        # the model is an upper bound; headroom must not alarm
+        obs.record_decision(
+            "capacity", chosen, inputs=inputs,
+            predicted={"bytes": float(total)},
+            measured={"bytes": {"counters": ["mem/peak_tracked_bytes"]}},
+            band=0)
+    return record
+
+
+def capacity_actuals() -> dict:
+    """Predicted-vs-actual snapshot for the OOM-split rung
+    (resilience/ladder.py): the last capacity prediction next to the
+    tracked/process peaks at split time, so the split threshold stops
+    being folklore."""
+    cur, peak = rss_bytes()
+    with _plane.lock:
+        cap = _plane.last_capacity
+        out = {
+            "predicted_bytes": (cap or {}).get("predicted_bytes"),
+            "live_tracked_bytes": _plane.total_live,
+            "peak_tracked_bytes": _plane.total_peak,
+            "rss_mb": round(cur / 1e6, 2),
+            "peak_rss_mb": round(peak / 1e6, 2),
+        }
+    dev = device_memory_stats()
+    if dev is not None:
+        out["device_bytes_in_use"] = dev.get("bytes_in_use", 0)
+    return out
+
+
+# =========================================================================
+# OOM forensics
+# =========================================================================
+def write_mem_dump(out_dir: str, exc: Optional[BaseException] = None,
+                   registry=None, context: Optional[dict] = None
+                   ) -> Optional[str]:
+    """Write ``mem_dump.json`` into ``out_dir``; returns the path.
+    Never raises — forensics must not replace one failure with
+    another."""
+    try:
+        from .metrics import current as _current_registry
+        from .telemetry import atomic_write_text
+        from .trace import current_span_name
+
+        if registry is None:
+            registry = _current_registry()
+        classification = None
+        if exc is not None:
+            try:
+                from ..resilience.policy import classify
+
+                classification = classify(exc)
+            except Exception:
+                classification = None
+        snap = registry.snapshot()
+        mem_counters = {k: v for k, v in snap["counters"].items()
+                        if k.startswith(("mem/", "cache/evicted"))}
+        with _plane.lock:
+            fams = {f: {"live_bytes": _plane.live.get(f, 0),
+                        "peak_bytes": _plane.peak.get(f, 0)}
+                    for f in sorted(set(_plane.live) | set(_plane.peak))}
+            totals = {"live_bytes": _plane.total_live,
+                      "peak_bytes": _plane.total_peak}
+            capacity = dict(_plane.last_capacity) \
+                if _plane.last_capacity else None
+        blob = {
+            "schema": MEM_DUMP_SCHEMA,
+            "created_unix": round(time.time(), 3),
+            "pid": os.getpid(),
+            "error": ({
+                "type": type(exc).__name__,
+                "message": str(exc),
+                "classification": classification,
+            } if exc is not None else None),
+            "families": fams,
+            "tracked": totals,
+            "watermarks": sample(registry=registry),
+            "watermark_tail": history_tail(),
+            "capacity": capacity,
+            "registry_mem_counters": mem_counters,
+            "open_span": current_span_name(),
+            "context": dict(context or {}),
+        }
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, MEM_DUMP_NAME)
+        atomic_write_text(path, json.dumps(blob, indent=1, default=str)
+                          + "\n")
+        logger.warning("memory forensics written to %s (%s)", path,
+                       blob["error"])
+        return path
+    except Exception as dump_exc:
+        logger.warning("mem_dump write failed: %s: %s",
+                       type(dump_exc).__name__, dump_exc)
+        return None
+
+
+def dump_on_capacity(exc: BaseException, out_dir: Optional[str],
+                     registry=None,
+                     context: Optional[dict] = None) -> Optional[str]:
+    """The OOM hook: write the forensic dump iff ``exc`` classifies
+    CAPACITY (resilience/policy.py) and a destination exists.  Counted
+    ``mem/oom_dumps`` so a job that died of memory says so from any
+    artifact."""
+    if not enabled() or not out_dir:
+        return None
+    try:
+        from ..resilience.policy import CAPACITY, classify
+
+        if classify(exc) != CAPACITY:
+            return None
+    except Exception:
+        return None
+    path = write_mem_dump(out_dir, exc=exc, registry=registry,
+                          context=context)
+    if path is not None:
+        from .metrics import current as _current_registry
+
+        (registry or _current_registry()).add("mem/oom_dumps", 1)
+    return path
+
+
+def _reset_for_tests() -> None:
+    """Zero the process-wide plane (tests only — families are
+    deliberately process-lifetime in production)."""
+    global _plane
+    _plane = _Plane()

@@ -60,6 +60,9 @@ LOWER_IS_BETTER = {
     "jax_sec": True, "cpu_sec": True, "sec": True, "elapsed_sec": True,
     "vs_baseline": False, "bases_per_sec": False, "value": False,
     "pileup_mcells_per_s": False, "decode_mbases_per_s": False,
+    # residency regresses UPWARD (tools/mem_watermark.py + the bench
+    # rows' peak_rss_mb — the memory plane's gated metrics)
+    "peak_rss_mb": True, "peak_tracked_mb": True,
 }
 
 

@@ -179,7 +179,13 @@ class AggregateRegistry(MetricsRegistry):
     # job's registry carries its own cache/{hits,misses} copy for the
     # per-job manifest — folding that copy would double-count the
     # server-lifetime family
-    FOLD_SKIP_PREFIXES = ("serve/", "slo/", "telemetry/", "cache/")
+    # mem/: the memory plane's per-registry PEAK ratchets are maxima,
+    # not flows — summing per-job peaks would report jobs_folded x the
+    # real footprint.  The watchdog-tick sampler
+    # (observability/memplane.sample) publishes the server-lifetime
+    # mem/* family into this registry directly instead.
+    FOLD_SKIP_PREFIXES = ("serve/", "slo/", "telemetry/", "cache/",
+                          "mem/")
 
     def fold(self, registry: MetricsRegistry, job_id: str = "",
              tenant: str = "") -> None:
@@ -302,6 +308,11 @@ _HELP = {
                               "input cold (no warm entry).",
     "s2c_cache_evictions_total": "Entries evicted by the LRU byte "
                                  "budget.",
+    "s2c_cache_evicted_bytes_total": "Bytes of warm count state "
+                                     "evicted under the LRU budget "
+                                     "(the silent-pressure signal: a "
+                                     "growing rate means the budget "
+                                     "is churning).",
     "s2c_cache_invalidated_total": "Entries dropped whole after a "
                                    "seeded job failed (the count-bank "
                                    "rule).",
@@ -315,6 +326,35 @@ _HELP = {
     "s2c_epilogue_host_tails_total": "Tails whose render epilogue ran "
                                      "host-side (sharded/native/"
                                      "unrepresentable fill).",
+    # memory plane (observability/memplane.py): the s2c_mem_* family
+    "s2c_mem_live_bytes": "Live tracked bytes per allocation family "
+                          "(counts/staging/caches/... — see "
+                          "observability/memplane.py).",
+    "s2c_mem_peak_bytes": "Peak tracked bytes per allocation family "
+                          "since this registry started.",
+    "s2c_mem_live_tracked_bytes": "Live tracked bytes across all "
+                                  "allocation families.",
+    "s2c_mem_peak_tracked_bytes_total": "Peak-tracked-bytes ratchet "
+                                        "(monotone; the capacity "
+                                        "ledger decision's measured "
+                                        "side).",
+    "s2c_mem_rss_mb": "Process resident set size, MB (watermark "
+                      "sampler on the watchdog/telemetry tick).",
+    "s2c_mem_peak_rss_mb": "Process peak RSS, MB (ru_maxrss).",
+    "s2c_mem_device_bytes_in_use": "Device bytes in use where the "
+                                   "backend exposes memory_stats() "
+                                   "(absent on CPU).",
+    "s2c_mem_device_peak_bytes": "Device peak bytes in use where "
+                                 "exposed.",
+    "s2c_mem_oom_dumps_total": "CAPACITY-class failures that wrote a "
+                               "mem_dump.json forensic record.",
+    "s2c_serve_admission_capacity_total": "Jobs shed because their "
+                                          "predicted peak exceeded "
+                                          "--mem-budget (queued-not-"
+                                          "OOMed).",
+    "s2c_serve_oom_dumps_total": "Serve jobs whose CAPACITY failure "
+                                 "wrote a mem_dump.json next to the "
+                                 "journal.",
 }
 
 
@@ -397,6 +437,13 @@ def render_openmetrics(snapshot: dict) -> str:
     for name, entry in snapshot.get("gauges", {}).items():
         # info payloads are manifest material, not exposition material;
         # only the scalar value ships
+        m = re.match(r"^mem/(live|peak)_bytes/(.+)$", name)
+        if m:
+            # per-family residency gauges get a proper family label
+            # instead of one sanitized series per allocation family
+            fam(f"s2c_mem_{m.group(1)}_bytes", "gauge").add(
+                "", [("family", m.group(2))], entry["value"])
+            continue
         fam(_sanitize(name), "gauge").add("", [], entry["value"])
     for name, entry in snapshot.get("histograms", {}).items():
         m = re.match(r"^slo/([^/]*)/([^/]+)$", name)

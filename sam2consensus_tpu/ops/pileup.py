@@ -33,8 +33,9 @@ import numpy as np
 from .. import observability as obs
 from ..constants import NUM_SYMBOLS, PAD_CODE
 from ..encoder.events import MIN_BUCKET_W, SegmentBatch, StagedSlab
-from ..observability import jitcache
+from ..observability import jitcache, memplane
 from ..resilience.faultinject import fault_check
+from ..wire import account_h2d
 from ..wire import codec as wire_codec
 
 
@@ -251,6 +252,9 @@ def prewarm_scatter(total_len: int, shapes, device=None) -> int:
     serve runner binds its server registry, so per-job registries show
     the prewarmed shapes as pure cache hits)."""
     padded = padded_total_len(total_len)
+    # the counts tensor is DEVICE-born (jnp.zeros) — nothing crosses
+    # the link for it, so nothing bills; only the host-built operand
+    # uploads below are real h2d traffic
     counts = jnp.zeros((padded, NUM_SYMBOLS), dtype=jnp.int32)
     if device is not None:
         counts = jax.device_put(counts, device)
@@ -258,11 +262,15 @@ def prewarm_scatter(total_len: int, shapes, device=None) -> int:
     for rows, width in sorted(set((int(r), int(w)) for r, w in shapes)):
         if width % 2 or rows <= 0:
             continue
-        starts = jnp.zeros(rows, dtype=jnp.int32)
-        packed = jnp.full((rows, width // 2), 255, dtype=jnp.uint8)
-        if device is not None:
-            starts = jax.device_put(starts, device)
-            packed = jax.device_put(packed, device)
+        # host-built operands + device_put: the same real upload a
+        # job's first slab would pay, billed at the same h2d choke
+        # point (they land in the SERVER registry — the serve runner
+        # binds it around prewarm — so the fleet ledger is complete
+        # without polluting any job's bill)
+        starts = jax.device_put(np.zeros(rows, dtype=np.int32), device)
+        packed = jax.device_put(
+            np.full((rows, width // 2), 255, dtype=np.uint8), device)
+        account_h2d(int(starts.nbytes) + int(packed.nbytes))
         # donated counts chain through every shape (same array shape)
         counts = _scatter_segments_packed(counts, starts, packed,
                                           total_len)
@@ -467,6 +475,11 @@ class HostPileupAccumulator:
 
         self.total_len = total_len
         self._counts = np.zeros((total_len, NUM_SYMBOLS), dtype=np.int32)
+        # residency accounting (observability/memplane.py): released
+        # with the accumulator.  No mem_alloc fault site here — the
+        # host rung is the ladder's bottom by construction, same
+        # contract as every other injection site.
+        memplane.track_obj("counts_host", self, self._counts.nbytes)
         self._lib = native.load()              # None -> numpy fallback
         self._device_counts = None
         self._wire_itemsize = None
@@ -540,6 +553,7 @@ class HostPileupAccumulator:
                     # (transient transfer failure under the resilience
                     # policy) must not double-count the tensor
                     self.bytes_h2d += arr.nbytes   # real wire bytes
+                    account_h2d(arr.nbytes)
         return self._device_counts
 
     def counts_host(self) -> np.ndarray:
@@ -672,10 +686,18 @@ class PileupAccumulator:
         # compiles against the same counts shape, so a drift here would
         # silently turn prewarm into dead weight
         self.padded_len = padded_total_len(total_len)
+        # the mem_alloc fault site: the device count-tensor allocation
+        # boundary (the one ops/mxu_pileup.py's HBM-OOM note names).
+        # Raises InjectedOomError -> CAPACITY, so the forensic-dump +
+        # split/demote path is testable without a real OOM; the host
+        # rung allocates no device tensor and carries no site.
+        fault_check("mem_alloc")
         counts = jnp.zeros((self.padded_len, NUM_SYMBOLS), dtype=jnp.int32)
         if device is not None:
             counts = jax.device_put(counts, device)
         self._counts = counts
+        memplane.track_obj("counts", self,
+                           self.padded_len * NUM_SYMBOLS * 4)
         self.strategy_used: dict = {}
         self.bytes_h2d = 0                 # wire accounting for bench
         self._mxu_rows_real = 0            # occupancy accounting: run
@@ -734,14 +756,19 @@ class PileupAccumulator:
         if slab is not None:
             ops = tuple(jax.device_put(a, self.device)
                         for a in slab.arrays())
-            return StagedSlab("delta8", ops, slab.wire_bytes, raw,
-                              meta=(slab.width, slab.sentinel))
-        packed = pack_nibbles(codes)
-        return StagedSlab(
-            "packed5",
-            (jax.device_put(starts, self.device),
-             jax.device_put(packed, self.device)),
-            starts.nbytes + packed.nbytes, raw)
+            staged = StagedSlab("delta8", ops, slab.wire_bytes, raw,
+                                meta=(slab.width, slab.sentinel))
+        else:
+            packed = pack_nibbles(codes)
+            staged = StagedSlab(
+                "packed5",
+                (jax.device_put(starts, self.device),
+                 jax.device_put(packed, self.device)),
+                starts.nbytes + packed.nbytes, raw)
+        # staging-slot residency: released when the slab is consumed
+        # and dropped (observability/memplane.py)
+        memplane.track_obj("wire_staging", staged, staged.nbytes)
+        return staged
 
     def _consume_slab(self, staged: StagedSlab):
         """(starts_dev, packed_dev) from a shipped slab — the delta8
@@ -755,6 +782,7 @@ class PileupAccumulator:
             # bytes re-crossing the link
             staged.billed = True
             self.bytes_h2d += staged.nbytes
+            account_h2d(staged.nbytes)
             account_wire(staged.codec, staged.nbytes, staged.raw_nbytes)
             if staged.codec == "delta8":
                 # recorded in strategy_used only when the codec engaged
@@ -830,6 +858,7 @@ class PileupAccumulator:
             def exec_mxu(plan):
                 st, pk = put_operands()
                 self.bytes_h2d += plan.slot.nbytes
+                account_h2d(plan.slot.nbytes)
                 # occupancy accounting for the bench: padded/real row
                 # ratio aggregated over the run (a last-slab snapshot
                 # would report whichever bucket ran last) — and only for
@@ -872,6 +901,8 @@ class PileupAccumulator:
                 st, pk = put_operands()
                 self.bytes_h2d += (plan.rank.nbytes + plan.blk_lo.nbytes
                                    + plan.blk_n.nbytes)
+                account_h2d(plan.rank.nbytes + plan.blk_lo.nbytes
+                            + plan.blk_n.nbytes)
                 self._counts = pallas_pileup.pileup_pallas_packed(
                     self._counts, st[:n_rows], pk[:n_rows],
                     jax.device_put(plan.rank, self.device),

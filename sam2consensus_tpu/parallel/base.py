@@ -251,6 +251,7 @@ class ShardedCountsBase:
         """
         from ..ops.pileup import (account_wire, encode_wire_slab,
                                   pack_nibbles)
+        from ..wire import account_h2d
         from ..wire import codec as wire_codec
 
         raw = wire_codec.packed5_slab_bytes(len(starts), codes.shape[1])
@@ -258,6 +259,7 @@ class ShardedCountsBase:
         if slab is None:
             packed = pack_nibbles(codes)
             self.bytes_h2d += starts.nbytes + packed.nbytes
+            account_h2d(starts.nbytes + packed.nbytes)
             account_wire("packed5", starts.nbytes + packed.nbytes, raw)
             return (jax.device_put(starts, self._row_spec),
                     jax.device_put(packed, self._mat_spec))
@@ -271,6 +273,7 @@ class ShardedCountsBase:
         ops = tuple(jax.device_put(a, NamedSharding(self.mesh, P(ALL)))
                     for a in slab.arrays())
         self.bytes_h2d += slab.wire_bytes
+        account_h2d(slab.wire_bytes)
         account_wire("delta8", slab.wire_bytes, raw)
         return self._wire_decode(*ops, width=slab.width,
                                  sentinel=slab.sentinel)
@@ -299,6 +302,7 @@ class ShardedCountsBase:
             self._counts = jax.device_put(
                 jnp.zeros((self.padded_len, NUM_SYMBOLS), dtype=jnp.int32),
                 NamedSharding(self.mesh, P(self.pos_axes, None)))
+            self._track_counts()
         return self._counts
 
     def counts_host(self) -> np.ndarray:
@@ -312,6 +316,18 @@ class ShardedCountsBase:
         self._counts = jax.device_put(
             jnp.asarray(padded),
             NamedSharding(self.mesh, P(self.pos_axes, None)))
+        self._track_counts()
+
+    def _track_counts(self) -> None:
+        """Residency accounting for the sharded count tensor — once per
+        accumulator (lazy alloc and checkpoint restore both land here),
+        released with the accumulator (observability/memplane.py)."""
+        if not getattr(self, "_mem_tracked", False):
+            self._mem_tracked = True
+            from ..observability import memplane
+
+            memplane.track_obj("counts", self,
+                               self.padded_len * NUM_SYMBOLS * 4)
 
     # -- vote -------------------------------------------------------------
     def vote(self, thr_enc: np.ndarray, min_depth: int) -> np.ndarray:

@@ -37,6 +37,7 @@ from ..encoder.events import SegmentBatch
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
                           round_rows_grid, round_rows_pow2,
                           unpack_nibbles)
+from ..wire import account_h2d
 from ..wire.codec import canonicalize_rows
 from .base import ALL, ShardedCountsBase, shard_map
 
@@ -254,6 +255,8 @@ class ShardedConsensus(ShardedCountsBase):
                 fn = self._pallas_accumulate(w, plan)
                 self.bytes_h2d += (plan.rank.nbytes + plan.blk_lo.nbytes
                                    + plan.blk_n.nbytes)
+                account_h2d(plan.rank.nbytes + plan.blk_lo.nbytes
+                            + plan.blk_n.nbytes)
                 st_dev, pk_dev = self.put_rows(
                     p_starts.astype(np.int32), p_codes)
                 self._counts = fn(
@@ -266,6 +269,7 @@ class ShardedConsensus(ShardedCountsBase):
                 p_starts, p_codes, slots, e = plan
                 fn = self._mxu_accumulate(e, w)
                 self.bytes_h2d += slots.nbytes
+                account_h2d(slots.nbytes)
                 st_dev, pk_dev = self.put_rows(p_starts, p_codes)
                 self._counts = fn(
                     self.counts, st_dev, pk_dev,

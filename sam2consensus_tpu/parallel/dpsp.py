@@ -48,6 +48,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..constants import NUM_SYMBOLS
 from ..encoder.events import SegmentBatch
+from ..wire import account_h2d
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
                           round_rows_grid, unpack_nibbles)
 from .base import (ALL, ShardedCountsBase, plan_mxu_grids, real_row_mask,
@@ -233,6 +234,7 @@ class ProductShardedConsensus(ShardedCountsBase):
                 jax.device_put(a, self._row_spec if a.ndim == 1
                                else self._mat_spec) for a in extra)
             self.bytes_h2d += sum(a.nbytes for a in extra)
+            account_h2d(sum(a.nbytes for a in extra))
             st_dev, pk_dev = self.put_rows(
                 sl.reshape(-1),
                 np.ascontiguousarray(c_grid[:, :, lo:hi]).reshape(-1, w))

@@ -56,6 +56,7 @@ import numpy as np
 
 from ..constants import NUM_SYMBOLS, PAD_CODE, SP_WINDOW_CAP
 from ..encoder.events import SegmentBatch
+from ..wire import account_h2d
 from ..ops.pileup import (expand_segment_positions, iter_row_slices,
                           round_rows_grid, unpack_nibbles)
 from .base import (ALL, ShardedCountsBase, block_for, plan_mxu_grids,
@@ -269,6 +270,7 @@ class PositionShardedConsensus(ShardedCountsBase):
                 jax.device_put(a, self._row_spec if a.ndim == 1
                                else self._mat_spec) for a in extra)
             self.bytes_h2d += sum(a.nbytes for a in extra)
+            account_h2d(sum(a.nbytes for a in extra))
             st_dev, pk_dev = self.put_rows(
                 sl.reshape(-1),
                 np.ascontiguousarray(c_grid[:, lo:hi]).reshape(-1, w))

@@ -32,6 +32,15 @@ site                  boundary
                       returns; the serve watchdog (serve/runner.py) is
                       what is supposed to notice.  The rule's kind is
                       what the sleep eventually raises, if it wakes.
+``mem_alloc``         the device count-tensor allocation boundary
+                      (ops/pileup.py ``PileupAccumulator``) — the
+                      memory plane's OOM-forensics test hook
+                      (observability/memplane.py): an ``oom`` rule here
+                      models host/HBM exhaustion at allocation time,
+                      exercising the CAPACITY classification, the
+                      ``mem_dump.json`` forensic write, and the serve
+                      host-rung demotion.  The host accumulator carries
+                      no site (the bottom rung, by construction).
 ====================  =====================================================
 
 Spec grammar (CLI ``--fault-inject`` or env ``S2C_FAULT_INJECT``;
@@ -69,7 +78,7 @@ from typing import Dict, List, Optional
 SITES = ("device_put", "pileup_dispatch", "accumulate", "vote",
          "insertion_build", "link_probe", "wire_encode",
          "serve_decode_ahead", "journal_write", "job_hang",
-         "bam_inflate", "ingest_decode_shard")
+         "bam_inflate", "ingest_decode_shard", "mem_alloc")
 
 #: how long a firing ``job_hang`` rule sleeps before raising (seconds);
 #: far past any sane --job-timeout, so the watchdog always wins the race

@@ -298,9 +298,21 @@ class ResilientDispatcher:
                 raise exc              # nothing left to split
             reg = obs.metrics()
             reg.add("resilience/capacity_splits", 1)
+            # predicted-vs-actual at the moment the rung fired
+            # (observability/memplane.py): the capacity model's
+            # prediction next to the tracked/process/device residency,
+            # so the split threshold is evidence, not folklore
+            from ..observability import memplane
+
+            actuals = memplane.capacity_actuals()
+            reg.gauge("resilience/capacity_split").set_info(
+                {"depth": depth,
+                 "error": f"{type(exc).__name__}: {exc}", **actuals})
             obs.tracer().event("resilience/capacity_split",
                                depth=depth,
-                               error=f"{type(exc).__name__}: {exc}")
+                               error=f"{type(exc).__name__}: {exc}",
+                               **{k: v for k, v in actuals.items()
+                                  if v is not None})
             for part in parts:
                 self._dispatch_unit(part, depth + 1)
 

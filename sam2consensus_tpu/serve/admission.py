@@ -38,6 +38,14 @@ from typing import Dict, Optional
 
 REASON_QUEUE_FULL = "queue_full"
 REASON_TENANT_QUOTA = "tenant_quota"
+#: capacity shed (``serve/admission_capacity``): the job's predicted
+#: peak host+device bytes (observability/memplane.py capacity model,
+#: priced from its header-probed genome length + config) exceeds the
+#: server's ``--mem-budget`` — the job is queued-not-OOMed: rejection
+#: is the backpressure signal, and the submitter re-offers it to a
+#: host that fits (or after raising the budget) instead of discovering
+#: the OOM post-mortem
+REASON_CAPACITY = "capacity"
 
 
 @dataclass
@@ -62,6 +70,10 @@ class AdmissionController:
 
     max_queue: int = 0
     tenant_quota: int = 0
+    #: predicted-peak byte budget per job (0 = no capacity gate); see
+    #: REASON_CAPACITY.  Parsed with the count-cache size grammar
+    #: (``--mem-budget 4G`` / S2C_MEM_BUDGET).
+    mem_budget: int = 0
     _window_admitted: int = 0
     _window_by_tenant: Dict[str, int] = field(default_factory=dict)
     #: tenant -> rung its last degraded job landed on ("host"/"device_scatter")
@@ -84,13 +96,22 @@ class AdmissionController:
         self._window_admitted = 0
         self._window_by_tenant = {}
 
-    def admit(self, tenant: str = "") -> Decision:
+    def admit(self, tenant: str = "",
+              predicted_bytes: Optional[int] = None) -> Decision:
+        """One spec's verdict.  ``predicted_bytes`` is the memory
+        plane's capacity prediction for the job (None = unpriceable —
+        header unreadable; admitted, the serial path surfaces the real
+        error): a prediction over ``mem_budget`` sheds the job instead
+        of letting it OOM the warm server."""
         if self.max_queue and self._window_admitted >= self.max_queue:
             return Decision(False, reason=REASON_QUEUE_FULL)
         if (self.tenant_quota and tenant
                 and self._window_by_tenant.get(tenant, 0)
                 >= self.tenant_quota):
             return Decision(False, reason=REASON_TENANT_QUOTA)
+        if (self.mem_budget and predicted_bytes is not None
+                and predicted_bytes > self.mem_budget):
+            return Decision(False, reason=REASON_CAPACITY)
         self._window_admitted += 1
         if tenant:
             self._window_by_tenant[tenant] = \

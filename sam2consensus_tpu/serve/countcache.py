@@ -32,10 +32,15 @@ re-inserts it (updated) at commit.
 from __future__ import annotations
 
 import hashlib
+import logging
 import re
 import threading
 from collections import OrderedDict
 from typing import Optional
+
+from ..observability import memplane
+
+logger = logging.getLogger("sam2consensus_tpu.serve.countcache")
 
 
 def parse_budget(value) -> int:
@@ -116,11 +121,17 @@ class CountCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.evicted_bytes = 0
         self.inserts = 0
         self.invalidated = 0
 
     # -- accounting --------------------------------------------------------
-    def _publish(self, registry) -> None:
+    def _publish(self, registry, prev_bytes: Optional[int] = None) -> None:
+        if prev_bytes is not None:
+            # residency accounting (observability/memplane.py): the
+            # cache bills its byte delta into the count_cache family,
+            # so warm entries show up in every memory surface
+            memplane.adjust("count_cache", self._bytes - prev_bytes)
         if registry is None:
             return
         registry.gauge("cache/entries").set(float(len(self._entries)))
@@ -135,6 +146,7 @@ class CountCache:
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
+                "evicted_mb": round(self.evicted_bytes / 1e6, 3),
                 "inserts": self.inserts,
                 "invalidated": self.invalidated,
             }
@@ -163,39 +175,59 @@ class CountCache:
         budget is not cached (it would evict everything for nothing)."""
         nbytes = entry_nbytes(state)
         with self._lock:
+            prev = self._bytes
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= entry_nbytes(old)
             if nbytes > self.budget:
                 if registry is not None:
                     registry.add("cache/oversize_skipped", 1)
-                self._publish(registry)
+                self._publish(registry, prev_bytes=prev)
                 return
             self._entries[key] = state
             self._bytes += nbytes
             self.inserts += 1
             if registry is not None:
                 registry.add("cache/inserts", 1)
+            evicted = 0
             while self._bytes > self.budget and len(self._entries) > 1:
                 _k, victim = self._entries.popitem(last=False)
-                self._bytes -= entry_nbytes(victim)
+                vbytes = entry_nbytes(victim)
+                self._bytes -= vbytes
                 self.evictions += 1
+                self.evicted_bytes += vbytes
+                evicted += vbytes
                 if registry is not None:
                     registry.add("cache/evictions", 1)
-            self._publish(registry)
+                    # the silent-budget fix: eviction under pressure
+                    # used to log nothing fleet-wide — the evicted
+                    # BYTES now ride the exposition (s2c_cache_
+                    # evicted_bytes_total), the health snapshot and
+                    # the s2c_top memory line
+                    registry.add("cache/evicted_bytes", vbytes)
+            if evicted:
+                logger.info(
+                    "count cache evicted %.1f MB under the %.0f MB "
+                    "budget (%d entr%s resident, %.1f MB)",
+                    evicted / 1e6, self.budget / 1e6,
+                    len(self._entries),
+                    "y" if len(self._entries) == 1 else "ies",
+                    self._bytes / 1e6)
+            self._publish(registry, prev_bytes=prev)
 
     def invalidate(self, key: str, registry=None) -> bool:
         """Drop ``key`` whole — the count-bank rule's failure edge: a
         seeded job that failed may have observed (or half-applied)
         state the next job must not inherit."""
         with self._lock:
+            prev = self._bytes
             state = self._entries.pop(key, None)
             if state is not None:
                 self._bytes -= entry_nbytes(state)
                 self.invalidated += 1
                 if registry is not None:
                     registry.add("cache/invalidated", 1)
-            self._publish(registry)
+            self._publish(registry, prev_bytes=prev)
             return state is not None
 
 

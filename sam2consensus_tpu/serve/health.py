@@ -94,6 +94,9 @@ def snapshot(runner) -> dict:
             # poison submissions (DATA class: blown bad-record budgets);
             # counted per tenant WITHOUT device-rung demotion
             "poison": int(reg.value("serve/admission_poison")),
+            # capacity sheds: predicted peak > --mem-budget
+            # (observability/memplane.py) — queued-not-OOMed
+            "capacity": int(reg.value("serve/admission_capacity")),
         },
         # tolerant decode across the queue + the last job's verdict
         # (per-job history rides each JobResult / job manifest)
@@ -140,6 +143,19 @@ def snapshot(runner) -> dict:
             "burn_by_tenant": dict(getattr(
                 runner.admission, "slo_burn_by_tenant", {})),
         }
+    # memory plane (observability/memplane.py): per-family live/peak +
+    # process/device watermarks, so a prober (or tools/s2c_top.py)
+    # sees residency without a Prometheus stack; the OOM-forensics
+    # tally rides along when any dump was written
+    from ..observability import memplane
+
+    snap["memory"] = memplane.summary()
+    if runner.admission.mem_budget:
+        snap["memory"]["mem_budget_mb"] = round(
+            runner.admission.mem_budget / 1e6, 1)
+    if reg.value("serve/oom_dumps"):
+        snap["memory"]["oom_dumps"] = int(reg.value("serve/oom_dumps"))
+        snap["memory"]["last_oom_dump"] = reg.info("serve/last_oom_dump")
     prof = getattr(runner, "profiler", None)
     if prof is not None and (prof.captures
                              or reg.value("telemetry/write_failed")):

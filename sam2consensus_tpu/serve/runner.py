@@ -274,6 +274,13 @@ class _DecodeAhead:
                     with self._lock:
                         self._intervals.append((t0, t1))
                     self.done_batches.append(batch)
+                    # residency: predecoded batches pin memory until
+                    # job N+1 consumes them (memplane decode_ahead
+                    # family; released when the batch is collected)
+                    from ..observability import memplane
+
+                    memplane.track_obj("decode_ahead", batch,
+                                       memplane.batch_nbytes(batch))
                 self.rest = gen
             except BaseException as exc:
                 # surfaced to the job when it consumes past the decoded
@@ -331,7 +338,7 @@ class ServeRunner:
                  slo=None,
                  profile_capture_dir: Optional[str] = None,
                  batch="off", batch_window: Optional[float] = None,
-                 count_cache=None):
+                 count_cache=None, mem_budget=None):
         from ..backends.jax_backend import JaxBackend
 
         if prewarm not in ("auto", "off"):
@@ -358,8 +365,22 @@ class ServeRunner:
             else _env_float("S2C_JOB_TIMEOUT")
         self.stall_timeout = stall_timeout if stall_timeout is not None \
             else _env_float("S2C_STALL_TIMEOUT")
-        self.admission = AdmissionController(max_queue=max_queue,
-                                             tenant_quota=tenant_quota)
+        # capacity-priced admission (observability/memplane.py): a job
+        # whose predicted peak exceeds the budget is shed with reason
+        # "capacity" instead of being allowed to OOM the warm server.
+        # Same size grammar as --count-cache; a typo fails the start.
+        from . import countcache as _ccache
+
+        try:
+            _mem_budget = _ccache.parse_budget(
+                mem_budget if mem_budget is not None
+                else os.environ.get("S2C_MEM_BUDGET"))
+        except ValueError as exc:
+            raise ValueError(str(exc).replace(
+                "--count-cache", "--mem-budget")) from None
+        self.admission = AdmissionController(
+            max_queue=max_queue, tenant_quota=tenant_quota,
+            mem_budget=_mem_budget)
         # -- continuous batching (serve/scheduler.py) -----------------
         # a typo'd --batch must fail the server start, same discipline
         # as --slo / --fault-inject
@@ -671,6 +692,13 @@ class ServeRunner:
                 < self.telemetry_interval:
             return
         self._telemetry_last = now
+        # low-rate watermark sampler (observability/memplane.py): rides
+        # the telemetry cadence, so a mid-hang scrape of the exposition
+        # or health file shows memory too — and the bounded history
+        # ring this feeds is the OOM forensic dump's watermark tail
+        from ..observability import memplane
+
+        memplane.sample(self.registry)
         if self.telemetry_out:
             try:
                 stele.atomic_write_text(self.telemetry_out,
@@ -919,10 +947,37 @@ class ServeRunner:
                 n_skipped += 1
                 plan.append(entry)
                 continue
-            dec = self.admission.admit(spec.tenant)
+            # capacity signal (observability/memplane.py): only priced
+            # when a --mem-budget is set — the header probe reuses the
+            # batch scheduler's cached-handle discipline, so a later
+            # pack/decode never re-sniffs the container
+            predicted = None
+            if self.admission.mem_budget:
+                total_len = self.scheduler._probe_total_len(entry)
+                if total_len:
+                    from ..observability import memplane
+
+                    predicted = memplane.predict_job_peak_bytes(
+                        total_len, spec.config)
+                    entry["mem_predicted"] = predicted
+                if not self.scheduler.enabled:
+                    # without batching nothing downstream reuses the
+                    # probe handle (decode-ahead re-opens per job) —
+                    # close NOW, or a wide submission window holds one
+                    # open fd per probed spec until the queue drains
+                    ai = entry.pop("batch_handle", None)
+                    if ai is not None:
+                        ai.close()
+            dec = self.admission.admit(spec.tenant,
+                                       predicted_bytes=predicted)
             if not dec.admitted:
                 entry["action"] = "reject"
                 entry["admission"] = dec.reason
+                if dec.reason == "capacity":
+                    self.registry.add("serve/admission_capacity", 1)
+                    ai = entry.pop("batch_handle", None)
+                    if ai is not None:
+                        ai.close()
                 plan.append(entry)
                 continue
             cfg = spec.config
@@ -1057,11 +1112,20 @@ class ServeRunner:
                 else:
                     reason = entry["admission"]
                     res.admission = reason
-                    res.error = f"admission rejected: {reason}"
+                    detail = ""
+                    if reason == "capacity":
+                        detail = (
+                            f": predicted peak "
+                            f"{entry.get('mem_predicted', 0) / 1e6:.1f}"
+                            f" MB > --mem-budget "
+                            f"{self.admission.mem_budget / 1e6:.1f} MB"
+                            f" — re-offer to a host that fits")
+                    res.error = f"admission rejected: {reason}{detail}"
                     self.registry.add("serve/admission_rejected", 1)
                     self.registry.add(
                         f"serve/admission_rejected/{reason}", 1)
-                    self.echo(f"[serve] {job_id}: REJECTED ({reason})")
+                    self.echo(f"[serve] {job_id}: REJECTED "
+                              f"({reason}{detail})")
                 results.append(res)
                 self.jobs_run += 1
                 continue
@@ -1203,6 +1267,7 @@ class ServeRunner:
                 except Exception as exc:
                     self._note_timeout_if_deadline(robs, exc)
                     self._note_poison(spec, exc, res)
+                    self._note_capacity(spec, exc, robs)
                     retry_cfg = self._retry_config(cfg, exc)
                     if retry_cfg is not None:
                         if cache_key is not None:
@@ -1408,6 +1473,38 @@ class ServeRunner:
             self.count_cache.put(key, result, self.registry)
         else:
             self.count_cache.invalidate(key, self.registry)
+
+    def _note_capacity(self, spec: JobSpec, exc: BaseException,
+                       robs) -> None:
+        """OOM forensics (observability/memplane.py): a CAPACITY-class
+        job failure writes ``mem_dump.json`` next to the journal (the
+        durable place an operator already looks — the profiler-capture
+        home otherwise): per-family live/peak, the watermark tail, the
+        capacity prediction, the error.  The job still classifies and
+        (under fallback) demotes exactly as before — forensics never
+        changes the recovery path, it explains it."""
+        from ..observability import memplane
+
+        if robs.registry.value("mem/oom_dumps"):
+            # the backend already dumped next to the job's own metrics
+            # artifact (JaxBackend.run's except path — jobs with a
+            # per-job metrics_out); count it fleet-side, don't write a
+            # second dump over it
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(robs.metrics_out)),
+                memplane.MEM_DUMP_NAME) if robs.metrics_out else None
+        else:
+            out_dir = self.journal.root if self.journal is not None \
+                else self.profiler.out_dir
+            path = memplane.dump_on_capacity(
+                exc, out_dir, registry=robs.registry,
+                context={"job_id": self.health.in_flight,
+                         "tenant": spec.tenant})
+        if path is not None:
+            self.registry.add("serve/oom_dumps", 1)
+            self.registry.gauge("serve/last_oom_dump").set_info(
+                {"path": path, "job": self.health.in_flight,
+                 "error": f"{type(exc).__name__}: {exc}"})
 
     def _note_poison(self, spec: JobSpec, exc: BaseException,
                      res: JobResult) -> None:

@@ -790,6 +790,14 @@ class BatchScheduler:
                 tr = obs.tracer()
                 reg = obs.metrics()
                 merged = packing.merge_batches(plan_pk, pairs)
+                # residency: the combined tensors pin every member's
+                # rows until the wave dispatches
+                # (observability/memplane.py packed_batch family)
+                from ..observability import memplane
+
+                for mb in merged:
+                    memplane.track_obj("packed_batch", mb,
+                                       memplane.batch_nbytes(mb))
                 for m in wave:
                     m.batches = []          # rows now live in the slabs
                 for mb in merged:

@@ -66,6 +66,24 @@ def account_d2h(nbytes: int, link_free=None) -> None:
     obs.metrics().add("wire/d2h_bytes", int(nbytes))
 
 
+def account_h2d(nbytes: int) -> None:
+    """THE host→device accounting choke point, mirroring
+    :func:`account_d2h` for the other direction: every staged
+    ``device_put`` (slab operands, kernel plans, counts uploads,
+    prewarm compiles) bills ``wire/h2d_bytes`` here, so
+    ``stats.extra["h2d_bytes"]`` and the manifests read the registry
+    instead of re-summing per-accumulator attributes.  Unlike d2h
+    there is NO link-free skip: the legacy ``bytes_h2d`` attributes
+    always counted staged bytes even on a shared-memory backend (the
+    encode + copy work is real, and the wire-codec A/B tests compare
+    exactly those totals) — the registry must mirror them exactly."""
+    if nbytes <= 0:
+        return
+    from .. import observability as obs
+
+    obs.metrics().add("wire/h2d_bytes", int(nbytes))
+
+
 def fetch_d2h(x, link_free=None):
     """``np.asarray`` with the transfer billed through
     :func:`account_d2h`; returns the host array."""
@@ -80,5 +98,5 @@ __all__ = [
     "CODECS", "WireSlab", "encode_slab", "decode_slab_host",
     "modeled_wire_ratio", "packed5_slab_bytes", "resolve_codec",
     "row_bytes_estimate", "wire_auto_cutoff_bps", "worthwhile",
-    "account_d2h", "fetch_d2h", "link_free_default",
+    "account_d2h", "account_h2d", "fetch_d2h", "link_free_default",
 ]

@@ -1,0 +1,421 @@
+"""Memory observability plane (observability/memplane.py, ISSUE 14).
+
+Covers the three tentpole surfaces — byte accounting (per-family
+live/peak + the per-registry peak ratchet), the capacity ledger
+decision, and OOM forensics (the ``mem_alloc`` fault site →
+``mem_dump.json`` + CAPACITY classification + serve host-rung
+demotion) — plus the neutrality contract: consensus bytes are
+identical with the plane on or off, the PR 10/12 pattern.
+"""
+
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+from sam2consensus_tpu import observability as obs
+from sam2consensus_tpu.observability import memplane
+# the accessor function obs.metrics shadows the submodule name on the
+# package — import the registry helpers from the module path directly
+from sam2consensus_tpu.observability.metrics import pop_run, push_run
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    memplane._reset_for_tests()
+    yield
+    memplane._reset_for_tests()
+
+
+@pytest.fixture
+def reg():
+    r = push_run()
+    yield r
+    pop_run(r)
+
+
+def _sim_sam(tmp_path, n_reads=1500, seed=7):
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+    path = tmp_path / "in.sam"
+    path.write_text(simulate(SimSpec(
+        n_contigs=2, contig_len=400, n_reads=n_reads, read_len=80,
+        seed=seed)))
+    return str(path)
+
+
+def _run_backend(path, **cfg_kwargs):
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.formats import open_alignment_input
+    from sam2consensus_tpu.io.fasta import render_file
+
+    cfg = RunConfig(prefix="mp", backend="jax", shards=1, **cfg_kwargs)
+    ai = open_alignment_input(path, "auto", binary=True)
+    try:
+        res = JaxBackend().run(ai.contigs, ai.stream, cfg)
+    finally:
+        ai.close()
+    rendered = {n: render_file(r, 0) for n, r in res.fastas.items()}
+    return res, rendered
+
+
+# =========================================================================
+# Accounting choke point
+# =========================================================================
+class TestAccounting:
+    def test_track_release_live_peak(self, reg):
+        memplane.track("counts", 1000)
+        memplane.track("counts", 500)
+        memplane.release("counts", 500)
+        s = memplane.summary()
+        assert s["families"]["counts"]["live_bytes"] == 1000
+        assert s["families"]["counts"]["peak_bytes"] == 1500
+        # registry mirror: live gauge absolute, peak gauge ratcheted
+        assert reg.value("mem/live_bytes/counts") == 1000
+        assert reg.value("mem/peak_bytes/counts") == 1500
+        assert reg.value("mem/peak_tracked_bytes") == 1500
+
+    def test_peak_ratchet_is_concurrent_max_not_sum(self, reg):
+        for _ in range(5):
+            memplane.track("a", 100)
+            memplane.release("a", 100)
+        # five sequential 100-byte lives never coexisted: the ratchet
+        # records the max concurrent footprint, not turnover
+        assert reg.value("mem/peak_tracked_bytes") == 100
+
+    def test_fresh_registry_sees_resident_carryover(self, reg):
+        memplane.track("count_cache", 4096)       # resident before job
+        r2 = push_run()
+        try:
+            memplane.track("counts", 100)
+            # the new job's peak includes the resident cache entry
+            assert r2.value("mem/peak_tracked_bytes") == 4196
+        finally:
+            pop_run(r2)
+
+    def test_track_obj_releases_on_gc(self, reg):
+        class Holder:
+            pass
+
+        h = Holder()
+        memplane.track_obj("decode_ahead", h, 2048)
+        assert memplane.summary()["families"]["decode_ahead"][
+            "live_bytes"] == 2048
+        del h
+        import gc
+
+        gc.collect()
+        s = memplane.summary()["families"]["decode_ahead"]
+        assert s["live_bytes"] == 0
+        assert s["peak_bytes"] == 2048
+
+    def test_disabled_plane_is_a_no_op(self, reg, monkeypatch):
+        monkeypatch.setenv("S2C_MEMPLANE", "0")
+        memplane.track("counts", 12345)
+        assert memplane.summary()["tracked"]["live_bytes"] == 0
+        assert reg.value("mem/peak_tracked_bytes") == 0
+
+    def test_batch_nbytes(self):
+        batch = types.SimpleNamespace(
+            buckets={128: (np.zeros(4, np.int32),
+                           np.zeros((4, 128), np.uint8))},
+            staged={})
+        assert memplane.batch_nbytes(batch) == 4 * 4 + 4 * 128
+
+
+# =========================================================================
+# Watermarks
+# =========================================================================
+class TestWatermarks:
+    def test_sample_publishes_and_keeps_history(self, reg):
+        s = memplane.sample()
+        assert s["peak_rss_mb"] > 0
+        assert reg.value("mem/peak_rss_mb") > 0
+        for _ in range(3):
+            memplane.sample()
+        tail = memplane.history_tail(2)
+        assert len(tail) == 2
+        assert all("peak_rss_mb" in t for t in tail)
+
+    def test_summary_shape(self, reg):
+        memplane.track("counts", 10)
+        s = memplane.summary()
+        assert s["enabled"] is True
+        assert s["tracked"]["live_bytes"] == 10
+        assert "watermarks" in s
+
+
+# =========================================================================
+# Capacity model
+# =========================================================================
+class TestCapacity:
+    def test_predict_monotonic(self):
+        small, comp = memplane.predict_run_peak_bytes(10_000)
+        big, _ = memplane.predict_run_peak_bytes(10_000_000)
+        assert big > small
+        assert set(comp) == {"counts_bytes", "staging_bytes",
+                             "tail_bytes"}
+        t1, _ = memplane.predict_run_peak_bytes(10_000, n_thresholds=1)
+        t3, _ = memplane.predict_run_peak_bytes(10_000, n_thresholds=3)
+        assert t3 > t1
+
+    def test_record_capacity_joins_measured_ratchet(self):
+        robs = obs.start_run()
+        try:
+            memplane.record_capacity(5000, n_thresholds=1,
+                                     chunk_reads=2048)
+            memplane.track("counts", 100_000)
+            recs = obs.finalize_decisions()
+        finally:
+            obs.finish_run(robs)
+        cap = next(r for r in recs if r.decision == "capacity")
+        assert cap.predicted["bytes"] > 0
+        assert cap.measured["bytes"] == 100_000
+        assert "bytes" in cap.residual
+        # informational residual (band=0): headroom never alarms
+        assert cap.drift is False
+
+    def test_budget_verdict(self):
+        robs = obs.start_run()
+        try:
+            rec = memplane.record_capacity(5000, n_thresholds=1,
+                                           chunk_reads=2048,
+                                           budget_bytes=1)
+        finally:
+            obs.finish_run(robs)
+        assert rec["chosen"] == "over_budget"
+
+
+# =========================================================================
+# OOM forensics
+# =========================================================================
+class TestForensics:
+    def test_mem_dump_schema(self, tmp_path, reg):
+        from sam2consensus_tpu.resilience.faultinject import \
+            InjectedOomError
+
+        memplane.track("counts", 777)
+        memplane.record_capacity(1000, n_thresholds=1)
+        exc = InjectedOomError("injected: RESOURCE_EXHAUSTED: oom")
+        path = memplane.dump_on_capacity(exc, str(tmp_path),
+                                         registry=reg,
+                                         context={"job_id": "j1"})
+        assert path is not None
+        blob = json.loads(open(path).read())
+        assert blob["schema"] == "s2c-mem-dump/1"
+        assert blob["error"]["classification"] == "capacity"
+        assert blob["families"]["counts"]["live_bytes"] == 777
+        assert blob["capacity"]["predicted_bytes"] > 0
+        assert blob["context"]["job_id"] == "j1"
+        assert isinstance(blob["watermark_tail"], list)
+        assert reg.value("mem/oom_dumps") == 1
+
+    def test_non_capacity_errors_do_not_dump(self, tmp_path, reg):
+        assert memplane.dump_on_capacity(
+            ValueError("nope"), str(tmp_path), registry=reg) is None
+        assert not (tmp_path / "mem_dump.json").exists()
+
+    def test_injected_mem_alloc_writes_dump_next_to_metrics(
+            self, tmp_path):
+        path = _sim_sam(tmp_path)
+        with pytest.raises(MemoryError):
+            _run_backend(path, pileup="scatter",
+                         fault_inject="mem_alloc:oom:0",
+                         metrics_out=str(tmp_path / "m.jsonl"))
+        from sam2consensus_tpu.resilience.policy import CAPACITY, classify
+        from sam2consensus_tpu.resilience.faultinject import \
+            InjectedOomError
+
+        assert classify(InjectedOomError("x")) == CAPACITY
+        dump = tmp_path / "mem_dump.json"
+        assert dump.exists()
+        blob = json.loads(dump.read_text())
+        assert blob["error"]["classification"] == "capacity"
+        assert blob["error"]["type"] == "InjectedOomError"
+
+    def test_serve_oom_demotes_to_host_rung_with_forensics(
+            self, tmp_path):
+        """An injected allocation OOM in a serve job: the CAPACITY
+        class must demote the job to the host rung (never blindly
+        retry the same shape) AND leave mem_dump.json next to the
+        journal."""
+        from sam2consensus_tpu.config import RunConfig
+        from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+        path = _sim_sam(tmp_path)
+        jdir = tmp_path / "journal"
+        runner = ServeRunner(prewarm="off", decode_ahead=False,
+                             persistent_cache=False,
+                             journal_dir=str(jdir))
+        try:
+            cfg = RunConfig(backend="jax", prefix="mp", shards=1,
+                            pileup="scatter",
+                            on_device_error="fallback",
+                            fault_inject="mem_alloc:oom:0",
+                            outfolder=str(tmp_path / "out"))
+            res = runner.submit_jobs([JobSpec(filename=path,
+                                              config=cfg)])[0]
+            assert res.ok, res.error
+            assert res.rungs.get("pileup") == "host"   # demoted, not
+            # blind-retried: the host rung allocates no device tensor
+            assert runner.registry.value("serve/oom_dumps") == 1
+            assert (jdir / "mem_dump.json").exists()
+            snap = runner.health_snapshot()
+            assert snap["memory"]["oom_dumps"] == 1
+        finally:
+            runner.close()
+
+
+# =========================================================================
+# Capacity-priced admission
+# =========================================================================
+class TestAdmission:
+    def test_controller_capacity_reason(self):
+        from sam2consensus_tpu.serve.admission import (
+            REASON_CAPACITY, AdmissionController)
+
+        adm = AdmissionController(mem_budget=100)
+        adm.open_window()
+        dec = adm.admit("t", predicted_bytes=1000)
+        assert not dec.admitted and dec.reason == REASON_CAPACITY
+        # unpriceable (header unreadable) jobs admit — the serial path
+        # surfaces the real error
+        assert adm.admit("t", predicted_bytes=None).admitted
+        assert adm.admit("t", predicted_bytes=50).admitted
+
+    def test_serve_sheds_over_budget_job(self, tmp_path):
+        from sam2consensus_tpu.config import RunConfig
+        from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+        path = _sim_sam(tmp_path)
+        runner = ServeRunner(prewarm="off", decode_ahead=False,
+                             persistent_cache=False, mem_budget="64K")
+        try:
+            cfg = RunConfig(backend="jax", prefix="mp", shards=1,
+                            outfolder=str(tmp_path / "out"))
+            res = runner.submit_jobs([JobSpec(filename=path,
+                                              config=cfg)])[0]
+            assert not res.ok
+            assert res.admission == "capacity"
+            assert "mem-budget" in res.error
+            assert runner.registry.value(
+                "serve/admission_capacity") == 1
+            snap = runner.health_snapshot()
+            assert snap["admission"]["capacity"] == 1
+            assert snap["memory"]["mem_budget_mb"] > 0
+        finally:
+            runner.close()
+
+    def test_mem_budget_typo_fails_start(self):
+        from sam2consensus_tpu.serve import ServeRunner
+
+        with pytest.raises(ValueError, match="mem-budget"):
+            ServeRunner(prewarm="off", persistent_cache=False,
+                        mem_budget="lots")
+
+
+# =========================================================================
+# Neutrality + registry mirrors
+# =========================================================================
+class TestNeutralityAndSurfaces:
+    @pytest.mark.parametrize("cfg_kwargs", [
+        {"pileup": "scatter"},
+        {"pileup": "host"},
+        {"pileup": "scatter", "wire": "delta8"},
+    ])
+    def test_byte_identity_plane_on_vs_off(self, tmp_path, monkeypatch,
+                                           cfg_kwargs):
+        path = _sim_sam(tmp_path)
+        monkeypatch.setenv("S2C_MEMPLANE", "1")
+        _res_on, out_on = _run_backend(path, **cfg_kwargs)
+        memplane._reset_for_tests()
+        monkeypatch.setenv("S2C_MEMPLANE", "0")
+        _res_off, out_off = _run_backend(path, **cfg_kwargs)
+        assert out_on == out_off
+
+    def test_h2d_mirrors_registry_choke_point(self, tmp_path):
+        path = _sim_sam(tmp_path)
+        res, _out = _run_backend(path, pileup="scatter")
+        extra = res.stats.extra
+        assert extra["h2d_bytes"] > 0
+        # the compat key IS the registry counter now (satellite: h2d
+        # billed through wire.account_h2d like d2h through account_d2h)
+        assert extra["h2d_bytes"] == extra["wire/h2d_bytes"]
+        # memory keys ride stats.extra + manifest
+        assert extra["mem/peak_tracked_bytes"] > 0
+        assert extra["peak_rss_mb"] > 0
+        man = obs.last_manifest()
+        assert man["memory"]["mem/peak_tracked_bytes"] > 0
+
+    def test_openmetrics_mem_family(self, reg):
+        from sam2consensus_tpu.observability.telemetry import (
+            lint_openmetrics, render_openmetrics)
+
+        memplane.track("counts", 4096)
+        memplane.track("wire_staging", 1024)
+        memplane.sample(reg)
+        text = render_openmetrics(reg.snapshot())
+        assert 's2c_mem_live_bytes{family="counts"} 4096' in text
+        assert 's2c_mem_peak_bytes{family="wire_staging"} 1024' in text
+        assert "# HELP s2c_mem_live_bytes " in text
+        assert "s2c_mem_peak_rss_mb" in text
+        assert lint_openmetrics(text) == []
+
+    def test_s2c_top_memory_line(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "s2c_top", os.path.join(os.path.dirname(__file__), "..",
+                                    "tools", "s2c_top.py"))
+        s2c_top = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(s2c_top)
+        health = {
+            "uptime_sec": 10, "queue_depth": 0, "jobs": {},
+            "admission": {"capacity": 2},
+            "memory": {
+                "families": {},
+                "tracked": {"live_bytes": 5_000_000,
+                            "peak_bytes": 9_000_000},
+                "watermarks": {"rss_mb": 150.0, "peak_rss_mb": 200.0},
+                "mem_budget_mb": 64.0,
+                "oom_dumps": 1,
+                "last_oom_dump": {"path": "/j/mem_dump.json"},
+            },
+        }
+        lines = s2c_top.render(health, None)
+        memline = next(ln for ln in lines if ln.startswith("memory:"))
+        assert "5.0 MB live" in memline
+        assert "9.0 MB peak" in memline
+        assert "rss 150 MB" in memline
+        assert "2 capacity-shed" in memline
+        assert any("OOM forensics: 1 dump" in ln for ln in lines)
+
+
+# =========================================================================
+# Count-cache eviction visibility (satellite)
+# =========================================================================
+class TestCacheEviction:
+    @staticmethod
+    def _state(nbytes):
+        counts = np.zeros(max(1, nbytes // 4), dtype=np.int32)
+        return types.SimpleNamespace(
+            counts=counts,
+            insertions=types.SimpleNamespace(array_chunks=[]),
+            sources=[])
+
+    def test_eviction_emits_bytes(self, reg):
+        from sam2consensus_tpu.serve.countcache import CountCache
+
+        cache = CountCache(10_000)
+        cache.put("a", self._state(6000), reg)
+        cache.put("b", self._state(6000), reg)   # evicts a
+        assert cache.evictions == 1
+        assert reg.value("cache/evicted_bytes") >= 6000
+        assert cache.stats()["evicted_mb"] > 0
+        # memplane family mirrors cache residency
+        fams = memplane.summary()["families"]
+        assert fams["count_cache"]["live_bytes"] == cache.stats()[
+            "resident_mb"] * 1e6

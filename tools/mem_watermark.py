@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""Memory-watermark bench leg: peak host+device bytes per config, JSONL.
+
+The bench trajectory measures seconds; this leg measures *residency* —
+one row per config carrying the memory plane's per-family peak bytes,
+the process peak RSS, device peaks where the backend exposes them, and
+the ``capacity`` ledger decision's predicted-vs-measured residual
+(observability/memplane.py).  Each config runs in its OWN subprocess:
+``ru_maxrss`` is a process-lifetime high-water mark, so in-process
+sequencing would make every config inherit its predecessors' peak —
+the exact distortion this tool exists to avoid.
+
+Configs are deliberately CHUNK-FILLING (``chunk_reads`` below the read
+count) with the device pileup pinned, so the staged-slab geometry the
+capacity model prices is the geometry that actually allocates and the
+residual lands inside the default drift band — the committed artifact
+(``campaign/mem_watermark_r06_cpufallback.jsonl``) is what keeps the
+model honest (the model's residual on under-filled interactive runs is
+informational headroom by design).
+
+Usage:
+  python tools/mem_watermark.py --out -                 # JSONL to stdout
+  python tools/mem_watermark.py --out mem.jsonl --configs phix_8k
+  python tools/regress_check.py --jsonl mem.jsonl \
+      --group-by config --value peak_rss_mb --lower-is-better
+
+Wired as the idempotent ``mem_watermark`` campaign step
+(tools/tpu_campaign.sh); gated alongside ``jax_sec`` by
+tools/regress_check.py (``peak_rss_mb`` rides the default bench-series
+metric set too).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: (name, sim kwargs, run kwargs) — chunk-filling shapes, device pileup
+CONFIGS = {
+    "phix_8k": (
+        dict(n_contigs=1, contig_len=5386, n_reads=8000, read_len=100,
+             seed=101, contig_prefix="phiX"),
+        dict(thresholds=[0.25], chunk_reads=2048, pileup="scatter")),
+    "target_capture_16k": (
+        dict(n_contigs=350, contig_len=1200, n_reads=16000,
+             read_len=100, seed=202, contig_prefix="gene"),
+        dict(thresholds=[0.25], chunk_reads=4096, pileup="scatter")),
+    "multithreshold_8k": (
+        dict(n_contigs=1, contig_len=5386, n_reads=8000, read_len=100,
+             seed=101, contig_prefix="phiX"),
+        dict(thresholds=[0.25, 0.5, 0.75], chunk_reads=2048,
+             pileup="scatter")),
+}
+
+
+def run_one(name: str) -> dict:
+    """Run ONE config in this process and print its row (the subprocess
+    entry — fresh ru_maxrss, fresh jit cache, fresh memory plane)."""
+    import tempfile
+
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.formats import open_alignment_input
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+    sim_kwargs, run_kwargs = CONFIGS[name]
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, f"{name}.sam")
+        with open(path, "w") as fh:
+            fh.write(simulate(SimSpec(**sim_kwargs)))
+        cfg = RunConfig(prefix="mw", backend="jax", shards=1,
+                        **run_kwargs)
+        backend = JaxBackend()
+        ai = open_alignment_input(path, "auto", binary=True)
+        t0 = time.perf_counter()
+        res = backend.run(ai.contigs, ai.stream, cfg)
+        elapsed = time.perf_counter() - t0
+        ai.close()
+    extra = res.stats.extra
+    from sam2consensus_tpu import observability
+
+    man = observability.last_manifest() or {}
+    cap = next((d for d in man.get("decisions", [])
+                if d.get("decision") == "capacity"), {})
+    fams = {k[len("mem/peak_bytes/"):]: round(v / 1e6, 3)
+            for k, v in extra.items()
+            if k.startswith("mem/peak_bytes/")}
+    row = {
+        "config": name,
+        "reads": int(res.stats.reads_mapped),
+        "total_len": cap.get("inputs", {}).get("total_len"),
+        "jax_sec": round(elapsed, 3),
+        "peak_rss_mb": extra.get("peak_rss_mb"),
+        "peak_tracked_mb": round(
+            extra.get("mem/peak_tracked_bytes", 0) / 1e6, 3),
+        "family_peak_mb": fams,
+        "device_peak_mb": round(
+            extra.get("mem/device_peak_bytes", 0) / 1e6, 3)
+        if extra.get("mem/device_peak_bytes") else None,
+        "capacity_predicted_mb": round(
+            cap.get("predicted", {}).get("bytes", 0) / 1e6, 3),
+        "capacity_residual": cap.get("residual", {}).get("bytes"),
+        "capacity_drift": cap.get("drift", False),
+    }
+    return row
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default="-",
+                   help="JSONL destination ('-' = stdout)")
+    p.add_argument("--configs", default=",".join(CONFIGS),
+                   help="comma-separated subset of: "
+                        + ", ".join(CONFIGS))
+    p.add_argument("--one", default=None, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.one is not None:
+        # subprocess mode: one config, one row on stdout
+        print(json.dumps(run_one(args.one)))
+        return 0
+
+    names = [n for n in args.configs.split(",") if n]
+    unknown = [n for n in names if n not in CONFIGS]
+    if unknown:
+        print(f"unknown config(s): {unknown}", file=sys.stderr)
+        return 2
+    rows = []
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    for name in names:
+        print(f"[mem_watermark] {name}...", file=sys.stderr)
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--one", name],
+            capture_output=True, text=True, env=env, timeout=900)
+        if r.returncode != 0:
+            err = (r.stderr.strip().splitlines() or ["no output"])[-1]
+            print(f"[mem_watermark] {name} FAILED: {err}",
+                  file=sys.stderr)
+            rows.append({"config": name, "error": err})
+            continue
+        line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+        row = json.loads(line)
+        rows.append(row)
+        print(f"[mem_watermark] {name}: peak_rss {row['peak_rss_mb']} "
+              f"MB, tracked {row['peak_tracked_mb']} MB, predicted "
+              f"{row['capacity_predicted_mb']} MB (residual "
+              f"{row['capacity_residual']})", file=sys.stderr)
+    text = "".join(json.dumps(r) + "\n" for r in rows)
+    if args.out == "-":
+        sys.stdout.write(text)
+    else:
+        with open(args.out, "w") as fh:
+            fh.write(text)
+    bad = [r for r in rows if "error" in r]
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,7 +90,7 @@ def main(argv=None):
                         "file)")
     p.add_argument("--metric", action="append", default=None,
                    help="per-config metric(s) to gate "
-                        "(default: vs_baseline, jax_sec)")
+                        "(default: vs_baseline, jax_sec, peak_rss_mb)")
     p.add_argument("--k", type=float, default=regress.DEFAULT_K,
                    help="MAD band width (sigmas; default %(default)s)")
     p.add_argument("--rel-floor", type=float,
@@ -128,7 +128,11 @@ def main(argv=None):
             print("no bench artifacts found", file=sys.stderr)
             return 2
         candidate = args.new or paths[-1]
-        metrics = tuple(args.metric or ("vs_baseline", "jax_sec"))
+        # peak_rss_mb rides alongside the time metrics (rows before the
+        # memory plane simply contribute no history for it, which the
+        # min-repeat rule reports loudly rather than banding on noise)
+        metrics = tuple(args.metric or ("vs_baseline", "jax_sec",
+                                        "peak_rss_mb"))
         verdicts = gate_bench(paths, candidate, metrics, args.k,
                               args.rel_floor, args.min_repeats)
 

@@ -156,6 +156,46 @@ def render(health, samples, now=None):
             f"{int(chits or 0)} hits  {int(cevict or 0)} evictions"
             + (f"  (budget {cc.get('budget_mb')} MB)"
                if cc.get("budget_mb") else ""))
+    # memory plane (health "memory" section, falling back to the
+    # s2c_mem_* exposition family): tracked live/peak, process RSS,
+    # device bytes, the capacity-shed tally and the count cache's
+    # eviction pressure — the line that answers "is this server about
+    # to OOM" without a Prometheus stack
+    mem = health.get("memory") or {}
+    tracked = mem.get("tracked") or {}
+    wm = mem.get("watermarks") or {}
+    live = tracked.get("live_bytes")
+    if live is None:
+        live = _sample(samples, "s2c_mem_live_tracked_bytes")
+    peak = tracked.get("peak_bytes")
+    rss = wm.get("rss_mb")
+    if rss is None:
+        rss = _sample(samples, "s2c_mem_rss_mb")
+    prss = wm.get("peak_rss_mb")
+    if prss is None:
+        prss = _sample(samples, "s2c_mem_peak_rss_mb")
+    cev = _sample(samples, "s2c_cache_evicted_bytes_total")
+    if cev is None:
+        cev = (cc.get("evicted_mb") or 0.0) * 1e6 if cc else None
+    ncap = health.get("admission", {}).get("capacity")
+    if live is not None or mem:
+        dev = wm.get("device_bytes_in_use")
+        line = (f"memory: tracked {(live or 0) / 1e6:.1f} MB live"
+                + (f" / {peak / 1e6:.1f} MB peak"
+                   if peak is not None else "")
+                + (f"  rss {rss:.0f} MB" if rss is not None else "")
+                + (f" (peak {prss:.0f})" if prss is not None else "")
+                + (f"  device {dev / 1e6:.1f} MB"
+                   if dev is not None else "")
+                + (f"  budget {mem.get('mem_budget_mb')} MB"
+                   if mem.get("mem_budget_mb") else "")
+                + (f"  {int(ncap)} capacity-shed" if ncap else "")
+                + (f"  cache evicted {cev / 1e6:.1f} MB" if cev else ""))
+        lines.append(line)
+    if mem.get("oom_dumps"):
+        last = (mem.get("last_oom_dump") or {}).get("path")
+        lines.append(f"OOM forensics: {mem['oom_dumps']} dump(s)"
+                     + (f" (last: {last})" if last else ""))
     # per-tenant table from the exposition (p50/p99 e2e + rung)
     rungs = health.get("tenant_rungs", {})
     tenants = _tenants(samples) or sorted(rungs) or []

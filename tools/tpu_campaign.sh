@@ -274,4 +274,17 @@ S2C_DECODE_MBPS_PER_CORE=1200 \
   "campaign/incremental_stderr_$R.log" 1800 \
   python tools/incremental_bench.py --reads 1000000 --passes 3 --out -
 
+# 13. memory watermarks (ISSUE 14 memory plane): peak host+device
+# bytes per config, one subprocess per config (ru_maxrss is a
+# process-lifetime high-water mark), chunk-filling shapes so the
+# capacity ledger decision's residual sits inside the drift band.
+# On the TPU rig this additionally captures device memory_stats()
+# peaks that the cpu-fallback proof cannot.  Gate the series with:
+#   python tools/regress_check.py --jsonl campaign/mem_watermark_$R.jsonl \
+#     --group-by config --value peak_rss_mb --lower-is-better
+# CPU-fallback harness proof: campaign/mem_watermark_r06_cpufallback.jsonl
+run_step mem_watermark "campaign/mem_watermark_$R.jsonl" \
+  "campaign/mem_watermark_stderr_$R.log" 1800 \
+  python tools/mem_watermark.py --out -
+
 echo "$(date +%H:%M:%S) campaign complete" >> "$LOG"
