@@ -700,6 +700,46 @@ def incremental_leg(extra_pct):
     return row
 
 
+def serve_fleet_leg(n_jobs):
+    """The fleet queue-drain row (ISSUE 15 tentpole): the same
+    journaled queue drained by one worker vs BENCH_FLEET_WORKERS
+    work-stealing workers (sam2consensus_tpu/serve/fleet.py).
+    ``jax_sec`` is the fleet per-job drain wall and ``vs_baseline``
+    the one-worker/fleet drain ratio (bigger = better, like every
+    row), so the regression gate judges the fleet series with the
+    same bands.  The ROADMAP 2(b) >=1.8x target applies on multi-core
+    rigs; the row records ``host_cores`` so a 1-core harness artifact
+    reads as what it is."""
+    from sam2consensus_tpu.serve.benchmark import run_fleet_bench
+
+    n_workers = int(os.environ.get("BENCH_FLEET_WORKERS", "2"))
+    res = run_fleet_bench(n_jobs=n_jobs, n_workers=n_workers, log=log)
+    s = res["summary"]
+    row = {
+        "config": "serve_fleet",
+        "jobs": s["n_jobs"],
+        "reads_per_job": s["n_reads"],
+        "workers": s["n_workers"],
+        "host_cores": s["host_cores"],
+        "jax_sec": s["fleet_per_job_sec"],
+        "serial_drain_sec": s["serial_drain_sec"],
+        "fleet_drain_sec": s["fleet_drain_sec"],
+        "vs_baseline": s["drain_speedup"],
+        "vs_baseline_kind": "one_worker_drain",
+        "identical": s["identical"],
+        "fleet": {
+            "lost": s["lost"],
+            "duplicated": s["duplicated"],
+            "lease_ttl_sec": s["lease_ttl_sec"],
+        },
+    }
+    log(f"[serve_fleet] 1 worker {s['serial_drain_sec']}s vs "
+        f"{s['n_workers']} workers {s['fleet_drain_sec']}s = "
+        f"{s['drain_speedup']}x ({s['host_cores']} core(s)), "
+        f"identical={s['identical']}")
+    return row
+
+
 def full_artifact_path():
     """Destination for the complete (untruncated) result object:
     BENCH_FULL_OUT wins, else BENCH_TAG -> BENCH_<tag>.full.json next
@@ -773,6 +813,17 @@ def main():
             except Exception as exc:
                 log(f"[serve_batch] FAILED: {type(exc).__name__}: {exc}")
                 rows.append({"config": "serve_batch",
+                             "error": repr(exc)})
+        # fleet queue-drain leg: 1 worker vs N work-stealing workers
+        # over one journal (BENCH_FLEET_JOBS=0 disables)
+        n_fleet = int(os.environ.get("BENCH_FLEET_JOBS", "6"))
+        if n_fleet > 0 and (not only or "serve_fleet" in only):
+            try:
+                rows.append(serve_fleet_leg(n_fleet))
+            except Exception as exc:
+                log(f"[serve_fleet] FAILED: {type(exc).__name__}: "
+                    f"{exc}")
+                rows.append({"config": "serve_fleet",
                              "error": repr(exc)})
         # incremental-consensus leg: +N% reads on a warm reference vs
         # the cold combined job (BENCH_INCR_PCT=0 disables)

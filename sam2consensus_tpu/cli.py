@@ -477,6 +477,39 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "(checkpoints need serial decode).  Outputs are "
                         "written per job at commit time, not at queue "
                         "end")
+    p.add_argument("--worker-id", dest="worker_id", default="",
+                   help="fleet mode (sam2consensus_tpu/serve/fleet.py; "
+                        "requires --journal): join the journal as a "
+                        "work-stealing worker under this UNIQUE id — "
+                        "N processes launched with the same --journal "
+                        "and the same inputs share the queue: each job "
+                        "is claimed (atomic journal event, first "
+                        "writer wins) before it runs, leases carry a "
+                        "TTL renewed while the worker lives, and a "
+                        "dead/frozen worker's expired lease is reaped "
+                        "by a peer which re-claims the job from its "
+                        "checkpoint — zero lost, zero duplicated.  Two "
+                        "live processes sharing one id is operator "
+                        "error (the id IS the lease identity)")
+    p.add_argument("--lease-ttl", dest="lease_ttl", type=float,
+                   default=None,
+                   help="fleet lease TTL seconds (env S2C_LEASE_TTL, "
+                        "default 30): a worker silent this long is "
+                        "presumed dead and its in-flight job becomes "
+                        "re-claimable; recovery latency is ~TTL + one "
+                        "reap-scan period, so smaller = faster "
+                        "takeover, larger = more tolerance for "
+                        "stop-the-world pauses.  Renewals ride the "
+                        "0.1 s watchdog poll at half-TTL margin")
+    p.add_argument("--verify-outputs", dest="verify_outputs",
+                   choices=["fast", "full"], default="fast",
+                   help="journal-resume output verification: fast "
+                        "(default) accepts a committed file whose "
+                        "size+mtime still match the commit-time stat "
+                        "and re-hashes only on drift — resume over a "
+                        "large committed queue is O(stat); full "
+                        "re-hashes every committed output "
+                        "unconditionally")
     p.add_argument("--job-timeout", dest="job_timeout", type=float,
                    default=None,
                    help="per-job wall-clock deadline in seconds "
@@ -644,6 +677,22 @@ def serve_main(argv: List[str]) -> int:
             "error: --incremental does not compose with --journal "
             "(the journal injects per-job checkpoint homes, a second "
             "source of resumable state)")
+    if args.worker_id and not args.journal:
+        raise SystemExit(
+            "error: --worker-id requires --journal (the shared "
+            "journal IS the fleet's work-stealing queue)")
+    if args.worker_id and args.batch != "off":
+        raise SystemExit(
+            "error: --worker-id does not compose with --batch "
+            "(packed batches would need batch-level leases; the "
+            "fleet IS the parallelism)")
+    if args.worker_id and cache_on:
+        raise SystemExit(
+            "error: --worker-id does not compose with --count-cache "
+            "(incremental jobs are rejected on a journaled server, "
+            "so the cache would be a silent no-op)")
+    if args.lease_ttl is not None and not args.lease_ttl > 0:
+        raise SystemExit("error: --lease-ttl must be > 0")
     if args.fault_inject:
         from .resilience.faultinject import parse_spec
 
@@ -695,8 +744,13 @@ def serve_main(argv: List[str]) -> int:
                          batch=args.batch,
                          batch_window=args.batch_window,
                          count_cache=args.count_cache,
-                         mem_budget=args.mem_budget)
+                         mem_budget=args.mem_budget,
+                         worker_id=args.worker_id,
+                         lease_ttl=args.lease_ttl,
+                         verify_outputs=args.verify_outputs)
     echo(f"\nServing {len(specs)} job(s) on one warm backend"
+         + (f" as fleet worker {args.worker_id!r}"
+            if args.worker_id else "")
          + (f" (jit cache: {runner.cache_dir})" if runner.cache_dir
             else "")
          + (f" (journal: {runner.journal.root})" if runner.journal

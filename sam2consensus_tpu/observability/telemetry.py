@@ -184,8 +184,11 @@ class AggregateRegistry(MetricsRegistry):
     # real footprint.  The watchdog-tick sampler
     # (observability/memplane.sample) publishes the server-lifetime
     # mem/* family into this registry directly instead.
+    # fleet/: the claim/lease counters are runner-owned coordination
+    # state (serve/fleet.py records them straight into the server
+    # registry); a job registry carrying a copy would double-count
     FOLD_SKIP_PREFIXES = ("serve/", "slo/", "telemetry/", "cache/",
-                          "mem/")
+                          "mem/", "fleet/")
 
     def fold(self, registry: MetricsRegistry, job_id: str = "",
              tenant: str = "") -> None:
@@ -355,6 +358,40 @@ _HELP = {
     "s2c_serve_oom_dumps_total": "Serve jobs whose CAPACITY failure "
                                  "wrote a mem_dump.json next to the "
                                  "journal.",
+    # fleet mode (serve/fleet.py): the s2c_fleet_* family — every
+    # sample additionally carries a worker="<id>" label so
+    # tools/s2c_top.py --fleet can merge N workers' expositions
+    "s2c_fleet_claims_total": "Job leases this worker won (fleet "
+                              "work-stealing over the shared "
+                              "journal).",
+    "s2c_fleet_claim_lost_total": "Claim races this worker lost to a "
+                                  "peer (it moved on; the peer runs "
+                                  "the job).",
+    "s2c_fleet_steals_total": "Expired peer leases this worker reaped "
+                              "AND re-claimed (dead/frozen worker's "
+                              "job resumed from its checkpoint).",
+    "s2c_fleet_lease_renewals_total": "Lease TTL renewals on the "
+                                      "watchdog tick.",
+    "s2c_fleet_lease_reaped_total": "Peer leases this worker marked "
+                                    "expired (lease_expired events "
+                                    "appended).",
+    "s2c_fleet_lease_lost_total": "Jobs this worker finished but "
+                                  "could NOT commit: its lease had "
+                                  "been reaped mid-run (result "
+                                  "abandoned, the thief commits).",
+    "s2c_fleet_completed_elsewhere_total": "Queue entries resolved by "
+                                           "a peer's journal commit "
+                                           "(this worker never "
+                                           "decoded a byte).",
+    "s2c_fleet_failed_elsewhere_total": "Queue entries a peer "
+                                        "journaled as failed "
+                                        "(terminal, like a local "
+                                        "failure).",
+    "s2c_fleet_journal_write_failed_total": "Fleet journal appends "
+                                            "that failed (an "
+                                            "unjournaled claim is "
+                                            "simply not held).",
+    "s2c_fleet_leases_held": "Leases this worker currently holds.",
 }
 
 
@@ -397,7 +434,8 @@ class _Family:
                              float(value)))
 
 
-def render_openmetrics(snapshot: dict) -> str:
+def render_openmetrics(snapshot: dict,
+                       worker: Optional[str] = None) -> str:
     """Registry snapshot -> Prometheus/OpenMetrics text exposition.
 
     Structured families get proper labels instead of path-encoded
@@ -409,6 +447,11 @@ def render_openmetrics(snapshot: dict) -> str:
     rendered flat under a sanitized ``s2c_`` name (counters suffixed
     ``_total``).  Output is sorted and deterministic; ends with
     ``# EOF``.
+
+    ``worker`` (fleet mode, ``--worker-id``) stamps EVERY sample with
+    a trailing ``worker="<id>"`` label, so N workers' expositions
+    merge into one fleet view (``tools/s2c_top.py --fleet``, or any
+    Prometheus scraping all of them) without sample collisions.
     """
     fams: Dict[str, _Family] = {}
 
@@ -459,6 +502,7 @@ def render_openmetrics(snapshot: dict) -> str:
         f.add("_sum", labels, entry["sum"])
         f.add("_count", labels, entry["count"])
 
+    wlabel = [("worker", worker)] if worker else []
     lines: List[str] = []
     for name in sorted(fams):
         f = fams[name]
@@ -469,7 +513,8 @@ def render_openmetrics(snapshot: dict) -> str:
         lines.append(f"# TYPE {name} {f.ftype}")
         for sname, labels, value in sorted(
                 f.samples, key=lambda s: (s[0], s[1])):
-            lines.append(f"{sname}{_labels(labels)} {_fmt(value)}")
+            lines.append(
+                f"{sname}{_labels(labels + wlabel)} {_fmt(value)}")
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 

@@ -67,6 +67,15 @@ capture (SIGUSR2 / ``capture_profile`` touch-file), and correlated
 JSON logs (``--log-format json``).  All best-effort: telemetry never
 fails a job.
 
+Fleet mode (:mod:`.fleet`, ``--worker-id W --lease-ttl S`` on a
+``--journal`` server): N worker processes share ONE journal as a
+work-stealing queue — jobs are claimed through atomic first-writer-
+wins journal events, leases carry a TTL renewed on the watchdog tick,
+and each worker reaps peers' expired leases so a SIGKILL'd or frozen
+worker's in-flight job is re-claimed from its checkpoint with zero
+lost / zero duplicated jobs (a worker re-confirms its lease before
+committing, so a woken zombie abandons rather than double-commits).
+
 Continuous batching (:mod:`.scheduler` + :mod:`.packing`,
 ``--batch {off,auto,N}`` / ``--batch-window``): the admission queue's
 eligible small jobs are packed into shared canonical slabs so N jobs
@@ -78,6 +87,7 @@ packed phase demoting only that batch back to the serial path.
 
 from .admission import AdmissionController
 from .countcache import CountCache, parse_budget, reference_key
+from .fleet import FleetCoordinator
 from .health import snapshot as health_snapshot
 from .journal import JobJournal, job_key
 from .packing import (PackPlan, extract_counts, extract_member,
@@ -90,4 +100,4 @@ __all__ = ["JobSpec", "JobResult", "ServeRunner", "submit_jobs",
            "health_snapshot", "BatchScheduler", "parse_batch_mode",
            "PackPlan", "plan_pack", "merge_batches", "extract_counts",
            "extract_member", "CountCache", "parse_budget",
-           "reference_key"]
+           "reference_key", "FleetCoordinator"]

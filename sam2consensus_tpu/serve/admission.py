@@ -96,6 +96,19 @@ class AdmissionController:
         self._window_admitted = 0
         self._window_by_tenant = {}
 
+    def seed_window(self, counts: Dict[str, int]) -> None:
+        """Pre-charge the freshly-opened window with jobs the rest of
+        the FLEET already has live (journal-visible submitted-not-
+        terminal keys of other workers, serve/fleet.py): per-tenant
+        quotas then hold against the fleet's queue, not just this
+        worker's submission."""
+        for tenant, n in counts.items():
+            if n <= 0:
+                continue
+            self._window_admitted += n
+            self._window_by_tenant[tenant] = \
+                self._window_by_tenant.get(tenant, 0) + n
+
     def admit(self, tenant: str = "",
               predicted_bytes: Optional[int] = None) -> Decision:
         """One spec's verdict.  ``predicted_bytes`` is the memory

@@ -107,6 +107,16 @@ def snapshot(runner) -> dict:
         "journal": runner.journal.position()
         if runner.journal is not None else None,
     }
+    # fleet mode (serve/fleet.py): which worker this snapshot belongs
+    # to, plus its lease book — held leases with renewal ages, the
+    # reap/steal tallies.  tools/s2c_top.py --fleet merges N of these
+    # into one view; a lease whose last_renew_age_sec approaches the
+    # TTL is the about-to-be-reaped signature.
+    if getattr(runner, "worker_id", ""):
+        snap["worker_id"] = runner.worker_id
+        fl = getattr(runner, "fleet", None)
+        if fl is not None:
+            snap["lease"] = fl.lease_summary()
     # fleet telemetry (observability/telemetry.py): the SLO burn and
     # the telemetry plane's own health, so a prober without a
     # Prometheus stack still sees objective breaches

@@ -5,20 +5,28 @@ lost every pending job and forgot which jobs already ran, so a naive
 re-launch either dropped work or ran it twice.  The journal makes the
 queue durable with the cheapest discipline that is actually
 crash-safe on POSIX: an append-only sequence of single-event JSON
-SEGMENTS, each written to a temp file and ``os.replace``d into place
-(the same atomicity utils/checkpoint.py relies on).  A ``kill -9`` at
-any instant leaves only whole events behind — there is no shared
-append file whose torn last line needs heuristic repair, and replay
-order is the segment sequence number, not mtime.
+SEGMENTS, each written to a temp file, fsynced, and PUBLISHED with
+``os.link`` — an O_EXCL-style rename that FAILS when the target
+sequence number is already taken, which is what makes the journal safe
+for MULTIPLE writer processes (the fleet, below): two workers racing
+for segment N cannot tear or overwrite each other; exactly one wins N,
+the loser re-scans and takes N+1.  A ``kill -9`` at any instant leaves
+only whole events behind — there is no shared append file whose torn
+last line needs heuristic repair, and replay order is the segment
+sequence number, not mtime.
 
 Event vocabulary (one JSON object per segment)::
 
-    submitted  {job, key, filename, seq}
-    started    {job, key, ckpt}          # ckpt = per-job checkpoint dir
-    committed  {job, key, outputs: {path: "sha256:..."}, elapsed_sec}
-    failed     {job, key, error}
-    rejected   {job, key, reason}        # admission control audit
-    resumed    {job, key, mode}          # restart bookkeeping (audit)
+    submitted     {job, key, filename, seq}
+    started       {job, key, ckpt[, worker, tenant]}
+    committed     {job, key, outputs: {path: fingerprint}, elapsed_sec
+                   [, worker, tenant]}
+    failed        {job, key, error}
+    rejected      {job, key, reason}       # admission control audit
+    resumed       {job, key, mode}         # restart bookkeeping (audit)
+    claimed       {job, key, worker, expires_unix}   # fleet: lease open
+    lease_renewed {key, worker, expires_unix}        # fleet: TTL push
+    lease_expired {key, worker, reaper}              # fleet: lease reap
 
 A job's IDENTITY (``key``) hashes its input path plus every config
 field that changes the output bytes — so a restarted server given the
@@ -34,6 +42,29 @@ Replay semantics (:meth:`JobJournal.replay`):
   process died: it re-runs, resuming from its per-job checkpoint dir
   (the PR-2 emergency/periodic checkpoints) when one survived;
 * everything else re-runs from scratch (zero lost jobs).
+
+Claim/lease semantics (serve/fleet.py drives these; replay just keeps
+the state machine):
+
+* the FIRST ``claimed`` event for a key — in segment order, which the
+  O_EXCL publication makes a total order — opens that key's lease;
+  later ``claimed`` events while a lease is open are LOSING claims and
+  are ignored (the loser observes this on replay and moves on);
+* ``lease_renewed`` by the holding worker pushes ``expires_unix``;
+* ``lease_expired`` (appended by a REAPER that observed the wall-clock
+  expiry) closes the lease, so the next ``claimed`` can win — this is
+  how a SIGKILL'd or frozen worker's in-flight job gets re-claimed;
+* ``committed``/``failed`` close the lease terminally.
+
+Replay cursor/compaction: every ``checkpoint_every`` appends the
+journal writes a ``checkpoint-NNNNNNNN.json`` summary segment — the
+full :class:`ReplayState` as of segment N, built from a fresh disk
+replay (never from a possibly-stale in-memory mirror).  ``replay()``
+loads the newest readable checkpoint and applies only the segments
+past it, so a long-lived fleet journal replays O(tail), not
+O(lifetime); ``replay(full=True)`` ignores checkpoints (the audit path
+that proves compacted replay == full replay), and :meth:`prune`
+deletes the segments a checkpoint already covers.
 
 The ``journal_write`` fault-injection site fires on every segment
 append (resilience/faultinject.py; the serve runner checks it against
@@ -52,11 +83,12 @@ import os
 import shutil
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("sam2consensus_tpu.serve.journal")
 
 SCHEMA = "s2c-journal/1"
+CKPT_SCHEMA = "s2c-journal-checkpoint/1"
 
 #: fields of RunConfig that change the OUTPUT BYTES of a job — the job
 #: key hashes exactly these, so a re-queued job with a different
@@ -66,9 +98,21 @@ SCHEMA = "s2c-journal/1"
 KEY_FIELDS = ("thresholds", "min_depth", "fill", "maxdel", "prefix",
               "nchar", "outfolder", "py2_compat", "strict")
 
-#: lifecycle events; ``rejected``/``resumed`` are audit-only
+#: lifecycle events; ``rejected``/``resumed`` are audit-only, the
+#: ``claimed``/``lease_*`` trio is the fleet's work-stealing layer
 EVENTS = ("submitted", "started", "committed", "failed", "rejected",
-          "resumed")
+          "resumed", "claimed", "lease_renewed", "lease_expired")
+
+#: default appends between checkpoint segments (S2C_JOURNAL_CKPT_EVERY
+#: overrides; 0 disables).  Small enough that a busy fleet journal's
+#: replay tail stays a few hundred segments, large enough that the
+#: full-replay cost of writing one is paid rarely.
+DEFAULT_CHECKPOINT_EVERY = 512
+
+#: bounded retry for the O_EXCL segment-number race — each loss means
+#: another writer PUBLISHED a segment, so 64 losses in a row would
+#: need 64 concurrent appends landing between our rescans
+_APPEND_ATTEMPTS = 64
 
 
 def job_key(filename: str, config) -> str:
@@ -90,6 +134,22 @@ def file_sha256(path: str) -> Optional[str]:
         return None
 
 
+def file_fingerprint(path: str) -> Optional[dict]:
+    """Commit-time output fingerprint: content hash PLUS the stat pair
+    (size, mtime) that lets the resume-time verifier skip the re-hash
+    when the file demonstrably never changed (see
+    :meth:`JobJournal.verify_outputs`)."""
+    sha = file_sha256(path)
+    if sha is None:
+        return None
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return {"sha256": sha, "size": st.st_size,
+            "mtime": round(st.st_mtime, 6)}
+
+
 @dataclass
 class ReplayState:
     """What a restarted server knows about its queue."""
@@ -106,81 +166,205 @@ class ReplayState:
     #: every key ever journaled as submitted (restart re-submits are
     #: deduped against this)
     submitted: set = field(default_factory=set)
+    #: key -> the OPEN lease: {worker, claim_seq, expires_unix} — the
+    #: winning claim per key (fleet mode; see the module docstring)
+    claims: Dict[str, dict] = field(default_factory=dict)
+    #: keys that have EVER been claimed — once a key's lifecycle uses
+    #: leases, its commits are FENCED: a ``committed`` event must come
+    #: from the holder of the key's open lease (worker + claim_seq) or
+    #: it is void on replay.  This is what makes duplicated=0
+    #: structural under split-brain: a zombie whose pending commit
+    #: append lands AFTER the thief's commit is rejected by journal
+    #: order, not by a racy pre-append check.
+    claimed_ever: set = field(default_factory=set)
+    #: key -> count of commit events VOIDED by the lease fence (a
+    #: zombie's stale append) — forensic, not part of commit_counts
+    stale_commits: Dict[str, int] = field(default_factory=dict)
+    #: key -> tenant label, from started events that carried one (the
+    #: journal-visible input to fleet-global admission accounting)
+    tenants: Dict[str, str] = field(default_factory=dict)
     last_seq: int = 0
     events: int = 0
     corrupt_segments: int = 0
 
+    # -- checkpoint (de)serialization ----------------------------------
+    def to_blob(self) -> dict:
+        return {"schema": CKPT_SCHEMA,
+                "committed": self.committed, "failed": self.failed,
+                "inflight": self.inflight,
+                "commit_counts": self.commit_counts,
+                "submitted": sorted(self.submitted),
+                "claims": self.claims, "tenants": self.tenants,
+                "claimed_ever": sorted(self.claimed_ever),
+                "stale_commits": self.stale_commits,
+                "last_seq": self.last_seq, "events": self.events,
+                "corrupt_segments": self.corrupt_segments}
+
+    @classmethod
+    def from_blob(cls, blob: dict) -> "ReplayState":
+        st = cls()
+        st.committed = dict(blob.get("committed") or {})
+        st.failed = dict(blob.get("failed") or {})
+        st.inflight = dict(blob.get("inflight") or {})
+        st.commit_counts = dict(blob.get("commit_counts") or {})
+        st.submitted = set(blob.get("submitted") or ())
+        st.claims = dict(blob.get("claims") or {})
+        st.tenants = dict(blob.get("tenants") or {})
+        st.claimed_ever = set(blob.get("claimed_ever") or ())
+        st.stale_commits = dict(blob.get("stale_commits") or {})
+        st.last_seq = int(blob.get("last_seq", 0))
+        st.events = int(blob.get("events", 0))
+        st.corrupt_segments = int(blob.get("corrupt_segments", 0))
+        return st
+
 
 class JobJournal:
     """Append-only journal over atomic single-event segments.
+
+    Safe for CONCURRENT writer processes sharing ``root`` (the fleet):
+    appends publish via ``os.link`` so a sequence-number race has
+    exactly one winner, never a torn or overwritten segment.
 
     ``fault_cb`` (the serve runner's queue-lifetime injector hook) is
     called with site ``journal_write`` before every append.
     """
 
     def __init__(self, root: str,
-                 fault_cb: Optional[Callable[[str], None]] = None):
+                 fault_cb: Optional[Callable[[str], None]] = None,
+                 checkpoint_every: Optional[int] = None):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self.fault_cb = fault_cb
+        if checkpoint_every is None:
+            try:
+                checkpoint_every = int(os.environ.get(
+                    "S2C_JOURNAL_CKPT_EVERY", DEFAULT_CHECKPOINT_EVERY))
+            except ValueError:
+                checkpoint_every = DEFAULT_CHECKPOINT_EVERY
+        self.checkpoint_every = max(0, checkpoint_every)
         self._seq = self._max_seq() + 1
         #: in-memory mirror of ReplayState, maintained incrementally by
         #: append() so position() (called at every health publish) does
-        #: not re-read the whole segment directory per job
+        #: not re-read the whole segment directory per job.  The mirror
+        #: only sees THIS process's appends plus whatever the last
+        #: replay() read — fleet coordination (serve/fleet.py) always
+        #: arbitrates from a fresh replay(), never from the mirror.
         self._mirror: Optional[ReplayState] = None
 
     # -- segment mechanics -------------------------------------------------
     def _seg_path(self, seq: int) -> str:
         return os.path.join(self.root, f"ev-{seq:08d}.json")
 
-    def _segments(self) -> List[str]:
-        try:
-            names = sorted(n for n in os.listdir(self.root)
-                           if n.startswith("ev-") and n.endswith(".json"))
-        except OSError:
-            return []
-        return [os.path.join(self.root, n) for n in names]
+    def _ckpt_path(self, seq: int) -> str:
+        return os.path.join(self.root, f"checkpoint-{seq:08d}.json")
 
-    def _max_seq(self) -> int:
-        top = 0
-        for p in self._segments():
+    def _listing(self, prefix: str) -> List[Tuple[int, str]]:
+        """(seq, path) for every ``<prefix>-NNNNNNNN.json`` in root,
+        seq-sorted."""
+        out: List[Tuple[int, str]] = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        head = prefix + "-"
+        for n in names:
+            if not (n.startswith(head) and n.endswith(".json")):
+                continue
             try:
-                top = max(top, int(os.path.basename(p)[3:-5]))
+                out.append((int(n[len(head):-5]),
+                            os.path.join(self.root, n)))
             except ValueError:
                 continue
+        out.sort()
+        return out
+
+    def _segments(self) -> List[str]:
+        return [p for _, p in self._listing("ev")]
+
+    def _max_seq(self) -> int:
+        """Highest sequence number the journal knows about — segments
+        AND checkpoints (after :meth:`prune` the checkpoint may be the
+        only record of where the sequence got to)."""
+        segs = self._listing("ev")
+        ckpts = self._listing("checkpoint")
+        top = 0
+        if segs:
+            top = max(top, segs[-1][0])
+        if ckpts:
+            top = max(top, ckpts[-1][0])
         return top
 
     def append(self, ev: str, **fields) -> int:
         """Durably record one event; returns its sequence number.
 
-        tmp + fsync + ``os.replace``: after this returns, the event
+        tmp + fsync + ``os.link``: after this returns, the event
         survives ``kill -9``; if the process dies inside, the journal
-        simply does not contain the event — never half of it."""
+        simply does not contain the event — never half of it.  The link
+        (not a rename) is what makes MULTI-process appends safe: it
+        fails with EEXIST when another writer already owns the target
+        sequence number, and the loser retries on the next free one."""
         assert ev in EVENTS, ev
         if self.fault_cb is not None:
             self.fault_cb("journal_write")
-        seq = self._seq
-        rec = {"schema": SCHEMA, "seq": seq, "ev": ev,
-               "t": round(time.time(), 3), **fields}
-        path = self._seg_path(seq)
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(rec, fh, sort_keys=True)
-            fh.write("\n")
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
-        self._seq = seq + 1
-        if self._mirror is not None:    # keep the cheap mirror current
-            self._apply(self._mirror, rec)
-        return seq
+        last_exc: Optional[BaseException] = None
+        for _ in range(_APPEND_ATTEMPTS):
+            seq = self._seq
+            rec = {"schema": SCHEMA, "seq": seq, "ev": ev,
+                   "t": round(time.time(), 3), **fields}
+            path = self._seg_path(seq)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(rec, fh, sort_keys=True)
+                fh.write("\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            try:
+                os.link(tmp, path)
+            except FileExistsError as exc:
+                # another writer published this seq between our scan
+                # and our link: re-anchor past everything visible now
+                last_exc = exc
+                os.unlink(tmp)
+                self._seq = max(self._seq + 1, self._max_seq() + 1)
+                continue
+            os.unlink(tmp)
+            self._seq = seq + 1
+            if self._mirror is not None:  # keep the cheap mirror current
+                self._apply(self._mirror, rec)
+            if self.checkpoint_every \
+                    and seq % self.checkpoint_every == 0:
+                try:
+                    self.write_checkpoint()
+                except Exception as exc:   # compaction is an optimization
+                    logger.warning("journal checkpoint at seq %d failed "
+                                   "(%s: %s): replay stays O(lifetime)",
+                                   seq, type(exc).__name__, exc)
+            return seq
+        raise OSError(
+            f"journal append lost the segment race {_APPEND_ATTEMPTS} "
+            f"times in a row ({last_exc}) — is something flooding "
+            f"{self.root}?")
 
-    def events(self) -> List[dict]:
-        """Every readable event in sequence order; corrupt/truncated
-        segments (possible only from external damage — appends are
-        atomic) are skipped with a warning, not raised."""
+    def events(self, from_seq: int = 0) -> List[dict]:
+        """Every readable event with seq > ``from_seq`` in sequence
+        order; corrupt/truncated segments (possible only from external
+        damage — appends are atomic) are skipped with a warning, not
+        raised.  A numbering GAP below the visible maximum triggers one
+        re-list: a concurrent writer links segment N strictly before
+        anyone can create N+1, but a directory scan racing both may
+        catch the newer entry first."""
+        listing = [(s, p) for s, p in self._listing("ev")
+                   if s > from_seq]
+        if listing:
+            want = set(range(listing[0][0], listing[-1][0] + 1))
+            have = {s for s, _ in listing}
+            # a gap at the FRONT is expected after prune(); only
+            # re-list for holes between visible segments
+            if want - have:
+                listing = [(s, p) for s, p in self._listing("ev")
+                           if s > from_seq]
         out: List[dict] = []
-        for p in self._segments():
+        for _, p in listing:
             try:
                 with open(p, encoding="utf-8") as fh:
                     out.append(json.load(fh))
@@ -206,24 +390,99 @@ class JobJournal:
             return
         if ev == "submitted":
             st.submitted.add(key)
+            if rec.get("tenant"):
+                st.tenants[key] = rec["tenant"]
         elif ev == "started":
             st.inflight[key] = rec
             st.failed.pop(key, None)
+            if rec.get("tenant"):
+                st.tenants[key] = rec["tenant"]
         elif ev == "committed":
+            if key in st.claimed_ever:
+                # lease fencing: once a key's lifecycle uses claims,
+                # only the holder of its OPEN lease may commit.  A
+                # zombie that passed its pre-append lease check, then
+                # stalled past the TTL while a thief re-claimed,
+                # re-ran and committed, lands its stale append HERE —
+                # with no open claim (the thief's commit closed it) or
+                # the wrong lineage — and is void: the thief's record
+                # (whose output fingerprints describe the files
+                # actually on disk) stays authoritative, and
+                # duplicated=0 is structural.
+                cur = st.claims.get(key)
+                cs = rec.get("claim_seq")
+                if cur is None or cur["worker"] != rec.get("worker") \
+                        or (cs is not None
+                            and cs != cur.get("claim_seq")):
+                    st.stale_commits[key] = \
+                        st.stale_commits.get(key, 0) + 1
+                    return
             st.committed[key] = rec
             st.inflight.pop(key, None)
             st.failed.pop(key, None)
+            st.claims.pop(key, None)
             st.commit_counts[key] = st.commit_counts.get(key, 0) + 1
         elif ev == "failed":
             st.failed[key] = str(rec.get("error", ""))
             st.inflight.pop(key, None)
+            st.claims.pop(key, None)
+        elif ev == "claimed":
+            st.claimed_ever.add(key)
+            # first live claim wins; later claims while a lease is open
+            # are the LOSERS of the race (they observe this on replay)
+            if key not in st.claims:
+                st.claims[key] = {
+                    "worker": rec.get("worker", ""),
+                    "claim_seq": int(rec.get("seq", 0)),
+                    "expires_unix": float(rec.get("expires_unix", 0.0))}
+        elif ev == "lease_renewed":
+            cur = st.claims.get(key)
+            if cur is not None and cur["worker"] == rec.get("worker"):
+                cur["expires_unix"] = float(rec.get("expires_unix", 0.0))
+        elif ev == "lease_expired":
+            # effective only if the lease was genuinely expired when
+            # the reap event was APPENDED — a renewal that published
+            # first pushed expires_unix forward and voids a stale reap
+            # (the reaper's subsequent claim then simply loses)
+            cur = st.claims.get(key)
+            if cur is not None and cur["worker"] == rec.get("worker") \
+                    and float(rec.get("t", 0.0)) >= cur["expires_unix"]:
+                del st.claims[key]
 
-    def replay(self) -> ReplayState:
+    # -- checkpoint / compaction -------------------------------------------
+    def _latest_checkpoint(self) -> Tuple[int, Optional[ReplayState]]:
+        """Newest READABLE checkpoint (seq, state); unreadable ones
+        fall back to the next older, then to genesis (0, None)."""
+        for seq, path in reversed(self._listing("checkpoint")):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    blob = json.load(fh)
+                if blob.get("schema") != CKPT_SCHEMA:
+                    raise ValueError(f"schema {blob.get('schema')!r}")
+                return seq, ReplayState.from_blob(blob)
+            except Exception as exc:
+                logger.warning("journal checkpoint %s unreadable "
+                               "(%s: %s): falling back", path,
+                               type(exc).__name__, exc)
+        return 0, None
+
+    def _replay_from_disk(self, full: bool = False) -> ReplayState:
+        st = ReplayState()
+        base = 0
+        if not full:
+            base, loaded = self._latest_checkpoint()
+            if loaded is not None:
+                st = loaded
+            else:
+                base = 0
+        for rec in self.events(from_seq=base):
+            self._apply(st, rec)
+        return st
+
+    def replay(self, full: bool = False) -> ReplayState:
         import copy
 
-        st = ReplayState()
-        for rec in self.events():
-            self._apply(st, rec)
+        st = self._replay_from_disk(full=full)
         # the mirror must be a SEPARATE copy: later appends update it
         # incrementally, and mutating the state just handed to the
         # caller would corrupt its view (the runner reads replay()
@@ -231,19 +490,105 @@ class JobJournal:
         self._mirror = copy.deepcopy(st)
         return st
 
-    def verify_outputs(self, committed_rec: dict) -> bool:
+    def read_state(self, full: bool = False) -> ReplayState:
+        """Replay WITHOUT refreshing the :meth:`position` mirror — the
+        fleet's arbitration hot path (several reads per second per
+        worker) skips the full-state deepcopy that :meth:`replay` pays
+        to keep health reporting cheap."""
+        return self._replay_from_disk(full=full)
+
+    def write_checkpoint(self) -> Optional[str]:
+        """Summarize the journal so far into a checkpoint segment.
+
+        The state is rebuilt from DISK (newest checkpoint + tail) at
+        write time — never from the in-memory mirror, which in a fleet
+        misses other workers' appends.  Published with the same O_EXCL
+        link as event segments; a concurrent writer checkpointing the
+        same seq is absorbed (both built the same state)."""
+        st = self._replay_from_disk()
+        if st.last_seq <= 0:
+            return None
+        path = self._ckpt_path(st.last_seq)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(st.to_blob(), fh, sort_keys=True)
+            fh.write("\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        try:
+            os.link(tmp, path)
+        except FileExistsError:
+            pass                        # a peer already wrote this one
+        os.unlink(tmp)
+        return path
+
+    def prune(self) -> int:
+        """Delete event segments the newest checkpoint already covers
+        (and all older checkpoints); returns the number of files
+        removed.  Replay state is unchanged — the checkpoint IS the
+        prefix — but ``replay(full=True)``/forensics lose the pruned
+        tail, so pruning is explicit, never automatic."""
+        base, loaded = self._latest_checkpoint()
+        if loaded is None:
+            return 0
+        removed = 0
+        for seq, path in self._listing("ev"):
+            if seq <= base:
+                try:
+                    os.unlink(path)
+                    removed += 1
+                except OSError:
+                    pass
+        for seq, path in self._listing("checkpoint"):
+            if seq < base:
+                try:
+                    os.unlink(path)
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
+
+    def verify_outputs(self, committed_rec: dict,
+                       mode: str = "fast") -> bool:
         """True iff every output file the commit recorded still exists
         with its recorded fingerprint — the skip-on-restart gate.  A
         missing or drifted file re-runs the job (the journal is an
-        audit trail, not a trust store)."""
+        audit trail, not a trust store).
+
+        ``mode="fast"`` (default): a file whose (size, mtime) both
+        match the commit-time stat is accepted WITHOUT re-hashing —
+        resume over a large committed queue is O(stat), not O(bytes).
+        Any stat drift falls through to the content hash, so a
+        touched-but-identical file still verifies and a corrupted one
+        still fails; ``mode="full"`` (``--verify-outputs full``)
+        re-hashes everything unconditionally.  Legacy string
+        fingerprints (``"sha256:..."``, pre-fleet commits) always
+        re-hash."""
         outputs = committed_rec.get("outputs") or {}
         if not outputs:
             return False
-        # a null recorded fingerprint (commit-time hash failure) must
-        # NOT match a null re-hash of a missing file — unknown never
-        # verifies, the job re-runs
-        return all(want is not None and file_sha256(p) == want
-                   for p, want in outputs.items())
+        for path, want in outputs.items():
+            # a null recorded fingerprint (commit-time hash failure)
+            # must NOT match a null re-hash of a missing file —
+            # unknown never verifies, the job re-runs
+            if want is None:
+                return False
+            if isinstance(want, str):
+                if file_sha256(path) != want:
+                    return False
+                continue
+            try:
+                st = os.stat(path)
+            except OSError:
+                return False
+            if st.st_size != want.get("size"):
+                return False            # content hash cannot match
+            if mode != "full" \
+                    and round(st.st_mtime, 6) == want.get("mtime"):
+                continue                # demonstrably untouched
+            if file_sha256(path) != want.get("sha256"):
+                return False
+        return True
 
     # -- per-job checkpoint homes ------------------------------------------
     def ckpt_dir(self, key: str) -> str:
@@ -263,21 +608,29 @@ class JobJournal:
         Served from the in-memory mirror (one full replay at first use,
         incremental per append after) — health publishes happen at
         every job boundary, and re-reading the whole segment directory
-        each time would grow per-job cost linearly with history."""
+        each time would grow per-job cost linearly with history.  In
+        fleet mode the mirror may lag peers' appends between replays;
+        the drain loop's frequent replay() keeps it near-fresh."""
         st = self._mirror if self._mirror is not None else self.replay()
         return {"root": self.root, "last_seq": st.last_seq,
                 "events": st.events, "committed": len(st.committed),
                 "inflight": len(st.inflight), "failed": len(st.failed),
+                "claims": len(st.claims),
                 "corrupt_segments": st.corrupt_segments}
 
-    def audit(self) -> dict:
+    def audit(self, full: bool = False) -> dict:
         """Duplication/loss audit over the whole journal: per-key commit
         counts plus the set of keys ever submitted — the chaos-soak
         harness asserts ``max(commit_counts.values()) <= 1`` per cycle
-        and ``submitted ⊆ committed`` at cycle end."""
-        st = self.replay()
+        and ``submitted ⊆ committed`` at cycle end.  ``full=True``
+        bypasses checkpoints (the compaction audit)."""
+        st = self.replay(full=full)
         return {"submitted": sorted(st.submitted),
                 "commit_counts": dict(st.commit_counts),
                 "duplicated": sorted(k for k, n in st.commit_counts.items()
                                      if n > 1),
-                "lost": sorted(st.submitted - set(st.committed))}
+                "lost": sorted(st.submitted - set(st.committed)),
+                # commits VOIDED by the lease fence (zombie appends):
+                # forensic — these are the protocol WORKING, not a
+                # duplication
+                "stale_commits": dict(st.stale_commits)}

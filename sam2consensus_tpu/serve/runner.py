@@ -154,6 +154,10 @@ class JobResult:
     #: (DATA class: failed fast, no retry, no rung demotion, tenant
     #: stays on the device path)
     budget_exhausted: bool = False
+    #: fleet mode: the worker that committed this job — THIS worker's
+    #: id when it ran the job itself, a peer's id when the result is a
+    #: journal-observed commit (``resumed`` True, ``fastas`` None)
+    worker: str = ""
 
     @property
     def ok(self) -> bool:
@@ -321,6 +325,13 @@ class ServeRunner:
     slabs riding one dispatch sequence, with per-job count partitions
     extracted for byte-identical per-job consensus; SLO-burning
     tenants flush the filling batch immediately.
+
+    Fleet mode (``worker_id``/``lease_ttl`` — serve/fleet.py;
+    requires ``journal_dir``, excludes ``batch``): this runner joins
+    the journal as one of N work-stealing workers — submit_jobs
+    arbitrates every entry through atomic claim/lease events instead
+    of the serial loop.  ``verify_outputs`` ("fast"/"full") controls
+    resume-time output verification (stat fast path vs full re-hash).
     """
 
     def __init__(self, prewarm: str = "auto", decode_ahead: bool = True,
@@ -338,7 +349,10 @@ class ServeRunner:
                  slo=None,
                  profile_capture_dir: Optional[str] = None,
                  batch="off", batch_window: Optional[float] = None,
-                 count_cache=None, mem_budget=None):
+                 count_cache=None, mem_budget=None,
+                 worker_id: str = "",
+                 lease_ttl: Optional[float] = None,
+                 verify_outputs: str = "fast"):
         from ..backends.jax_backend import JaxBackend
 
         if prewarm not in ("auto", "off"):
@@ -402,6 +416,12 @@ class ServeRunner:
         self.last_job_badrec: Optional[dict] = None
         self.health_out = health_out
         self._fault = self._build_fault_injector(fault_inject)
+        if verify_outputs not in ("fast", "full"):
+            raise ValueError(
+                f"verify_outputs={verify_outputs!r}: use 'fast' "
+                f"(skip-by-stat, re-hash on drift) or 'full' "
+                f"(re-hash everything)")
+        self.verify_mode = verify_outputs
         self.journal: Optional[sjournal.JobJournal] = None
         if journal_dir:
             self.journal = sjournal.JobJournal(journal_dir,
@@ -415,6 +435,38 @@ class ServeRunner:
                 logger.info("journal mode: decode-ahead disabled "
                             "(per-job checkpoints need serial decode)")
                 self.decode_ahead = False
+        # -- fleet mode (serve/fleet.py): N workers, one journal -------
+        from .fleet import FleetCoordinator, resolve_lease_ttl
+
+        self.worker_id = str(worker_id or "")
+        self.fleet: Optional[FleetCoordinator] = None
+        if self.worker_id:
+            if self.journal is None:
+                raise ValueError(
+                    "--worker-id requires --journal: the shared "
+                    "journal IS the fleet's work-stealing queue")
+            if self.scheduler.enabled:
+                raise ValueError(
+                    "--worker-id does not compose with --batch: "
+                    "packed batches would need batch-level leases; "
+                    "run fleet workers serial (the fleet IS the "
+                    "parallelism)")
+            if self.count_cache is not None:
+                raise ValueError(
+                    "--worker-id does not compose with --count-cache: "
+                    "incremental jobs are already rejected on a "
+                    "journaled server, so the cache could never be "
+                    "consulted — configuring it would be a silent "
+                    "no-op")
+            ttl = resolve_lease_ttl(lease_ttl)
+            self.fleet = FleetCoordinator(self.journal, self.worker_id,
+                                          ttl, self.registry,
+                                          verify_mode=self.verify_mode)
+            self.registry.gauge("fleet/worker").set_info(
+                {"worker": self.worker_id, "lease_ttl_sec": ttl})
+            self._fleet_first_run_seen = False
+            logger.info("fleet worker %r on journal %s (lease TTL "
+                        "%gs)", self.worker_id, self.journal.root, ttl)
         # -- telemetry plane (observability/telemetry.py) --------------
         # strictly best-effort: every write path below degrades to the
         # per-job manifests (telemetry/write_failed counter + warning)
@@ -660,13 +712,17 @@ class ServeRunner:
         since = h.in_flight_since
         reg.gauge("serve/inflight_age_sec").set(
             round(now - since, 3) if since is not None else 0.0)
+        if self.fleet is not None:
+            reg.gauge("fleet/leases_held").set(
+                float(len(self.fleet.held)))
 
     def render_telemetry(self) -> str:
         """The OpenMetrics exposition over the server-lifetime
         aggregate, gauges refreshed first — an HTTP scrape between
         watchdog ticks still sees current heartbeat ages."""
         self._update_live_gauges()
-        return stele.render_openmetrics(self.registry.snapshot())
+        return stele.render_openmetrics(self.registry.snapshot(),
+                                        worker=self.worker_id or None)
 
     def telemetry_tick(self, force: bool = False) -> None:
         """One heartbeat of the telemetry plane, driven from the
@@ -678,6 +734,10 @@ class ServeRunner:
         hangs under ``--job-timeout``).  Every failure degrades to the
         per-job manifests: counted, warned, never raised."""
         self._update_live_gauges()
+        if self.fleet is not None:
+            # lease duty cycle rides the same heartbeat: renew what we
+            # hold, reap what peers abandoned (serve/fleet.py)
+            self.fleet.tick()
         if self.profiler.pending():
             path = self.profiler.capture(
                 tracer=obs.tracer(), registry=self.registry,
@@ -814,7 +874,11 @@ class ServeRunner:
         self.backend.serve_prepared_obs = robs
         self.backend.serve_dispatch_log = dlog
         try:
-            if self.job_timeout is None and self.stall_timeout is None:
+            if self.job_timeout is None and self.stall_timeout is None \
+                    and self.fleet is None:
+                # fleet mode always takes the monitored path: the poll
+                # loop's telemetry_tick is what renews this worker's
+                # leases mid-job (no deadline is enforced unless set)
                 return self.backend.run(contigs, records, cfg)
 
             box: list = []
@@ -925,7 +989,24 @@ class ServeRunner:
         # -- plan: admission + journal replay, before anything runs ---
         replay = self.journal.replay() if self.journal is not None \
             else None
+        if self.fleet is None and replay is not None \
+                and replay.claimed_ever:
+            # commits on ever-claimed keys are lease-fenced: a
+            # worker-less server's commits on them would be VOID on
+            # replay (it can hold no lease) — refuse loudly instead
+            # of running jobs whose commits silently never land
+            raise ValueError(
+                "this journal has fleet claim/lease history "
+                f"({len(replay.claimed_ever)} claimed key(s)): "
+                "restart with --worker-id so commits carry the lease "
+                "lineage the journal now enforces")
         self.admission.open_window()
+        if self.fleet is not None and replay is not None:
+            # fleet-global quotas: peers' journal-visible live jobs
+            # count against this window's per-tenant quota too
+            self.admission.seed_window(self.fleet.seed_window_counts(
+                replay, {sjournal.job_key(s.filename, s.config)
+                         for s in specs}))
         jobs_base = self.jobs_run
         plan: List[dict] = []           # one entry per spec, in order
         n_skipped = 0
@@ -940,7 +1021,8 @@ class ServeRunner:
                      "jobnum": jobnum, "action": "run", "cfg": spec.config,
                      "admission": None, "resume_ckpt": False}
             if replay is not None and key in replay.committed \
-                    and self.journal.verify_outputs(replay.committed[key]):
+                    and self.journal.verify_outputs(
+                        replay.committed[key], mode=self.verify_mode):
                 entry["action"] = "skip"
                 entry["outputs"] = \
                     list(replay.committed[key].get("outputs", {}))
@@ -1015,7 +1097,8 @@ class ServeRunner:
                         key=entry["key"],
                         filename=os.path.abspath(
                             entry["spec"].filename),
-                        outfolder=entry["spec"].config.outfolder)
+                        outfolder=entry["spec"].config.outfolder,
+                        tenant=entry["spec"].tenant or "")
             for entry in plan:
                 if entry["action"] == "skip":
                     self._journal_append("resumed", job=entry["job_id"],
@@ -1050,6 +1133,18 @@ class ServeRunner:
         #: successor's queue_wait, which is exactly the signal)
         window_t0 = time.perf_counter()
         self.telemetry_tick(force=True)
+
+        # -- fleet mode (serve/fleet.py): claim/lease arbitration over
+        #    the shared journal replaces the serial loop — this worker
+        #    runs the entries whose leases it wins, observes peers'
+        #    commits for the rest, and steals expired leases
+        if self.fleet is not None:
+            try:
+                return self.fleet.drain(self, plan, window_t0, replay,
+                                        recovery_info)
+            finally:
+                self.scheduler.release_handles(plan)
+                self.telemetry_tick(force=True)
 
         # -- continuous batching (serve/scheduler.py): compose packed
         #    batches over the eligible small jobs up front; the loop
@@ -1101,33 +1196,7 @@ class ServeRunner:
             jobnum = entry["jobnum"]
             # -- non-running entries -----------------------------------
             if entry["action"] in ("skip", "reject"):
-                res = JobResult(job_id=job_id, filename=spec.filename,
-                                index=i)
-                if entry["action"] == "skip":
-                    res.resumed = True
-                    res.output_paths = entry.get("outputs", [])
-                    res.metrics = {"serve/resume_skipped": 1}
-                    self.echo(f"[serve] {job_id}: resumed (committed in "
-                              f"journal, outputs verified)")
-                else:
-                    reason = entry["admission"]
-                    res.admission = reason
-                    detail = ""
-                    if reason == "capacity":
-                        detail = (
-                            f": predicted peak "
-                            f"{entry.get('mem_predicted', 0) / 1e6:.1f}"
-                            f" MB > --mem-budget "
-                            f"{self.admission.mem_budget / 1e6:.1f} MB"
-                            f" — re-offer to a host that fits")
-                    res.error = f"admission rejected: {reason}{detail}"
-                    self.registry.add("serve/admission_rejected", 1)
-                    self.registry.add(
-                        f"serve/admission_rejected/{reason}", 1)
-                    self.echo(f"[serve] {job_id}: REJECTED "
-                              f"({reason}{detail})")
-                results.append(res)
-                self.jobs_run += 1
+                results.append(self._resolve_nonrun(entry, i))
                 continue
             self.registry.add("serve/admission_admitted", 1)
             # degraded-tenant isolation, decided at JOB-START time (a
@@ -1311,9 +1380,214 @@ class ServeRunner:
         self.telemetry_tick(force=True)
         return results
 
+    # -- plan-entry resolution (shared: serial loop + fleet drain) ---------
+    def _resolve_nonrun(self, entry: dict, i: int) -> JobResult:
+        """A plan entry that never executes: journal-resumed skip or
+        admission reject — one result, counters, echo, bookkeeping."""
+        spec = entry["spec"]
+        job_id = entry["job_id"]
+        res = JobResult(job_id=job_id, filename=spec.filename, index=i)
+        if entry["action"] == "skip":
+            res.resumed = True
+            res.output_paths = entry.get("outputs", [])
+            res.metrics = {"serve/resume_skipped": 1}
+            self.echo(f"[serve] {job_id}: resumed (committed in "
+                      f"journal, outputs verified)")
+        else:
+            reason = entry["admission"]
+            res.admission = reason
+            detail = ""
+            if reason == "capacity":
+                detail = (
+                    f": predicted peak "
+                    f"{entry.get('mem_predicted', 0) / 1e6:.1f}"
+                    f" MB > --mem-budget "
+                    f"{self.admission.mem_budget / 1e6:.1f} MB"
+                    f" — re-offer to a host that fits")
+            res.error = f"admission rejected: {reason}{detail}"
+            self.registry.add("serve/admission_rejected", 1)
+            self.registry.add(
+                f"serve/admission_rejected/{reason}", 1)
+            self.echo(f"[serve] {job_id}: REJECTED "
+                      f"({reason}{detail})")
+        self.jobs_run += 1
+        return res
+
+    def _resolve_completed_elsewhere(self, entry: dict, i: int,
+                                     rec: dict) -> JobResult:
+        """Fleet: a peer's journal commit resolves this entry — the
+        drain verified the recorded outputs before calling this (a
+        drifted commit is re-claimed and re-run instead), so this
+        worker never decodes a byte."""
+        job_id = entry["job_id"]
+        res = JobResult(job_id=job_id, filename=entry["spec"].filename,
+                        index=i, resumed=True)
+        res.worker = rec.get("worker", "")
+        res.output_paths = list(rec.get("outputs") or {})
+        res.metrics = {"fleet/completed_elsewhere": 1}
+        # NOT serve/jobs: that family counts jobs THIS worker ran —
+        # the peer already counted the run on its side (the fleet view
+        # sums workers' counters, and a double count would misreport)
+        self.registry.add("fleet/completed_elsewhere", 1)
+        self.jobs_run += 1
+        self.health.queue_depth = max(0, self.health.queue_depth - 1)
+        self.echo(f"[serve] {job_id}: committed by worker "
+                  f"{res.worker or '?'} in "
+                  f"{rec.get('elapsed_sec', 0.0):.2f}s")
+        return res
+
+    def _resolve_failed_elsewhere(self, entry: dict, i: int,
+                                  error: str) -> JobResult:
+        """Fleet: a peer journaled this job failed — terminal for the
+        queue run, exactly as a local failure would be."""
+        job_id = entry["job_id"]
+        res = JobResult(job_id=job_id, filename=entry["spec"].filename,
+                        index=i)
+        res.error = f"failed on another worker: {error}"
+        # like completed-elsewhere: the peer owns the serve/jobs_*
+        # accounting for the run itself
+        self.registry.add("fleet/failed_elsewhere", 1)
+        self.jobs_run += 1
+        self.health.queue_depth = max(0, self.health.queue_depth - 1)
+        self.echo(f"[serve] {job_id}: FAILED on another worker "
+                  f"({error})")
+        return res
+
+    def _run_claimed_entry(self, entry: dict, i: int, window_t0: float,
+                           recovery_info) -> JobResult:
+        """Run one claim-won plan entry — the fleet drain's execution
+        body: the serial loop's run path minus decode-ahead (journal
+        mode already forces serial decode), batching and count-cache
+        seeding (both rejected with ``--worker-id``), plus the
+        lease-confirmation gate before the commit.
+
+        KEEP IN SYNC with the serial loop's run block in
+        :meth:`submit_jobs` (open-input/prewarm/health-gauge prologue,
+        the _execute/_note_*/_retry_on_host_rung failure sequence) —
+        the two are deliberate near-twins until a shared _run_one
+        extraction unifies them; both are pinned by byte-identity
+        suites (tests/test_serve.py vs tests/test_fleet.py), so drift
+        fails tests, but fix bugs in BOTH places."""
+        from ..config import resolve_decode_threads
+        from ..formats import open_alignment_input
+        from ..resilience import ladder as rladder
+
+        spec = entry["spec"]
+        job_id = entry["job_id"]
+        cfg = entry["cfg"]
+        jobnum = entry["jobnum"]
+        self.registry.add("serve/admission_admitted", 1)
+        rung = self.admission.pin_rung(spec.tenant)
+        if rung is not None and cfg.pileup != "host":
+            cfg = rladder.job_host_rung_config(cfg)
+            entry["cfg"] = cfg
+            entry["admission"] = f"pinned:{rung}"
+        if entry["admission"]:
+            self.registry.add("serve/admission_pinned", 1)
+        robs = obs.prepare_run(
+            trace_out=self._job_out(cfg.trace_out,
+                                    "S2C_TRACE_OUT", jobnum),
+            metrics_out=self._job_out(cfg.metrics_out,
+                                      "S2C_METRICS_OUT", jobnum),
+            config=cfg)
+        close_handle = None
+        contigs = records = None
+        header_err = None
+        try:
+            ai = open_alignment_input(
+                spec.filename, getattr(cfg, "input_format", "auto"),
+                binary=True, threads=resolve_decode_threads(cfg))
+            close_handle = ai.close
+            contigs, records = ai.contigs, ai.stream
+        except Exception as exc:
+            header_err = exc
+        if contigs is not None and not self._fleet_first_run_seen:
+            from ..encoder.events import GenomeLayout
+
+            self._auto_prewarm(spec, GenomeLayout(contigs).total_len)
+            self._fleet_first_run_seen = True
+        if recovery_info is not None:
+            robs.registry.gauge("serve/recovery").set_info(
+                recovery_info)
+        robs.registry.gauge("serve/health").set_info({
+            "queue_depth": self.health.queue_depth,
+            "in_flight": job_id, "worker": self.worker_id,
+            "tenant_rungs": dict(self.admission.tenant_rungs)})
+        res = JobResult(job_id=job_id, filename=spec.filename,
+                        index=i, admission=entry["admission"])
+        res.worker = self.worker_id
+        dlog: List[Tuple[float, float]] = []
+        stele.set_log_context(
+            job_id=job_id, tenant=spec.tenant,
+            rung=(entry["admission"] or cfg.pileup),
+            worker=self.worker_id)
+        self.health.job_started(job_id)
+        self._journal_append("started", job=job_id, key=entry["key"],
+                             ckpt=cfg.checkpoint_dir or "",
+                             worker=self.worker_id,
+                             tenant=spec.tenant or "")
+        t0 = time.perf_counter()
+        if header_err is not None:
+            res.error = f"{type(header_err).__name__}: {header_err}"
+            if close_handle is not None:
+                close_handle()
+        else:
+            out = None
+            try:
+                out = self._execute(contigs, records, cfg, robs,
+                                    dlog, job_id)
+            except Exception as exc:
+                self._note_timeout_if_deadline(robs, exc)
+                self._note_poison(spec, exc, res)
+                self._note_capacity(spec, exc, robs)
+                retry_cfg = self._retry_config(cfg, exc)
+                if retry_cfg is not None:
+                    out, robs, res.error = self._retry_on_host_rung(
+                        spec, retry_cfg, exc, jobnum, job_id)
+                else:
+                    res.error = f"{type(exc).__name__}: {exc}"
+                if res.error is not None:
+                    logger.warning("job %s failed: %s", job_id,
+                                   res.error)
+            finally:
+                if close_handle is not None:
+                    close_handle()
+            if out is not None:
+                res.fastas, res.stats = out.fastas, out.stats
+                res.error = None
+        res.elapsed_sec = time.perf_counter() - t0
+        # -- lease confirmation: only the live holder may journal -----
+        # (ok AND failed outcomes: a woken zombie's "failed" append
+        # would pop the thief's live claim and wreck ITS commit — the
+        # thief owns the whole lifecycle once it re-claims)
+        journal_lifecycle = True
+        if not self.fleet.holds(entry["key"]):
+            self.registry.add("fleet/lease_lost", 1)
+            journal_lifecycle = False
+            if res.ok:
+                # abandon the result: no outputs, no journal events —
+                # a second commit is exactly the duplication the
+                # audit forbids
+                res.fastas = None
+                res.error = (
+                    f"lease lost: worker {self.worker_id!r} held job "
+                    f"{job_id} past its TTL and the lease was "
+                    f"re-claimed by a peer; result abandoned (the "
+                    f"re-claiming worker commits it)")
+            else:
+                res.error = (
+                    f"{res.error} [lease lost mid-run: failure not "
+                    f"journaled — the re-claiming worker owns the "
+                    f"job's lifecycle]")
+        self._finalize_job(entry, res, robs, spec,
+                           queue_wait=t0 - window_t0,
+                           journal_lifecycle=journal_lifecycle)
+        return res
+
     def _finalize_job(self, entry: dict, res: JobResult, robs,
                       spec: JobSpec, queue_wait: float,
-                      echo_suffix: str = "") -> None:
+                      echo_suffix: str = "",
+                      journal_lifecycle: bool = True) -> None:
         """Everything after a job's run attempt, shared by the serial
         loop and the batch scheduler (serve/scheduler.py) so the two
         execution paths cannot drift: metrics subset + rung/manifest
@@ -1342,14 +1616,24 @@ class ServeRunner:
             self.registry.add("serve/bad_records", res.bad_records)
         res.rungs = rladder.job_rungs(snap)
         res.manifest = obs.last_manifest() if res.ok else None
+        if self.worker_id and res.manifest is not None:
+            # which worker committed the job — stamped BEFORE the slo
+            # rewrite below persists the manifest file
+            res.manifest.setdefault("serve", {})["worker"] = \
+                self.worker_id
         # -- commit: outputs durably on disk, then the journal -----
         if res.ok and res.fastas is not None \
-                and self.journal is not None:
+                and self.journal is not None and journal_lifecycle:
+            if self.fleet is not None:
+                # the output write + fingerprint pass below runs with
+                # no watchdog ticks (no renewals): start the commit
+                # window with a full TTL of margin
+                self.fleet.renew_now(entry["key"])
             try:
                 res.output_paths = write_outputs(
                     res.fastas, cfg.outfolder, cfg.prefix,
                     cfg.nchar, cfg.thresholds, echo=self.echo)
-                fps = {p: sjournal.file_sha256(p)
+                fps = {p: sjournal.file_fingerprint(p)
                        for p in res.output_paths}
             except Exception as exc:
                 # a commit-time write failure (disk full, bad
@@ -1362,12 +1646,41 @@ class ServeRunner:
                 res.output_paths = []
                 logger.warning("job %s: %s", job_id, res.error)
             else:
-                self._journal_append(
-                    "committed", job=job_id, key=entry["key"],
-                    outputs=fps,
-                    elapsed_sec=round(res.elapsed_sec, 3))
-                self.journal.drop_ckpt(entry["key"])
-        if not res.ok:
+                if self.fleet is not None \
+                        and not self.fleet.holds(entry["key"]):
+                    # the write outlived even the renewed lease and a
+                    # peer re-claimed: appending "committed" NOW would
+                    # be the duplicate commit the audit forbids — the
+                    # thief owns the lifecycle.  (The bytes on disk
+                    # are identical to what the thief writes, so the
+                    # files themselves are not a hazard.)
+                    self.registry.add("fleet/lease_lost", 1)
+                    journal_lifecycle = False
+                    res.output_paths = []
+                    res.fastas = None
+                    res.error = (
+                        f"lease lost during commit: job {job_id}'s "
+                        f"output write outlived the lease TTL and a "
+                        f"peer re-claimed the job; commit abandoned "
+                        f"(the re-claiming worker commits it)")
+                    logger.warning("job %s: %s", job_id, res.error)
+                else:
+                    fence = {}
+                    if self.fleet is not None:
+                        # lease lineage: replay voids a commit whose
+                        # (worker, claim_seq) does not match the open
+                        # lease — the structural duplicate guard
+                        cs = self.fleet.claim_seqs.get(entry["key"])
+                        if cs is not None:
+                            fence["claim_seq"] = cs
+                    self._journal_append(
+                        "committed", job=job_id, key=entry["key"],
+                        outputs=fps,
+                        elapsed_sec=round(res.elapsed_sec, 3),
+                        worker=self.worker_id,
+                        tenant=spec.tenant or "", **fence)
+                    self.journal.drop_ckpt(entry["key"])
+        if not res.ok and journal_lifecycle:
             self._journal_append("failed", job=job_id,
                                  key=entry["key"], error=res.error)
         # fold the job's registry into the server-lifetime

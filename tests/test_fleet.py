@@ -1,0 +1,881 @@
+"""Serve fleet: journal claim/lease semantics, work-stealing workers.
+
+The ISSUE-15 acceptance pins live here:
+
+* claim arbitration is first-writer-wins over O_EXCL-atomic journal
+  segments: concurrent appends never tear, losers observe the winner
+  on replay and move on;
+* leases expire and are reaped: a dead/frozen worker's in-flight job
+  is re-claimed by a peer (the 2x-TTL bound rides the slow soak and
+  the committed campaign artifact);
+* a 2-worker subprocess fleet drains a shared journaled queue
+  byte-identical to a single worker, zero lost / zero duplicated;
+* journal replay is O(tail) via checkpoints, with compacted replay
+  provably equal to full replay;
+* resume-time output verification has a stat fast path with a
+  ``--verify-outputs full`` escape hatch;
+* the exposition carries ``worker`` labels lint-clean, and
+  ``s2c_top --fleet`` renders an aggregated multi-worker frame.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.observability.metrics import MetricsRegistry
+from sam2consensus_tpu.serve import journal as sjournal
+from sam2consensus_tpu.serve.fleet import FleetCoordinator
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_cache(monkeypatch):
+    monkeypatch.setenv("S2C_JIT_CACHE", "")
+
+
+def _journal(tmp_path, **kw):
+    kw.setdefault("checkpoint_every", 0)   # deterministic segment sets
+    return sjournal.JobJournal(str(tmp_path / "j"), **kw)
+
+
+def _coord(j, worker, ttl=5.0):
+    return FleetCoordinator(j, worker, ttl, MetricsRegistry())
+
+
+def _sim(tmp, name, seed, contig_len=2500, n_reads=800, prefix="fl"):
+    spec = SimSpec(n_contigs=1, contig_len=contig_len, n_reads=n_reads,
+                   read_len=100, contig_len_jitter=0.0, seed=seed,
+                   contig_prefix=prefix)
+    path = os.path.join(str(tmp), name)
+    with open(path, "w") as fh:
+        fh.write(simulate(spec))
+    return path
+
+
+# =========================================================================
+# claim / lease state machine
+# =========================================================================
+class TestClaims:
+    def test_first_claim_wins_second_loses(self, tmp_path):
+        j = _journal(tmp_path)
+        a = _coord(j, "wa")
+        b = _coord(sjournal.JobJournal(j.root, checkpoint_every=0),
+                   "wb")
+        assert a.try_claim("k1", "job1")
+        assert not b.try_claim("k1", "job1")
+        assert a.registry.value("fleet/claims") == 1
+        # a LIVE peer lease is observed, not raced: b appends nothing
+        assert b.registry.value("fleet/claims") == 0
+        st = j.replay()
+        assert st.claims["k1"]["worker"] == "wa"
+        assert len([e for e in j.events()
+                    if e["ev"] == "claimed"]) == 1
+
+    def test_losing_claim_event_ignored_on_replay(self, tmp_path):
+        j = _journal(tmp_path)
+        now = time.time()
+        j.append("claimed", key="k", worker="wa",
+                 expires_unix=now + 60)
+        j.append("claimed", key="k", worker="wb",
+                 expires_unix=now + 60)
+        st = j.replay()
+        assert st.claims["k"]["worker"] == "wa"
+
+    def test_commit_and_failure_close_the_lease(self, tmp_path):
+        j = _journal(tmp_path)
+        now = time.time()
+        j.append("claimed", key="k", worker="wa",
+                 expires_unix=now + 60)
+        j.append("committed", key="k", job="x", outputs={},
+                 worker="wa")
+        assert "k" not in j.replay().claims
+        j.append("claimed", key="k2", worker="wa",
+                 expires_unix=now + 60)
+        j.append("failed", key="k2", job="x", error="boom")
+        assert "k2" not in j.replay().claims
+
+    def test_expired_lease_is_reaped_and_stolen(self, tmp_path):
+        j = _journal(tmp_path)
+        a = _coord(j, "wa", ttl=0.05)
+        b = _coord(sjournal.JobJournal(j.root, checkpoint_every=0),
+                   "wb", ttl=5.0)
+        assert a.try_claim("k", "job")
+        time.sleep(0.08)
+        assert b.try_claim("k", "job")        # reap + steal
+        assert b.registry.value("fleet/steals") == 1
+        assert b.registry.value("fleet/lease_reaped") == 1
+        evs = [e["ev"] for e in j.events()]
+        assert "lease_expired" in evs
+        st = j.replay()
+        assert st.claims["k"]["worker"] == "wb"
+        # the frozen-then-woken original holder must see the loss
+        assert not a.holds("k")
+        assert "k" not in a.held
+
+    def test_zombie_commit_is_fenced_void(self, tmp_path):
+        """The split-brain TOCTOU closed structurally: a zombie whose
+        pending 'committed' append lands AFTER the thief's commit is
+        VOID on replay (wrong lease lineage), so commit_counts stays
+        at 1 and the thief's record — whose fingerprints describe the
+        files actually on disk — remains authoritative."""
+        j = _journal(tmp_path)
+        now = time.time()
+        s_a = j.append("claimed", key="k", job="x", worker="wa",
+                       expires_unix=now - 1.0)   # zombie's stale lease
+        j.append("lease_expired", key="k", worker="wa", reaper="wb")
+        s_b = j.append("claimed", key="k", job="x", worker="wb",
+                       expires_unix=now + 60)
+        j.append("committed", key="k", job="x", worker="wb",
+                 claim_seq=s_b, outputs={"f": None})
+        # the zombie wakes and its stale append lands LAST
+        j.append("committed", key="k", job="x", worker="wa",
+                 claim_seq=s_a, outputs={"stale": None})
+        st = j.replay()
+        assert st.commit_counts == {"k": 1}
+        assert st.committed["k"]["worker"] == "wb"
+        assert st.stale_commits == {"k": 1}
+        audit = j.audit()
+        assert audit["duplicated"] == []
+        assert audit["stale_commits"] == {"k": 1}
+        # serial-mode journals (no claims ever) stay unfenced: the
+        # restart drift re-commit contract is unchanged
+        j.append("committed", key="plain", job="y", outputs={})
+        j.append("committed", key="plain", job="y", outputs={})
+        assert j.replay().commit_counts["plain"] == 2
+
+    def test_renewal_extends_and_voids_stale_reap(self, tmp_path):
+        j = _journal(tmp_path)
+        now = time.time()
+        j.append("claimed", key="k", worker="wa",
+                 expires_unix=now - 1.0)          # looks expired...
+        j.append("lease_renewed", key="k", worker="wa",
+                 expires_unix=now + 60.0)         # ...but renewed first
+        # a reaper acting on the stale view appends lease_expired NOW;
+        # its event time is < the renewed expiry, so it must be void
+        j.append("lease_expired", key="k", worker="wa", reaper="wb")
+        st = j.replay()
+        assert st.claims["k"]["worker"] == "wa"
+        assert st.claims["k"]["expires_unix"] == pytest.approx(
+            now + 60.0, abs=0.01)
+
+    def test_tick_renews_at_half_ttl(self, tmp_path):
+        j = _journal(tmp_path)
+        a = _coord(j, "wa", ttl=0.2)
+        assert a.try_claim("k", "job")
+        time.sleep(0.12)                          # past half-TTL
+        a.tick()
+        assert a.registry.value("fleet/lease_renewals") >= 1
+        assert a.holds("k")
+
+    def test_restart_adopts_own_claim(self, tmp_path):
+        j = _journal(tmp_path)
+        a = _coord(j, "wa", ttl=60.0)
+        assert a.try_claim("k", "job")
+        # same worker id, new process (the restart): adopt, not lose
+        a2 = _coord(sjournal.JobJournal(j.root, checkpoint_every=0),
+                    "wa", ttl=60.0)
+        assert a2.try_claim("k", "job")
+        assert a2.holds("k")
+
+    def test_steal_happens_within_bound_in_process(self, tmp_path):
+        """A non-renewing holder's job becomes claimable roughly at
+        TTL; the hard 2x-TTL bound is pinned by the soak artifact —
+        here we pin that the steal path works and is prompt."""
+        j = _journal(tmp_path)
+        a = _coord(j, "wa", ttl=0.3)
+        b = _coord(sjournal.JobJournal(j.root, checkpoint_every=0),
+                   "wb", ttl=0.3)
+        assert a.try_claim("k", "job")
+        t0 = time.monotonic()
+        while not b.try_claim("k", "job"):
+            time.sleep(0.02)
+            assert time.monotonic() - t0 < 5.0
+        assert b.holds("k")
+
+    def test_fleet_burn_and_window_seed(self, tmp_path):
+        j = _journal(tmp_path)
+        j.append("submitted", key="k1", job="a", tenant="tb")
+        j.append("submitted", key="k2", job="b", tenant="tb")
+        j.append("started", key="k1", job="a", worker="wa",
+                 tenant="tb")
+        j.append("committed", key="k1", job="a", outputs={},
+                 elapsed_sec=9.0, tenant="tb", worker="wa")
+        st = j.replay()
+        c = _coord(j, "wb")
+        assert c.fleet_burn(st, {"e2e": 5.0}) == {"tb": 1}
+        assert c.fleet_burn(st, {"e2e": 20.0}) == {}
+        # k2 is live elsewhere (not ours, not terminal): seeds quota
+        assert c.seed_window_counts(st, own_keys=set()) == {"tb": 1}
+        assert c.seed_window_counts(st, own_keys={"k2"}) == {}
+
+    def test_claim_refused_for_healthy_committed_key(self, tmp_path):
+        """A peer's commit landing between a drain scan and the claim
+        append must not let a second worker re-run the job (the
+        duplicate-commit race): try_claim re-checks terminal state on
+        its own fresh replay."""
+        j = _journal(tmp_path)
+        p = tmp_path / "out.fasta"
+        p.write_text(">r\nACGT\n")
+        j.append("committed", key="k", job="x",
+                 outputs={str(p): sjournal.file_fingerprint(str(p))})
+        c = _coord(j, "wb")
+        assert not c.try_claim("k", "job")
+        # ... but a commit whose outputs DRIFTED is claimable (the
+        # re-run restores them — the serial restart contract)
+        os.unlink(p)
+        assert c.try_claim("k", "job")
+
+    def test_stale_failures_are_reclaimable_fresh_ones_not(self,
+                                                           tmp_path):
+        j = _journal(tmp_path)
+        j.append("failed", key="k", job="x", error="old crash")
+        c = _coord(j, "wb")
+        assert not c.try_claim("k", "job")     # fresh failure: terminal
+        assert c.try_claim("k", "job",
+                           reclaim_stale_failed=True)  # restart retry
+
+    def test_woken_zombie_never_journals_its_failure(self, tmp_path):
+        """A worker whose lease was stolen mid-run must journal
+        NOTHING for the job — even a failure: a 'failed' append would
+        pop the thief's live claim and wreck its commit."""
+        from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+        path = _sim(tmp_path, "z.sam", 73, prefix="zz_")
+        out = str(tmp_path / "out")
+        os.makedirs(out)
+        r = ServeRunner(prewarm="off", persistent_cache=False,
+                        journal_dir=str(tmp_path / "j"),
+                        worker_id="w0", lease_ttl=0.2)
+        thief_events = []
+
+        def hijacked_execute(*a, **k):
+            # model the zombie: the run outlives the TTL (no renewals
+            # fire inside this stub), a thief reaps + re-claims, and
+            # then OUR run fails
+            time.sleep(0.3)
+            jj = sjournal.JobJournal(r.journal.root,
+                                     checkpoint_every=0)
+            st = jj.read_state()
+            (key, cur), = st.claims.items()
+            thief_events.append(key)
+            jj.append("lease_expired", key=key, worker="w0",
+                      reaper="thief")
+            jj.append("claimed", key=key, job="stolen", worker="thief",
+                      expires_unix=time.time() + 60)
+            raise RuntimeError("boom after steal")
+
+        r._execute = hijacked_execute
+        try:
+            res = r.submit_jobs([JobSpec(
+                filename=path,
+                config=RunConfig(backend="jax", outfolder=out,
+                                 prefix="pz"))])[0]
+            assert not res.ok
+            assert "lease lost" in res.error
+            st = r.journal.read_state()
+            key = thief_events[0]
+            # no failed event polluted the journal; the thief's claim
+            # is intact and it owns the lifecycle
+            assert st.failed == {}
+            assert st.claims[key]["worker"] == "thief"
+            assert r.registry.value("fleet/lease_lost") == 1
+        finally:
+            r.close()
+
+    def test_admission_seed_window_charges_quota(self):
+        from sam2consensus_tpu.serve.admission import (
+            REASON_TENANT_QUOTA, AdmissionController)
+
+        adm = AdmissionController(tenant_quota=2)
+        adm.open_window()
+        adm.seed_window({"tb": 2})
+        dec = adm.admit("tb")
+        assert not dec.admitted and dec.reason == REASON_TENANT_QUOTA
+        assert adm.admit("other").admitted
+
+
+# =========================================================================
+# concurrent journal writers (satellite: hammer test)
+# =========================================================================
+_HAMMER = """
+import sys
+from sam2consensus_tpu.serve.journal import JobJournal
+j = JobJournal(sys.argv[1], checkpoint_every=0)
+tag, n = sys.argv[2], int(sys.argv[3])
+for i in range(n):
+    j.append("submitted", key=f"{tag}-{i}", job=f"{tag}{i}")
+"""
+
+
+def _hammer(jdir, writers, per_writer):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _HAMMER, jdir, f"w{k}",
+         str(per_writer)], env=env, stderr=subprocess.PIPE)
+        for k in range(writers)]
+    for p in procs:
+        _, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()
+
+
+class TestConcurrentWriters:
+    def test_two_writers_never_tear_or_misorder(self, tmp_path):
+        jdir = str(tmp_path / "j")
+        _hammer(jdir, writers=2, per_writer=40)
+        j = sjournal.JobJournal(jdir, checkpoint_every=0)
+        evs = j.events()
+        assert len(evs) == 80
+        assert not any(e["ev"] == "_corrupt" for e in evs)
+        seqs = [e["seq"] for e in evs]
+        # dense, unique, ordered: the O_EXCL link allocation worked
+        assert seqs == sorted(seqs)
+        assert len(set(seqs)) == 80
+        assert seqs == list(range(seqs[0], seqs[0] + 80))
+        st = j.replay(full=True)
+        assert len(st.submitted) == 80
+
+    @pytest.mark.slow
+    def test_three_writers_hammer_full(self, tmp_path):
+        jdir = str(tmp_path / "j")
+        _hammer(jdir, writers=3, per_writer=300)
+        j = sjournal.JobJournal(jdir, checkpoint_every=0)
+        evs = j.events()
+        assert len(evs) == 900
+        seqs = [e["seq"] for e in evs]
+        assert len(set(seqs)) == 900 and seqs == sorted(seqs)
+
+
+# =========================================================================
+# checkpoint / compaction (satellite: replay cursor)
+# =========================================================================
+def _state_tuple(st):
+    return (st.committed, st.failed, st.inflight, st.commit_counts,
+            st.submitted, st.claims, st.tenants)
+
+
+class TestCheckpointCompaction:
+    def _busy_journal(self, tmp_path, every=16):
+        j = sjournal.JobJournal(str(tmp_path / "j"),
+                                checkpoint_every=every)
+        now = time.time()
+        for i in range(40):
+            key = f"k{i}"
+            j.append("submitted", key=key, job=f"job{i}", tenant="t")
+            j.append("claimed", key=key, worker="wa",
+                     expires_unix=now + 600)
+            j.append("started", key=key, job=f"job{i}", worker="wa",
+                     tenant="t")
+            if i % 3 == 0:
+                j.append("failed", key=key, job=f"job{i}", error="x")
+            elif i % 3 == 1:
+                j.append("committed", key=key, job=f"job{i}",
+                         outputs={}, elapsed_sec=0.1, worker="wa")
+            # i % 3 == 2 stays in flight with a live claim
+        return j
+
+    def test_compacted_replay_equals_full_replay(self, tmp_path):
+        j = self._busy_journal(tmp_path)
+        base, loaded = j._latest_checkpoint()
+        assert base > 0 and loaded is not None   # checkpoints exist
+        fast = j.replay()
+        full = j.replay(full=True)
+        assert _state_tuple(fast) == _state_tuple(full)
+        assert fast.last_seq == full.last_seq
+        assert j.audit() == j.audit(full=True)
+
+    def test_replay_is_o_tail_after_prune(self, tmp_path):
+        j = self._busy_journal(tmp_path)
+        before = j.replay()
+        n_segs = len(j._segments())
+        removed = j.prune()
+        assert removed > 0
+        assert len(j._segments()) < n_segs
+        after = j.replay()
+        assert _state_tuple(before) == _state_tuple(after)
+        # appends keep working past a prune (seq continues, not reused)
+        seq = j.append("submitted", key="fresh", job="fresh")
+        assert seq == before.last_seq + 1
+        assert "fresh" in j.replay().submitted
+
+    def test_corrupt_checkpoint_falls_back(self, tmp_path):
+        j = self._busy_journal(tmp_path)
+        full = j.replay(full=True)
+        ckpts = j._listing("checkpoint")
+        with open(ckpts[-1][1], "w") as fh:
+            fh.write("{torn")
+        again = j.replay()                 # older ckpt or genesis
+        assert _state_tuple(again) == _state_tuple(full)
+
+
+# =========================================================================
+# verify_outputs fast path (satellite)
+# =========================================================================
+class TestVerifyOutputs:
+    def _committed(self, p):
+        return {"outputs": {str(p): sjournal.file_fingerprint(str(p))}}
+
+    def test_untouched_passes_without_rehash(self, tmp_path,
+                                             monkeypatch):
+        p = tmp_path / "out.fasta"
+        p.write_text(">r\nACGT\n")
+        rec = self._committed(p)
+        j = _journal(tmp_path)
+        calls = []
+        orig = sjournal.file_sha256
+        monkeypatch.setattr(sjournal, "file_sha256",
+                            lambda q: calls.append(q) or orig(q))
+        assert j.verify_outputs(rec)
+        assert calls == []                 # stat fast path, no hash
+
+    def test_touched_but_identical_still_passes(self, tmp_path):
+        p = tmp_path / "out.fasta"
+        p.write_text(">r\nACGT\n")
+        rec = self._committed(p)
+        time.sleep(0.01)
+        os.utime(p)                        # mtime drifts, bytes same
+        j = _journal(tmp_path)
+        assert j.verify_outputs(rec)       # re-hash path, passes
+
+    def test_corrupted_same_size_fails(self, tmp_path):
+        p = tmp_path / "out.fasta"
+        p.write_text(">r\nACGT\n")
+        rec = self._committed(p)
+        time.sleep(0.01)
+        p.write_text(">r\nTTTT\n")         # same size, new bytes
+        j = _journal(tmp_path)
+        assert not j.verify_outputs(rec)
+
+    def test_full_mode_catches_mtime_reset_corruption(self, tmp_path):
+        """An adversarially reset mtime fools the stat fast path by
+        design — ``--verify-outputs full`` is the escape hatch."""
+        p = tmp_path / "out.fasta"
+        p.write_text(">r\nACGT\n")
+        rec = self._committed(p)
+        fp = rec["outputs"][str(p)]
+        p.write_text(">r\nTTTT\n")
+        os.utime(p, (fp["mtime"], fp["mtime"]))
+        j = _journal(tmp_path)
+        assert j.verify_outputs(rec)           # fooled (documented)
+        assert not j.verify_outputs(rec, mode="full")
+
+    def test_size_change_and_missing_fail_fast(self, tmp_path):
+        p = tmp_path / "out.fasta"
+        p.write_text(">r\nACGT\n")
+        rec = self._committed(p)
+        p.write_text(">r\nACGTACGT\n")
+        j = _journal(tmp_path)
+        assert not j.verify_outputs(rec)
+        os.unlink(p)
+        assert not j.verify_outputs(rec)
+
+    def test_legacy_string_fingerprints_still_verify(self, tmp_path):
+        p = tmp_path / "out.fasta"
+        p.write_text(">r\nACGT\n")
+        rec = {"outputs": {str(p): sjournal.file_sha256(str(p))}}
+        j = _journal(tmp_path)
+        assert j.verify_outputs(rec)
+        p.write_text(">r\nTTTT\n")
+        assert not j.verify_outputs(rec)
+
+
+# =========================================================================
+# runner integration (in-process, single worker)
+# =========================================================================
+class TestFleetRunner:
+    def test_single_worker_fleet_end_to_end(self, tmp_path):
+        from sam2consensus_tpu.observability.telemetry import \
+            lint_openmetrics
+        from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+        paths = [_sim(tmp_path, f"j{k}.sam", 60 + k,
+                      prefix=f"fr{k}_") for k in range(2)]
+        out = str(tmp_path / "out")
+        os.makedirs(out)
+        r = ServeRunner(prewarm="off", persistent_cache=False,
+                        journal_dir=str(tmp_path / "j"),
+                        worker_id="w0", lease_ttl=30.0)
+        try:
+            res = r.submit_jobs([
+                JobSpec(filename=p,
+                        config=RunConfig(backend="jax", outfolder=out,
+                                         prefix=f"p{k}"),
+                        tenant="ta")
+                for k, p in enumerate(paths)])
+            assert all(x.ok for x in res)
+            assert all(x.worker == "w0" for x in res)
+            assert all(x.output_paths for x in res)
+            # manifest records the committing worker (satellite)
+            assert res[0].manifest["serve"]["worker"] == "w0"
+            audit = r.journal.audit()
+            assert not audit["lost"] and not audit["duplicated"]
+            # health snapshot: worker identity + lease section
+            snap = r.health_snapshot()
+            assert snap["worker_id"] == "w0"
+            assert snap["lease"]["claims"] == 2
+            assert snap["lease"]["held"] == {}
+            # exposition: worker-labeled, lint-clean
+            tel = r.render_telemetry()
+            assert lint_openmetrics(tel) == []
+            samples = [ln for ln in tel.splitlines()
+                       if ln and not ln.startswith("#")]
+            assert samples
+            assert all('worker="w0"' in ln for ln in samples)
+            assert any("s2c_fleet_claims_total" in ln
+                       for ln in samples)
+        finally:
+            r.close()
+
+    def test_worker_id_requires_journal(self):
+        from sam2consensus_tpu.serve import ServeRunner
+
+        with pytest.raises(ValueError, match="requires --journal"):
+            ServeRunner(prewarm="off", persistent_cache=False,
+                        worker_id="w0")
+
+    def test_worker_id_rejects_batch(self, tmp_path):
+        from sam2consensus_tpu.serve import ServeRunner
+
+        with pytest.raises(ValueError, match="--batch"):
+            ServeRunner(prewarm="off", persistent_cache=False,
+                        journal_dir=str(tmp_path / "j"),
+                        worker_id="w0", batch="4")
+
+    def test_worker_id_rejects_count_cache(self, tmp_path):
+        """--count-cache on a fleet worker would be a silent no-op
+        (incremental jobs are rejected on journaled servers): refuse
+        it up front instead."""
+        from sam2consensus_tpu.serve import ServeRunner
+
+        with pytest.raises(ValueError, match="--count-cache"):
+            ServeRunner(prewarm="off", persistent_cache=False,
+                        journal_dir=str(tmp_path / "j"),
+                        worker_id="w0", count_cache="64M")
+
+    def test_drifted_commit_is_reclaimed_and_rerun(self, tmp_path):
+        """A committed job whose outputs no longer verify must be
+        RE-RUN by the fleet drain (the serial restart path's
+        contract), not reported as completed-elsewhere."""
+        from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+        path = _sim(tmp_path, "d.sam", 71, prefix="dr_")
+        out = str(tmp_path / "out")
+        os.makedirs(out)
+        spec = JobSpec(filename=path,
+                       config=RunConfig(backend="jax", outfolder=out,
+                                        prefix="pd"))
+        r1 = ServeRunner(prewarm="off", persistent_cache=False,
+                         journal_dir=str(tmp_path / "j"),
+                         worker_id="w0", lease_ttl=30.0)
+        try:
+            first = r1.submit_jobs([spec])[0]
+            assert first.ok and first.output_paths
+        finally:
+            r1.close()
+        target = first.output_paths[0]
+        os.unlink(target)                   # corrupt the commit
+        r2 = ServeRunner(prewarm="off", persistent_cache=False,
+                         journal_dir=str(tmp_path / "j"),
+                         worker_id="w0", lease_ttl=30.0)
+        try:
+            redo = r2.submit_jobs([spec])[0]
+            assert redo.ok
+            assert not redo.resumed          # ran, not skipped
+            assert redo.worker == "w0"
+            assert os.path.exists(target)    # outputs restored
+        finally:
+            r2.close()
+
+    def test_drain_stall_backstop_raises(self, tmp_path):
+        """Dead journal appends (disk full) must fail the drain
+        loudly, not spin forever."""
+        j = _journal(tmp_path)
+        coord = FleetCoordinator(j, "w0", 5.0, MetricsRegistry())
+        coord.drain_stall_budget = 0.4
+
+        class _StubRunner:
+            journal = j
+            verify_mode = "fast"
+            slo = {}
+
+            class admission:
+                slo_burn_by_tenant = {}
+
+            def telemetry_tick(self):
+                pass
+
+        def broken_append(*a, **k):
+            raise OSError("disk full")
+
+        j.append = broken_append
+        plan = [{"action": "run", "key": "k0", "job_id": "j0"}]
+        with pytest.raises(RuntimeError, match="stalled"):
+            coord.drain(_StubRunner(), plan, 0.0, j.replay(), None)
+
+    def test_fleet_journal_refuses_workerless_restart(self, tmp_path):
+        """Commits on ever-claimed keys are lease-fenced, so a
+        worker-less server could never commit them — refuse loudly."""
+        from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+        jdir = str(tmp_path / "j")
+        sjournal.JobJournal(jdir, checkpoint_every=0).append(
+            "claimed", key="k", job="x", worker="dead",
+            expires_unix=time.time() - 5)
+        path = _sim(tmp_path, "w.sam", 77, n_reads=200, prefix="wl_")
+        r = ServeRunner(prewarm="off", persistent_cache=False,
+                        journal_dir=jdir)
+        try:
+            with pytest.raises(ValueError, match="--worker-id"):
+                r.submit_jobs([JobSpec(
+                    filename=path,
+                    config=RunConfig(backend="jax",
+                                     outfolder=str(tmp_path)))])
+        finally:
+            r.close()
+
+    def test_bad_verify_mode_rejected(self):
+        from sam2consensus_tpu.serve import ServeRunner
+
+        with pytest.raises(ValueError, match="verify_outputs"):
+            ServeRunner(prewarm="off", persistent_cache=False,
+                        verify_outputs="sometimes")
+
+    def test_serve_cli_validations(self, tmp_path, capsys):
+        from sam2consensus_tpu.cli import serve_main
+
+        with pytest.raises(SystemExit,
+                           match="--worker-id requires --journal"):
+            serve_main(["-i", "x.sam", "--worker-id", "w0"])
+        with pytest.raises(SystemExit, match="--batch"):
+            serve_main(["-i", "x.sam", "--journal",
+                        str(tmp_path / "j"), "--worker-id", "w0",
+                        "--batch", "4"])
+        with pytest.raises(SystemExit, match="--lease-ttl"):
+            serve_main(["-i", "x.sam", "--journal",
+                        str(tmp_path / "j"), "--worker-id", "w0",
+                        "--lease-ttl", "0"])
+        with pytest.raises(SystemExit, match="--count-cache"):
+            serve_main(["-i", "x.sam", "--journal",
+                        str(tmp_path / "j"), "--worker-id", "w0",
+                        "--count-cache", "64M"])
+
+
+# =========================================================================
+# 2-worker subprocess smoke (tier-1 fast; the full rotating-kill soak
+# is the slow test below + the committed campaign artifact)
+# =========================================================================
+def _serve_cmd(inputs, outdir, jdir, worker, extra=()):
+    cmd = [sys.executable, "-m", "sam2consensus_tpu.cli", "serve"]
+    for p in inputs:
+        cmd += ["-i", p]
+    cmd += ["-o", outdir, "--journal", jdir, "--worker-id", worker,
+            "--lease-ttl", "10", "--pileup", "scatter", "--quiet",
+            *extra]
+    return cmd
+
+
+def _sha_dir(d):
+    import hashlib
+
+    return {n: hashlib.sha256(
+        open(os.path.join(d, n), "rb").read()).hexdigest()
+        for n in sorted(os.listdir(d))}
+
+
+class TestFleetSmoke:
+    def test_two_workers_drain_byte_identical_to_serial(self, tmp_path):
+        inputs = [_sim(tmp_path, f"s{k}.sam", 80 + k, n_reads=600,
+                       prefix=f"sm{k}_") for k in range(3)]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH",
+                                                        "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["S2C_JIT_CACHE"] = str(tmp_path / "_jit_cache")
+        out1, j1 = str(tmp_path / "o1"), str(tmp_path / "jj1")
+        r = subprocess.run(_serve_cmd(inputs, out1, j1, "solo"),
+                           env=env, capture_output=True, timeout=300)
+        assert r.returncode == 0, r.stderr.decode()
+        out2, j2 = str(tmp_path / "o2"), str(tmp_path / "jj2")
+        procs = [subprocess.Popen(
+            _serve_cmd(inputs, out2, j2, w), env=env,
+            stderr=subprocess.PIPE) for w in ("fw0", "fw1")]
+        for p in procs:
+            _, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err.decode()
+        assert _sha_dir(out1) == _sha_dir(out2)
+        audit = sjournal.JobJournal(j2).audit()
+        assert audit["lost"] == [] and audit["duplicated"] == []
+        assert len(audit["commit_counts"]) == 3
+        evs = sjournal.JobJournal(j2).events()
+        claimers = {e.get("worker") for e in evs
+                    if e.get("ev") == "claimed"}
+        assert claimers <= {"fw0", "fw1"} and claimers
+
+    @pytest.mark.slow
+    def test_rotating_kill_soak(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import fleet_soak
+
+        out = str(tmp_path / "soak.jsonl")
+        rc = fleet_soak.main([
+            "--cycles", "3", "--jobs", "3", "--reads", "6000",
+            "--contig-len", "4000", "--lease-ttl", "2.0",
+            "--skip-speedup", "--out", out,
+            "--workdir", str(tmp_path / "wk")])
+        assert rc == 0
+        rows = [json.loads(ln) for ln in open(out) if ln.strip()]
+        summary = rows[-1]
+        assert summary["failures"] == 0
+        assert summary["identical_all"] is True
+        assert summary["lost_total"] == 0
+        assert summary["duplicated_total"] == 0
+        steals = [r["steal_sec"] for r in rows
+                  if r.get("steal_sec") is not None]
+        assert steals, "no chaos signal landed"
+        assert all(s <= summary["steal_bound_sec"] for s in steals)
+
+
+# =========================================================================
+# exposition worker labels + s2c_top --fleet
+# =========================================================================
+class TestFleetTelemetry:
+    def test_worker_label_round_trips_and_lints(self):
+        from sam2consensus_tpu.observability.telemetry import (
+            lint_openmetrics, parse_openmetrics, render_openmetrics)
+
+        reg = MetricsRegistry()
+        reg.add("fleet/claims", 3)
+        reg.add("phase/decode_sec", 1.5)
+        reg.observe("slo/ta/e2e", 0.7)
+        text = render_openmetrics(reg.snapshot(), worker="w3")
+        assert lint_openmetrics(text) == []
+        samples = parse_openmetrics(text)
+        assert samples
+        assert all(s["labels"].get("worker") == "w3" for s in samples)
+        # two workers' scrapes merge without collisions
+        other = parse_openmetrics(
+            render_openmetrics(reg.snapshot(), worker="w4"))
+        keys = {(s["name"], tuple(sorted(s["labels"].items())))
+                for s in samples + other}
+        assert len(keys) == len(samples) + len(other)
+
+    def _healths(self):
+        h0 = {"worker_id": "w0", "uptime_sec": 30.0, "queue_depth": 1,
+              "in_flight": "job3:a.sam", "in_flight_sec": 4.0,
+              "last_heartbeat_age_sec": 0.2,
+              "jobs": {"run": 3, "failed": 0},
+              "lease": {"held": {"k1": {"expires_in_sec": 8.0,
+                                        "last_renew_age_sec": 1.0}},
+                        "reaped": 1, "steals": 1, "lease_lost": 0,
+                        "claims": 4, "claim_lost": 1},
+              "slo": {"burn_by_tenant": {"ta": 2}},
+              "journal": {"root": "/j", "last_seq": 17}}
+        h1 = {"worker_id": "w1", "uptime_sec": 29.0, "queue_depth": 0,
+              "in_flight": None, "in_flight_sec": None,
+              "last_heartbeat_age_sec": 0.4,
+              "jobs": {"run": 2, "failed": 1},
+              "lease": {"held": {}, "reaped": 0, "steals": 0,
+                        "lease_lost": 0, "claims": 2,
+                        "claim_lost": 2},
+              "slo": {"burn_by_tenant": {"ta": 1}},
+              "journal": {"root": "/j", "last_seq": 17}}
+        return [("h0.json", h0), ("h1.json", h1)]
+
+    def test_s2c_top_fleet_frame(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import s2c_top
+
+        samples = [
+            {"name": "s2c_slo_phase_seconds",
+             "labels": {"tenant": "ta", "phase": "e2e",
+                        "quantile": "0.99", "worker": "w0"},
+             "value": 1.25},
+            {"name": "s2c_slo_phase_seconds",
+             "labels": {"tenant": "ta", "phase": "e2e",
+                        "quantile": "0.99", "worker": "w1"},
+             "value": 2.5},
+            {"name": "s2c_slo_violations_total",
+             "labels": {"tenant": "ta", "phase": "e2e",
+                        "worker": "w0"}, "value": 2},
+            {"name": "s2c_slo_violations_total",
+             "labels": {"tenant": "ta", "phase": "e2e",
+                        "worker": "w1"}, "value": 1},
+        ]
+        frame = s2c_top.render_fleet(self._healths(), samples)
+        text = "\n".join(frame)
+        assert "2 worker(s) (2 reporting)" in text
+        assert "jobs 5 (1 failed)" in text
+        assert "leases held 1, reaped 1, stolen 1" in text
+        w0row = next(ln for ln in frame if ln.startswith("w0"))
+        assert "job3:a.sam" in w0row
+        assert any(ln.startswith("w1") for ln in frame)
+        assert "slo burn by tenant (all workers): {'ta': 3}" in text
+        assert "w0=1.250s" in text and "w1=2.500s" in text
+        assert "journal:" in text
+
+    def test_s2c_top_fleet_waits_without_snapshots(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import s2c_top
+
+        frame = s2c_top.render_fleet([("h.json", None)], None)
+        assert "waiting" in frame[0]
+
+    def test_s2c_top_single_frame_shows_lease_line(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import s2c_top
+
+        frame = s2c_top.render(self._healths()[0][1], None)
+        assert any("worker: w0" in ln and "steals 1" in ln
+                   for ln in frame)
+
+
+# =========================================================================
+# claim evidence: committed artifact + check_perf_claims integration
+# =========================================================================
+class TestFleetArtifact:
+    ARTIFACT = os.path.join(REPO, "campaign",
+                            "fleet_soak_r06_cpufallback.jsonl")
+
+    def test_committed_artifact_invariants(self):
+        rows = [json.loads(ln) for ln in open(self.ARTIFACT)
+                if ln.strip()]
+        summary = [r for r in rows if r.get("mode") == "summary"][-1]
+        cycles = [r for r in rows if isinstance(r.get("cycle"), int)]
+        assert summary["identical_all"] is True
+        assert summary["lost_total"] == 0
+        assert summary["duplicated_total"] == 0
+        assert summary["failures"] == 0
+        assert summary["signaled_cycles"] >= 2    # chaos landed
+        assert {"kill", "wedge", "fault"} <= {r["mode"]
+                                              for r in cycles}
+        # the 2x-TTL takeover bound held on every signaled cycle
+        assert summary["max_steal_sec"] is not None
+        assert summary["max_steal_sec"] <= summary["steal_bound_sec"]
+        # the speedup leg is present and honest about its host
+        assert summary["host_cores"] >= 1
+        assert summary["drain_speedup"] is not None
+
+    def test_check_perf_claims_lints_fleet_artifacts(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import check_perf_claims
+
+        assert check_perf_claims.lint_fleet_soak_artifact(
+            self.ARTIFACT) == []
+        bad = tmp_path / "fleet_soak_bad.jsonl"
+        bad.write_text(json.dumps(
+            {"mode": "summary", "lost_total": 1,
+             "duplicated_total": 0, "identical_all": True,
+             "failures": 0}) + "\n")
+        errs = check_perf_claims.lint_fleet_soak_artifact(str(bad))
+        assert any("lost_total" in e for e in errs)
+        none = tmp_path / "fleet_soak_empty.jsonl"
+        none.write_text("")
+        assert check_perf_claims.lint_fleet_soak_artifact(
+            str(none)) == ["no summary row"]

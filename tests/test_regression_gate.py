@@ -258,6 +258,39 @@ def test_gate_fails_on_synthetic_recovery_regression(tmp_path, capsys):
     assert "REGRESSED: kill/recovery_sec" in out
 
 
+# -- fleet soak: drain_sec rides the gate (ISSUE 15) -----------------------
+def test_committed_fleet_soak_artifact_parses_and_gates(capsys):
+    """The committed fleet-soak artifact is well-formed (cycle
+    invariants are pinned in tests/test_fleet.py) and its drain_sec
+    series runs through the JSONL gate mode without erroring — the
+    per-mode groups (kill/wedge/fault + serial_drain/fleet_drain) are
+    the series future rounds regress against."""
+    path = os.path.join(REPO, "campaign",
+                        "fleet_soak_r06_cpufallback.jsonl")
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    modes = {r["mode"] for r in rows if "drain_sec" in r}
+    assert {"kill", "wedge", "fault", "serial_drain",
+            "fleet_drain"} <= modes
+    rc = regress_check.main(["--jsonl", path, "--group-by", "mode",
+                             "--value", "drain_sec",
+                             "--lower-is-better"])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_gate_fails_on_synthetic_drain_regression(tmp_path, capsys):
+    path = tmp_path / "fleet.jsonl"
+    rows = [{"mode": "fleet_drain", "drain_sec": s}
+            for s in (4.0, 4.2, 3.9, 4.1, 30.0)]   # regressed tail
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    rc = regress_check.main(["--jsonl", str(path), "--group-by",
+                             "mode", "--value", "drain_sec",
+                             "--lower-is-better"])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "REGRESSED: fleet_drain/drain_sec" in out
+
+
 # -- campaign JSONL mode ---------------------------------------------------
 def test_gate_jsonl_series(tmp_path, capsys):
     path = tmp_path / "sweep.jsonl"

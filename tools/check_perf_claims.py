@@ -110,7 +110,51 @@ def check_file(repo, name):
                         f"{name}:{lineno}: telemetry artifact {art!r} "
                         f"fails the OpenMetrics lint "
                         f"({len(errs)} error(s); first: {errs[0]})")
+            elif os.path.basename(art).startswith("fleet_soak") \
+                    and art.endswith(".jsonl"):
+                errs = lint_fleet_soak_artifact(path)
+                if errs:
+                    violations.append(
+                        f"{name}:{lineno}: fleet-soak artifact "
+                        f"{art!r} is not valid claim evidence "
+                        f"({len(errs)} error(s); first: {errs[0]})")
     return violations
+
+
+def lint_fleet_soak_artifact(path):
+    """Structural lint for a cited fleet-soak JSONL (tools/
+    fleet_soak.py): parseable rows, a summary row, and the summary's
+    invariants intact — an artifact recording lost/duplicated jobs or
+    cycle failures is no more evidence than a missing file."""
+    import json
+
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = [ln for ln in fh if ln.strip()]
+    except OSError as exc:
+        return [f"unreadable: {exc}"]
+    rows = []
+    for i, ln in enumerate(lines, 1):
+        try:
+            rows.append(json.loads(ln))
+        except ValueError:
+            errs.append(f"line {i}: not JSON")
+    summaries = [r for r in rows if r.get("mode") == "summary"]
+    if not summaries:
+        errs.append("no summary row")
+        return errs
+    s = summaries[-1]
+    if s.get("lost_total", 1) != 0:
+        errs.append(f"summary lost_total={s.get('lost_total')}")
+    if s.get("duplicated_total", 1) != 0:
+        errs.append(
+            f"summary duplicated_total={s.get('duplicated_total')}")
+    if not s.get("identical_all", False):
+        errs.append("summary identical_all is not true")
+    if s.get("failures", 1) != 0:
+        errs.append(f"summary failures={s.get('failures')}")
+    return errs
 
 
 def lint_telemetry_artifact(path):

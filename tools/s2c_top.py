@@ -6,6 +6,16 @@ server already writes:
     python tools/s2c_top.py --health health.json --telemetry metrics.prom
     python tools/s2c_top.py --health health.json --once       # one frame
 
+Fleet mode (``--fleet``): ``--health`` / ``--telemetry`` become GLOBS
+over N workers' atomically-written files (each worker runs with its
+own ``--health-out``/``--telemetry-out``; exposition samples carry
+``worker`` labels), merged into one aggregated frame — fleet totals,
+a per-worker liveness/lease table, the shared journal's position, and
+the merged per-tenant SLO view:
+
+    python tools/s2c_top.py --fleet --health 'ops/health-*.json' \\
+        --telemetry 'ops/metrics-*.prom'
+
 Polls the atomic health snapshot (``s2c serve --health-out``) and the
 OpenMetrics exposition (``--telemetry-out``) every ``--interval``
 seconds and renders: uptime, queue depth, the in-flight job + its age,
@@ -109,6 +119,16 @@ def render(health, samples, now=None):
         f"{adm.get('pinned', 0)} pinned, "
         f"{adm.get('poison', 0)} poison; "
         f"bad records {health.get('bad_records', 0)}")
+    # fleet mode: this worker's identity + lease book
+    lease = health.get("lease") or {}
+    if health.get("worker_id") or lease:
+        lines.append(
+            f"worker: {health.get('worker_id', '?')}  "
+            f"leases held {len(lease.get('held', {}))}  "
+            f"claims {lease.get('claims', 0)} "
+            f"({lease.get('claim_lost', 0)} lost races)  "
+            f"steals {lease.get('steals', 0)}  "
+            f"reaped {lease.get('reaped', 0)}")
     slo = health.get("slo") or {}
     if slo:
         lines.append(
@@ -232,24 +252,124 @@ def render(health, samples, now=None):
     return lines
 
 
+def render_fleet(healths, samples, now=None):
+    """One aggregated fleet frame from N workers' health snapshots
+    (``[(path, dict-or-None), ...]``) plus their merged worker-labeled
+    exposition samples (pure — pinned by tests)."""
+    live = [(p, h) for p, h in healths if h]
+    if not live:
+        return ["s2c_top: waiting for fleet health snapshots..."]
+    lines = []
+    jobs = sum(h.get("jobs", {}).get("run", 0) for _, h in live)
+    failed = sum(h.get("jobs", {}).get("failed", 0) for _, h in live)
+    queue = sum(h.get("queue_depth", 0) for _, h in live)
+    held = sum(len((h.get("lease") or {}).get("held", {}))
+               for _, h in live)
+    reaped = sum((h.get("lease") or {}).get("reaped", 0)
+                 for _, h in live)
+    steals = sum((h.get("lease") or {}).get("steals", 0)
+                 for _, h in live)
+    lost = sum((h.get("lease") or {}).get("lease_lost", 0)
+               for _, h in live)
+    lines.append(
+        f"s2c fleet  {len(healths)} worker(s) ({len(live)} reporting)"
+        f"  queue {queue}  jobs {jobs} ({failed} failed)  "
+        f"leases held {held}, reaped {reaped}, stolen {steals}"
+        + (f", lost {lost}" if lost else ""))
+    lines.append(f"{'worker':<12} {'up':>7} {'queue':>5} "
+                 f"{'in-flight':<26} {'hb-age':>7} {'leases':>6} "
+                 f"{'jobs':>5}")
+    for path, h in sorted(healths,
+                          key=lambda ph: (ph[1] or {}).get(
+                              "worker_id") or ph[0]):
+        wid = (h or {}).get("worker_id") \
+            or os.path.basename(path)
+        if h is None:
+            lines.append(f"{wid:<12} {'-':>7}  (no snapshot yet)")
+            continue
+        hb = h.get("last_heartbeat_age_sec")
+        inflight = h.get("in_flight")
+        flag = " <<wedge?" if inflight and hb is not None \
+            and hb > 5.0 else ""
+        infl = "-"
+        if inflight:
+            infl = (f"{inflight[:18]} "
+                    f"({_age_fmt(h.get('in_flight_sec'))})")
+        lines.append(
+            f"{wid:<12} {_age_fmt(h.get('uptime_sec')):>7} "
+            f"{h.get('queue_depth', 0):>5} {infl:<26} "
+            f"{_age_fmt(hb):>7} "
+            f"{len((h.get('lease') or {}).get('held', {})):>6} "
+            f"{h.get('jobs', {}).get('run', 0):>5}{flag}")
+    # merged per-tenant SLO burn from the health side (the exposition
+    # table below carries the latency quantiles when wired)
+    burn = {}
+    for _, h in live:
+        for t, n in ((h.get("slo") or {}).get("burn_by_tenant")
+                     or {}).items():
+            burn[t] = burn.get(t, 0) + n
+    if burn:
+        lines.append(f"slo burn by tenant (all workers): {burn}")
+    tenants = _tenants(samples)
+    if tenants:
+        lines.append(f"{'tenant':<14} {'e2e p99 by worker':<40} "
+                     f"{'viol':>5}")
+        for t in tenants:
+            per_w = {}
+            for s in samples or ():
+                if s["name"] == "s2c_slo_phase_seconds" \
+                        and s["labels"].get("tenant") == t \
+                        and s["labels"].get("phase") == "e2e" \
+                        and s["labels"].get("quantile") == "0.99":
+                    per_w[s["labels"].get("worker", "?")] = s["value"]
+            viol = sum(s["value"] for s in samples or ()
+                       if s["name"] == "s2c_slo_violations_total"
+                       and s["labels"].get("tenant") == t)
+            cells = "  ".join(f"{w}={v:.3f}s"
+                              for w, v in sorted(per_w.items()))
+            lines.append(f"{t:<14} {cells:<40} {int(viol):>5}")
+    # every worker shares ONE journal: show it once
+    jr = next((h.get("journal") for _, h in live
+               if h.get("journal")), None)
+    if jr:
+        lines.append(f"journal: {jr}")
+    return lines
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--health", required=True,
-                   help="the server's --health-out path")
+                   help="the server's --health-out path (a GLOB over "
+                        "worker snapshots with --fleet)")
     p.add_argument("--telemetry", default=None,
                    help="the server's --telemetry-out exposition path "
-                        "(optional; adds per-tenant latency columns)")
+                        "(optional; adds per-tenant latency columns; "
+                        "a GLOB with --fleet)")
+    p.add_argument("--fleet", action="store_true",
+                   help="aggregate N workers' health/exposition files "
+                        "(--health/--telemetry become globs) into one "
+                        "fleet frame")
     p.add_argument("--interval", type=float, default=2.0,
                    help="poll period in seconds (default 2)")
     p.add_argument("--once", action="store_true",
                    help="render one frame and exit (CI logs, tests)")
     args = p.parse_args(argv)
 
+    import glob as _glob
+
     while True:
-        health = read_health(args.health)
-        samples = read_telemetry(args.telemetry) \
-            if args.telemetry else None
-        frame = render(health, samples)
+        if args.fleet:
+            hpaths = sorted(_glob.glob(args.health)) or [args.health]
+            healths = [(pth, read_health(pth)) for pth in hpaths]
+            samples = []
+            for pth in sorted(_glob.glob(args.telemetry or "")):
+                samples.extend(read_telemetry(pth) or [])
+            frame = render_fleet(healths, samples or None)
+        else:
+            health = read_health(args.health)
+            samples = read_telemetry(args.telemetry) \
+                if args.telemetry else None
+            frame = render(health, samples)
         if args.once:
             print("\n".join(frame))
             return 0

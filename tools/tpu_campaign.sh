@@ -84,9 +84,10 @@ except Exception as e:
 # reads directly.  BENCH_SERVE_JOBS=0: the cold-vs-warm serving
 # numbers come from step 4e's dedicated serve_bench artifact — running
 # the 8 cold subprocesses twice per round would double several minutes
-# of wall clock for no extra signal)
+# of wall clock for no extra signal.  BENCH_FLEET_JOBS=0 likewise:
+# step 14's fleet_soak owns the queue-drain speedup artifact)
 BENCH_INIT_TIMEOUT=300 BENCH_INIT_RETRIES=3 BENCH_SERVE_JOBS=0 \
-  BENCH_INCR_PCT=0 \
+  BENCH_INCR_PCT=0 BENCH_FLEET_JOBS=0 \
   BENCH_FULL_OUT="campaign/bench_preview_$R.full.json" \
   run_step bench "campaign/bench_preview_$R.json" \
   "campaign/bench_stderr_$R.log" 5400 python bench.py
@@ -286,5 +287,22 @@ S2C_DECODE_MBPS_PER_CORE=1200 \
 run_step mem_watermark "campaign/mem_watermark_$R.jsonl" \
   "campaign/mem_watermark_stderr_$R.log" 1800 \
   python tools/mem_watermark.py --out -
+
+# 14. serve fleet soak (ISSUE 15 / ROADMAP 2(b) scale-out): N workers
+# over ONE journal as a work-stealing queue — per cycle the rotation
+# SIGKILL / SIGSTOP-wedge / persistent-fault must finish the queue
+# byte-identical to a 1-worker chaos-free baseline with zero lost /
+# zero duplicated jobs (journal fingerprint audit), and a dead or
+# frozen worker's leased job must be re-claimed by a peer within 2x
+# the lease TTL (steal_sec, measured from journal event timestamps).
+# The speedup leg is the >=1.8x queue-drain target — meaningful on
+# the multi-core rig; the cpu-fallback artifact records the 1-core
+# harness truth (host_cores in the summary says which).  Gate:
+#   python tools/regress_check.py --jsonl campaign/fleet_soak_$R.jsonl \
+#     --group-by mode --value drain_sec --lower-is-better
+# CPU-fallback harness proof: campaign/fleet_soak_r06_cpufallback.jsonl
+run_step fleet_soak "campaign/fleet_soak_$R.jsonl" \
+  "campaign/fleet_soak_stderr_$R.log" 3600 \
+  python tools/fleet_soak.py
 
 echo "$(date +%H:%M:%S) campaign complete" >> "$LOG"
