@@ -72,7 +72,16 @@ def _json_default(o):
 
 def write_chrome_trace(tracer: Tracer, path: str) -> None:
     blob = {"traceEvents": chrome_trace_events(tracer),
-            "displayTimeUnit": "ms"}
+            "displayTimeUnit": "ms",
+            # trace-context block for the fleet flight recorder
+            # (observability/flight.py): epoch_unix re-anchors this
+            # process's perf_counter microseconds onto the journal's
+            # wall clock; trace_id/key/worker (stamped by the serve
+            # runner into tracer.meta) join this artifact to its
+            # journal per-job track.  Perfetto ignores unknown
+            # top-level keys, so the file stays loadable as-is.
+            "s2c": {"epoch_unix": getattr(tracer, "epoch_unix", None),
+                    **getattr(tracer, "meta", {})}}
     # explicit utf-8: ensure_ascii=False emits raw unicode, and a
     # C/POSIX-locale CI host must not turn a unicode span label into a
     # lost artifact

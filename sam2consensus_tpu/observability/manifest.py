@@ -139,6 +139,16 @@ def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
     for rec in ledger_records:
         d = rec.to_dict() if hasattr(rec, "to_dict") else dict(rec)
         decisions.append(d)
+    # flight-recorder lifecycle seed (observability/flight.py): the
+    # sched/trace info gauge carries the job's trace-context
+    # (trace_id = journal key) so a cold-written manifest already
+    # joins the fleet trace; the serve runner's finalize then
+    # overlays the full journal-measured ``lifecycle`` section
+    # (queue wait, claim/steal latency, worker) on top of this.
+    lifecycle: dict = {}
+    tg = snap["gauges"].get("sched/trace")
+    if tg is not None and tg.get("info"):
+        lifecycle = dict(tg["info"])
     return {
         "schema": SCHEMA,
         "created_unix": round(time.time(), 3),
@@ -153,6 +163,7 @@ def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
         "serve": serve,
         "ingest": ingest,
         "memory": memory,
+        "lifecycle": lifecycle,
         "drift_events": int(counters.get("drift/events", 0)),
         "artifacts": dict(artifacts or {}),
     }

@@ -187,8 +187,15 @@ class AggregateRegistry(MetricsRegistry):
     # fleet/: the claim/lease counters are runner-owned coordination
     # state (serve/fleet.py records them straight into the server
     # registry); a job registry carrying a copy would double-count
+    # sched/: the flight recorder's scheduler telemetry (queue-wait /
+    # claim / steal distributions, lease churn, occupancy) is likewise
+    # runner-owned — derived from journal wall times at finalize, not
+    # from anything a job's own registry could know.  The one sched/
+    # name a JOB registry carries (the sched/trace info gauge stamping
+    # trace_id into the metrics artifact) must not leak into the
+    # server aggregate either: the last-folded job would overwrite it.
     FOLD_SKIP_PREFIXES = ("serve/", "slo/", "telemetry/", "cache/",
-                          "mem/", "fleet/")
+                          "mem/", "fleet/", "sched/")
 
     def fold(self, registry: MetricsRegistry, job_id: str = "",
              tenant: str = "") -> None:
@@ -392,6 +399,28 @@ _HELP = {
                                             "unjournaled claim is "
                                             "simply not held).",
     "s2c_fleet_leases_held": "Leases this worker currently holds.",
+    # flight recorder (observability/flight.py): journal-measured
+    # scheduler telemetry — the s2c_sched_* family
+    "s2c_sched_seconds": "Journal-measured scheduler latency summary "
+                         "per tenant: kind=queue_wait (submitted -> "
+                         "started wall time, the SLO plane's "
+                         "queue-wait truth source), kind="
+                         "claim_latency (submitted -> this worker won "
+                         "the lease), kind=steal_latency (victim's "
+                         "last lease sign of life -> winning "
+                         "re-claim; bounded by ~2x lease TTL).",
+    "s2c_sched_lease_churn_total": "Lease-lifecycle turnover this "
+                                   "worker observed: reaps it "
+                                   "appended, claim races it lost, "
+                                   "leases it lost mid-run. High "
+                                   "churn with low steals means "
+                                   "contention, not failure "
+                                   "recovery.",
+    "s2c_sched_occupancy_ratio": "Fraction of this worker's serve "
+                                 "uptime spent running jobs "
+                                 "(busy-seconds / uptime; the "
+                                 "flight recorder's per-worker "
+                                 "occupancy lane, live).",
 }
 
 
@@ -489,8 +518,15 @@ def render_openmetrics(snapshot: dict,
             continue
         fam(_sanitize(name), "gauge").add("", [], entry["value"])
     for name, entry in snapshot.get("histograms", {}).items():
-        m = re.match(r"^slo/([^/]*)/([^/]+)$", name)
+        m = re.match(r"^sched/([^/]*)/([^/]+)$", name)
         if m:
+            # flight-recorder scheduler distributions: kind is the
+            # latency being measured (queue_wait / claim_latency /
+            # steal_latency), tenant-labeled like the SLO families
+            labels = [("tenant", m.group(1) or "default"),
+                      ("kind", m.group(2))]
+            f = fam("s2c_sched_seconds", "summary")
+        elif (m := re.match(r"^slo/([^/]*)/([^/]+)$", name)):
             labels = [("tenant", m.group(1) or "default"),
                       ("phase", m.group(2))]
             f = fam("s2c_slo_phase_seconds", "summary")

@@ -144,6 +144,20 @@ class Tracer:
     def __init__(self, enabled: bool = False):
         self.enabled = enabled
         self._epoch = time.perf_counter()
+        #: wall-clock instant of the perf_counter epoch above — the
+        #: anchor the fleet flight recorder (observability/flight.py)
+        #: uses to re-base this process's span microseconds onto the
+        #: journal's wall clock when assembling a cross-process trace.
+        #: Captured back-to-back with the perf_counter read; the
+        #: microseconds of skew between the two reads is far below the
+        #: journal's 1 ms timestamp granularity.
+        self.epoch_unix = time.time()
+        #: trace-context carried into the exported artifact
+        #: (export.write_chrome_trace emits it as the ``s2c`` block):
+        #: the serve runner stamps ``trace_id`` / ``key`` / ``worker``
+        #: here so per-worker trace JSONs join the journal's per-job
+        #: tracks without filename guessing.
+        self.meta: Dict[str, object] = {}
         self._lock = threading.Lock()
         self._spans: List[Span] = []
         self._thread_names: Dict[int, str] = {}

@@ -103,6 +103,16 @@ class FleetCoordinator:
         self.claim_seqs: Dict[str, int] = {}
         #: key -> monotonic time of the last successful renewal
         self.last_renew: Dict[str, float] = {}
+        #: key -> wall time this worker WON the key's lease — the
+        #: flight recorder's claim-latency epoch (claim_unix -
+        #: journal submit time); consumed by the runner at finalize
+        self.claim_unix: Dict[str, float] = {}
+        #: key -> measured steal gap for leases this worker STOLE:
+        #: victim's last lease sign of life (claims entry ``t``) ->
+        #: our winning re-claim.  The per-job number fleet_soak's
+        #: 2xTTL bound is asserted against, surfaced as
+        #: ``sched/<tenant>/steal_latency`` at finalize.
+        self.steal_gaps: Dict[str, float] = {}
         self.reaped = 0
         self._last_reap_scan = 0.0
         #: drain liveness backstop (see drain()): seconds of ZERO
@@ -195,9 +205,11 @@ class FleetCoordinator:
                     self.claim_seqs[key] = int(
                         cur.get("claim_seq", 0))
                     self.last_renew[key] = time.monotonic()
+                    self.claim_unix[key] = now
                     self.registry.add("fleet/claims", 1)
                     return True
                 self.registry.add("fleet/claim_lost", 1)
+                self.registry.add("sched/lease_churn", 1)
                 return False
             if cur["worker"] != self.worker_id \
                     and now < cur["expires_unix"]:
@@ -209,7 +221,12 @@ class FleetCoordinator:
                          reaper=self.worker_id)
             self.reaped += 1
             self.registry.add("fleet/lease_reaped", 1)
+            self.registry.add("sched/lease_churn", 1)
             stole = cur["worker"] != self.worker_id
+            # the victim's last lease sign of life (claims entry
+            # ``t``): the epoch the steal gap is measured from
+            victim_last_t = float(cur.get(
+                "t", cur["expires_unix"] - self.ttl))
         exp = now + self.ttl
         seq = self._append("claimed", key=key, job=job_id,
                            worker=self.worker_id,
@@ -226,11 +243,14 @@ class FleetCoordinator:
             self.held[key] = exp
             self.claim_seqs[key] = seq
             self.last_renew[key] = time.monotonic()
+            self.claim_unix[key] = now
             self.registry.add("fleet/claims", 1)
             if stole:
                 self.registry.add("fleet/steals", 1)
+                self.steal_gaps[key] = max(0.0, now - victim_last_t)
         else:
             self.registry.add("fleet/claim_lost", 1)
+            self.registry.add("sched/lease_churn", 1)
         return won
 
     def holds(self, key: str) -> bool:
@@ -272,6 +292,8 @@ class FleetCoordinator:
         self.held.pop(key, None)
         self.claim_seqs.pop(key, None)
         self.last_renew.pop(key, None)
+        self.claim_unix.pop(key, None)
+        self.steal_gaps.pop(key, None)
 
     # -- the watchdog-tick duties ------------------------------------------
     def tick(self) -> None:
@@ -312,6 +334,7 @@ class FleetCoordinator:
                              reaper=self.worker_id)
                 self.reaped += 1
                 self.registry.add("fleet/lease_reaped", 1)
+                self.registry.add("sched/lease_churn", 1)
                 n += 1
                 logger.warning(
                     "reaped expired lease: key %s held by worker %r "

@@ -139,6 +139,37 @@ def snapshot(runner) -> dict:
             "last_jobs_per_sec": g.get("batch/jobs_per_sec",
                                        {}).get("value", 0.0),
         }
+    # flight recorder (observability/flight.py): journal-measured
+    # scheduler telemetry — queue-wait / claim / steal summaries per
+    # tenant ride the s2c_sched_* exposition; here the prober-visible
+    # synopsis (occupancy, churn, last lifecycle) plus the telemetry
+    # interval s2c_top --fleet uses to age-flag stale workers
+    reg_snap = reg.snapshot()
+    sched_hists = {name: entry for name, entry
+                   in reg_snap["histograms"].items()
+                   if name.startswith("sched/")}
+    churn = reg.value("sched/lease_churn")
+    occ = reg_snap["gauges"].get("sched/occupancy_ratio",
+                                 {}).get("value", 0.0)
+    snap["sched"] = {
+        "telemetry_interval_sec": getattr(
+            runner, "telemetry_interval", None),
+        "occupancy_ratio": occ,
+        "lease_churn": int(churn),
+        "queue_wait": {
+            name.split("/", 2)[1] or "default": {
+                "count": entry["count"],
+                "p50_sec": round(entry["p50"], 4),
+                "p95_sec": round(entry["p95"], 4)}
+            for name, entry in sorted(sched_hists.items())
+            if name.endswith("/queue_wait")},
+        "steals_measured": {
+            name.split("/", 2)[1] or "default": {
+                "count": entry["count"],
+                "max_sec": round(entry["max"], 3)}
+            for name, entry in sorted(sched_hists.items())
+            if name.endswith("/steal_latency")},
+    }
     # incremental consensus (serve/countcache.py): the per-reference
     # count cache's residency + hit/evict story, mirrored from the
     # s2c_cache_* exposition family for probers without a scraper

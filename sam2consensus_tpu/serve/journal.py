@@ -183,6 +183,12 @@ class ReplayState:
     #: key -> tenant label, from started events that carried one (the
     #: journal-visible input to fleet-global admission accounting)
     tenants: Dict[str, str] = field(default_factory=dict)
+    #: key -> wall time of the FIRST submitted event — the flight
+    #: recorder's queue-wait epoch (observability/flight.py): journal-
+    #: measured queue wait is started.t - submit_times[key], which
+    #: survives restarts and steals where a process-local window epoch
+    #: cannot
+    submit_times: Dict[str, float] = field(default_factory=dict)
     last_seq: int = 0
     events: int = 0
     corrupt_segments: int = 0
@@ -197,6 +203,7 @@ class ReplayState:
                 "claims": self.claims, "tenants": self.tenants,
                 "claimed_ever": sorted(self.claimed_ever),
                 "stale_commits": self.stale_commits,
+                "submit_times": self.submit_times,
                 "last_seq": self.last_seq, "events": self.events,
                 "corrupt_segments": self.corrupt_segments}
 
@@ -212,6 +219,7 @@ class ReplayState:
         st.tenants = dict(blob.get("tenants") or {})
         st.claimed_ever = set(blob.get("claimed_ever") or ())
         st.stale_commits = dict(blob.get("stale_commits") or {})
+        st.submit_times = dict(blob.get("submit_times") or {})
         st.last_seq = int(blob.get("last_seq", 0))
         st.events = int(blob.get("events", 0))
         st.corrupt_segments = int(blob.get("corrupt_segments", 0))
@@ -390,6 +398,11 @@ class JobJournal:
             return
         if ev == "submitted":
             st.submitted.add(key)
+            if key not in st.submit_times:
+                try:
+                    st.submit_times[key] = float(rec.get("t", 0.0))
+                except (TypeError, ValueError):
+                    st.submit_times[key] = 0.0
             if rec.get("tenant"):
                 st.tenants[key] = rec["tenant"]
         elif ev == "started":
@@ -434,11 +447,15 @@ class JobJournal:
                 st.claims[key] = {
                     "worker": rec.get("worker", ""),
                     "claim_seq": int(rec.get("seq", 0)),
-                    "expires_unix": float(rec.get("expires_unix", 0.0))}
+                    "expires_unix": float(rec.get("expires_unix", 0.0)),
+                    # last lease sign-of-life wall time: the epoch a
+                    # thief's steal gap is measured from (flight.py)
+                    "t": float(rec.get("t", 0.0))}
         elif ev == "lease_renewed":
             cur = st.claims.get(key)
             if cur is not None and cur["worker"] == rec.get("worker"):
                 cur["expires_unix"] = float(rec.get("expires_unix", 0.0))
+                cur["t"] = float(rec.get("t", 0.0))
         elif ev == "lease_expired":
             # effective only if the lease was genuinely expired when
             # the reap event was APPENDED — a renewal that published

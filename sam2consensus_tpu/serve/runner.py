@@ -369,6 +369,14 @@ class ServeRunner:
         #: gauges and histograms, plus the per-tenant SLO histograms
         self.registry = stele.AggregateRegistry()
         self.jobs_run = 0
+        #: flight recorder (observability/flight.py) state: journal
+        #: submit wall time per key (replay.submit_times for restarted
+        #: queues, append time for fresh submissions) — the epoch the
+        #: journal-measured queue wait and claim latency count from
+        self._submit_unix: dict = {}
+        #: accumulated run-attempt seconds — the live numerator of the
+        #: sched/occupancy_ratio gauge (busy / uptime)
+        self._busy_sec = 0.0
         self._prewarmed: set = set()
         self._prewarm_threads: list = []
         self._prewarm_stop = threading.Event()
@@ -715,6 +723,12 @@ class ServeRunner:
         if self.fleet is not None:
             reg.gauge("fleet/leases_held").set(
                 float(len(self.fleet.held)))
+        # flight-recorder occupancy: fraction of serve uptime spent in
+        # run attempts — the live counterpart of the per-worker
+        # occupancy lane fleet_trace derives from the journal offline
+        uptime = now - h._started_mono
+        reg.gauge("sched/occupancy_ratio").set(
+            round(self._busy_sec / uptime, 4) if uptime > 0 else 0.0)
 
     def render_telemetry(self) -> str:
         """The OpenMetrics exposition over the server-lifetime
@@ -832,6 +846,71 @@ class ServeRunner:
                     self.registry.add("telemetry/write_failed", 1)
                     logger.warning("manifest slo rewrite failed: %s",
                                    exc)
+
+    # -- flight recorder (observability/flight.py) -------------------------
+    def _stamp_trace(self, robs, entry: dict) -> None:
+        """Propagate the job's trace-context onto every artifact this
+        run will export: ``trace_id`` (= the journal key) into the
+        tracer's meta (export.write_chrome_trace emits it as the
+        ``s2c`` block), and the same identity as the ``sched/trace``
+        info gauge so the metrics JSONL and the manifest ``lifecycle``
+        section carry it too — a per-worker artifact then joins its
+        journal per-job track without filename guessing.  Safe (and a
+        near-no-op) for journal-less runs: the job id stands in for
+        the key."""
+        from ..observability import flight
+
+        key = entry.get("key")
+        info = {"trace_id": flight.trace_id(key) if key
+                else entry["job_id"],
+                "key": key or "", "job": entry["job_id"]}
+        if self.worker_id:
+            info["worker"] = self.worker_id
+        tr = getattr(robs, "tracer", None)
+        if tr is not None and hasattr(tr, "meta"):
+            tr.meta.update(info)
+        robs.registry.gauge("sched/trace").set_info(info)
+
+    def _sched_lifecycle(self, entry: dict, window_queue_wait: float):
+        """The job's journal-measured lifecycle numbers, as stamped
+        into its manifest ``lifecycle`` section.  Returns
+        ``(lifecycle_dict, journal_queue_wait_or_None)`` — the journal
+        number (started append wall time minus the key's FIRST
+        submitted wall time) is the queue-wait truth source when a
+        journal is present; the window-epoch measure rides along as
+        ``window_queue_wait_sec`` so the two stay cross-checkable
+        (they agree on a clean queue; they diverge exactly when a
+        restart or steal hid wall time from the window epoch)."""
+        from ..observability import flight
+
+        key = entry.get("key")
+        lc: dict = {
+            "trace_id": flight.trace_id(key) if key
+            else entry["job_id"],
+            "key": key or "",
+            "worker": self.worker_id or "",
+            "window_queue_wait_sec": round(
+                max(0.0, window_queue_wait), 4)}
+        sub = self._submit_unix.get(key) if key else None
+        started = entry.get("started_unix")
+        journal_qw = None
+        if sub is not None:
+            lc["submit_unix"] = sub
+        if started is not None:
+            lc["started_unix"] = started
+        if sub is not None and started is not None:
+            journal_qw = max(0.0, started - sub)
+            lc["queue_wait_sec"] = round(journal_qw, 4)
+        if self.fleet is not None and key:
+            cu = self.fleet.claim_unix.get(key)
+            if cu is not None and sub is not None:
+                lc["claim_latency_sec"] = round(
+                    max(0.0, cu - sub), 4)
+            sg = self.fleet.steal_gaps.get(key)
+            if sg is not None:
+                lc["steal_latency_sec"] = round(sg, 4)
+                lc["stolen"] = True
+        return lc, journal_qw
 
     # -- journal helpers ---------------------------------------------------
     def _journal_append(self, ev: str, **fields) -> None:
@@ -1089,6 +1168,12 @@ class ServeRunner:
         # remembers the whole queue
         if self.journal is not None:
             already = replay.submitted if replay is not None else set()
+            if replay is not None:
+                # restarted queue: prior submissions keep their
+                # ORIGINAL journal submit time — a job's queue wait
+                # spans the crash, which is exactly the point of
+                # measuring it from the journal instead of the window
+                self._submit_unix.update(replay.submit_times)
             for entry in plan:
                 if entry["action"] == "run" \
                         and entry["key"] not in already:
@@ -1099,6 +1184,10 @@ class ServeRunner:
                             entry["spec"].filename),
                         outfolder=entry["spec"].config.outfolder,
                         tenant=entry["spec"].tenant or "")
+                    # mirror of the append's own stamp (same clock,
+                    # same 1 ms rounding) — saves a replay per job
+                    self._submit_unix.setdefault(
+                        entry["key"], round(time.time(), 3))
             for entry in plan:
                 if entry["action"] == "skip":
                     self._journal_append("resumed", job=entry["job_id"],
@@ -1264,6 +1353,10 @@ class ServeRunner:
                     header_err = exc
             ahead = None
             ahead_for = None
+            # trace-context onto this run's artifacts (works for the
+            # decode-ahead robs too: its trace file is written at
+            # finish_run, after this stamp)
+            self._stamp_trace(robs, entry)
             if not first_run_seen and contigs is not None:
                 from ..encoder.events import GenomeLayout
 
@@ -1323,6 +1416,9 @@ class ServeRunner:
             self._journal_append("started", job=job_id,
                                  key=entry["key"],
                                  ckpt=cfg.checkpoint_dir or "")
+            # mirror of the started append's wall stamp: the journal-
+            # measured queue wait's right edge (flight recorder)
+            entry["started_unix"] = round(time.time(), 3)
             t0 = time.perf_counter()
             if header_err is not None:
                 res.error = f"{type(header_err).__name__}: {header_err}"
@@ -1490,6 +1586,7 @@ class ServeRunner:
             metrics_out=self._job_out(cfg.metrics_out,
                                       "S2C_METRICS_OUT", jobnum),
             config=cfg)
+        self._stamp_trace(robs, entry)
         close_handle = None
         contigs = records = None
         header_err = None
@@ -1526,6 +1623,7 @@ class ServeRunner:
                              ckpt=cfg.checkpoint_dir or "",
                              worker=self.worker_id,
                              tenant=spec.tenant or "")
+        entry["started_unix"] = round(time.time(), 3)
         t0 = time.perf_counter()
         if header_err is not None:
             res.error = f"{type(header_err).__name__}: {header_err}"
@@ -1621,6 +1719,26 @@ class ServeRunner:
             # rewrite below persists the manifest file
             res.manifest.setdefault("serve", {})["worker"] = \
                 self.worker_id
+        # -- flight recorder: journal-measured lifecycle -----------
+        # (computed BEFORE the commit below releases fleet claim
+        # bookkeeping, stamped BEFORE the slo rewrite persists the
+        # manifest).  When a journal is present its wall-clock queue
+        # wait is the SLO truth source; the window-epoch measure rides
+        # in the lifecycle section as the cross-check.
+        lifecycle, journal_qw = self._sched_lifecycle(entry, queue_wait)
+        tlabel = spec.tenant or "default"
+        if journal_qw is not None:
+            self.registry.observe(f"sched/{tlabel}/queue_wait",
+                                  journal_qw)
+        if "claim_latency_sec" in lifecycle:
+            self.registry.observe(f"sched/{tlabel}/claim_latency",
+                                  lifecycle["claim_latency_sec"])
+        if "steal_latency_sec" in lifecycle:
+            self.registry.observe(f"sched/{tlabel}/steal_latency",
+                                  lifecycle["steal_latency_sec"])
+        self._busy_sec += max(0.0, res.elapsed_sec)
+        if res.manifest is not None:
+            res.manifest["lifecycle"] = lifecycle
         # -- commit: outputs durably on disk, then the journal -----
         if res.ok and res.fastas is not None \
                 and self.journal is not None and journal_lifecycle:
@@ -1684,9 +1802,14 @@ class ServeRunner:
             self._journal_append("failed", job=job_id,
                                  key=entry["key"], error=res.error)
         # fold the job's registry into the server-lifetime
-        # aggregate + per-tenant SLO verdict (never fails a job)
+        # aggregate + per-tenant SLO verdict (never fails a job).
+        # Journal-measured queue wait is the truth source when
+        # available (PERF.md R15): it spans restarts and steals,
+        # which the process-local window epoch cannot.
         self._telemetry_job_end(robs, res, snap, spec.tenant,
-                                queue_wait=queue_wait)
+                                queue_wait=journal_qw
+                                if journal_qw is not None
+                                else queue_wait)
         self.jobs_run += 1
         self.registry.add("serve/jobs", 1)
         if not res.ok:

@@ -336,6 +336,9 @@ class BatchScheduler:
             runner._journal_append(
                 "started", job=m.entry["job_id"], key=m.entry["key"],
                 ckpt="", packed=bid)
+            # flight recorder: the journal-measured queue wait counts
+            # to HERE (batch members start together)
+            m.entry["started_unix"] = round(time.time(), 3)
         # admitted accounting happens where a job actually executes:
         # the serial loop counts its own entries, so packed members
         # count here (and are un-counted on a demotion hand-back — the
@@ -633,6 +636,7 @@ class BatchScheduler:
                                         "S2C_METRICS_OUT",
                                         entry["jobnum"]),
             config=m.cfg)
+        runner._stamp_trace(m.robs, entry)
         m.res = JobResult(job_id=entry["job_id"], filename=spec.filename,
                           index=m.index, admission=entry["admission"])
         m.t0 = time.perf_counter()

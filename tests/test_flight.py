@@ -1,0 +1,588 @@
+"""Fleet flight recorder (ISSUE 16): journal -> distributed trace.
+
+The acceptance pins live here:
+
+* the clock assumptions the assembler leans on — per-key journal
+  event timestamps are non-decreasing across claim/steal/commit
+  lineages, and ``lease_expired`` arbitration is exactly
+  ``rec.t >= expires_unix`` (a renewal that published first voids the
+  reap) — in both serve/journal._apply and flight.assemble's mirror;
+* a mid-queue SIGKILL lineage assembles into a GAP-FREE per-job track
+  (segments tile submit -> terminal, zero negative durations) whose
+  measured steal latency sits within the fleet_soak 2x-lease-TTL
+  bound;
+* Chrome assembly validates (per-job tracks, worker occupancy lanes,
+  flow arrows, no orphans) and per-worker ``--trace-out`` blobs merge
+  re-anchored onto the journal wall clock, joined by trace_id;
+* the runner stamps trace context end-to-end: manifest ``lifecycle``
+  section, ``s2c_sched_*`` exposition (lint-clean, worker-labeled),
+  health ``sched`` section — with the journal-measured queue wait
+  agreeing with the window-epoch measure on a clean queue;
+* recording is passive: outputs are byte-identical with the flight
+  recorder on vs off;
+* the riding tools: trace_summary multi-file merge (``worker;`` flame
+  root), s2c_top --fleet staleness flag, check_perf_claims
+  flight-artifact lints.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.observability import flight
+from sam2consensus_tpu.observability.metrics import MetricsRegistry
+from sam2consensus_tpu.serve import journal as sjournal
+from sam2consensus_tpu.serve.fleet import FleetCoordinator
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_cache(monkeypatch):
+    monkeypatch.setenv("S2C_JIT_CACHE", "")
+
+
+def _journal(tmp_path, name="j", **kw):
+    kw.setdefault("checkpoint_every", 0)
+    return sjournal.JobJournal(str(tmp_path / name), **kw)
+
+
+def _sim(tmp, name, seed, n_reads=400, prefix="fl"):
+    spec = SimSpec(n_contigs=1, contig_len=2500, n_reads=n_reads,
+                   read_len=100, contig_len_jitter=0.0, seed=seed,
+                   contig_prefix=prefix)
+    path = os.path.join(str(tmp), name)
+    with open(path, "w") as fh:
+        fh.write(simulate(spec))
+    return path
+
+
+def _ev(seq, ev, key, t, **kw):
+    return {"schema": "s2c-journal/1", "seq": seq, "ev": ev,
+            "key": key, "t": t, **kw}
+
+
+def _sigkill_lineage(ttl=2.5):
+    """The canonical mid-queue SIGKILL story: w1 claims, renews once,
+    dies; w2 reaps at expiry, steals, commits."""
+    return [
+        _ev(1, "submitted", "k", 100.0, job="x", tenant="ta"),
+        _ev(2, "claimed", "k", 100.1, worker="w1",
+            expires_unix=100.1 + ttl),
+        _ev(3, "started", "k", 100.15, job="x", worker="w1"),
+        _ev(4, "lease_renewed", "k", 101.0, worker="w1",
+            expires_unix=101.0 + ttl),
+        # SIGKILL lands here; silence until the reap
+        _ev(5, "lease_expired", "k", 103.6, worker="w1", reaper="w2"),
+        _ev(6, "claimed", "k", 103.7, worker="w2",
+            expires_unix=103.7 + ttl),
+        _ev(7, "started", "k", 103.8, job="x", worker="w2"),
+        _ev(8, "committed", "k", 104.9, job="x", worker="w2",
+            claim_seq=6, outputs={}),
+    ]
+
+
+# =========================================================================
+# clock assumptions (journal side)
+# =========================================================================
+class TestJournalClockAssumptions:
+    def test_timestamps_non_decreasing_per_key_across_steal(self,
+                                                            tmp_path):
+        """A real claim/steal/commit lineage through the journal keeps
+        per-key ``t`` non-decreasing in seq order — the ordering the
+        assembler's segment derivation (and commit fencing) leans
+        on."""
+        j = _journal(tmp_path)
+        a = FleetCoordinator(j, "wa", 0.05, MetricsRegistry())
+        b = FleetCoordinator(
+            sjournal.JobJournal(j.root, checkpoint_every=0), "wb",
+            5.0, MetricsRegistry())
+        j.append("submitted", key="k", job="x")
+        assert a.try_claim("k", "x")
+        time.sleep(0.08)
+        assert b.try_claim("k", "x")       # reap + steal
+        j.append("started", key="k", job="x", worker="wb")
+        j.append("committed", key="k", job="x", outputs={},
+                 worker="wb")
+        evs = j.events()
+        by_key = {}
+        for e in evs:
+            if e.get("key"):
+                by_key.setdefault(e["key"], []).append(e)
+        for key, kevs in by_key.items():
+            ts = [float(e["t"]) for e in kevs]
+            assert ts == sorted(ts), (key, kevs)
+        # the steal is visible and measurable
+        assert b.steal_gaps.get("k", -1) >= 0.0
+
+    def test_reap_effective_only_at_or_after_expiry(self, tmp_path):
+        """``lease_expired`` arbitration is ``rec.t >= expires_unix``:
+        a reap racing a live (future-expiry) lease is void, in both
+        the journal replay and the assembler's mirror."""
+        j = _journal(tmp_path)
+        now = time.time()
+        j.append("claimed", key="k", worker="wa",
+                 expires_unix=now + 60)
+        j.append("lease_expired", key="k", worker="wa", reaper="wb")
+        st = j.replay()
+        assert st.claims["k"]["worker"] == "wa"      # reap voided
+        jobs = flight.assemble(j.events())
+        names = [n for n, _t, _a in jobs["k"].instants]
+        assert "lease_reap_void" in names
+        assert "lease_reaped" not in names
+        # expired lease: the same reap is effective
+        j2 = _journal(tmp_path, "j2")
+        j2.append("claimed", key="k", worker="wa",
+                  expires_unix=time.time() - 1)
+        j2.append("lease_expired", key="k", worker="wa", reaper="wb")
+        assert "k" not in j2.replay().claims
+        jobs2 = flight.assemble(j2.events())
+        assert "lease_reaped" in [n for n, _t, _a
+                                  in jobs2["k"].instants]
+
+
+# =========================================================================
+# assembler (synthetic lineages)
+# =========================================================================
+class TestAssemble:
+    def test_sigkill_track_is_gap_free_with_bounded_steal(self):
+        ttl = 2.5
+        jobs = flight.assemble(_sigkill_lineage(ttl))
+        assert list(jobs) == ["k"]
+        jl = jobs["k"]
+        assert jl.tenant == "ta"
+        assert jl.terminal_ev == "committed"
+        assert jl.committed_worker == "w2"
+        segs = jl.segments
+        assert segs, "no segments derived"
+        # gap-free tiling submit -> terminal, no negative durations
+        assert segs[0].t0 == jl.submitted_t == 100.0
+        assert segs[-1].t1 == jl.terminal_t == 104.9
+        for prev, nxt in zip(segs, segs[1:]):
+            assert prev.t1 == nxt.t0, (prev, nxt)
+        assert all(s.dur > 0 for s in segs)
+        kinds = [s.kind for s in segs]
+        assert kinds == ["queue_wait", "claim_latency", "run",
+                         "steal_gap", "claim_latency", "run"]
+        # the steal: victim's last sign of life (renewal at 101.0) ->
+        # winning re-claim at 103.7, within the fleet_soak bound
+        assert jl.steals == 1
+        assert jl.steal_latency_sec == pytest.approx(2.7)
+        assert jl.steal_latency_sec <= 2 * ttl
+        gap = [s for s in segs if s.kind == "steal_gap"][0]
+        assert gap.args["victim_last_t"] == 101.0
+        # journal-measured scheduler numbers
+        assert jl.queue_wait_sec == pytest.approx(0.15)
+        assert jl.claim_latency_sec == pytest.approx(0.1)
+        assert jl.lease_churn == 1                   # the reap
+        assert jl.renewals == 1
+
+    def test_zombie_commit_is_fenced_to_instant(self):
+        evs = _sigkill_lineage()
+        # the woken victim's commit lands between the steal and the
+        # thief's real commit — the lease fence voids it
+        evs.insert(7, _ev(9, "committed", "k", 104.0, job="x",
+                          worker="w1", claim_seq=2, outputs={}))
+        jobs = flight.assemble(evs)
+        jl = jobs["k"]
+        assert jl.terminal_ev == "committed"
+        assert jl.committed_worker == "w2"
+        assert jl.terminal_t == 104.9
+        assert ("stale_commit", 104.0, {"worker": "w1"}) \
+            in jl.instants
+        # the thief's run segment is NOT truncated at the zombie's t
+        run2 = [s for s in jl.segments if s.kind == "run"][-1]
+        assert (run2.t0, run2.t1) == (103.8, 104.9)
+
+    def test_claim_race_loser_counts_churn_not_ownership(self):
+        evs = [
+            _ev(1, "submitted", "k", 10.0, job="x"),
+            _ev(2, "claimed", "k", 10.1, worker="wa",
+                expires_unix=70.0),
+            _ev(3, "claimed", "k", 10.1, worker="wb",
+                expires_unix=70.0),
+            _ev(4, "started", "k", 10.2, job="x", worker="wa"),
+            _ev(5, "committed", "k", 11.0, job="x", worker="wa",
+                claim_seq=2, outputs={}),
+        ]
+        jl = flight.assemble(evs)["k"]
+        assert jl.lease_churn == 1
+        names = [n for n, _t, _a in jl.instants]
+        assert names.count("claim_won") == 1
+        assert names.count("claim_lost") == 1
+        assert jl.steals == 0
+        run = [s for s in jl.segments if s.kind == "run"][0]
+        assert run.worker == "wa"
+
+    def test_serial_journal_without_claims_still_tracks(self):
+        evs = [
+            _ev(1, "submitted", "k", 5.0, job="x"),
+            _ev(2, "started", "k", 5.4, job="x"),
+            _ev(3, "committed", "k", 6.0, job="x", outputs={}),
+        ]
+        jl = flight.assemble(evs)["k"]
+        assert [s.kind for s in jl.segments] == ["queue_wait", "run"]
+        assert jl.queue_wait_sec == pytest.approx(0.4)
+        assert jl.claim_latency_sec is None
+        assert jl.steal_latency_sec is None
+
+
+# =========================================================================
+# sched metrics + critical path
+# =========================================================================
+class TestSchedMetrics:
+    def test_fleet_aggregates_from_lineage(self):
+        jobs = flight.assemble(_sigkill_lineage())
+        sched = flight.sched_metrics(jobs)
+        ta = sched["per_tenant"]["ta"]
+        assert ta["queue_wait_sec"] == [pytest.approx(0.15)]
+        assert ta["claim_latency_sec"] == [pytest.approx(0.1)]
+        assert ta["steal_latency_sec"] == [pytest.approx(2.7)]
+        assert sched["lease_churn"] == 1
+        assert sched["wall_sec"] == pytest.approx(4.9)
+        # w1 ran 100.15 -> 103.6 (reap closes it), w2 103.8 -> 104.9
+        assert sched["workers"]["w1"]["busy_sec"] == pytest.approx(
+            3.45)
+        assert sched["workers"]["w2"]["busy_sec"] == pytest.approx(
+            1.1)
+        assert sched["workers"]["w1"]["occupancy"] == pytest.approx(
+            3.45 / 4.9, abs=1e-3)
+
+    def test_critical_path_splits_run_and_caps_overshoot(self):
+        jl = flight.assemble(_sigkill_lineage())["k"]
+        phases = {"phase/decode_sec": 0.5, "phase/accumulate_sec": 1.0,
+                  "phase/vote_sec": 0.25}
+        d = flight.critical_path(jl, phases)
+        run_total = 3.45 + 1.1
+        # queue = submit -> first claim; claim = both attempts' claim
+        # -> started gaps; steal = the gap's visible (post-reap) tail
+        assert d["queue"] == pytest.approx(0.1)
+        assert d["claim"] == pytest.approx(0.15)
+        assert d["steal"] == pytest.approx(0.1)
+        assert d["decode"] == pytest.approx(0.5)
+        assert d["dispatch"] == pytest.approx(1.0)
+        assert d["tail"] == pytest.approx(0.25)
+        assert d["run_other"] == pytest.approx(run_total - 1.75)
+        # a counter overshoot can never exceed the measured run wall
+        d2 = flight.critical_path(jl, {"phase/decode_sec": 99.0})
+        assert d2["decode"] == pytest.approx(run_total)
+        assert d2["run_other"] == 0.0
+        report = flight.wall_report({"k": jl})
+        assert report["total_sec"] > 0
+        assert set(report["totals_sec"]) == set(flight.PATH_BUCKETS)
+
+
+# =========================================================================
+# Chrome assembly + validation
+# =========================================================================
+class TestChromeAssembly:
+    def test_lineage_validates_with_lanes_and_flows(self):
+        jobs = flight.assemble(_sigkill_lineage())
+        events = flight.chrome_events(jobs)
+        assert flight.validate(events) == []
+        names = {e["args"]["name"] for e in events
+                 if e.get("ph") == "M"
+                 and e.get("name") == "thread_name"}
+        assert any(n.startswith("job x [k]") for n in names)
+        assert {"worker w1", "worker w2"} <= names
+        # flow arrows tie job track to worker lane (the steal hop)
+        assert any(e.get("ph") == "s" for e in events)
+        assert any(e.get("ph") == "f" for e in events)
+        # every X span is non-negative and on the journal-relative
+        # microsecond clock
+        for e in events:
+            if e.get("ph") == "X":
+                assert e["dur"] >= 0
+                assert e["ts"] >= 0
+
+    def test_worker_trace_merges_reanchored_by_trace_id(self):
+        jobs = flight.assemble(_sigkill_lineage())
+        blob = {"traceEvents": [
+            {"ph": "X", "tid": 0, "ts": 1000.0, "dur": 10.0,
+             "name": "decode"}],
+            "s2c": {"epoch_unix": 100.15, "trace_id": "k",
+                    "worker": "w1"}}
+        no_anchor = {"traceEvents": [
+            {"ph": "X", "tid": 0, "ts": 0.0, "dur": 1.0,
+             "name": "x"}], "s2c": {}}
+        events = flight.chrome_events(jobs, [blob, no_anchor])
+        assert flight.validate(events) == []
+        merged = [e for e in events
+                  if e.get("pid") == flight.PID_WORKER_TRACE0
+                  and e.get("ph") == "X"]
+        assert len(merged) == 1
+        # (epoch_unix - journal t0) * 1e6 + perf_counter_us
+        assert merged[0]["ts"] == pytest.approx(151000.0)
+        assert merged[0]["args"]["trace_id"] == "k"
+        # the anchorless blob was skipped, not mis-anchored
+        assert not any(e.get("pid") == flight.PID_WORKER_TRACE0 + 1
+                       for e in events)
+
+    def test_validate_flags_breakage(self):
+        assert flight.validate([]) != []             # no job track
+        bad = [{"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+                "args": {"name": "job j"}},
+               {"ph": "X", "pid": 1, "tid": 1, "name": "s",
+                "ts": 0.0, "dur": -5.0},
+               {"ph": "X", "pid": 1, "tid": 9, "name": "o",
+                "ts": 0.0, "dur": 1.0}]
+        errs = flight.validate(bad)
+        assert any("negative" in e for e in errs)
+        assert any("orphaned" in e for e in errs)
+
+
+# =========================================================================
+# runner integration: trace context + sched telemetry end-to-end
+# =========================================================================
+class TestRunnerLifecycle:
+    def test_lifecycle_stamped_and_sched_exposed(self, tmp_path):
+        from sam2consensus_tpu.observability.telemetry import \
+            lint_openmetrics
+        from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+        path = _sim(tmp_path, "a.sam", 91, prefix="lc_")
+        out = str(tmp_path / "out")
+        os.makedirs(out)
+        r = ServeRunner(prewarm="off", persistent_cache=False,
+                        journal_dir=str(tmp_path / "j"),
+                        worker_id="w0", lease_ttl=30.0)
+        try:
+            res = r.submit_jobs([JobSpec(
+                filename=path,
+                config=RunConfig(backend="jax", outfolder=out,
+                                 prefix="pl"),
+                tenant="ta")])[0]
+            assert res.ok
+            lc = res.manifest["lifecycle"]
+            st = r.journal.read_state()
+            (key,) = st.submitted
+            assert lc["trace_id"] == flight.trace_id(key)
+            assert lc["key"] == key
+            assert lc["worker"] == "w0"
+            # journal-measured queue wait is present and agrees with
+            # the window-epoch measure on a clean queue
+            jqw = lc["queue_wait_sec"]
+            wqw = lc["window_queue_wait_sec"]
+            assert jqw >= 0.0
+            assert abs(jqw - wqw) <= max(0.1 * max(jqw, wqw), 0.25)
+            assert lc["claim_latency_sec"] >= 0.0
+            assert "steal_latency_sec" not in lc     # nothing stolen
+            # live histograms observed per tenant
+            hist = r.registry.snapshot()["histograms"]
+            assert hist["sched/ta/queue_wait"]["count"] == 1
+            assert hist["sched/ta/claim_latency"]["count"] == 1
+            # exposition: s2c_sched_* family, worker-labeled,
+            # lint-clean
+            tel = r.render_telemetry()
+            assert lint_openmetrics(tel) == []
+            sched_lines = [ln for ln in tel.splitlines()
+                           if ln.startswith("s2c_sched_seconds")]
+            assert sched_lines
+            assert all('tenant="ta"' in ln and 'worker="w0"' in ln
+                       for ln in sched_lines)
+            assert any('kind="queue_wait"' in ln
+                       for ln in sched_lines)
+            # health snapshot sched section
+            snap = r.health_snapshot()
+            assert snap["sched"]["queue_wait"]["ta"]["count"] == 1
+            assert snap["sched"]["occupancy_ratio"] >= 0.0
+        finally:
+            r.close()
+
+    def test_outputs_byte_identical_flight_on_vs_off(self, tmp_path,
+                                                     monkeypatch):
+        """Recording is passive: a journaled worker with per-job
+        trace artifacts + trace-context stamping produces
+        byte-identical consensus outputs to the same worker run with
+        recording off."""
+        from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+        path = _sim(tmp_path, "b.sam", 92, prefix="bi_")
+
+        def run(tag, **kw):
+            out = str(tmp_path / f"out_{tag}") + os.sep
+            os.makedirs(out)
+            r = ServeRunner(prewarm="off", persistent_cache=False,
+                            journal_dir=str(tmp_path / f"j_{tag}"),
+                            worker_id="w0", lease_ttl=30.0, **kw)
+            try:
+                res = r.submit_jobs([JobSpec(
+                    filename=path,
+                    config=RunConfig(backend="jax", outfolder=out,
+                                     prefix="pb"))])[0]
+                assert res.ok and res.output_paths
+                return {os.path.basename(p): open(p, "rb").read()
+                        for p in res.output_paths}
+            finally:
+                r.close()
+
+        monkeypatch.setenv("S2C_TRACE_OUT",
+                           str(tmp_path / "trace_on"))
+        on = run("on")
+        monkeypatch.delenv("S2C_TRACE_OUT")
+        off = run("off")
+        assert on == off
+        # the recorder side really was on: a per-job trace exists and
+        # carries the trace context the assembler joins on
+        traces = [n for n in os.listdir(tmp_path)
+                  if n.startswith("trace_on")]
+        assert traces
+        blob = json.load(open(tmp_path / traces[0]))
+        assert blob["s2c"]["worker"] == "w0"
+        assert blob["s2c"]["trace_id"]
+        assert blob["s2c"]["epoch_unix"] > 0
+
+
+# =========================================================================
+# the assembler tool over a real journal
+# =========================================================================
+class TestFleetTraceTool:
+    def test_assembles_real_steal_journal_within_bound(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import fleet_trace
+
+        ttl = 0.05
+        j = _journal(tmp_path)
+        a = FleetCoordinator(j, "wa", ttl, MetricsRegistry())
+        b = FleetCoordinator(
+            sjournal.JobJournal(j.root, checkpoint_every=0), "wb",
+            5.0, MetricsRegistry())
+        j.append("submitted", key="k", job="x", tenant="tt")
+        assert a.try_claim("k", "x")
+        j.append("started", key="k", job="x", worker="wa")
+        time.sleep(0.08)
+        assert b.try_claim("k", "x")
+        j.append("started", key="k", job="x", worker="wb")
+        j.append("committed", key="k", job="x", outputs={},
+                 worker="wb")
+        jobs, events, sched, report = fleet_trace.assemble_journal(
+            j.root)
+        assert flight.validate(events) == []
+        jl = jobs["k"]
+        assert jl.steals == 1
+        assert jl.steal_latency_sec is not None
+        # generous wall bound: claims stamp second-resolution t's
+        assert jl.steal_latency_sec <= 2 * ttl + 2.0
+        assert sched["per_tenant"]["tt"]["steal_latency_sec"]
+        # trace round-trips through write_trace as valid JSON
+        out = str(tmp_path / "t.json")
+        fleet_trace.write_trace(out, events, sched)
+        blob = json.load(open(out))
+        assert blob["s2c"]["kind"] == "fleet_trace"
+        assert flight.validate(blob["traceEvents"]) == []
+
+
+# =========================================================================
+# riding tools: trace_summary merge, s2c_top staleness, claim lints
+# =========================================================================
+class TestTools:
+    def _trace(self, tmp_path, name, worker, span_name, dur):
+        blob = {"traceEvents": [
+            {"ph": "X", "tid": 0, "ts": 0.0, "dur": dur,
+             "name": span_name}],
+            "s2c": {"worker": worker, "epoch_unix": 100.0}}
+        p = str(tmp_path / name)
+        with open(p, "w") as fh:
+            json.dump(blob, fh)
+        return p
+
+    def test_trace_summary_merges_with_worker_flame_root(
+            self, tmp_path, capsys):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import trace_summary
+
+        p1 = self._trace(tmp_path, "t1.json", "wa", "decode", 100.0)
+        p2 = self._trace(tmp_path, "t2.json", "wb", "vote", 200.0)
+        assert trace_summary.main([p1, p2, "--flame"]) == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert "wa;decode 100" in lines
+        assert "wb;vote 200" in lines
+        # single-file mode: unchanged, no worker root
+        assert trace_summary.main([p1, "--flame"]) == 0
+        assert capsys.readouterr().out.strip() == "decode 100"
+        # glob expansion merges into ONE ranking
+        assert trace_summary.main(
+            [str(tmp_path / "t*.json"), "-n", "5"]) == 0
+        out = capsys.readouterr().out
+        assert "2 spans / 2 names" in out
+
+    def test_s2c_top_fleet_flags_stale_snapshots(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import s2c_top
+
+        fresh = str(tmp_path / "h_fresh.json")
+        stale = str(tmp_path / "h_stale.json")
+        for p, wid in ((fresh, "w0"), (stale, "w1")):
+            with open(p, "w") as fh:
+                json.dump({"worker_id": wid, "uptime_sec": 10.0,
+                           "jobs": {"run": 1},
+                           "sched": {"telemetry_interval_sec": 2.0}},
+                          fh)
+        old = time.time() - 60
+        os.utime(stale, (old, old))
+        healths = [(fresh, s2c_top.read_health(fresh)),
+                   (stale, s2c_top.read_health(stale))]
+        flagged = s2c_top.stale_workers(healths)
+        assert stale in flagged and fresh not in flagged
+        assert flagged[stale] > 3 * 2.0
+        frame = s2c_top.render_fleet(healths, None, stale=flagged)
+        assert any("1 stale" in ln for ln in frame)
+        w1_row = [ln for ln in frame if ln.startswith("w1")][0]
+        assert "stale" in w1_row
+        w0_row = [ln for ln in frame if ln.startswith("w0")][0]
+        assert "stale" not in w0_row
+        # 2-arg call stays valid (pinned fleet-frame contract)
+        assert s2c_top.render_fleet(healths, None)
+
+    def test_check_perf_claims_lints_flight_artifacts(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import check_perf_claims
+        import fleet_trace
+
+        jobs = flight.assemble(_sigkill_lineage())
+        events = flight.chrome_events(jobs)
+        good = str(tmp_path / "fleet_trace_ok.json")
+        fleet_trace.write_trace(good, events,
+                                flight.sched_metrics(jobs))
+        assert check_perf_claims.lint_flight_trace_artifact(good) == []
+        bad = str(tmp_path / "fleet_trace_bad.json")
+        with open(bad, "w") as fh:
+            json.dump({"traceEvents": [
+                {"ph": "X", "pid": 1, "tid": 1, "name": "s",
+                 "ts": 0.0, "dur": -1.0}]}, fh)
+        assert check_perf_claims.lint_flight_trace_artifact(bad)
+        notjson = str(tmp_path / "fleet_trace_nj.json")
+        with open(notjson, "w") as fh:
+            fh.write("{nope")
+        assert check_perf_claims.lint_flight_trace_artifact(notjson)
+        # leg JSONL: clean summary passes, any failure is flagged
+        okrow = {"mode": "summary", "failures": 0, "lost_total": 0,
+                 "duplicated_total": 0, "identical_all": True,
+                 "per_job_tracks": 3, "validation_errors": 0}
+        leg = str(tmp_path / "fleet_trace_leg.jsonl")
+        with open(leg, "w") as fh:
+            fh.write(json.dumps(okrow) + "\n")
+        assert check_perf_claims.lint_fleet_trace_leg_artifact(
+            leg) == []
+        badrow = dict(okrow, validation_errors=2, per_job_tracks=0)
+        with open(leg, "w") as fh:
+            fh.write(json.dumps(badrow) + "\n")
+        errs = check_perf_claims.lint_fleet_trace_leg_artifact(leg)
+        assert any("validation_errors" in e for e in errs)
+        assert any("per-job" in e for e in errs)
+
+    def test_committed_leg_artifact_is_lint_clean(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import check_perf_claims
+
+        art = os.path.join(REPO, "campaign",
+                           "fleet_trace_r06_cpufallback.jsonl")
+        assert os.path.exists(art), \
+            "campaign/fleet_trace_r06_cpufallback.jsonl missing"
+        assert check_perf_claims.lint_fleet_trace_leg_artifact(
+            art) == []

@@ -24,7 +24,11 @@ Telemetry artifacts are first-class claim evidence: a cited
 plane's ``--telemetry-out`` / the campaign ``serve_telemetry`` leg)
 must additionally PASS the OpenMetrics format lint
 (``observability/telemetry.lint_openmetrics``) — a malformed
-exposition is no more evidence than a missing file.
+exposition is no more evidence than a missing file.  Likewise cited
+flight-recorder artifacts: a ``fleet_trace*.json`` trace must pass
+``observability/flight.validate`` (valid trace-event JSON, >=1
+per-job track, no negative durations or orphans) and a
+``fleet_trace*.jsonl`` leg result must carry a clean summary row.
 
 Usage: python tools/check_perf_claims.py [--repo DIR]; exit 0 clean,
 1 with one violation per line otherwise.
@@ -118,6 +122,23 @@ def check_file(repo, name):
                         f"{name}:{lineno}: fleet-soak artifact "
                         f"{art!r} is not valid claim evidence "
                         f"({len(errs)} error(s); first: {errs[0]})")
+            elif os.path.basename(art).startswith("fleet_trace") \
+                    and art.endswith(".jsonl"):
+                errs = lint_fleet_trace_leg_artifact(path)
+                if errs:
+                    violations.append(
+                        f"{name}:{lineno}: flight-recorder leg "
+                        f"artifact {art!r} is not valid claim "
+                        f"evidence ({len(errs)} error(s); "
+                        f"first: {errs[0]})")
+            elif os.path.basename(art).startswith("fleet_trace") \
+                    and art.endswith(".json"):
+                errs = lint_flight_trace_artifact(path)
+                if errs:
+                    violations.append(
+                        f"{name}:{lineno}: flight-recorder trace "
+                        f"{art!r} fails the structural lint "
+                        f"({len(errs)} error(s); first: {errs[0]})")
     return violations
 
 
@@ -154,6 +175,72 @@ def lint_fleet_soak_artifact(path):
         errs.append("summary identical_all is not true")
     if s.get("failures", 1) != 0:
         errs.append(f"summary failures={s.get('failures')}")
+    return errs
+
+
+def lint_flight_trace_artifact(path):
+    """Structural lint for a cited flight-recorder trace JSON
+    (``tools/fleet_trace.py --out``): valid trace-event JSON with at
+    least one per-job track and zero negative-duration or orphaned
+    synthetic events — ``observability/flight.validate``'s exact
+    invariants, so a cited trace that Perfetto would render garbled is
+    no more evidence than a missing file."""
+    import json
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from sam2consensus_tpu.observability import flight
+
+    try:
+        with open(path, encoding="utf-8") as fh:
+            blob = json.load(fh)
+    except (OSError, ValueError) as exc:
+        return [f"not valid JSON: {exc}"]
+    events = blob.get("traceEvents") if isinstance(blob, dict) else blob
+    if not isinstance(events, list) or not events:
+        return ["no traceEvents"]
+    return flight.validate(events)
+
+
+def lint_fleet_trace_leg_artifact(path):
+    """Structural lint for a cited flight-recorder leg JSONL
+    (``tools/fleet_trace.py --leg``): parseable rows, a summary row,
+    and the summary's invariants intact — zero check failures, zero
+    lost/duplicated jobs, at least one per-job track assembled, and
+    zero trace-validation errors."""
+    import json
+
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = [ln for ln in fh if ln.strip()]
+    except OSError as exc:
+        return [f"unreadable: {exc}"]
+    rows = []
+    for i, ln in enumerate(lines, 1):
+        try:
+            rows.append(json.loads(ln))
+        except ValueError:
+            errs.append(f"line {i}: not JSON")
+    summaries = [r for r in rows if r.get("mode") == "summary"]
+    if not summaries:
+        errs.append("no summary row")
+        return errs
+    s = summaries[-1]
+    if s.get("failures", 1) != 0:
+        errs.append(f"summary failures={s.get('failures')}")
+    if s.get("lost_total", 1) != 0:
+        errs.append(f"summary lost_total={s.get('lost_total')}")
+    if s.get("duplicated_total", 1) != 0:
+        errs.append(
+            f"summary duplicated_total={s.get('duplicated_total')}")
+    if not s.get("identical_all", False):
+        errs.append("summary identical_all is not true")
+    if s.get("per_job_tracks", 0) < 1:
+        errs.append("summary assembled no per-job tracks")
+    if s.get("validation_errors", 1) != 0:
+        errs.append(
+            f"summary validation_errors={s.get('validation_errors')}")
     return errs
 
 

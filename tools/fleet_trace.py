@@ -1,0 +1,341 @@
+#!/usr/bin/env python3
+"""Fleet flight-recorder assembler: one journal -> one Perfetto trace.
+
+The offline half of observability/flight.py (ISSUE 16): replay a serve
+journal directory — any ``--journal DIR`` a fleet ran over, including
+the scratch journals tools/fleet_soak.py and tools/chaos_soak.py leave
+behind with ``--workdir`` — into
+
+* a **Chrome/Perfetto trace** (``--out trace.json``): per-job tracks
+  (queue wait, claim latency, run attempts per worker, steal gaps
+  death -> reap -> re-claim), lease renewals/reaps as instants,
+  per-worker occupancy lanes, flow arrows job-track -> worker-lane.
+  Per-worker ``--trace-out`` artifacts (``--worker-traces GLOB``) are
+  merged in, re-anchored from each process's perf_counter epoch onto
+  the journal's wall clock and joined by the ``trace_id`` their
+  ``s2c`` metadata block carries.  Load at https://ui.perfetto.dev;
+* **scheduler telemetry** (always printed as a JSON summary): per-
+  tenant queue-wait / claim-latency / steal-latency distributions,
+  lease churn, per-worker busy seconds and occupancy — the offline
+  audit of the live ``s2c_sched_*`` exposition family;
+* a **critical-path report** (``--report``): per job the end-to-end
+  queue -> claim -> decode -> dispatch -> tail -> commit decomposition
+  (phase splits joined from job manifests via ``--manifests GLOB``),
+  aggregated into the fleet "where does the wall go" table.
+
+``--leg`` runs the self-contained campaign harness instead (step 15 of
+tools/tpu_campaign.sh): a 2-worker journaled queue with one mid-queue
+SIGKILL cycle, then assembles the journal + surviving worker traces,
+asserts trace validity (flight.validate: >=1 per-job track, zero
+negative durations, zero orphans), sched-metric presence including a
+measured steal gap within 2 x lease TTL, and byte identity against a
+chaos-free baseline — one JSONL row per check plus a summary row
+(committed cpu-fallback artifact:
+campaign/fleet_trace_r06_cpufallback.jsonl).
+
+Usage:
+  python tools/fleet_trace.py --journal DIR [--worker-traces GLOB]
+         [--manifests GLOB] [--out trace.json] [--report]
+  python tools/fleet_trace.py --leg [--jobs 3] [--reads 8000]
+         [--lease-ttl 2.5] [--out FILE.jsonl] [--trace-out FILE.json]
+"""
+
+import argparse
+import glob as globmod
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+from fleet_soak import (journal_events, log, sha_dir,  # noqa: E402
+                        wait_for_inflight, worker_cmd)
+
+
+def load_worker_traces(patterns):
+    """Parsed --trace-out blobs (dicts) from glob patterns; files
+    without the ``s2c`` wall anchor still load (the assembler skips
+    them with their absence visible in the summary)."""
+    blobs = []
+    for pat in patterns or ():
+        for p in sorted(globmod.glob(pat)):
+            try:
+                with open(p, encoding="utf-8") as fh:
+                    blob = json.load(fh)
+            except (OSError, ValueError) as exc:
+                log(f"[fleet_trace] skipping unreadable trace {p}: "
+                    f"{exc}")
+                continue
+            blob["_path"] = p
+            blobs.append(blob)
+    return blobs
+
+
+def load_phase_maps(patterns):
+    """trace_id -> ``phase/<p>_sec`` dict, joined from job manifests
+    (their ``lifecycle.trace_id`` + ``phases`` sections)."""
+    out = {}
+    for pat in patterns or ():
+        for p in sorted(globmod.glob(pat)):
+            try:
+                with open(p, encoding="utf-8") as fh:
+                    man = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            tid = (man.get("lifecycle") or {}).get("trace_id")
+            if tid and man.get("phases"):
+                out[tid] = man["phases"]
+    return out
+
+
+def assemble_journal(jdir, worker_trace_globs=(), manifest_globs=()):
+    """(jobs, chrome_events, sched, report) for one journal dir."""
+    from sam2consensus_tpu.observability import flight
+
+    evs = journal_events(jdir)
+    if not evs:
+        raise SystemExit(f"no journal events under {jdir}")
+    jobs = flight.assemble(evs)
+    traces = load_worker_traces(worker_trace_globs)
+    events = flight.chrome_events(jobs, worker_traces=traces)
+    sched = flight.sched_metrics(jobs)
+    report = flight.wall_report(jobs,
+                                load_phase_maps(manifest_globs))
+    return jobs, events, sched, report
+
+
+def write_trace(path, events, sched):
+    blob = {"traceEvents": events, "displayTimeUnit": "ms",
+            "s2c": {"kind": "fleet_trace", "sched": sched}}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(blob, fh, ensure_ascii=False)
+        fh.write("\n")
+
+
+def print_report(report, sched, file=sys.stdout):
+    print("fleet critical path — where does the wall go", file=file)
+    total = report["total_sec"]
+    for bucket, sec in report["totals_sec"].items():
+        pct = report["pct"][bucket]
+        bar = "#" * int(round(pct / 2))
+        print(f"  {bucket:>10}  {sec:10.3f}s  {pct:6.2f}%  {bar}",
+              file=file)
+    print(f"  {'total':>10}  {total:10.3f}s", file=file)
+    print(f"workers ({len(sched['workers'])}):", file=file)
+    for w, info in sorted(sched["workers"].items()):
+        print(f"  {w:>10}  busy {info['busy_sec']:.3f}s  "
+              f"occupancy {info['occupancy']:.1%}  "
+              f"jobs {info['jobs']}", file=file)
+    print(f"lease churn: {sched['lease_churn']}", file=file)
+
+
+# =========================================================================
+# --leg: the campaign harness (2 workers, one SIGKILL, assemble+assert)
+# =========================================================================
+def run_leg(args):
+    import tempfile
+
+    from sam2consensus_tpu.observability import flight
+    from sam2consensus_tpu.serve.journal import JobJournal
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+    work = args.workdir or tempfile.mkdtemp(prefix="s2c_ftrace_")
+    os.makedirs(work, exist_ok=True)
+    log(f"[fleet_trace] leg workdir {work}")
+
+    inputs = []
+    for k in range(args.jobs):
+        spec = SimSpec(n_contigs=1, contig_len=args.contig_len,
+                       n_reads=args.reads, read_len=args.read_len,
+                       contig_len_jitter=0.0, seed=7600 + k,
+                       contig_prefix=f"ft{k:02d}_")
+        p = os.path.join(work, f"job{k}.sam")
+        with open(p, "w") as fh:
+            fh.write(simulate(spec))
+        inputs.append(p)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["S2C_JIT_CACHE"] = os.path.join(work, "_jit_cache")
+
+    # chaos-free single-worker baseline: the byte-identity oracle
+    # (the flight recorder is passive — recording must not change
+    # output bytes)
+    base_out = os.path.join(work, "out_base")
+    r = subprocess.run(worker_cmd(inputs, base_out,
+                                  os.path.join(work, "j_base"),
+                                  "base0", args.lease_ttl),
+                       env=env, capture_output=True, text=True,
+                       timeout=args.per_process_timeout)
+    if r.returncode != 0:
+        log(f"[fleet_trace] baseline failed rc={r.returncode}:\n"
+            f"{r.stderr[-2000:]}")
+        return 2
+    want = sha_dir(base_out)
+
+    # 2-worker kill cycle, per-worker trace artifacts via the
+    # env-derived per-job suffixing (S2C_TRACE_OUT -> <base>.jobN)
+    outdir = os.path.join(work, "out_fleet")
+    jdir = os.path.join(work, "j_fleet")
+    procs = {}
+    for w in ("ft0", "ft1"):
+        wenv = dict(env)
+        wenv["S2C_TRACE_OUT"] = os.path.join(work, f"trace_{w}")
+        procs[w] = subprocess.Popen(
+            worker_cmd(inputs, outdir, jdir, w, args.lease_ttl),
+            env=wenv, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + args.per_process_timeout
+    victim, vkey = wait_for_inflight(jdir, deadline)
+    t_signal = None
+    if victim in procs:
+        t_signal = time.time()
+        procs[victim].send_signal(signal.SIGKILL)
+        log(f"[fleet_trace] killed {victim} holding {vkey}")
+    rc = 0
+    for w, pr in procs.items():
+        try:
+            pr.wait(timeout=args.per_process_timeout)
+        except subprocess.TimeoutExpired:
+            pr.kill()
+            pr.wait(timeout=30)
+            rc = rc or -1
+        if w != victim:
+            rc = rc or pr.returncode
+
+    # -- assemble + assert ---------------------------------------------
+    trace_glob = os.path.join(work, "trace_*")
+    jobs, events, sched, report = assemble_journal(
+        jdir, worker_trace_globs=[trace_glob])
+    errors = flight.validate(events)
+    trace_out = args.trace_out or os.path.join(work,
+                                               "fleet_trace.json")
+    write_trace(trace_out, events, sched)
+    log(f"[fleet_trace] wrote {trace_out} ({len(events)} events)")
+
+    audit = JobJournal(jdir).audit()
+    got = sha_dir(outdir) if os.path.isdir(outdir) else {}
+    steals = [jl.steal_latency_sec for jl in jobs.values()
+              if jl.steal_latency_sec is not None]
+    bound = 2 * args.lease_ttl
+    qw = [v for t in sched["per_tenant"].values()
+          for v in t["queue_wait_sec"]]
+    # the victim may have committed the watched job in the scan ->
+    # signal gap (same degenerate case fleet_soak tolerates)
+    signal_late = t_signal is not None and not steals and any(
+        e.get("ev") == "committed" and e.get("key") == vkey
+        and e.get("worker") == victim for e in journal_events(jdir))
+    checks = {
+        "rc_zero": rc == 0,
+        "trace_valid": not errors,
+        "per_job_tracks": len(jobs) >= args.jobs,
+        "sched_queue_wait_present": len(qw) >= args.jobs,
+        "steal_measured": bool(steals) or signal_late,
+        "steal_within_bound": (max(steals) <= bound) if steals
+        else signal_late,
+        "identical": got == want,
+        "lost_zero": not audit["lost"],
+        "duplicated_zero": not audit["duplicated"],
+    }
+    ok = all(checks.values())
+    if errors:
+        log("[fleet_trace] validation errors: "
+            + "; ".join(errors[:10]))
+    rows = [{"mode": "leg_check", "check": k, "ok": v}
+            for k, v in checks.items()]
+    rows.append({
+        "mode": "summary", "ok": ok,
+        "jobs": args.jobs, "workers": 2, "reads": args.reads,
+        "lease_ttl_sec": args.lease_ttl,
+        "events": len(events),
+        "per_job_tracks": len(jobs),
+        "validation_errors": len(errors),
+        "victim": victim, "signal_late": signal_late,
+        "max_steal_sec": round(max(steals), 3) if steals else None,
+        "steal_bound_sec": bound,
+        "queue_wait_p50_sec": round(
+            sorted(qw)[len(qw) // 2], 3) if qw else None,
+        "lease_churn": sched["lease_churn"],
+        "occupancy": {w: i["occupancy"]
+                      for w, i in sched["workers"].items()},
+        "identical_all": checks["identical"],
+        "lost_total": len(audit["lost"]),
+        "duplicated_total": len(audit["duplicated"]),
+        "failures": 0 if ok else 1,
+        "host_cores": os.cpu_count(),
+        "platform": os.environ.get("JAX_PLATFORMS", ""),
+    })
+    blob = "\n".join(json.dumps(r) for r in rows) + "\n"
+    if args.out and args.out != "-":
+        with open(args.out, "w") as fh:
+            fh.write(blob)
+        log(f"[fleet_trace] wrote {args.out}")
+    else:
+        # "-"/unset: rows to stdout (tpu_campaign.sh's run_step
+        # captures stdout as the committed artifact)
+        sys.stdout.write(blob)
+    if not args.workdir:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0 if ok else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--journal", default=None,
+                    help="journal directory to assemble")
+    ap.add_argument("--worker-traces", action="append", default=[],
+                    help="glob of per-worker --trace-out JSONs "
+                         "(repeatable)")
+    ap.add_argument("--manifests", action="append", default=[],
+                    help="glob of job manifest JSONs for the "
+                         "critical-path phase split (repeatable)")
+    ap.add_argument("--out", default=None,
+                    help="trace JSON destination (assembler mode) / "
+                         "JSONL destination (--leg)")
+    ap.add_argument("--report", action="store_true",
+                    help="print the fleet critical-path report")
+    ap.add_argument("--leg", action="store_true",
+                    help="run the campaign harness (2 workers, one "
+                         "kill, assemble + assert)")
+    ap.add_argument("--jobs", type=int, default=3)
+    ap.add_argument("--reads", type=int, default=8000)
+    ap.add_argument("--contig-len", type=int, default=5000)
+    ap.add_argument("--read-len", type=int, default=100)
+    ap.add_argument("--lease-ttl", type=float, default=2.5)
+    ap.add_argument("--per-process-timeout", type=float, default=600.0)
+    ap.add_argument("--workdir", default=None,
+                    help="leg scratch dir (default: a fresh tempdir)")
+    ap.add_argument("--trace-out", default=None,
+                    help="leg: where to keep the assembled trace")
+    args = ap.parse_args(argv)
+
+    if args.leg:
+        return run_leg(args)
+    if not args.journal:
+        ap.error("--journal DIR is required (or use --leg)")
+    from sam2consensus_tpu.observability import flight
+
+    jobs, events, sched, report = assemble_journal(
+        args.journal, args.worker_traces, args.manifests)
+    errors = flight.validate(events)
+    if args.out:
+        write_trace(args.out, events, sched)
+        log(f"[fleet_trace] wrote {args.out} ({len(events)} events, "
+            f"{len(jobs)} job track(s))")
+    if args.report:
+        print_report(report, sched)
+    else:
+        print(json.dumps({"jobs": len(jobs), "events": len(events),
+                          "validation_errors": errors,
+                          "sched": sched}, indent=1))
+    return 0 if not errors else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

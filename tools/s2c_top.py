@@ -252,10 +252,38 @@ def render(health, samples, now=None):
     return lines
 
 
-def render_fleet(healths, samples, now=None):
+def stale_workers(healths, now=None):
+    """``{path: age_sec}`` for snapshot files older than 3x their
+    worker's own telemetry interval (the health ``sched`` section
+    carries it; absent -> the telemetry default).  A live worker
+    rewrites its snapshot every interval, so a file this old means the
+    worker died, wedged, or lost its disk — the fleet frame must say
+    so instead of rendering minutes-old numbers as current."""
+    from sam2consensus_tpu.observability.telemetry import \
+        DEFAULT_INTERVAL_S
+
+    now = time.time() if now is None else now
+    out = {}
+    for path, h in healths:
+        interval = ((h or {}).get("sched") or {}).get(
+            "telemetry_interval_sec") or DEFAULT_INTERVAL_S
+        try:
+            age = now - os.path.getmtime(path)
+        except OSError:
+            continue
+        if age > 3.0 * interval:
+            out[path] = age
+    return out
+
+
+def render_fleet(healths, samples, now=None, stale=None):
     """One aggregated fleet frame from N workers' health snapshots
     (``[(path, dict-or-None), ...]``) plus their merged worker-labeled
-    exposition samples (pure — pinned by tests)."""
+    exposition samples (pure — pinned by tests).  ``stale`` is
+    :func:`stale_workers`'s ``{path: age_sec}`` map; listed workers
+    render a ``stale`` flag instead of passing off old numbers as
+    live."""
+    stale = stale or {}
     live = [(p, h) for p, h in healths if h]
     if not live:
         return ["s2c_top: waiting for fleet health snapshots..."]
@@ -272,7 +300,8 @@ def render_fleet(healths, samples, now=None):
     lost = sum((h.get("lease") or {}).get("lease_lost", 0)
                for _, h in live)
     lines.append(
-        f"s2c fleet  {len(healths)} worker(s) ({len(live)} reporting)"
+        f"s2c fleet  {len(healths)} worker(s) ({len(live)} reporting"
+        + (f", {len(stale)} stale" if stale else "") + ")"
         f"  queue {queue}  jobs {jobs} ({failed} failed)  "
         f"leases held {held}, reaped {reaped}, stolen {steals}"
         + (f", lost {lost}" if lost else ""))
@@ -291,6 +320,9 @@ def render_fleet(healths, samples, now=None):
         inflight = h.get("in_flight")
         flag = " <<wedge?" if inflight and hb is not None \
             and hb > 5.0 else ""
+        if path in stale:
+            flag = (f" <<stale: snapshot {_age_fmt(stale[path])} old"
+                    f"{flag}")
         infl = "-"
         if inflight:
             infl = (f"{inflight[:18]} "
@@ -364,7 +396,8 @@ def main(argv=None):
             samples = []
             for pth in sorted(_glob.glob(args.telemetry or "")):
                 samples.extend(read_telemetry(pth) or [])
-            frame = render_fleet(healths, samples or None)
+            frame = render_fleet(healths, samples or None,
+                                 stale=stale_workers(healths))
         else:
             health = read_health(args.health)
             samples = read_telemetry(args.telemetry) \

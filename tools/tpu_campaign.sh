@@ -305,4 +305,19 @@ run_step fleet_soak "campaign/fleet_soak_$R.jsonl" \
   "campaign/fleet_soak_stderr_$R.log" 3600 \
   python tools/fleet_soak.py
 
+# 15. fleet flight recorder (ISSUE 16 observability): a fresh 2-worker
+# journaled queue with one SIGKILL cycle, replayed by
+# tools/fleet_trace.py into ONE Perfetto-loadable trace — per-job
+# tracks must tile submit->commit gap-free (queue-wait / claim /
+# steal-gap / run segments), the measured steal latency must sit
+# within the fleet_soak 2x-lease-TTL bound, the s2c_sched_* queue-wait
+# summary must be populated from journal timestamps, and the drained
+# queue must stay byte-identical to a chaos-free baseline (the flight
+# recorder observes; it must not perturb).  The leg JSONL's summary
+# row is what check_perf_claims.py lints when cited.
+# CPU-fallback harness proof: campaign/fleet_trace_r06_cpufallback.jsonl
+run_step fleet_trace "campaign/fleet_trace_$R.jsonl" \
+  "campaign/fleet_trace_stderr_$R.log" 1800 \
+  python tools/fleet_trace.py --leg --out -
+
 echo "$(date +%H:%M:%S) campaign complete" >> "$LOG"

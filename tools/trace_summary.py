@@ -1,8 +1,8 @@
 #!/usr/bin/env python3
 """One-shot trace triage: where did the time actually go?
 
-Usage: python tools/trace_summary.py <trace.json> [-n TOP]
-                                     [--inclusive | --flame]
+Usage: python tools/trace_summary.py <trace.json> [trace2.json ...]
+                                     [-n TOP] [--inclusive | --flame]
 
 Reads ``ph: "X"`` complete events from a Chrome/Perfetto trace-event
 JSON (the CLI's ``--trace-out`` artifact) and prints the top-N span
@@ -22,10 +22,21 @@ speedscope's "collapsed stacks" importer:
 
     python tools/trace_summary.py trace.json --flame > out.collapsed
     flamegraph.pl out.collapsed > flame.svg
+
+Multiple traces (or a quoted glob — ``'run/trace_*.json'`` is expanded
+here for shells that don't) merge into ONE ranking, so an N-worker
+fleet run (ISSUE 16's per-worker ``--trace-out`` artifacts) needs one
+invocation, not N.  In merged mode each file's spans are kept on their
+own thread keys (two workers' tid 0 must not nest into each other) and
+``--flame`` paths gain a ``<worker>;`` stack root — the worker id from
+the trace's ``s2c`` metadata block when stamped, else the file's
+basename — so a fleet flamegraph splits per worker at the base.
 """
 
 import argparse
+import glob
 import json
+import os
 import sys
 from collections import defaultdict
 
@@ -35,6 +46,32 @@ def load_events(path):
         obj = json.load(fh)
     events = obj["traceEvents"] if isinstance(obj, dict) else obj
     return [e for e in events if e.get("ph") == "X"]
+
+
+def load_trace(path):
+    """(complete-spans, worker-label) for one trace file; the label is
+    the ``s2c`` metadata block's worker id when the serve runner
+    stamped one, else the file basename."""
+    with open(path) as fh:
+        obj = json.load(fh)
+    events = obj["traceEvents"] if isinstance(obj, dict) else obj
+    meta = obj.get("s2c") or {} if isinstance(obj, dict) else {}
+    worker = str(meta.get("worker") or "") \
+        or os.path.splitext(os.path.basename(path))[0]
+    return [e for e in events if e.get("ph") == "X"], worker
+
+
+def load_merged(paths):
+    """Spans from N trace files on disjoint thread keys (file index
+    paired into the tid), each tagged with its worker label."""
+    spans = []
+    for fi, path in enumerate(paths):
+        s, worker = load_trace(path)
+        for e in s:
+            e["tid"] = (fi, e.get("tid", 0))
+            e["_worker"] = worker
+        spans.extend(s)
+    return spans
 
 
 def _stack_pass(spans):
@@ -97,7 +134,10 @@ def collapsed_stacks(spans):
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("trace", help="trace-event JSON (--trace-out output)")
+    p.add_argument("trace", nargs="+",
+                   help="trace-event JSON file(s) or glob(s) "
+                        "(--trace-out output); several merge into one "
+                        "ranking")
     p.add_argument("-n", "--top", type=int, default=5,
                    help="rows to print (default 5)")
     p.add_argument("--inclusive", action="store_true",
@@ -110,12 +150,28 @@ def main(argv=None):
                         "top-N table")
     args = p.parse_args(argv)
 
-    spans = load_events(args.trace)
+    paths = []
+    for pat in args.trace:
+        hits = sorted(glob.glob(pat))
+        paths.extend(hits if hits else [pat])
+    merged = len(paths) > 1
+    spans = load_merged(paths) if merged else load_events(paths[0])
     if args.flame:
         if not spans:
             print("no complete spans in trace", file=sys.stderr)
             return 1
-        for path, self_us in sorted(collapsed_stacks(spans).items()):
+        if merged:
+            # worker; stack root: a fleet flamegraph splits per
+            # worker at the base instead of smearing N workers'
+            # same-named phases into one frame
+            agg = defaultdict(float)
+            for spath, e, acc in _stack_pass(spans):
+                agg[f"{e['_worker']};{spath}"] += \
+                    max(0.0, e["dur"] - acc[0])
+            stacks = dict(agg)
+        else:
+            stacks = collapsed_stacks(spans)
+        for path, self_us in sorted(stacks.items()):
             n = int(round(self_us))
             if n > 0:
                 print(f"{path} {n}")
