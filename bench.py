@@ -37,7 +37,11 @@ batching leg: warm-serial vs warm-packed jobs/sec over one small-job
 queue, default 16; 0 disables), BENCH_INCR_PCT (incremental-consensus
 leg: +N% reads on a warm per-reference count cache vs the cold
 combined job, default 10; 0 disables; BENCH_INCR_READS sizes the
-base), BENCH_FULL_OUT / BENCH_TAG (write the
+base), BENCH_FLEET_JOBS / BENCH_FLEET_WORKERS (fleet queue-drain leg,
+defaults 6 / 2; 0 jobs disables), BENCH_STREAM_WAVES
+(streaming-session leg: the same reads absorbed live in N journaled
+waves with read-until early stop vs the one-shot cold job, default
+10; 0 disables), BENCH_FULL_OUT / BENCH_TAG (write the
 complete result object — every row, untruncated — to this path / to
 BENCH_<tag>.full.json, so downstream consumers stop recovering rows
 from head-truncated stdout captures).
@@ -740,6 +744,46 @@ def serve_fleet_leg(n_jobs):
     return row
 
 
+def streaming_leg(n_waves):
+    """The streaming-session row (ISSUE 17 tentpole): the same reads
+    absorbed live in N journaled waves (serve/session.py) vs the
+    one-shot cold batch job.  ``jax_sec`` is the session wall and
+    ``vs_baseline`` the cold/stream ratio (bigger = better, like
+    every row) so the regression gate judges the streaming series
+    with the same bands; the row also carries the <=1.3x
+    ``stream_cost_ratio`` target the ISSUE pins, the stability
+    early-stop wave (the read-until verdict), and the honest
+    ``stream_vs_warm`` durability bill vs a warm in-process one-shot."""
+    from sam2consensus_tpu.serve.benchmark import run_streaming_bench
+
+    res = run_streaming_bench(n_waves=n_waves, log=log)
+    s = res["summary"]
+    row = {
+        "config": "streaming",
+        "waves": s["n_waves"],
+        "waves_fed": s["waves_fed"],
+        "reads": s["n_reads"],
+        "host_cores": s["host_cores"],
+        "jax_sec": s["stream_sec"],
+        "cold_sec": s["cold_sec"],
+        "warm_one_shot_sec": s["warm_one_shot_sec"],
+        "vs_baseline": (round(s["cold_sec"] / s["stream_sec"], 3)
+                        if s["stream_sec"] else 0.0),
+        "vs_baseline_kind": "one_shot_cold",
+        "stream_cost_ratio": s["stream_cost_ratio"],
+        "stream_vs_warm": s["stream_vs_warm"],
+        "early_stop_wave": s["early_stop_wave"],
+        "stable": s["stable"],
+        "identical": s["digest_matches_cold"],
+    }
+    log(f"[streaming] {s['waves_fed']}/{s['n_waves']} wave(s) "
+        f"{s['stream_sec']}s vs cold {s['cold_sec']}s = "
+        f"{s['stream_cost_ratio']}x of cold (target <=1.3x), "
+        f"early_stop_wave={s['early_stop_wave']}, "
+        f"identical={s['digest_matches_cold']}")
+    return row
+
+
 def full_artifact_path():
     """Destination for the complete (untruncated) result object:
     BENCH_FULL_OUT wins, else BENCH_TAG -> BENCH_<tag>.full.json next
@@ -824,6 +868,16 @@ def main():
                 log(f"[serve_fleet] FAILED: {type(exc).__name__}: "
                     f"{exc}")
                 rows.append({"config": "serve_fleet",
+                             "error": repr(exc)})
+        # streaming-session leg: live waves + read-until early stop vs
+        # the one-shot cold job (BENCH_STREAM_WAVES=0 disables)
+        n_waves = int(os.environ.get("BENCH_STREAM_WAVES", "10"))
+        if n_waves > 0 and (not only or "streaming" in only):
+            try:
+                rows.append(streaming_leg(n_waves))
+            except Exception as exc:
+                log(f"[streaming] FAILED: {type(exc).__name__}: {exc}")
+                rows.append({"config": "streaming",
                              "error": repr(exc)})
         # incremental-consensus leg: +N% reads on a warm reference vs
         # the cold combined job (BENCH_INCR_PCT=0 disables)

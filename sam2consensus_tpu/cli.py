@@ -351,9 +351,10 @@ def build_serve_parser() -> argparse.ArgumentParser:
                     "backend across every input (jit reuse + cross-job "
                     "pipelining); outputs per job like N one-shot runs")
     p.add_argument("-i", "--input", dest="inputs", action="append",
-                   required=True,
+                   default=None,
                    help="SAM input (repeatable; one job per input, run "
-                        "in order)")
+                        "in order).  Required unless --ingest-port "
+                        "starts a streaming-session server instead")
     p.add_argument("-c", "--consensus-thresholds", dest="thresholds",
                    type=str, default="0.25")
     p.add_argument("-n", dest="n", type=int, default=0)
@@ -510,6 +511,50 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "large committed queue is O(stat); full "
                         "re-hashes every committed output "
                         "unconditionally")
+    # --- streaming sessions (serve/{session,stream_server}.py) ---
+    p.add_argument("--ingest-port", dest="ingest_port", type=int,
+                   default=None,
+                   help="streaming-session mode (requires --journal; "
+                        "serve/stream_server.py): serve the live wave "
+                        "ingest API on 127.0.0.1:PORT (0 = ephemeral, "
+                        "logged at startup) instead of draining a "
+                        "fixed -i queue.  Sessions are journal "
+                        "entities under claim/lease semantics: a "
+                        "killed worker's open sessions are stolen by "
+                        "a peer sharing the journal, replaying every "
+                        "journaled-but-unabsorbed wave — zero lost, "
+                        "zero double-counted reads")
+    p.add_argument("--stability-waves", dest="stability_waves",
+                   type=int, default=3,
+                   help="consecutive waves the consensus digest must "
+                        "survive unchanged before the session emits "
+                        "its stability verdict (the read-until "
+                        "signal; default 3, must be >= 1)")
+    p.add_argument("--revote-debounce", dest="revote_debounce",
+                   type=float, default=0.0,
+                   help="seconds to coalesce arriving waves before "
+                        "re-voting (default 0 = re-vote on every "
+                        "wave; must be >= 0).  Debounced waves are "
+                        "journaled + ACKed 202 immediately and "
+                        "absorbed in arrival order on the cadence")
+    p.add_argument("--ingest-max-body", dest="ingest_max_body",
+                   type=int, default=None,
+                   help="max wave body bytes the ingest endpoint "
+                        "accepts (default 64 MiB); larger uploads "
+                        "answer 413 before buffering")
+    p.add_argument("--ingest-timeout", dest="ingest_timeout",
+                   type=float, default=None,
+                   help="per-request socket deadline seconds on the "
+                        "ingest endpoint (default 10); a client "
+                        "silent this long mid-body answers 408 and "
+                        "frees the handler thread")
+    p.add_argument("--ingest-max-pending", dest="ingest_max_pending",
+                   type=int, default=None,
+                   help="per-session journaled-but-unabsorbed wave "
+                        "bound (default 64): a session at its bound "
+                        "answers 429 + Retry-After (admission "
+                        "backpressure) instead of buffering without "
+                        "limit")
     p.add_argument("--job-timeout", dest="job_timeout", type=float,
                    default=None,
                    help="per-job wall-clock deadline in seconds "
@@ -620,6 +665,95 @@ def build_serve_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _serve_sessions(args: argparse.Namespace, echo) -> int:
+    """``s2c serve --journal DIR --ingest-port P``: host streaming
+    consensus sessions behind the live ingest endpoint until told to
+    stop (SIGTERM / SIGINT / ctrl-C) — there is no fixed queue to
+    drain.  Open sessions survive the stop: their journaled waves are
+    replayed by whichever worker (this one restarted, or a fleet peer)
+    claims them next."""
+    import copy
+    import logging
+    import signal
+    import time
+
+    from .serve import ServeRunner
+    from .serve.session import (DEFAULT_MAX_PENDING, SessionManager)
+    from .serve.stream_server import (DEFAULT_MAX_BODY,
+                                      DEFAULT_TIMEOUT_S, IngestServer)
+
+    base_args = copy.copy(args)
+    base_args.filename = ""             # per-session prefix, not per-job
+    base_args.prefix = ""
+    base_cfg = config_from_args(base_args)
+
+    runner = ServeRunner(prewarm=args.prewarm,
+                         decode_ahead=args.decode_ahead, echo=echo,
+                         journal_dir=args.journal,
+                         job_timeout=args.job_timeout,
+                         stall_timeout=args.stall_timeout,
+                         max_queue=args.max_queue,
+                         tenant_quota=args.tenant_quota,
+                         health_out=args.health_out,
+                         fault_inject=args.fault_inject,
+                         telemetry_out=args.telemetry_out,
+                         telemetry_port=args.telemetry_port,
+                         telemetry_interval=args.telemetry_interval,
+                         slo=args.slo,
+                         profile_capture_dir=args.profile_capture_dir,
+                         mem_budget=args.mem_budget,
+                         worker_id=args.worker_id,
+                         lease_ttl=args.lease_ttl,
+                         verify_outputs=args.verify_outputs)
+    manager = SessionManager(
+        runner, base_cfg,
+        stability_waves=args.stability_waves,
+        revote_debounce=args.revote_debounce,
+        max_pending=(args.ingest_max_pending
+                     if args.ingest_max_pending is not None
+                     else DEFAULT_MAX_PENDING))
+    runner.sessions = manager           # health snapshot `sessions` gate
+    server = IngestServer(
+        manager, port=args.ingest_port,
+        max_body=(args.ingest_max_body
+                  if args.ingest_max_body is not None
+                  else DEFAULT_MAX_BODY),
+        timeout=(args.ingest_timeout
+                 if args.ingest_timeout is not None
+                 else DEFAULT_TIMEOUT_S))
+    echo(f"\nStreaming sessions on 127.0.0.1:{server.port}"
+         + (f" as fleet worker {args.worker_id!r}"
+            if args.worker_id else "")
+         + f" (journal: {runner.journal.root})\n")
+
+    stop = {"flag": False}
+
+    def _stop(signum, frame):
+        stop["flag"] = True
+
+    prev = signal.signal(signal.SIGTERM, _stop)
+    try:
+        while not stop["flag"]:
+            try:
+                manager.tick()
+                runner.telemetry_tick()
+            except Exception as exc:    # the loop must outlive anything
+                logging.getLogger("sam2consensus_tpu.serve").warning(
+                    "session tick failed (%s: %s)",
+                    type(exc).__name__, exc)
+            time.sleep(0.1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        server.close()
+        runner.close()
+    n_open = len(manager.sessions)
+    echo(f"Ingest stopped; {n_open} open session(s) remain journaled "
+         f"for takeover.\n")
+    return 0
+
+
 def serve_main(argv: List[str]) -> int:
     """``s2c serve -i a.sam -i b.sam [...]``: run every input through
     one warm server; exit 0 iff every job succeeded."""
@@ -693,6 +827,49 @@ def serve_main(argv: List[str]) -> int:
             "so the cache would be a silent no-op)")
     if args.lease_ttl is not None and not args.lease_ttl > 0:
         raise SystemExit("error: --lease-ttl must be > 0")
+    # --- streaming-session cross-checks: a typo'd session flag must
+    # fail the server start, not surface as a deep mid-wave error
+    # (same up-front discipline as parse_slo / --fault-inject)
+    session_mode = args.ingest_port is not None
+    if session_mode and not args.journal:
+        raise SystemExit(
+            "error: --ingest-port requires --journal (sessions are "
+            "journal entities — the durable wave intent log IS the "
+            "crash-safety story)")
+    if session_mode and args.inputs:
+        raise SystemExit(
+            "error: --ingest-port does not compose with -i/--input "
+            "(waves arrive over the ingest API, not a fixed queue)")
+    if not session_mode and not args.inputs:
+        raise SystemExit(
+            "error: at least one -i/--input is required (or "
+            "--ingest-port to serve streaming sessions)")
+    if session_mode and args.batch != "off":
+        raise SystemExit(
+            "error: --ingest-port does not compose with --batch "
+            "(waves of one session must absorb serially in arrival "
+            "order; packed batches would break the count-bank rule)")
+    if session_mode and args.incremental:
+        raise SystemExit(
+            "error: --ingest-port does not compose with --incremental "
+            "(sessions ARE the incremental path — per-wave "
+            "checkpoint-seeded absorption, journal-fenced)")
+    if session_mode and cache_on:
+        raise SystemExit(
+            "error: --ingest-port does not compose with --count-cache "
+            "(session count state lives in per-session checkpoint "
+            "homes under the journal, not the LRU cache)")
+    if args.stability_waves < 1:
+        raise SystemExit("error: --stability-waves must be >= 1")
+    if args.revote_debounce < 0:
+        raise SystemExit("error: --revote-debounce must be >= 0")
+    if args.ingest_max_body is not None and args.ingest_max_body <= 0:
+        raise SystemExit("error: --ingest-max-body must be > 0")
+    if args.ingest_timeout is not None and not args.ingest_timeout > 0:
+        raise SystemExit("error: --ingest-timeout must be > 0")
+    if args.ingest_max_pending is not None \
+            and args.ingest_max_pending < 1:
+        raise SystemExit("error: --ingest-max-pending must be >= 1")
     if args.fault_inject:
         from .resilience.faultinject import parse_spec
 
@@ -700,6 +877,9 @@ def serve_main(argv: List[str]) -> int:
             parse_spec(args.fault_inject)
         except ValueError as exc:
             raise SystemExit(f"error: {exc}") from None
+
+    if session_mode:
+        return _serve_sessions(args, echo)
 
     specs = []
     for k, path in enumerate(args.inputs):

@@ -391,6 +391,80 @@ def sched_metrics(jobs: Dict[str, JobLifecycle]) -> dict:
             "workers": workers, "wall_sec": round(wall, 6)}
 
 
+def session_wave_tracks(events: List[dict]) -> Dict[str, dict]:
+    """Streaming-session wave tracks from raw journal events
+    (serve/session.py's vocabulary: ``session_open`` /
+    ``wave_received`` / ``wave_absorbed`` / ``wave_rejected`` /
+    ``session_stable`` / ``session_closed``).
+
+    Per session: one track entry per wave with its received->absorbed
+    latency (the durable-intent-to-counted gap — a wave replayed after
+    a steal shows the steal's takeover window here), the absorbing
+    worker, any DATA-class rejection, plus session-level marks
+    (opened/stable/closed) and the claim handoffs (``claimed`` events
+    on the session key from successive workers — each handoff past the
+    first is a steal or restart takeover).  Offline twin of the live
+    ``s2c_session_*`` exposition family, same journal truth source as
+    :func:`assemble`."""
+    sessions: Dict[str, dict] = {}
+
+    def _view(sid: str) -> dict:
+        s = sessions.get(sid)
+        if s is None:
+            s = sessions[sid] = {
+                "tenant": "", "opened_t": None, "closed_t": None,
+                "stable_t": None, "stable_wave": None,
+                "waves": {}, "handoffs": []}
+        return s
+
+    def _wave(s: dict, rec: dict) -> dict:
+        n = int(rec.get("wave", 0))
+        w = s["waves"].get(n)
+        if w is None:
+            w = s["waves"][n] = {
+                "received_t": None, "absorbed_t": None,
+                "absorb_latency_sec": None, "worker": "",
+                "rejected": None, "sha": str(rec.get("sha", ""))}
+        return w
+
+    for rec in events:
+        ev = rec.get("ev")
+        sid = rec.get("key")
+        if ev == "_corrupt" or not sid:
+            continue
+        t = _t(rec)
+        if ev == "session_open":
+            s = _view(sid)
+            s["opened_t"] = t
+            s["tenant"] = str(rec.get("tenant", "") or "")
+        elif ev == "wave_received":
+            w = _wave(_view(sid), rec)
+            if w["received_t"] is None:     # first intent wins
+                w["received_t"] = t
+        elif ev == "wave_absorbed":
+            w = _wave(_view(sid), rec)
+            if w["absorbed_t"] is None:     # exactly-once: first wins
+                w["absorbed_t"] = t
+                w["worker"] = str(rec.get("worker", "") or "")
+                if w["received_t"] is not None:
+                    w["absorb_latency_sec"] = round(
+                        t - w["received_t"], 6)
+        elif ev == "wave_rejected":
+            w = _wave(_view(sid), rec)
+            w["rejected"] = str(rec.get("reason", "") or "rejected")
+        elif ev == "session_stable":
+            s = _view(sid)
+            if s["stable_t"] is None:
+                s["stable_t"] = t
+                s["stable_wave"] = rec.get("wave")
+        elif ev == "session_closed":
+            _view(sid)["closed_t"] = t
+        elif ev == "claimed" and sid in sessions:
+            sessions[sid]["handoffs"].append(
+                {"worker": str(rec.get("worker", "") or ""), "t": t})
+    return sessions
+
+
 # =========================================================================
 # Chrome/Perfetto assembly
 # =========================================================================

@@ -126,6 +126,19 @@ def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
     qg = snap["gauges"].get("quarantine/summary")
     if qg is not None and qg.get("info"):
         ingest["quarantine/summary"] = qg["info"]
+    # streaming sessions (serve/session.py + serve/stream_server.py):
+    # wave absorb/reject/steal tallies plus the front door's request
+    # counters — the manifest's record of the live-ingest plane
+    # (empty dict outside session mode).  ``ingest/bad_records*``
+    # stays in the ingest section above: that family is the per-job
+    # tolerant-decode taxonomy, not the network front door
+    sessions = {k: v for k, v in counters.items()
+                if k.startswith("session/")
+                or (k.startswith("ingest/")
+                    and not k.startswith("ingest/bad_records"))}
+    for name, g in snap["gauges"].items():
+        if name.startswith("session/"):
+            sessions[name] = g["value"]
     # memory plane (observability/memplane.py): per-family live/peak
     # gauges, the peak-tracked ratchet, process/device watermarks and
     # any OOM-dump tally — the manifest answers "what did this run
@@ -162,6 +175,7 @@ def build_manifest(registry, ledger_records, meta: Optional[dict] = None,
         "wire": wire,
         "serve": serve,
         "ingest": ingest,
+        "sessions": sessions,
         "memory": memory,
         "lifecycle": lifecycle,
         "drift_events": int(counters.get("drift/events", 0)),

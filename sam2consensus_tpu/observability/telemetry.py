@@ -421,6 +421,59 @@ _HELP = {
                                  "(busy-seconds / uptime; the "
                                  "flight recorder's per-worker "
                                  "occupancy lane, live).",
+    # streaming sessions (serve/session.py + serve/stream_server.py):
+    # the s2c_session_* / s2c_ingest_* families — the live-ingest plane
+    "s2c_session_opened_total": "Streaming sessions opened (lifetime).",
+    "s2c_session_closed_total": "Streaming sessions closed cleanly "
+                                "(final outputs written).",
+    "s2c_session_waves_total": "Read waves journaled as received "
+                               "(durable intent precedes the ACK).",
+    "s2c_session_waves_absorbed_total": "Waves absorbed exactly once "
+                                        "into session count state "
+                                        "(wave_absorbed journaled, "
+                                        "lease-fenced).",
+    "s2c_session_waves_rejected_total": "Waves rejected DATA-class "
+                                        "(malformed/poison/sha "
+                                        "mismatch; quarantined, never "
+                                        "retried).",
+    "s2c_session_waves_shed_total": "Waves shed by admission "
+                                    "backpressure (429 + Retry-After; "
+                                    "pending backlog at its bound).",
+    "s2c_session_torn_waves_total": "Spooled wave bodies whose hash no "
+                                    "longer matched the journaled "
+                                    "intent (re-requested, never "
+                                    "absorbed).",
+    "s2c_session_revotes_total": "Consensus re-votes over already-"
+                                 "absorbed counts (zero re-ingest).",
+    "s2c_session_stability_events_total": "Sessions whose consensus "
+                                          "digest survived N "
+                                          "consecutive waves unchanged "
+                                          "(the read-until verdict).",
+    "s2c_session_steals_total": "Orphaned sessions this worker stole "
+                                "lease-and-all from a dead/frozen "
+                                "peer (journaled waves replayed; "
+                                "zero lost, zero double-counted).",
+    "s2c_session_recovered_total": "Sessions rebuilt from journal "
+                                   "replay (restart resume + fleet "
+                                   "steals).",
+    "s2c_session_reads_absorbed_total": "Reads absorbed across all "
+                                        "sessions (lifetime).",
+    "s2c_session_open": "Streaming sessions currently open on this "
+                        "worker.",
+    "s2c_session_pending_waves": "Journaled-but-unabsorbed waves "
+                                 "across open sessions (the "
+                                 "backpressure gauge).",
+    "s2c_ingest_requests_total": "HTTP requests the ingest endpoint "
+                                 "answered (lifetime).",
+    "s2c_ingest_rejected_total": "Ingest requests rejected with a "
+                                 "typed status (+ per-reason "
+                                 "children).",
+    "s2c_ingest_bytes_total": "Wave/header body bytes the ingest "
+                              "endpoint accepted.",
+    "s2c_ingest_slow_clients_total": "Requests killed by the "
+                                     "per-request socket deadline "
+                                     "(408; the handler thread is "
+                                     "freed, never wedged).",
 }
 
 

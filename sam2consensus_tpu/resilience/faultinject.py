@@ -32,6 +32,20 @@ site                  boundary
                       returns; the serve watchdog (serve/runner.py) is
                       what is supposed to notice.  The rule's kind is
                       what the sleep eventually raises, if it wakes.
+``session_wave_append``a streaming session's per-wave absorb step
+                      (serve/session.py), fired after the durable
+                      ``wave_received`` intent but before the wave's
+                      ``wave_absorbed`` commit — the crash window the
+                      count-bank rule exists for: the wave's partition
+                      is invalidated whole and replayed, never
+                      half-counted
+``session_revote``    a streaming session's re-vote dispatch (the
+                      scatter-new-reads + vote path that never
+                      re-ingests; serve/session.py)
+``ingest_conn``       the network front door's per-request handling
+                      (serve/stream_server.py) — models a connection
+                      torn mid-request; the server must answer a typed
+                      5xx (or drop the socket) and stay alive
 ``mem_alloc``         the device count-tensor allocation boundary
                       (ops/pileup.py ``PileupAccumulator``) — the
                       memory plane's OOM-forensics test hook
@@ -78,7 +92,8 @@ from typing import Dict, List, Optional
 SITES = ("device_put", "pileup_dispatch", "accumulate", "vote",
          "insertion_build", "link_probe", "wire_encode",
          "serve_decode_ahead", "journal_write", "job_hang",
-         "bam_inflate", "ingest_decode_shard", "mem_alloc")
+         "bam_inflate", "ingest_decode_shard", "mem_alloc",
+         "session_wave_append", "session_revote", "ingest_conn")
 
 #: how long a firing ``job_hang`` rule sleeps before raising (seconds);
 #: far past any sane --job-timeout, so the watchdog always wins the race

@@ -26,7 +26,10 @@ layers:
   tenant demotion, count ``serve/admission_poison``) apart from "this
   code path is broken".  Marked by a ``data_error`` attribute on the
   exception (``ingest/badrecords.py``), same marker protocol as
-  ``transient``.
+  ``transient``.  Streaming-session wave rejections ride the same
+  marker (``serve/session.SessionError`` with a 422 status): a
+  malformed or torn wave is quarantined and answered with a typed
+  reason — never retried, never a rung demotion, never a wedge.
 
 The classifier is name/message-based for the jax runtime's exception
 types (``XlaRuntimeError`` carries its gRPC-style status in the

@@ -83,6 +83,20 @@ ride one device dispatch sequence, with per-job count partitions
 extracted for byte-identical per-job consensus, per-job
 observability/journal/SLO scoping intact, and any fault inside a
 packed phase demoting only that batch back to the serial path.
+
+Streaming sessions (:mod:`.session` + :mod:`.stream_server`,
+``--ingest-port P`` on a ``--journal`` server): long-lived per-tenant
+consensus sessions fed by live read *waves* over a fault-tolerant
+HTTP ingest endpoint.  Every wave is journaled as durable intent
+BEFORE it is ACKed, absorbed exactly once through a checkpoint-shaped
+seed/capture handoff (any mid-wave fault invalidates and replays the
+wave whole — the count-bank rule), re-voted on a debounced cadence,
+and watched for early stability: a consensus digest unchanged N
+consecutive waves emits the read-until verdict so the basecaller can
+stop sequencing.  Sessions are journal entities under the same
+claim/lease semantics as fleet jobs — a SIGKILL'd worker's open
+sessions are stolen lease-and-all by a peer that replays every
+journaled-but-unabsorbed wave: zero lost, zero double-counted reads.
 """
 
 from .admission import AdmissionController
@@ -94,10 +108,13 @@ from .packing import (PackPlan, extract_counts, extract_member,
                       merge_batches, plan_pack)
 from .runner import JobResult, JobSpec, ServeRunner, submit_jobs
 from .scheduler import BatchScheduler, parse_batch_mode
+from .session import SessionError, SessionManager, consensus_digest
+from .stream_server import IngestServer
 
 __all__ = ["JobSpec", "JobResult", "ServeRunner", "submit_jobs",
            "JobJournal", "job_key", "AdmissionController",
            "health_snapshot", "BatchScheduler", "parse_batch_mode",
            "PackPlan", "plan_pack", "merge_batches", "extract_counts",
            "extract_member", "CountCache", "parse_budget",
-           "reference_key", "FleetCoordinator"]
+           "reference_key", "FleetCoordinator", "SessionManager",
+           "SessionError", "IngestServer", "consensus_digest"]

@@ -46,6 +46,11 @@ REASON_TENANT_QUOTA = "tenant_quota"
 #: host that fits (or after raising the budget) instead of discovering
 #: the OOM post-mortem
 REASON_CAPACITY = "capacity"
+#: streaming-session backpressure (serve/stream_server.py): the
+#: session's journaled-but-unabsorbed wave backlog is at its bound —
+#: the wave is rejected with HTTP 429 + Retry-After instead of being
+#: buffered without limit (reject-with-reason, never wedge)
+REASON_BACKPRESSURE = "backpressure"
 
 
 @dataclass
@@ -129,6 +134,25 @@ class AdmissionController:
         if tenant:
             self._window_by_tenant[tenant] = \
                 self._window_by_tenant.get(tenant, 0) + 1
+        return Decision(True)
+
+    def price_wave(self, tenant: str = "", body_bytes: int = 0,
+                   pending_waves: int = 0,
+                   max_pending: int = 0) -> Decision:
+        """One streaming wave's admission verdict (serve/session.py).
+
+        Waves are NOT window-scoped jobs — a session absorbs thousands
+        over its lifetime — so the queue/tenant window counters are
+        left alone; the gates that matter here are the session's
+        unabsorbed-wave backlog (``max_pending`` -> REASON_BACKPRESSURE,
+        the 429 + Retry-After signal) and the same capacity plane the
+        job path prices against: a wave whose body alone exceeds the
+        server's ``--mem-budget`` could never be absorbed whole."""
+        if max_pending and pending_waves >= max_pending:
+            return Decision(False, reason=REASON_BACKPRESSURE)
+        if self.mem_budget and body_bytes \
+                and body_bytes > self.mem_budget:
+            return Decision(False, reason=REASON_CAPACITY)
         return Decision(True)
 
     def pin_rung(self, tenant: str) -> Optional[str]:

@@ -625,3 +625,157 @@ def run_fleet_bench(n_jobs: int = 6, n_reads: int = 4000,
             f"{len(workers)} workers {fleet_sec:.1f}s = {speedup}x "
             f"({os.cpu_count()} host core(s)), identical={identical}")
     return {"rows": rows, "summary": summary}
+
+
+def run_streaming_bench(n_waves: int = 10, n_reads: int = 40000,
+                        contig_len: int = 8000, read_len: int = 100,
+                        stability_waves: int = 3,
+                        per_process_timeout: float = 600.0,
+                        log: Optional[Callable] = None) -> dict:
+    """Streaming-session benchmark (ISSUE 17): the SAME reads absorbed
+    live in ``n_waves`` waves through a journaled session
+    (serve/session.py) vs the one-shot COLD batch job.
+
+    COLD here is what cold means everywhere in this module: the
+    one-shot CLI in a fresh subprocess — the basecaller's actual
+    alternative to streaming is "wait for the run to end, then launch
+    the batch job" (interpreter + jax import + compile + the whole
+    ingest).  ``stream_cost_ratio`` = session wall (open + waves +
+    close) / cold wall; target <=1.3x.  The summary also records
+    ``stream_vs_warm`` against a warm IN-PROCESS one-shot of the same
+    reads — the durability bill with no startup to hide behind: each
+    wave pays a journal fsync, an atomic checkpoint save and a full
+    vote tail, so at harness scale this ratio is well above 1 (the
+    artifact says so rather than burying it).
+
+    The READ-UNTIL dividend rides the same run: the session watches
+    its consensus digest and goes STABLE once it is unchanged
+    ``stability_waves`` consecutive waves — the bench stops feeding at
+    that verdict (``early_stop_wave``), which is the point of
+    streaming: the basecaller stops sequencing early.  The
+    early-stopped consensus must still match the full cold run at
+    SEQUENCE level (``consensus_digest`` — coverage annotations in
+    the headers legitimately differ when fewer reads were absorbed).
+    """
+    log = log or (lambda *a, **k: None)
+    from ..config import RunConfig
+    from .runner import JobSpec, ServeRunner
+    from .session import SessionManager, consensus_digest
+
+    rows = []
+    with tempfile.TemporaryDirectory() as tmp:
+        from ..utils.simulate import SimSpec, simulate
+
+        # low-noise corpus: stability must mean CONVERGED (a noisy
+        # corpus keeps near-threshold columns flapping wave to wave,
+        # and an early stop would then diverge from the full run)
+        spec = SimSpec(n_contigs=1, contig_len=contig_len,
+                       n_reads=n_reads, read_len=read_len,
+                       contig_len_jitter=0.0, seed=8300,
+                       contig_prefix="st_", sub_rate=0.002,
+                       n_rate=0.0005)
+        text = simulate(spec)
+        lines = text.splitlines(keepends=True)
+        header = "".join(l for l in lines if l.startswith("@"))
+        reads = [l for l in lines if not l.startswith("@")]
+        per = max(1, (len(reads) + n_waves - 1) // n_waves)
+        waves = ["".join(reads[i:i + per]).encode("utf-8")
+                 for i in range(0, len(reads), per)]
+        concat = os.path.join(tmp, "stream.sam")
+        with open(concat, "w") as fh:
+            fh.write(text)
+
+        # cold leg: the one-shot CLI in a fresh subprocess
+        cold_out = os.path.join(tmp, "out_cold")
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            _cold_cmd(concat, cold_out, "auto"),
+            env=dict(os.environ,
+                     PYTHONPATH=REPO + os.pathsep
+                     + os.environ.get("PYTHONPATH", "")),
+            capture_output=True, timeout=per_process_timeout)
+        cold_sec = time.monotonic() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cold one-shot failed rc={proc.returncode}: "
+                f"{proc.stderr.decode()[-800:]}")
+
+        noop = lambda *a, **k: None  # noqa: E731
+        cfg = RunConfig(prefix="", outfolder=tmp + os.sep)
+        # warm comparator runs on a journal-FREE runner (a journaled
+        # runner would dedup the timed job against the warmup commit);
+        # the session runs on a journaled one.  Same process, so the
+        # warmup's XLA compile warmth covers both.
+        batch_runner = ServeRunner(prewarm="off", decode_ahead=False,
+                                   echo=noop)
+        runner = ServeRunner(prewarm="off", decode_ahead=False,
+                             echo=noop,
+                             journal_dir=os.path.join(tmp, "journal"))
+        try:
+            def warm_shot(job_id):
+                t0 = time.monotonic()
+                res = batch_runner.submit_jobs(
+                    [JobSpec(filename=concat, config=cfg,
+                             job_id=job_id)])[0]
+                if res.error or res.fastas is None:
+                    raise RuntimeError(f"warm one-shot failed: "
+                                       f"{res.error}")
+                return time.monotonic() - t0, res.fastas
+
+            warm_shot("warmup")         # untimed: fills the jit cache
+            warm_sec, warm_fastas = warm_shot("warm")
+            full_digest = consensus_digest(warm_fastas)
+
+            manager = SessionManager(runner, cfg,
+                                     stability_waves=stability_waves,
+                                     revote_debounce=0.0)
+            t0 = time.monotonic()
+            sid = manager.open_session(header, tenant="bench")["sid"]
+            waves_fed = 0
+            early_stop_wave = None
+            for body in waves:
+                ack = manager.receive_wave(sid, body)
+                waves_fed += 1
+                if ack.get("stable"):
+                    early_stop_wave = ack.get("stable_wave")
+                    break
+            final = manager.close_session(sid)
+            stream_sec = time.monotonic() - t0
+        finally:
+            runner.close()
+            batch_runner.close()
+
+        ratio = round(stream_sec / cold_sec, 3) if cold_sec else 0.0
+        vs_warm = round(stream_sec / warm_sec, 3) if warm_sec else 0.0
+        digest_matches = final.get("digest") == full_digest
+        rows.append({"mode": "one_shot_cold", "waves": 1,
+                     "wall_sec": round(cold_sec, 3)})
+        rows.append({"mode": "one_shot_warm", "waves": 1,
+                     "wall_sec": round(warm_sec, 3)})
+        rows.append({"mode": "streaming", "waves": waves_fed,
+                     "wall_sec": round(stream_sec, 3),
+                     "early_stop_wave": early_stop_wave})
+        summary = {
+            "summary": True,
+            "n_waves": len(waves), "waves_fed": waves_fed,
+            "n_reads": n_reads, "contig_len": contig_len,
+            "stability_waves": stability_waves,
+            "cold_sec": round(cold_sec, 3),
+            "warm_one_shot_sec": round(warm_sec, 3),
+            "stream_sec": round(stream_sec, 3),
+            "stream_cost_ratio": ratio,
+            "stream_vs_warm": vs_warm,
+            "early_stop_wave": early_stop_wave,
+            "stable": early_stop_wave is not None,
+            "digest_matches_cold": digest_matches,
+            "host_cores": os.cpu_count(),
+            "ok": (digest_matches and early_stop_wave is not None
+                   and ratio <= 1.3),
+        }
+        log(f"[streaming_bench] {waves_fed}/{len(waves)} wave(s) "
+            f"{stream_sec:.2f}s vs cold one-shot {cold_sec:.2f}s = "
+            f"{ratio}x (target <=1.3x; vs warm in-process "
+            f"{warm_sec:.2f}s = {vs_warm}x), "
+            f"early_stop_wave={early_stop_wave}, "
+            f"digest_matches_cold={digest_matches}")
+    return {"rows": rows, "summary": summary}

@@ -27,6 +27,13 @@ partially-applied state must never seed the next job — and a job only
 (re-)inserts its entry after it commits.  An entry evicted while a job
 holds its seed is harmless: the job owns the state by reference, and
 re-inserts it (updated) at commit.
+
+Streaming sessions (serve/session.py, ``--ingest-port``) are this
+cache's journaled successor: the same seed/capture handoff and the
+same count-bank rule, but the warm state is a per-session checkpoint
+file under the journal instead of an LRU entry — durable across
+SIGKILL and stealable by fleet peers, which is why the two modes are
+mutually exclusive at the CLI (one authority per count bank).
 """
 
 from __future__ import annotations

@@ -44,6 +44,15 @@ tenant, so admission evidence (per-tenant in-flight counts, SLO e2e
 burn over committed ``elapsed_sec``) is computed from journal-visible
 fleet state rather than one worker's private counters —
 :meth:`FleetCoordinator.fleet_burn` / :meth:`seed_window_counts`.
+
+The claim/lease machinery is deliberately KEY-GENERIC: a key is any
+journal string, not only a job fingerprint.  Streaming sessions
+(serve/session.py) lease their session ids through the same
+``try_claim``/renew/reap protocol — a dead worker's open session is
+stolen lease-and-all and its unabsorbed waves replayed — with one
+asymmetry: a session's ``wave_absorbed`` commits are lease-fenced
+like job commits but NOT terminal (the lease stays open until
+``session_closed``).
 """
 
 from __future__ import annotations

@@ -176,6 +176,15 @@ def snapshot(runner) -> dict:
     cc = getattr(runner, "count_cache", None)
     if cc is not None:
         snap["count_cache"] = cc.stats()
+    # streaming sessions (serve/session.py): open sessions, wave
+    # absorb/reject tallies, stability verdicts and last-wave ages —
+    # the prober's view of the live-ingest plane.  A session whose
+    # last_wave_age_sec keeps growing while open is a stalled
+    # basecaller, not a stalled server (the ingest endpoint answers
+    # per request; nothing here blocks)
+    smgr = getattr(runner, "sessions", None)
+    if smgr is not None:
+        snap["sessions"] = smgr.health_summary()
     slo_obj = getattr(runner, "slo", None)
     if slo_obj or reg.value("slo/violations"):
         snap["slo"] = {

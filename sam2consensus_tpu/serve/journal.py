@@ -27,6 +27,13 @@ Event vocabulary (one JSON object per segment)::
     claimed       {job, key, worker, expires_unix}   # fleet: lease open
     lease_renewed {key, worker, expires_unix}        # fleet: TTL push
     lease_expired {key, worker, reaper}              # fleet: lease reap
+    session_open  {key, tenant, header_sha, refs}    # stream: session born
+    wave_received {key, wave, sha, reads, bytes}     # stream: durable intent
+    wave_absorbed {key, wave, sha, reads_total, digest
+                   [, worker, claim_seq]}            # stream: counted once
+    wave_rejected {key, wave, reason}                # stream: DATA-class audit
+    session_stable{key, wave, digest, waves_stable}  # stream: read-until
+    session_closed{key, worker, outputs, digest}     # stream: terminal
 
 A job's IDENTITY (``key``) hashes its input path plus every config
 field that changes the output bytes — so a restarted server given the
@@ -55,6 +62,27 @@ the state machine):
   expiry) closes the lease, so the next ``claimed`` can win — this is
   how a SIGKILL'd or frozen worker's in-flight job gets re-claimed;
 * ``committed``/``failed`` close the lease terminally.
+
+Streaming-session semantics (serve/session.py drives these; the
+journal is again just the durable state machine):
+
+* a SESSION is a journal entity whose key is its session id; it reuses
+  the claim/lease trio above unchanged (the lease code is key-generic),
+  so a SIGKILL'd worker's open session is reaped and stolen exactly
+  like an in-flight job;
+* ``wave_received`` is the durable INTENT — appended before any ingest
+  work, carrying the wave body's sha256, so a steal replays exactly the
+  waves whose intent exists but whose ``wave_absorbed`` does not;
+* ``wave_absorbed`` is the exactly-once COMMIT of one wave into the
+  session's count tensors.  It is lease-FENCED like ``committed``: once
+  the session key has ever been claimed, an absorb not matching the
+  open lease's (worker, claim_seq) lineage is VOID on replay — a zombie
+  mid-wave when its lease was stolen cannot double-count the wave;
+* ``wave_rejected`` audits a DATA-class wave (malformed body, torn
+  spool detected by sha mismatch) — never absorbed, never retried;
+* ``session_stable`` records the read-until verdict (consensus digest
+  unchanged for N consecutive waves); ``session_closed`` is terminal
+  and closes the lease like ``committed``.
 
 Replay cursor/compaction: every ``checkpoint_every`` appends the
 journal writes a ``checkpoint-NNNNNNNN.json`` summary segment — the
@@ -99,9 +127,13 @@ KEY_FIELDS = ("thresholds", "min_depth", "fill", "maxdel", "prefix",
               "nchar", "outfolder", "py2_compat", "strict")
 
 #: lifecycle events; ``rejected``/``resumed`` are audit-only, the
-#: ``claimed``/``lease_*`` trio is the fleet's work-stealing layer
+#: ``claimed``/``lease_*`` trio is the fleet's work-stealing layer,
+#: and the ``session_*``/``wave_*`` family is the streaming-session
+#: materialized view (serve/session.py)
 EVENTS = ("submitted", "started", "committed", "failed", "rejected",
-          "resumed", "claimed", "lease_renewed", "lease_expired")
+          "resumed", "claimed", "lease_renewed", "lease_expired",
+          "session_open", "wave_received", "wave_absorbed",
+          "wave_rejected", "session_stable", "session_closed")
 
 #: default appends between checkpoint segments (S2C_JOURNAL_CKPT_EVERY
 #: overrides; 0 disables).  Small enough that a busy fleet journal's
@@ -113,6 +145,15 @@ DEFAULT_CHECKPOINT_EVERY = 512
 #: another writer PUBLISHED a segment, so 64 losses in a row would
 #: need 64 concurrent appends landing between our rescans
 _APPEND_ATTEMPTS = 64
+
+
+def _session_view(st: "ReplayState", key: str) -> dict:
+    """The (lazily created) replay view of one streaming session."""
+    return st.sessions.setdefault(key, {
+        "status": "open", "waves": {}, "absorbed": {},
+        "absorb_counts": {}, "rejected": {}, "reads_total": 0,
+        "digest": "", "stable": False, "stable_wave": None,
+        "opened_t": 0.0, "last_wave_t": 0.0})
 
 
 def job_key(filename: str, config) -> str:
@@ -189,6 +230,14 @@ class ReplayState:
     #: survives restarts and steals where a process-local window epoch
     #: cannot
     submit_times: Dict[str, float] = field(default_factory=dict)
+    #: key -> streaming-session view (serve/session.py): status,
+    #: received waves (``waves``), effective absorbs (``absorbed``),
+    #: per-wave absorb counts (the duplication audit — anything > 1
+    #: means a wave was counted twice), rejected waves, cumulative
+    #: read count, last consensus digest and the stability verdict.
+    #: Wave numbers are STRING keys so the dict round-trips through
+    #: JSON checkpoints unchanged.
+    sessions: Dict[str, dict] = field(default_factory=dict)
     last_seq: int = 0
     events: int = 0
     corrupt_segments: int = 0
@@ -204,6 +253,7 @@ class ReplayState:
                 "claimed_ever": sorted(self.claimed_ever),
                 "stale_commits": self.stale_commits,
                 "submit_times": self.submit_times,
+                "sessions": self.sessions,
                 "last_seq": self.last_seq, "events": self.events,
                 "corrupt_segments": self.corrupt_segments}
 
@@ -220,6 +270,7 @@ class ReplayState:
         st.claimed_ever = set(blob.get("claimed_ever") or ())
         st.stale_commits = dict(blob.get("stale_commits") or {})
         st.submit_times = dict(blob.get("submit_times") or {})
+        st.sessions = dict(blob.get("sessions") or {})
         st.last_seq = int(blob.get("last_seq", 0))
         st.events = int(blob.get("events", 0))
         st.corrupt_segments = int(blob.get("corrupt_segments", 0))
@@ -465,6 +516,69 @@ class JobJournal:
             if cur is not None and cur["worker"] == rec.get("worker") \
                     and float(rec.get("t", 0.0)) >= cur["expires_unix"]:
                 del st.claims[key]
+        elif ev == "session_open":
+            s = _session_view(st, key)
+            s["status"] = "open"
+            s["opened_t"] = float(rec.get("t", 0.0))
+            if rec.get("tenant"):
+                st.tenants[key] = rec["tenant"]
+        elif ev == "wave_received":
+            s = _session_view(st, key)
+            w = str(rec.get("wave"))
+            # first intent wins: a re-request after a torn spool
+            # re-journals the SAME wave number with the same sha, and
+            # the duplicate intent is a no-op on replay
+            if w not in s["waves"]:
+                s["waves"][w] = {"sha": rec.get("sha", ""),
+                                 "reads": int(rec.get("reads", 0)),
+                                 "seq": int(rec.get("seq", 0)),
+                                 "t": float(rec.get("t", 0.0))}
+            s["last_wave_t"] = float(rec.get("t", 0.0))
+        elif ev == "wave_absorbed":
+            if key in st.claimed_ever:
+                # same lease fence as ``committed``: once a session's
+                # lifecycle uses leases, only the open lease's holder
+                # may absorb.  A zombie's stale absorb append (its
+                # lease stolen mid-wave, the thief already replayed
+                # the wave) is VOID — the count bank stays exact.
+                cur = st.claims.get(key)
+                cs = rec.get("claim_seq")
+                if cur is None or cur["worker"] != rec.get("worker") \
+                        or (cs is not None
+                            and cs != cur.get("claim_seq")):
+                    st.stale_commits[key] = \
+                        st.stale_commits.get(key, 0) + 1
+                    return
+            s = _session_view(st, key)
+            w = str(rec.get("wave"))
+            s["absorbed"][w] = {"sha": rec.get("sha", ""),
+                                "reads_total": int(
+                                    rec.get("reads_total", 0)),
+                                "worker": rec.get("worker", ""),
+                                "t": float(rec.get("t", 0.0))}
+            s["absorb_counts"][w] = s["absorb_counts"].get(w, 0) + 1
+            s["reads_total"] = int(rec.get("reads_total",
+                                           s["reads_total"]))
+            if rec.get("digest"):
+                s["digest"] = rec["digest"]
+            # an absorb is NOT terminal: the lease stays open for the
+            # next wave (unlike ``committed``, which closes it)
+        elif ev == "wave_rejected":
+            s = _session_view(st, key)
+            s["rejected"][str(rec.get("wave"))] = \
+                str(rec.get("reason", ""))
+        elif ev == "session_stable":
+            s = _session_view(st, key)
+            s["stable"] = True
+            s["stable_wave"] = rec.get("wave")
+            if rec.get("digest"):
+                s["digest"] = rec["digest"]
+        elif ev == "session_closed":
+            s = _session_view(st, key)
+            s["status"] = "closed"
+            if rec.get("digest"):
+                s["digest"] = rec["digest"]
+            st.claims.pop(key, None)    # terminal, like committed
 
     # -- checkpoint / compaction -------------------------------------------
     def _latest_checkpoint(self) -> Tuple[int, Optional[ReplayState]]:
@@ -642,12 +756,31 @@ class JobJournal:
         and ``submitted ⊆ committed`` at cycle end.  ``full=True``
         bypasses checkpoints (the compaction audit)."""
         st = self.replay(full=full)
-        return {"submitted": sorted(st.submitted),
-                "commit_counts": dict(st.commit_counts),
-                "duplicated": sorted(k for k, n in st.commit_counts.items()
-                                     if n > 1),
-                "lost": sorted(st.submitted - set(st.committed)),
-                # commits VOIDED by the lease fence (zombie appends):
-                # forensic — these are the protocol WORKING, not a
-                # duplication
-                "stale_commits": dict(st.stale_commits)}
+        out = {"submitted": sorted(st.submitted),
+               "commit_counts": dict(st.commit_counts),
+               "duplicated": sorted(k for k, n in st.commit_counts.items()
+                                    if n > 1),
+               "lost": sorted(st.submitted - set(st.committed)),
+               # commits VOIDED by the lease fence (zombie appends):
+               # forensic — these are the protocol WORKING, not a
+               # duplication
+               "stale_commits": dict(st.stale_commits)}
+        if st.sessions:
+            # streaming sessions: the same 0-lost / 0-duplicated audit
+            # at WAVE granularity — a rejected (DATA-class) wave is
+            # accounted, never "lost"
+            out["sessions"] = {
+                key: {"waves": len(s["waves"]),
+                      "absorbed": len(s["absorbed"]),
+                      "duplicated_waves": sorted(
+                          w for w, n in s["absorb_counts"].items()
+                          if n > 1),
+                      "lost_waves": sorted(
+                          w for w in s["waves"]
+                          if w not in s["absorbed"]
+                          and w not in s["rejected"]),
+                      "rejected_waves": sorted(s["rejected"]),
+                      "reads_total": s["reads_total"],
+                      "status": s["status"], "stable": s["stable"]}
+                for key, s in sorted(st.sessions.items())}
+        return out

@@ -40,6 +40,30 @@ def test_fuzz_ingest_smoke(tmp_path):
     assert len(summary["flavors"]) >= 6
 
 
+def test_fuzz_ingest_network_smoke(tmp_path):
+    """Tier-1 slice of the network-framing leg: the streaming-session
+    front door under malformed chunked framing, truncated bodies,
+    oversize declarations, slow trickle and mid-wave disconnects —
+    the server must answer the taxonomy (400/408/413/422), never hang,
+    and the journal audit must stay 0-lost/0-duplicated throughout."""
+    out = str(tmp_path / "fuzz_net.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, TOOL, "--network", "--smoke", "--no-progress",
+         "--out", out],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert proc.returncode == 0, \
+        f"network fuzz found issues:\n{proc.stdout}\n{proc.stderr}"
+    rows = [json.loads(ln) for ln in open(out)]
+    summary = rows[-1]
+    assert summary["kind"] == "summary"
+    assert summary["schema"] == "s2c-fuzz-ingest-net/1"
+    assert summary["mode"] == "smoke"
+    assert (summary["crashes"], summary["hangs"],
+            summary["divergences"]) == (0, 0, 0)
+    assert summary["flavors"] >= 8
+
+
 def test_fuzz_harness_catches_a_planted_divergence(tmp_path):
     """The harness itself must be able to FAIL: a mutant with a bare
     NUL in SEQ must register as bad_alphabet on every rung — feed the

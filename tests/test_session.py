@@ -1,0 +1,640 @@
+"""Streaming consensus sessions: crash-safe live ingest (ISSUE 17).
+
+The acceptance pins live here:
+
+* waves are journaled (``wave_received``) BEFORE the ACK and absorbed
+  exactly once — the journal audit proves 0 lost / 0 duplicated at
+  wave granularity, and compacted replay equals full replay;
+* a torn spool (sha mismatch vs the journaled intent) is re-requested,
+  never absorbed; a declared-sha mismatch is rejected 422 at receive;
+* the early-stability verdict fires when the consensus digest is
+  unchanged for N waves, and ``revote`` re-votes without new ingest;
+* the HTTP front door answers the full status taxonomy (404/405/413/
+  422/429) without dying, and backpressure carries Retry-After;
+* a SIGKILLed worker's session is stolen by a peer and replayed from
+  the journal, byte-identical to the one-shot run (subprocess smoke
+  here, the rotating soak is the slow test + the committed campaign
+  artifact campaign/session_soak_r06_cpufallback.jsonl);
+* session counters ride the lint-clean OpenMetrics exposition and the
+  health snapshot's ``sessions`` section;
+* the serve CLI rejects incoherent session flag combinations at parse
+  time.
+"""
+
+import hashlib
+import http.client
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.serve import journal as sjournal
+from sam2consensus_tpu.serve.session import (
+    SessionError, SessionManager, consensus_digest, sha256_hex)
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_cache(monkeypatch):
+    monkeypatch.setenv("S2C_JIT_CACHE", "")
+
+
+# =========================================================================
+# corpus helpers: one simulated SAM split into header + read waves
+# =========================================================================
+def _corpus(tmp_path, n_waves=3, n_reads=900, contig_len=2200, seed=411,
+            prefix="ts_"):
+    spec = SimSpec(n_contigs=1, contig_len=contig_len, n_reads=n_reads,
+                   read_len=100, contig_len_jitter=0.0, seed=seed,
+                   contig_prefix=prefix)
+    text = simulate(spec)
+    header = [ln for ln in text.splitlines() if ln.startswith("@")]
+    reads = [ln for ln in text.splitlines()
+             if ln and not ln.startswith("@")]
+    per = max(1, len(reads) // n_waves)
+    waves = [reads[i:i + per] for i in range(0, len(reads), per)]
+    if len(waves) > n_waves:                    # fold the remainder
+        waves[n_waves - 1].extend(
+            ln for w in waves[n_waves:] for ln in w)
+        waves = waves[:n_waves]
+    concat = str(tmp_path / "concat.sam")
+    with open(concat, "w") as fh:
+        fh.write(text)
+    header_text = "\n".join(header) + "\n"
+    bodies = [("\n".join(w) + "\n").encode("utf-8") for w in waves]
+    return header_text, bodies, concat
+
+
+def _runner(tmp_path, worker="w0", ttl=30.0):
+    from sam2consensus_tpu.serve import ServeRunner
+
+    return ServeRunner(prewarm="off", persistent_cache=False,
+                       journal_dir=str(tmp_path / "j"),
+                       worker_id=worker, lease_ttl=ttl)
+
+
+def _cfg(tmp_path):
+    out = str(tmp_path / "oneshot_out")
+    os.makedirs(out, exist_ok=True)
+    return RunConfig(backend="jax", outfolder=out + os.sep, prefix="")
+
+
+def _content_shas(paths):
+    """Per-reference FASTA content, keyed on the reference stem (the
+    filename prefix differs between one-shot and session mode)."""
+    shas = {}
+    for p in paths:
+        ref = os.path.basename(p).split("__")[0]
+        with open(p, "rb") as fh:
+            shas[ref] = hashlib.sha256(fh.read()).hexdigest()
+    return shas
+
+
+# =========================================================================
+# journal: session events replay, audit, compaction equivalence
+# =========================================================================
+class TestSessionJournal:
+    def test_session_audit_counts_waves_not_reads(self, tmp_path):
+        j = sjournal.JobJournal(str(tmp_path / "j"),
+                                checkpoint_every=0)
+        j.append("session_open", key="s-ab", tenant="t0",
+                 header_sha="x", refs=1)
+        for n in range(3):
+            j.append("wave_received", key="s-ab", wave=n,
+                     sha=f"h{n}", reads=100, bytes=999)
+        j.append("wave_absorbed", key="s-ab", wave=0, sha="h0",
+                 reads_total=100, digest="d0")
+        j.append("wave_absorbed", key="s-ab", wave=1, sha="h1",
+                 reads_total=200, digest="d1")
+        j.append("wave_rejected", key="s-ab", wave=2, reason="torn")
+        aud = j.audit(full=True)["sessions"]["s-ab"]
+        assert aud["waves"] == 3
+        assert aud["absorbed"] == 2
+        assert aud["lost_waves"] == []          # rejected != lost
+        assert aud["duplicated_waves"] == []
+        assert aud["rejected_waves"] != []
+        assert aud["reads_total"] == 200
+
+    def test_double_absorb_is_flagged_duplicated(self, tmp_path):
+        j = sjournal.JobJournal(str(tmp_path / "j"),
+                                checkpoint_every=0)
+        j.append("session_open", key="s-cd", tenant="", header_sha="x",
+                 refs=1)
+        j.append("wave_received", key="s-cd", wave=0, sha="h0",
+                 reads=10, bytes=99)
+        j.append("wave_absorbed", key="s-cd", wave=0, sha="h0",
+                 reads_total=10, digest="d")
+        j.append("wave_absorbed", key="s-cd", wave=0, sha="h0",
+                 reads_total=20, digest="d")
+        aud = j.audit(full=True)["sessions"]["s-cd"]
+        assert aud["duplicated_waves"] != []
+
+    def test_compacted_replay_equals_full(self, tmp_path):
+        j = sjournal.JobJournal(str(tmp_path / "j"),
+                                checkpoint_every=2)
+        j.append("session_open", key="s-ef", tenant="t",
+                 header_sha="x", refs=2)
+        for n in range(4):
+            j.append("wave_received", key="s-ef", wave=n, sha=f"h{n}",
+                     reads=50, bytes=100)
+            j.append("wave_absorbed", key="s-ef", wave=n, sha=f"h{n}",
+                     reads_total=50 * (n + 1), digest=f"d{n}")
+        j.append("session_stable", key="s-ef", wave=3, digest="d3",
+                 waves_stable=3)
+        j.append("session_closed", key="s-ef", digest="d3",
+                 outputs={}, reads_total=200)
+        j2 = sjournal.JobJournal(str(tmp_path / "j"))
+        assert j2.audit() == j2.audit(full=True)
+        aud = j2.audit(full=True)["sessions"]["s-ef"]
+        assert aud["status"] == "closed"
+        assert aud["stable"] is True
+        assert aud["lost_waves"] == [] and aud["duplicated_waves"] == []
+
+
+# =========================================================================
+# absorb engine: exactly-once, byte-identity, torn waves, stability
+# =========================================================================
+class TestSessionAbsorb:
+    def test_stream_byte_identical_to_one_shot(self, tmp_path):
+        """The tentpole oracle: a session fed the corpus wave by wave
+        writes per-reference FASTA content byte-identical to the
+        one-shot run over the concatenated SAM."""
+        from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+        header, bodies, concat = _corpus(tmp_path, n_waves=3)
+        r = _runner(tmp_path)
+        mgr = SessionManager(r, _cfg(tmp_path), stability_waves=99,
+                             revote_debounce=0.0)
+        r.sessions = mgr
+        try:
+            sid = mgr.open_session(header, tenant="t0")["sid"]
+            total = 0
+            for body in bodies:
+                ack = mgr.receive_wave(
+                    sid, body, declared_sha="sha256:" +
+                    sha256_hex(body))
+                assert ack["status"] == "absorbed"
+                total = ack["reads_total"]
+            res = mgr.close_session(sid)
+            assert res["outputs"], "session wrote no FASTA outputs"
+            assert res["reads_total"] == total
+            aud = r.journal.audit(full=True)["sessions"][sid]
+            assert aud["lost_waves"] == []
+            assert aud["duplicated_waves"] == []
+            assert aud["absorbed"] == len(bodies)
+
+            # health snapshot carries the sessions section (absorbed
+            # counters survive the close)
+            snap = r.health_snapshot()
+            assert snap["sessions"]["waves_absorbed"] == len(bodies)
+
+            # exposition: session counters ride the worker-labeled,
+            # lint-clean OpenMetrics text
+            from sam2consensus_tpu.observability.telemetry import \
+                lint_openmetrics
+
+            tel = r.render_telemetry()
+            assert lint_openmetrics(tel) == []
+            assert "s2c_session_waves_absorbed_total" in tel
+            assert "s2c_session_opened_total" in tel
+        finally:
+            r.close()
+
+        rb = ServeRunner(prewarm="off", persistent_cache=False)
+        try:
+            one = rb.submit_jobs([JobSpec(filename=concat,
+                                          config=_cfg(tmp_path))])[0]
+            assert one.error is None, one.error
+        finally:
+            rb.close()
+        from sam2consensus_tpu.io.fasta import write_outputs
+
+        oneshot_dir = str(tmp_path / "oneshot_fasta")
+        os.makedirs(oneshot_dir)
+        paths = write_outputs(one.fastas, oneshot_dir + os.sep, "", 0,
+                              [0.25], echo=lambda *a, **k: None)
+        assert _content_shas(res["outputs"]) == _content_shas(paths)
+        assert res["digest"] == consensus_digest(one.fastas)
+
+    def test_declared_sha_mismatch_rejected_never_absorbed(
+            self, tmp_path):
+        header, bodies, _ = _corpus(tmp_path, n_waves=2)
+        r = _runner(tmp_path)
+        mgr = SessionManager(r, _cfg(tmp_path), stability_waves=99,
+                             revote_debounce=0.0)
+        try:
+            sid = mgr.open_session(header)["sid"]
+            with pytest.raises(SessionError) as ei:
+                mgr.receive_wave(sid, bodies[0],
+                                 declared_sha="sha256:" + "0" * 64)
+            assert ei.value.status == 422
+            assert ei.value.reason == "sha_mismatch"
+            # the session survives: the same bytes with the right sha
+            # absorb cleanly afterwards
+            ack = mgr.receive_wave(
+                sid, bodies[0],
+                declared_sha="sha256:" + sha256_hex(bodies[0]))
+            assert ack["status"] == "absorbed"
+            aud = r.journal.audit(full=True)["sessions"][sid]
+            assert aud["rejected_waves"] != []
+            assert aud["lost_waves"] == []
+        finally:
+            r.close()
+
+    def test_malformed_and_empty_waves_are_data_class(self, tmp_path):
+        header, _, _ = _corpus(tmp_path, n_waves=1)
+        r = _runner(tmp_path)
+        mgr = SessionManager(r, _cfg(tmp_path), revote_debounce=0.0)
+        try:
+            sid = mgr.open_session(header)["sid"]
+            with pytest.raises(SessionError) as ei:
+                mgr.receive_wave(sid, b"not\ta\tsam\trecord\n")
+            assert ei.value.status == 422
+            assert ei.value.reason == "malformed_wave"
+            with pytest.raises(SessionError) as ei:
+                mgr.receive_wave(sid, b"@CO just header noise\n")
+            assert ei.value.status == 422
+            assert ei.value.reason == "empty_wave"
+        finally:
+            r.close()
+
+    def test_torn_spool_re_requested_then_resent(self, tmp_path):
+        """Crash-torn spool: the journaled intent's sha no longer
+        matches the file — the wave lands on the resend list, is never
+        absorbed, and a client re-post of the same bytes recovers."""
+        header, bodies, _ = _corpus(tmp_path, n_waves=2)
+        r = _runner(tmp_path)
+        mgr = SessionManager(r, _cfg(tmp_path), stability_waves=99,
+                             revote_debounce=0.2)     # hold pending
+        try:
+            sid = mgr.open_session(header)["sid"]
+            ack = mgr.receive_wave(sid, bodies[0])
+            assert ack["status"] == "pending"
+            n = ack["wave"]
+            sess = mgr.sessions[sid]
+            with open(sess.body_path(n), "wb") as fh:
+                fh.write(bodies[0][: len(bodies[0]) // 2])   # tear it
+            time.sleep(0.25)            # let the debounce expire
+            mgr.tick()
+            st = mgr.status(sid)
+            assert st["absorbed"] == 0
+            assert st["resend"] == [n]
+            assert r.registry.value("session/torn_waves") == 1
+            # resend: same bytes arrive as a fresh wave and absorb
+            mgr.receive_wave(sid, bodies[0])
+            time.sleep(0.25)
+            mgr.tick()
+            st = mgr.status(sid)
+            assert st["absorbed"] == 1 and st["reads_total"] > 0
+            aud = r.journal.audit(full=True)["sessions"][sid]
+            assert aud["lost_waves"] == []
+            assert aud["duplicated_waves"] == []
+            assert aud["rejected_waves"] != []     # the torn wave
+        finally:
+            r.close()
+
+    def test_stability_verdict_and_revote_without_ingest(
+            self, tmp_path):
+        """Identical wave content only deepens coverage — the digest
+        holds still, the read-until verdict fires at the configured
+        streak, and revote() re-votes with zero new ingest."""
+        header, bodies, _ = _corpus(tmp_path, n_waves=1)
+        body = bodies[0]
+        r = _runner(tmp_path)
+        mgr = SessionManager(r, _cfg(tmp_path), stability_waves=2,
+                             revote_debounce=0.0)
+        try:
+            sid = mgr.open_session(header)["sid"]
+            a0 = mgr.receive_wave(sid, body)
+            assert a0["stable"] is False
+            a1 = mgr.receive_wave(sid, body)
+            assert a1["stable"] is True
+            assert a1["stable_wave"] == a1["wave"]
+            assert a1["digest"] == a0["digest"] != ""
+            evs = [e for e in r.journal.events()
+                   if e.get("ev") == "session_stable"]
+            assert len(evs) == 1 and evs[0]["key"] == sid
+            before = mgr.status(sid)
+            rv = mgr.revote(sid)
+            assert rv["digest"] == a1["digest"]
+            assert mgr.status(sid)["waves"] == before["waves"]
+            assert r.registry.value("session/revotes") == 1
+        finally:
+            r.close()
+
+    def test_backpressure_sheds_with_retry_after(self, tmp_path):
+        header, bodies, _ = _corpus(tmp_path, n_waves=2)
+        r = _runner(tmp_path)
+        mgr = SessionManager(r, _cfg(tmp_path), revote_debounce=60.0,
+                             max_pending=1)
+        try:
+            sid = mgr.open_session(header)["sid"]
+            assert mgr.receive_wave(
+                sid, bodies[0])["status"] == "pending"
+            with pytest.raises(SessionError) as ei:
+                mgr.receive_wave(sid, bodies[1])
+            assert ei.value.status == 429
+            assert ei.value.retry_after and ei.value.retry_after > 0
+            assert r.registry.value("session/waves_shed") == 1
+        finally:
+            r.close()
+
+    def test_unknown_session_is_404(self, tmp_path):
+        r = _runner(tmp_path)
+        mgr = SessionManager(r, _cfg(tmp_path))
+        try:
+            with pytest.raises(SessionError) as ei:
+                mgr.status("s-nope")
+            assert ei.value.status == 404
+            with pytest.raises(SessionError) as ei:
+                mgr.receive_wave("s-nope", b"x\t" * 10 + b"x\n")
+            assert ei.value.status == 404
+        finally:
+            r.close()
+
+
+# =========================================================================
+# HTTP front door: the full status taxonomy against a live server
+# =========================================================================
+class TestIngestHTTP:
+    def _request(self, port, method, path, body=b"", headers=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=15)
+        try:
+            hdrs = dict(headers or {})
+            if method == "POST":
+                hdrs.setdefault("Content-Length", str(len(body)))
+            conn.request(method, path, body=body or None,
+                         headers=hdrs)
+            resp = conn.getresponse()
+            payload = resp.read()
+            try:
+                doc = json.loads(payload.decode("utf-8"))
+            except Exception:
+                doc = {}
+            return resp.status, doc, dict(resp.getheaders())
+        finally:
+            conn.close()
+
+    def test_status_taxonomy_end_to_end(self, tmp_path):
+        from sam2consensus_tpu.serve.stream_server import IngestServer
+
+        header, bodies, _ = _corpus(tmp_path, n_waves=2)
+        r = _runner(tmp_path)
+        mgr = SessionManager(r, _cfg(tmp_path), stability_waves=99,
+                             revote_debounce=0.0)
+        srv = IngestServer(mgr, port=0,
+                           max_body=max(len(b) for b in bodies) + 512,
+                           timeout=10.0)
+        port = srv.port
+        try:
+            # routing + method taxonomy
+            assert self._request(port, "GET", "/nope")[0] == 404
+            assert self._request(port, "PUT", "/session/open")[0] == 405
+            assert self._request(
+                port, "POST", "/session/x/frob")[0] == 404
+            assert self._request(
+                port, "GET", "/session/s-missing")[0] == 404
+
+            # DATA-class open: header with no usable @SQ
+            st, doc, _ = self._request(port, "POST", "/session/open",
+                                       b"@CO\tnothing here\n")
+            assert st == 422 and doc["error"] == "bad_header"
+
+            st, doc, _ = self._request(
+                port, "POST", "/session/open",
+                header.encode("utf-8"), {"X-Tenant": "net0"})
+            assert st == 200
+            sid = doc["sid"]
+
+            # torn upload: declared sha disagrees with the bytes
+            st, doc, _ = self._request(
+                port, "POST", f"/session/{sid}/wave", bodies[0],
+                {"X-Wave-Sha256": "sha256:" + "f" * 64})
+            assert st == 422 and doc["error"] == "sha_mismatch"
+
+            # oversize wave: refused by declared length, 413
+            big = b"x" * (srv.max_body + 1)
+            st, _, _ = self._request(
+                port, "POST", f"/session/{sid}/wave", big)
+            assert st == 413
+
+            # POST without a length is 400, not a hang
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=15)
+            try:
+                conn.putrequest("POST", f"/session/{sid}/wave")
+                conn.endheaders()
+                assert conn.getresponse().status == 400
+            finally:
+                conn.close()
+
+            # the happy path still works after every rejection above
+            st, doc, _ = self._request(
+                port, "POST", f"/session/{sid}/wave", bodies[0],
+                {"X-Wave-Sha256": "sha256:" + sha256_hex(bodies[0])})
+            assert st == 200 and doc["status"] == "absorbed"
+            assert doc["digest"]
+
+            # revote on no new ingest answers 200 with the digest
+            st, doc2, _ = self._request(
+                port, "POST", f"/session/{sid}/revote")
+            assert st == 200 and doc2["digest"] == doc["digest"]
+
+            st, doc, _ = self._request(port, "GET", f"/session/{sid}")
+            assert st == 200 and doc["absorbed"] == 1
+
+            st, doc, _ = self._request(port, "GET", "/sessions")
+            assert st == 200 and doc["open"] == 1
+            assert doc["waves_rejected"] >= 1
+
+            st, doc, _ = self._request(
+                port, "POST", f"/session/{sid}/close")
+            assert st == 200 and doc["outputs"]
+
+            # a closed session is gone: the wave answers 404
+            st, _, _ = self._request(
+                port, "POST", f"/session/{sid}/wave", bodies[1])
+            assert st == 404
+        finally:
+            srv.close()
+            r.close()
+
+    def test_backpressure_answers_429_with_retry_after(self, tmp_path):
+        from sam2consensus_tpu.serve.stream_server import IngestServer
+
+        header, bodies, _ = _corpus(tmp_path, n_waves=2)
+        r = _runner(tmp_path)
+        mgr = SessionManager(r, _cfg(tmp_path), revote_debounce=60.0,
+                             max_pending=1)
+        srv = IngestServer(mgr, port=0, max_body=1 << 20, timeout=10.0)
+        try:
+            st, doc, _ = self._request(
+                srv.port, "POST", "/session/open",
+                header.encode("utf-8"))
+            sid = doc["sid"]
+            st, doc, _ = self._request(
+                srv.port, "POST", f"/session/{sid}/wave", bodies[0])
+            assert st == 202 and doc["status"] == "pending"
+            st, doc, hdrs = self._request(
+                srv.port, "POST", f"/session/{sid}/wave", bodies[1])
+            assert st == 429
+            assert float(hdrs.get("Retry-After", "0")) > 0
+        finally:
+            srv.close()
+            r.close()
+
+
+# =========================================================================
+# crash recovery: orphaned sessions are adopted and replayed
+# =========================================================================
+class TestSessionRecovery:
+    def test_peer_adopts_orphan_and_replays_uncovered_wave(
+            self, tmp_path):
+        """In-process model of the SIGKILL story: worker w0 absorbs
+        two waves, ACKs a third (journaled intent + spool) and dies
+        before absorbing it.  Peer w1 adopts the session once the
+        lease expires, replays exactly the uncovered wave, and closes
+        with all reads counted once."""
+        from sam2consensus_tpu.serve.session import _count_reads
+
+        header, bodies, _ = _corpus(tmp_path, n_waves=3)
+        cfg = _cfg(tmp_path)
+        ra = _runner(tmp_path, worker="w0", ttl=0.6)
+        ma = SessionManager(ra, cfg, stability_waves=99,
+                            revote_debounce=0.0)
+        sid = ma.open_session(header, tenant="tr")["sid"]
+        for body in bodies[:2]:
+            assert ma.receive_wave(sid, body)["status"] == "absorbed"
+        # the crash site: the next wave was ACKed (spool + journal
+        # intent) but the worker died before the absorb
+        sess = ma.sessions[sid]
+        n = sess.wave_next
+        with open(sess.body_path(n), "wb") as fh:
+            fh.write(bodies[2])
+        ra.journal.append("wave_received", key=sid, wave=n,
+                          sha=sha256_hex(bodies[2]),
+                          reads=_count_reads(bodies[2]),
+                          bytes=len(bodies[2]))
+        expected_reads = sum(_count_reads(b) for b in bodies)
+        ra.close()          # w0 is gone; its lease will expire
+
+        rb = _runner(tmp_path, worker="w1", ttl=0.6)
+        mb = SessionManager(rb, cfg, stability_waves=99,
+                            revote_debounce=0.0)
+        try:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                mb.tick()
+                if sid in mb.sessions:
+                    break
+                time.sleep(0.2)
+            assert sid in mb.sessions, "peer never adopted the orphan"
+            st = mb.status(sid)
+            assert st["stolen_from"] == "w0"
+            assert st["absorbed"] == 3
+            assert st["reads_total"] == expected_reads
+            res = mb.close_session(sid)
+            assert res["outputs"]
+            aud = rb.journal.audit(full=True)["sessions"][sid]
+            assert aud["lost_waves"] == []
+            assert aud["duplicated_waves"] == []
+            assert rb.registry.value("session/steals") == 1
+        finally:
+            rb.close()
+
+    def test_sigkill_steal_subprocess_smoke(self, tmp_path):
+        """One kill cycle of the real thing: two CLI server processes,
+        SIGKILL mid-stream, client retargets, byte-identity + audit.
+        (The rotating multi-mode soak is the slow test below.)"""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import session_soak
+
+        out = str(tmp_path / "soak.jsonl")
+        rc = session_soak.main([
+            "--cycles", "1", "--waves", "4", "--reads", "3000",
+            "--contig-len", "2500", "--lease-ttl", "1.5",
+            "--out", out, "--workdir", str(tmp_path / "wk")])
+        assert rc == 0
+        rows = [json.loads(ln) for ln in open(out) if ln.strip()]
+        summary = rows[-1]
+        assert summary["kind"] == "summary"
+        assert summary["schema"] == "s2c-session-soak/1"
+        assert summary["failures"] == 0
+        assert summary["identical_all"] is True
+        assert summary["lost_total"] == 0
+        assert summary["duplicated_total"] == 0
+        assert summary["max_steal_sec"] is not None
+        assert summary["max_steal_sec"] <= summary["steal_bound_sec"]
+
+    @pytest.mark.slow
+    def test_session_soak_all_modes(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import session_soak
+
+        out = str(tmp_path / "soak.jsonl")
+        rc = session_soak.main([
+            "--cycles", "3", "--waves", "5", "--reads", "4000",
+            "--contig-len", "3000", "--lease-ttl", "2.0",
+            "--out", out, "--workdir", str(tmp_path / "wk")])
+        assert rc == 0
+        rows = [json.loads(ln) for ln in open(out) if ln.strip()]
+        summary = rows[-1]
+        assert summary["failures"] == 0
+        assert summary["identical_all"] is True
+        assert {r["mode"] for r in rows if r.get("kind") == "cycle"} \
+            == {"kill", "wedge", "fault"}
+
+
+# =========================================================================
+# serve CLI: incoherent session flags fail at parse time
+# =========================================================================
+class TestSessionCLI:
+    def test_session_flag_cross_checks(self, tmp_path):
+        from sam2consensus_tpu.cli import serve_main
+
+        j = str(tmp_path / "j")
+        with pytest.raises(SystemExit,
+                           match="--ingest-port requires --journal"):
+            serve_main(["--ingest-port", "0"])
+        with pytest.raises(SystemExit,
+                           match="does not compose with -i/--input"):
+            serve_main(["--ingest-port", "0", "--journal", j,
+                        "-i", "x.sam"])
+        with pytest.raises(SystemExit, match="--batch"):
+            serve_main(["--ingest-port", "0", "--journal", j,
+                        "--batch", "4"])
+        with pytest.raises(SystemExit, match="--incremental"):
+            serve_main(["--ingest-port", "0", "--journal", j,
+                        "--incremental"])
+        with pytest.raises(SystemExit, match="--count-cache"):
+            serve_main(["--ingest-port", "0", "--journal", j,
+                        "--count-cache", "64M"])
+        with pytest.raises(SystemExit,
+                           match="--stability-waves must be >= 1"):
+            serve_main(["--ingest-port", "0", "--journal", j,
+                        "--stability-waves", "0"])
+        with pytest.raises(SystemExit,
+                           match="--revote-debounce must be >= 0"):
+            serve_main(["--ingest-port", "0", "--journal", j,
+                        "--revote-debounce", "-1"])
+        with pytest.raises(SystemExit,
+                           match="--ingest-max-body must be > 0"):
+            serve_main(["--ingest-port", "0", "--journal", j,
+                        "--ingest-max-body", "0"])
+        with pytest.raises(SystemExit,
+                           match="--ingest-timeout must be > 0"):
+            serve_main(["--ingest-port", "0", "--journal", j,
+                        "--ingest-timeout", "0"])
+        with pytest.raises(SystemExit,
+                           match="--ingest-max-pending must be >= 1"):
+            serve_main(["--ingest-port", "0", "--journal", j,
+                        "--ingest-max-pending", "0"])
+        with pytest.raises(SystemExit,
+                           match="at least one -i/--input"):
+            serve_main([])

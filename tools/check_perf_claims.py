@@ -29,6 +29,10 @@ flight-recorder artifacts: a ``fleet_trace*.json`` trace must pass
 ``observability/flight.validate`` (valid trace-event JSON, >=1
 per-job track, no negative durations or orphans) and a
 ``fleet_trace*.jsonl`` leg result must carry a clean summary row.
+Cited streaming-session soak artifacts (``session_soak*.jsonl``,
+tools/session_soak.py) must likewise carry a clean summary: zero
+failures, zero lost/duplicated waves, byte-identity with the one-shot
+oracle, and every lease steal inside the 2x-TTL bound.
 
 Usage: python tools/check_perf_claims.py [--repo DIR]; exit 0 clean,
 1 with one violation per line otherwise.
@@ -122,6 +126,14 @@ def check_file(repo, name):
                         f"{name}:{lineno}: fleet-soak artifact "
                         f"{art!r} is not valid claim evidence "
                         f"({len(errs)} error(s); first: {errs[0]})")
+            elif os.path.basename(art).startswith("session_soak") \
+                    and art.endswith(".jsonl"):
+                errs = lint_session_soak_artifact(path)
+                if errs:
+                    violations.append(
+                        f"{name}:{lineno}: session-soak artifact "
+                        f"{art!r} is not valid claim evidence "
+                        f"({len(errs)} error(s); first: {errs[0]})")
             elif os.path.basename(art).startswith("fleet_trace") \
                     and art.endswith(".jsonl"):
                 errs = lint_fleet_trace_leg_artifact(path)
@@ -175,6 +187,53 @@ def lint_fleet_soak_artifact(path):
         errs.append("summary identical_all is not true")
     if s.get("failures", 1) != 0:
         errs.append(f"summary failures={s.get('failures')}")
+    return errs
+
+
+def lint_session_soak_artifact(path):
+    """Structural lint for a cited streaming-session soak JSONL
+    (tools/session_soak.py): parseable rows, a summary row, and the
+    summary's invariants intact — zero cycle failures, zero
+    lost/duplicated waves, byte-identity with the one-shot batch
+    oracle, and every measured lease steal inside the 2x-TTL bound.
+    An artifact recording a lost wave is no more evidence than a
+    missing file."""
+    import json
+
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = [ln for ln in fh if ln.strip()]
+    except OSError as exc:
+        return [f"unreadable: {exc}"]
+    rows = []
+    for i, ln in enumerate(lines, 1):
+        try:
+            rows.append(json.loads(ln))
+        except ValueError:
+            errs.append(f"line {i}: not JSON")
+    summaries = [r for r in rows if r.get("kind") == "summary"]
+    if not summaries:
+        errs.append("no summary row")
+        return errs
+    s = summaries[-1]
+    if s.get("failures", 1) != 0:
+        errs.append(f"summary failures={s.get('failures')}")
+    if s.get("lost_total", 1) != 0:
+        errs.append(f"summary lost_total={s.get('lost_total')}")
+    if s.get("duplicated_total", 1) != 0:
+        errs.append(
+            f"summary duplicated_total={s.get('duplicated_total')}")
+    if not s.get("identical_all", False):
+        errs.append("summary identical_all is not true")
+    bound = s.get("steal_bound_sec")
+    max_steal = s.get("max_steal_sec")
+    if max_steal is None:
+        errs.append("summary has no measured steal latency "
+                    "(no kill/wedge cycle ran?)")
+    elif bound is not None and max_steal > bound:
+        errs.append(f"summary max_steal_sec={max_steal} exceeds "
+                    f"steal_bound_sec={bound}")
     return errs
 
 

@@ -29,16 +29,30 @@ summary row with the headline counters (``crashes`` / ``hangs`` /
 ``divergences`` must all be 0).  Divergence rows carry the mutant's
 seed + flavor so any failure replays exactly.
 
+A third leg (``--network``) fuzzes the streaming-session FRONT DOOR
+(serve/stream_server.py) over raw sockets against a live in-process
+IngestServer: truncated chunked bodies, lying/oversize Content-Length,
+mid-wave connection drops, garbage chunk framing, wrong methods/paths,
+sha-mismatch declarations and interleaved-session writes.  The
+contract asserted per mutant: every answered request carries a TYPED
+4xx/5xx with a machine-readable reason (or the connection dies
+client-side on drop flavors — never a hang); and after the whole
+barrage the server still answers, a canary session's consensus digest
+is UNCHANGED (garbage never mutates absorbed state), and a fresh good
+wave still absorbs.  Same crashes/hangs/divergences=0 headline.
+
 Usage:
   python tools/fuzz_ingest.py [--smoke] [--trials N] [--seed S]
                               [--out results.jsonl] [--per-mutant-timeout S]
+  python tools/fuzz_ingest.py --network [--smoke] [--out results.jsonl]
   python tools/fuzz_ingest.py --overhead [--repeats N] [--out perf.json]
 
 ``--smoke`` is the tier-1 slice (seeded, ~200 mutants, <60 s —
-tests/test_fuzz_smoke.py).  ``--overhead`` instead measures
-tolerant-mode decode overhead on CLEAN input (the sink attached but
-never hit: the C fast path must stay ~free) and writes a small JSON
-artifact for PERF.md.
+tests/test_fuzz_smoke.py; with ``--network`` a trimmed mutant matrix,
+same invariants).  ``--overhead`` instead measures tolerant-mode
+decode overhead on CLEAN input (the sink attached but never hit: the C
+fast path must stay ~free) and writes a small JSON artifact for
+PERF.md.
 """
 
 import argparse
@@ -693,10 +707,277 @@ def run_overhead(args) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# network-framing leg: the streaming-session front door under fire
+# ---------------------------------------------------------------------------
+_NET_HEADER = "@HD\tVN:1.6\n@SQ\tSN:fuzzref\tLN:24\n"
+_NET_READ = ("fr1\t0\tfuzzref\t1\t60\t24M\t*\t0\t0\t"
+             "ACGTACGTACGTACGTACGTACGT\t"
+             "IIIIIIIIIIIIIIIIIIIIIIII\n")
+
+
+def _http_exchange(port, payload: bytes, read_reply=True,
+                   half_close=False, timeout=10.0):
+    """One raw-socket exchange; returns the reply status int, or None
+    when the client tears the connection / the server cannot answer."""
+    import socket as _socket
+
+    s = _socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        s.sendall(payload)
+        if half_close:
+            s.shutdown(_socket.SHUT_WR)
+        if not read_reply:
+            return None
+        s.settimeout(timeout)
+        buf = b""
+        while b"\r\n" not in buf:
+            chunk = s.recv(4096)
+            if not chunk:
+                return None
+            buf += chunk
+        return int(buf.split(b"\r\n", 1)[0].split()[1])
+    except (_socket.timeout, ConnectionError, OSError):
+        return None
+    finally:
+        s.close()
+
+
+def _req(method, path, body=b"", headers=(), chunks=None,
+         no_length=False):
+    """Assemble a raw HTTP/1.1 request.  ``chunks`` switches to chunked
+    framing: a list of (size_line, data, trailer_crlf) triples sent
+    verbatim — malformed framing is the point."""
+    head = [f"{method} {path} HTTP/1.1", "Host: 127.0.0.1",
+            "Connection: close"]
+    head += [f"{k}: {v}" for k, v in headers]
+    if chunks is not None:
+        head.append("Transfer-Encoding: chunked")
+        body = b"".join(sz + data + tail for sz, data, tail in chunks)
+    elif not no_length:
+        head.append(f"Content-Length: {len(body)}")
+    return ("\r\n".join(head) + "\r\n\r\n").encode("ascii") + body
+
+
+def _net_flavors(sid: str, max_body: int):
+    """(name, payload, half_close, expected_statuses) — expected=None
+    means any answer is fine as long as the server survives (client-
+    drop flavors where no reply can be delivered)."""
+    wave = f"/session/{sid}/wave"
+    good = _NET_READ.encode()
+    return [
+        ("truncated_chunked",
+         _req("POST", wave, chunks=[(b"18\r\n", good[:12], b"")]),
+         True, {400, 408}),
+        ("bad_chunk_hex",
+         _req("POST", wave, chunks=[(b"zz\r\n", b"", b"")]),
+         False, {400}),
+        ("bad_chunk_framing",
+         _req("POST", wave,
+              chunks=[(b"4\r\n", b"ACGT", b"XX"),
+                      (b"0\r\n", b"", b"\r\n")]),
+         False, {400}),
+        ("oversize_chunk",
+         _req("POST", wave,
+              chunks=[(hex(max_body + 9)[2:].encode() + b"\r\n",
+                       b"", b"")]),
+         False, {413}),
+        ("oversize_content_length",
+         _req("POST", wave, headers=[("Content-Length",
+                                      str(max_body + 9))],
+              no_length=True), False, {413}),
+        ("negative_content_length",
+         _req("POST", wave, headers=[("Content-Length", "-5")],
+              no_length=True), False, {400}),
+        ("bad_content_length",
+         _req("POST", wave, headers=[("Content-Length", "4x")],
+              no_length=True), False, {400}),
+        ("no_length",
+         _req("POST", wave, no_length=True), False, {400}),
+        ("mid_wave_drop",
+         _req("POST", wave, headers=[("Content-Length", "5000")],
+              no_length=True) + good, True, {400, 408, None}),
+        ("malformed_wave",
+         _req("POST", wave, body=b"not\ta\tsam\tline\n"),
+         False, {422}),
+        ("empty_wave",
+         _req("POST", wave, body=b""), False, {422}),
+        ("sha_mismatch",
+         _req("POST", wave, body=good,
+              headers=[("X-Wave-Sha256", "0" * 64)]), False, {422}),
+        ("non_utf8_header",
+         _req("POST", "/session/open", body=b"@SQ\xff\xfe\n"),
+         False, {422}),
+        ("unknown_session",
+         _req("POST", "/session/nosuchsid/wave", body=good),
+         False, {404}),
+        ("bad_verb",
+         _req("POST", f"/session/{sid}/frobnicate", body=b""),
+         False, {404}),
+        ("bad_path",
+         _req("POST", "/frobnicate", body=b""), False, {404}),
+        ("bad_method",
+         _req("PUT", wave, body=good), False, {405}),
+        ("get_unknown",
+         _req("GET", "/session/nosuchsid"), False, {404}),
+    ]
+
+
+def run_network_campaign(args) -> int:
+    """The front-door leg: every mutant against a LIVE IngestServer,
+    then the survival + digest-invariance postconditions."""
+    import shutil
+    import urllib.request
+
+    from sam2consensus_tpu.serve import IngestServer, ServeRunner
+    from sam2consensus_tpu.serve.session import SessionManager
+
+    rows = []
+    t_start = time.time()
+    crashes = hangs = divergences = 0
+    tmp = tempfile.mkdtemp(prefix="s2c_fuzz_net_")
+    runner = ServeRunner(prewarm="off", decode_ahead=False,
+                         echo=lambda *a, **k: None,
+                         journal_dir=os.path.join(tmp, "journal"))
+    manager = SessionManager(runner, _net_base_cfg(tmp))
+    max_body = 1 << 20
+    server = IngestServer(manager, port=0, max_body=max_body,
+                          timeout=3.0)
+    port = server.port
+
+    def api(method, path, body=b"", headers=None):
+        r = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                   data=body, method=method,
+                                   headers=headers or {})
+        with urllib.request.urlopen(r, timeout=120) as resp:
+            return json.loads(resp.read())
+
+    try:
+        # canary session: two good waves absorbed, digest recorded
+        sid = api("POST", "/session/open", _NET_HEADER.encode())["sid"]
+        for _ in range(2):
+            api("POST", f"/session/{sid}/wave", _NET_READ.encode())
+        before = api("GET", f"/session/{sid}")
+        flavors = _net_flavors(sid, max_body)
+        rounds = 2 if args.smoke else 8
+        for rnd in range(rounds):
+            for name, payload, half_close, expected in flavors:
+                t0 = time.time()
+                try:
+                    status = _http_exchange(port, payload,
+                                            half_close=half_close,
+                                            timeout=8.0)
+                except Exception as exc:   # noqa: BLE001
+                    crashes += 1
+                    rows.append({"kind": "crash", "flavor": name,
+                                 "round": rnd, "detail": repr(exc)})
+                    continue
+                el = time.time() - t0
+                if el > 7.5:
+                    hangs += 1
+                    rows.append({"kind": "hang", "flavor": name,
+                                 "round": rnd,
+                                 "elapsed_sec": round(el, 2)})
+                elif expected is not None and status not in expected:
+                    divergences += 1
+                    rows.append({
+                        "kind": "divergence", "flavor": name,
+                        "round": rnd, "status": status,
+                        "detail": f"expected {sorted(map(str, expected))}, "
+                                  f"got {status}"})
+            # interleaved-session writes: two sessions' waves racing on
+            # parallel connections must both absorb cleanly
+            sid2 = api("POST", "/session/open",
+                       _NET_HEADER.encode())["sid"]
+            import threading as _threading
+            errs = []
+
+            def _w(target_sid):
+                try:
+                    r = api("POST", f"/session/{target_sid}/wave",
+                            _NET_READ.encode())
+                    if r.get("status") not in ("absorbed", "pending"):
+                        errs.append(r)
+                except Exception as exc:   # noqa: BLE001
+                    errs.append(repr(exc))
+
+            ts = [_threading.Thread(target=_w, args=(s,))
+                  for s in (sid, sid2, sid, sid2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            if errs:
+                divergences += 1
+                rows.append({"kind": "divergence",
+                             "flavor": "interleaved_sessions",
+                             "round": rnd, "detail": repr(errs[:3])})
+            api("POST", f"/session/{sid2}/close", b"")
+        # -- postconditions ------------------------------------------
+        after = api("GET", f"/session/{sid}")
+        if after["digest"] != before["digest"]:
+            divergences += 1
+            rows.append({"kind": "divergence", "flavor": "postcondition",
+                         "detail": "canary digest moved under garbage: "
+                                   f"{before['digest']} -> "
+                                   f"{after['digest']}"})
+        # waves absorbed during the barrage are the interleaved GOOD
+        # ones only; rejected garbage must never have counted
+        final = api("POST", f"/session/{sid}/wave", _NET_READ.encode())
+        if final.get("status") not in ("absorbed", "pending"):
+            crashes += 1
+            rows.append({"kind": "crash", "flavor": "postcondition",
+                         "detail": f"good wave no longer absorbs: "
+                                   f"{final}"})
+        audit = runner.journal.audit()
+        bad = {s: a for s, a in audit.get("sessions", {}).items()
+               if a["duplicated_waves"] or a["lost_waves"]}
+        if bad:
+            divergences += 1
+            rows.append({"kind": "divergence", "flavor": "postcondition",
+                         "detail": f"journal audit: {bad}"})
+    finally:
+        server.close()
+        runner.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary = {
+        "kind": "summary", "schema": "s2c-fuzz-ingest-net/1",
+        "mode": "smoke" if args.smoke else "full",
+        "flavors": len(_net_flavors("x", 1)) + 1,
+        "rounds": 2 if args.smoke else 8,
+        "crashes": crashes, "hangs": hangs, "divergences": divergences,
+        "elapsed_sec": round(time.time() - t_start, 2),
+    }
+    rows.append(summary)
+    if args.out == "-":
+        for r in rows:
+            print(json.dumps(r))
+    elif args.out:
+        with open(args.out, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+    print(f"FUZZ INGEST NET: rounds={summary['rounds']} "
+          f"crashes={crashes} hangs={hangs} divergences={divergences} "
+          f"elapsed={summary['elapsed_sec']}s "
+          + ("CLEAN" if not (crashes or hangs or divergences)
+             else "FINDINGS"),
+          file=sys.stderr if args.out == "-" else sys.stdout)
+    return 1 if (crashes or hangs or divergences) else 0
+
+
+def _net_base_cfg(tmp: str):
+    from sam2consensus_tpu.config import RunConfig
+    return RunConfig(prefix="fuzz", outfolder=tmp + os.sep)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 slice: ~200 mutants, <60 s")
+    ap.add_argument("--network", action="store_true",
+                    help="fuzz the streaming-session ingest endpoint "
+                         "over raw sockets instead of the decode layer")
     ap.add_argument("--overhead", action="store_true",
                     help="measure tolerant-mode overhead on clean input")
     ap.add_argument("--trials", type=int, default=None)
@@ -710,6 +991,8 @@ def main() -> int:
     args = ap.parse_args()
     if args.overhead:
         return run_overhead(args)
+    if args.network:
+        return run_network_campaign(args)
     if args.trials is None:
         args.trials = 200 if args.smoke else 1200
     if args.per_mutant_timeout is None:

@@ -176,6 +176,35 @@ def render(health, samples, now=None):
             f"{int(chits or 0)} hits  {int(cevict or 0)} evictions"
             + (f"  (budget {cc.get('budget_mb')} MB)"
                if cc.get("budget_mb") else ""))
+    # streaming sessions (health "sessions" section, falling back to
+    # the s2c_session_* exposition family): the live-ingest plane's
+    # one-line answer — open sessions, wave flow, backlog, stability
+    ses = health.get("sessions") or {}
+    sopen = ses.get("open")
+    if sopen is None:
+        sopen = _sample(samples, "s2c_session_open")
+    if sopen is not None or ses:
+        sabs = ses.get("waves_absorbed")
+        if sabs is None:
+            sabs = _sample(samples, "s2c_session_waves_absorbed_total")
+        srej = ses.get("waves_rejected")
+        if srej is None:
+            srej = _sample(samples, "s2c_session_waves_rejected_total")
+        spend = ses.get("pending")
+        if spend is None:
+            spend = _sample(samples, "s2c_session_pending_waves")
+        ssteal = ses.get("steals")
+        if ssteal is None:
+            ssteal = _sample(samples, "s2c_session_steals_total")
+        age = ses.get("last_wave_age_sec")
+        lines.append(
+            f"sessions: {int(sopen or 0)} open "
+            f"({int(ses.get('stable', 0) or 0)} stable)  "
+            f"waves {int(sabs or 0)} absorbed / "
+            f"{int(srej or 0)} rejected  "
+            f"pending {int(spend or 0)}  steals {int(ssteal or 0)}"
+            + (f"  last wave {_age_fmt(age)} ago"
+               if age is not None else ""))
     # memory plane (health "memory" section, falling back to the
     # s2c_mem_* exposition family): tracked live/peak, process RSS,
     # device bytes, the capacity-shed tally and the count cache's

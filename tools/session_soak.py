@@ -1,0 +1,533 @@
+#!/usr/bin/env python3
+"""Streaming-session chaos soak: SIGKILL/SIGSTOP/fault cycles against
+live ingest, asserting byte-identity and exactly-once wave accounting.
+
+Each cycle simulates a basecaller feeding one journaled streaming
+session (``s2c serve --journal DIR --ingest-port 0``) in read WAVES
+over the HTTP front door, then murders the serving worker mid-session:
+
+* ``kill``  — SIGKILL the worker after some waves are absorbed and
+  others are journaled-but-pending; a peer worker must steal the
+  session lease, replay every uncovered wave from its spool, and keep
+  serving the SAME sid to the retargeted client;
+* ``wedge`` — SIGSTOP instead (a zombie holding a live socket); the
+  peer must still take over once the lease TTL lapses, and the frozen
+  victim is reaped at cycle end without ever double-absorbing;
+* ``fault`` — no signals; the worker runs with an injected
+  ``session_wave_append`` fault (the crash window between the durable
+  ``wave_received`` intent and the ``wave_absorbed`` commit) so the
+  count-bank rule's invalidate-and-replay path fires mid-soak.
+
+Invariants asserted per cycle (any miss is a cycle failure):
+
+* the final per-reference FASTA content is byte-identical to a
+  ONE-SHOT batch run over the concatenated waves (same RunConfig);
+* the journal audit shows 0 lost and 0 duplicated waves for the sid;
+* kill/wedge cycles: the peer's re-claim lands within 2x lease TTL
+  (measured from journal event timestamps), and every wave posted
+  before the signal is absorbed by the thief before new waves land.
+
+Emits one JSONL row per cycle plus a ``summary`` row; commit the
+output as ``campaign/session_soak_*.jsonl`` and cite it from PERF.md
+(tools/check_perf_claims.py lints the citation).
+
+Usage::
+
+    python tools/session_soak.py --cycles 3 --waves 6 --out soak.jsonl
+"""
+
+import argparse
+import hashlib
+import http.client
+import json
+import os
+import platform
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODES = ("kill", "wedge", "fault")
+PORT_RE = re.compile(r"127\.0\.0\.1:(\d+)")
+DEFAULT_FAULT_SPEC = ("session_wave_append:rpc:1:2,"
+                      "session_wave_append:rpc:6:1")
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# corpus: one simulated SAM split into a header + contiguous read waves
+# ---------------------------------------------------------------------------
+
+def build_corpus(args, work):
+    """Returns (header_text, [wave_body_bytes...], concat_sam_path)."""
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+    spec = SimSpec(n_contigs=2, contig_len=args.contig_len,
+                   n_reads=args.reads, read_len=args.read_len,
+                   contig_len_jitter=0.0, seed=8200,
+                   contig_prefix="ss_")
+    text = simulate(spec)
+    lines = text.splitlines(keepends=True)
+    header = "".join(l for l in lines if l.startswith("@"))
+    reads = [l for l in lines if not l.startswith("@")]
+    waves = []
+    per = max(1, (len(reads) + args.waves - 1) // args.waves)
+    for i in range(0, len(reads), per):
+        waves.append("".join(reads[i:i + per]).encode("utf-8"))
+    concat = os.path.join(work, "corpus.sam")
+    with open(concat, "w") as fh:
+        fh.write(text)
+    return header, waves, concat
+
+
+def baseline_shas(concat, work):
+    """{reference -> sha256(file content)} from a one-shot in-process
+    batch run with the SAME RunConfig the session servers use (prefix
+    "" — session mode has no -p flag, and the prefix is baked into
+    every FASTA header, so the oracle must match it)."""
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.io.fasta import write_outputs
+    from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+    outdir = os.path.join(work, "out_base")
+    os.makedirs(outdir, exist_ok=True)
+    noop = lambda *a, **k: None  # noqa: E731
+    runner = ServeRunner(prewarm="off", decode_ahead=False, echo=noop)
+    try:
+        res = runner.submit_jobs(
+            [JobSpec(filename=concat,
+                     config=RunConfig(prefix="",
+                                      outfolder=outdir + os.sep),
+                     job_id="baseline")])[0]
+        if res.error or res.fastas is None:
+            raise RuntimeError(f"baseline job failed: {res.error}")
+        paths = write_outputs(res.fastas, outdir + os.sep, "", 0,
+                              [0.25], echo=noop)
+    finally:
+        runner.close()
+    return ref_shas(paths)
+
+
+def ref_shas(paths):
+    """{reference -> content sha} (filenames differ between baseline
+    and session outputs — ``{ref}__{prefix-or-sid}.fasta`` — so the
+    comparison is keyed on the reference name, valued on CONTENT)."""
+    out = {}
+    for p in paths:
+        ref = os.path.basename(p).split("__")[0]
+        with open(p, "rb") as fh:
+            out[ref] = sha256_hex(fh.read())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# workers: real CLI server subprocesses with ephemeral ingest ports
+# ---------------------------------------------------------------------------
+
+def worker_cmd(jdir, worker, ttl, debounce, extra=()):
+    return [sys.executable, "-m", "sam2consensus_tpu.cli", "serve",
+            "--journal", jdir, "--ingest-port", "0",
+            "--worker-id", worker, "--lease-ttl", str(ttl),
+            "--revote-debounce", str(debounce),
+            "--stability-waves", "3", *extra]
+
+
+class Worker:
+    """One server subprocess + a stdout reader thread (the ingest port
+    is announced on stdout; the thread also keeps the pipe drained)."""
+
+    def __init__(self, name, cmd, env, work):
+        self.name = name
+        self.errpath = os.path.join(work, f"{name}.stderr")
+        self._errfh = open(self.errpath, "w")
+        self.proc = subprocess.Popen(cmd, env=env,
+                                     stdout=subprocess.PIPE,
+                                     stderr=self._errfh, text=True)
+        self.lines = []
+        self._t = threading.Thread(target=self._drain, daemon=True)
+        self._t.start()
+
+    def _drain(self):
+        try:
+            for line in self.proc.stdout:
+                self.lines.append(line)
+        except (ValueError, OSError):
+            pass
+
+    def port(self, timeout):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for line in list(self.lines):
+                m = PORT_RE.search(line)
+                if m:
+                    return int(m.group(1))
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"worker {self.name} exited rc="
+                    f"{self.proc.returncode} before announcing a port "
+                    f"(stderr: {self.errpath})")
+            time.sleep(0.02)
+        raise RuntimeError(f"worker {self.name}: no ingest port within "
+                           f"{timeout:g}s (stderr: {self.errpath})")
+
+    def reap(self, timeout=30.0):
+        if self.proc.poll() is None:
+            try:
+                self.proc.send_signal(signal.SIGCONT)  # un-wedge first
+            except OSError:
+                pass
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+        try:
+            self.proc.stdout.close()
+        except (ValueError, OSError):
+            pass
+        self._errfh.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP client helpers (stdlib only — same dependency budget as the server)
+# ---------------------------------------------------------------------------
+
+def api(port, method, path, body=b"", headers=None, timeout=30.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        try:
+            payload = json.loads(data.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            payload = {}
+        return resp.status, payload
+    finally:
+        conn.close()
+
+
+def post_wave(port, sid, body, deadline):
+    """POST one wave with its integrity sha; retries 429 backpressure
+    and 5xx until ``deadline``.  Returns the final ACK payload, or
+    None if the worker died (connection refused/reset) — the caller
+    retargets to the surviving peer."""
+    headers = {"X-Wave-Sha256": "sha256:" + sha256_hex(body)}
+    while True:
+        try:
+            status, payload = api(port, "POST",
+                                  f"/session/{sid}/wave", body=body,
+                                  headers=headers)
+        except OSError:
+            return None
+        if status in (200, 202):
+            return payload
+        if time.monotonic() >= deadline:
+            raise RuntimeError(
+                f"wave POST stuck at HTTP {status}: {payload}")
+        if status in (429, 500, 503):
+            time.sleep(float(payload.get("retry_after") or 0.2))
+            continue
+        raise RuntimeError(f"wave POST rejected: HTTP {status} "
+                           f"{payload}")
+
+
+def poll_status(port, sid, want, deadline, allow_dead=False):
+    """Poll GET /session/<sid> until ``want(status_payload)`` is true."""
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            status, payload = api(port, "GET", f"/session/{sid}")
+        except OSError:
+            if not allow_dead:
+                raise
+            status, payload = None, {}
+        last = (status, payload)
+        if status == 200 and want(payload):
+            return payload
+        time.sleep(0.05)
+    raise RuntimeError(f"session {sid}: condition not reached "
+                       f"(last: {last})")
+
+
+# ---------------------------------------------------------------------------
+# journal forensics
+# ---------------------------------------------------------------------------
+
+def journal_events(jdir):
+    from sam2consensus_tpu.serve.journal import JobJournal
+
+    if not os.path.isdir(jdir):
+        return []
+    try:
+        return JobJournal(jdir, checkpoint_every=0).events()
+    except OSError:
+        return []
+
+
+def steal_latency(jdir, sid, victim, t_signal):
+    """Seconds from the chaos signal to a peer's re-claim of the
+    session lease (journal event wall-clock timestamps)."""
+    for e in journal_events(jdir):
+        if e.get("ev") == "claimed" and e.get("key") == sid \
+                and e.get("worker") != victim \
+                and float(e.get("t", 0)) >= t_signal:
+            return round(float(e["t"]) - t_signal, 3)
+    return None
+
+
+def session_audit(jdir, sid):
+    from sam2consensus_tpu.serve.journal import JobJournal
+
+    audit = JobJournal(jdir, checkpoint_every=0).audit(full=True)
+    return (audit.get("sessions") or {}).get(sid) or {}
+
+
+# ---------------------------------------------------------------------------
+# one chaos cycle
+# ---------------------------------------------------------------------------
+
+def run_cycle(c, mode, args, header, waves, want, env, work):
+    jdir = os.path.join(work, f"j_c{c}")
+    shutil.rmtree(jdir, ignore_errors=True)
+    deadline = time.monotonic() + args.per_process_timeout
+    names = ("sv0", "sv1")
+    victim_name, peer_name = names
+    workers = {}
+    t_cycle = time.monotonic()
+    errors = []
+    row = {"kind": "cycle", "cycle": c, "mode": mode}
+    try:
+        for i, name in enumerate(names):
+            extra = ()
+            if mode == "fault" and i == 0:
+                extra = ("--fault-inject", args.fault_spec)
+            workers[name] = Worker(
+                name, worker_cmd(jdir, name, args.lease_ttl,
+                                 args.revote_debounce, extra),
+                env, work)
+        ports = {n: w.port(args.per_process_timeout)
+                 for n, w in workers.items()}
+
+        status, payload = api(ports[victim_name], "POST",
+                              "/session/open",
+                              body=header.encode("utf-8"),
+                              headers={"X-Tenant": "soak"})
+        if status != 200:
+            raise RuntimeError(f"open failed: HTTP {status} {payload}")
+        sid = payload["sid"]
+        row["sid"] = sid
+
+        # phase 1: feed the victim.  First ``j`` waves are allowed to
+        # absorb fully (so a checkpoint exists to re-seed from); the
+        # next chunk is posted back-to-back inside the debounce window
+        # so the signal lands on a journaled-but-unabsorbed backlog —
+        # the exact window the replay machinery exists for.
+        k_signal = len(waves) if mode == "fault" \
+            else max(2, len(waves) * 2 // 3)
+        j = max(1, k_signal // 2)
+        for n in range(j):
+            if post_wave(ports[victim_name], sid, waves[n],
+                         deadline) is None:
+                raise RuntimeError("victim died before the signal")
+        poll_status(ports[victim_name], sid,
+                    lambda s: s["absorbed"] >= j, deadline)
+        for n in range(j, k_signal):
+            if post_wave(ports[victim_name], sid, waves[n],
+                         deadline) is None:
+                raise RuntimeError("victim died before the signal")
+
+        steal_sec = None
+        serve_port = ports[victim_name]
+        if mode in ("kill", "wedge"):
+            t_signal = time.time()
+            workers[victim_name].proc.send_signal(
+                signal.SIGKILL if mode == "kill" else signal.SIGSTOP)
+            log(f"[session_soak] c{c} {mode}: "
+                f"{'killed' if mode == 'kill' else 'froze'} "
+                f"{victim_name} with {k_signal - j} wave(s) pending")
+            # retarget the client: the peer adopts the orphaned
+            # session once the lease TTL lapses, replays the
+            # journaled-but-unabsorbed waves from their spools, and
+            # answers the same sid
+            serve_port = ports[peer_name]
+            st = poll_status(serve_port, sid,
+                             lambda s: s["absorbed"] >= k_signal,
+                             deadline)
+            if st.get("stolen_from") != victim_name:
+                errors.append(f"thief reports stolen_from="
+                              f"{st.get('stolen_from')!r}, expected "
+                              f"{victim_name!r}")
+            steal_sec = steal_latency(jdir, sid, victim_name, t_signal)
+            if steal_sec is None:
+                errors.append("no peer re-claim in the journal")
+            elif steal_sec > 2 * args.lease_ttl:
+                errors.append(f"steal took {steal_sec:.2f}s "
+                              f"(bound {2 * args.lease_ttl:.2f}s)")
+
+        for n in range(k_signal, len(waves)):
+            if post_wave(serve_port, sid, waves[n], deadline) is None:
+                raise RuntimeError("serving worker died mid-stream")
+        poll_status(serve_port, sid,
+                    lambda s: s["absorbed"] >= len(waves), deadline)
+
+        status, final = api(serve_port, "POST", f"/session/{sid}/close",
+                            timeout=args.per_process_timeout)
+        if status != 200:
+            raise RuntimeError(f"close failed: HTTP {status} {final}")
+
+        got = ref_shas(final.get("outputs") or [])
+        identical = got == want
+        if not identical:
+            errors.append(f"output mismatch: want {sorted(want)}, "
+                          f"got {sorted(got)}")
+
+        aud = session_audit(jdir, sid)
+        if aud.get("duplicated_waves"):
+            errors.append(f"duplicated waves: "
+                          f"{aud['duplicated_waves']}")
+        if aud.get("lost_waves"):
+            errors.append(f"lost waves: {aud['lost_waves']}")
+        if aud.get("absorbed") != len(waves):
+            errors.append(f"absorbed {aud.get('absorbed')} of "
+                          f"{len(waves)} waves")
+
+        row.update({
+            "waves": len(waves),
+            "waves_before_signal": k_signal,
+            "steal_sec": steal_sec,
+            "steal_bound_sec": round(2 * args.lease_ttl, 3),
+            "identical": identical,
+            "duplicated_waves": aud.get("duplicated_waves", []),
+            "lost_waves": aud.get("lost_waves", []),
+            "rejected_waves": aud.get("rejected_waves", []),
+            "reads_total": aud.get("reads_total"),
+            "stable": bool(final.get("stable")
+                           or aud.get("stable")),
+            "digest": (final.get("digest") or "")[:19],
+        })
+    except Exception as exc:  # a dead cycle is a row, not a crash
+        errors.append(f"{type(exc).__name__}: {exc}")
+    finally:
+        for w in workers.values():
+            w.reap()
+    row["elapsed_sec"] = round(time.monotonic() - t_cycle, 3)
+    row["ok"] = not errors
+    row["errors"] = errors
+    return row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--cycles", type=int, default=6)
+    ap.add_argument("--waves", type=int, default=6)
+    ap.add_argument("--reads", type=int, default=6000)
+    ap.add_argument("--contig-len", type=int, default=3000)
+    ap.add_argument("--read-len", type=int, default=100)
+    ap.add_argument("--lease-ttl", type=float, default=2.5)
+    ap.add_argument("--revote-debounce", type=float, default=0.3,
+                    help="victim/peer debounce: waves ACK 202 and "
+                         "absorb on the tick, so a signal can land on "
+                         "a journaled-but-unabsorbed backlog")
+    ap.add_argument("--fault-spec", default=DEFAULT_FAULT_SPEC)
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh tempdir)")
+    ap.add_argument("--per-process-timeout", type=float, default=600.0)
+    ap.add_argument("--out", default=None,
+                    help="JSONL destination (default: stdout)")
+    args = ap.parse_args(argv)
+    if args.waves < 3:
+        ap.error("--waves must be >= 3 (need absorbed + pending + "
+                 "post-steal waves)")
+
+    import tempfile
+
+    work = args.workdir or tempfile.mkdtemp(prefix="s2c_session_")
+    os.makedirs(work, exist_ok=True)
+    log(f"[session_soak] workdir {work}")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # one persistent compile cache for the whole soak: cycles measure
+    # recovery + replay, not XLA re-compilation
+    env["S2C_JIT_CACHE"] = os.path.join(work, "_jit_cache")
+    os.environ["S2C_JIT_CACHE"] = env["S2C_JIT_CACHE"]
+
+    header, waves, concat = build_corpus(args, work)
+    log(f"[session_soak] corpus: {args.reads} reads over "
+        f"{len(waves)} wave(s)")
+    t0 = time.monotonic()
+    want = baseline_shas(concat, work)
+    log(f"[session_soak] one-shot baseline "
+        f"{time.monotonic() - t0:.1f}s, {len(want)} reference(s)")
+
+    rows = []
+    failures = 0
+    steals = []
+    for c in range(args.cycles):
+        mode = MODES[c % len(MODES)]
+        row = run_cycle(c, mode, args, header, waves, want, env, work)
+        rows.append(row)
+        if not row["ok"]:
+            failures += 1
+            log(f"[session_soak] c{c} {mode} FAILED: {row['errors']}")
+        else:
+            extra = (f" steal {row['steal_sec']:.2f}s"
+                     if row.get("steal_sec") is not None else "")
+            log(f"[session_soak] c{c} {mode} ok "
+                f"({row['elapsed_sec']:.1f}s{extra})")
+        if row.get("steal_sec") is not None:
+            steals.append(row["steal_sec"])
+
+    summary = {
+        "kind": "summary",
+        "schema": "s2c-session-soak/1",
+        "cycles": args.cycles,
+        "waves": len(waves),
+        "reads": args.reads,
+        "lease_ttl_sec": args.lease_ttl,
+        "steal_bound_sec": round(2 * args.lease_ttl, 3),
+        "max_steal_sec": max(steals) if steals else None,
+        "failures": failures,
+        "identical_all": all(r.get("identical") for r in rows),
+        "lost_total": sum(len(r.get("lost_waves") or [])
+                          for r in rows),
+        "duplicated_total": sum(len(r.get("duplicated_waves") or [])
+                                for r in rows),
+        "host_cores": os.cpu_count(),
+        "platform": platform.platform(),
+    }
+    rows.append(summary)
+
+    out = "\n".join(json.dumps(r, sort_keys=True) for r in rows) + "\n"
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(out)
+    else:
+        sys.stdout.write(out)
+    log(f"[session_soak] SUMMARY: failures={failures} "
+        f"lost={summary['lost_total']} "
+        f"dup={summary['duplicated_total']} "
+        f"identical_all={summary['identical_all']} "
+        f"max_steal={summary['max_steal_sec']}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -320,4 +320,21 @@ run_step fleet_trace "campaign/fleet_trace_$R.jsonl" \
   "campaign/fleet_trace_stderr_$R.log" 1800 \
   python tools/fleet_trace.py --leg --out -
 
+# 16. streaming-session chaos soak (ISSUE 17 / ROADMAP 2(c) live
+# ingest): a journaled streaming session fed in read waves over the
+# HTTP front door, with the serving worker SIGKILLed / SIGSTOP-wedged
+# mid-session (journaled-but-unabsorbed backlog) or running under an
+# injected session_wave_append fault (the count-bank crash window).
+# Per cycle: the surviving peer must steal the session lease within
+# 2x the lease TTL, replay every uncovered wave from its spool, keep
+# serving the SAME sid to the retargeted client, and the final
+# per-reference FASTA must be byte-identical to a one-shot batch run
+# over the concatenated waves — with the journal wave audit showing
+# zero lost / zero duplicated waves.  The summary row is what
+# check_perf_claims.py lints when PERF.md cites the artifact.
+# CPU-fallback harness proof: campaign/session_soak_r06_cpufallback.jsonl
+run_step session_soak "campaign/session_soak_$R.jsonl" \
+  "campaign/session_soak_stderr_$R.log" 3600 \
+  python tools/session_soak.py
+
 echo "$(date +%H:%M:%S) campaign complete" >> "$LOG"
