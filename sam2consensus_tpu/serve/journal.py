@@ -109,6 +109,7 @@ import json
 import logging
 import os
 import shutil
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -154,6 +155,31 @@ def _session_view(st: "ReplayState", key: str) -> dict:
         "absorb_counts": {}, "rejected": {}, "reads_total": 0,
         "digest": "", "stable": False, "stable_wave": None,
         "opened_t": 0.0, "last_wave_t": 0.0})
+
+
+def effective_rejections(view: dict) -> set:
+    """Wave numbers (string keys) of one session view whose rejection
+    actually gates replay.
+
+    A ``wave_rejected`` record is EFFECTIVE when the wave was never
+    received at all (a pre-receive rejection — declared-sha mismatch,
+    malformed body: there is nothing to replay) or when the rejection
+    was journaled AFTER the wave's durable intent (a torn spool).  A
+    rejection OLDER than the intent names a previous use of the wave
+    number — honoring it would silently drop an ACKed-but-unabsorbed
+    wave on crash recovery or steal with a clean audit, which is
+    exactly the lost-reads failure the journal exists to make
+    impossible.  The session layer no longer reuses wave numbers at
+    all (rejections consume theirs), so this fence is the structural
+    backstop for journals written before that rule."""
+    out = set()
+    waves = view.get("waves") or {}
+    for w, rej in (view.get("rejected") or {}).items():
+        rej_seq = int(rej.get("seq", 0)) if isinstance(rej, dict) else 0
+        wave = waves.get(w)
+        if wave is None or rej_seq > int(wave.get("seq", 0)):
+            out.add(w)
+    return out
 
 
 def job_key(filename: str, config) -> str:
@@ -301,6 +327,11 @@ class JobJournal:
             except ValueError:
                 checkpoint_every = DEFAULT_CHECKPOINT_EVERY
         self.checkpoint_every = max(0, checkpoint_every)
+        #: serializes THIS process's appends: the O_EXCL link already
+        #: arbitrates across processes, but concurrent handler threads
+        #: (the streaming front door) would otherwise race on _seq /
+        #: the mirror and burn link-collision retries for nothing
+        self._append_lock = threading.Lock()
         self._seq = self._max_seq() + 1
         #: in-memory mirror of ReplayState, maintained incrementally by
         #: append() so position() (called at every health publish) does
@@ -366,39 +397,45 @@ class JobJournal:
         if self.fault_cb is not None:
             self.fault_cb("journal_write")
         last_exc: Optional[BaseException] = None
-        for _ in range(_APPEND_ATTEMPTS):
-            seq = self._seq
-            rec = {"schema": SCHEMA, "seq": seq, "ev": ev,
-                   "t": round(time.time(), 3), **fields}
-            path = self._seg_path(seq)
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w", encoding="utf-8") as fh:
-                json.dump(rec, fh, sort_keys=True)
-                fh.write("\n")
-                fh.flush()
-                os.fsync(fh.fileno())
-            try:
-                os.link(tmp, path)
-            except FileExistsError as exc:
-                # another writer published this seq between our scan
-                # and our link: re-anchor past everything visible now
-                last_exc = exc
-                os.unlink(tmp)
-                self._seq = max(self._seq + 1, self._max_seq() + 1)
-                continue
-            os.unlink(tmp)
-            self._seq = seq + 1
-            if self._mirror is not None:  # keep the cheap mirror current
-                self._apply(self._mirror, rec)
-            if self.checkpoint_every \
-                    and seq % self.checkpoint_every == 0:
+        # one intra-process writer at a time (tmp-file names collide
+        # per-pid, _seq/mirror updates stay coherent); cross-PROCESS
+        # arbitration stays with the O_EXCL link below
+        with self._append_lock:
+            for _ in range(_APPEND_ATTEMPTS):
+                seq = self._seq
+                rec = {"schema": SCHEMA, "seq": seq, "ev": ev,
+                       "t": round(time.time(), 3), **fields}
+                path = self._seg_path(seq)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump(rec, fh, sort_keys=True)
+                    fh.write("\n")
+                    fh.flush()
+                    os.fsync(fh.fileno())
                 try:
-                    self.write_checkpoint()
-                except Exception as exc:   # compaction is an optimization
-                    logger.warning("journal checkpoint at seq %d failed "
-                                   "(%s: %s): replay stays O(lifetime)",
-                                   seq, type(exc).__name__, exc)
-            return seq
+                    os.link(tmp, path)
+                except FileExistsError as exc:
+                    # another writer published this seq between our
+                    # scan and our link: re-anchor past everything
+                    # visible now
+                    last_exc = exc
+                    os.unlink(tmp)
+                    self._seq = max(self._seq + 1, self._max_seq() + 1)
+                    continue
+                os.unlink(tmp)
+                self._seq = seq + 1
+                if self._mirror is not None:  # keep the mirror current
+                    self._apply(self._mirror, rec)
+                if self.checkpoint_every \
+                        and seq % self.checkpoint_every == 0:
+                    try:
+                        self.write_checkpoint()
+                    except Exception as exc:  # compaction is optional
+                        logger.warning(
+                            "journal checkpoint at seq %d failed "
+                            "(%s: %s): replay stays O(lifetime)",
+                            seq, type(exc).__name__, exc)
+                return seq
         raise OSError(
             f"journal append lost the segment race {_APPEND_ATTEMPTS} "
             f"times in a row ({last_exc}) — is something flooding "
@@ -525,9 +562,10 @@ class JobJournal:
         elif ev == "wave_received":
             s = _session_view(st, key)
             w = str(rec.get("wave"))
-            # first intent wins: a re-request after a torn spool
-            # re-journals the SAME wave number with the same sha, and
-            # the duplicate intent is a no-op on replay
+            # first intent wins: a duplicate intent append for a wave
+            # number (a retried client racing its own ACK) is a no-op
+            # on replay — the session layer never reuses numbers, so
+            # a second intent can only be the same wave re-declared
             if w not in s["waves"]:
                 s["waves"][w] = {"sha": rec.get("sha", ""),
                                  "reads": int(rec.get("reads", 0)),
@@ -565,8 +603,13 @@ class JobJournal:
             # next wave (unlike ``committed``, which closes it)
         elif ev == "wave_rejected":
             s = _session_view(st, key)
-            s["rejected"][str(rec.get("wave"))] = \
-                str(rec.get("reason", ""))
+            # the seq records WHEN the rejection landed relative to
+            # the wave's intent — recovery honors a rejection only
+            # when it post-dates (or precedes any) wave_received for
+            # the number (see effective_rejections)
+            s["rejected"][str(rec.get("wave"))] = {
+                "reason": str(rec.get("reason", "")),
+                "seq": int(rec.get("seq", 0))}
         elif ev == "session_stable":
             s = _session_view(st, key)
             s["stable"] = True
@@ -768,19 +811,22 @@ class JobJournal:
         if st.sessions:
             # streaming sessions: the same 0-lost / 0-duplicated audit
             # at WAVE granularity — a rejected (DATA-class) wave is
-            # accounted, never "lost"
-            out["sessions"] = {
-                key: {"waves": len(s["waves"]),
-                      "absorbed": len(s["absorbed"]),
-                      "duplicated_waves": sorted(
-                          w for w, n in s["absorb_counts"].items()
-                          if n > 1),
-                      "lost_waves": sorted(
-                          w for w in s["waves"]
-                          if w not in s["absorbed"]
-                          and w not in s["rejected"]),
-                      "rejected_waves": sorted(s["rejected"]),
-                      "reads_total": s["reads_total"],
-                      "status": s["status"], "stable": s["stable"]}
-                for key, s in sorted(st.sessions.items())}
+            # accounted, never "lost".  Only EFFECTIVE rejections
+            # excuse a wave (a stale rejection naming a later wave's
+            # number must not launder that wave out of lost_waves)
+            out["sessions"] = {}
+            for key, s in sorted(st.sessions.items()):
+                rej = effective_rejections(s)
+                out["sessions"][key] = {
+                    "waves": len(s["waves"]),
+                    "absorbed": len(s["absorbed"]),
+                    "duplicated_waves": sorted(
+                        w for w, n in s["absorb_counts"].items()
+                        if n > 1),
+                    "lost_waves": sorted(
+                        w for w in s["waves"]
+                        if w not in s["absorbed"] and w not in rej),
+                    "rejected_waves": sorted(s["rejected"]),
+                    "reads_total": s["reads_total"],
+                    "status": s["status"], "stable": s["stable"]}
         return out

@@ -50,6 +50,16 @@ A torn spool (sha mismatch against the journaled intent) is rejected
 with reason ``torn`` and surfaces on the session's ``resend`` list —
 re-requested, never absorbed.
 
+Wave numbers are NEVER reused, absorbed or rejected: a pre-receive
+rejection (declared-sha mismatch, malformed body) consumes its wave
+number too.  A ``wave_rejected`` record must uniquely name the wave it
+voids — if a later valid wave reused the number, recovery would read
+the old rejection as covering the new wave and silently drop ACKed
+reads.  Journal replay adds a structural second fence (the rejection's
+``seq`` must post-date the wave's intent to gate replay; see
+``journal.effective_rejections``), so even a journal written before
+this rule holds cannot lose a received wave to a stale rejection.
+
 Early stability (the read-until loop): after every absorb the consensus
 digest is compared to the previous wave's; ``stability_waves``
 consecutive identical digests emit a ``session_stable`` journal event,
@@ -242,6 +252,10 @@ class StreamSession:
     last_wave_mono: float = dataclasses.field(
         default_factory=time.monotonic)
     last_wave_unix: float = dataclasses.field(default_factory=time.time)
+    #: serializes THIS session's wave lifecycle (receive/absorb/
+    #: revote/close) — see SessionManager's concurrency contract
+    lock: threading.RLock = dataclasses.field(
+        default_factory=threading.RLock, repr=False, compare=False)
 
     @property
     def state_dir(self) -> str:
@@ -264,11 +278,32 @@ class StreamSession:
 class SessionManager:
     """All live sessions of one serve runner, plus the absorb engine.
 
-    One lock serializes every state mutation AND every backend run —
-    the front door's handler threads spool + journal + (synchronously)
-    absorb under it, the drain loop's :meth:`tick` absorbs debounced
-    waves and adopts orphaned sessions under it.  Session mode owns the
-    runner: no batch queue runs concurrently (the CLI enforces it)."""
+    Concurrency contract — three lock planes, ordered so observability
+    and other tenants never wait behind one session's absorb (a backend
+    run can take seconds to minutes):
+
+    * ``_lock`` (manager): guards the ``sessions`` map only — lookups,
+      open/adopt inserts, close/zombie pops, gauge sweeps.  Held for
+      microseconds, never across a journal replay or a backend run.
+    * per-session ``StreamSession.lock``: serializes one session's
+      wave lifecycle (receive -> absorb -> commit, revote, close), so
+      a slow tenant's absorb blocks only its own session's ingest.
+    * ``_backend_lock``: the seed/execute/capture critical section of
+      :meth:`_run_wave`.  The backend's ``serve_count_*`` handoff
+      registers are process-global, so actual backend runs still
+      serialize — but ONLY the runs, not the spool/journal/ACK path,
+      not :meth:`status`, not :meth:`health_summary`.
+
+    Ordering: a thread holding a session lock may take the manager or
+    backend lock; a thread holding the manager lock never waits on a
+    session lock (no cycles).  :meth:`status` and
+    :meth:`health_summary` read per-session fields WITHOUT the session
+    lock — each field read is GIL-atomic, the snapshot is advisory
+    observability, and taking the wave lock would reintroduce the
+    absorb-blocks-every-prober stall this contract exists to prevent.
+
+    Session mode owns the runner: no batch queue runs concurrently
+    (the CLI enforces it)."""
 
     def __init__(self, runner, base_cfg,
                  stability_waves: int = DEFAULT_STABILITY_WAVES,
@@ -285,7 +320,13 @@ class SessionManager:
         self.revote_debounce = max(0.0, float(revote_debounce))
         self.max_pending = max(0, int(max_pending))
         self.sessions: Dict[str, StreamSession] = {}
-        self._lock = threading.RLock()
+        self._lock = threading.RLock()          # sessions-map guard
+        self._backend_lock = threading.Lock()   # seed/execute/capture
+        #: last orphan scan (monotonic); the scan replays the journal
+        #: tail from disk, so it runs on its own cadence (a fraction
+        #: of the lease TTL, like the fleet reap scan) instead of at
+        #: every 10 Hz drain tick
+        self._orphan_scan_mono = 0.0
         self.sessions_root = os.path.join(self.journal.root, "sessions")
         os.makedirs(self.sessions_root, exist_ok=True)
 
@@ -294,7 +335,10 @@ class SessionManager:
         return getattr(self.runner, "fleet", None)
 
     def _get(self, sid: str) -> StreamSession:
-        sess = self.sessions.get(sid)
+        """Resolve a session WITHOUT taking its wave lock — callers
+        that mutate re-check ``closed`` under ``sess.lock``."""
+        with self._lock:
+            sess = self.sessions.get(sid)
         if sess is None:
             # a client retargeting this worker right after its peer
             # died must not wait for the next steal tick: try a
@@ -307,6 +351,13 @@ class SessionManager:
             raise SessionError(409, "session_closed",
                                f"session {sid} is closed")
         return sess
+
+    def _check_open(self, sess: StreamSession) -> None:
+        """Re-check under ``sess.lock``: a close/zombie-drop may have
+        raced the lockless lookup in :meth:`_get`."""
+        if sess.closed:
+            raise SessionError(409, "session_closed",
+                               f"session {sess.sid} is closed")
 
     def _try_adopt(self, sid: str) -> Optional[StreamSession]:
         """Adopt one journaled session on demand: after a restart (no
@@ -330,17 +381,18 @@ class SessionManager:
                 return None
             if cur is not None and cur["worker"] != fl.worker_id:
                 stolen_from = cur["worker"]
-                self.registry.add("session/steals", 1)
         return self._recover(sid, view,
                              tenant=st.tenants.get(sid, ""),
                              stolen_from=stolen_from)
 
     def _gauges(self) -> None:
+        with self._lock:
+            sessions = list(self.sessions.values())
         g = self.registry.gauge
         g("session/open").set(float(
-            sum(1 for s in self.sessions.values() if not s.closed)))
+            sum(1 for s in sessions if not s.closed)))
         g("session/pending_waves").set(float(
-            sum(len(s.pending) for s in self.sessions.values())))
+            sum(len(s.pending) for s in sessions)))
 
     def _append(self, ev: str, **fields) -> None:
         """Journal append via the runner's failure-absorbing wrapper
@@ -366,7 +418,8 @@ class SessionManager:
         if fl is None:
             return
         if not fl.holds(sess.sid):
-            self.sessions.pop(sess.sid, None)
+            with self._lock:
+                self.sessions.pop(sess.sid, None)
             self._gauges()
             raise SessionError(
                 409, "lease_lost",
@@ -376,43 +429,46 @@ class SessionManager:
     # -- lifecycle ---------------------------------------------------------
     def open_session(self, header_text: str, tenant: str = "") -> dict:
         """Open a session against a reference set (a SAM header)."""
+        refs = _parse_header(header_text)
+        header_sha = sha256_hex(header_text.encode("utf-8"))
         with self._lock:
-            refs = _parse_header(header_text)
-            header_sha = sha256_hex(header_text.encode("utf-8"))
-            sid = "s-" + sha256_hex(
-                f"{header_sha}:{tenant}:{os.getpid()}:"
-                f"{time.time():.6f}:{len(self.sessions)}"
-                .encode("utf-8"))[:12]
-            root = os.path.join(self.sessions_root, sid)
-            os.makedirs(root, exist_ok=True)
-            os.makedirs(os.path.join(root, "state"), exist_ok=True)
-            os.makedirs(os.path.join(root, "out"), exist_ok=True)
-            _atomic_write_bytes(os.path.join(root, "header.sam"),
-                                header_text.encode("utf-8"))
-            sess = StreamSession(sid=sid, tenant=tenant, root=root,
-                                 header_text=header_text,
-                                 header_sha=header_sha, refs=refs)
-            fl = self._fleet()
-            if fl is not None and not fl.try_claim(sid, sid):
-                raise SessionError(  # fresh sid: only a journal outage
-                    503, "lease_unavailable",
-                    f"could not open a lease for session {sid}")
-            self.journal.append("session_open", key=sid, tenant=tenant,
-                                header_sha=header_sha, refs=len(refs))
+            n_live = len(self.sessions)
+        sid = "s-" + sha256_hex(
+            f"{header_sha}:{tenant}:{os.getpid()}:"
+            f"{time.time():.6f}:{n_live}"
+            .encode("utf-8"))[:12]
+        root = os.path.join(self.sessions_root, sid)
+        os.makedirs(root, exist_ok=True)
+        os.makedirs(os.path.join(root, "state"), exist_ok=True)
+        os.makedirs(os.path.join(root, "out"), exist_ok=True)
+        _atomic_write_bytes(os.path.join(root, "header.sam"),
+                            header_text.encode("utf-8"))
+        sess = StreamSession(sid=sid, tenant=tenant, root=root,
+                             header_text=header_text,
+                             header_sha=header_sha, refs=refs)
+        fl = self._fleet()
+        if fl is not None and not fl.try_claim(sid, sid):
+            raise SessionError(  # fresh sid: only a journal outage
+                503, "lease_unavailable",
+                f"could not open a lease for session {sid}")
+        self.journal.append("session_open", key=sid, tenant=tenant,
+                            header_sha=header_sha, refs=len(refs))
+        with self._lock:
             self.sessions[sid] = sess
-            self.registry.add("session/opened", 1)
-            self._gauges()
-            logger.info("session %s opened (%d reference(s), tenant=%r)",
-                        sid, len(refs), tenant or "")
-            return {"sid": sid, "refs": len(refs),
-                    "stability_waves": self.stability_waves}
+        self.registry.add("session/opened", 1)
+        self._gauges()
+        logger.info("session %s opened (%d reference(s), tenant=%r)",
+                    sid, len(refs), tenant or "")
+        return {"sid": sid, "refs": len(refs),
+                "stability_waves": self.stability_waves}
 
     def receive_wave(self, sid: str, body: bytes,
                      declared_sha: Optional[str] = None) -> dict:
         """Spool + journal one wave; absorb synchronously unless the
         debounce window defers it to the next tick."""
-        with self._lock:
-            sess = self._get(sid)
+        sess = self._get(sid)
+        with sess.lock:
+            self._check_open(sess)
             dec = self.runner.admission.price_wave(
                 tenant=sess.tenant, body_bytes=len(body),
                 pending_waves=len(sess.pending),
@@ -429,7 +485,13 @@ class SessionManager:
             sha = sha256_hex(body)
             if declared_sha and declared_sha.removeprefix("sha256:") \
                     != sha:
-                self._reject_wave(sess, sess.wave_next, "sha_mismatch")
+                # the rejection CONSUMES its wave number (wave_next
+                # advances): the journaled wave_rejected must never
+                # name a number a later valid wave will reuse, or
+                # recovery would drop that wave as rejected
+                n = sess.wave_next
+                sess.wave_next = n + 1
+                self._reject_wave(sess, n, "sha_mismatch")
                 raise SessionError(
                     422, "sha_mismatch",
                     f"declared body sha256 {declared_sha!r} does not "
@@ -437,7 +499,9 @@ class SessionManager:
             try:
                 reads = _count_reads(body)
             except SessionError as exc:
-                self._reject_wave(sess, sess.wave_next, exc.reason)
+                n = sess.wave_next
+                sess.wave_next = n + 1      # consumed, like sha_mismatch
+                self._reject_wave(sess, n, exc.reason)
                 raise
             n = sess.wave_next
             _atomic_write_bytes(sess.body_path(n), body)
@@ -467,8 +531,9 @@ class SessionManager:
     def revote(self, sid: str) -> dict:
         """On-demand re-vote over the absorbed state — zero decode,
         zero scatter (the duplicate-shard skip), only the vote tail."""
-        with self._lock:
-            sess = self._get(sid)
+        sess = self._get(sid)
+        with sess.lock:
+            self._check_open(sess)
             self.runner._fault_check("session_revote")
             if sess.pending:
                 self._absorb_pending(sess)
@@ -486,30 +551,34 @@ class SessionManager:
                     "stable": sess.stable}
 
     def status(self, sid: str) -> dict:
+        """Advisory snapshot, read WITHOUT the session's wave lock (a
+        mid-absorb probe answers immediately; see the class
+        docstring's concurrency contract)."""
         with self._lock:
             sess = self.sessions.get(sid)
-            if sess is None:
-                raise SessionError(404, "unknown_session",
-                                   f"no session {sid!r} on this worker")
-            return {
-                "sid": sid, "tenant": sess.tenant,
-                "closed": sess.closed, "refs": len(sess.refs),
-                "waves": len(sess.waves),
-                "absorbed": len(sess.absorbed),
-                "pending": sorted(sess.pending),
-                "resend": sorted(sess.resend),
-                "reads_total": sess.reads_total,
-                "digest": sess.digest, "stable": sess.stable,
-                "stable_wave": sess.stable_wave,
-                "stolen_from": sess.stolen_from,
-                "last_wave_age_sec": round(
-                    time.monotonic() - sess.last_wave_mono, 3)}
+        if sess is None:
+            raise SessionError(404, "unknown_session",
+                               f"no session {sid!r} on this worker")
+        return {
+            "sid": sid, "tenant": sess.tenant,
+            "closed": sess.closed, "refs": len(sess.refs),
+            "waves": len(sess.waves),
+            "absorbed": len(sess.absorbed),
+            "pending": sorted(list(sess.pending)),
+            "resend": sorted(list(sess.resend)),
+            "reads_total": sess.reads_total,
+            "digest": sess.digest, "stable": sess.stable,
+            "stable_wave": sess.stable_wave,
+            "stolen_from": sess.stolen_from,
+            "last_wave_age_sec": round(
+                time.monotonic() - sess.last_wave_mono, 3)}
 
     def close_session(self, sid: str) -> dict:
         """Absorb the backlog, write the final FASTA outputs, journal
         the terminal event (closing the lease) and forget the session."""
-        with self._lock:
-            sess = self._get(sid)
+        sess = self._get(sid)
+        with sess.lock:
+            self._check_open(sess)
             if sess.pending:
                 self._absorb_pending(sess)
             outputs: Dict[str, Optional[dict]] = {}
@@ -535,7 +604,8 @@ class SessionManager:
                 fl.held.pop(sid, None)      # terminal event closed it
                 fl.claim_seqs.pop(sid, None)
             sess.closed = True
-            self.sessions.pop(sid, None)
+            with self._lock:
+                self.sessions.pop(sid, None)
             self.registry.add("session/closed", 1)
             self._gauges()
             logger.info("session %s closed: %d wave(s), %d read(s), "
@@ -563,7 +633,8 @@ class SessionManager:
     def _absorb_pending(self, sess: StreamSession) -> None:
         """Drain the session's pending waves IN ORDER, one backend run
         per wave (grouping is forbidden: a crash between group members
-        must not change how reads partition into absorbs on replay)."""
+        must not change how reads partition into absorbs on replay).
+        Caller holds ``sess.lock``."""
         while sess.pending:
             n = sess.pending[0]
             self._absorb_wave(sess, n)
@@ -688,31 +759,35 @@ class SessionManager:
         job_id = f"{sess.sid}:w{n}" + (":revote" if revote else "")
         if sess.state is None:
             sess.state = _load_state(sess.state_dir)
-        runner._plant_seed(sess.state)
-        dlog: List = []
-        try:
-            out = runner._execute(ai.contigs, ai.stream, cfg, robs,
-                                  dlog, job_id)
-        except Exception:
+        # the backend's serve_count_* handoff registers are process-
+        # global: the seed/execute/capture sequence is the one section
+        # two sessions' absorbs must not interleave
+        with self._backend_lock:
+            runner._plant_seed(sess.state)
+            dlog: List = []
+            try:
+                out = runner._execute(ai.contigs, ai.stream, cfg, robs,
+                                      dlog, job_id)
+            except Exception:
+                runner.backend.serve_count_result = None
+                runner.backend.serve_count_seed = None
+                runner.backend.serve_capture_counts = False
+                raise
+            finally:
+                ai.close()
+                try:
+                    obs.finish_run(robs)
+                except Exception:       # instruments are derived state
+                    pass
+                try:
+                    runner.registry.fold(robs.registry, job_id=job_id,
+                                         tenant=sess.tenant)
+                except Exception:
+                    runner.registry.add("telemetry/fold_failed", 1)
+            result = getattr(runner.backend, "serve_count_result", None)
             runner.backend.serve_count_result = None
             runner.backend.serve_count_seed = None
             runner.backend.serve_capture_counts = False
-            raise
-        finally:
-            ai.close()
-            try:
-                obs.finish_run(robs)
-            except Exception:           # instruments are derived state
-                pass
-            try:
-                runner.registry.fold(robs.registry, job_id=job_id,
-                                     tenant=sess.tenant)
-            except Exception:
-                runner.registry.add("telemetry/fold_failed", 1)
-        result = getattr(runner.backend, "serve_count_result", None)
-        runner.backend.serve_count_result = None
-        runner.backend.serve_count_seed = None
-        runner.backend.serve_capture_counts = False
         if result is not None and not revote:
             # the atomic save IS the count bank: a crash between here
             # and the wave_absorbed append replays the wave, and the
@@ -725,17 +800,22 @@ class SessionManager:
 
     # -- drain / recovery --------------------------------------------------
     def tick(self) -> int:
-        """One heartbeat: absorb debounce-expired waves and adopt
-        orphaned sessions (fleet mode).  Returns absorbed-wave count —
-        the drain loop's idleness signal."""
+        """One heartbeat: absorb debounce-expired waves and (on its
+        own throttled cadence) adopt orphaned sessions (fleet mode).
+        Returns absorbed-wave count — the drain loop's idleness
+        signal."""
         absorbed = 0
         with self._lock:
-            now = time.monotonic()
-            for sess in list(self.sessions.values()):
-                if not sess.pending:
-                    continue
-                if self.revote_debounce > 0 and \
-                        now - sess.last_wave_mono < self.revote_debounce:
+            sessions = list(self.sessions.values())
+        now = time.monotonic()
+        for sess in sessions:
+            if sess.closed or not sess.pending:
+                continue
+            if self.revote_debounce > 0 and \
+                    now - sess.last_wave_mono < self.revote_debounce:
+                continue
+            with sess.lock:
+                if sess.closed:
                     continue
                 before = len(sess.absorbed)
                 try:
@@ -744,49 +824,73 @@ class SessionManager:
                     logger.warning("session %s backlog drain: %s",
                                    sess.sid, exc)
                 absorbed += len(sess.absorbed) - before
-            if self._fleet() is not None:
+        fl = self._fleet()
+        if fl is not None:
+            # the orphan scan replays the journal tail from disk —
+            # at the 10 Hz drain cadence that is 10 tail replays/sec
+            # per worker for nothing, so it runs at the fleet reap
+            # scan's throttle (a fraction of the lease TTL) instead;
+            # recovery latency stays bounded by ~TTL + one scan period
+            mono = time.monotonic()
+            if mono - self._orphan_scan_mono >= max(0.25, fl.ttl / 4):
+                self._orphan_scan_mono = mono
                 absorbed += self._adopt_orphans()
         return absorbed
 
     def _adopt_orphans(self) -> int:
         """Steal abandoned sessions: any journal-open session this
-        worker doesn't hold whose lease is absent or expired is claimed
-        lease-and-all, recovered from its checkpoint + spool directory,
-        and its uncovered waves replayed — the fleet's work-stealing
-        protocol applied to session keys."""
+        worker doesn't hold in memory whose lease is absent, expired,
+        or our own (a restart under the same ``--worker-id``: the
+        orphan must not wait for a client to happen to hit its sid) is
+        claimed lease-and-all, recovered from its checkpoint + spool
+        directory, and its uncovered waves replayed — the fleet's
+        work-stealing protocol applied to session keys."""
         fl = self._fleet()
         st = self.journal.read_state()
         absorbed = 0
         now = time.time()
+        with self._lock:
+            have = set(self.sessions)
         for sid, view in sorted(st.sessions.items()):
-            if view.get("status") == "closed" or sid in self.sessions:
+            if view.get("status") == "closed" or sid in have:
                 continue
             cur = st.claims.get(sid)
-            if cur is not None and (cur["worker"] == fl.worker_id
-                                    or now < cur["expires_unix"]):
-                continue                # live peer still owns it
+            # skip only a LIVE lease held by a PEER (mirrors
+            # _try_adopt); our own lease — live or expired — over a
+            # session we don't hold in memory is a restart's orphan,
+            # and try_claim adopts it by renewal
+            if cur is not None and cur["worker"] != fl.worker_id \
+                    and now < cur["expires_unix"]:
+                continue
             if not fl.try_claim(sid, sid, st=st):
                 continue                # lost the steal race
+            stolen_from = ""
+            if cur is not None and cur["worker"] != fl.worker_id:
+                stolen_from = cur["worker"]
             sess = self._recover(sid, view,
                                  tenant=st.tenants.get(sid, ""),
-                                 stolen_from=(cur or {}).get(
-                                     "worker", ""))
+                                 stolen_from=stolen_from)
             if sess is None:
                 continue
-            self.registry.add("session/steals", 1)
-            before = len(sess.absorbed)
-            try:
-                self._absorb_pending(sess)
-            except SessionError as exc:
-                logger.warning("stolen session %s replay: %s", sid, exc)
-            absorbed += len(sess.absorbed) - before
+            with sess.lock:
+                before = len(sess.absorbed)
+                try:
+                    self._absorb_pending(sess)
+                except SessionError as exc:
+                    logger.warning("stolen session %s replay: %s",
+                                   sid, exc)
+                absorbed += len(sess.absorbed) - before
         return absorbed
 
     def _recover(self, sid: str, view: dict, tenant: str = "",
                  stolen_from: str = "") -> Optional[StreamSession]:
         """Rebuild a session's in-memory face from the journal view +
-        its on-disk directory; pending = received − absorbed − rejected
-        (the exactly-once replay set)."""
+        its on-disk directory; pending = received − absorbed −
+        effectively-rejected (the exactly-once replay set).  Only an
+        EFFECTIVE rejection gates replay — one journaled after the
+        wave's intent, or for a wave never received at all; a stale
+        rejection naming a number a later wave legitimately carries
+        must not suppress that wave (journal.effective_rejections)."""
         root = os.path.join(self.sessions_root, sid)
         try:
             with open(os.path.join(root, "header.sam"),
@@ -800,7 +904,8 @@ class SessionManager:
         waves = {int(w): dict(m)
                  for w, m in (view.get("waves") or {}).items()}
         absorbed = {int(w) for w in (view.get("absorbed") or {})}
-        rejected = {int(w) for w in (view.get("rejected") or {})}
+        rejected = {int(w)
+                    for w in sjournal.effective_rejections(view)}
         pending = sorted(set(waves) - absorbed - rejected)
         sess = StreamSession(
             sid=sid, tenant=tenant,
@@ -814,9 +919,21 @@ class SessionManager:
             stable=bool(view.get("stable")),
             stable_wave=view.get("stable_wave"),
             stolen_from=stolen_from)
-        sess.wave_next = max(waves, default=0) + 1
-        self.sessions[sid] = sess
+        # wave_next clears EVERY journaled number, rejected ones
+        # included: reusing a rejected number would let its old
+        # wave_rejected record void the next wave on a later recovery
+        sess.wave_next = max(
+            max(waves, default=0),
+            max((int(w) for w in (view.get("rejected") or {})),
+                default=0)) + 1
+        with self._lock:
+            existing = self.sessions.get(sid)
+            if existing is not None:
+                return existing     # a concurrent adopter won the race
+            self.sessions[sid] = sess
         self.registry.add("session/recovered", 1)
+        if stolen_from:
+            self.registry.add("session/steals", 1)
         self._gauges()
         logger.info(
             "session %s adopted (%s): %d wave(s) received, %d absorbed,"
@@ -828,33 +945,38 @@ class SessionManager:
     # -- health ------------------------------------------------------------
     def health_summary(self) -> dict:
         """The ``sessions`` health-snapshot section (serve/health.py)
-        and the s2c_top sessions line's data source."""
+        and the s2c_top sessions line's data source.  Built WITHOUT
+        any session's wave lock (the map lock is held only for the
+        snapshot of the map itself): a mid-absorb health probe answers
+        immediately, which is what lets health.py promise that nothing
+        in this section blocks."""
         with self._lock:
-            now = time.monotonic()
-            live = {sid: s for sid, s in self.sessions.items()
-                    if not s.closed}
-            newest = max((s.last_wave_mono for s in live.values()),
-                         default=None)
-            return {
-                "open": len(live),
-                "waves_received": int(
-                    self.registry.value("session/waves")),
-                "waves_absorbed": int(
-                    self.registry.value("session/waves_absorbed")),
-                "waves_rejected": int(
-                    self.registry.value("session/waves_rejected")),
-                "pending": sum(len(s.pending) for s in live.values()),
-                "stable": sum(1 for s in live.values() if s.stable),
-                "steals": int(self.registry.value("session/steals")),
-                "last_wave_age_sec": round(now - newest, 3)
-                if newest is not None else None,
-                "sessions": {
-                    sid: {"tenant": s.tenant, "waves": len(s.waves),
-                          "absorbed": len(s.absorbed),
-                          "pending": len(s.pending),
-                          "reads_total": s.reads_total,
-                          "stable": s.stable,
-                          "digest": s.digest[:19],
-                          "last_wave_age_sec": round(
-                              now - s.last_wave_mono, 3)}
-                    for sid, s in sorted(live.items())}}
+            sessions = dict(self.sessions)
+        now = time.monotonic()
+        live = {sid: s for sid, s in sessions.items()
+                if not s.closed}
+        newest = max((s.last_wave_mono for s in live.values()),
+                     default=None)
+        return {
+            "open": len(live),
+            "waves_received": int(
+                self.registry.value("session/waves")),
+            "waves_absorbed": int(
+                self.registry.value("session/waves_absorbed")),
+            "waves_rejected": int(
+                self.registry.value("session/waves_rejected")),
+            "pending": sum(len(s.pending) for s in live.values()),
+            "stable": sum(1 for s in live.values() if s.stable),
+            "steals": int(self.registry.value("session/steals")),
+            "last_wave_age_sec": round(now - newest, 3)
+            if newest is not None else None,
+            "sessions": {
+                sid: {"tenant": s.tenant, "waves": len(s.waves),
+                      "absorbed": len(s.absorbed),
+                      "pending": len(s.pending),
+                      "reads_total": s.reads_total,
+                      "stable": s.stable,
+                      "digest": s.digest[:19],
+                      "last_wave_age_sec": round(
+                          now - s.last_wave_mono, 3)}
+                for sid, s in sorted(live.items())}}

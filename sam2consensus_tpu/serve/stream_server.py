@@ -23,6 +23,9 @@ front door facing real (slow, buggy, malicious) clients needs:
   poison wave (quarantined, never retried), 429 + ``Retry-After``
   admission backpressure, 503 transient absorb failure.  Rejecting
   with a reason IS the backpressure signal — the server never wedges;
+* **keep-alive framing safety** — an error answered before the
+  request body was fully consumed closes the connection instead of
+  letting the unread bytes desync the next request on the socket;
 * the ``ingest_conn`` fault site fires per request (the chaos
   harness's handle on torn connections).
 
@@ -158,6 +161,13 @@ class IngestServer:
 
             def _error(self, status: int, reason: str, detail: str = "",
                        retry_after: Optional[float] = None) -> None:
+                # an error answered BEFORE the request body was fully
+                # consumed leaves its unread bytes on the socket; on a
+                # keep-alive connection the next "request" would be
+                # parsed out of those leftovers (a 400 cascade), so
+                # the connection must close instead of desyncing
+                if not getattr(self, "_body_done", True):
+                    self.close_connection = True
                 outer.registry.add("ingest/rejected", 1)
                 outer.registry.add(f"ingest/rejected/{reason}", 1)
                 self._reply(status, {"error": reason,
@@ -168,7 +178,9 @@ class IngestServer:
                 te = (self.headers.get("Transfer-Encoding") or "") \
                     .lower()
                 if "chunked" in te:
-                    return read_chunked(self.rfile, outer.max_body)
+                    body = read_chunked(self.rfile, outer.max_body)
+                    self._body_done = True
+                    return body
                 cl = self.headers.get("Content-Length")
                 if cl is None:
                     raise RequestError(
@@ -190,7 +202,9 @@ class IngestServer:
                         413, "body_too_large",
                         f"declared {n} bytes exceeds the "
                         f"{outer.max_body}-byte wave bound")
-                return _read_exact(self.rfile, n)
+                body = _read_exact(self.rfile, n)
+                self._body_done = True
+                return body
 
             def _drain_body(self) -> None:
                 """Consume a (possibly present) body on verbs that
@@ -198,9 +212,12 @@ class IngestServer:
                 if "Content-Length" in self.headers \
                         or "Transfer-Encoding" in self.headers:
                     self._read_body()
+                else:
+                    self._body_done = True
 
             # -- routes -----------------------------------------------
             def do_POST(self):          # noqa: N802 (stdlib name)
+                self._body_done = False     # set by a complete read
                 try:
                     self.connection.settimeout(outer.timeout)
                     outer.registry.add("ingest/requests", 1)
@@ -263,8 +280,10 @@ class IngestServer:
                                      f"{type(exc).__name__}: {exc}")
 
             def do_GET(self):           # noqa: N802 (stdlib name)
+                self._body_done = False
                 try:
                     self.connection.settimeout(outer.timeout)
+                    self._drain_body()  # a GET with a body stays framed
                     parts = [p for p in
                              self.path.split("?")[0].split("/") if p]
                     if parts == ["sessions"]:
@@ -277,6 +296,8 @@ class IngestServer:
                         self._error(404, "not_found",
                                     f"no such route {self.path!r}")
                 except SessionError as exc:
+                    self._safe_error(exc.status, exc.reason, str(exc))
+                except RequestError as exc:
                     self._safe_error(exc.status, exc.reason, str(exc))
                 except Exception as exc:
                     self._safe_error(500, "internal",
@@ -294,6 +315,12 @@ class IngestServer:
                     self.close_connection = True
 
             def do_PUT(self):           # noqa: N802
+                self._body_done = False
+                try:
+                    self.connection.settimeout(outer.timeout)
+                    self._drain_body()  # keep the connection framed
+                except Exception:
+                    pass                # _error closes it instead
                 self._safe_error(405, "method_not_allowed",
                                  "use POST/GET")
 

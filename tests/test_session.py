@@ -18,7 +18,14 @@ The acceptance pins live here:
 * session counters ride the lint-clean OpenMetrics exposition and the
   health snapshot's ``sessions`` section;
 * the serve CLI rejects incoherent session flag combinations at parse
-  time.
+  time;
+* review hardening: rejections consume their wave number (a stale
+  rejection can never void a later ACKed wave — in the journal fence
+  and end to end through a steal), status/health/other-tenant ingest
+  never queue behind one session's absorb, early HTTP errors close the
+  keep-alive connection instead of desyncing it, a restarted worker
+  re-adopts its own orphans, and the orphan scan runs on a lease-TTL
+  cadence rather than every drain tick.
 """
 
 import hashlib
@@ -154,6 +161,39 @@ class TestSessionJournal:
         assert aud["status"] == "closed"
         assert aud["stable"] is True
         assert aud["lost_waves"] == [] and aud["duplicated_waves"] == []
+
+    def test_stale_rejection_does_not_launder_a_reused_number(
+            self, tmp_path):
+        """Journals written before the no-reuse rule could reject wave
+        N pre-receive and later journal a valid intent under the same
+        N.  The seq fence (effective_rejections) must keep that ACKed
+        wave in the replay set instead of laundering it as rejected —
+        the HIGH-severity lost-reads hole."""
+        j = sjournal.JobJournal(str(tmp_path / "j"),
+                                checkpoint_every=0)
+        j.append("session_open", key="s-gh", tenant="",
+                 header_sha="x", refs=1)
+        j.append("wave_rejected", key="s-gh", wave=1,
+                 reason="sha_mismatch")           # pre-receive reject
+        j.append("wave_received", key="s-gh", wave=1, sha="h1",
+                 reads=5, bytes=9)                # number reused later
+        view = j.read_state().sessions["s-gh"]
+        assert sjournal.effective_rejections(view) == set()
+        aud = j.audit(full=True)["sessions"]["s-gh"]
+        assert aud["lost_waves"] == ["1"]       # still needs replay
+        assert aud["rejected_waves"] == ["1"]   # but stays accounted
+        # a rejection journaled AFTER the intent (torn spool) gates
+        j.append("wave_rejected", key="s-gh", wave=1, reason="torn")
+        view = j.read_state().sessions["s-gh"]
+        assert sjournal.effective_rejections(view) == {"1"}
+        assert j.audit(
+            full=True)["sessions"]["s-gh"]["lost_waves"] == []
+        # a rejection of a number never received at all is effective
+        # (there is nothing to replay)
+        j.append("wave_rejected", key="s-gh", wave=2,
+                 reason="malformed_wave")
+        view = j.read_state().sessions["s-gh"]
+        assert sjournal.effective_rejections(view) == {"1", "2"}
 
 
 # =========================================================================
@@ -638,3 +678,202 @@ class TestSessionCLI:
         with pytest.raises(SystemExit,
                            match="at least one -i/--input"):
             serve_main([])
+
+
+# =========================================================================
+# review hardening: wave-number consumption, lock planes, keep-alive
+# framing, own-orphan re-adoption, orphan-scan throttle
+# =========================================================================
+class TestReviewHardening:
+    def test_rejection_never_voids_a_later_acked_wave(self, tmp_path):
+        """The review's lost-reads sequence, end to end: a torn upload
+        is 422-rejected, the client re-sends and gets a 202 ACK, the
+        worker dies before absorbing — the thief must replay the ACKed
+        wave (the rejection consumed its own wave number and must not
+        gate the resend)."""
+        from sam2consensus_tpu.serve.session import _count_reads
+
+        header, bodies, _ = _corpus(tmp_path, n_waves=2)
+        cfg = _cfg(tmp_path)
+        ra = _runner(tmp_path, worker="w0", ttl=0.6)
+        ma = SessionManager(ra, cfg, stability_waves=99,
+                            revote_debounce=60.0)    # hold pending
+        sid = ma.open_session(header)["sid"]
+        assert ma.receive_wave(sid, bodies[0])["status"] == "pending"
+        with pytest.raises(SessionError) as ei:
+            ma.receive_wave(sid, bodies[1],
+                            declared_sha="sha256:" + "0" * 64)
+        assert ei.value.reason == "sha_mismatch"
+        ack = ma.receive_wave(
+            sid, bodies[1],
+            declared_sha="sha256:" + sha256_hex(bodies[1]))
+        assert ack["status"] == "pending"
+        # the rejection consumed its number: no journaled wave shares
+        # a number with a journaled rejection
+        view = ra.journal.read_state().sessions[sid]
+        assert set(view["rejected"]).isdisjoint(set(view["waves"]))
+        expected = sum(_count_reads(b) for b in bodies)
+        ra.close()      # crash before any absorb; the lease expires
+
+        rb = _runner(tmp_path, worker="w1", ttl=0.6)
+        mb = SessionManager(rb, cfg, stability_waves=99,
+                            revote_debounce=0.0)
+        try:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                mb.tick()
+                if sid in mb.sessions:
+                    break
+                time.sleep(0.2)
+            assert sid in mb.sessions, "thief never adopted"
+            st = mb.status(sid)
+            assert st["absorbed"] == 2          # BOTH valid waves
+            assert st["reads_total"] == expected
+            aud = rb.journal.audit(full=True)["sessions"][sid]
+            assert aud["lost_waves"] == []
+            assert aud["duplicated_waves"] == []
+            assert aud["rejected_waves"] != []
+            # fresh ingest resumes past every journaled number,
+            # rejected ones included
+            assert mb.sessions[sid].wave_next > max(
+                int(w) for w in view["rejected"])
+        finally:
+            rb.close()
+
+    def test_observability_answers_while_a_wave_lock_is_held(
+            self, tmp_path):
+        """status(), health_summary() and OTHER sessions' ingest must
+        not queue behind one session's absorb (the review's global-
+        RLock stall): hold one session's wave lock — a stand-in for a
+        minutes-long backend run — and everything else still answers."""
+        import threading
+
+        header, bodies, _ = _corpus(tmp_path, n_waves=2)
+        r = _runner(tmp_path)
+        mgr = SessionManager(r, _cfg(tmp_path), stability_waves=99,
+                             revote_debounce=60.0)   # no backend runs
+        try:
+            s1 = mgr.open_session(header, tenant="a")["sid"]
+            s2 = mgr.open_session(header, tenant="b")["sid"]
+            mgr.receive_wave(s1, bodies[0])
+            held, release = threading.Event(), threading.Event()
+
+            def long_absorb():
+                with mgr.sessions[s1].lock:
+                    held.set()
+                    release.wait(20.0)
+
+            t = threading.Thread(target=long_absorb, daemon=True)
+            t.start()
+            assert held.wait(5.0)
+            t0 = time.monotonic()
+            st = mgr.status(s1)                 # mid-absorb probe
+            hs = mgr.health_summary()
+            ack = mgr.receive_wave(s2, bodies[1])   # another tenant
+            took = time.monotonic() - t0
+            release.set()
+            t.join(10.0)
+            assert took < 5.0, \
+                f"observability blocked {took:.1f}s behind a wave lock"
+            assert st["waves"] == 1 and st["pending"]
+            assert hs["open"] == 2
+            assert ack["status"] == "pending"
+        finally:
+            r.close()
+
+    def test_early_error_closes_keepalive_connection(self, tmp_path):
+        """An error reply sent before the request body is consumed
+        (413 on declared length) must close the connection — replying
+        and then parsing the unread body bytes as the next request
+        desyncs keep-alive into a 400 cascade."""
+        import socket
+
+        from sam2consensus_tpu.serve.stream_server import IngestServer
+
+        r = _runner(tmp_path)
+        mgr = SessionManager(r, _cfg(tmp_path), revote_debounce=0.0)
+        srv = IngestServer(mgr, port=0, max_body=1024, timeout=5.0)
+        try:
+            req = ("POST /session/open HTTP/1.1\r\nHost: t\r\n"
+                   f"Content-Length: {srv.max_body + 1}\r\n\r\n"
+                   ).encode("ascii")
+            # bytes a desynced server would parse as a second request
+            trailing = b"GET /sessions HTTP/1.1\r\nHost: t\r\n\r\n"
+            with socket.create_connection(
+                    ("127.0.0.1", srv.port), timeout=10.0) as s:
+                s.sendall(req + trailing)
+                s.settimeout(10.0)
+                buf = b""
+                while True:
+                    try:
+                        chunk = s.recv(65536)
+                    except socket.timeout:
+                        break
+                    if not chunk:
+                        break
+                    buf += chunk
+            assert buf.startswith(b"HTTP/1.1 413")
+            # exactly ONE response: the server closed instead of
+            # answering the leftover bytes as a pipelined GET
+            assert buf.count(b"HTTP/1.1 ") == 1
+        finally:
+            srv.close()
+            r.close()
+
+    def test_restarted_worker_readopts_its_own_orphans(self, tmp_path):
+        """A worker restarted under the SAME --worker-id must adopt
+        its own orphaned sessions from tick() — before the fix the
+        scan skipped any lease bearing its own id, so in a one-worker
+        fleet journaled-but-unabsorbed waves waited forever."""
+        from sam2consensus_tpu.serve.session import _count_reads
+
+        header, bodies, _ = _corpus(tmp_path, n_waves=1)
+        cfg = _cfg(tmp_path)
+        ra = _runner(tmp_path, worker="w0", ttl=0.6)
+        ma = SessionManager(ra, cfg, revote_debounce=60.0)
+        sid = ma.open_session(header)["sid"]
+        assert ma.receive_wave(sid, bodies[0])["status"] == "pending"
+        ra.close()      # crash: the journal lease stays under w0
+
+        rb = _runner(tmp_path, worker="w0", ttl=0.6)  # same id
+        mb = SessionManager(rb, cfg, revote_debounce=0.0)
+        try:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                mb.tick()
+                if sid in mb.sessions:
+                    break
+                time.sleep(0.2)
+            assert sid in mb.sessions, \
+                "restarted worker never re-adopted its own orphan"
+            st = mb.status(sid)
+            assert st["absorbed"] == 1
+            assert st["reads_total"] == _count_reads(bodies[0])
+            # recovering one's own session is not a steal
+            assert st["stolen_from"] == ""
+            assert rb.registry.value("session/steals") == 0.0
+        finally:
+            rb.close()
+
+    def test_orphan_scan_is_throttled_below_tick_rate(self, tmp_path):
+        """tick() runs at 10 Hz in the drain loop; the orphan scan (a
+        full journal tail replay from disk) must run on its own
+        lease-TTL-fraction cadence, not every tick."""
+        r = _runner(tmp_path, worker="w0", ttl=40.0)
+        mgr = SessionManager(r, _cfg(tmp_path))
+        try:
+            calls = [0]
+            orig = r.journal.read_state
+
+            def counting(*a, **k):
+                calls[0] += 1
+                return orig(*a, **k)
+
+            r.journal.read_state = counting
+            for _ in range(30):         # ~3 s of drain-loop ticks
+                mgr.tick()
+                time.sleep(0.01)
+            # ttl/4 = 10 s cadence: exactly the first tick scans
+            assert calls[0] == 1
+        finally:
+            r.close()
