@@ -686,6 +686,12 @@ class JaxBackend:
                               "min_num": _drift_min_wire_bytes()}})
 
         n_dev = len(jax.devices())
+        # typed up-front capacity check (parallel.mesh): an explicit
+        # --shards over the runtime's devices fails HERE, before any
+        # decode or compile — MeshCapacityError, not a late XLA error
+        from ..parallel.mesh import validate_shards
+
+        validate_shards(cfg.shards, n_available=n_dev)
         shards = cfg.shards if cfg.shards > 0 else n_dev
         if getattr(cfg, "pileup", "auto") == "host" and cfg.shards == 0:
             # host pileup implies single-device: an unspecified --shards
@@ -1841,12 +1847,16 @@ class JaxBackend:
             else:
                 rows, rb, imb, sfrac = 0, 0, 1.0, 0.0
             _rt, link_bps = _link_constants()
+            from ..parallel.partition import mesh_process_count
+
+            n_hosts = mesh_process_count(mesh)
             mode, mode_costs = shard_auto.shard_mode_costs(
                 layout.total_len, shards, dict(mesh.shape), rows, rb,
-                imb, sfrac, halo, link_bps)
+                imb, sfrac, halo, link_bps, n_hosts=n_hosts)
             stats.extra["shard_auto"] = {
                 "rows": int(rows), "peak_frac": round(float(imb), 2),
-                "sorted_frac": round(float(sfrac), 2), "halo": int(halo)}
+                "sorted_frac": round(float(sfrac), 2), "halo": int(halo),
+                "hosts": int(n_hosts)}
             # ledger: the model prices per-slab OVERHEAD deltas between
             # layouts, not absolute slab time — so the measured
             # per-slab dispatch seconds join is informational (band=0:

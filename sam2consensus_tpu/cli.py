@@ -773,6 +773,16 @@ def serve_main(argv: List[str]) -> int:
     if args.pileup == "host" and args.shards > 1:
         raise SystemExit("--pileup host accumulates on the single host; "
                          "it does not compose with --shards")
+    if args.shards > 1:
+        # typed capacity check BEFORE the server warms: a --shards over
+        # the runtime's device count rejects here, not as a late
+        # XLA/mesh failure on the first admitted job
+        from .parallel.mesh import MeshCapacityError, validate_shards
+
+        try:
+            validate_shards(args.shards, pileup=args.pileup)
+        except MeshCapacityError as exc:
+            raise SystemExit(f"error: {exc}") from None
     # a typo'd SLO objective must fail the server start, not silently
     # never fire (same up-front discipline as --fault-inject)
     from .observability.telemetry import parse_slo
@@ -994,6 +1004,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     if cfg.pileup == "host" and cfg.shards > 1:
         raise SystemExit("--pileup host accumulates on the single host; "
                          "it does not compose with --shards")
+    if cfg.shards > 1:
+        # typed up-front rejection (parallel.mesh.MeshCapacityError):
+        # over-device --shards must fail HERE with the remedy in the
+        # message, not as a late mesh/XLA error mid-run
+        from .parallel.mesh import MeshCapacityError, validate_shards
+
+        try:
+            validate_shards(cfg.shards, pileup=cfg.pileup)
+        except MeshCapacityError as exc:
+            raise SystemExit(f"error: {exc}") from None
     if cfg.checkpoint_dir and cfg.backend != "jax":
         raise SystemExit("--checkpoint-dir requires --backend jax")
     if cfg.incremental and not cfg.checkpoint_dir:

@@ -362,6 +362,29 @@ _HELP = {
                                           "predicted peak exceeded "
                                           "--mem-budget (queued-not-"
                                           "OOMed).",
+    "s2c_serve_admission_mesh_total": "Over-budget jobs admitted with "
+                                      "a capacity-planned 'needs K "
+                                      "hosts' mesh_shards verdict "
+                                      "instead of being shed.",
+    # mesh plane (parallel/partition.py): the s2c_mesh_* family —
+    # topology + shard/gather traffic of the sharded count tensor
+    "s2c_mesh_hosts": "Distinct processes owning the active mesh's "
+                      "devices (1 on any single-controller mesh).",
+    "s2c_mesh_shards": "Device count of the active ('dp','sp') mesh "
+                       "(the count tensor's position shard count).",
+    "s2c_mesh_planned_hosts": "Host count the admission-time "
+                              "mesh_shards capacity plan chose for "
+                              "the most recent over-budget job.",
+    "s2c_mesh_shard_bytes_total": "Bytes THIS process shipped to its "
+                                  "own devices' shards on a process-"
+                                  "spanning mesh (host label = "
+                                  "process index; counts never ride "
+                                  "DCN on the way in).",
+    "s2c_mesh_gather_bytes_total": "Bytes landed on this host by "
+                                   "cross-process gathers "
+                                   "(process_allgather tails: vote "
+                                   "symbols and stats, never raw "
+                                   "counts).",
     "s2c_serve_oom_dumps_total": "Serve jobs whose CAPACITY failure "
                                  "wrote a mem_dump.json next to the "
                                  "journal.",
@@ -554,6 +577,13 @@ def render_openmetrics(snapshot: dict,
             fam("s2c_slo_violations_total", "counter").add(
                 "", [("tenant", m.group(1) or "default"),
                      ("phase", m.group(2))], value)
+            continue
+        m = re.match(r"^mesh/shard_bytes/(\d+)$", name)
+        if m:
+            # per-host shard traffic: one labeled series per process
+            # index instead of a sanitized name per host
+            fam("s2c_mesh_shard_bytes_total", "counter").add(
+                "", [("host", m.group(1))], value)
             continue
         n = _sanitize(name)
         if not n.endswith("_total"):

@@ -55,6 +55,17 @@ def _ici_bps() -> float:
     return float(os.environ.get("S2C_ICI_GBPS", "10")) * 1e9
 
 
+def _dcn_bps() -> float:
+    """Per-host cross-host collective bandwidth on a process-spanning
+    mesh (``jax.distributed``).  DCN is the slow fabric the mesh design
+    keeps counts off of — but the per-slab collectives every layout
+    pays (reduce-scatter, window psum, halo shift) DO cross it, so on
+    a multi-host mesh they bill this rate, not ICI.  Default is
+    conservative for data-center ethernet (and the gloo CPU stand-in
+    moves loopback-speed, which the same order of magnitude covers)."""
+    return float(os.environ.get("S2C_DCN_GBPS", "1")) * 1e9
+
+
 def _route_rows_per_sec() -> float:
     """Host routing throughput: counting sort + slot-grid scatter,
     measured ~5-20 M rows/s on one core (numpy argsort dominated)."""
@@ -133,20 +144,23 @@ def slab_stats(buckets, total_len: int, wire: str = "packed5") -> tuple:
 def choose_shard_mode(total_len: int, n_devices: int, mesh_shape: dict,
                       rows_per_slab: int, row_bytes_per_slab: int,
                       peak_frac: float, sorted_frac: float,
-                      halo: int, link_bps: float) -> str:
+                      halo: int, link_bps: float,
+                      n_hosts: int = 1) -> str:
     """Pick dp / sp / dpsp by modeled per-slab overhead (module doc);
     see :func:`shard_mode_costs` for the full priced table (the
     decision ledger records it alongside the pick)."""
     mode, _costs = shard_mode_costs(
         total_len, n_devices, mesh_shape, rows_per_slab,
-        row_bytes_per_slab, peak_frac, sorted_frac, halo, link_bps)
+        row_bytes_per_slab, peak_frac, sorted_frac, halo, link_bps,
+        n_hosts=n_hosts)
     return mode
 
 
 def shard_mode_costs(total_len: int, n_devices: int, mesh_shape: dict,
                      rows_per_slab: int, row_bytes_per_slab: int,
                      peak_frac: float, sorted_frac: float,
-                     halo: int, link_bps: float) -> tuple:
+                     halo: int, link_bps: float,
+                     n_hosts: int = 1) -> tuple:
     """(chosen_mode, {mode: modeled_per_slab_overhead_sec}) — the pick
     plus every feasible candidate's priced cost, so the decision ledger
     (observability/ledger.py) can record prediction AND alternatives.
@@ -164,7 +178,13 @@ def shard_mode_costs(total_len: int, n_devices: int, mesh_shape: dict,
     n = max(1, n_devices)
     n_sp = max(1, mesh_shape.get("sp", 1))
     padded = -(-(total_len + 1) // n) * n
-    ici = _ici_bps()
+    # on a process-spanning mesh every flattened-ring collective
+    # crosses host boundaries: bill the slow fabric, not ICI — this is
+    # what makes dp's full-tensor reduce-scatter lose to sp's
+    # O(halo)/O(window) traffic on multi-host meshes even when the
+    # genome would fit dp's memory gate
+    ici = _ici_bps() if max(1, int(n_hosts)) == 1 \
+        else min(_ici_bps(), _dcn_bps())
     route = _route_rows_per_sec()
     rows = max(1, rows_per_slab)
     rb = max(1, row_bytes_per_slab)

@@ -44,6 +44,9 @@ if "check_vma" not in _SHARD_MAP_PARAMS:
         return _shard_map_native(*args, **kwargs)
 
 from ..constants import NUM_SYMBOLS, PAD_CODE
+from .partition import (gather_from_mesh, make_shard_and_gather_fns,
+                        match_partition_rules, partition_rules,
+                        publish_mesh_gauges)
 
 #: both mesh axes flattened: every collective treats the mesh as one ring
 ALL = ("dp", "sp")
@@ -61,15 +64,11 @@ def fetch_host(x: jax.Array) -> np.ndarray:
 
     Every fetch bills the run's d2h choke point (``wire.account_d2h``)
     — the gather-based sharded tails (vote symbols, tail stats, count
-    snapshots) previously bypassed ``wire/d2h_bytes`` entirely.
+    snapshots) previously bypassed ``wire/d2h_bytes`` entirely.  The
+    one implementation lives in ``parallel.partition`` next to the
+    shard path so the two directions cannot diverge.
     """
-    from ..wire import fetch_d2h
-
-    if x.is_fully_addressable or x.sharding.is_fully_replicated:
-        return fetch_d2h(x)
-    from jax.experimental import multihost_utils
-
-    return fetch_d2h(multihost_utils.process_allgather(x, tiled=True))
+    return gather_from_mesh(x)
 
 
 def record_slab(key: str, t0: float, n_rows: int, width: int) -> None:
@@ -231,8 +230,37 @@ class ShardedCountsBase:
         # accumulate at chromosome scale (250 Mbp) via ShapeDtypeStruct
         # without ever materializing the tensor
         self._counts = None
-        self._row_spec = NamedSharding(mesh, P(ALL))
-        self._mat_spec = NamedSharding(mesh, P(ALL, None))
+        # every placement this accumulator makes comes from the ONE
+        # partition-rule table (parallel/partition.py): named arrays →
+        # PartitionSpecs, matched once here, turned into shard/gather
+        # fns that are multi-host aware (per-process window shipping,
+        # d2h-billed gathers).  _row_spec/_mat_spec remain as derived
+        # views because the jitted decode needs raw shardings for
+        # out_shardings.
+        self.partition_specs = match_partition_rules(
+            partition_rules(pos_axes), {
+                "counts": jax.ShapeDtypeStruct(
+                    (self.padded_len, NUM_SYMBOLS), jnp.int32),
+                "row_starts": jax.ShapeDtypeStruct((0,), jnp.int32),
+                "row_codes": jax.ShapeDtypeStruct((0, 0), jnp.uint8),
+                "kernel_rank": jax.ShapeDtypeStruct((0,), jnp.int32),
+                "kernel_aux": jax.ShapeDtypeStruct((0, 0), jnp.int32),
+                "wire_lane": jax.ShapeDtypeStruct((0,), jnp.uint8),
+                "vote_syms": jax.ShapeDtypeStruct((0, 0), jnp.uint8),
+                "insertion_bank": jax.ShapeDtypeStruct((0, 0), jnp.int32),
+                "thresholds": jax.ShapeDtypeStruct((0,), jnp.uint8),
+                "contig_offsets": jax.ShapeDtypeStruct((0,), jnp.int32),
+                "site_keys": jax.ShapeDtypeStruct((0,), jnp.int32),
+                "contig_sums": jax.ShapeDtypeStruct((0,), jnp.int32),
+                "site_cov": jax.ShapeDtypeStruct((0,), jnp.int32),
+            })
+        self._shard_fns, self._gather_fns = make_shard_and_gather_fns(
+            mesh, self.partition_specs)
+        publish_mesh_gauges(mesh)
+        self._row_spec = NamedSharding(
+            mesh, self.partition_specs["row_starts"])
+        self._mat_spec = NamedSharding(
+            mesh, self.partition_specs["row_codes"])
         self.bytes_h2d = 0                     # wire accounting for bench
 
     def put_rows(self, starts: np.ndarray, codes: np.ndarray):
@@ -261,8 +289,8 @@ class ShardedCountsBase:
             self.bytes_h2d += starts.nbytes + packed.nbytes
             account_h2d(starts.nbytes + packed.nbytes)
             account_wire("packed5", starts.nbytes + packed.nbytes, raw)
-            return (jax.device_put(starts, self._row_spec),
-                    jax.device_put(packed, self._mat_spec))
+            return (self._shard_fns["row_starts"](starts),
+                    self._shard_fns["row_codes"](packed))
         if self._wire_decode is None:
             from ..wire import device as wire_device
 
@@ -270,13 +298,23 @@ class ShardedCountsBase:
                 out_shardings=(self._row_spec, self._mat_spec))
         # every lane is chunk-major: sharding dim 0 over the flattened
         # mesh puts each chunk's lanes on the device that owns its rows
-        ops = tuple(jax.device_put(a, NamedSharding(self.mesh, P(ALL)))
+        # (rule ``wire_lane``; on a process-spanning mesh each host
+        # ships only the chunks its own devices decode)
+        ops = tuple(self._shard_fns["wire_lane"](a)
                     for a in slab.arrays())
         self.bytes_h2d += slab.wire_bytes
         account_h2d(slab.wire_bytes)
         account_wire("delta8", slab.wire_bytes, raw)
         return self._wire_decode(*ops, width=slab.width,
                                  sentinel=slab.sentinel)
+
+    def ship_kernel_operand(self, a: np.ndarray) -> jax.Array:
+        """Ship one routed-kernel side operand (MXU slot grids, Pallas
+        rank/block lanes) under the partition table: 1-d lanes ride the
+        ``kernel_rank`` rule, matrices ``kernel_aux`` — the same row
+        ring as the slab operands they accompany."""
+        name = "kernel_rank" if a.ndim == 1 else "kernel_aux"
+        return self._shard_fns[name](a)
 
     def sync(self) -> None:
         """Profiling barrier (S2C_SYNC_ACCUMULATE): block until every
@@ -299,35 +337,47 @@ class ShardedCountsBase:
     def counts(self) -> jax.Array:
         """Position-sharded counts including pad rows ([padded_len, 6])."""
         if self._counts is None:
-            self._counts = jax.device_put(
-                jnp.zeros((self.padded_len, NUM_SYMBOLS), dtype=jnp.int32),
-                NamedSharding(self.mesh, P(self.pos_axes, None)))
+            # must be jax-owned (jnp, not np): device_put of raw numpy can
+            # zero-copy alias host memory on cpu, and the fused tail /
+            # scatter kernels DONATE this buffer — aliased donation
+            # corrupts warm serve jobs (fleet byte-identity catches it)
+            self._counts = self._shard_fns["counts"](
+                jnp.zeros((self.padded_len, NUM_SYMBOLS), dtype=jnp.int32))
             self._track_counts()
         return self._counts
 
     def counts_host(self) -> np.ndarray:
         """Valid counts on host, ``[total_len, 6]``."""
-        return fetch_host(self.counts)[: self.total_len]
+        return self._gather_fns["counts"](self.counts)[: self.total_len]
 
     def restore(self, counts: np.ndarray) -> None:
         """Load checkpointed counts (``[total_len, 6]``), re-sharded."""
         padded = np.zeros((self.padded_len, NUM_SYMBOLS), dtype=np.int32)
         padded[: self.total_len] = counts
-        self._counts = jax.device_put(
-            jnp.asarray(padded),
-            NamedSharding(self.mesh, P(self.pos_axes, None)))
+        # jnp.asarray first: same donation/aliasing constraint as counts()
+        self._counts = self._shard_fns["counts"](jnp.asarray(padded))
         self._track_counts()
 
     def _track_counts(self) -> None:
         """Residency accounting for the sharded count tensor — once per
         accumulator (lazy alloc and checkpoint restore both land here),
-        released with the accumulator (observability/memplane.py)."""
+        released with the accumulator (observability/memplane.py).  On
+        a process-spanning mesh THIS process is resident for only its
+        addressable fraction of the tensor — billing the global bytes
+        would make every host's tracked peak read as if it held the
+        whole genome, exactly the per-host headroom the mesh_shards
+        capacity planner needs to see."""
         if not getattr(self, "_mem_tracked", False):
             self._mem_tracked = True
             from ..observability import memplane
 
-            memplane.track_obj("counts", self,
-                               self.padded_len * NUM_SYMBOLS * 4)
+            n_local = sum(
+                d.process_index == jax.process_index()
+                for d in np.asarray(self.mesh.devices).reshape(-1))
+            frac = n_local / max(1, self.n)
+            memplane.track_obj(
+                "counts", self,
+                int(self.padded_len * NUM_SYMBOLS * 4 * frac))
 
     # -- vote -------------------------------------------------------------
     def vote(self, thr_enc: np.ndarray, min_depth: int) -> np.ndarray:
@@ -347,7 +397,7 @@ class ShardedCountsBase:
             return syms
 
         syms = jax.jit(voted)(self.counts, jnp.asarray(thr_enc))
-        return fetch_host(syms)[:, : self.total_len]
+        return self._gather_fns["vote_syms"](syms)[:, : self.total_len]
 
     def tail_stats(self, offsets: np.ndarray, site_keys: np.ndarray
                    ) -> Tuple[np.ndarray, np.ndarray]:
@@ -386,5 +436,6 @@ class ShardedCountsBase:
         contig_sums, site_cov = jax.jit(stats)(
             self.counts, jnp.asarray(offsets.astype(np.int32)),
             jnp.asarray(site_keys.astype(np.int32)))
-        return (fetch_host(contig_sums).astype(np.int64),
-                fetch_host(site_cov).astype(np.int64))
+        return (self._gather_fns["contig_sums"](contig_sums)
+                .astype(np.int64),
+                self._gather_fns["site_cov"](site_cov).astype(np.int64))

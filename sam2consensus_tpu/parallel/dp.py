@@ -261,9 +261,9 @@ class ShardedConsensus(ShardedCountsBase):
                     p_starts.astype(np.int32), p_codes)
                 self._counts = fn(
                     self.counts, st_dev, pk_dev,
-                    jax.device_put(plan.rank.reshape(-1), self._row_spec),
-                    jax.device_put(plan.blk_lo, self._mat_spec),
-                    jax.device_put(plan.blk_n, self._mat_spec))
+                    self.ship_kernel_operand(plan.rank.reshape(-1)),
+                    self.ship_kernel_operand(plan.blk_lo),
+                    self.ship_kernel_operand(plan.blk_n))
 
             def exec_mxu(plan):
                 p_starts, p_codes, slots, e = plan
@@ -273,7 +273,7 @@ class ShardedConsensus(ShardedCountsBase):
                 st_dev, pk_dev = self.put_rows(p_starts, p_codes)
                 self._counts = fn(
                     self.counts, st_dev, pk_dev,
-                    jax.device_put(slots, self._row_spec))
+                    self.ship_kernel_operand(slots))
 
             def exec_scatter():
                 s = len(starts)

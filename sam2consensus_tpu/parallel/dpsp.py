@@ -230,9 +230,8 @@ class ProductShardedConsensus(ShardedCountsBase):
                 extra = (slots.reshape(-1),)
             staged.append((lo, hi, sl, fn, extra))
         for lo, hi, sl, fn, extra in staged:
-            extra_dev = tuple(
-                jax.device_put(a, self._row_spec if a.ndim == 1
-                               else self._mat_spec) for a in extra)
+            extra_dev = tuple(self.ship_kernel_operand(a)
+                              for a in extra)
             self.bytes_h2d += sum(a.nbytes for a in extra)
             account_h2d(sum(a.nbytes for a in extra))
             st_dev, pk_dev = self.put_rows(

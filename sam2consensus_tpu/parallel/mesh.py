@@ -25,6 +25,49 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+class MeshCapacityError(ValueError):
+    """Typed up-front rejection of an unplaceable mesh request.
+
+    Raised at CLI parse / serve admission / backend config resolution —
+    BEFORE any XLA compilation — when ``--shards`` asks for more
+    devices than the runtime has, or combines with a host-only pileup.
+    Subclasses ``ValueError`` so every existing reject-with-reason
+    path (CLI SystemExit mapping, serve ``_validate``) keeps working.
+    """
+
+
+def available_devices() -> int:
+    """Global device count the mesh can draw on (honors
+    ``JAX_PLATFORMS`` / ``--xla_force_host_platform_device_count``
+    forcing and ``jax.distributed`` process-spanning runtimes)."""
+    return len(jax.devices())
+
+
+def validate_shards(shards: int, n_available: Optional[int] = None,
+                    pileup: Optional[str] = None) -> None:
+    """Reject impossible ``--shards`` requests up front, typed.
+
+    The late failure this replaces: ``make_mesh`` raising deep inside
+    backend construction after the input was already opened and the
+    first batch staged — or worse, XLA failing on a device put.  Both
+    CLI and serve admission call this before any work is committed.
+    """
+    if shards is None or shards <= 1:
+        return
+    if pileup == "host":
+        raise MeshCapacityError(
+            "--pileup host accumulates on the single host; it does "
+            "not compose with --shards")
+    if n_available is None:
+        n_available = available_devices()
+    if shards > n_available:
+        raise MeshCapacityError(
+            f"--shards {shards} exceeds the {n_available} available "
+            f"device(s): shrink --shards, or widen the mesh "
+            f"(more hosts via jax.distributed, or "
+            f"--xla_force_host_platform_device_count on CPU)")
+
+
 def factor_mesh(n: int) -> Tuple[int, int]:
     """Split ``n`` devices into (dp, sp), preferring a balanced 2-D mesh."""
     sp = 1
@@ -42,7 +85,7 @@ def make_mesh(n_devices: Optional[int] = None,
         devices = jax.devices()
     if n_devices is not None:
         if n_devices > len(devices):
-            raise ValueError(
+            raise MeshCapacityError(
                 f"requested {n_devices} devices, have {len(devices)}")
         devices = devices[:n_devices]
     dp, sp = factor_mesh(len(devices))

@@ -1,0 +1,250 @@
+"""Rule-driven PartitionSpecs for every named array the mesh touches.
+
+Before this module each sharded accumulator placed its operands with
+ad-hoc ``NamedSharding`` literals scattered through ``put_rows`` /
+``fetch_host`` call sites — fine on one host, but a multi-host mesh
+(``jax.distributed`` over DCN) needs every placement decision in ONE
+auditable place: which arrays shard over the position ring, which ride
+the row ring, which stay replicated, and which may legally cross the
+slow fabric on the way home.  The shape is the classic LLM-scale
+pattern (SNIPPETS.md [2]/[3]): a regex→PartitionSpec *rule table*
+matched against array NAMES, plus factories that turn the matched
+specs into shard/gather functions.
+
+* :data:`PARTITION_RULES` / :func:`partition_rules` — the table.  One
+  ordered list of ``(regex, PartitionSpec)``; first match wins; a name
+  no rule covers raises (placement must never be accidental).
+* :func:`match_partition_rules` — names → specs, scalars replicated.
+* :func:`make_shard_and_gather_fns` — specs → per-name shard fns
+  (host array → mesh-placed ``jax.Array``; on a process-spanning mesh
+  each host ships ONLY its addressable window's rows) and gather fns
+  (mesh array → host, billed through the ``wire`` d2h choke point).
+
+The shard path is the multi-host rung of ``put_rows``: on a
+single-controller mesh it is a plain ``device_put`` (XLA splits
+locally, no copy crosses any fabric it shouldn't); when the mesh spans
+processes it assembles the global array from per-device slices of the
+host value via ``make_array_from_single_device_arrays``, so the bytes
+leaving THIS host are exactly its own devices' shards — the DCN never
+carries another host's rows.  Both paths bill ``wire.account_h2d``
+with the LOCAL bytes only and feed the ``mesh/*`` gauges the
+``s2c_mesh_*`` OpenMetrics family renders.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, Mapping, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: both mesh axes flattened: every collective treats the mesh as one ring
+#: (mirrors parallel.base.ALL; redefined here so the table imports
+#: jax-light)
+ALL = ("dp", "sp")
+
+
+def partition_rules(pos_axes: Tuple[str, str] = ALL
+                    ) -> Tuple[Tuple[str, P], ...]:
+    """The partition-rule table for one accumulator layout.
+
+    ``pos_axes`` is the mesh-axis ordering of the position sharding —
+    the flattened ``("dp", "sp")`` ring for the dp/sp pipelines,
+    ``("sp", "dp")`` for the dpsp product mode — exactly the knob
+    ``ShardedCountsBase`` already threads through every spec.
+
+    Rules are ordered; the FIRST match wins.  Names:
+
+    * ``counts`` — the position-sharded count tensor ``[padded, 6]``;
+    * ``row_starts`` / ``kernel_rank`` — per-row int32 lanes, sharded
+      over the flattened row ring (each device owns its slice's rows);
+    * ``row_codes`` / ``kernel_aux`` — per-row matrices (packed nibble
+      lanes, MXU slot grids), row-sharded, trailing dim local;
+    * ``wire_lane*`` — chunk-major delta8 codec lanes: sharding dim 0
+      over the ring lands each chunk's lanes on the device that owns
+      its rows, so the decode is shard-local by construction;
+    * ``vote_syms`` — the vote's ``[T, padded]`` symbol planes,
+      position-sharded on the SECOND axis (threshold axis replicated);
+    * ``insertion_bank*`` — host-built insertion evidence shipped for
+      device-side filtering: row-sharded like every per-row operand;
+    * ``thresholds`` / ``contig_offsets`` / ``site_keys`` /
+      ``contig_sums`` / ``site_cov`` — small control/stat vectors,
+      replicated (every device needs them whole; crossing DCN for
+      these is the design: base strings and stats move, counts don't).
+    """
+    pos = tuple(pos_axes)
+    return (
+        (r"^counts$",               P(pos, None)),
+        (r"^row_starts$",           P(ALL)),
+        (r"^kernel_rank$",          P(ALL)),
+        (r"^row_codes$",            P(ALL, None)),
+        (r"^kernel_aux$",           P(ALL, None)),
+        (r"^wire_lane(_[a-z0-9]+)?$", P(ALL)),
+        (r"^vote_syms$",            P(None, pos)),
+        (r"^insertion_bank(_[a-z0-9]+)?$", P(ALL, None)),
+        (r"^(thresholds|contig_offsets|site_keys|contig_sums|site_cov)$",
+         P()),
+    )
+
+
+#: the default (flattened-ring) table — what dp and sp use
+PARTITION_RULES: Tuple[Tuple[str, P], ...] = partition_rules()
+
+
+def matching_rules(rules: Sequence[Tuple[str, P]], name: str):
+    """Every rule whose regex matches ``name`` (test surface: the
+    canonical names must each match EXACTLY one rule)."""
+    return [(pat, spec) for pat, spec in rules if re.search(pat, name)]
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]],
+                          named: Mapping[str, object]) -> Dict[str, P]:
+    """Map array names to PartitionSpecs via the rule table.
+
+    ``named`` maps name → array-like (anything with ``ndim``/``shape``,
+    including ``jax.ShapeDtypeStruct``) or a scalar.  Scalars and
+    0-d arrays replicate (``P()``) without consulting the table —
+    there is nothing to shard.  A non-scalar name no rule matches
+    raises ``ValueError``: silent replication of a tensor that should
+    have been sharded is exactly the OOM this module exists to prevent.
+    """
+    specs: Dict[str, P] = {}
+    for name, arr in named.items():
+        ndim = getattr(arr, "ndim", None)
+        if ndim is None:
+            ndim = np.ndim(arr)
+        if ndim == 0:
+            specs[name] = P()
+            continue
+        hits = matching_rules(rules, name)
+        if not hits:
+            raise ValueError(
+                f"partition rules don't cover array {name!r} "
+                f"(shape {getattr(arr, 'shape', ())}): add a rule to "
+                f"parallel.partition.partition_rules — placement must "
+                f"never be accidental")
+        spec = hits[0][1]
+        if len([a for a in spec if a is not None]) > ndim:
+            raise ValueError(
+                f"partition rule {hits[0][0]!r} wants "
+                f"{len(tuple(spec))} dims but {name!r} has {ndim}")
+        specs[name] = spec
+    return specs
+
+
+def _record_mesh_bytes(counter: str, nbytes: int) -> None:
+    """Bill one shard/gather transfer to the ``mesh/*`` plane (the
+    ``s2c_mesh_*`` exposition family; observability is optional and
+    must never break shipping)."""
+    if nbytes <= 0:
+        return
+    try:
+        from .. import observability as obs
+
+        obs.metrics().add(counter, int(nbytes))
+    except Exception:
+        pass
+
+
+def shard_to_mesh(arr, sharding: NamedSharding,
+                  force_assemble: bool = False) -> jax.Array:
+    """Place one host array on the mesh under ``sharding``.
+
+    Single-controller meshes take the plain ``device_put``.  When the
+    mesh spans processes, the host value (identical on every process —
+    multi-controller SPMD feeds the same globals) is sliced to THIS
+    process's addressable windows and assembled with
+    ``make_array_from_single_device_arrays``: each host ships only the
+    rows its own devices own, so count-plane operands never ride DCN.
+    ``force_assemble`` takes the per-device assembly path even on a
+    single-controller mesh — the test surface for the multi-host rung
+    (every virtual-device test can exercise the exact code a real DCN
+    mesh runs).
+
+    Caveat: ``device_put`` of a raw numpy array may zero-copy alias the
+    host buffer (cpu backend).  Callers whose placed array is later
+    DONATED (the counts plane) must pass a jax-owned array
+    (``jnp.asarray`` first) or the donation scribbles over aliased
+    memory.
+    """
+    mesh_devs = getattr(sharding, "mesh", None)
+    spans = force_assemble or (
+        jax.process_count() > 1 and mesh_devs is not None and any(
+            d.process_index != jax.process_index()
+            for d in np.asarray(sharding.mesh.devices).reshape(-1)))
+    if not spans:
+        return jax.device_put(arr, sharding)
+    arr = np.asarray(arr)
+    idx_map = sharding.addressable_devices_indices_map(arr.shape)
+    local = [(d, np.ascontiguousarray(arr[idx]))
+             for d, idx in idx_map.items()]
+    _record_mesh_bytes(f"mesh/shard_bytes/{jax.process_index()}",
+                       sum(s.nbytes for _d, s in local))
+    shards = [jax.device_put(s, d) for d, s in local]
+    return jax.make_array_from_single_device_arrays(
+        arr.shape, sharding, shards)
+
+
+def gather_from_mesh(x: jax.Array) -> np.ndarray:
+    """Host copy of a mesh-placed array, billed through the wire's d2h
+    choke point; process-spanning shards assemble via one
+    ``process_allgather`` (the only collective that legally moves
+    count-plane data over DCN — and the tails that ride it are base
+    strings and stats, not counts)."""
+    from ..wire import fetch_d2h
+
+    if x.is_fully_addressable or x.sharding.is_fully_replicated:
+        return fetch_d2h(x)
+    from jax.experimental import multihost_utils
+
+    out = fetch_d2h(multihost_utils.process_allgather(x, tiled=True))
+    _record_mesh_bytes("mesh/gather_bytes", out.nbytes)
+    return out
+
+
+def make_shard_and_gather_fns(mesh: Mesh, specs: Mapping[str, P]
+                              ) -> Tuple[Dict[str, Callable],
+                                         Dict[str, Callable]]:
+    """Per-name shard/gather functions from matched PartitionSpecs.
+
+    ``shard_fns[name](host_array)`` returns the mesh-placed
+    ``jax.Array`` (multi-host aware, h2d-billed by the caller's wire
+    path); ``gather_fns[name](mesh_array)`` returns the host value
+    (d2h-billed).  The pytree-of-functions shape mirrors the exemplar
+    (SNIPPETS.md [3]) so downstream code can thread them like specs.
+    """
+    shard_fns: Dict[str, Callable] = {}
+    gather_fns: Dict[str, Callable] = {}
+    for name, spec in specs.items():
+        sharding = NamedSharding(mesh, spec)
+
+        def shard_fn(arr, _s=sharding):
+            return shard_to_mesh(arr, _s)
+
+        shard_fns[name] = shard_fn
+        gather_fns[name] = gather_from_mesh
+    return shard_fns, gather_fns
+
+
+def mesh_process_count(mesh: Mesh) -> int:
+    """Distinct OS processes owning this mesh's devices (1 on any
+    single-controller mesh; the ``s2c_mesh_hosts`` gauge)."""
+    return len({d.process_index
+                for d in np.asarray(mesh.devices).reshape(-1)})
+
+
+def publish_mesh_gauges(mesh: Mesh) -> None:
+    """Surface the mesh's shape to the metrics plane: hosts, shard
+    count, per-host addressable shard bytes come from the shard path's
+    counters; these two gauges pin the topology every row of the
+    MULTICHIP bench joins against."""
+    try:
+        from .. import observability as obs
+
+        reg = obs.metrics()
+        reg.gauge("mesh/hosts").set(mesh_process_count(mesh))
+        reg.gauge("mesh/shards").set(mesh.size)
+    except Exception:
+        pass

@@ -62,6 +62,13 @@ class Decision:
 
     admitted: bool
     reason: Optional[str] = None        # set iff rejected
+    #: capacity-planned mesh scale-up verdict: the job's predicted
+    #: peak exceeds one host's ``mem_budget`` but the memory plane's
+    #: ``mesh_shards`` plan (observability/memplane.plan_mesh_shards)
+    #: fits it on this many hosts — "this job needs K hosts", decided
+    #: at admission time instead of discovered as an OOM.  None on
+    #: single-host admits and on rejects.
+    mesh_shards: Optional[int] = None
 
 
 @dataclass
@@ -79,6 +86,13 @@ class AdmissionController:
     #: REASON_CAPACITY.  Parsed with the count-cache size grammar
     #: (``--mem-budget 4G`` / S2C_MEM_BUDGET).
     mem_budget: int = 0
+    #: hosts the fleet can dedicate to ONE mesh-sharded job
+    #: (S2C_MESH_HOSTS; 0 = no mesh scale-out — over-budget jobs shed
+    #: as before).  When > 1, an over-budget job is priced by
+    #: ``memplane.plan_mesh_shards`` and admitted with a "needs K
+    #: hosts" verdict if its per-host peak fits the budget on
+    #: K <= mesh_hosts hosts.
+    mesh_hosts: int = 0
     _window_admitted: int = 0
     _window_by_tenant: Dict[str, int] = field(default_factory=dict)
     #: tenant -> rung its last degraded job landed on ("host"/"device_scatter")
@@ -115,26 +129,36 @@ class AdmissionController:
                 self._window_by_tenant.get(tenant, 0) + n
 
     def admit(self, tenant: str = "",
-              predicted_bytes: Optional[int] = None) -> Decision:
+              predicted_bytes: Optional[int] = None,
+              shard_plan: Optional[dict] = None) -> Decision:
         """One spec's verdict.  ``predicted_bytes`` is the memory
         plane's capacity prediction for the job (None = unpriceable —
         header unreadable; admitted, the serial path surfaces the real
         error): a prediction over ``mem_budget`` sheds the job instead
-        of letting it OOM the warm server."""
+        of letting it OOM the warm server — UNLESS ``shard_plan`` (the
+        memory plane's ``mesh_shards`` verdict,
+        ``observability.memplane.plan_mesh_shards``) says the job fits
+        sharded across K > 1 hosts, in which case it is admitted with
+        ``Decision.mesh_shards = K``: capacity planning replaces
+        capacity shedding whenever the fleet has the hosts."""
         if self.max_queue and self._window_admitted >= self.max_queue:
             return Decision(False, reason=REASON_QUEUE_FULL)
         if (self.tenant_quota and tenant
                 and self._window_by_tenant.get(tenant, 0)
                 >= self.tenant_quota):
             return Decision(False, reason=REASON_TENANT_QUOTA)
+        mesh_shards = None
         if (self.mem_budget and predicted_bytes is not None
                 and predicted_bytes > self.mem_budget):
-            return Decision(False, reason=REASON_CAPACITY)
+            if not (shard_plan and shard_plan.get("fits")
+                    and int(shard_plan.get("hosts", 1)) > 1):
+                return Decision(False, reason=REASON_CAPACITY)
+            mesh_shards = int(shard_plan["hosts"])
         self._window_admitted += 1
         if tenant:
             self._window_by_tenant[tenant] = \
                 self._window_by_tenant.get(tenant, 0) + 1
-        return Decision(True)
+        return Decision(True, mesh_shards=mesh_shards)
 
     def price_wave(self, tenant: str = "", body_bytes: int = 0,
                    pending_waves: int = 0,

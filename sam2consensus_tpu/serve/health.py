@@ -200,6 +200,27 @@ def snapshot(runner) -> dict:
     from ..observability import memplane
 
     snap["memory"] = memplane.summary()
+    # mesh plane (parallel/partition.py): topology of the active
+    # sharded mesh + the admission-time capacity plan — only present
+    # once a sharded accumulator ran or a mesh_shards verdict fired,
+    # so single-host servers keep their old snapshot shape
+    g = reg_snap["gauges"]
+    if ("mesh/shards" in g or "mesh/planned_hosts" in g
+            or runner.admission.mesh_hosts):
+        shard_bytes = {
+            name.rsplit("/", 1)[1]: int(value)
+            for name, value in reg_snap["counters"].items()
+            if name.startswith("mesh/shard_bytes/")}
+        snap["mesh"] = {
+            "hosts": int(g.get("mesh/hosts", {}).get("value", 1)),
+            "shards": int(g.get("mesh/shards", {}).get("value", 0)),
+            "mesh_hosts_capacity": int(runner.admission.mesh_hosts),
+            "planned_hosts": int(g.get("mesh/planned_hosts",
+                                       {}).get("value", 0)) or None,
+            "admitted_mesh": int(reg.value("serve/admission_mesh")),
+            "shard_bytes_by_host": shard_bytes,
+            "gather_bytes": int(reg.value("mesh/gather_bytes")),
+        }
     if runner.admission.mem_budget:
         snap["memory"]["mem_budget_mb"] = round(
             runner.admission.mem_budget / 1e6, 1)

@@ -400,9 +400,19 @@ class ServeRunner:
         except ValueError as exc:
             raise ValueError(str(exc).replace(
                 "--count-cache", "--mem-budget")) from None
+        # mesh scale-out capacity (hosts the fleet can dedicate to one
+        # sharded job): lets the capacity gate PLAN instead of shed —
+        # an over-budget job admitted with a "needs K hosts"
+        # mesh_shards verdict (observability/memplane.plan_mesh_shards)
+        try:
+            _mesh_hosts = int(os.environ.get("S2C_MESH_HOSTS", "0"))
+        except ValueError:
+            raise ValueError(
+                "S2C_MESH_HOSTS must be an integer host count") \
+                from None
         self.admission = AdmissionController(
             max_queue=max_queue, tenant_quota=tenant_quota,
-            mem_budget=_mem_budget)
+            mem_budget=_mem_budget, mesh_hosts=_mesh_hosts)
         # -- continuous batching (serve/scheduler.py) -----------------
         # a typo'd --batch must fail the server start, same discipline
         # as --slo / --fault-inject
@@ -645,6 +655,15 @@ class ServeRunner:
                 "--pileup host accumulates on the single host; it does "
                 "not compose with --shards (same contract as the "
                 "one-shot CLI)")
+        if spec.config.shards > 1 and spec.config.backend != "cpu":
+            # typed up-front capacity check (parallel.mesh
+            # MeshCapacityError): a --shards over the runtime's device
+            # count must reject at admission, not as a late XLA/mesh
+            # failure after the queue is journaled and inputs staged
+            from ..parallel.mesh import validate_shards
+
+            validate_shards(spec.config.shards,
+                            pileup=spec.config.pileup)
         if self.journal is not None:
             # journal mode injects a per-job checkpoint_dir, and BAM
             # inputs do not support checkpoint resume yet — failing the
@@ -1113,6 +1132,7 @@ class ServeRunner:
             # batch scheduler's cached-handle discipline, so a later
             # pack/decode never re-sniffs the container
             predicted = None
+            shard_plan = None
             if self.admission.mem_budget:
                 total_len = self.scheduler._probe_total_len(entry)
                 if total_len:
@@ -1121,6 +1141,18 @@ class ServeRunner:
                     predicted = memplane.predict_job_peak_bytes(
                         total_len, spec.config)
                     entry["mem_predicted"] = predicted
+                    if (predicted > self.admission.mem_budget
+                            and self.admission.mesh_hosts > 1):
+                        # the memory plane as planner: price the job
+                        # per-host across K hosts and admit it with a
+                        # "needs K hosts" verdict when it fits the
+                        # fleet, instead of shedding it (the
+                        # mesh_shards ledger decision records the
+                        # choice + its alternatives)
+                        shard_plan = memplane.plan_mesh_shards(
+                            total_len, spec.config,
+                            budget_bytes=self.admission.mem_budget,
+                            max_hosts=self.admission.mesh_hosts)
                 if not self.scheduler.enabled:
                     # without batching nothing downstream reuses the
                     # probe handle (decode-ahead re-opens per job) —
@@ -1130,7 +1162,13 @@ class ServeRunner:
                     if ai is not None:
                         ai.close()
             dec = self.admission.admit(spec.tenant,
-                                       predicted_bytes=predicted)
+                                       predicted_bytes=predicted,
+                                       shard_plan=shard_plan)
+            if dec.admitted and dec.mesh_shards:
+                entry["mesh_shards"] = dec.mesh_shards
+                self.registry.add("serve/admission_mesh", 1)
+                self.registry.gauge("mesh/planned_hosts").set(
+                    dec.mesh_shards)
             if not dec.admitted:
                 entry["action"] = "reject"
                 entry["admission"] = dec.reason
@@ -1183,7 +1221,9 @@ class ServeRunner:
                         filename=os.path.abspath(
                             entry["spec"].filename),
                         outfolder=entry["spec"].config.outfolder,
-                        tenant=entry["spec"].tenant or "")
+                        tenant=entry["spec"].tenant or "",
+                        **({"mesh_shards": entry["mesh_shards"]}
+                           if entry.get("mesh_shards") else {}))
                     # mirror of the append's own stamp (same clock,
                     # same 1 ms rounding) — saves a replay per job
                     self._submit_unix.setdefault(

@@ -1,0 +1,343 @@
+"""Multi-host mesh scale-up (ISSUE 18): the partition-rule table, the
+shard/gather rungs it drives, typed ``--shards`` capacity validation,
+and the capacity-planned ``mesh_shards`` admission verdict.
+
+* every canonical array name matches EXACTLY one rule (both mesh-axis
+  orderings), an uncovered non-scalar raises — placement must never be
+  accidental;
+* shard→gather round-trips are byte-identical on the 8-virtual-device
+  mesh, including the per-device assembly path a real process-spanning
+  mesh takes (``force_assemble``), which bills this host's shard bytes;
+* impossible ``--shards`` requests fail up front with
+  ``MeshCapacityError`` at every entry point (helper, backend, CLI);
+* the memory plane picks the minimal host count K that fits the
+  budget, records the ``mesh_shards`` ledger decision, and the
+  admission controller turns it into an admit-with-K verdict instead
+  of a capacity shed.
+"""
+
+import io
+import re
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sam2consensus_tpu import observability as obs
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.observability import memplane
+from sam2consensus_tpu.observability import telemetry as T
+from sam2consensus_tpu.observability.metrics import (MetricsRegistry,
+                                                     pop_run, push_run)
+from sam2consensus_tpu.parallel import partition
+from sam2consensus_tpu.parallel.mesh import (MeshCapacityError, make_mesh,
+                                             validate_shards)
+from sam2consensus_tpu.serve.admission import (REASON_CAPACITY,
+                                               AdmissionController)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    memplane._reset_for_tests()
+    yield
+    memplane._reset_for_tests()
+
+
+#: every array name the accumulators ship through the shard path
+CANONICAL_NAMES = (
+    "counts", "row_starts", "row_codes", "kernel_rank", "kernel_aux",
+    "wire_lane", "wire_lane_lo", "wire_lane_hi", "vote_syms",
+    "insertion_bank", "insertion_bank_rows", "thresholds",
+    "contig_offsets", "site_keys", "contig_sums", "site_cov",
+)
+
+
+# =========================================================================
+# The rule table
+# =========================================================================
+class TestRuleTable:
+    @pytest.mark.parametrize("pos_axes", [("dp", "sp"), ("sp", "dp")])
+    def test_each_canonical_name_matches_exactly_one_rule(self, pos_axes):
+        rules = partition.partition_rules(pos_axes)
+        for name in CANONICAL_NAMES:
+            hits = partition.matching_rules(rules, name)
+            assert len(hits) == 1, \
+                f"{name!r} matched {len(hits)} rules: {hits}"
+
+    def test_expected_specs(self):
+        named = {
+            "counts": jax.ShapeDtypeStruct((64, 6), np.int32),
+            "vote_syms": jax.ShapeDtypeStruct((2, 64), np.uint8),
+            "wire_lane_lo": jax.ShapeDtypeStruct((128,), np.uint8),
+            "row_codes": jax.ShapeDtypeStruct((128, 4), np.uint8),
+            "thresholds": jax.ShapeDtypeStruct((3,), np.float32),
+        }
+        specs = partition.match_partition_rules(
+            partition.PARTITION_RULES, named)
+        assert specs["counts"] == P(("dp", "sp"), None)
+        assert specs["vote_syms"] == P(None, ("dp", "sp"))
+        assert specs["wire_lane_lo"] == P(("dp", "sp"))
+        assert specs["row_codes"] == P(("dp", "sp"), None)
+        assert specs["thresholds"] == P()
+        # dpsp's product ordering threads straight through to the spec
+        flipped = partition.match_partition_rules(
+            partition.partition_rules(("sp", "dp")), named)
+        assert flipped["counts"] == P(("sp", "dp"), None)
+        assert flipped["vote_syms"] == P(None, ("sp", "dp"))
+        # the row ring is ordering-independent (always the flat ring)
+        assert flipped["row_codes"] == P(("dp", "sp"), None)
+
+    def test_uncovered_name_raises(self):
+        with pytest.raises(ValueError,
+                           match="partition rules don't cover"):
+            partition.match_partition_rules(
+                partition.PARTITION_RULES,
+                {"mystery_plane": np.zeros((4, 4), np.int32)})
+
+    def test_scalars_replicate_without_a_rule(self):
+        specs = partition.match_partition_rules(
+            partition.PARTITION_RULES,
+            {"n_reads": 3, "zero_d": np.float32(1.5)})
+        assert specs == {"n_reads": P(), "zero_d": P()}
+
+    def test_rule_dim_overflow_raises(self):
+        # canonical rules never over-ask; a custom table that wants
+        # more sharded dims than the array has must fail loudly
+        rules = ((r"^x$", P("dp", "sp")),)
+        with pytest.raises(ValueError, match="wants"):
+            partition.match_partition_rules(
+                rules, {"x": np.zeros(8, np.int32)})
+
+
+# =========================================================================
+# shard -> gather round-trips on the virtual 8-device mesh
+# =========================================================================
+class TestShardGather:
+    @pytest.fixture()
+    def mesh(self):
+        with make_mesh(8) as m:
+            yield m
+
+    def test_round_trip_byte_identity(self, mesh):
+        rng = np.random.default_rng(7)
+        named = {
+            "counts": rng.integers(0, 2 ** 20, (64, 6)).astype(np.int32),
+            "row_starts": rng.integers(0, 2 ** 16, 128).astype(np.int32),
+            "row_codes": rng.integers(0, 255, (128, 4)).astype(np.uint8),
+            "vote_syms": rng.integers(0, 6, (2, 64)).astype(np.uint8),
+            "thresholds": np.asarray([0.25, 0.5, 0.75], np.float32),
+        }
+        specs = partition.match_partition_rules(
+            partition.PARTITION_RULES, named)
+        shard_fns, gather_fns = partition.make_shard_and_gather_fns(
+            mesh, specs)
+        assert set(shard_fns) == set(named) == set(gather_fns)
+        for name, arr in named.items():
+            placed = shard_fns[name](arr)
+            assert placed.sharding.spec == specs[name]
+            back = gather_fns[name](placed)
+            assert back.dtype == arr.dtype
+            assert np.array_equal(back, arr), name
+
+    def test_force_assemble_round_trips_and_bills_local_bytes(self, mesh):
+        # the per-device assembly path is exactly what a DCN-spanning
+        # mesh runs; on one controller the "local window" is the whole
+        # array, so the billed shard bytes equal arr.nbytes
+        arr = np.arange(64 * 6, dtype=np.int32).reshape(64, 6)
+        sharding = NamedSharding(mesh, P(("dp", "sp"), None))
+        reg = push_run()
+        try:
+            placed = partition.shard_to_mesh(arr, sharding,
+                                             force_assemble=True)
+            billed = reg.value("mesh/shard_bytes/0")
+        finally:
+            pop_run(reg)
+        assert billed == arr.nbytes
+        assert np.array_equal(partition.gather_from_mesh(placed), arr)
+
+    def test_mesh_gauges(self, mesh):
+        assert partition.mesh_process_count(mesh) == 1
+        reg = push_run()
+        try:
+            partition.publish_mesh_gauges(mesh)
+            assert reg.value("mesh/hosts") == 1
+            assert reg.value("mesh/shards") == 8
+        finally:
+            pop_run(reg)
+
+
+# =========================================================================
+# typed --shards validation (helper, backend, CLI)
+# =========================================================================
+class TestShardValidation:
+    def test_noop_below_two(self):
+        validate_shards(None)
+        validate_shards(0)
+        validate_shards(1)
+        validate_shards(1, pileup="host")  # single shard composes fine
+
+    def test_host_pileup_conflict(self):
+        with pytest.raises(MeshCapacityError, match="does not compose"):
+            validate_shards(4, pileup="host")
+
+    def test_over_device_request(self):
+        with pytest.raises(MeshCapacityError,
+                           match="exceeds the 8 available"):
+            validate_shards(64, n_available=8)
+        # remedy is in the message, not just the verdict
+        with pytest.raises(MeshCapacityError, match="widen the mesh"):
+            validate_shards(64, n_available=8)
+        validate_shards(8, n_available=8)  # exact fit is legal
+
+    def test_default_pool_is_the_runtime(self):
+        n = len(jax.devices())  # conftest forces 8 virtual devices
+        validate_shards(n)
+        with pytest.raises(MeshCapacityError, match="exceeds"):
+            validate_shards(n + 1)
+
+    def test_typed_as_value_error(self):
+        # every existing reject-with-reason path keeps working
+        assert issubclass(MeshCapacityError, ValueError)
+
+    def test_make_mesh_over_request(self):
+        with pytest.raises(MeshCapacityError, match="requested 99"):
+            make_mesh(99)
+
+    def test_backend_rejects_before_decode(self):
+        from sam2consensus_tpu.backends.jax_backend import JaxBackend
+        from sam2consensus_tpu.io.sam import iter_records, read_header
+        from sam2consensus_tpu.utils.simulate import sam_text
+
+        handle = io.StringIO(
+            sam_text([("g", 8)], [("g", 1, "4M", "ACGT")]))
+        contigs, _n, first = read_header(handle)
+        cfg = RunConfig(thresholds=[0.25], backend="jax", shards=64)
+        with pytest.raises(MeshCapacityError, match="exceeds"):
+            JaxBackend().run(contigs, iter_records(handle, first), cfg)
+
+    def test_cli_rejects_up_front(self, tmp_path):
+        from sam2consensus_tpu.cli import main
+        from sam2consensus_tpu.utils.simulate import sam_text, write_sam
+
+        sam = write_sam(sam_text([("g", 8)], [("g", 1, "4M", "ACGT")]),
+                        str(tmp_path / "t.sam"))
+        out = str(tmp_path / "o")
+        with pytest.raises(SystemExit, match="exceeds"):
+            main(["-i", sam, "-o", out, "--backend", "jax",
+                  "--shards", "64", "--quiet"])
+        with pytest.raises(SystemExit,
+                           match="does not compose with --shards"):
+            main(["-i", sam, "-o", out, "--backend", "jax",
+                  "--shards", "4", "--pileup", "host", "--quiet"])
+
+
+# =========================================================================
+# capacity-planned admission: the mesh_shards verdict
+# =========================================================================
+def _two_host_budget(total_len=200_000, max_hosts=4):
+    """A budget strictly between the 1-host and 2-host per-host peaks:
+    single-host runs are over budget, two hosts fit."""
+    probe = memplane.plan_mesh_shards(total_len, None, budget_bytes=0,
+                                      max_hosts=max_hosts, record=False)
+    alt = probe["alternatives"]
+    return int((alt["1"] + alt["2"]) / 2)
+
+
+class TestMeshAdmission:
+    def test_plan_picks_minimal_k(self):
+        budget = _two_host_budget()
+        plan = memplane.plan_mesh_shards(200_000, None,
+                                         budget_bytes=budget,
+                                         max_hosts=4, record=False)
+        assert plan["fits"] is True
+        assert plan["hosts"] == 2
+        assert plan["per_host_bytes"] <= budget < plan["single_host_bytes"]
+        # alternatives are keyed by STRING host counts (JSON-stable)
+        assert set(plan["alternatives"]) == {"1", "2", "3", "4"}
+
+    def test_plan_over_capacity(self):
+        plan = memplane.plan_mesh_shards(200_000, None, budget_bytes=1,
+                                         max_hosts=4, record=False)
+        assert plan["fits"] is False
+        assert plan["hosts"] == 4  # best effort: the cap, still over
+
+    def test_plan_within_budget_stays_single_host(self):
+        plan = memplane.plan_mesh_shards(200_000, None,
+                                         budget_bytes=2 ** 40,
+                                         max_hosts=4, record=False)
+        assert plan["fits"] is True and plan["hosts"] == 1
+
+    def test_plan_records_ledger_decision(self):
+        budget = _two_host_budget()
+        robs = obs.start_run()
+        try:
+            memplane.plan_mesh_shards(200_000, None, budget_bytes=budget,
+                                      max_hosts=4)
+            memplane.track("counts", 50_000)
+            recs = obs.finalize_decisions()
+        finally:
+            obs.finish_run(robs)
+        rec = next(r for r in recs if r.decision == "mesh_shards")
+        assert rec.chosen == "hosts_2"
+        assert rec.predicted["per_host_bytes"] > 0
+        assert rec.measured["per_host_bytes"] == 50_000
+        # band=0: the model is an upper bound, headroom must not alarm
+        assert rec.drift is False
+
+    def test_admission_verdict_matrix(self):
+        fits2 = {"fits": True, "hosts": 2}
+        adm = AdmissionController(mem_budget=100, mesh_hosts=4)
+        d = adm.admit("t", predicted_bytes=50)
+        assert d.admitted and d.mesh_shards is None
+        d = adm.admit("t", predicted_bytes=500)
+        assert not d.admitted and d.reason == REASON_CAPACITY
+        d = adm.admit("t", predicted_bytes=500, shard_plan=fits2)
+        assert d.admitted and d.mesh_shards == 2
+        d = adm.admit("t", predicted_bytes=500,
+                      shard_plan={"fits": False, "hosts": 4})
+        assert not d.admitted and d.reason == REASON_CAPACITY
+        d = adm.admit("t", predicted_bytes=500,
+                      shard_plan={"fits": True, "hosts": 1})
+        assert not d.admitted and d.reason == REASON_CAPACITY
+        # no budget -> no capacity gate, plan or not
+        assert AdmissionController().admit(
+            "t", predicted_bytes=500).admitted
+
+    def test_mesh_hosts_env(self, monkeypatch):
+        from sam2consensus_tpu.serve import ServeRunner
+
+        monkeypatch.setenv("S2C_JIT_CACHE", "")
+        monkeypatch.setenv("S2C_MESH_HOSTS", "3")
+        r = ServeRunner(prewarm="off", persistent_cache=False)
+        assert r.admission.mesh_hosts == 3
+        monkeypatch.setenv("S2C_MESH_HOSTS", "lots")
+        with pytest.raises(ValueError,
+                           match="S2C_MESH_HOSTS must be an integer"):
+            ServeRunner(prewarm="off", persistent_cache=False)
+
+
+# =========================================================================
+# the s2c_mesh_* OpenMetrics family
+# =========================================================================
+def test_mesh_openmetrics_family():
+    r = MetricsRegistry()
+    r.add("mesh/shard_bytes/0", 1024)
+    r.add("mesh/shard_bytes/1", 2048)
+    r.add("mesh/gather_bytes", 4096)
+    r.add("serve/admission_mesh", 1)
+    r.gauge("mesh/hosts").set(2)
+    r.gauge("mesh/shards").set(8)
+    r.gauge("mesh/planned_hosts").set(2)
+    text = T.render_openmetrics(r.snapshot())
+    assert re.search(r's2c_mesh_shard_bytes_total\{host="0"\} 1024',
+                     text)
+    assert re.search(r's2c_mesh_shard_bytes_total\{host="1"\} 2048',
+                     text)
+    assert re.search(r"s2c_mesh_gather_bytes_total 4096", text)
+    assert re.search(r"s2c_mesh_hosts 2(\.0)?\b", text)
+    assert re.search(r"s2c_mesh_shards 8(\.0)?\b", text)
+    assert re.search(r"s2c_mesh_planned_hosts 2(\.0)?\b", text)
+    assert re.search(r"s2c_serve_admission_mesh_total 1\b", text)
+    assert T.lint_openmetrics(text) == []

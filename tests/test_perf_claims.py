@@ -54,5 +54,68 @@ def test_code_blocks_are_skipped(tmp_path):
     assert check_perf_claims.main(["--repo", str(tmp_path)]) == 0
 
 
+# -- multi-host bench artifact lint (ISSUE 18) ----------------------------
+def _bench_rows(identical=True, multihost=True, residual=True,
+                mesh_admit=True, with_summary=True):
+    import json
+
+    row1 = {"kind": "row", "config": "p1d8", "hosts": 1, "shards": 8,
+            "admission": "reject:capacity"}
+    row2 = {"kind": "row", "config": "p2d4",
+            "hosts": 2 if multihost else 1,
+            "shards": 4 if multihost else 1,
+            "admission": ("admit:mesh_2" if mesh_admit
+                          else "admit")}
+    for r in (row1, row2):
+        r["identical_fasta"] = bool(identical)
+        if residual:
+            r["capacity_residual"] = 2.0
+            r["capacity_in_band"] = True
+    rows = [row1, row2]
+    if with_summary:
+        rows.append({"kind": "summary", "ok": True, "failures": 0,
+                     "identical_all": bool(identical),
+                     "capacity_in_band_all": True})
+    return "\n".join(json.dumps(r) for r in rows) + "\n"
+
+
+def test_committed_multihost_bench_artifact_is_valid_evidence():
+    path = os.path.join(REPO, "campaign",
+                        "multihost_bench_r06_cpufallback.jsonl")
+    assert os.path.exists(path)
+    assert check_perf_claims.lint_multihost_bench_artifact(path) == []
+
+
+@pytest.mark.parametrize("kw,needle", [
+    (dict(), None),                                  # well-formed -> clean
+    (dict(identical=False), "identical_fasta is false"),
+    (dict(multihost=False), "no row ran multi-host"),
+    (dict(residual=False), "no capacity residual"),
+    (dict(mesh_admit=False), "mesh_shards admission verdict"),
+    (dict(with_summary=False), "no summary row"),
+])
+def test_multihost_bench_lint_structure(tmp_path, kw, needle):
+    path = tmp_path / "multihost_bench_r99.jsonl"
+    path.write_text(_bench_rows(**kw))
+    errs = check_perf_claims.lint_multihost_bench_artifact(str(path))
+    if needle is None:
+        assert errs == []
+    else:
+        assert any(needle in e for e in errs), errs
+
+
+def test_cited_multihost_bench_artifact_must_lint(tmp_path):
+    # a PERF.md claim citing a structurally-broken bench JSONL fails
+    os.makedirs(tmp_path / "campaign")
+    (tmp_path / "campaign" / "multihost_bench_r99.jsonl").write_text(
+        _bench_rows(identical=False))
+    (tmp_path / "PERF.md").write_text(
+        "Sharding wins 2× (campaign/multihost_bench_r99.jsonl).\n")
+    assert check_perf_claims.main(["--repo", str(tmp_path)]) == 1
+    (tmp_path / "campaign" / "multihost_bench_r99.jsonl").write_text(
+        _bench_rows())
+    assert check_perf_claims.main(["--repo", str(tmp_path)]) == 0
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

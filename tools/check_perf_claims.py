@@ -151,6 +151,14 @@ def check_file(repo, name):
                         f"{name}:{lineno}: flight-recorder trace "
                         f"{art!r} fails the structural lint "
                         f"({len(errs)} error(s); first: {errs[0]})")
+            elif os.path.basename(art).startswith("multihost_bench") \
+                    and art.endswith(".jsonl"):
+                errs = lint_multihost_bench_artifact(path)
+                if errs:
+                    violations.append(
+                        f"{name}:{lineno}: multi-host bench artifact "
+                        f"{art!r} is not valid claim evidence "
+                        f"({len(errs)} error(s); first: {errs[0]})")
     return violations
 
 
@@ -300,6 +308,64 @@ def lint_fleet_trace_leg_artifact(path):
     if s.get("validation_errors", 1) != 0:
         errs.append(
             f"summary validation_errors={s.get('validation_errors')}")
+    return errs
+
+
+def lint_multihost_bench_artifact(path):
+    """Structural lint for a cited multi-host mesh bench JSONL
+    (``tools/multihost_dryrun.py --bench``): parseable rows, a clean
+    summary, and the three things a MULTICHIP citation is actually
+    claiming — every row carries its shard-vs-oracle identity flag
+    (true), at least one point ran genuinely multi-host (hosts > 1,
+    shards > 1), and the capacity-planned admission story is recorded
+    per row (a ``mesh_shards`` verdict plus a predicted-vs-measured
+    residual inside the drift band)."""
+    import json
+
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = [ln for ln in fh if ln.strip()]
+    except OSError as exc:
+        return [f"unreadable: {exc}"]
+    rows = []
+    for i, ln in enumerate(lines, 1):
+        try:
+            rows.append(json.loads(ln))
+        except ValueError:
+            errs.append(f"line {i}: not JSON")
+    data = [r for r in rows if r.get("kind") == "row"]
+    summaries = [r for r in rows if r.get("kind") == "summary"]
+    if not summaries:
+        errs.append("no summary row")
+        return errs
+    if not data:
+        errs.append("no data rows")
+        return errs
+    s = summaries[-1]
+    if not s.get("ok", False):
+        errs.append("summary ok is not true")
+    if s.get("failures", 1) != 0:
+        errs.append(f"summary failures={s.get('failures')}")
+    if not s.get("identical_all", False):
+        errs.append("summary identical_all is not true")
+    if not s.get("capacity_in_band_all", False):
+        errs.append("summary capacity_in_band_all is not true")
+    for i, r in enumerate(data):
+        if "identical_fasta" not in r:
+            errs.append(f"row {i}: no identical_fasta identity flag")
+        elif not r["identical_fasta"]:
+            errs.append(f"row {i}: identical_fasta is false")
+        if "capacity_residual" not in r or "capacity_in_band" not in r:
+            errs.append(f"row {i}: no capacity residual recorded")
+        if "admission" not in r:
+            errs.append(f"row {i}: no admission verdict recorded")
+    if not any(r.get("hosts", 0) > 1 and r.get("shards", 0) > 1
+               for r in data):
+        errs.append("no row ran multi-host (hosts > 1, shards > 1)")
+    if not any(str(r.get("admission", "")).startswith("admit:mesh_")
+               for r in data):
+        errs.append("no row carries a mesh_shards admission verdict")
     return errs
 
 

@@ -18,9 +18,23 @@ Checks (every process asserts, process 0 reports):
   * sharded vote symbols == unsharded ``vote_positions``;
   * ``tail_stats`` contig sums == oracle coverage sums.
 
+``--bench`` is the MULTICHIP measurement leg (campaign step 17): a
+procs x devs sweep where each point runs the FULL production
+``JaxBackend`` job over the process-spanning mesh and is compared
+byte-for-byte against the in-launcher ``CpuBackend`` FASTA oracle.
+Each row also carries the capacity-planned admission story end to end:
+the memory plane prices the job (``plan_mesh_shards``) against a
+budget deliberately set between the 1-host and 2-host per-host peaks,
+the real ``AdmissionController`` issues the "needs K hosts"
+``mesh_shards`` verdict, and the row joins the predicted per-host
+bytes against the workers' measured tracked peak (residual must sit
+inside the S2C_DRIFT_BAND).  Rows are JSONL on stdout (``--out -``
+campaign idiom); worker chatter goes to stderr.
+
 Usage:
   python tools/multihost_dryrun.py              # spawn 2 procs x 4 devs
   python tools/multihost_dryrun.py --procs 2 --devs 4
+  python tools/multihost_dryrun.py --bench --sweep 1x8,2x4 --out -
   (workers are re-invocations of this script with --worker <pid>)
 """
 
@@ -33,14 +47,30 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def worker(pid: int, n_procs: int, n_devs: int, port: int) -> int:
+def _init_distributed(n_procs: int, pid: int, port: int):
+    """``jax.distributed`` bring-up for one worker.  The CPU stand-in
+    needs the gloo collectives implementation selected BEFORE the
+    backend initializes — without it the CPU client has no
+    cross-process transport and every process-spanning computation
+    dies with "Multiprocess computations aren't implemented on the
+    CPU backend" (the env var spelling of the option is not read on
+    this jax, so it must be set via jax.config)."""
     from sam2consensus_tpu.utils.platform import pin_platform_from_env
 
     pin_platform_from_env()
     import jax
 
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass            # non-CPU rig or the option moved; best effort
     jax.distributed.initialize(coordinator_address=f"localhost:{port}",
                                num_processes=n_procs, process_id=pid)
+    return jax
+
+
+def worker(pid: int, n_procs: int, n_devs: int, port: int) -> int:
+    jax = _init_distributed(n_procs, pid, port)
     import numpy as np
 
     from sam2consensus_tpu.encoder.events import GenomeLayout, ReadEncoder
@@ -123,56 +153,158 @@ def worker(pid: int, n_procs: int, n_devs: int, port: int) -> int:
     return 0
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--procs", type=int, default=2)
-    ap.add_argument("--devs", type=int, default=4)
-    ap.add_argument("--port", type=int, default=9977)
-    ap.add_argument("--worker", type=int, default=None)
-    args = ap.parse_args()
+# =====================================================================
+# --bench: the MULTICHIP measurement leg
+# =====================================================================
+#: the bench fixture — a wide_genome-class shape: the genome is wide
+#: enough that the count/tail planes dominate staging in the capacity
+#: model (so per_host(2) is a real cut below per_host(1) and the
+#: tracked-counts measurement can sit inside the drift band of the
+#: per-host prediction) while still finishing on the one-core gloo
+#: stand-in inside the shared deadline
+BENCH_SIM = dict(n_contigs=4, contig_len=24000, n_reads=1200,
+                 read_len=60, max_indel=2, seed=101)
+BENCH_THRESHOLDS = [0.25, 0.75]
+#: staging chunk pinned to the fixture's scale: the capacity model
+#: prices the configured chunk geometry, so leaving the 262144-read
+#: default would predict ~30x the slab bytes this fixture ever stages
+#: and push the mesh_shards residual out of the drift band for the
+#: wrong reason (model/config mismatch, not model error)
+BENCH_CHUNK_READS = 2048
 
-    if args.worker is not None:
-        rc = worker(args.worker, args.procs, args.devs, args.port)
-        # gloo/distributed client teardown can abort at interpreter
-        # exit; the asserts have already decided the outcome
-        sys.stdout.flush()
-        sys.stderr.flush()
-        os._exit(rc)
+
+def _bench_fixture() -> str:
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+    return simulate(SimSpec(**BENCH_SIM))
+
+
+def _rendered(backend, text: str, cfg) -> dict:
+    """{ref_name: full FASTA file text} — the byte-identity surface the
+    differential suite gates on (tests/test_differential.py)."""
+    import io as _io
+
+    from sam2consensus_tpu.io.fasta import render_file
+    from sam2consensus_tpu.io.sam import iter_records, read_header
+
+    handle = _io.StringIO(text)
+    contigs, _n, first = read_header(handle)
+    res = backend.run(contigs, iter_records(handle, first), cfg)
+    return {name: render_file(recs, cfg.nchar)
+            for name, recs in res.fastas.items()}
+
+
+def _fasta_sha(rendered: dict) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for name in sorted(rendered):
+        h.update(name.encode())
+        h.update(b"\x00")
+        h.update(rendered[name].encode())
+    return h.hexdigest()
+
+
+def bench_worker(pid: int, n_procs: int, n_devs: int, port: int,
+                 oracle_sha: str) -> int:
+    """One bench process: full ``JaxBackend`` job over the
+    process-spanning mesh, FASTA hash vs the launcher's CPU oracle,
+    mesh/memory counters read back from the run's metrics JSONL.
+    Emits one ``BENCHJSON {...}`` line (every pid — the launcher sums
+    per-host shard bytes and cross-checks hash agreement)."""
+    import json
+    import tempfile
+    import time
+
+    jax = _init_distributed(n_procs, pid, port)
+    from sam2consensus_tpu.backends.jax_backend import JaxBackend
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.observability import memplane
+    from sam2consensus_tpu.observability.export import read_metrics_jsonl
+
+    n_global = n_procs * n_devs
+    assert len(jax.devices()) == n_global
+    text = _bench_fixture()
+    fd, metrics_path = tempfile.mkstemp(prefix="s2c_meshbench_",
+                                        suffix=".jsonl")
+    os.close(fd)
+    cfg = RunConfig(thresholds=list(BENCH_THRESHOLDS), prefix="bench",
+                    backend="jax", shards=n_global,
+                    chunk_reads=BENCH_CHUNK_READS,
+                    metrics_out=metrics_path)
+    t0 = time.perf_counter()
+    rendered = _rendered(JaxBackend(), text, cfg)
+    wall = time.perf_counter() - t0
+    sha = _fasta_sha(rendered)
+
+    counters, gauges = {}, {}
+    try:
+        for row in read_metrics_jsonl(metrics_path):
+            if row.get("kind") == "counter":
+                counters[row["name"]] = row["value"]
+            elif row.get("kind") == "gauge":
+                gauges[row["name"]] = row["value"]
+    finally:
+        try:
+            os.unlink(metrics_path)
+        except OSError:
+            pass
+    payload = {
+        "pid": pid,
+        "wall_sec": round(wall, 4),
+        "fasta_sha": sha,
+        "identical_fasta": sha == oracle_sha,
+        "hosts": int(gauges.get("mesh/hosts", 1)),
+        "shards": int(gauges.get("mesh/shards", n_global)),
+        "shard_bytes": int(counters.get(f"mesh/shard_bytes/{pid}", 0)),
+        "gather_bytes": int(counters.get("mesh/gather_bytes", 0)),
+        "h2d_bytes": int(counters.get("wire/h2d_bytes", 0)),
+        "d2h_bytes": int(counters.get("wire/d2h_bytes", 0)),
+        "peak_tracked_bytes":
+            int(memplane.summary()["tracked"]["peak_bytes"]),
+    }
+    print("BENCHJSON " + json.dumps(payload), flush=True)
+    return 0
+
+
+def _spawn_workers(n_procs: int, n_devs: int, port: int,
+                   extra_argv=(), deadline_sec: float = 480.0):
+    """Spawn N worker re-invocations; returns (rcs, outs, timed_out).
+
+    Each worker gets its own process group (start_new_session) so a
+    hang can be killed wholesale; one drain thread per pipe so a
+    worker writing a large failure traceback can never block on a
+    full unread pipe while the launcher waits on another worker.  One
+    SHARED deadline across all joins (sequential per-thread timeouts
+    would sum to procs x deadline and outlive the suite test's outer
+    timeout, leaking killed-launcher worker groups)."""
+    import signal
+    import threading
+    import time
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                         f" --xla_force_host_platform_device_count="
-                        f"{args.devs}").strip()
-    import signal
-    import threading
-
-    # each worker gets its own process group (start_new_session) so a
-    # hang can be killed wholesale; one drain thread per pipe so a
-    # worker writing a large failure traceback can never block on a
-    # full unread pipe while the launcher waits on another worker
+                        f"{n_devs}").strip()
     procs = [subprocess.Popen(
         [sys.executable, os.path.abspath(__file__),
-         "--worker", str(i), "--procs", str(args.procs),
-         "--devs", str(args.devs), "--port", str(args.port)],
+         "--worker", str(i), "--procs", str(n_procs),
+         "--devs", str(n_devs), "--port", str(port),
+         *extra_argv],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         start_new_session=True)
-        for i in range(args.procs)]
-    outs = [b""] * args.procs
+        for i in range(n_procs)]
+    outs = [b""] * n_procs
 
     def drain(i):
         outs[i] = procs[i].communicate()[0]
 
     threads = [threading.Thread(target=drain, args=(i,), daemon=True)
-               for i in range(args.procs)]
+               for i in range(n_procs)]
     for t in threads:
         t.start()
-    import time
-
-    # one SHARED deadline across all joins (sequential per-thread
-    # timeouts would sum to procs x 480 s and outlive the suite test's
-    # 560 s outer timeout, leaking killed-launcher worker groups)
-    end = time.monotonic() + 480
+    end = time.monotonic() + deadline_sec
     for t in threads:
         t.join(timeout=max(0.0, end - time.monotonic()))
     timed_out = any(t.is_alive() for t in threads)
@@ -185,7 +317,183 @@ def main() -> int:
                     pass
         for t in threads:
             t.join(timeout=10)
-    rcs = [p.poll() for p in procs]
+    return [p.poll() for p in procs], outs, timed_out
+
+
+def run_bench(args) -> int:
+    """The launcher side of ``--bench``: oracle once, then per sweep
+    point the capacity/admission leg + the distributed measurement."""
+    import json
+    import time
+
+    from sam2consensus_tpu.backends.cpu import CpuBackend
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.io.sam import read_header
+    from sam2consensus_tpu.observability import memplane
+    from sam2consensus_tpu.serve.admission import AdmissionController
+
+    band = float(os.environ.get("S2C_DRIFT_BAND", "4"))
+    out = sys.stdout if args.out in (None, "-") \
+        else open(args.out, "w", encoding="utf-8")
+
+    def emit(row):
+        out.write(json.dumps(row) + "\n")
+        out.flush()
+
+    text = _bench_fixture()
+    import io as _io
+
+    contigs, _n, _first = read_header(_io.StringIO(text))
+    total_len = sum(c.length for c in contigs)
+    cfg_cpu = RunConfig(thresholds=list(BENCH_THRESHOLDS),
+                        prefix="bench",
+                        chunk_reads=BENCH_CHUNK_READS)
+    print("bench: rendering CPU oracle...", file=sys.stderr, flush=True)
+    oracle_sha = _fasta_sha(_rendered(CpuBackend(), text, cfg_cpu))
+
+    sweep = []
+    for leg in (args.sweep or "1x8,2x4").split(","):
+        p, _, d = leg.strip().partition("x")
+        sweep.append((int(p), int(d)))
+
+    # the budget the whole sweep prices against: deliberately BETWEEN
+    # the 1-host and 2-host per-host peaks, so the single-host verdict
+    # is reject:capacity and the 2-host verdict is the "needs 2 hosts"
+    # mesh_shards admit — the acceptance scenario, in miniature
+    probe = memplane.plan_mesh_shards(total_len, cfg_cpu,
+                                      budget_bytes=0, max_hosts=2,
+                                      record=False)
+    budget = int((probe["single_host_bytes"]
+                  + probe["alternatives"]["2"]) // 2)
+    predicted = memplane.predict_job_peak_bytes(total_len, cfg_cpu)
+
+    rows, failures = [], 0
+    port = args.port
+    for rep in range(max(1, args.repeats)):
+        for n_procs, n_devs in sweep:
+            config = f"p{n_procs}d{n_devs}"
+            plan = memplane.plan_mesh_shards(
+                total_len, cfg_cpu, budget_bytes=budget,
+                max_hosts=n_procs, record=False)
+            dec = AdmissionController(
+                mem_budget=budget, mesh_hosts=n_procs).admit(
+                "bench", predicted_bytes=predicted,
+                shard_plan=plan if n_procs > 1 else None)
+            admission = (f"admit:mesh_{dec.mesh_shards}"
+                         if dec.admitted and dec.mesh_shards
+                         else "admit" if dec.admitted
+                         else f"reject:{dec.reason}")
+            print(f"bench: {config} rep{rep} "
+                  f"(admission {admission})...",
+                  file=sys.stderr, flush=True)
+            t0 = time.perf_counter()
+            rcs, outs, timed_out = _spawn_workers(
+                n_procs, n_devs, port,
+                extra_argv=("--bench", "--oracle-sha", oracle_sha),
+                deadline_sec=args.deadline)
+            port += 1
+            wall_spawn = time.perf_counter() - t0
+            reports = {}
+            for i, blob in enumerate(outs):
+                for line in blob.decode(errors="replace").splitlines():
+                    if line.startswith("BENCHJSON "):
+                        reports[i] = json.loads(line[len("BENCHJSON "):])
+            ok = (not timed_out and not any(rcs)
+                  and len(reports) == n_procs
+                  and all(r["identical_fasta"]
+                          for r in reports.values()))
+            if not ok:
+                failures += 1
+                for i, blob in enumerate(outs):
+                    sys.stderr.write(blob.decode(errors="replace"))
+            r0 = reports.get(0, {})
+            peak = max((r["peak_tracked_bytes"]
+                        for r in reports.values()), default=0)
+            ratio = (plan["per_host_bytes"] / peak) if peak else 0.0
+            in_band = bool(peak) and (1.0 / band) <= ratio <= band
+            emit({
+                "kind": "row", "series": "MULTICHIP",
+                "config": config, "rep": rep,
+                "procs": n_procs, "devs": n_devs,
+                "shards": int(r0.get("shards", n_procs * n_devs)),
+                "hosts": int(r0.get("hosts", 0)),
+                "total_len": int(total_len),
+                "wall_sec": round(float(r0.get("wall_sec",
+                                               wall_spawn)), 4),
+                "spawn_sec": round(wall_spawn, 4),
+                "identical_fasta": bool(ok),
+                "timed_out": bool(timed_out),
+                "rcs": rcs,
+                "shard_bytes_by_host": {
+                    str(i): int(r["shard_bytes"])
+                    for i, r in sorted(reports.items())},
+                "gather_bytes": int(r0.get("gather_bytes", 0)),
+                "h2d_bytes": int(r0.get("h2d_bytes", 0)),
+                "d2h_bytes": int(r0.get("d2h_bytes", 0)),
+                "budget_bytes": budget,
+                "predicted_peak_bytes": int(predicted),
+                "per_host_predicted_bytes": plan["per_host_bytes"],
+                "mesh_shards_planned": dec.mesh_shards,
+                "admission": admission,
+                "peak_tracked_bytes": int(peak),
+                "capacity_residual": round(ratio, 4),
+                "capacity_in_band": bool(in_band),
+            })
+            rows.append((config, ok, in_band))
+    emit({
+        "kind": "summary", "series": "MULTICHIP",
+        "legs": len(rows), "failures": failures,
+        "identical_all": all(ok for _c, ok, _b in rows),
+        "capacity_in_band_all": all(b for _c, _ok, b in rows),
+        "max_shards": max((p * d for p, d in sweep), default=0),
+        "budget_bytes": budget,
+        "oracle_sha": oracle_sha,
+        "host_cores": os.cpu_count(),
+        "ok": failures == 0,
+    })
+    if out is not sys.stdout:
+        out.close()
+    return 0 if failures == 0 else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--devs", type=int, default=4)
+    ap.add_argument("--port", type=int, default=9977)
+    ap.add_argument("--worker", type=int, default=None)
+    ap.add_argument("--bench", action="store_true",
+                    help="MULTICHIP JSONL measurement sweep")
+    ap.add_argument("--sweep", default="1x8,2x4",
+                    help="bench points as PROCSxDEVS, comma-separated")
+    ap.add_argument("--repeats", type=int, default=1,
+                    help="bench repetitions per point (regression "
+                         "series depth)")
+    ap.add_argument("--out", default="-",
+                    help="bench JSONL sink (- = stdout)")
+    ap.add_argument("--deadline", type=float, default=480.0,
+                    help="shared per-point worker deadline (seconds)")
+    ap.add_argument("--oracle-sha", default="",
+                    help="(worker-internal) launcher oracle FASTA hash")
+    args = ap.parse_args()
+
+    if args.worker is not None:
+        if args.bench:
+            rc = bench_worker(args.worker, args.procs, args.devs,
+                              args.port, args.oracle_sha)
+        else:
+            rc = worker(args.worker, args.procs, args.devs, args.port)
+        # gloo/distributed client teardown can abort at interpreter
+        # exit; the asserts have already decided the outcome
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
+
+    if args.bench:
+        return run_bench(args)
+
+    rcs, outs, timed_out = _spawn_workers(args.procs, args.devs,
+                                          args.port)
     sys.stdout.write(outs[0].decode(errors="replace"))
     if timed_out or any(rcs):
         for i in range(1, args.procs):

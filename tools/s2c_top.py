@@ -245,6 +245,34 @@ def render(health, samples, now=None):
         last = (mem.get("last_oom_dump") or {}).get("path")
         lines.append(f"OOM forensics: {mem['oom_dumps']} dump(s)"
                      + (f" (last: {last})" if last else ""))
+    # mesh plane (health "mesh" section, falling back to the
+    # s2c_mesh_* exposition family): hosts x shards topology, the
+    # capacity plan's verdict and the shard/gather traffic — the line
+    # that answers "is this job actually spanning the mesh"
+    mesh = health.get("mesh") or {}
+    mhosts = mesh.get("hosts")
+    if mhosts is None:
+        mhosts = _sample(samples, "s2c_mesh_hosts")
+    mshards = mesh.get("shards")
+    if mshards is None:
+        mshards = _sample(samples, "s2c_mesh_shards")
+    if mesh or (mshards or 0) > 1 or (mhosts or 0) > 1:
+        mgather = mesh.get("gather_bytes")
+        if mgather is None:
+            mgather = _sample(samples, "s2c_mesh_gather_bytes_total")
+        msbytes = mesh.get("shard_bytes_by_host") or {}
+        planned = mesh.get("planned_hosts")
+        nmesh = mesh.get("admitted_mesh")
+        line = (f"mesh: {int(mhosts or 1)} host(s) x "
+                f"{int(mshards or 0)} shard(s)"
+                + (f"  planned {int(planned)} hosts"
+                   if planned else "")
+                + (f"  {int(nmesh)} mesh-admitted" if nmesh else "")
+                + (f"  shard {sum(msbytes.values()) / 1e6:.1f} MB"
+                   if msbytes else "")
+                + (f"  gather {mgather / 1e6:.1f} MB"
+                   if mgather else ""))
+        lines.append(line)
     # per-tenant table from the exposition (p50/p99 e2e + rung)
     rungs = health.get("tenant_rungs", {})
     tenants = _tenants(samples) or sorted(rungs) or []

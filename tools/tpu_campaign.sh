@@ -337,4 +337,21 @@ run_step session_soak "campaign/session_soak_$R.jsonl" \
   "campaign/session_soak_stderr_$R.log" 3600 \
   python tools/session_soak.py
 
+# 17. multi-host mesh scale-up (ISSUE 18 / ROADMAP 1 multichip): a
+# procs x devs sweep where each point runs the FULL production jax
+# backend over a process-spanning jax.distributed mesh (gloo is the
+# DCN stand-in on CPU rigs) and must render FASTA byte-identical to
+# the in-launcher CPU oracle.  Each row carries the capacity-planned
+# admission story: the memory plane's plan_mesh_shards prices the job
+# against a budget between the 1-host and 2-host per-host peaks, the
+# real AdmissionController issues the "needs K hosts" mesh_shards
+# verdict, and the predicted per-host bytes join the workers' measured
+# tracked peak (capacity_in_band per S2C_DRIFT_BAND).  Gate the series:
+#   python tools/regress_check.py --jsonl campaign/multihost_bench_$R.jsonl \
+#     --group-by config --value wall_sec --lower-is-better
+# CPU-fallback harness proof: campaign/multihost_bench_r06_cpufallback.jsonl
+run_step multihost_bench "campaign/multihost_bench_$R.jsonl" \
+  "campaign/multihost_bench_stderr_$R.log" 2400 \
+  python tools/multihost_dryrun.py --bench --repeats 2 --out -
+
 echo "$(date +%H:%M:%S) campaign complete" >> "$LOG"
