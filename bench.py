@@ -622,6 +622,9 @@ def serve_leg(n_jobs):
             # the warm side ran with the telemetry plane on; its
             # exposition format-lint verdict rides the gated artifact
             "telemetry": s.get("telemetry"),
+            # what the capacity plane learned about this host during
+            # the warm run (per-rate mean/n/confidence)
+            "ratecard": s.get("ratecard"),
         },
     }
     log(f"[serve_warm] cold {s['cold_per_job_sec']}s/job vs warm "

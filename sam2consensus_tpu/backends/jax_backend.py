@@ -126,6 +126,14 @@ def _link_constants() -> tuple:
         link_free = jax.default_backend() == "cpu"
     except Exception:
         link_free = True
+    # rate-card consultation stamp (observability/ratecard.py): which
+    # aging mechanism served these constants — the value itself still
+    # comes from env/probe/cache (linkprobe feeds the card, so the two
+    # agree once the card has samples), but the manifest records the
+    # card's view (n, age) next to the decision either way
+    from ..observability import ratecard as _rc
+
+    _unused_bps, rc_prov = _rc.consult("link_bps", bps)
     obs.record_decision(
         "link_constants", source, inputs=inputs,
         predicted={"bps": bps},
@@ -133,7 +141,8 @@ def _link_constants() -> tuple:
         {"bps": {"num": ["wire/bytes"],
                  "den": ["phase/stage_sec",
                          "phase/pileup_dispatch_sec"],
-                 "min_num": _drift_min_wire_bytes()}})
+                 "min_num": _drift_min_wire_bytes()}},
+        provenance=rc_prov)
     return (rt, bps)
 
 
@@ -673,17 +682,28 @@ class JaxBackend:
         # keep falling back (escape-dense input) shows residual << 1
         from ..wire.codec import modeled_wire_ratio
 
+        # predicted bps optionally sourced from the learned card: the
+        # card's wire_bps is the EWMA of ACHIEVED rates on this host,
+        # a tighter prediction than the raw link constant once it has
+        # samples (codec ROUTING stays on the link constants — the
+        # card refines the prediction, not the choice)
+        from ..observability import ratecard as _rc
+
+        _pred_bps, _wire_rc_prov = (
+            _rc.consult("wire_bps", _wire_bps)
+            if _wire_bps is not None else (None, None))
         obs.record_decision(
             "wire_codec", wire_sel, inputs=winfo,
             predicted={"ratio": modeled_wire_ratio(wire_sel),
-                       **({"bps": _wire_bps}
-                          if _wire_bps is not None else {})},
+                       **({"bps": _pred_bps}
+                          if _pred_bps is not None else {})},
             measured={"ratio": {"num": ["wire/raw_bytes"],
                                 "den": ["wire/bytes"]},
                       "bps": {"num": ["wire/bytes"],
                               "den": ["phase/stage_sec",
                                       "phase/pileup_dispatch_sec"],
-                              "min_num": _drift_min_wire_bytes()}})
+                              "min_num": _drift_min_wire_bytes()}},
+            provenance=_wire_rc_prov)
 
         n_dev = len(jax.devices())
         # typed up-front capacity check (parallel.mesh): an explicit
@@ -2225,12 +2245,26 @@ class JaxBackend:
             except ValueError:
                 return float(default)
 
-        rate = _envf("S2C_DECODE_MBPS_PER_CORE", "330") * 1e6
+        # the decode rate, by precedence: explicit env override, then
+        # the learned rate card (serve workers: the card converges on
+        # THIS host's measured per-core rate after a few jobs), then
+        # the baked 330 MB/s default — with the consultation stamped
+        # into the ledger inputs either way
+        from ..observability import ratecard as _rc
+
+        if "S2C_DECODE_MBPS_PER_CORE" in os.environ:
+            rate_mbps = _envf("S2C_DECODE_MBPS_PER_CORE", "330")
+            rc_prov = {"source": "env", "key": "decode_mbps_per_core"}
+        else:
+            rate_mbps, rc_prov = _rc.consult("decode_mbps_per_core",
+                                             330.0)
+        rate = rate_mbps * 1e6
         eff = _envf("S2C_DECODE_PAR_EFF", "0.85")
         cores = os.cpu_count() or 1
         inputs = {"threads": int(threads),
                   "requested": int(getattr(cfg, "decode_threads", 1)),
                   "cores": int(cores), "parallel": bool(parallel),
+                  "rate_mbps_per_core": round(rate_mbps, 2),
                   "rung": "fused" if fuse else "slab"}
         # priced only for plain uncompressed files (ReadStream owns the
         # ONE plain-file rule: a gzip handle's fstat size is COMPRESSED
@@ -2267,7 +2301,8 @@ class JaxBackend:
             inputs=inputs, predicted=predicted,
             alternatives=alternatives,
             measured={"sec": {"counters": ["phase/decode_sec"]}},
-            band=None if fuse or not parallel else 0.0)
+            band=None if fuse or not parallel else 0.0,
+            provenance=rc_prov)
 
     @staticmethod
     def _record_layout_decision(cfg, seg_w: int) -> None:

@@ -127,10 +127,20 @@ class DecisionLedger:
                predicted: Optional[dict] = None,
                alternatives: Optional[dict] = None,
                measured: Optional[dict] = None,
-               band: Optional[float] = None) -> DecisionRecord:
+               band: Optional[float] = None,
+               provenance: Optional[dict] = None) -> DecisionRecord:
+        """``provenance`` is the rate-card consultation stamp
+        (observability/ratecard.py ``consult``): which source priced
+        this decision's prediction inputs — learned (with sample count
+        and age) or default.  It rides ``inputs["ratecard"]`` so the
+        manifest's residual record answers "was the drift the MODEL's
+        fault or the CONSTANT's fault" per decision."""
+        merged = dict(inputs or {})
+        if provenance:
+            merged["ratecard"] = dict(provenance)
         rec = DecisionRecord(
             decision=decision, chosen=str(chosen),
-            inputs=dict(inputs or {}),
+            inputs=merged,
             predicted={k: float(v) for k, v in (predicted or {}).items()
                        if v is not None},
             alternatives={k: float(v)

@@ -465,12 +465,18 @@ def record_capacity(total_len: int, n_thresholds: int,
         _plane.last_capacity = record
     if enabled():
         # band=0: informational residual (see the module docstring) —
-        # the model is an upper bound; headroom must not alarm
+        # the model is an upper bound; headroom must not alarm.  The
+        # rate-card stamp reports how tight the bound has been running
+        # on this host (learned measured/predicted ratio), so the
+        # manifest can distinguish honest headroom from a stale model.
+        from . import ratecard as _rc
+
+        _ratio, _cap_prov = _rc.consult("capacity_residual_ratio", 1.0)
         obs.record_decision(
             "capacity", chosen, inputs=inputs,
             predicted={"bytes": float(total)},
             measured={"bytes": {"counters": ["mem/peak_tracked_bytes"]}},
-            band=0)
+            band=0, provenance=_cap_prov)
     return record
 
 
@@ -536,6 +542,10 @@ def plan_mesh_shards(total_len: int, cfg=None, budget_bytes: int = 0,
         from .. import observability as obs
 
         chosen = (f"hosts_{hosts}" if fits else "over_capacity")
+        from . import ratecard as _rc
+
+        _ratio, _mesh_prov = _rc.consult("capacity_residual_ratio",
+                                         1.0)
         obs.record_decision(
             "mesh_shards", chosen,
             inputs={"total_len": int(total_len),
@@ -544,7 +554,7 @@ def plan_mesh_shards(total_len: int, cfg=None, budget_bytes: int = 0,
             predicted={"per_host_bytes": float(plan["per_host_bytes"])},
             measured={"per_host_bytes":
                       {"counters": ["mem/peak_tracked_bytes"]}},
-            alternatives=alternatives, band=0)
+            alternatives=alternatives, band=0, provenance=_mesh_prov)
     return plan
 
 

@@ -41,6 +41,11 @@ from typing import Dict, List, Optional
 #: any real run, small enough that a snapshot's sort is microseconds
 HIST_CAP = 4096
 
+#: windowed-view ring bound: a (stamp, value) pair per observation —
+#: at one serve job per second this holds >1 h of job-boundary
+#: observations, which is exactly the slow burn window's horizon
+WINDOW_CAP = 4096
+
 
 class Counter:
     __slots__ = ("value",)
@@ -124,6 +129,37 @@ class Histogram:
         return s[idx]
 
 
+class Windowed:
+    """Timestamped ring buffer: the WINDOWED view over a histogram's
+    observation stream (the multi-window SLO burn plane's substrate,
+    observability/burn.py).  Histograms deliberately forget WHEN an
+    observation happened — fleet percentiles don't need it — but burn
+    rates are meaningless without it: "violations per evaluated
+    objective over the last 5 minutes" needs stamps.  Bounded like the
+    reservoir (WINDOW_CAP ring, oldest overwritten), so a runaway
+    queue cannot grow it; reads tolerate the wrap by filtering on
+    stamp, not position."""
+
+    __slots__ = ("items", "count")
+
+    def __init__(self):
+        self.items: List[tuple] = []     # (stamp_unix, value) ring
+        self.count = 0
+
+    def observe(self, v: float, stamp: float) -> None:
+        if len(self.items) < WINDOW_CAP:
+            self.items.append((stamp, v))
+        else:
+            self.items[self.count % WINDOW_CAP] = (stamp, v)
+        self.count += 1
+
+    def window(self, seconds: float, now: float) -> List[float]:
+        """Values observed within the trailing ``seconds`` of ``now``
+        (unsorted; the ring wraps out of stamp order past the cap)."""
+        lo = now - seconds
+        return [v for (t, v) in self.items if lo <= t <= now]
+
+
 class MetricsRegistry:
     """Thread-safe named instruments; see the module docstring."""
 
@@ -132,6 +168,7 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._hists: Dict[str, Histogram] = {}
+        self._windows: Dict[str, Windowed] = {}
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -162,12 +199,35 @@ class MetricsRegistry:
                 c = self._counters[name] = Counter()
             c.value += n
 
-    def observe(self, name: str, v: float) -> None:
+    def observe(self, name: str, v: float,
+                stamp: Optional[float] = None) -> None:
+        """Histogram observe; with ``stamp`` (a wall time) the value
+        ALSO lands in the name's windowed ring so burn-style trailing-
+        window reads work (:meth:`window_values`).  Stampless
+        observations stay windowless — one-shot runs pay nothing."""
         with self._lock:
             h = self._hists.get(name)
             if h is None:
                 h = self._hists[name] = Histogram()
             h.observe(v)
+            if stamp is not None:
+                w = self._windows.get(name)
+                if w is None:
+                    w = self._windows[name] = Windowed()
+                w.observe(v, stamp)
+
+    def window_values(self, name: str, seconds: float,
+                      now: Optional[float] = None) -> List[float]:
+        """The name's stamped observations within the trailing window
+        (empty when never stamped) — the burn plane's read side."""
+        import time as _time
+
+        with self._lock:
+            w = self._windows.get(name)
+            if w is None:
+                return []
+            return w.window(seconds,
+                            now if now is not None else _time.time())
 
     def value(self, name: str, default: float = 0.0) -> float:
         with self._lock:

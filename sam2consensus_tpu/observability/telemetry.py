@@ -194,8 +194,14 @@ class AggregateRegistry(MetricsRegistry):
     # name a JOB registry carries (the sched/trace info gauge stamping
     # trace_id into the metrics artifact) must not leak into the
     # server aggregate either: the last-folded job would overwrite it.
+    # rate/ + burn/ + process/: the learned rate card, the windowed
+    # burn plane and the start-time gauge are likewise runner-owned —
+    # folded-in job registries never carry them, and a job that DID
+    # (a test fixture, a future leak) must not overwrite the server's
+    # card state or alerting state
     FOLD_SKIP_PREFIXES = ("serve/", "slo/", "telemetry/", "cache/",
-                          "mem/", "fleet/", "sched/")
+                          "mem/", "fleet/", "sched/", "rate/",
+                          "burn/", "process/")
 
     def fold(self, registry: MetricsRegistry, job_id: str = "",
              tenant: str = "") -> None:
@@ -497,6 +503,39 @@ _HELP = {
                                      "per-request socket deadline "
                                      "(408; the handler thread is "
                                      "freed, never wedged).",
+    # -- rate cards / burn alerts / scale hints (PR 19) -------------
+    "s2c_rate": "Learned rate-card EWMA mean per rate key "
+                "(observability/ratecard.py; served to decision "
+                "sites only past the min-sample + staleness gates).",
+    "s2c_rate_stddev": "Rate-card exponentially-weighted standard "
+                       "deviation per rate key.",
+    "s2c_rate_samples": "Rate-card observation count per rate key "
+                        "(below the min-sample gate the key is not "
+                        "served).",
+    "s2c_rate_age_seconds": "Seconds since the rate key's last "
+                            "observation (past S2C_LINK_CACHE_MAX_AGE "
+                            "the key reads as stale and is not "
+                            "served).",
+    "s2c_rate_card": "Rate-card restart epoch (successful reloads of "
+                     "the persisted card; the restart_epoch label's "
+                     "source).",
+    "s2c_rate_card_corrupt_total": "Persisted rate-card files that "
+                                   "failed to parse and were read as "
+                                   "absent (never fails a job).",
+    "s2c_burn_rate": "Windowed SLO burn rate per tenant "
+                     "(violated/evaluated objectives over the "
+                     "trailing window; window=fast|slow).",
+    "s2c_burn_alert_state": "Burn alert state per tenant "
+                            "(0=ok 1=warn 2=page; hysteresis in "
+                            "observability/burn.py).",
+    "s2c_fleet_scale_hint": "Evidence-only fleet sizing hint: worker "
+                            "delta (sign is the verdict — positive "
+                            "scale-up, negative scale-down, 0 hold). "
+                            "No actuation.",
+    "s2c_process_start_time_seconds": "Unix time the serve process "
+                                      "started (the OpenMetrics "
+                                      "counter-reset detection "
+                                      "convention).",
 }
 
 
@@ -540,7 +579,8 @@ class _Family:
 
 
 def render_openmetrics(snapshot: dict,
-                       worker: Optional[str] = None) -> str:
+                       worker: Optional[str] = None,
+                       restart_epoch: Optional[int] = None) -> str:
     """Registry snapshot -> Prometheus/OpenMetrics text exposition.
 
     Structured families get proper labels instead of path-encoded
@@ -557,6 +597,11 @@ def render_openmetrics(snapshot: dict,
     a trailing ``worker="<id>"`` label, so N workers' expositions
     merge into one fleet view (``tools/s2c_top.py --fleet``, or any
     Prometheus scraping all of them) without sample collisions.
+    ``restart_epoch`` (the rate card's reload count) rides along as a
+    ``restart_epoch`` label: across a worker restart the labelset
+    changes, so a scraper's monotonicity check sees a NEW series
+    instead of a counter going backwards — counter resets become
+    detectable instead of lint violations.
     """
     fams: Dict[str, _Family] = {}
 
@@ -599,8 +644,35 @@ def render_openmetrics(snapshot: dict,
             fam(f"s2c_mem_{m.group(1)}_bytes", "gauge").add(
                 "", [("family", m.group(2))], entry["value"])
             continue
+        m = re.match(r"^rate/(mean|stddev|samples|age_seconds)/(.+)$",
+                     name)
+        if m:
+            # rate-card estimators: one labeled family per statistic
+            # instead of a sanitized series per rate key
+            suffix = "" if m.group(1) == "mean" else f"_{m.group(1)}"
+            fam(f"s2c_rate{suffix}", "gauge").add(
+                "", [("key", m.group(2))], entry["value"])
+            continue
+        m = re.match(r"^burn/rate/([^/]*)/(fast|slow)$", name)
+        if m:
+            fam("s2c_burn_rate", "gauge").add(
+                "", [("tenant", m.group(1) or "default"),
+                     ("window", m.group(2))], entry["value"])
+            continue
+        m = re.match(r"^burn/state/([^/]*)$", name)
+        if m:
+            fam("s2c_burn_alert_state", "gauge").add(
+                "", [("tenant", m.group(1) or "default")],
+                entry["value"])
+            continue
         fam(_sanitize(name), "gauge").add("", [], entry["value"])
     for name, entry in snapshot.get("histograms", {}).items():
+        if name.startswith("burn/"):
+            # the burn monitor's windowed rings are internal state —
+            # the derived s2c_burn_rate/s2c_burn_alert_state gauges
+            # are the exposition surface (a raw per-tenant summary
+            # family here would be a series-per-tenant explosion)
+            continue
         m = re.match(r"^sched/([^/]*)/([^/]+)$", name)
         if m:
             # flight-recorder scheduler distributions: kind is the
@@ -622,6 +694,8 @@ def render_openmetrics(snapshot: dict,
         f.add("_count", labels, entry["count"])
 
     wlabel = [("worker", worker)] if worker else []
+    if restart_epoch is not None:
+        wlabel = wlabel + [("restart_epoch", str(int(restart_epoch)))]
     lines: List[str] = []
     for name in sorted(fams):
         f = fams[name]
@@ -712,8 +786,18 @@ def lint_openmetrics(text: str,
     ``prev`` (an earlier scrape of the same endpoint) counters must be
     monotone non-decreasing — the rule that catches a "counter" that
     is secretly a gauge.
+
+    Restart-epoch rules (PR 19): a ``restart_epoch`` label value must
+    be a non-negative integer, and any exposition carrying one must
+    also expose ``s2c_process_start_time_seconds`` — the two signals a
+    scraper needs to tell a counter RESET (new epoch, new start time,
+    fresh series) from a counter going backwards (same epoch: still a
+    violation, and still caught by the ``prev`` check because the
+    labelsets match).
     """
     errs: List[str] = []
+    saw_restart_epoch = False
+    saw_start_time = False
     types: Dict[str, str] = {}
     fam_sampled: set = set()
     seen: set = set()
@@ -753,6 +837,15 @@ def lint_openmetrics(text: str,
         for k in labels:
             if not _LABEL_NAME_RE.match(k):
                 errs.append(f"line {lineno}: bad label name {k!r}")
+        if name == "s2c_process_start_time_seconds":
+            saw_start_time = True
+        if "restart_epoch" in labels:
+            saw_restart_epoch = True
+            if not labels["restart_epoch"].isdigit():
+                errs.append(
+                    f"line {lineno}: restart_epoch label "
+                    f"{labels['restart_epoch']!r} is not a "
+                    f"non-negative integer")
         family = name
         if family not in types:
             for suffix in ("_sum", "_count"):
@@ -792,6 +885,10 @@ def lint_openmetrics(text: str,
     tail = [ln for ln in lines if ln.strip()]
     if not tail or tail[-1].strip() != "# EOF":
         errs.append("exposition does not end with # EOF")
+    if saw_restart_epoch and not saw_start_time:
+        errs.append("restart_epoch labels present without an "
+                    "s2c_process_start_time_seconds sample (scrapers "
+                    "cannot confirm the reset)")
     if prev is not None:
         prev_errs = []
         prev_samples: Dict[Tuple[str, tuple], float] = {}

@@ -110,6 +110,31 @@ class AdmissionController:
     #: not broken, and the breach may be the FLEET's queue, not the
     #: tenant's data)
     slo_burn_by_tenant: Dict[str, int] = field(default_factory=dict)
+    #: windowed burn view (observability/burn.py BurnMonitor),
+    #: attached by the serve runner.  ``slo_burn()`` reads through it
+    #: so live consumers (batch priority, health) see breaches DECAY
+    #: out of the window instead of the lifetime dict's
+    #: breached-once-throttled-forever reads
+    burn_monitor: Optional[object] = None
+
+    def slo_burn(self, now: Optional[float] = None) -> Dict[str, int]:
+        """Tenant -> recent (slow-window) SLO breach count.  The
+        monitor is the truth for every tenant it has observed (so an
+        aged-out breach reads as unburnt); lifetime-dict entries for
+        tenants the monitor has never seen pass through (bare
+        controllers in tests and tools, externally-seeded burn)."""
+        mon = self.burn_monitor
+        if mon is None:
+            return dict(self.slo_burn_by_tenant)
+        try:
+            out = mon.burn_counts("slow", now=now)
+            seen = set(mon.states())
+        except Exception:
+            return dict(self.slo_burn_by_tenant)
+        for t, n in self.slo_burn_by_tenant.items():
+            if t not in seen and n > 0:
+                out[t] = n
+        return out
 
     def open_window(self) -> None:
         self._window_admitted = 0

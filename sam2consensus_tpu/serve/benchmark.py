@@ -471,6 +471,17 @@ def run_serve_bench(n_jobs: int = 8, n_reads: int = 5000,
                 runner.registry.value("serve/overlap_sec"), 4),
             "jit_cache_dir": runner.cache_dir,
         }
+        # the rate card the warm run learned rides the summary: a
+        # committed serve_bench artifact then doubles as evidence of
+        # what the capacity plane would have believed about this host
+        try:
+            card = runner.ratecard.snapshot()
+            summary["ratecard"] = {
+                k: {"mean": v["mean"], "n": v["n"],
+                    "confident": v["confident"]}
+                for k, v in card.get("rates", {}).items()}
+        except Exception:
+            summary["ratecard"] = {}
         try:
             from ..observability.telemetry import lint_openmetrics
 

@@ -417,6 +417,13 @@ class FleetCoordinator:
         for t, n in burn.items():
             if n > runner.admission.slo_burn_by_tenant.get(t, 0):
                 runner.admission.slo_burn_by_tenant[t] = n
+        # windowed counterpart: feed peer-committed breaches into the
+        # burn monitor WITH their commit stamps, so fleet-observed
+        # burn decays out of the alert windows like local burn does
+        # (getattr: bare stub runners in tests have no monitor)
+        note = getattr(runner, "note_fleet_burn", None)
+        if callable(note):
+            note(replay)
         for i, entry in enumerate(plan):
             if entry["action"] in ("skip", "reject"):
                 results[i] = runner._resolve_nonrun(entry, i)

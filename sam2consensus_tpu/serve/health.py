@@ -187,12 +187,36 @@ def snapshot(runner) -> dict:
         snap["sessions"] = smgr.health_summary()
     slo_obj = getattr(runner, "slo", None)
     if slo_obj or reg.value("slo/violations"):
+        # windowed burn read when the runner attached a monitor: a
+        # breach that aged out of the slow window stops reading as
+        # "burning" here (the lifetime dict never decayed)
+        slo_burn = getattr(runner.admission, "slo_burn", None)
         snap["slo"] = {
             "objectives": dict(slo_obj or {}),
             "violations": int(reg.value("slo/violations")),
-            "burn_by_tenant": dict(getattr(
+            "burn_by_tenant": dict(slo_burn()) if callable(slo_burn)
+            else dict(getattr(
                 runner.admission, "slo_burn_by_tenant", {})),
         }
+    # burn-alert plane (observability/burn.py): per-tenant ok/warn/
+    # page with the fast/slow window ratios behind the verdict — only
+    # present once any job was scored against an objective
+    burn = getattr(runner, "burn", None)
+    if burn is not None:
+        bsnap = burn.snapshot()
+        if bsnap.get("tenants"):
+            snap["burn"] = bsnap
+    # rate-card plane (observability/ratecard.py): this worker's
+    # learned throughput constants + confidence verdicts, and the
+    # latest evidence-only fleet scale hint when one was computed
+    card = getattr(runner, "ratecard", None)
+    if card is not None:
+        csnap = card.snapshot()
+        if csnap.get("rates") or csnap.get("restarts"):
+            snap["ratecard"] = csnap
+    hint = getattr(runner, "last_scale_hint", None)
+    if hint is not None:
+        snap["scale_hint"] = dict(hint)
     # memory plane (observability/memplane.py): per-family live/peak +
     # process/device watermarks, so a prober (or tools/s2c_top.py)
     # sees residency without a Prometheus stack; the OOM-forensics

@@ -61,7 +61,9 @@ from typing import Callable, List, Optional, Tuple
 from .. import observability as obs
 from ..config import RunConfig
 from ..observability import jitcache
+from ..observability import ratecard as rcard
 from ..observability import telemetry as stele
+from ..observability.burn import BurnMonitor
 from ..observability.metrics import MetricsRegistry
 from . import health as shealth
 from . import journal as sjournal
@@ -516,6 +518,35 @@ class ServeRunner:
                 port=telemetry_port)
             logger.info("telemetry endpoint on 127.0.0.1:%d "
                         "(/metrics, /healthz)", self.http.port)
+        # -- evidence plane: rate card + burn monitor ------------------
+        # the card learns per-worker throughput constants from finished
+        # jobs; journaled servers persist it next to the journal so a
+        # restart resumes with aged-but-confident estimates instead of
+        # cold defaults.  A corrupt or stale card reads as absent (with
+        # a counter) — it never fails a job.
+        card_name = self.worker_id or "serve"
+        if self.journal is not None:
+            self.ratecard = rcard.RateCard.load(
+                rcard.card_path(self.journal.root, card_name),
+                worker=card_name, registry=self.registry)
+        else:
+            self.ratecard = rcard.RateCard(worker=card_name)
+        rcard.install(self.ratecard)
+        self.ratecard.publish(self.registry)
+        self.registry.gauge("process/start_time_seconds").set(
+            round(time.time(), 3))
+        self.burn = BurnMonitor(self.registry)
+        self.admission.burn_monitor = self.burn
+        #: latest evidence-only scale hint (journaled servers); the
+        #: drain episode tracker joins projected vs measured drain
+        self.last_scale_hint: Optional[dict] = None
+        self._drain_t0: Optional[float] = None
+        self._drain_hint: Optional[dict] = None
+        self._scale_hint_episodes = 0
+        #: journal keys already fed to the burn monitor (local
+        #: finalizes + fleet replay) — prevents double-counting when
+        #: drain() replays this life's own commits
+        self._burn_fed_keys: set = set()
         # a daemon thread killed MID-XLA-COMPILE at interpreter exit
         # aborts the whole process from C++ ("terminate called without
         # an active exception"); close() stops the prewarm loop at the
@@ -562,6 +593,13 @@ class ServeRunner:
         if self.http is not None:
             self.http.close()
             self.http = None
+        if getattr(self, "ratecard", None) is not None:
+            if rcard.installed() is self.ratecard:
+                rcard.install(None)
+            try:
+                self.ratecard.save()
+            except Exception:
+                pass
         import atexit
 
         try:
@@ -754,8 +792,11 @@ class ServeRunner:
         aggregate, gauges refreshed first — an HTTP scrape between
         watchdog ticks still sees current heartbeat ages."""
         self._update_live_gauges()
-        return stele.render_openmetrics(self.registry.snapshot(),
-                                        worker=self.worker_id or None)
+        return stele.render_openmetrics(
+            self.registry.snapshot(),
+            worker=self.worker_id or None,
+            restart_epoch=self.ratecard.restarts
+            if self.worker_id else None)
 
     def telemetry_tick(self, force: bool = False) -> None:
         """One heartbeat of the telemetry plane, driven from the
@@ -780,11 +821,30 @@ class ServeRunner:
                 self.registry.add("telemetry/profile_captures", 1)
                 self.registry.gauge("telemetry/last_profile").set_info(
                     {"path": path, "in_flight": self.health.in_flight})
+        try:
+            self.burn.tick()
+        except Exception as exc:     # alerting is derived state
+            logger.warning("burn tick failed: %s", exc)
         now = time.monotonic()
         if not force and now - self._telemetry_last \
                 < self.telemetry_interval:
             return
         self._telemetry_last = now
+        # rate-card cadence work: refresh the exported gauges, persist
+        # the card (journaled servers), and recompute the evidence-only
+        # scale hint.  All best-effort — the card never fails a job.
+        try:
+            self.ratecard.publish(self.registry)
+            if self.ratecard.path:
+                self.ratecard.save()
+        except Exception as exc:
+            self.registry.add("rate/card_write_failed", 1)
+            logger.warning("rate card persist failed: %s", exc)
+        if self.journal is not None:
+            try:
+                self._scale_hint_tick()
+            except Exception as exc:
+                logger.warning("scale hint tick failed: %s", exc)
         # low-rate watermark sampler (observability/memplane.py): rides
         # the telemetry cadence, so a mid-hang scrape of the exposition
         # or health file shows memory too — and the bounded history
@@ -803,6 +863,108 @@ class ServeRunner:
                     "degrading to per-job manifests",
                     type(exc).__name__, exc)
         self._publish_health()
+
+    # -- scale-hint evidence plane (observability/ratecard.py) -------------
+    def _scale_hint_tick(self) -> None:
+        """Recompute the evidence-only fleet scale hint from every
+        persisted rate card in the journal root (own card live, peers
+        read-only from disk), the burn plane's alert states, and the
+        live queue depth.  Publishes ``fleet/scale_hint`` and tracks
+        drain episodes: when the queue empties, the hint that opened
+        the episode is joined against the measured drain time as a
+        band=0 ``scale_hint`` ledger decision.  No actuation."""
+        import glob as _glob
+
+        cards = [self.ratecard.snapshot()]
+        own = os.path.basename(self.ratecard.path or "")
+        for p in sorted(_glob.glob(os.path.join(
+                self.journal.root, "ratecard-*.json"))):
+            if os.path.basename(p) == own:
+                continue
+            peer = rcard.RateCard.load(p)
+            if peer.restarts or peer.snapshot()["rates"]:
+                cards.append(peer.snapshot())
+        workers = max(1, len(cards)) if self.worker_id else 1
+        hint = rcard.compute_scale_hint(
+            cards, queue_depth=self.health.queue_depth,
+            workers=workers, burn_states=self.burn.states())
+        self.last_scale_hint = hint
+        g = self.registry.gauge("fleet/scale_hint")
+        g.set(float(hint["delta"]))
+        g.set_info(hint)
+        # drain-episode join: projected (at queue-open) vs measured
+        now = time.monotonic()
+        if self.health.queue_depth > 0 and self._drain_t0 is None \
+                and hint.get("projected_drain_sec") is not None:
+            self._drain_t0 = now
+            self._drain_hint = hint
+        elif self.health.queue_depth == 0 \
+                and self._drain_t0 is not None:
+            measured = now - self._drain_t0
+            opened = self._drain_hint
+            self._drain_t0 = None
+            self._drain_hint = None
+            if opened is not None:
+                self._join_scale_hint(opened, measured)
+
+    def _join_scale_hint(self, hint: dict, measured_sec: float) -> None:
+        """Hindsight-join one drain episode: the hint's projected
+        drain vs the wall-clock measured drain, as a band=0
+        ``scale_hint`` decision in an episode-scoped ledger (the
+        per-run ledgers finalize at backend end — an episode spans
+        runs).  The residual gauges mirror into the server registry so
+        the exposition and s2c_top carry them."""
+        led = obs.DecisionLedger()
+        led.record(
+            "scale_hint", hint["verdict"], inputs=hint,
+            predicted={"drain_sec": hint["projected_drain_sec"]},
+            measured={"drain_sec": {
+                "counters": ["fleet/drain_measured_sec"]}},
+            band=0)
+        ep = MetricsRegistry()
+        ep.add("fleet/drain_measured_sec", round(measured_sec, 3))
+        # NOTE: observability.ledger the ATTRIBUTE is the
+        # current-ledger accessor function; the module import must be
+        # explicit
+        from ..observability.ledger import finalize as _finalize
+
+        _finalize(led, ep)
+        for name in ("residual/scale_hint", "residual/scale_hint/"
+                     "drain_sec"):
+            src = ep.gauge(name)
+            dst = self.registry.gauge(name)
+            dst.set(src.value)
+            if getattr(src, "info", None):
+                dst.set_info(dict(src.info))
+        self._scale_hint_episodes += 1
+        self.registry.add("fleet/drain_episodes", 1)
+        self.registry.gauge("fleet/drain_measured_sec").set(
+            round(measured_sec, 3))
+
+    def note_fleet_burn(self, replay) -> None:
+        """Feed peer-committed SLO breaches from a journal replay into
+        the windowed burn monitor WITH their commit stamps — an old
+        breach ages out of the fast/slow windows naturally, unlike the
+        lifetime ``slo_burn_by_tenant`` dict it complements.  Keys this
+        life already observed locally are skipped (no double count)."""
+        obj = self.slo.get("e2e")
+        if obj is None or replay is None:
+            return
+        for key, rec in getattr(replay, "committed", {}).items():
+            if key in self._burn_fed_keys:
+                continue
+            self._burn_fed_keys.add(key)
+            elapsed = rec.get("elapsed_sec")
+            if elapsed is None:
+                continue
+            stamp = float(rec.get("t", 0.0)) or None
+            try:
+                self.burn.observe_job(
+                    rec.get("tenant") or "default", evaluated=1,
+                    violated=1 if float(elapsed) > obj else 0,
+                    now=stamp)
+            except Exception:
+                continue
 
     def _telemetry_job_end(self, robs, res: JobResult, snap: dict,
                            tenant: str, queue_wait: float) -> None:
@@ -830,6 +992,17 @@ class ServeRunner:
                 violated.append(ph)
                 self.registry.add("slo/violations", 1)
                 self.registry.add(f"slo/violations/{tlabel}/{ph}", 1)
+        evaluated = [ph for ph in phases
+                     if self.slo.get(ph) is not None]
+        if evaluated:
+            # windowed burn view: one observation per job under the
+            # same label, stamped now — the fast/slow ratios the alert
+            # state machine reads (observability/burn.py)
+            try:
+                self.burn.observe_job(tlabel, evaluated=len(evaluated),
+                                      violated=len(violated))
+            except Exception:
+                pass
         if violated:
             # burn under the SAME label the exposition/manifest use
             # ("default" for untenanted jobs) so an operator can
@@ -1850,6 +2023,29 @@ class ServeRunner:
                                 queue_wait=journal_qw
                                 if journal_qw is not None
                                 else queue_wait)
+        # fold the job's measured throughput into this worker's rate
+        # card (observability/ratecard.py) — successful jobs only, so
+        # a crash-looping input cannot poison the learned constants
+        if res.ok:
+            try:
+                try:
+                    in_bytes = os.path.getsize(spec.filename)
+                except OSError:
+                    in_bytes = 0
+                self.ratecard.observe_job(
+                    snap, res.elapsed_sec, input_bytes=in_bytes,
+                    decode_cores=max(
+                        1, int(getattr(cfg, "decode_threads", 1) or 1)),
+                    packed=snap["counters"].get("serve/batched", 0) > 0,
+                    lifecycle=lifecycle)
+            except Exception as exc:
+                logger.warning("rate card fold failed for %s: %s",
+                               job_id, exc)
+        if entry.get("key"):
+            # this life observed the job's SLO verdict directly — a
+            # later fleet replay must not feed it to the burn
+            # monitor again
+            self._burn_fed_keys.add(entry["key"])
         self.jobs_run += 1
         self.registry.add("serve/jobs", 1)
         if not res.ok:
@@ -1916,11 +2112,23 @@ class ServeRunner:
             size = os.path.getsize(spec.filename)
         except OSError:
             size = 0
-        try:
-            rate = float(os.environ.get("S2C_DECODE_MBPS_PER_CORE",
-                                        "330")) * 1e6
-        except ValueError:
-            rate = 330e6
+        # decode rate by precedence: env override, learned rate card
+        # (this worker's measured per-core rate), baked default — the
+        # same ladder the decode_threads decision prices from, stamped
+        # with the consultation's provenance
+        from ..observability import ratecard as _rc
+
+        if "S2C_DECODE_MBPS_PER_CORE" in os.environ:
+            try:
+                rate_mbps = float(
+                    os.environ["S2C_DECODE_MBPS_PER_CORE"])
+            except ValueError:
+                rate_mbps = 330.0
+            rc_prov = {"source": "env", "key": "decode_mbps_per_core"}
+        else:
+            rate_mbps, rc_prov = _rc.consult("decode_mbps_per_core",
+                                             330.0)
+        rate = rate_mbps * 1e6
         cstats = self.count_cache.stats()
         with obs.bind_run_to_thread(robs):
             obs.record_decision(
@@ -1933,7 +2141,7 @@ class ServeRunner:
                         "tenant": spec.tenant or ""},
                 predicted={"sec": size / rate} if size else {},
                 measured={"sec": {"counters": ["phase/decode_sec"]}},
-                band=0)
+                band=0, provenance=rc_prov)
         return key, seed, cfg
 
     def _cache_end(self, key: str, ok: bool) -> None:

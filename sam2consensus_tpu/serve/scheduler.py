@@ -242,7 +242,14 @@ class BatchScheduler:
         return True
 
     def _burning(self, tenant: str) -> bool:
-        burn = getattr(self.runner.admission, "slo_burn_by_tenant", {})
+        # windowed read when the runner attached a burn monitor: a
+        # tenant whose last breach aged out of the slow window stops
+        # pre-empting batch fills (the lifetime dict would flush-on-
+        # burn forever after a single historical breach)
+        adm = self.runner.admission
+        slo_burn = getattr(adm, "slo_burn", None)
+        burn = slo_burn() if callable(slo_burn) \
+            else getattr(adm, "slo_burn_by_tenant", {})
         return bool(burn.get(tenant or "", 0))
 
     def compose(self, plan: List[dict],
@@ -556,6 +563,15 @@ class BatchScheduler:
                 r.gauge("serve/batch").set_info(
                     {**binfo, "share_sec": round(share, 4),
                      "events": pm.n_events})
+                # rate-card cross-check (observability/ratecard.py):
+                # the learned packed-jobs rate rides the inputs as
+                # provenance — the scheduler's own shared-phase EMA
+                # stays the prediction (it models THIS batch's shape;
+                # the card models the fleet-visible average)
+                from ..observability import ratecard as _rc
+
+                _jps_rc, _jps_prov = _rc.consult(
+                    "packed_jobs_per_sec", n / predicted_wall)
                 with obs.bind_run_to_thread(m.robs):
                     obs.record_decision(
                         "serve_batch", str(n),
@@ -568,6 +584,7 @@ class BatchScheduler:
                                 "events": int(total_events),
                                 "predicted_jobs_per_sec": round(
                                     n / predicted_wall, 3)},
+                        provenance=_jps_prov,
                         predicted={"sec": predicted_wall,
                                    "jobs_per_sec": n / predicted_wall},
                         measured={"sec": {"counters":

@@ -69,11 +69,13 @@ CACHE_MAX_AGE_SEC = 7 * 86400.0
 
 
 def cache_max_age() -> float:
-    try:
-        return float(os.environ.get("S2C_LINK_CACHE_MAX_AGE",
-                                    CACHE_MAX_AGE_SEC))
-    except ValueError:
-        return CACHE_MAX_AGE_SEC
+    # one staleness knob for both aged-constant planes: the rate card
+    # (observability/ratecard.py) reads the SAME env var for its
+    # confidence gate, so "how old may a learned constant be" is
+    # answered once per rig
+    from ..observability import ratecard as _rc
+
+    return _rc.max_age_sec()
 
 
 def _cache_file() -> Optional[str]:
@@ -256,6 +258,19 @@ def _record_link(probed: Tuple[float, float]) -> None:
     reg = obs.metrics()
     reg.gauge("link/rt_sec").set(probed[0])
     reg.gauge("link/bps").set(probed[1])
+    # measured link constants are ALSO rate-card entries: the card's
+    # EWMA + staleness age is the unified learned-constant plane the
+    # wire/placement decisions consult (best-effort — a serve runner
+    # installs a card; one-shot runs have none)
+    from ..observability import ratecard as _rc
+
+    card = _rc.installed()
+    if card is not None:
+        try:
+            card.observe("link_rt_sec", probed[0])
+            card.observe("link_bps", probed[1])
+        except Exception:
+            pass
 
 
 def _probe_into(box: list) -> None:

@@ -1,0 +1,227 @@
+"""Multi-window SLO burn alerting (observability/burn.py) + the
+windowed metric rings behind it (metrics.Windowed).
+
+Covers (ISSUE 19):
+* windowed ring views on the metrics registry;
+* burn rates over fast/slow windows, with caller-supplied stamps so
+  fleet-replayed breaches age like local ones;
+* the ok -> warn -> page state machine with hysteresis: a single blip
+  never alarms, a sustained breach pages, recovery de-escalates one
+  level per quiet period;
+* ``burn_counts`` — the decaying replacement for the admission
+  controller's never-decaying ``slo_burn_by_tenant`` reads — and the
+  ``AdmissionController.slo_burn()`` routing;
+* ``replay_burn`` hindsight verdicts over journal events (the
+  tools/fleet_whatif.py scorer);
+* the s2c_burn_* exposition families.
+"""
+
+from sam2consensus_tpu.observability import burn as B
+from sam2consensus_tpu.observability import telemetry as T
+from sam2consensus_tpu.observability.metrics import (MetricsRegistry,
+                                                     WINDOW_CAP,
+                                                     Windowed)
+from sam2consensus_tpu.serve.admission import AdmissionController
+
+
+# =========================================================================
+# units: windowed rings
+# =========================================================================
+def test_windowed_ring_filters_by_stamp():
+    w = Windowed()
+    for i in range(10):
+        w.observe(float(i), stamp=100.0 + i)
+    assert w.window(5.0, now=109.0) == [4.0, 5.0, 6.0, 7.0, 8.0, 9.0]
+    assert w.window(100.0, now=109.0) == [float(i) for i in range(10)]
+    assert w.window(5.0, now=500.0) == []          # all aged out
+
+
+def test_windowed_ring_overwrites_past_cap():
+    w = Windowed()
+    for i in range(WINDOW_CAP + 10):
+        w.observe(1.0, stamp=float(i))
+    assert w.count == WINDOW_CAP + 10
+    vals = w.window(float(WINDOW_CAP + 10), now=float(WINDOW_CAP + 9))
+    assert len(vals) == WINDOW_CAP                 # ring, not a leak
+
+
+def test_registry_window_values():
+    reg = MetricsRegistry()
+    reg.observe("burn/t/violated", 1.0, stamp=100.0)
+    reg.observe("burn/t/violated", 1.0, stamp=200.0)
+    assert reg.window_values("burn/t/violated", 50.0, now=210.0) \
+        == [1.0]
+    assert reg.window_values("burn/t/violated", 150.0, now=210.0) \
+        == [1.0, 1.0]
+    assert reg.window_values("burn/absent", 50.0, now=210.0) == []
+
+
+# =========================================================================
+# burn rates + state machine
+# =========================================================================
+def _mon(reg=None, **kw):
+    kw.setdefault("fast_sec", 300.0)
+    kw.setdefault("slow_sec", 3600.0)
+    kw.setdefault("warn_ratio", 0.25)
+    kw.setdefault("page_ratio", 0.5)
+    kw.setdefault("min_violations", 2)
+    kw.setdefault("clear_sec", 300.0)
+    return B.BurnMonitor(reg if reg is not None else MetricsRegistry(),
+                         **kw)
+
+
+def test_single_blip_stays_ok():
+    mon = _mon()
+    t0 = 10_000.0
+    # one violated job in an otherwise empty window: ratio 1.0 but
+    # below min_violations — the classic false-page this gate kills
+    mon.observe_job("ta", evaluated=1, violated=1, now=t0)
+    assert mon.tick(t0 + 1) == {"ta": "ok"}
+
+
+def test_sustained_breach_pages_and_clean_tenant_stays_ok():
+    mon = _mon()
+    t0 = 10_000.0
+    for i in range(4):
+        mon.observe_job("hung", evaluated=1, violated=1, now=t0 + i)
+        mon.observe_job("fine", evaluated=1, violated=0, now=t0 + i)
+    states = mon.tick(t0 + 10)
+    assert states["hung"] == "page"      # burning in BOTH windows
+    assert states["fine"] == "ok"
+    assert mon.rate("hung", "fast", now=t0 + 10) == 1.0
+    assert mon.rate("fine", "slow", now=t0 + 10) == 0.0
+
+
+def test_warn_without_page_when_slow_window_healthy():
+    mon = _mon(fast_sec=60.0, slow_sec=3600.0)
+    t0 = 50_000.0
+    # an hour of clean traffic, then a fresh fast-window burn: fast
+    # ratio 1.0 but the slow ratio is diluted below page_ratio
+    for i in range(20):
+        mon.observe_job("ta", evaluated=1, violated=0,
+                        now=t0 - 3000.0 + i)
+    for i in range(3):
+        mon.observe_job("ta", evaluated=1, violated=1, now=t0 + i)
+    assert mon.tick(t0 + 5) == {"ta": "warn"}
+
+
+def test_recovery_deescalates_one_level_per_quiet_period():
+    mon = _mon(clear_sec=300.0)
+    t0 = 10_000.0
+    for i in range(4):
+        mon.observe_job("ta", evaluated=1, violated=1, now=t0 + i)
+    assert mon.tick(t0 + 5) == {"ta": "page"}
+    # fast window clears as the breaches age out; hysteresis steps
+    # page -> warn -> ok, one level per clear_sec of quiet
+    assert mon.tick(t0 + 400) == {"ta": "warn"}
+    assert mon.tick(t0 + 500) == {"ta": "warn"}   # quiet < clear_sec
+    assert mon.tick(t0 + 800) == {"ta": "ok"}
+
+
+def test_flapping_does_not_oscillate_to_page():
+    mon = _mon(fast_sec=300.0, clear_sec=300.0)
+    t0 = 10_000.0
+    for i in range(4):
+        mon.observe_job("ta", evaluated=1, violated=1, now=t0 + i)
+    assert mon.tick(t0 + 5) == {"ta": "page"}
+    # a fresh blip during recovery re-arms last_above: the state
+    # holds (escalation is only ever upward from the current level)
+    assert mon.tick(t0 + 400) == {"ta": "warn"}
+    mon.observe_job("ta", evaluated=1, violated=1, now=t0 + 410)
+    assert mon.tick(t0 + 420) == {"ta": "warn"}   # blip: min_violations
+    assert mon.tick(t0 + 1020) == {"ta": "ok"}
+
+
+# =========================================================================
+# burn_counts: the decaying slo_burn_by_tenant replacement
+# =========================================================================
+def test_burn_counts_decay_out_of_window():
+    mon = _mon(slow_sec=3600.0)
+    t0 = 100_000.0
+    mon.observe_job("ta", evaluated=1, violated=1, now=t0)
+    mon.observe_job("tb", evaluated=1, violated=0, now=t0)
+    assert mon.burn_counts("slow", now=t0 + 10) == {"ta": 1}
+    # an hour later the breach has aged out: ta reads UNBURNT — the
+    # exact read the lifetime dict could never produce
+    assert mon.burn_counts("slow", now=t0 + 3700.0) == {}
+
+
+def test_admission_slo_burn_routes_through_monitor():
+    adm = AdmissionController()
+    adm.note_slo("ta", 1)
+    assert adm.slo_burn() == {"ta": 1}            # no monitor: dict
+    mon = _mon()
+    adm.burn_monitor = mon
+    t0 = 100_000.0
+    mon.observe_job("ta", evaluated=1, violated=1, now=t0)
+    assert adm.slo_burn(now=t0 + 10) == {"ta": 1}
+    # the monitor is the truth for tenants it has seen: the aged-out
+    # breach decays even though the lifetime dict still says 1
+    assert adm.slo_burn(now=t0 + 9999.0) == {}
+    assert adm.slo_burn_by_tenant == {"ta": 1}    # dict untouched
+    # dict entries for tenants the monitor never saw pass through
+    # (tests/tools seed burn directly)
+    adm.slo_burn_by_tenant["hot"] = 2
+    assert adm.slo_burn(now=t0 + 9999.0) == {"hot": 2}
+
+
+# =========================================================================
+# replay_burn: the whatif scorer
+# =========================================================================
+def test_replay_burn_pages_exactly_the_hung_tenant():
+    t0 = 200_000.0
+    events = []
+    for i in range(6):
+        events.append({"ev": "committed", "t": t0 + i,
+                       "tenant": "hung", "elapsed_sec": 9.0})
+        events.append({"ev": "committed", "t": t0 + i,
+                       "tenant": "fine", "elapsed_sec": 0.2})
+    events.append({"ev": "submitted", "t": t0, "tenant": "hung"})
+    out = B.replay_burn(events, {"e2e": 2.0}, min_violations=2)
+    assert out["states"]["hung"] == "page"
+    assert out["states"]["fine"] == "ok"
+    snap = out["snapshot"]
+    assert snap["tenants"]["hung"]["fast"]["ratio"] == 1.0
+    assert snap["tenants"]["fine"]["slow"]["violated"] == 0
+
+
+def test_replay_burn_old_breaches_read_ok_now():
+    t0 = 200_000.0
+    events = [{"ev": "committed", "t": t0 + i, "tenant": "ta",
+               "elapsed_sec": 9.0} for i in range(4)]
+    # scored AT the breach time: paging
+    assert B.replay_burn(events, {"e2e": 2.0})["states"]["ta"] \
+        == "page"
+    # scored two hours later: every breach aged out of both windows
+    assert B.replay_burn(events, {"e2e": 2.0},
+                         now=t0 + 7200.0)["states"]["ta"] == "ok"
+
+
+def test_replay_burn_no_objective_is_quiet():
+    events = [{"ev": "committed", "t": 1.0, "tenant": "ta",
+               "elapsed_sec": 9.0}]
+    assert B.replay_burn(events, {})["states"] == {}
+    assert B.replay_burn(events, None)["states"] == {}
+
+
+# =========================================================================
+# exposition
+# =========================================================================
+def test_burn_families_render_and_lint():
+    reg = MetricsRegistry()
+    mon = _mon(reg)
+    t0 = 10_000.0
+    for i in range(4):
+        mon.observe_job("ta", evaluated=1, violated=1, now=t0 + i)
+    mon.tick(t0 + 5)
+    reg.gauge("process/start_time_seconds").set(t0)
+    text = T.render_openmetrics(reg.snapshot(), worker="w0",
+                                restart_epoch=0)
+    assert ('s2c_burn_rate{tenant="ta",window="fast",worker="w0",'
+            'restart_epoch="0"} 1') in text
+    assert 's2c_burn_rate{tenant="ta",window="slow"' in text
+    assert ('s2c_burn_alert_state{tenant="ta",worker="w0",'
+            'restart_epoch="0"} 2') in text
+    # the raw windowed rings are internal state, not families
+    assert "s2c_burn_ta" not in text
+    assert T.lint_openmetrics(text) == []

@@ -1,0 +1,394 @@
+"""Rate cards (observability/ratecard.py): per-worker learned
+throughput constants with confidence gating, crash-safe persistence,
+decision-site consultation provenance, and the evidence-only fleet
+scale hint computed from them.
+
+Covers (ISSUE 19):
+* EWMA convergence + spread tracking;
+* min-sample and staleness confidence gates (consult falls back to
+  the caller's default, with an auditable provenance stamp);
+* atomic persistence across restarts — age stamps intact, restarts
+  (the exposition's restart-epoch) bumped per reload, corrupt files
+  read as absent-with-counter;
+* the job-snapshot fold at the ``_finalize_job`` choke point;
+* ``compute_scale_hint`` verdicts (refuse-to-guess, drain-over-target,
+  tenant-paging, headroom, in-band);
+* the link-constant aging unification with utils/linkprobe.py;
+* the exposition: s2c_rate_* families, restart_epoch label rules and
+  the process start-time gauge.
+"""
+
+import json
+import os
+
+import pytest
+
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.observability import ratecard as rc
+from sam2consensus_tpu.observability import telemetry as T
+from sam2consensus_tpu.observability.metrics import MetricsRegistry
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_cache(monkeypatch):
+    monkeypatch.setenv("S2C_JIT_CACHE", "")
+
+
+# =========================================================================
+# units: estimator
+# =========================================================================
+def test_ewma_converges_to_constant():
+    est = rc.RateEstimator()
+    for _ in range(50):
+        est.observe(120.0, now=1000.0)
+    assert est.mean == 120.0
+    assert est.stddev() == 0.0
+    assert est.n == 50
+
+
+def test_ewma_tracks_level_shift():
+    est = rc.RateEstimator()
+    for _ in range(20):
+        est.observe(100.0, now=1000.0)
+    for _ in range(20):
+        est.observe(200.0, now=1001.0)
+    # alpha=0.3: twenty samples at the new level all but complete the
+    # transition
+    assert 195.0 < est.mean <= 200.0
+    assert est.stddev() > 0.0            # spread reflects the shift
+
+
+def test_estimator_rejects_junk():
+    est = rc.RateEstimator()
+    for bad in (0.0, -5.0, float("nan"), float("inf")):
+        est.observe(bad)
+    assert est.n == 0
+    est.observe(3.0, now=50.0)
+    assert est.n == 1 and est.mean == 3.0
+
+
+# =========================================================================
+# confidence gates: min samples + staleness
+# =========================================================================
+def test_consult_min_sample_gate():
+    card = rc.RateCard(worker="w0")
+    now = 1000.0
+    v, prov = card.consult("decode_mbps_per_core", 330.0, now=now)
+    assert (v, prov["source"]) == (330.0, "default")
+    card.observe("decode_mbps_per_core", 100.0, now=now)
+    card.observe("decode_mbps_per_core", 100.0, now=now)
+    v, prov = card.consult("decode_mbps_per_core", 330.0, now=now)
+    assert prov["source"] == "default" and prov["n"] == 2   # gated
+    assert v == 330.0
+    card.observe("decode_mbps_per_core", 100.0, now=now)
+    v, prov = card.consult("decode_mbps_per_core", 330.0, now=now)
+    assert prov["source"] == "learned"
+    assert v == 100.0
+    assert prov["n"] == 3 and prov["default"] == 330.0
+
+
+def test_consult_staleness_gate(monkeypatch):
+    monkeypatch.setenv("S2C_LINK_CACHE_MAX_AGE", "100")
+    card = rc.RateCard(worker="w0")
+    for _ in range(5):
+        card.observe("wire_bps", 8e6, now=1000.0)
+    v, prov = card.consult("wire_bps", 1e6, now=1050.0)
+    assert prov["source"] == "learned" and v == 8e6
+    v, prov = card.consult("wire_bps", 1e6, now=1200.0)   # 200 s old
+    assert prov["source"] == "default" and v == 1e6
+    assert prov["age_sec"] == 200.0      # the audit trail survives
+
+
+def test_module_consult_without_card_serves_default():
+    rc.install(None)
+    v, prov = rc.consult("decode_mbps_per_core", 330.0)
+    assert v == 330.0 and prov == {"source": "default",
+                                   "key": "decode_mbps_per_core"}
+
+
+# =========================================================================
+# persistence: restart survival, age stamps, corruption
+# =========================================================================
+def test_save_load_roundtrip_preserves_age_and_bumps_restarts(tmp_path):
+    path = str(tmp_path / "ratecard-w0.json")
+    card = rc.RateCard(worker="w0", path=path)
+    for _ in range(4):
+        card.observe("warm_jobs_per_sec", 0.5, now=5000.0)
+    card.save(now=5010.0)
+
+    loaded = rc.RateCard.load(path, worker="w0")
+    assert loaded.restarts == 1          # second life of the card
+    v, prov = loaded.consult("warm_jobs_per_sec", 9.9, now=5020.0)
+    assert prov["source"] == "learned" and v == 0.5
+    # the age stamp is the PERSISTED observation time, not load time
+    assert prov["age_sec"] == 20.0
+
+    loaded.save(now=5030.0)
+    third = rc.RateCard.load(path, worker="w0")
+    assert third.restarts == 2
+
+
+def test_corrupt_card_reads_as_absent_with_counter(tmp_path):
+    path = str(tmp_path / "ratecard-w0.json")
+    with open(path, "w") as fh:
+        fh.write('{"schema": "s2c-ratecard/1", "rates": {tr')
+    reg = MetricsRegistry()
+    card = rc.RateCard.load(path, worker="w0", registry=reg)
+    assert card.restarts == 0
+    assert card.snapshot()["rates"] == {}
+    assert reg.value("rate/card_corrupt") == 1
+    # schema mismatch is the same verdict
+    with open(path, "w") as fh:
+        json.dump({"schema": "bogus/9", "rates": {}}, fh)
+    card = rc.RateCard.load(path, worker="w0", registry=reg)
+    assert card.snapshot()["rates"] == {}
+    assert reg.value("rate/card_corrupt") == 2
+
+
+def test_missing_card_is_fresh_not_corrupt(tmp_path):
+    reg = MetricsRegistry()
+    card = rc.RateCard.load(str(tmp_path / "nope.json"),
+                            worker="w0", registry=reg)
+    assert card.restarts == 0
+    assert reg.value("rate/card_corrupt") == 0
+
+
+# =========================================================================
+# the _finalize_job fold
+# =========================================================================
+def _snap(**counters):
+    return {"counters": counters, "gauges": {}}
+
+
+def test_observe_job_folds_expected_rates():
+    card = rc.RateCard(worker="w0")
+    seen = card.observe_job(
+        _snap(**{"phase/decode_sec": 2.0,
+                 "phase/pileup_dispatch_sec": 1.0,
+                 "phase/accumulate_sec": 0.5,
+                 "phase/stage_sec": 0.5,
+                 "phase/vote_sec": 0.4,
+                 "pileup/cells": 2e6,
+                 "wire/bytes": 3e6}),
+        elapsed_sec=5.0, input_bytes=100_000_000, decode_cores=4,
+        packed=False,
+        lifecycle={"steal_latency_sec": 2.5}, now=100.0)
+    assert seen["decode_mbps_per_core"] == 100 / 2.0 / 4
+    assert seen["dispatch_cells_per_sec"] == 2e6 / 2.0
+    assert seen["vote_sec_per_mcell"] == 0.4 / 2.0
+    assert seen["wire_bps"] == 3e6 / 1.5
+    assert seen["warm_jobs_per_sec"] == 1 / 5.0
+    assert seen["steal_sec"] == 2.5
+    assert seen["recovery_sec"] == 7.5
+
+
+def test_observe_job_guards_noise_denominators():
+    card = rc.RateCard(worker="w0")
+    seen = card.observe_job(
+        _snap(**{"phase/decode_sec": 0.001,       # sub-ms decode
+                 "pileup/cells": 10.0,            # trivial pileup
+                 "wire/bytes": 1000.0}),          # sub-MB wire
+        elapsed_sec=0.0001, input_bytes=500)
+    assert seen == {}                             # nothing learned
+
+
+def test_observe_job_packed_key():
+    card = rc.RateCard(worker="w0")
+    seen = card.observe_job(_snap(), elapsed_sec=2.0, packed=True)
+    assert seen == {"packed_jobs_per_sec": 0.5}
+
+
+# =========================================================================
+# scale hint
+# =========================================================================
+def _card_snap(jps, confident=True, key="warm_jobs_per_sec"):
+    return {"worker": "w", "restarts": 0,
+            "rates": {key: {"mean": jps, "stddev": 0.0, "n": 5,
+                            "age_sec": 1.0, "confident": confident}}}
+
+
+def test_scale_hint_refuses_to_guess_without_confident_cards():
+    hint = rc.compute_scale_hint(
+        [_card_snap(0.5, confident=False)], queue_depth=50, workers=1)
+    assert hint["verdict"] == "hold"
+    assert hint["reason"] == "no_confident_rate"
+    assert hint["projected_drain_sec"] is None
+    assert hint["delta"] == 0
+
+
+def test_scale_hint_up_when_drain_over_target():
+    # 100 jobs at 0.05 jobs/s = 2000 s projected vs a 600 s target
+    hint = rc.compute_scale_hint(
+        [_card_snap(0.05)], queue_depth=100, workers=1,
+        target_sec=600.0)
+    assert hint["verdict"] == "up" and hint["delta"] >= 1
+    assert hint["reason"] == "drain_over_target"
+    assert hint["projected_drain_sec"] == 2000.0
+
+
+def test_scale_hint_up_when_tenant_paging():
+    hint = rc.compute_scale_hint(
+        [_card_snap(10.0)], queue_depth=1, workers=1,
+        burn_states={"hot": "page", "cold": "ok"}, target_sec=600.0)
+    assert hint["verdict"] == "up" and hint["delta"] >= 1
+    assert hint["reason"] == "tenant_paging"
+    assert hint["paging_tenants"] == ["hot"]
+
+
+def test_scale_hint_down_on_headroom_and_hold_in_band():
+    # two workers, nearly empty queue, drain far under target
+    hint = rc.compute_scale_hint(
+        [_card_snap(1.0), _card_snap(1.0, key="packed_jobs_per_sec")],
+        queue_depth=1, workers=2, target_sec=600.0)
+    assert hint["verdict"] == "down" and hint["delta"] < 0
+    assert hint["reason"] == "headroom"
+    hint = rc.compute_scale_hint(
+        [_card_snap(0.02)], queue_depth=10, workers=1,
+        target_sec=600.0)
+    assert hint["verdict"] == "hold" and hint["reason"] == "in_band"
+
+
+# =========================================================================
+# link-constant aging unification (utils/linkprobe.py satellite)
+# =========================================================================
+def test_link_cache_age_is_the_ratecard_knob(monkeypatch):
+    from sam2consensus_tpu.utils import linkprobe
+
+    monkeypatch.delenv("S2C_LINK_CACHE_MAX_AGE", raising=False)
+    assert linkprobe.cache_max_age() == rc.max_age_sec() == 7 * 86400
+    monkeypatch.setenv("S2C_LINK_CACHE_MAX_AGE", "123")
+    assert linkprobe.cache_max_age() == 123.0
+    assert rc.max_age_sec() == 123.0
+
+
+def test_record_link_feeds_installed_card():
+    from sam2consensus_tpu.utils import linkprobe
+
+    card = rc.RateCard(worker="w0")
+    rc.install(card)
+    try:
+        linkprobe._record_link((0.2, 42e6))
+    finally:
+        rc.install(None)
+    snap = card.snapshot()
+    assert snap["rates"]["link_bps"]["mean"] == 42e6
+    assert snap["rates"]["link_rt_sec"]["mean"] == 0.2
+
+
+# =========================================================================
+# exposition: rate families, restart epoch, start-time gauge
+# =========================================================================
+def test_rate_families_render_and_lint(tmp_path):
+    reg = MetricsRegistry()
+    card = rc.RateCard(worker="w0",
+                       path=str(tmp_path / "ratecard-w0.json"))
+    for _ in range(4):
+        card.observe("decode_mbps_per_core", 80.0, now=100.0)
+    card.publish(reg, now=110.0)
+    reg.gauge("process/start_time_seconds").set(12345.0)
+    text = T.render_openmetrics(reg.snapshot(), worker="w0",
+                                restart_epoch=card.restarts)
+    assert 's2c_rate{key="decode_mbps_per_core"' in text
+    assert 's2c_rate_samples{key="decode_mbps_per_core"' in text
+    assert 's2c_rate_age_seconds{key="decode_mbps_per_core"' in text
+    assert 's2c_process_start_time_seconds' in text
+    assert 'restart_epoch="0"' in text
+    assert T.lint_openmetrics(text) == []
+
+
+def test_lint_rejects_restart_epoch_without_start_time():
+    reg = MetricsRegistry()
+    reg.add("serve/jobs", 1)
+    text = T.render_openmetrics(reg.snapshot(), worker="w0",
+                                restart_epoch=2)
+    errs = T.lint_openmetrics(text)
+    assert any("process_start_time" in e for e in errs)
+
+
+def test_lint_rejects_non_integer_restart_epoch():
+    reg = MetricsRegistry()
+    reg.gauge("process/start_time_seconds").set(1.0)
+    text = T.render_openmetrics(reg.snapshot(), worker="w0",
+                                restart_epoch=1)
+    bad = text.replace('restart_epoch="1"', 'restart_epoch="-1"')
+    assert any("restart_epoch" in e for e in T.lint_openmetrics(bad))
+    assert T.lint_openmetrics(text) == []
+
+
+def _sim(tmp, name, seed):
+    spec = SimSpec(n_contigs=1, contig_len=3000, n_reads=1000,
+                   read_len=100, contig_len_jitter=0.0, seed=seed,
+                   contig_prefix="rcrd")
+    path = os.path.join(str(tmp), name)
+    with open(path, "w") as fh:
+        fh.write(simulate(spec))
+    return path
+
+
+def test_serve_card_survives_restart_with_ages(tmp_path):
+    """A journaled server persists its card at job boundaries; the
+    next life loads it (restarts bumped, sample counts and age stamps
+    intact) and the health snapshot carries the card + scale hint."""
+    from sam2consensus_tpu.serve import JobSpec, ServeRunner
+
+    jdir = str(tmp_path / "journal")
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    path = _sim(tmp_path, "a.sam", seed=7)
+
+    def spec(jid):
+        return JobSpec(
+            filename=path, job_id=jid, tenant="ta",
+            config=RunConfig(backend="jax", pileup="scatter",
+                             shards=1, outfolder=str(outdir) + "/",
+                             prefix=jid))
+
+    r1 = ServeRunner(prewarm="off", persistent_cache=False,
+                     journal_dir=jdir, slo="e2e=60s")
+    try:
+        res = r1.submit_jobs([spec("j0"), spec("j1")])
+        assert all(r.ok for r in res)
+        card_file = rc.card_path(jdir, "serve")
+        assert os.path.exists(card_file)
+        blob = json.load(open(card_file))
+        assert blob["schema"] == rc.SCHEMA
+        n1 = blob["rates"]["warm_jobs_per_sec"]["n"]
+        assert n1 >= 2
+        h = r1.health_snapshot()
+        assert h["ratecard"]["restarts"] == 0
+        assert "warm_jobs_per_sec" in h["ratecard"]["rates"]
+        assert "scale_hint" in h           # tick ran at job end
+    finally:
+        r1.close()
+
+    r2 = ServeRunner(prewarm="off", persistent_cache=False,
+                     journal_dir=jdir, slo="e2e=60s")
+    try:
+        assert r2.ratecard.restarts == 1   # second life
+        snap = r2.ratecard.snapshot()
+        assert snap["rates"]["warm_jobs_per_sec"]["n"] == n1
+        # the age stamp survived the restart (measured-at, not loaded-at)
+        assert snap["rates"]["warm_jobs_per_sec"]["age_sec"] is not None
+        v, prov = r2.ratecard.consult("warm_jobs_per_sec", 0.0) \
+            if n1 >= rc.min_samples() else (None, {"source": "default"})
+        if n1 >= rc.min_samples():
+            assert prov["source"] == "learned" and v > 0
+    finally:
+        r2.close()
+
+
+def test_restart_epoch_label_change_does_not_trip_monotonicity():
+    reg = MetricsRegistry()
+    reg.add("serve/jobs", 5)
+    reg.gauge("process/start_time_seconds").set(1.0)
+    prev = T.render_openmetrics(reg.snapshot(), worker="w0",
+                                restart_epoch=0)
+    reg2 = MetricsRegistry()                      # restarted: reset
+    reg2.add("serve/jobs", 1)
+    reg2.gauge("process/start_time_seconds").set(2.0)
+    cur = T.render_openmetrics(reg2.snapshot(), worker="w0",
+                               restart_epoch=1)
+    # same worker, fewer jobs — but the epoch label makes it a NEW
+    # series, so the cross-scrape monotonicity check cannot false-fire
+    assert T.lint_openmetrics(cur, prev=prev) == []

@@ -159,6 +159,14 @@ def check_file(repo, name):
                         f"{name}:{lineno}: multi-host bench artifact "
                         f"{art!r} is not valid claim evidence "
                         f"({len(errs)} error(s); first: {errs[0]})")
+            elif os.path.basename(art).startswith("fleet_whatif") \
+                    and art.endswith(".jsonl"):
+                errs = lint_fleet_whatif_artifact(path)
+                if errs:
+                    violations.append(
+                        f"{name}:{lineno}: fleet-whatif artifact "
+                        f"{art!r} is not valid claim evidence "
+                        f"({len(errs)} error(s); first: {errs[0]})")
     return violations
 
 
@@ -195,6 +203,67 @@ def lint_fleet_soak_artifact(path):
         errs.append("summary identical_all is not true")
     if s.get("failures", 1) != 0:
         errs.append(f"summary failures={s.get('failures')}")
+    return errs
+
+
+def lint_fleet_whatif_artifact(path):
+    """Structural lint for a cited fleet-whatif JSONL
+    (tools/fleet_whatif.py, the ISSUE 19 evidence-plane harness):
+    parseable rows, a summary row, zero check failures, the scale-hint
+    row present with its drain residual inside the recorded band, the
+    burn verdicts matching the injected hang (hung tenant paged, fast
+    tenant ok — replayed AND live-after-restart), a surviving card
+    restart, and plane-on/off byte identity."""
+    import json
+
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = [ln for ln in fh if ln.strip()]
+    except OSError as exc:
+        return [f"unreadable: {exc}"]
+    rows = []
+    for i, ln in enumerate(lines, 1):
+        try:
+            rows.append(json.loads(ln))
+        except ValueError:
+            errs.append(f"line {i}: not JSON")
+    summaries = [r for r in rows if r.get("mode") == "summary"]
+    if not summaries:
+        errs.append("no summary row")
+        return errs
+    s = summaries[-1]
+    if s.get("failures", 1) != 0:
+        errs.append(f"summary failures={s.get('failures')}")
+    if not s.get("identical_all", False):
+        errs.append("summary identical_all is not true "
+                    "(plane on/off byte identity)")
+    for field, want in (("burn_verdicts", {"hung": "page",
+                                           "fast": "ok"}),
+                        ("burn_live_verdicts", {"hung": "page",
+                                                "fast": "ok"})):
+        got = s.get(field) or {}
+        for tenant, state in want.items():
+            if got.get(tenant) != state:
+                errs.append(f"summary {field}[{tenant!r}]="
+                            f"{got.get(tenant)!r}, want {state!r}")
+    if s.get("card_restarts") != 1:
+        errs.append(f"summary card_restarts={s.get('card_restarts')}"
+                    f" (card did not survive exactly one restart)")
+    hints = [r for r in rows
+             if r.get("check") == "scale_hint_drain_join"]
+    if not hints:
+        errs.append("no scale_hint_drain_join row")
+    else:
+        h = hints[-1]
+        resid, band = h.get("residual"), h.get("band")
+        if not h.get("ok"):
+            errs.append("scale_hint_drain_join row not ok")
+        if not (isinstance(resid, (int, float))
+                and isinstance(band, (int, float)) and band >= 1.0
+                and 1.0 / band <= resid <= band):
+            errs.append(f"scale-hint residual {resid!r} outside "
+                        f"band {band!r}")
     return errs
 
 

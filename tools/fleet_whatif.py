@@ -1,0 +1,372 @@
+#!/usr/bin/env python3
+"""Fleet what-if: score the evidence plane against a journaled soak.
+
+The ISSUE 19 acceptance harness for the rate-card / burn-alert /
+scale-hint plane (observability/ratecard.py, observability/burn.py):
+one journaled two-round soak with a hung tenant and a worker restart,
+replayed in hindsight and scored against what the plane predicted —
+
+* **round 1 (learn)** — worker ``w0`` drains a mixed queue (a fast
+  tenant and a deliberately heavy "hung" tenant) on a fresh journal;
+  its rate card learns the measured throughput constants and persists
+  next to the journal at every job boundary.  An e2e objective is
+  then chosen BETWEEN the two tenants' measured elapsed ranges (the
+  harness never guesses machine speed), and ``burn.replay_burn``
+  re-scores the committed events with their wall stamps: the hung
+  tenant must PAGE, the fast tenant must stay OK;
+* **restart (churn)** — ``w0``'s second life loads the persisted card
+  (restart epoch bumped, sample counts and age stamps intact — the
+  SIGKILL-survival claim: the card was durable at the last job
+  boundary, nothing depended on a clean shutdown).  Replaying the
+  shared journal feeds round 1's peer-committed breaches into the
+  LIVE burn monitor with their commit stamps, so the second life
+  pages the hung tenant before running a single job of its own;
+* **round 2 (joined drain)** — the scale hint computed from the
+  learned card BEFORE the round projects the queue's drain time; the
+  journal then measures the actual drain; the residual must land
+  within ``--band``.  The runner's own drain-episode join
+  (``scale_hint`` band=0 ledger decision, ``fleet/drain_episodes``)
+  must have fired;
+* **byte identity** — round 1's committed FASTA set is sha256-equal
+  to a plane-dark baseline of the same queue (no SLO, no confident
+  card: every consult serves defaults) — the evidence plane never
+  touches output bytes;
+* **exposition** — the second life's rendered telemetry carries the
+  ``restart_epoch`` label, the ``s2c_process_start_time_seconds``
+  gauge and the ``s2c_rate_*`` families, and lints clean.
+
+One JSON row per check + a ``"mode": "summary"`` row, as JSONL on
+stdout (or ``--out``); exit 0 iff every check passed.  Campaign step
+18 (tools/tpu_campaign.sh) commits the cpu-fallback artifact at
+campaign/fleet_whatif_r06_cpufallback.jsonl, which rides
+``tools/regress_check.py --jsonl`` and the structural
+``tools/check_perf_claims.py`` lint (hint row present, residual
+in-band, burn verdict matches the injected hang).
+
+Usage: python tools/fleet_whatif.py [--fast-jobs 3] [--hung-jobs 2]
+       [--reads 1500] [--hung-factor 8] [--band 6.0] [--out FILE]
+"""
+
+import argparse
+import json
+import math
+import os
+import shutil
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def sha_dir(d):
+    from sam2consensus_tpu.serve.benchmark import _sha_dir
+
+    return _sha_dir(d)
+
+
+def _sim_inputs(work, tag, n_fast, n_hung, reads, hung_factor,
+                contig_len, read_len, seed0):
+    from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+    jobs = []
+    for k in range(n_fast + n_hung):
+        hung = k >= n_fast
+        spec = SimSpec(
+            n_contigs=1,
+            contig_len=contig_len * (2 if hung else 1),
+            n_reads=reads * (hung_factor if hung else 1),
+            read_len=read_len, contig_len_jitter=0.0,
+            seed=seed0 + k, contig_prefix=f"wi{tag}{k:02d}_")
+        p = os.path.join(work, f"{tag}_job{k}.sam")
+        with open(p, "w") as fh:
+            fh.write(simulate(spec))
+        jobs.append((p, "hung" if hung else "fast"))
+    return jobs
+
+
+def _specs(jobs, outdir, tag):
+    from sam2consensus_tpu.config import RunConfig
+    from sam2consensus_tpu.serve import JobSpec
+
+    specs = []
+    for k, (path, tenant) in enumerate(jobs):
+        cfg = RunConfig(backend="jax", pileup="scatter", shards=1,
+                        outfolder=outdir + "/", prefix=f"{tag}{k}")
+        specs.append(JobSpec(filename=path, config=cfg,
+                             job_id=f"{tag}{k}", tenant=tenant))
+    return specs
+
+
+def _runner(**kw):
+    from sam2consensus_tpu.serve import ServeRunner
+
+    kw.setdefault("prewarm", "off")
+    kw.setdefault("persistent_cache", False)
+    return ServeRunner(**kw)
+
+
+def _journal_events(jdir):
+    from sam2consensus_tpu.serve.journal import JobJournal
+
+    return JobJournal(jdir, checkpoint_every=0).events()
+
+
+def _elapsed_by_tenant(events):
+    out = {}
+    for e in events:
+        if e.get("ev") == "committed" and "elapsed_sec" in e:
+            out.setdefault(e.get("tenant") or "default", []).append(
+                float(e["elapsed_sec"]))
+    return out
+
+
+def _drain_sec(events, keys):
+    """Journal-measured drain of a key set: first submit stamp to
+    last commit stamp (wall, from the events' own ``t``)."""
+    subs = [float(e["t"]) for e in events
+            if e.get("ev") == "submitted" and e.get("key") in keys]
+    coms = [float(e["t"]) for e in events
+            if e.get("ev") == "committed" and e.get("key") in keys]
+    if not subs or not coms:
+        return None
+    return max(coms) - min(subs)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--fast-jobs", type=int, default=3)
+    ap.add_argument("--hung-jobs", type=int, default=2)
+    ap.add_argument("--reads", type=int, default=1500)
+    ap.add_argument("--hung-factor", type=int, default=8,
+                    help="hung-tenant jobs carry this many times the "
+                         "fast tenant's reads (the injected 'hang' is "
+                         "honest slowness, not a sleep)")
+    ap.add_argument("--contig-len", type=int, default=3000)
+    ap.add_argument("--read-len", type=int, default=100)
+    ap.add_argument("--band", type=float, default=6.0,
+                    help="scale-hint drain residual band "
+                         "(measured/projected within [1/band, band])")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    if args.hung_jobs < 2:
+        ap.error("--hung-jobs must be >= 2 (one breach is a blip the "
+                 "hysteresis is REQUIRED to ignore)")
+
+    import tempfile
+
+    from sam2consensus_tpu.observability import burn as oburn
+    from sam2consensus_tpu.observability import ratecard as orc
+    from sam2consensus_tpu.observability import telemetry as otele
+
+    work = args.workdir or tempfile.mkdtemp(prefix="s2c_whatif_")
+    os.makedirs(work, exist_ok=True)
+    os.environ.setdefault("S2C_JIT_CACHE",
+                          os.path.join(work, "_jit_cache"))
+    log(f"[fleet_whatif] workdir {work}")
+
+    rows = []
+    failures = 0
+
+    def check(name, ok, **fields):
+        nonlocal failures
+        failures += 0 if ok else 1
+        rows.append({"check": name, "ok": bool(ok), **fields})
+        log(f"[fleet_whatif] {name}: " + ("OK" if ok else "FAIL")
+            + (f" {fields}" if not ok else ""))
+
+    q1 = _sim_inputs(work, "a", args.fast_jobs, args.hung_jobs,
+                     args.reads, args.hung_factor, args.contig_len,
+                     args.read_len, seed0=8100)
+    q2 = _sim_inputs(work, "b", args.fast_jobs, args.hung_jobs,
+                     args.reads, args.hung_factor, args.contig_len,
+                     args.read_len, seed0=8200)
+
+    # -- plane-dark baseline: the byte-identity oracle ----------------
+    base_out = os.path.join(work, "out_base")
+    os.makedirs(base_out, exist_ok=True)
+    r = _runner(journal_dir=os.path.join(work, "j_base"))
+    try:
+        res = r.submit_jobs(_specs(q1, base_out, "a"))
+        base_ok = all(x.ok for x in res)
+    finally:
+        r.close()
+    want = sha_dir(base_out)
+    log(f"[fleet_whatif] baseline: {len(want)} output file(s)")
+
+    jdir = os.path.join(work, "j_soak")
+    out1 = os.path.join(work, "out_r1")
+    os.makedirs(out1, exist_ok=True)
+
+    # -- round 1: w0 life 1 learns + commits the mixed queue ----------
+    t0 = time.monotonic()
+    r = _runner(journal_dir=jdir, worker_id="w0", lease_ttl=30.0)
+    try:
+        res1 = r.submit_jobs(_specs(q1, out1, "a"))
+        r1_ok = all(x.ok for x in res1)
+        card_file = orc.card_path(r.journal.root, "w0")
+    finally:
+        r.close()
+    r1_sec = time.monotonic() - t0
+    check("round1_drain", r1_ok, jobs=len(q1),
+          drain_sec=round(r1_sec, 3))
+
+    got = sha_dir(out1)
+    check("byte_identity_plane_on_vs_off", got == want and base_ok,
+          files=len(got))
+
+    # -- the persisted card: durable at the last job boundary ---------
+    card_blob = None
+    if os.path.exists(card_file):
+        with open(card_file) as fh:
+            card_blob = json.load(fh)
+    warm = ((card_blob or {}).get("rates") or {}).get(
+        "warm_jobs_per_sec") or {}
+    check("card_persisted", card_blob is not None
+          and card_blob.get("schema") == orc.SCHEMA
+          and int(warm.get("n", 0)) >= len(q1)
+          and float(warm.get("updated_unix", 0)) > 0,
+          path=os.path.basename(card_file),
+          samples=int(warm.get("n", 0)))
+
+    # -- choose the objective from the journal's own measurements -----
+    events = _journal_events(jdir)
+    by_tenant = _elapsed_by_tenant(events)
+    fast_max = max(by_tenant.get("fast") or [0.0])
+    hung_min = min(by_tenant.get("hung") or [float("inf")])
+    separated = 0.0 < fast_max < hung_min < float("inf")
+    objective = round(math.sqrt(fast_max * hung_min), 3) \
+        if separated else None
+    check("tenant_separation", separated,
+          fast_max_sec=round(fast_max, 3),
+          hung_min_sec=round(hung_min, 3) if hung_min < 1e9 else None,
+          e2e_objective_sec=objective)
+
+    # -- hindsight burn verdicts over the committed journal -----------
+    verdict = {}
+    if objective:
+        rb = oburn.replay_burn(events, {"e2e": objective})
+        verdict = rb["states"]
+        check("burn_replay_verdicts",
+              verdict.get("hung") == "page"
+              and verdict.get("fast") == "ok",
+              states=verdict, e2e_objective_sec=objective)
+    else:
+        check("burn_replay_verdicts", False, states={},
+              reason="no separated objective")
+
+    # -- restart: w0 life 2 — card ages intact, live burn from replay -
+    hint = None
+    hint_resid = None
+    lint_errs = None
+    r2_ok = False
+    expo_ok = False
+    joined = 0
+    live_states = {}
+    restarts = None
+    out2 = os.path.join(work, "out_r2")
+    os.makedirs(out2, exist_ok=True)
+    r = _runner(journal_dir=jdir, worker_id="w0", lease_ttl=30.0,
+                slo=f"e2e={objective}s" if objective else None)
+    try:
+        restarts = r.ratecard.restarts
+        snap = r.ratecard.snapshot()
+        w = snap["rates"].get("warm_jobs_per_sec") or {}
+        check("card_restart_survival", restarts == 1
+              and int(w.get("n", 0)) >= len(q1)
+              and w.get("age_sec") is not None
+              and w.get("confident") is True,
+              restarts=restarts, samples=int(w.get("n", 0)),
+              age_sec=w.get("age_sec"))
+
+        # the hint BEFORE round 2: projected drain for the new queue
+        hint = orc.compute_scale_hint([snap], queue_depth=len(q2),
+                                      workers=1)
+        res2 = r.submit_jobs(_specs(q2, out2, "b"))
+        r2_ok = all(x.ok for x in res2)
+        live_states = dict(r.burn.states())
+        joined = int(r.registry.value("fleet/drain_episodes"))
+        expo = r.render_telemetry()
+        lint_errs = otele.lint_openmetrics(expo)
+        expo_ok = (lint_errs == []
+                   and f'restart_epoch="{restarts}"' in expo
+                   and "s2c_process_start_time_seconds" in expo
+                   and 's2c_rate{key="warm_jobs_per_sec"' in expo
+                   and "s2c_burn_alert_state" in expo)
+    finally:
+        r.close()
+
+    check("burn_live_after_restart",
+          live_states.get("hung") == "page"
+          and live_states.get("fast") == "ok",
+          states=live_states)
+    check("exposition_lint", bool(expo_ok),
+          errors=(lint_errs or [])[:5], restart_epoch=restarts)
+
+    # -- round 2 measured drain vs the hint's projection --------------
+    events2 = _journal_events(jdir)
+    # round 2 keys: submitted events NOT present in round 1's scan
+    r1_keys = {e.get("key") for e in events
+               if e.get("ev") == "submitted"}
+    keys2 = {e.get("key") for e in events2
+             if e.get("ev") == "submitted"
+             and e.get("key") not in r1_keys}
+    measured = _drain_sec(events2, keys2)
+    projected = (hint or {}).get("projected_drain_sec")
+    if measured and projected:
+        hint_resid = round(measured / projected, 4)
+    check("scale_hint_drain_join",
+          r2_ok and hint is not None and projected is not None
+          and measured is not None
+          and hint_resid is not None
+          and 1.0 / args.band <= hint_resid <= args.band
+          and joined >= 1,
+          verdict=(hint or {}).get("verdict"),
+          reason=(hint or {}).get("reason"),
+          projected_drain_sec=projected,
+          measured_drain_sec=round(measured, 3) if measured else None,
+          residual=hint_resid, band=args.band,
+          drain_episodes_joined=joined)
+
+    summary = {
+        "mode": "summary",
+        "fast_jobs": args.fast_jobs, "hung_jobs": args.hung_jobs,
+        "reads": args.reads, "hung_factor": args.hung_factor,
+        "e2e_objective_sec": objective,
+        "burn_verdicts": verdict,
+        "burn_live_verdicts": live_states,
+        "card_restarts": restarts,
+        "hint_verdict": (hint or {}).get("verdict"),
+        "hint_projected_drain_sec": (hint or {}
+                                     ).get("projected_drain_sec"),
+        "hint_measured_drain_sec": round(measured, 3)
+        if measured else None,
+        "hint_residual": hint_resid,
+        "residual_band": args.band,
+        "identical_all": got == want,
+        "checks": len(rows),
+        "failures": failures,
+        "host_cores": os.cpu_count(),
+        "platform": os.environ.get("JAX_PLATFORMS", ""),
+    }
+    lines = [json.dumps(x) for x in rows] + [json.dumps(summary)]
+    blob = "\n".join(lines) + "\n"
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(blob)
+        log(f"[fleet_whatif] wrote {args.out}")
+    else:
+        sys.stdout.write(blob)
+    if not args.workdir:
+        shutil.rmtree(work, ignore_errors=True)
+    log(f"[fleet_whatif] {len(rows)} checks, {failures} failure(s)")
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

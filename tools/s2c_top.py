@@ -135,6 +135,33 @@ def render(health, samples, now=None):
             f"slo: objectives {slo.get('objectives')}  "
             f"violations {slo.get('violations', 0)}  "
             f"burn {slo.get('burn_by_tenant')}")
+    # burn-alert plane: one line per non-ok tenant (ok tenants stay
+    # quiet — the alert line IS the signal), with the fast/slow
+    # window ratios behind the verdict
+    bplane = health.get("burn") or {}
+    for t, ts in sorted((bplane.get("tenants") or {}).items()):
+        state = ts.get("state", "ok")
+        if state == "ok":
+            continue
+        fast = ts.get("fast") or {}
+        slow = ts.get("slow") or {}
+        lines.append(
+            f"burn ALERT [{state.upper()}] tenant {t}: "
+            f"fast {fast.get('violated', 0)}/{fast.get('evaluated', 0)} "
+            f"({100.0 * (fast.get('ratio') or 0):.0f}%)  "
+            f"slow {slow.get('violated', 0)}/{slow.get('evaluated', 0)} "
+            f"({100.0 * (slow.get('ratio') or 0):.0f}%)")
+    # evidence-only fleet scale hint (observability/ratecard.py)
+    hint = health.get("scale_hint") or {}
+    if hint:
+        drain = hint.get("projected_drain_sec")
+        lines.append(
+            f"scale hint: {hint.get('verdict', '?')} "
+            f"{hint.get('delta', 0):+d} worker(s)  "
+            f"[{hint.get('reason', '')}]  "
+            f"drain {_age_fmt(drain) if drain is not None else '?'} "
+            f"@ {hint.get('jobs_per_sec', 0):.3g} jobs/s "
+            f"({hint.get('confident_cards', 0)} confident card(s))")
     # continuous batching: prefer the live exposition gauges
     # (s2c_batch_* family), fall back to the health snapshot's batch
     # section when no exposition is wired
@@ -399,6 +426,33 @@ def render_fleet(healths, samples, now=None, stale=None):
             burn[t] = burn.get(t, 0) + n
     if burn:
         lines.append(f"slo burn by tenant (all workers): {burn}")
+    # worst burn-alert state + scale hint per worker (evidence plane)
+    paging = {}
+    for wid, h in live:
+        for t, ts in (((h.get("burn") or {}).get("tenants"))
+                      or {}).items():
+            st = ts.get("state", "ok")
+            if st != "ok":
+                cur = paging.get(t)
+                if cur is None or (st == "page" and cur != "page"):
+                    paging[t] = st
+    if paging:
+        lines.append("burn alerts: " + "  ".join(
+            f"{t}={s.upper()}" for t, s in sorted(paging.items())))
+    hints = [(wid, h.get("scale_hint")) for wid, h in live
+             if h.get("scale_hint")]
+    if hints:
+        # any worker arguing "up" wins the merged line (conservative:
+        # never under-report pressure); ties go to the latest worker
+        best = None
+        for wid, hint in hints:
+            if best is None or hint.get("verdict") == "up":
+                best = (wid, hint)
+        wid, hint = best
+        lines.append(
+            f"scale hint ({wid}): {hint.get('verdict', '?')} "
+            f"{hint.get('delta', 0):+d} worker(s) "
+            f"[{hint.get('reason', '')}]")
     tenants = _tenants(samples)
     if tenants:
         lines.append(f"{'tenant':<14} {'e2e p99 by worker':<40} "

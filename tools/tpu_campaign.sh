@@ -354,4 +354,20 @@ run_step multihost_bench "campaign/multihost_bench_$R.jsonl" \
   "campaign/multihost_bench_stderr_$R.log" 2400 \
   python tools/multihost_dryrun.py --bench --repeats 2 --out -
 
+# 18. evidence plane what-if (ISSUE 19): a journaled two-round soak
+# with a hung tenant and a worker restart, scored in hindsight —
+# burn alerts must page exactly the hung tenant (replayed AND live
+# after the restart), the rate card must survive the restart with its
+# sample counts and age stamps intact, the scale hint's projected
+# drain must join the journal-measured drain inside the recorded
+# residual band, and output FASTA must be byte-identical with the
+# plane dark.  One row per check + the summary row regress_check and
+# check_perf_claims consume:
+#   python tools/regress_check.py --jsonl campaign/fleet_whatif_$R.jsonl \
+#     --group-by check --value measured_drain_sec --lower-is-better
+# CPU-fallback harness proof: campaign/fleet_whatif_r06_cpufallback.jsonl
+run_step fleet_whatif "campaign/fleet_whatif_$R.jsonl" \
+  "campaign/fleet_whatif_stderr_$R.log" 1800 \
+  python tools/fleet_whatif.py
+
 echo "$(date +%H:%M:%S) campaign complete" >> "$LOG"
