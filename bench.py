@@ -41,7 +41,10 @@ base), BENCH_FLEET_JOBS / BENCH_FLEET_WORKERS (fleet queue-drain leg,
 defaults 6 / 2; 0 jobs disables), BENCH_STREAM_WAVES
 (streaming-session leg: the same reads absorbed live in N journaled
 waves with read-until early stop vs the one-shot cold job, default
-10; 0 disables), BENCH_FULL_OUT / BENCH_TAG (write the
+10; 0 disables), BENCH_COHORT_SAMPLES (cohort-serving leg: one
+shared-reference manifest streamed in packed waves vs the
+packed-stranger path, default 200; 0 disables), BENCH_FULL_OUT /
+BENCH_TAG (write the
 complete result object — every row, untruncated — to this path / to
 BENCH_<tag>.full.json, so downstream consumers stop recovering rows
 from head-truncated stdout captures).
@@ -787,6 +790,54 @@ def streaming_leg(n_waves):
     return row
 
 
+def cohort_leg(n_samples):
+    """The cohort-serving row (ISSUE 20 tentpole): N shared-reference
+    samples listed in ONE manifest and streamed through
+    serve/cohort.py in packed waves vs the PR-11 packed-STRANGER path
+    over the same job class (sam2consensus_tpu/serve/benchmark.py).
+    ``jax_sec`` is the cohort per-sample wall and ``vs_baseline`` the
+    cohort/stranger jobs-per-sec ratio (bigger = better, like every
+    row) so the regression gate judges the cohort series with the
+    same bands; the row also carries the zero-replan / zero-recompile
+    pins (one PanelGeometry + one compile footprint cover every wave)
+    and the concordance-vs-CPU-oracle verdict."""
+    from sam2consensus_tpu.serve.benchmark import run_cohort_bench
+
+    res = run_cohort_bench(n_samples=n_samples, log=log)
+    s = res["summary"]
+    row = {
+        "config": "cohort",
+        "samples": s["n_samples"],
+        "reads_per_sample": s["n_reads"],
+        "waves": s["waves"],
+        "jax_sec": round(s["cohort_sec"] / max(1, s["n_samples"]), 5),
+        "vs_baseline": round(s["jobs_per_sec"]
+                             / max(1e-9, s["stranger_jobs_per_sec"]),
+                             3),
+        "vs_baseline_kind": "packed_stranger",
+        "identical": s["identical"],
+        "cohort": {
+            "jobs_per_sec": s["jobs_per_sec"],
+            "stranger_jobs_per_sec": s["stranger_jobs_per_sec"],
+            "occupancy_pct": s["occupancy_pct"],
+            "panel_plans": s["panel_plans"],
+            "panel_reuses": s["panel_reuses"],
+            "replans_after_wave1": s["replans_after_wave1"],
+            "new_compiles_after_wave1": s["new_compiles_after_wave1"],
+            "concordance_pinned": s["concordance_pinned"],
+            "residual_in_band": s["residual_in_band"],
+            "ok": s["ok"],
+        },
+    }
+    log(f"[cohort] {s['samples_ok']}/{s['n_samples']} sample(s) at "
+        f"{s['jobs_per_sec']} jobs/s vs stranger "
+        f"{s['stranger_jobs_per_sec']} jobs/s, "
+        f"occupancy {s['occupancy_pct']}%, "
+        f"replans_after_wave1={s['replans_after_wave1']}, "
+        f"identical={s['identical']}")
+    return row
+
+
 def full_artifact_path():
     """Destination for the complete (untruncated) result object:
     BENCH_FULL_OUT wins, else BENCH_TAG -> BENCH_<tag>.full.json next
@@ -882,6 +933,15 @@ def main():
                 log(f"[streaming] FAILED: {type(exc).__name__}: {exc}")
                 rows.append({"config": "streaming",
                              "error": repr(exc)})
+        # cohort-serving leg: one manifest streamed in packed waves vs
+        # the packed-stranger path (BENCH_COHORT_SAMPLES=0 disables)
+        n_cohort = int(os.environ.get("BENCH_COHORT_SAMPLES", "200"))
+        if n_cohort > 0 and (not only or "cohort" in only):
+            try:
+                rows.append(cohort_leg(n_cohort))
+            except Exception as exc:
+                log(f"[cohort] FAILED: {type(exc).__name__}: {exc}")
+                rows.append({"config": "cohort", "error": repr(exc)})
         # incremental-consensus leg: +N% reads on a warm reference vs
         # the cold combined job (BENCH_INCR_PCT=0 disables)
         incr_pct = int(os.environ.get("BENCH_INCR_PCT", "10"))

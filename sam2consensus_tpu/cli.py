@@ -446,6 +446,36 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "more eligible jobs before flushing (default "
                         "50; live-arrival queues only — a pre-planned "
                         "queue arrives at once)")
+    # --- cohort serving (sam2consensus_tpu/serve/cohort.py) ---
+    p.add_argument("--cohort-manifest", dest="cohort_manifest",
+                   default=None,
+                   help="cohort mode: stream EVERY sample named by "
+                        "this manifest (a directory of .sam/.sam.gz/"
+                        ".bam files, a text file of paths/globs, or a "
+                        ".jsonl object-store-style listing with a "
+                        "'path' per row) through packed shared-panel "
+                        "waves — one submission, not N.  Implies "
+                        "--batch auto unless --batch is set; the "
+                        "shared reference layout is planned once and "
+                        "reused every wave, wave size follows the "
+                        "learned packed rate under --mem-budget/"
+                        "--max-queue caps, and --journal resumes an "
+                        "interrupted cohort at its last committed "
+                        "wave.  Does not compose with -i/--input or "
+                        "--ingest-port")
+    p.add_argument("--cohort-wave", dest="cohort_wave", type=int,
+                   default=0,
+                   help="fixed cohort wave size (members per packed "
+                        "wave); 0 (default) sizes waves from the "
+                        "learned cohort_jobs_per_sec rate card x "
+                        "S2C_COHORT_WAVE_SEC, clamped to the length/"
+                        "queue/memory caps")
+    p.add_argument("--cohort-summary", dest="cohort_summary",
+                   default=None,
+                   help="write the cohort summary JSON (waves, "
+                        "panel-plan reuse evidence, per-wave "
+                        "cohort_wave decisions, per-position call "
+                        "concordance) to this path")
     # --- incremental consensus (sam2consensus_tpu/serve/countcache.py) ---
     p.add_argument("--count-cache", dest="count_cache", default=None,
                    help="per-reference count cache byte budget (e.g. "
@@ -754,6 +784,77 @@ def _serve_sessions(args: argparse.Namespace, echo) -> int:
     return 0
 
 
+def _serve_cohort(args: argparse.Namespace, echo) -> int:
+    """``s2c serve --cohort-manifest M --batch auto``: stream one
+    manifest's samples through packed shared-panel waves
+    (serve/cohort.py).  Exit 0 iff every sample succeeded (resumed
+    samples count as succeeded — the journal already proved their
+    outputs)."""
+    import copy
+    import sys as _sys
+
+    from .serve import ServeRunner
+    from .serve.cohort import CohortRunner, load_manifest
+
+    try:
+        paths = load_manifest(args.cohort_manifest)
+    except (OSError, ValueError) as exc:
+        raise SystemExit(f"error: {exc}") from None
+    base_args = copy.copy(args)
+    base_args.filename = ""             # per-sample prefix, not per-job
+    base_args.prefix = ""
+    base_cfg = config_from_args(base_args)
+
+    runner = ServeRunner(prewarm=args.prewarm,
+                         decode_ahead=args.decode_ahead, echo=echo,
+                         journal_dir=args.journal,
+                         job_timeout=args.job_timeout,
+                         stall_timeout=args.stall_timeout,
+                         max_queue=args.max_queue,
+                         tenant_quota=args.tenant_quota,
+                         health_out=args.health_out,
+                         fault_inject=args.fault_inject,
+                         telemetry_out=args.telemetry_out,
+                         telemetry_port=args.telemetry_port,
+                         telemetry_interval=args.telemetry_interval,
+                         slo=args.slo,
+                         profile_capture_dir=args.profile_capture_dir,
+                         batch=args.batch if args.batch != "off"
+                         else "auto",
+                         batch_window=args.batch_window,
+                         mem_budget=args.mem_budget,
+                         verify_outputs=args.verify_outputs)
+    echo(f"\nCohort of {len(paths)} sample(s) from "
+         f"{args.cohort_manifest}"
+         + (f" (jit cache: {runner.cache_dir})" if runner.cache_dir
+            else "")
+         + (f" (journal: {runner.journal.root})" if runner.journal
+            else "") + "\n")
+    try:
+        cohort = CohortRunner(runner, paths, base_cfg,
+                              wave=args.cohort_wave,
+                              tenant=args.tenant,
+                              summary_out=args.cohort_summary,
+                              echo=echo)
+        summary = cohort.run()
+    finally:
+        runner.close()
+    for res in cohort.results:
+        if not res.ok:
+            print(f"job {res.job_id} FAILED: {res.error}",
+                  file=_sys.stderr)
+    conc = summary.get("concordance") or {}
+    echo(f"Cohort done: {summary['samples_ok']} ok + "
+         f"{summary['resumed']} resumed / {summary['samples_total']} "
+         f"sample(s) in {summary['waves']} wave(s), "
+         f"{summary['jobs_per_sec']} jobs/s"
+         + (f", mean concordance {conc['mean_concordance']}"
+            if conc else "") + ".\n")
+    if args.cohort_summary:
+        echo(f"Cohort summary at {args.cohort_summary}")
+    return 1 if summary["failed"] else 0
+
+
 def serve_main(argv: List[str]) -> int:
     """``s2c serve -i a.sam -i b.sam [...]``: run every input through
     one warm server; exit 0 iff every job succeeded."""
@@ -841,6 +942,40 @@ def serve_main(argv: List[str]) -> int:
     # fail the server start, not surface as a deep mid-wave error
     # (same up-front discipline as parse_slo / --fault-inject)
     session_mode = args.ingest_port is not None
+    # --- cohort cross-checks (serve/cohort.py): same fail-the-start
+    # discipline — a cohort flag combination that cannot work must
+    # reject before the server warms, not mid-manifest
+    cohort_mode = args.cohort_manifest is not None
+    if cohort_mode and session_mode:
+        raise SystemExit(
+            "error: --cohort-manifest does not compose with "
+            "--ingest-port (a cohort is a pre-planned manifest; "
+            "sessions are a live wave stream)")
+    if cohort_mode and args.inputs:
+        raise SystemExit(
+            "error: --cohort-manifest does not compose with "
+            "-i/--input (the manifest IS the input list — one "
+            "submission for the whole cohort)")
+    if cohort_mode and args.worker_id:
+        raise SystemExit(
+            "error: --cohort-manifest does not compose with "
+            "--worker-id (cohort waves ride packed batches, which "
+            "fleet workers exclude; shard cohorts by manifest "
+            "instead)")
+    if cohort_mode and args.incremental:
+        raise SystemExit(
+            "error: --cohort-manifest does not compose with "
+            "--incremental (incremental jobs are ineligible for "
+            "packing, so every wave would serialize)")
+    if cohort_mode and args.batch.strip().lower() in ("0", "1"):
+        raise SystemExit(
+            "error: --cohort-manifest needs packed waves: use "
+            "--batch auto or --batch N with N >= 2 (or omit --batch "
+            "— cohort mode defaults it to auto)")
+    if args.cohort_wave < 0 or args.cohort_wave == 1:
+        raise SystemExit(
+            "error: --cohort-wave must be 0 (rate-sized) or >= 2 "
+            "(a wave of one cannot pack)")
     if session_mode and not args.journal:
         raise SystemExit(
             "error: --ingest-port requires --journal (sessions are "
@@ -850,10 +985,11 @@ def serve_main(argv: List[str]) -> int:
         raise SystemExit(
             "error: --ingest-port does not compose with -i/--input "
             "(waves arrive over the ingest API, not a fixed queue)")
-    if not session_mode and not args.inputs:
+    if not session_mode and not cohort_mode and not args.inputs:
         raise SystemExit(
             "error: at least one -i/--input is required (or "
-            "--ingest-port to serve streaming sessions)")
+            "--ingest-port to serve streaming sessions, or "
+            "--cohort-manifest to serve a cohort)")
     if session_mode and args.batch != "off":
         raise SystemExit(
             "error: --ingest-port does not compose with --batch "
@@ -890,6 +1026,8 @@ def serve_main(argv: List[str]) -> int:
 
     if session_mode:
         return _serve_sessions(args, echo)
+    if cohort_mode:
+        return _serve_cohort(args, echo)
 
     specs = []
     for k, path in enumerate(args.inputs):

@@ -61,6 +61,10 @@ RATE_KEYS = (
     "link_rt_sec",              # probed link round-trip seconds
     "warm_jobs_per_sec",        # serial serve jobs/sec (1/elapsed)
     "packed_jobs_per_sec",      # batch-scheduler jobs/sec
+    "cohort_jobs_per_sec",      # cohort-wave samples/sec (serve/cohort
+                                # observes per wave; wave sizing
+                                # consults it, falling back to the
+                                # packed rate before wave 1)
     "steal_sec",                # lease-steal latency (expiry -> claim)
     "recovery_sec",             # steal latency + re-run wall seconds
     "capacity_residual_ratio",  # measured/predicted peak-bytes ratio

@@ -310,6 +310,39 @@ _HELP = {
                                "batch's merged slabs, percent.",
     "s2c_batch_jobs_per_sec": "Last batch's shared-phase throughput "
                               "(members / shared wall).",
+    # cohort serving (serve/cohort.py): the s2c_cohort_* family —
+    # manifest-streamed shared-panel waves
+    "s2c_cohort_waves_done": "Cohort waves fully finalized (journal "
+                             "cohort_wave markers written).",
+    "s2c_cohort_waves_total": "Estimated total waves (done + remaining "
+                              "at the last wave's size).",
+    "s2c_cohort_samples_done": "Cohort members finished or resumed "
+                               "from the journal.",
+    "s2c_cohort_samples_total": "Members the manifest resolved to.",
+    "s2c_cohort_jobs_per_sec": "Last wave's measured throughput "
+                               "(ok members / wave wall).",
+    "s2c_cohort_occupancy_pct": "Packed-slab occupancy of the last "
+                                "wave's batch, percent.",
+    "s2c_cohort_wave_wall_sec_total": "Cumulative wave wall seconds "
+                                      "(cohort_wave decisions' "
+                                      "measured denominator).",
+    "s2c_cohort_wave_jobs_total": "Members that finished OK inside a "
+                                  "packed cohort wave.",
+    "s2c_cohort_resumed_skipped_total": "Members skipped at cohort "
+                                        "start (journal-committed "
+                                        "with verified outputs).",
+    "s2c_cohort_prefetch_failed_total": "Wave-ahead header probes that "
+                                        "failed (the wave re-probes "
+                                        "inline).",
+    "s2c_cohort_admission_trips_total": "Wave sizes rejected by "
+                                        "admission and halved before "
+                                        "dispatch.",
+    "s2c_cohort_concordance_oracle_members_total":
+        "Serially-run members back-filled into the concordance table "
+        "via the CPU oracle accumulation.",
+    "s2c_cohort_concordance_skipped_total":
+        "Members whose counts reached neither the tap nor the oracle "
+        "(absent from the concordance table).",
     # incremental consensus (serve/countcache.py): the s2c_cache_*
     # family — per-reference device-resident count cache
     "s2c_cache_entries": "References with warm count state resident "

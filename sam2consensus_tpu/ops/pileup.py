@@ -241,6 +241,27 @@ def canonical_slab_shapes(total_len: int, read_len: int = 150,
     return sorted(set(shapes))
 
 
+def canonical_panel_shapes(panel_len: int, wave_jobs: int,
+                           read_len: int = 150,
+                           chunk_reads: int = 262144,
+                           n_reads: Optional[int] = None,
+                           segment_width: int = 0) -> list:
+    """The (rows, width) scatter shapes a shared-reference COHORT wave
+    dispatches — :func:`canonical_slab_shapes` over the combined panel
+    axis (``panel_len * wave_jobs`` positions; per-member read counts
+    sum across the wave).  A cohort driver prewarms this set once
+    before wave 1 (serve/cohort.py), so every wave of the cohort —
+    including the first — dispatches shapes the jit cache already
+    holds: the dedup story's compile half (the offset-table half lives
+    in serve/packing.PanelGeometry)."""
+    return canonical_slab_shapes(
+        int(panel_len) * max(1, int(wave_jobs)),
+        read_len=read_len, chunk_reads=chunk_reads,
+        n_reads=None if n_reads is None
+        else int(n_reads) * max(1, int(wave_jobs)),
+        segment_width=segment_width)
+
+
 def prewarm_scatter(total_len: int, shapes, device=None) -> int:
     """Compile the packed segment scatter for each ``(rows, width)`` in
     ``shapes`` without accumulating anything: all-PAD operands redirect

@@ -204,6 +204,26 @@ class AdmissionController:
             return Decision(False, reason=REASON_CAPACITY)
         return Decision(True)
 
+    def price_cohort_wave(self, wave_jobs: int,
+                          predicted_bytes: int = 0) -> Decision:
+        """One cohort wave's capacity verdict (serve/cohort.py).
+
+        Like :meth:`price_wave`, cohort waves are not window-scoped
+        jobs — the queue/tenant window counters are untouched.  The
+        single gate is the capacity plane: a wave whose predicted
+        combined peak (``memplane.predict_job_peak_bytes`` over the
+        wave's combined panel axis) exceeds ``--mem-budget`` would OOM
+        the warm server mid-cohort.  The cohort driver SIZES waves so
+        this verdict admits (``serve/cohort.size_wave`` binary-searches
+        the largest fitting wave), then prices the chosen size here —
+        so "no admission trips mid-cohort" is checked, not assumed."""
+        if wave_jobs < 1:
+            return Decision(False, reason=REASON_CAPACITY)
+        if self.mem_budget and predicted_bytes \
+                and predicted_bytes > self.mem_budget:
+            return Decision(False, reason=REASON_CAPACITY)
+        return Decision(True)
+
     def pin_rung(self, tenant: str) -> Optional[str]:
         """The rung a tenant's next job must run on (None = fast path).
         Consulted at JOB-START time, not admission time — a tenant

@@ -185,6 +185,17 @@ def snapshot(runner) -> dict:
     smgr = getattr(runner, "sessions", None)
     if smgr is not None:
         snap["sessions"] = smgr.health_summary()
+    # cohort serving (serve/cohort.py): manifest progress — waves
+    # done/estimated, samples done/total, last wave's rate + occupancy
+    # — the prober's (and s2c_top's) view of a streaming cohort.
+    # Guarded like every optional section: a cohort mid-teardown must
+    # never 500 a health scrape
+    cohort = getattr(runner, "cohort", None)
+    if cohort is not None:
+        try:
+            snap["cohort"] = cohort.health_summary()
+        except Exception:
+            pass
     slo_obj = getattr(runner, "slo", None)
     if slo_obj or reg.value("slo/violations"):
         # windowed burn read when the runner attached a monitor: a

@@ -134,7 +134,12 @@ KEY_FIELDS = ("thresholds", "min_depth", "fill", "maxdel", "prefix",
 EVENTS = ("submitted", "started", "committed", "failed", "rejected",
           "resumed", "claimed", "lease_renewed", "lease_expired",
           "session_open", "wave_received", "wave_absorbed",
-          "wave_rejected", "session_stable", "session_closed")
+          "wave_rejected", "session_stable", "session_closed",
+          "cohort_wave")
+#: ``cohort_wave`` (serve/cohort.py) marks one manifest wave fully
+#: finalized — the cohort driver's resume position.  Replay ignores it
+#: for job state (member jobs carry their own per-job lifecycles; the
+#: wave marker is an audit/progress record, not a commit fence).
 
 #: default appends between checkpoint segments (S2C_JOURNAL_CKPT_EVERY
 #: overrides; 0 disables).  Small enough that a busy fleet journal's

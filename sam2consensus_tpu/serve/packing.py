@@ -16,12 +16,15 @@ This module is the pure layer: offset planning, slab merging, count
 extraction, occupancy accounting.  No device work, no scheduling
 policy — both live with their owners (ops/pileup.py, serve/scheduler).
 
-Merged slabs stay on the CANONICAL shape grid (encoder bucket widths ×
-pow2 row paddings, floor 1024 — the exact family
-``ops.pileup.canonical_slab_shapes`` enumerates and the serve prewarm
-compiles), so a packed batch dispatches shapes the warm server has
-already compiled: packing changes how FULL the slabs are, never which
-programs run.
+Merged slabs stay on the CANONICAL shape grid: encoder bucket widths ×
+pow2 row counts.  This module pads rows pow2 with a floor of 8
+(:func:`_pad_rows` is the one authoritative statement of that
+contract); the accumulator's pad-tail trim then re-rounds each
+dispatch to pow2 of the REAL rows (``ops/pileup.py`` ``add``), landing
+on the same canonical family ``ops.pileup.canonical_slab_shapes``
+enumerates and the serve prewarm compiles — so a packed batch
+dispatches shapes the warm server has already compiled: packing
+changes how FULL the slabs are, never which programs run.
 """
 
 from __future__ import annotations
@@ -97,11 +100,12 @@ def _real_rows(starts: np.ndarray, codes: np.ndarray
 
 
 def _pad_rows(n: int) -> int:
-    """Merged-slab row padding: pow2 (floor 8).  The accumulator's
-    pad-tail trim re-rounds to pow2 of the REAL rows before dispatching
-    anyway (ops/pileup.py ``add``), so the dispatch shapes stay on the
-    same canonical grid the prewarm compiles — this pad only squares
-    the host array."""
+    """Merged-slab row padding: pow2, floor 8 — the authoritative
+    statement of the packing layer's row-padding contract (the module
+    docstring defers here).  The accumulator's pad-tail trim re-rounds
+    to pow2 of the REAL rows before dispatching anyway (ops/pileup.py
+    ``add``), so the dispatch shapes stay on the same canonical grid
+    the prewarm compiles — this pad only squares the host array."""
     return 1 << max(3, (max(1, n) - 1).bit_length())
 
 
@@ -148,7 +152,13 @@ def merge_batches(plan: PackPlan,
         slist, clist = by_w[w]
         starts = np.concatenate(slist) if len(slist) > 1 else slist[0]
         codes = np.concatenate(clist) if len(clist) > 1 else clist[0]
-        step = max(1024, (max_cells // int(w)) // 1024 * 1024)
+        # rows per slab under the cell budget: align down to 1024-row
+        # stripes when the budget allows one, else take the exact row
+        # budget (floor 1 row) — a wide bucket must never mint a slab
+        # over ``max_cells`` just to reach the alignment stripe
+        budget_rows = max(1, max_cells // int(w))
+        step = budget_rows // 1024 * 1024 if budget_rows >= 1024 \
+            else budget_rows
         for lo in range(0, len(starts), step):
             s = starts[lo:lo + step]
             c = codes[lo:lo + step]
@@ -187,3 +197,75 @@ def extract_member(combined_counts: np.ndarray, member: PackedMember
     lo = member.offset
     return np.ascontiguousarray(
         combined_counts[lo:lo + member.total_len])
+
+
+# -- shared-reference cohorts (layout dedup) --------------------------------
+def reference_fingerprint(contigs: Iterable) -> str:
+    """Order-sensitive fingerprint of a reference set: sha1 over the
+    header's (name, length) pairs.  Two inputs with equal fingerprints
+    declare byte-identical reference LAYOUTS — same contigs, same
+    lengths, same order — which is exactly the condition under which a
+    pack plan's offset table can be shared verbatim across jobs
+    (offsets are cumulative lengths, nothing else).  Accepts Contig
+    objects or plain ``(name, length)`` pairs."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for c in contigs:
+        name = getattr(c, "name", None)
+        if name is None:
+            name, length = c
+        else:
+            length = c.length
+        h.update(str(name).encode("utf-8", "replace"))
+        h.update(b"\x00")
+        h.update(str(int(length)).encode("ascii"))
+        h.update(b"\x00")
+    return h.hexdigest()[:16]
+
+
+@dataclass
+class PanelGeometry:
+    """ONE canonical slab geometry for a shared-reference cohort.
+
+    When every member of a batch targets the same reference panel
+    (equal :func:`reference_fingerprint`, hence equal ``total_len``),
+    the offset table degenerates to ``k * panel_len`` — so the
+    geometry is planned ONCE and every subsequent wave reuses the
+    cached table by prefix (a wave of ``n <= max_jobs`` members takes
+    ``offsets[:n]``).  ``plans_built`` / ``reuses`` are the re-plan
+    evidence the cohort bench gates on: after wave 1, ``plans_built``
+    stays at 1 and every wave increments ``reuses``."""
+
+    fingerprint: str
+    panel_len: int
+    max_jobs: int
+    offsets: Tuple[int, ...] = ()
+    plans_built: int = 0
+    reuses: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.offsets:
+            self.offsets = tuple(k * int(self.panel_len)
+                                 for k in range(int(self.max_jobs)))
+
+    def plan_wave(self, job_ids: Sequence[str]) -> PackPlan:
+        """A wave's :class:`PackPlan` from the cached offset table.
+
+        Fresh :class:`PackedMember` objects each call (the scheduler
+        mutates ``n_events`` per wave), but zero re-planning: offsets
+        come straight from the table built at construction."""
+        if len(job_ids) > self.max_jobs:
+            raise ValueError(
+                f"wave of {len(job_ids)} members exceeds the panel "
+                f"geometry's {self.max_jobs}-job table")
+        if self.plans_built:
+            self.reuses += 1
+        else:
+            self.plans_built = 1
+        plan = PackPlan(total_len=len(job_ids) * self.panel_len)
+        for k, job_id in enumerate(job_ids):
+            plan.members.append(PackedMember(job_id=job_id,
+                                             total_len=self.panel_len,
+                                             offset=self.offsets[k]))
+        return plan

@@ -169,6 +169,19 @@ class BatchScheduler:
         #: ledger decision predicts from it; None until the first batch
         #: (which additionally bills the first-compile term)
         self._rate: Optional[float] = None
+        #: shared-reference layout dedup (serve/packing.PanelGeometry):
+        #: (header fingerprint, panel_len) -> the ONE canonical offset
+        #: table a same-panel cohort reuses across every wave.  The
+        #: ``batch/panel_plans`` / ``batch/panel_reuses`` counters are
+        #: the cohort bench's zero-re-plans evidence.
+        self._panel_geoms: Dict[Tuple[str, int],
+                                packing.PanelGeometry] = {}
+        #: cohort prefetch hand-off (serve/cohort.py): filename ->
+        #: probe fields (total_len/handle/bytes/fingerprint) computed
+        #: on the prefetch thread while the PREVIOUS wave dispatches —
+        #: ``_probe_total_len`` consumes an entry instead of re-opening
+        #: and re-sniffing the container on the critical path.
+        self.probe_cache: Dict[str, dict] = {}
 
     @property
     def enabled(self) -> bool:
@@ -184,6 +197,11 @@ class BatchScheduler:
         from it instead of re-opening and re-sniffing the container."""
         if "batch_total_len" in entry:
             return entry["batch_total_len"]
+        pre = self.probe_cache.pop(entry["spec"].filename, None) \
+            if self.probe_cache else None
+        if pre is not None:
+            entry.update(pre)
+            return entry["batch_total_len"]
         total = None
         try:
             from ..config import resolve_decode_threads
@@ -197,6 +215,10 @@ class BatchScheduler:
                 threads=resolve_decode_threads(entry["cfg"]))
             total = GenomeLayout(ai.contigs).total_len
             entry["batch_handle"] = ai
+            # the fingerprint is free here (the contigs are parsed) and
+            # is what lets run_batch reuse a same-panel offset table
+            entry["batch_ref_fp"] = packing.reference_fingerprint(
+                ai.contigs)
             try:
                 entry["batch_bytes"] = os.path.getsize(
                     entry["spec"].filename)
@@ -364,9 +386,7 @@ class BatchScheduler:
         #    analogue of the serial path's prefetcher.  Failure
         #    bookkeeping (journal, admission, fold) is deferred to THIS
         #    thread — those surfaces are not concurrent-safe.
-        plan_pk = packing.plan_pack(
-            [(m.entry["job_id"], m.entry["batch_total_len"])
-             for m in members])
+        plan_pk = self._plan_members(members)
         for j, (m, pm) in enumerate(zip(members, plan_pk.members)):
             m.pm = pm
             m.ordinal = j
@@ -485,6 +505,19 @@ class BatchScheduler:
             # the failed members' finalize cleared in_flight; the live
             # remainder is still executing
             runner.health.job_started(f"{bid}[{len(live)} live]")
+        tap = getattr(runner, "count_tap", None)
+        if tap is not None and counts is not None:
+            # cohort concordance feed (serve/cohort.py): each live
+            # member's private partition sliced from the combined
+            # tensor the batch just fetched — zero extra device work.
+            # Absorbed on failure: the tap is an observer, never a
+            # reason a job fails.
+            for m in live:
+                try:
+                    tap(m.entry["job_id"],
+                        packing.extract_member(counts, m.pm))
+                except Exception:
+                    runner.registry.add("batch/tap_failed", 1)
         total_events = sum(mm.n_events for mm in plan_pk.members) or 1
         dispatch_sec = sum(t1 - t0 for t0, t1 in dlog)
         shared_wall = time.perf_counter() - t_batch0
@@ -510,6 +543,11 @@ class BatchScheduler:
         reg.gauge("batch/size").set(float(n))
         reg.gauge("batch/occupancy_pct").set(
             round(100.0 * plan_pk.occupancy, 2))
+        # raw merge accounting: the cohort driver reads real_rows to
+        # learn rows-per-member, which its occupancy-aware wave sizing
+        # snaps against pow2 pad boundaries (serve/cohort.py size_wave)
+        reg.gauge("batch/real_rows").set(float(plan_pk.real_rows))
+        reg.gauge("batch/padded_rows").set(float(plan_pk.padded_rows))
         reg.gauge("batch/jobs_per_sec").set(
             round(n / shared_wall, 3) if shared_wall > 0 else 0.0)
         binfo = {"batch": bid, "jobs": n,
@@ -625,6 +663,35 @@ class BatchScheduler:
                 finished[m.index] = m.res
         runner.health.job_finished()
         return finished, []
+
+    def _plan_members(self, members: List[_Member]) -> packing.PackPlan:
+        """Offset-plan a batch, deduplicating shared-reference layouts.
+
+        When every member declares the same header fingerprint (hence
+        the same panel length), the batch takes its offsets from the
+        cached :class:`~.packing.PanelGeometry` table — planned once
+        per (fingerprint, panel_len) and reused verbatim by every
+        later same-panel batch/wave.  ``batch/panel_plans`` counts the
+        builds and ``batch/panel_reuses`` the table hits: the cohort
+        bench's zero-re-plans-after-wave-1 evidence.  Mixed-stranger
+        batches keep the per-batch ``plan_pack`` path unchanged."""
+        fps = {m.entry.get("batch_ref_fp") for m in members}
+        lens = {m.entry["batch_total_len"] for m in members}
+        if len(fps) == 1 and None not in fps and len(lens) == 1:
+            key = (next(iter(fps)), int(next(iter(lens))))
+            geom = self._panel_geoms.get(key)
+            if geom is None or geom.max_jobs < len(members):
+                geom = packing.PanelGeometry(
+                    fingerprint=key[0], panel_len=key[1],
+                    max_jobs=max(len(members), self.max_jobs))
+                self._panel_geoms[key] = geom
+                self.runner.registry.add("batch/panel_plans", 1)
+            else:
+                self.runner.registry.add("batch/panel_reuses", 1)
+            return geom.plan_wave([m.entry["job_id"] for m in members])
+        return packing.plan_pack(
+            [(m.entry["job_id"], m.entry["batch_total_len"])
+             for m in members])
 
     # -- phases ------------------------------------------------------------
     def _decode_member(self, m: _Member) -> None:

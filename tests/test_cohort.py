@@ -1,0 +1,421 @@
+"""Cohort-scale serving (serve/cohort.py + the ISSUE-20 packing
+additions): the shared-reference wave-streaming pins.
+
+* layout dedup: equal reference fingerprints share ONE PanelGeometry;
+  ``plan_wave`` reuses the cached offset table and ``extract_member``
+  over the deduped plan is byte-identical to serial accumulation;
+* ``merge_batches`` cell-budget regression: a wide bucket whose row
+  budget sits under the 1024-row alignment stripe must still split
+  under ``max_cells`` (the satellite-1 floor fix);
+* manifest loading: directory scan, JSONL object-store listing, text
+  lists with globs/comments, and the zero-input ValueError;
+* wave sizing: hard caps (combined-length, ``--max-queue``,
+  ``--mem-budget``), the floor-2 rule, explicit-wave clamping, the pow2
+  occupancy snap — and the final-wave no-snap rule;
+* the ConcordanceAccumulator's tally/digest semantics;
+* end-to-end: a multi-wave cohort through one ServeRunner is
+  byte-identical to serial, plans ONE panel geometry, prices a
+  ``cohort_wave`` ledger decision per wave, reports progress through
+  health/s2c_top, and resumes from the journal;
+* CLI: cohort flag combinations that cannot work fail at start.
+"""
+
+import json
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from sam2consensus_tpu.config import RunConfig
+from sam2consensus_tpu.constants import PAD_CODE
+from sam2consensus_tpu.encoder.events import SegmentBatch
+from sam2consensus_tpu.io.fasta import render_file
+from sam2consensus_tpu.serve import JobSpec, packing
+from sam2consensus_tpu.serve.cohort import (ConcordanceAccumulator,
+                                            CohortRunner, load_manifest,
+                                            size_wave, wave_cap)
+from sam2consensus_tpu.utils.simulate import SimSpec, simulate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_cache(monkeypatch):
+    monkeypatch.setenv("S2C_JIT_CACHE", "")
+
+
+def _sim_member(tmp, k, n_reads=48, contig_len=900):
+    """One cohort member: every member shares the reference LAYOUT
+    (same contig name + length -> equal fingerprint) while the reads
+    differ per seed — the exact sameness class cohort dedup keys on."""
+    spec = SimSpec(n_contigs=1, contig_len=contig_len, n_reads=n_reads,
+                   read_len=100, contig_len_jitter=0.0,
+                   seed=30_000 + k, contig_prefix="cohtest")
+    path = os.path.join(str(tmp), f"coh_{k:03d}.sam")
+    with open(path, "w") as fh:
+        fh.write(simulate(spec))
+    return path
+
+
+def _runner(**kw):
+    from sam2consensus_tpu.serve import ServeRunner
+
+    kw.setdefault("prewarm", "off")
+    kw.setdefault("persistent_cache", False)
+    kw.setdefault("echo", lambda *a, **k: None)
+    return ServeRunner(**kw)
+
+
+def _rendered(res):
+    return {n: render_file(r, 0) for n, r in res.fastas.items()}
+
+
+# -- layout dedup ----------------------------------------------------------
+def test_reference_fingerprint_layout_semantics():
+    fp = packing.reference_fingerprint([("chr1", 100), ("chr2", 50)])
+    assert fp == packing.reference_fingerprint(
+        [("chr1", 100), ("chr2", 50)])
+    # order-sensitive: offsets are cumulative lengths
+    assert fp != packing.reference_fingerprint(
+        [("chr2", 50), ("chr1", 100)])
+    assert fp != packing.reference_fingerprint(
+        [("chr1", 100), ("chr2", 51)])
+    # Contig objects and plain pairs fingerprint identically
+    c1 = types.SimpleNamespace(name="chr1", length=100)
+    c2 = types.SimpleNamespace(name="chr2", length=50)
+    assert fp == packing.reference_fingerprint([c1, c2])
+
+
+def test_panel_geometry_reuse_and_member_extraction():
+    geom = packing.PanelGeometry(fingerprint="f" * 16, panel_len=100,
+                                 max_jobs=8)
+    assert geom.offsets == tuple(k * 100 for k in range(8))
+    plan = geom.plan_wave(["a", "b", "c"])
+    assert geom.plans_built == 1 and geom.reuses == 0
+    assert plan.total_len == 300
+    assert [m.offset for m in plan.members] == [0, 100, 200]
+    # every later wave — any size under the cap — is a reuse
+    plan2 = geom.plan_wave(["d", "e", "f", "g", "h"])
+    assert geom.plans_built == 1 and geom.reuses == 1
+    assert [m.offset for m in plan2.members] == [0, 100, 200, 300, 400]
+    with pytest.raises(ValueError):
+        geom.plan_wave([f"j{i}" for i in range(9)])
+    # extract_member over the deduped plan: each member's slice of the
+    # combined tensor is exactly its private partition
+    combined = np.arange(300 * 6).reshape(300, 6)
+    for k, m in enumerate(plan.members):
+        part = packing.extract_member(combined, m)
+        assert np.array_equal(part, combined[k * 100:(k + 1) * 100])
+        assert part.flags["C_CONTIGUOUS"]
+
+
+def test_merge_batches_wide_bucket_cell_budget():
+    """Satellite-1 regression: a bucket wider than ``max_cells/1024``
+    used to round its row budget DOWN to the 1024-row stripe (to zero
+    rows per slab) or mint a single over-budget slab; the floor fix
+    must split such buckets into slabs that each respect max_cells
+    without dropping rows."""
+    w, n_rows = 4096, 40
+    max_cells = 8 * w            # budget_rows = 8, far under the stripe
+    starts = np.arange(n_rows, dtype=np.int32)
+    codes = np.ones((n_rows, w), dtype=np.uint8)
+    plan = packing.plan_pack([("solo", n_rows * w)])
+    batch = SegmentBatch(buckets={w: (starts, codes)},
+                         n_events=n_rows * w)
+    merged = packing.merge_batches(plan, [(plan.members[0], [batch])],
+                                   max_cells=max_cells)
+    assert merged, "wide bucket produced no slabs"
+    got_rows = 0
+    for sb in merged:
+        (st, mat), = sb.buckets.values()
+        real = int((~(mat == PAD_CODE).all(axis=1)).sum())
+        got_rows += real
+        assert real * w <= max_cells, \
+            f"slab of {real} real rows x {w} exceeds max_cells"
+    assert got_rows == n_rows        # no rows dropped by the split
+    assert plan.real_rows == n_rows
+    assert plan.merged_slabs == len(merged) >= 5
+
+
+def test_pad_rows_contract():
+    """_pad_rows is the one authoritative padding statement: pow2 with
+    a floor of 8 (the module docstring defers here)."""
+    assert [packing._pad_rows(n) for n in (1, 7, 8, 9, 64, 65)] == \
+        [8, 8, 8, 16, 64, 128]
+
+
+# -- manifest loading ------------------------------------------------------
+def test_load_manifest_directory(tmp_path):
+    for name in ("b.sam", "a.sam", "c.bam", "d.sam.gz", "skip.txt"):
+        (tmp_path / name).write_text("")
+    got = load_manifest(str(tmp_path))
+    assert [os.path.basename(p) for p in got] == \
+        ["a.sam", "b.sam", "c.bam", "d.sam.gz"]
+
+
+def test_load_manifest_jsonl(tmp_path):
+    man = tmp_path / "listing.jsonl"
+    man.write_text(json.dumps({"path": "x.sam"}) + "\n"
+                   + json.dumps({"path": "/abs/y.sam"}) + "\n")
+    got = load_manifest(str(man))
+    assert got == [str(tmp_path / "x.sam"), "/abs/y.sam"]
+    man.write_text(json.dumps({"size": 3}) + "\n")
+    with pytest.raises(ValueError, match="no 'path' key"):
+        load_manifest(str(man))
+    man.write_text("{not json\n")
+    with pytest.raises(ValueError, match="not JSON"):
+        load_manifest(str(man))
+
+
+def test_load_manifest_text_globs_and_comments(tmp_path):
+    for name in ("g1.sam", "g2.sam", "one.sam"):
+        (tmp_path / name).write_text("")
+    man = tmp_path / "manifest.txt"
+    man.write_text("# cohort members\n\none.sam\ng*.sam\n")
+    got = load_manifest(str(man))
+    assert [os.path.basename(p) for p in got] == \
+        ["one.sam", "g1.sam", "g2.sam"]
+
+
+def test_load_manifest_empty_is_an_error(tmp_path):
+    (tmp_path / "empty.txt").write_text("# nothing\n")
+    with pytest.raises(ValueError, match="zero inputs"):
+        load_manifest(str(tmp_path / "empty.txt"))
+    os.mkdir(tmp_path / "emptydir")
+    with pytest.raises(ValueError, match="zero inputs"):
+        load_manifest(str(tmp_path / "emptydir"))
+
+
+# -- wave sizing -----------------------------------------------------------
+def _sched(max_combined_len=1_000_000):
+    return types.SimpleNamespace(max_combined_len=max_combined_len)
+
+
+def _admission(max_queue=0, mem_budget=0):
+    return types.SimpleNamespace(max_queue=max_queue,
+                                 mem_budget=mem_budget)
+
+
+def test_wave_cap_combined_length_and_queue():
+    cap, inputs = wave_cap(100, 100, None, _sched(1000), _admission())
+    assert cap == 10 and inputs["len_cap"] == 10
+    cap, inputs = wave_cap(100, 100, None, _sched(1000),
+                           _admission(max_queue=4))
+    assert cap == 4 and inputs["queue_cap"] == 4
+    cap, _ = wave_cap(3, 100, None, _sched(1000), _admission())
+    assert cap == 3                      # never beyond the remainder
+    with pytest.raises(ValueError, match="cannot pack"):
+        wave_cap(100, 80, None, _sched(100), _admission())
+
+
+def test_wave_cap_mem_budget_binary_search(monkeypatch):
+    from sam2consensus_tpu.observability import memplane
+
+    # linear model: W members x 100 positions -> W * 1000 bytes
+    monkeypatch.setattr(memplane, "predict_job_peak_bytes",
+                        lambda total_len, cfg: total_len * 10)
+    cap, inputs = wave_cap(100, 100, None, _sched(),
+                           _admission(mem_budget=5_000))
+    assert cap == 5 and inputs["mem_cap"] == 5
+    with pytest.raises(ValueError, match="mem-budget"):
+        wave_cap(100, 100, None, _sched(),
+                 _admission(mem_budget=1_500))   # even W=2 won't fit
+
+
+def test_size_wave_rate_target_and_floors():
+    # rate target: jps * wave_sec, floored at 2 (a wave of 1 can't pack)
+    w, inputs = size_wave(100, 100, None, _sched(), _admission(),
+                          jps=5.0, wave_sec=2.0)
+    assert w == 10 and inputs["rate_target"] == 10
+    w, _ = size_wave(100, 100, None, _sched(), _admission(),
+                     jps=0.1, wave_sec=2.0)
+    assert w == 2
+    # explicit --cohort-wave wins but clamps to the hard cap
+    w, inputs = size_wave(100, 100, None, _sched(1000), _admission(),
+                          requested=64)
+    assert w == 10 and inputs["requested"] == 64
+    # the remainder is the last clamp
+    w, _ = size_wave(3, 100, None, _sched(), _admission(), requested=8)
+    assert w == 3
+
+
+def test_size_wave_pow2_snap_and_final_wave_rule():
+    # rows_per_member=16 at a 10-member target: 160 rows pad to 256
+    # (62% full) while 8 members' 128 rows land exactly on a pow2
+    # boundary — the snap takes 8
+    w, inputs = size_wave(100, 100, None, _sched(), _admission(),
+                          jps=5.0, wave_sec=2.0, rows_per_member=16.0)
+    assert w == 8
+    assert inputs["occupancy_target_pct"] == 100.0
+    # ...but NEVER for the final wave: shrinking below the remainder
+    # would mint an extra wave, and wave fixed costs beat pad rows
+    w, inputs = size_wave(11, 100, None, _sched(), _admission(),
+                          jps=5.0, wave_sec=2.0, rows_per_member=16.0)
+    assert w == 10 and "occupancy_target_pct" not in inputs
+
+
+# -- concordance -----------------------------------------------------------
+def test_concordance_accumulator_tally_and_digest():
+    acc = ConcordanceAccumulator(3)
+    a = np.zeros((3, 6), dtype=np.int64)
+    a[0, 1] = 5                       # pos0: call 1
+    a[1, 2] = 4                       # pos1: call 2; pos2: no depth
+    b = np.zeros((3, 6), dtype=np.int64)
+    b[0, 1] = 2                       # pos0 agrees
+    b[1, 3] = 9                       # pos1 disagrees
+    acc.add_member(a)
+    acc.add_member(b)
+    s = acc.summary()
+    assert s["members"] == 2 and s["panel_len"] == 3
+    # pos0: 2/2 agree; pos1: 1/2 modal; pos2: nobody called -> 1.0
+    assert s["min_concordance"] == 0.5
+    assert s["discordant_positions"] == 1
+    assert s["mean_concordance"] == round((1.0 + 0.5 + 1.0) / 3, 6)
+    # digest is the pin: same members -> same digest, differing
+    # members -> different
+    acc2 = ConcordanceAccumulator(3)
+    acc2.add_member(a)
+    acc2.add_member(b)
+    assert acc2.summary()["digest"] == s["digest"]
+    acc2.add_member(a)
+    assert acc2.summary()["digest"] != s["digest"]
+    with pytest.raises(ValueError, match="positions"):
+        acc.add_member(np.zeros((4, 6), dtype=np.int64))
+
+
+# -- end-to-end ------------------------------------------------------------
+def test_cohort_multiwave_byte_identity_and_single_plan(tmp_path):
+    """A 10-member cohort at --cohort-wave 4 (3 waves): outputs
+    byte-identical to serial, ONE panel plan with a reuse per wave,
+    a cohort_wave ledger decision per wave, occupancy accounted, and
+    live progress visible through health + s2c_top."""
+    paths = [_sim_member(tmp_path, k) for k in range(10)]
+    cfg = RunConfig(backend="jax", prefix="",
+                    outfolder=str(tmp_path / "out_c"))
+
+    from sam2consensus_tpu.config import default_prefix
+
+    rs = _runner(batch="off")
+    serial = rs.submit_jobs(
+        [JobSpec(filename=p, config=RunConfig(
+            backend="jax", prefix=default_prefix(p),
+            outfolder=str(tmp_path / "out_s")), job_id=f"s{k}")
+         for k, p in enumerate(paths)])
+    rs.close()
+
+    rp = _runner(batch="auto")
+    try:
+        cohort = CohortRunner(rp, paths, cfg, wave=4)
+        assert rp.cohort is cohort       # health sees live progress
+        summary = cohort.run()
+        health = rp.health_snapshot()
+        reg = rp.registry
+        real = reg.snapshot()["gauges"].get(
+            "batch/real_rows", {}).get("value", 0.0)
+        padded = reg.snapshot()["gauges"].get(
+            "batch/padded_rows", {}).get("value", 0.0)
+    finally:
+        rp.close()
+
+    assert summary["samples_ok"] == 10 and summary["failed"] == 0
+    assert summary["waves"] == 3
+    # layout dedup: ONE plan, every wave a prefix-slice reuse
+    assert summary["panel_plans"] == 1
+    assert summary["panel_reuses"] >= 3
+    # one cohort_wave decision per wave, jobs priced = jobs measured
+    decisions = summary["decisions"]
+    assert len(decisions) == 3
+    assert [d["inputs"]["wave_jobs"] for d in decisions] == [4, 4, 2]
+    assert all(d["decision"] == "cohort_wave" for d in decisions)
+    # multi-wave occupancy accounting: the last wave's merge gauges
+    # are real and pow2-padded
+    assert 0 < real <= padded
+    assert cohort.last_wave["occupancy_pct"] > 0
+    # byte identity member-for-member vs the serial runner
+    by_file = {r.filename: r for r in cohort.results}
+    for k, (p, rser) in enumerate(zip(paths, serial)):
+        rc = by_file[p]
+        assert rc.ok and rser.ok
+        assert _rendered(rc) == _rendered(rser), f"member {k} differs"
+    # concordance accumulated every member
+    conc = summary["concordance"]
+    assert conc["members"] == 10 and conc["digest"]
+    # health + s2c_top surfacing
+    coh = health["cohort"]
+    assert coh["waves_done"] == 3
+    assert coh["samples_done"] == 10
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import s2c_top
+    finally:
+        sys.path.pop(0)
+    lines = s2c_top.render(health, [])
+    cline = [ln for ln in lines if ln.startswith("cohort:")]
+    assert cline and "wave 3/3" in cline[0] \
+        and "samples 10/10" in cline[0]
+
+
+def test_cohort_resumes_from_journal(tmp_path):
+    """Kill-and-restart semantics without the kill: run half the
+    cohort under a journal, then hand the FULL manifest to a fresh
+    process-equivalent runner — the resumed cohort must skip every
+    committed member and only run the remainder."""
+    paths = [_sim_member(tmp_path, k, n_reads=32, contig_len=600)
+             for k in range(6)]
+    jdir = str(tmp_path / "journal")
+    cfg = RunConfig(backend="jax", prefix="",
+                    outfolder=str(tmp_path / "out"))
+    r1 = _runner(batch="auto", journal_dir=jdir)
+    try:
+        CohortRunner(r1, paths[:3], cfg, wave=3).run()
+    finally:
+        r1.close()
+    r2 = _runner(batch="auto", journal_dir=jdir)
+    try:
+        cohort = CohortRunner(r2, paths, cfg, wave=3)
+        summary = cohort.run()
+    finally:
+        r2.close()
+    assert summary["resumed"] == 3
+    assert summary["samples_ok"] == 3 and summary["failed"] == 0
+    assert summary["waves"] == 1      # only the pending half ran
+    # the journal carries one cohort_wave marker per finished wave
+    # (one ev-NNNNNNNN.json segment per event)
+    events = []
+    for name in sorted(os.listdir(jdir)):
+        if name.startswith("ev-") and name.endswith(".json"):
+            with open(os.path.join(jdir, name)) as fh:
+                events.append(json.load(fh))
+    waves = [e for e in events if e.get("ev") == "cohort_wave"]
+    assert len(waves) == 2            # one per run
+    assert all(e["fingerprint"] for e in waves)
+
+
+def test_cohort_requires_batch_scheduler(tmp_path):
+    p = _sim_member(tmp_path, 0)
+    cfg = RunConfig(backend="jax")
+    r = _runner(batch="off")
+    try:
+        with pytest.raises(ValueError, match="--batch"):
+            CohortRunner(r, [p], cfg)
+    finally:
+        r.close()
+
+
+# -- CLI cross-checks ------------------------------------------------------
+@pytest.mark.parametrize("argv", [
+    ["--cohort-manifest", "m.txt", "-i", "x.sam"],
+    ["--cohort-manifest", "m.txt", "--batch", "0"],
+    ["--cohort-manifest", "m.txt", "--batch", "1"],
+    ["--cohort-manifest", "m.txt", "--worker-id", "w1"],
+    ["--cohort-manifest", "m.txt", "--ingest-port", "0"],
+    ["--cohort-manifest", "m.txt", "--cohort-wave", "1"],
+    ["-i", "x.sam", "--cohort-wave", "-2"],
+])
+def test_serve_cli_rejects_bad_cohort_combos(argv):
+    from sam2consensus_tpu.cli import serve_main
+
+    with pytest.raises(SystemExit):
+        serve_main(argv)

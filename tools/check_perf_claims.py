@@ -167,7 +167,69 @@ def check_file(repo, name):
                         f"{name}:{lineno}: fleet-whatif artifact "
                         f"{art!r} is not valid claim evidence "
                         f"({len(errs)} error(s); first: {errs[0]})")
+            elif os.path.basename(art).startswith("cohort") \
+                    and art.endswith(".jsonl"):
+                errs = lint_cohort_bench_artifact(path)
+                if errs:
+                    violations.append(
+                        f"{name}:{lineno}: cohort bench artifact "
+                        f"{art!r} is not valid claim evidence "
+                        f"({len(errs)} error(s); first: {errs[0]})")
     return violations
+
+
+def lint_cohort_bench_artifact(path):
+    """Structural lint for a cited cohort-bench JSONL
+    (tools/cohort_bench.py, the ISSUE 20 cohort-serving evidence):
+    parseable rows, at least one cohort_wave row, a summary row, and
+    the summary's acceptance pins intact — zero failed members, spot
+    checks byte-identical to serial, the concordance digest pinned to
+    the CPU oracle, zero re-plans and zero new compiles after wave 1
+    (one PanelGeometry and one compile footprint cover every wave),
+    no drifted cohort_wave decision, and cohort jobs/s at or above the
+    packed-stranger leg.  An artifact recording any broken pin is no
+    more evidence than a missing file."""
+    import json
+
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = [ln for ln in fh if ln.strip()]
+    except OSError as exc:
+        return [f"unreadable: {exc}"]
+    rows = []
+    for i, ln in enumerate(lines, 1):
+        try:
+            rows.append(json.loads(ln))
+        except ValueError:
+            errs.append(f"line {i}: not JSON")
+    if not any(r.get("mode") == "cohort_wave" for r in rows):
+        errs.append("no cohort_wave rows")
+    summaries = [r for r in rows if r.get("mode") == "summary"]
+    if not summaries:
+        errs.append("no summary row")
+        return errs
+    s = summaries[-1]
+    if s.get("failed", 1) != 0:
+        errs.append(f"summary failed={s.get('failed')}")
+    if not s.get("identical", False):
+        errs.append("summary identical is not true (spot-checked "
+                    "members differ from serial)")
+    if not s.get("concordance_pinned", False):
+        errs.append("summary concordance_pinned is not true")
+    if s.get("replans_after_wave1", 1) != 0:
+        errs.append(f"summary replans_after_wave1="
+                    f"{s.get('replans_after_wave1')}")
+    if s.get("new_compiles_after_wave1", 1) != 0:
+        errs.append(f"summary new_compiles_after_wave1="
+                    f"{s.get('new_compiles_after_wave1')}")
+    if not s.get("residual_in_band", False):
+        errs.append("summary residual_in_band is not true")
+    if not s.get("cohort_ge_stranger", False):
+        errs.append("summary cohort_ge_stranger is not true")
+    if not s.get("ok", False):
+        errs.append("summary ok is not true")
+    return errs
 
 
 def lint_fleet_soak_artifact(path):

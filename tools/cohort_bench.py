@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""Cohort-scale serving benchmark (the ISSUE-20 tentpole's evidence).
+
+Simulates N shared-reference samples, lists them in ONE manifest, and
+streams them through serve/cohort.py in packed waves — then measures
+the same job class through the plain packed-stranger path (PR 11's
+batch scheduler with no cohort planning) and a fresh serial runner for
+byte-identity spot checks.  One JSON row per wave/leg plus a summary
+row as JSONL (``--out``; stdout otherwise).
+
+The summary's acceptance fields: ``identical`` (spot-checked members
+byte-equal to serial), ``concordance_pinned`` (mini-cohort concordance
+digest == CPU oracle digest), ``replans_after_wave1`` /
+``new_compiles_after_wave1`` (both 0: one PanelGeometry + one compile
+footprint cover every wave), ``residual_in_band`` (no cohort_wave
+decision drifted once learned), ``cohort_ge_stranger`` (cohort jobs/s
+>= packed-stranger jobs/s), and the rolled-up ``ok``.
+
+Campaign usage (tools/tpu_campaign.sh step ``cohort``) runs 10k small
+samples; the CPU-fallback harness proof lives at
+campaign/cohort_r06_cpufallback.jsonl.
+
+Usage: python tools/cohort_bench.py [--samples 200] [--reads 64]
+       [--contig-len 1500] [--wave 0] [--spot-checks 20]
+       [--mem-budget BYTES] [--out FILE.jsonl]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--samples", type=int, default=200)
+    ap.add_argument("--reads", type=int, default=64)
+    ap.add_argument("--contig-len", type=int, default=1500)
+    ap.add_argument("--read-len", type=int, default=100)
+    ap.add_argument("--wave", type=int, default=0,
+                    help="fixed wave size (0 = rate-sized, the serve "
+                         "default)")
+    ap.add_argument("--stranger-n", type=int, default=0,
+                    help="members for the packed-stranger comparison "
+                         "leg (0 = 4x the stranger batch)")
+    ap.add_argument("--stranger-batch", type=int, default=8)
+    ap.add_argument("--spot-checks", type=int, default=20)
+    ap.add_argument("--pin-members", type=int, default=24,
+                    help="mini-cohort size for the concordance-vs-"
+                         "oracle pin")
+    ap.add_argument("--mem-budget", type=int, default=0,
+                    help="bytes; forwarded to the runner so wave "
+                         "sizing must respect it (0 = unbudgeted)")
+    ap.add_argument("--out", default=None,
+                    help="JSONL destination (default: stdout)")
+    args = ap.parse_args(argv)
+
+    from sam2consensus_tpu.utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
+
+    from sam2consensus_tpu.serve.benchmark import run_cohort_bench
+
+    res = run_cohort_bench(
+        n_samples=args.samples, n_reads=args.reads,
+        contig_len=args.contig_len, read_len=args.read_len,
+        wave=args.wave, stranger_n=args.stranger_n,
+        stranger_batch=args.stranger_batch,
+        spot_checks=args.spot_checks, pin_members=args.pin_members,
+        mem_budget=args.mem_budget, log=log)
+    lines = [json.dumps(r) for r in res["rows"]]
+    lines.append(json.dumps(res["summary"]))
+    blob = "\n".join(lines) + "\n"
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(blob)
+        log(f"[cohort_bench] wrote {args.out}")
+    else:
+        sys.stdout.write(blob)
+    return 0 if res["summary"]["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -183,6 +183,30 @@ def render(health, samples, now=None):
             f"{0.0 if bjps is None else bjps:.1f} packed-jobs/s  "
             f"({int(npacked or 0)} packed total"
             + (f", mode {bat.get('mode')}" if bat else "") + ")")
+    # cohort serving (s2c_cohort_* family, falling back to the health
+    # snapshot's cohort section): manifest progress in one line —
+    # waves done/total, samples/s, last wave's packed occupancy
+    cwd = _sample(samples, "s2c_cohort_waves_done")
+    cwt = _sample(samples, "s2c_cohort_waves_total")
+    csd = _sample(samples, "s2c_cohort_samples_done")
+    cst = _sample(samples, "s2c_cohort_samples_total")
+    cjps = _sample(samples, "s2c_cohort_jobs_per_sec")
+    cocc = _sample(samples, "s2c_cohort_occupancy_pct")
+    coh = health.get("cohort") or {}
+    if cwd is None and coh:
+        cwd = coh.get("waves_done")
+        cwt = coh.get("waves_total_est")
+        csd = coh.get("samples_done")
+        cst = coh.get("samples_total")
+        lw = coh.get("last_wave") or {}
+        cjps = lw.get("jobs_per_sec")
+        cocc = lw.get("occupancy_pct")
+    if cwd is not None or coh:
+        lines.append(
+            f"cohort: wave {int(cwd or 0)}/{int(cwt or 0)}  "
+            f"samples {int(csd or 0)}/{int(cst or 0)}  "
+            f"{0.0 if cjps is None else cjps:.1f} samples/s  "
+            f"occupancy {0.0 if cocc is None else cocc:.1f}%")
     # incremental count cache (s2c_cache_* family, falling back to the
     # health snapshot's count_cache section when no exposition is wired)
     cent = _sample(samples, "s2c_cache_entries")

@@ -370,4 +370,28 @@ run_step fleet_whatif "campaign/fleet_whatif_$R.jsonl" \
   "campaign/fleet_whatif_stderr_$R.log" 1800 \
   python tools/fleet_whatif.py
 
+# 19. cohort-scale batching (ISSUE 20): 10k shared-reference samples
+# from ONE manifest submission streamed in occupancy-aware packed
+# waves vs the PR-11 packed-stranger path (median-of-3) on the same
+# job class.  The summary row's acceptance fields: identical (20
+# random members byte-equal to a fresh serial runner),
+# concordance_pinned (24-member mini-cohort concordance digest ==
+# the CPU oracle's), replans_after_wave1 == 0 and
+# new_compiles_after_wave1 == 0 (ONE PanelGeometry + one compile
+# footprint cover every wave), residual_in_band (no cohort_wave
+# decision drifted once its rate was learned), cohort_ge_stranger.
+# Each cohort_wave row carries that wave's packed jobs/s and slab
+# occupancy, so the regression gate compares the LAST wave against the
+# earlier ones — a late-cohort rate collapse or occupancy decay fails
+# the gate even when the summary roll-up still looks healthy:
+#   python tools/regress_check.py --jsonl campaign/cohort_$R.jsonl \
+#     --group-by mode --value jobs_per_sec
+#   python tools/regress_check.py --jsonl campaign/cohort_$R.jsonl \
+#     --group-by mode --value occupancy_pct
+# CPU-fallback harness proof: campaign/cohort_r06_cpufallback.jsonl
+run_step cohort "campaign/cohort_$R.jsonl" \
+  "campaign/cohort_stderr_$R.log" 3600 \
+  python tools/cohort_bench.py --samples 10000 --reads 64 \
+  --contig-len 1500 --out -
+
 echo "$(date +%H:%M:%S) campaign complete" >> "$LOG"
